@@ -1,0 +1,52 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Core API mirrors the reference's surface (init/remote/get/put/wait/kill,
+actors, placement groups) while the compute path is pure JAX/XLA/Pallas over
+TPU meshes. See SURVEY.md for the reference analysis this build follows.
+"""
+
+from ray_tpu._private.api import (
+    available_resources,
+    cluster_resources,
+    cluster_state,
+    free,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu._private.worker import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cluster_resources",
+    "cluster_state",
+    "exceptions",
+    "free",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "__version__",
+]

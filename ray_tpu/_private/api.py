@@ -1,0 +1,179 @@
+"""Public API implementation: init/shutdown/remote/get/put/wait/kill.
+
+(reference: python/ray/_private/worker.py — init:1427, shutdown:2072,
+get:2821, plus the @ray.remote decorator plumbing.)
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+import threading
+from typing import Any, Sequence
+
+from ray_tpu._private.local_mode import LocalWorker
+from ray_tpu._private.node import Node
+from ray_tpu._private.worker import CoreWorker, ObjectRef, set_global_worker
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.remote_function import RemoteFunction
+
+_lock = threading.RLock()
+_node: Node | None = None
+_worker = None  # CoreWorker | LocalWorker
+_is_worker_process = False
+
+
+def _get_worker():
+    global _worker
+    with _lock:
+        if _worker is None:
+            # inside a worker subprocess, the global CoreWorker is set by worker_main
+            from ray_tpu._private.worker import _global_worker as gw
+
+            if gw is not None:
+                return gw
+            init()
+        return _worker
+
+
+def is_initialized() -> bool:
+    from ray_tpu._private.worker import _global_worker as gw
+
+    return _worker is not None or gw is not None
+
+
+def init(
+    *,
+    local_mode: bool = False,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict | None = None,
+    num_workers: int = 0,
+    max_workers: int = 16,
+    ignore_reinit_error: bool = True,
+):
+    """Start (or connect to) a session. Returns a context dict."""
+    global _node, _worker
+    with _lock:
+        if _worker is not None:
+            if ignore_reinit_error:
+                return {"session_id": getattr(_node, "session_id", "local")}
+            raise RayTpuError("ray_tpu already initialized")
+        if local_mode or os.environ.get("RAY_TPU_LOCAL_MODE") == "1":
+            _worker = LocalWorker()
+            set_global_worker(None)
+            return {"session_id": "local"}
+        _node = Node(
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            num_workers=num_workers,
+            max_workers=max_workers,
+        )
+        _worker = CoreWorker(_node.socket_path, _node.session_id, kind="driver")
+        atexit.register(shutdown)
+        if num_workers:
+            # block until the pre-spawned pool registers (slow interpreters on
+            # small hosts otherwise make scheduling look nondeterministic)
+            import time as _time
+
+            deadline = _time.monotonic() + 60.0
+            while _time.monotonic() < deadline:
+                if _worker.cluster_state()["num_workers"] >= num_workers:
+                    break
+                _time.sleep(0.05)
+        return {"session_id": _node.session_id, "session_dir": _node.session_dir}
+
+
+def shutdown():
+    global _node, _worker
+    with _lock:
+        if _worker is not None and isinstance(_worker, CoreWorker):
+            _worker.disconnect()
+        if _node is not None:
+            _node.shutdown()
+        _node = None
+        _worker = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes, with or without options."""
+
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **kwargs)
+        return RemoteFunction(obj, **kwargs)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or inspect.isclass(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return decorate
+
+
+def get(refs, *, timeout: float | None = None):
+    return _get_worker().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _get_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: float | None = None):
+    return _get_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _get_worker().kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str) -> ActorHandle:
+    aid = _get_worker().get_named_actor(name)
+    if aid is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(aid)
+
+
+def free(refs: Sequence[ObjectRef]):
+    _get_worker().free(refs)
+
+
+def cluster_resources() -> dict:
+    return _get_worker().cluster_state()["total_resources"]
+
+
+def available_resources() -> dict:
+    return _get_worker().cluster_state()["available_resources"]
+
+
+def cluster_state() -> dict:
+    return _get_worker().cluster_state()
+
+
+def timeline() -> list:
+    return []  # populated once task-event tracing lands
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._w = worker
+
+    @property
+    def was_current_actor_restarted(self):
+        return False
+
+    def get_actor_id(self):
+        return getattr(self._w, "current_actor_id", None)
+
+    def get_task_id(self):
+        return getattr(self._w, "current_task_id", None)
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_get_worker())

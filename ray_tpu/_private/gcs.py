@@ -1,0 +1,564 @@
+"""GCS — the control plane: object directory, scheduler, actor manager, KV.
+
+One process-wide server thread accepting unix-socket connections from the
+driver and worker processes. Collapses the reference's head-node GcsServer +
+per-node raylet NodeManager into one component, keeping the same
+responsibilities and state machines:
+
+- object directory + waiters      (reference: src/ray/gcs/gcs_server.h pubsub,
+                                   object_manager/ownership_object_directory.h)
+- lease-style task scheduling     (reference: raylet/scheduling/cluster_lease_manager.h:41
+                                   + local_lease_manager.h:60 — tasks are queued until
+                                   deps are local and resources free, then dispatched)
+- actor lifecycle + restarts      (reference: gcs/gcs_actor_manager.h:93)
+- named actors, internal KV       (reference: gcs/gcs_kv_manager.h:34)
+- worker pool scale-up            (reference: raylet/worker_pool.h:280)
+
+Single-node v1: multi-node federation (one GCS + per-node raylets over TCP) is
+the round-2 step; message types are already node-agnostic.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable
+
+from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
+
+logger = logging.getLogger(__name__)
+
+INLINE_LIMIT = 64 * 1024  # results smaller than this are stored in the GCS table
+
+
+class _Worker:
+    __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind", "running_task")
+
+    def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str):
+        self.wid = wid
+        self.conn = conn
+        self.pid = pid
+        self.kind = kind  # "worker" | "driver"
+        self.idle = kind == "worker"
+        self.actor_id: str | None = None
+        self.running_task: dict | None = None
+        self.dead = False
+
+
+class _Actor:
+    __slots__ = (
+        "aid", "state", "worker", "queue", "busy", "create_spec", "name",
+        "restarts_left", "waiters", "kill_requested",
+    )
+
+    def __init__(self, aid: str, create_spec: dict):
+        self.aid = aid
+        self.state = "pending"  # pending → alive → (restarting → alive)* → dead
+        self.worker: str | None = None
+        self.queue: collections.deque[dict] = collections.deque()
+        self.busy = False
+        self.create_spec = create_spec
+        self.name: str | None = create_spec.get("name")
+        self.restarts_left: int = create_spec.get("max_restarts", 0)
+        self.waiters: list[tuple[MsgConnection, int]] = []  # ready-waiters
+        self.kill_requested = False
+
+
+class GcsServer:
+    def __init__(
+        self,
+        socket_path: str,
+        total_resources: dict[str, float],
+        spawn_worker_cb: Callable[[int], None],
+        max_workers: int = 32,
+    ):
+        self.socket_path = socket_path
+        self.lock = threading.RLock()
+        self.total = dict(total_resources)
+        self.available = dict(total_resources)
+        self.spawn_worker_cb = spawn_worker_cb
+        self.max_workers = max_workers
+
+        self.objects: dict[str, dict] = {}
+        self.object_waiters: dict[str, list[tuple[MsgConnection, int]]] = {}
+        self.workers: dict[str, _Worker] = {}
+        self.pending_tasks: collections.deque[dict] = collections.deque()
+        self.pending_actor_creations: collections.deque[dict] = collections.deque()
+        self.actors: dict[str, _Actor] = {}
+        self.named_actors: dict[str, str] = {}
+        self.kv: dict[str, bytes] = {}
+        self._spawn_pending: collections.deque[float] = collections.deque()
+        self.stopped = False
+        self._conn_threads: list[threading.Thread] = []
+        self._listener = None
+        self._accept_thread: threading.Thread | None = None
+        # metrics / introspection
+        self.task_counter = collections.Counter()
+
+    # ------------------------------------------------------------------ server
+
+    def start(self):
+        self._listener = listen_unix(self.socket_path)
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True, name="gcs-accept")
+        self._accept_thread.start()
+
+    def stop(self):
+        with self.lock:
+            self.stopped = True
+            for w in self.workers.values():
+                if w.kind == "worker" and not w.dead:
+                    try:
+                        w.conn.send({"type": "exit"})
+                    except ConnectionClosed:
+                        pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self.stopped:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = MsgConnection(sock)
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True, name="gcs-conn")
+            t.start()
+            self._conn_threads.append(t)
+
+    def _serve_conn(self, conn: MsgConnection):
+        wid = None
+        try:
+            while True:
+                msg = conn.recv()
+                wid = self._handle(conn, msg, wid)
+        except ConnectionClosed:
+            if wid is not None:
+                self._on_worker_death(wid)
+
+    # --------------------------------------------------------------- dispatch
+
+    def _handle(self, conn: MsgConnection, msg: dict, wid: str | None) -> str | None:
+        t = msg["type"]
+        if t == "register":
+            with self.lock:
+                wid = msg["wid"]
+                self.workers[wid] = _Worker(wid, conn, msg.get("pid", 0), msg["kind"])
+                if msg["kind"] == "worker" and self._spawn_pending:
+                    self._spawn_pending.popleft()
+            conn.send({"rid": msg["rid"], "ok": True})
+            self._schedule()
+            return wid
+        if t == "submit_task":
+            self._submit_task(msg["spec"])
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "task_done":
+            self._on_task_done(msg)
+        elif t == "object_put":
+            self._on_object_ready(msg["oid"], where=msg.get("where", "shm"),
+                                  inline=msg.get("inline"), size=msg.get("size", 0),
+                                  is_error=False)
+        elif t == "wait_object":
+            self._wait_object(conn, msg)
+        elif t == "free_objects":
+            with self.lock:
+                for oid in msg["oids"]:
+                    self.objects.pop(oid, None)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "create_actor":
+            err = self._create_actor(msg["spec"])
+            conn.send({"rid": msg["rid"], "ok": err is None, "error": err})
+        elif t == "actor_task":
+            ok, err = self._submit_actor_task(msg["spec"])
+            conn.send({"rid": msg["rid"], "ok": ok, "error": err})
+        elif t == "wait_actor_ready":
+            self._wait_actor_ready(conn, msg)
+        elif t == "get_named_actor":
+            with self.lock:
+                aid = self.named_actors.get(msg["name"])
+                state = self.actors[aid].state if aid else None
+            conn.send({"rid": msg["rid"], "aid": aid, "state": state})
+        elif t == "kill_actor":
+            self._kill_actor(msg["aid"], msg.get("no_restart", True))
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "kv_put":
+            with self.lock:
+                self.kv[msg["key"]] = msg["value"]
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "kv_get":
+            with self.lock:
+                val = self.kv.get(msg["key"])
+            conn.send({"rid": msg["rid"], "value": val})
+        elif t == "kv_keys":
+            with self.lock:
+                keys = [k for k in self.kv if k.startswith(msg.get("prefix", ""))]
+            conn.send({"rid": msg["rid"], "keys": keys})
+        elif t == "kv_del":
+            with self.lock:
+                self.kv.pop(msg["key"], None)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "cluster_state":
+            with self.lock:
+                state = {
+                    "total_resources": dict(self.total),
+                    "available_resources": dict(self.available),
+                    "num_workers": sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead),
+                    "num_actors": sum(1 for a in self.actors.values() if a.state == "alive"),
+                    "pending_tasks": len(self.pending_tasks),
+                    "task_counter": dict(self.task_counter),
+                    "actors": {
+                        a.aid: {"state": a.state, "name": a.name, "worker": a.worker}
+                        for a in self.actors.values()
+                    },
+                }
+            conn.send({"rid": msg["rid"], "state": state})
+        else:
+            logger.warning("gcs: unknown message type %s", t)
+        return wid
+
+    # --------------------------------------------------------------- objects
+
+    def _on_object_ready(self, oid: str, where: str, inline, size: int, is_error: bool):
+        with self.lock:
+            self.objects[oid] = {
+                "status": "error" if is_error else "ready",
+                "where": where,
+                "inline": inline,
+                "size": size,
+            }
+            waiters = self.object_waiters.pop(oid, [])
+            entry = self.objects[oid]
+        for conn, rid in waiters:
+            self._reply_object(conn, rid, entry)
+        self._schedule()
+
+    def _reply_object(self, conn: MsgConnection, rid: int, entry: dict):
+        try:
+            conn.send({
+                "rid": rid, "ready": True, "status": entry["status"],
+                "where": entry["where"], "inline": entry["inline"], "size": entry["size"],
+            })
+        except ConnectionClosed:
+            pass
+
+    def _wait_object(self, conn: MsgConnection, msg: dict):
+        oid = msg["oid"]
+        with self.lock:
+            entry = self.objects.get(oid)
+            if entry is None or entry["status"] == "pending":
+                self.object_waiters.setdefault(oid, []).append((conn, msg["rid"]))
+                return
+        self._reply_object(conn, msg["rid"], entry)
+
+    # ----------------------------------------------------------------- tasks
+
+    def _submit_task(self, spec: dict):
+        with self.lock:
+            for i in range(spec["num_returns"]):
+                oid = f"{spec['task_id']}r{i:04d}"
+                self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+            self.pending_tasks.append(spec)
+            self.task_counter["submitted"] += 1
+        self._schedule()
+
+    def _deps_ready(self, spec: dict) -> bool:
+        for dep in spec.get("deps", ()):
+            e = self.objects.get(dep)
+            if e is None or e["status"] == "pending":
+                return False
+        return True
+
+    def _fits(self, resources: dict) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in resources.items())
+
+    def _acquire(self, resources: dict):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def _release(self, resources: dict):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _schedule(self):
+        """Dispatch whatever can run; request worker scale-up for the rest."""
+        to_send: list[tuple[MsgConnection, dict]] = []
+        want_spawn = 0
+        with self.lock:
+            if self.stopped:
+                return
+            idle = [w for w in self.workers.values()
+                    if w.kind == "worker" and w.idle and not w.dead and w.actor_id is None]
+
+            # actor creations first (they pin workers)
+            still_pending = collections.deque()
+            while self.pending_actor_creations:
+                spec = self.pending_actor_creations.popleft()
+                actor = self.actors.get(spec["actor_id"])
+                if actor is None or actor.state == "dead":
+                    continue
+                res = spec.get("resources", {})
+                if idle and self._fits(res) and self._deps_ready(spec):
+                    w = idle.pop()
+                    self._acquire(res)
+                    w.idle = False
+                    w.actor_id = spec["actor_id"]
+                    w.running_task = spec
+                    actor.worker = w.wid
+                    to_send.append((w.conn, {"type": "exec", "spec": spec}))
+                else:
+                    still_pending.append(spec)
+            self.pending_actor_creations = still_pending
+
+            # normal tasks
+            still = collections.deque()
+            while self.pending_tasks:
+                spec = self.pending_tasks.popleft()
+                res = spec.get("resources", {})
+                if idle and self._fits(res) and self._deps_ready(spec):
+                    w = idle.pop()
+                    self._acquire(res)
+                    w.idle = False
+                    w.running_task = spec
+                    to_send.append((w.conn, {"type": "exec", "spec": spec}))
+                else:
+                    still.append(spec)
+            self.pending_tasks = still
+
+            # actor method calls
+            for actor in self.actors.values():
+                if actor.state == "alive" and not actor.busy and actor.queue:
+                    w = self.workers.get(actor.worker)
+                    if w is None or w.dead:
+                        continue
+                    spec = actor.queue.popleft()
+                    actor.busy = True
+                    w.running_task = spec
+                    to_send.append((w.conn, {"type": "exec", "spec": spec}))
+
+            # scale-up: runnable-if-only-there-were-workers
+            now = time.monotonic()
+            while self._spawn_pending and now - self._spawn_pending[0] > 60.0:
+                self._spawn_pending.popleft()  # spawn presumed failed; allow retry
+            spawning = len(self._spawn_pending)
+            demand = len(self.pending_tasks) + len(self.pending_actor_creations)
+            n_workers = sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead)
+            if demand > 0:
+                headroom = self.max_workers - n_workers - spawning
+                want_spawn = max(0, min(demand - len(idle) - spawning, headroom))
+                for _ in range(want_spawn):
+                    self._spawn_pending.append(now)
+
+        for conn, msg in to_send:
+            try:
+                conn.send(msg)
+            except ConnectionClosed:
+                pass
+        if want_spawn > 0:
+            self.spawn_worker_cb(want_spawn)
+
+    def _on_task_done(self, msg: dict):
+        wid = msg["wid"]
+        ready: list[tuple[str, dict]] = []
+        with self.lock:
+            w = self.workers.get(wid)
+            spec = msg["spec"]
+            kind = spec["kind"]
+            res = spec.get("resources", {})
+            if w is not None:
+                w.running_task = None
+            error = msg.get("error")
+            if kind == "actor_create":
+                actor = self.actors.get(spec["actor_id"])
+                if error is None:
+                    if actor is not None:
+                        actor.state = "alive"
+                        waiters, actor.waiters = actor.waiters, []
+                        for conn, rid in waiters:
+                            try:
+                                conn.send({"rid": rid, "ok": True})
+                            except ConnectionClosed:
+                                pass
+                        if actor.kill_requested and w is not None and not w.dead:
+                            try:
+                                w.conn.send({"type": "kill_actor", "aid": actor.aid})
+                            except ConnectionClosed:
+                                pass
+                else:
+                    # creation failed → actor dead, release worker
+                    if actor is not None:
+                        actor.state = "dead"
+                        for conn, rid in actor.waiters:
+                            try:
+                                conn.send({"rid": rid, "ok": False, "error": error})
+                            except ConnectionClosed:
+                                pass
+                        actor.waiters = []
+                    if w is not None:
+                        w.actor_id = None
+                        w.idle = True
+                    self._release(res)
+            else:
+                if kind == "actor_task":
+                    actor = self.actors.get(spec["actor_id"])
+                    if actor is not None:
+                        actor.busy = False
+                else:
+                    if w is not None:
+                        w.idle = True
+                    self._release(res)
+            self.task_counter["finished" if error is None else "failed"] += 1
+
+            # record results
+            for oid, where, inline, size in msg.get("results", ()):
+                self.objects[oid] = {
+                    "status": "error" if error is not None else "ready",
+                    "where": where, "inline": inline, "size": size,
+                }
+                for conn, rid in self.object_waiters.pop(oid, []):
+                    self._reply_object(conn, rid, self.objects[oid])
+        self._schedule()
+
+    # ---------------------------------------------------------------- actors
+
+    def _create_actor(self, spec: dict) -> str | None:
+        with self.lock:
+            aid = spec["actor_id"]
+            actor = _Actor(aid, spec)
+            if actor.name:
+                existing = self.named_actors.get(actor.name)
+                if existing is not None and self.actors[existing].state != "dead":
+                    return f"an actor named {actor.name!r} already exists"
+                self.named_actors[actor.name] = aid
+            self.actors[aid] = actor
+            self.pending_actor_creations.append(spec)
+        self._schedule()
+        return None
+
+    def _submit_actor_task(self, spec: dict) -> tuple[bool, str | None]:
+        with self.lock:
+            actor = self.actors.get(spec["actor_id"])
+            if actor is None or actor.state == "dead":
+                return False, "ActorDiedError"
+            for i in range(spec["num_returns"]):
+                oid = f"{spec['task_id']}r{i:04d}"
+                self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+            actor.queue.append(spec)
+        self._schedule()
+        return True, None
+
+    def _wait_actor_ready(self, conn: MsgConnection, msg: dict):
+        with self.lock:
+            actor = self.actors.get(msg["aid"])
+            if actor is None:
+                pass
+            elif actor.state == "alive":
+                conn.send({"rid": msg["rid"], "ok": True})
+                return
+            elif actor.state in ("pending", "restarting"):
+                actor.waiters.append((conn, msg["rid"]))
+                return
+        try:
+            conn.send({"rid": msg["rid"], "ok": False, "error": "ActorDiedError"})
+        except ConnectionClosed:
+            pass
+
+    def _kill_actor(self, aid: str, no_restart: bool):
+        fail: list[dict] = []
+        with self.lock:
+            actor = self.actors.get(aid)
+            if actor is None:
+                return
+            if no_restart:
+                actor.restarts_left = 0
+            actor.kill_requested = True
+            w = self.workers.get(actor.worker) if actor.worker else None
+            if w is None and actor.state in ("pending", "restarting"):
+                # creation not yet dispatched: cancel it outright
+                actor.state = "dead"
+                self.pending_actor_creations = collections.deque(
+                    s for s in self.pending_actor_creations if s["actor_id"] != aid
+                )
+                while actor.queue:
+                    fail.append(actor.queue.popleft())
+                for conn, rid in actor.waiters:
+                    try:
+                        conn.send({"rid": rid, "ok": False, "error": "ActorDiedError"})
+                    except ConnectionClosed:
+                        pass
+                actor.waiters = []
+        for spec in fail:
+            self._fail_task_objects(spec, "actor killed before creation")
+        if w is not None and not w.dead:
+            try:
+                w.conn.send({"type": "kill_actor", "aid": aid})
+            except ConnectionClosed:
+                pass
+        # death will be observed via the worker connection closing
+
+    # ------------------------------------------------------------ fault paths
+
+    def _fail_task_objects(self, spec: dict, reason: str):
+        """Mark all return objects of a task as errored (caller holds no lock)."""
+        import ray_tpu._private.serialization as ser
+        from ray_tpu.exceptions import WorkerCrashedError, ActorDiedError
+
+        exc = ActorDiedError(reason) if spec["kind"] == "actor_task" else WorkerCrashedError(reason)
+        blob = ser.dumps(exc)
+        for i in range(spec["num_returns"]):
+            oid = f"{spec['task_id']}r{i:04d}"
+            self._on_object_ready(oid, where="inline", inline=blob, size=len(blob), is_error=True)
+
+    def _on_worker_death(self, wid: str):
+        requeue: dict | None = None
+        fail: list[dict] = []
+        with self.lock:
+            w = self.workers.get(wid)
+            if w is None or w.dead:
+                return
+            w.dead = True
+            if w.kind != "worker":
+                return  # driver death handled by node teardown
+            spec = w.running_task
+            aid = w.actor_id
+            if aid is None:
+                self._release({} if spec is None else spec.get("resources", {}) if spec["kind"] == "task" else {})
+                if spec is not None and spec["kind"] == "task":
+                    if spec.get("retries_used", 0) < spec.get("max_retries", 0):
+                        spec["retries_used"] = spec.get("retries_used", 0) + 1
+                        requeue = spec
+                    else:
+                        fail.append(spec)
+            else:
+                actor = self.actors.get(aid)
+                create_res = actor.create_spec.get("resources", {}) if actor else {}
+                self._release(create_res)
+                if actor is not None:
+                    if spec is not None and spec["kind"] in ("actor_task", "actor_create"):
+                        fail.append(spec)
+                    actor.busy = False
+                    actor.worker = None
+                    if actor.restarts_left != 0 and actor.state != "dead":
+                        if actor.restarts_left > 0:
+                            actor.restarts_left -= 1
+                        actor.state = "restarting"
+                        self.pending_actor_creations.append(actor.create_spec)
+                    else:
+                        actor.state = "dead"
+                        while actor.queue:
+                            fail.append(actor.queue.popleft())
+                        for conn, rid in actor.waiters:
+                            try:
+                                conn.send({"rid": rid, "ok": False, "error": "ActorDiedError"})
+                            except ConnectionClosed:
+                                pass
+                        actor.waiters = []
+        for spec in fail:
+            self._fail_task_objects(spec, f"worker {wid} died")
+        if requeue is not None:
+            with self.lock:
+                self.pending_tasks.appendleft(requeue)
+        self._schedule()

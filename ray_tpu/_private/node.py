@@ -1,0 +1,113 @@
+"""Node: session bootstrap — starts the GCS and owns the worker pool.
+
+(reference: python/ray/_private/node.py:47 starts gcs/raylet/log-monitor
+subprocesses; here the GCS runs as an in-process thread and workers are
+subprocesses. Multi-node: a follower node will run a thin agent that connects
+its worker pool to a remote GCS over TCP — message types are already
+node-agnostic.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.object_store import ShmObjectStore
+
+
+def detect_num_tpu_chips() -> int:
+    """TPU chip count without importing jax (reference:
+    python/ray/_private/accelerators/tpu.py:100 chips-per-host logic — there
+    via GKE env vars / GCE metadata; here via env override or device files)."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env:
+        return int(env)
+    try:
+        import glob
+
+        accel = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
+        if accel:
+            return len(accel)
+    except OSError:
+        pass
+    return 0
+
+
+class Node:
+    def __init__(
+        self,
+        *,
+        resources: dict | None = None,
+        num_cpus: float | None = None,
+        num_tpus: float | None = None,
+        num_workers: int = 0,
+        max_workers: int = 16,
+        session_dir: str | None = None,
+    ):
+        self.session_id = uuid.uuid4().hex[:8]
+        base = session_dir or os.path.join("/tmp", "ray_tpu")
+        self.session_dir = os.path.join(base, f"session_{self.session_id}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.socket_path = os.path.join(self.session_dir, "gcs.sock")
+
+        total = {"CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))}
+        ntpu = num_tpus if num_tpus is not None else detect_num_tpu_chips()
+        if ntpu:
+            total["TPU"] = float(ntpu)
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        self.total_resources = total
+
+        self._procs: list[subprocess.Popen] = []
+        self._spawn_lock = threading.Lock()
+        self.gcs = GcsServer(
+            self.socket_path,
+            total_resources=total,
+            spawn_worker_cb=self._spawn_workers,
+            max_workers=max_workers,
+        )
+        self.gcs.start()
+        # wait for socket
+        for _ in range(500):
+            if os.path.exists(self.socket_path):
+                break
+            time.sleep(0.005)
+        if num_workers:
+            now = time.monotonic()
+            self.gcs._spawn_pending.extend([now] * num_workers)  # counted before spawn to avoid a register race
+            self._spawn_workers(num_workers)
+
+    def _spawn_workers(self, n: int):
+        env = dict(os.environ)
+        env["RAY_TPU_SOCKET"] = self.socket_path
+        env["RAY_TPU_SESSION"] = self.session_id
+        # Workers default to CPU jax: the driver owns the TPU chip(s) unless a
+        # worker is explicitly given TPU resources (reference: TPU_VISIBLE_CHIPS
+        # isolation in _private/accelerators/tpu.py:36).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        with self._spawn_lock:
+            for _ in range(n):
+                log = open(os.path.join(self.session_dir, "logs", f"worker-{len(self._procs)}.log"), "ab")
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    env=env,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+                self._procs.append(p)
+
+    def shutdown(self):
+        self.gcs.stop()
+        deadline = time.monotonic() + 3.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        ShmObjectStore(self.session_id).cleanup_session()

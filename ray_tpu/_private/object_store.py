@@ -1,0 +1,92 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+Objects live as files under /dev/shm (tmpfs) and are mapped read-only by
+consumers, giving zero-copy cross-process reads of numpy payloads the same way
+the reference's plasma store hands out mmap'd fds
+(reference: src/ray/object_manager/plasma/store.h, dlmalloc over mmap'd shm,
+fd passing in fling.cc). Here tmpfs file names play the role of fds; the
+optional C++ arena allocator (src/shm_alloc.cc) can back large stores with a
+single mapped arena instead of one file per object.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+from typing import Iterable
+
+SHM_DIR = "/dev/shm"
+
+
+class PlasmaObject:
+    """A sealed object: keeps the mmap alive while consumers hold views."""
+
+    __slots__ = ("buf", "_mm", "_f")
+
+    def __init__(self, buf: memoryview, mm=None, f=None):
+        self.buf = buf
+        self._mm = mm
+        self._f = f
+
+
+class ShmObjectStore:
+    """One store per session; all processes of the session share the prefix."""
+
+    def __init__(self, session_id: str):
+        self.prefix = f"rtpu_{session_id}_"
+        self._created: set[str] = set()
+
+    def _path(self, object_hex: str) -> str:
+        return os.path.join(SHM_DIR, self.prefix + object_hex)
+
+    def put_parts(self, object_hex: str, parts: Iterable[bytes | memoryview], total: int) -> int:
+        """Create+seal an object from pre-serialized parts. Returns size."""
+        path = self._path(object_hex)
+        tmp = path + ".tmp"
+        with open(tmp, "w+b", buffering=0) as f:
+            if total > 0:
+                f.truncate(total)
+            mm = mmap.mmap(f.fileno(), max(total, 1))
+            off = 0
+            for p in parts:
+                n = len(p) if isinstance(p, bytes) else p.nbytes
+                mm[off : off + n] = p
+                off += n
+            mm.flush()
+            mm.close()
+        os.rename(tmp, path)  # atomic seal: readers never see partial objects
+        self._created.add(object_hex)
+        return total
+
+    def get(self, object_hex: str) -> PlasmaObject:
+        path = self._path(object_hex)
+        f = open(path, "rb")
+        size = os.fstat(f.fileno()).st_size
+        mm = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        return PlasmaObject(memoryview(mm), mm, f)
+
+    def contains(self, object_hex: str) -> bool:
+        return os.path.exists(self._path(object_hex))
+
+    def size(self, object_hex: str) -> int:
+        return os.stat(self._path(object_hex)).st_size
+
+    def delete(self, object_hex: str) -> None:
+        try:
+            os.unlink(self._path(object_hex))
+        except FileNotFoundError:
+            pass
+        self._created.discard(object_hex)
+
+    def cleanup_session(self) -> None:
+        """Unlink every object of this session (driver calls at shutdown)."""
+        try:
+            names = os.listdir(SHM_DIR)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name.startswith(self.prefix):
+                try:
+                    os.unlink(os.path.join(SHM_DIR, name))
+                except OSError:
+                    pass

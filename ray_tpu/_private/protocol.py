@@ -1,0 +1,94 @@
+"""Framed message protocol over unix-domain sockets.
+
+Wire format: 8-byte little-endian length + pickled dict. Every message is a
+dict with a "type" key; RPCs carry "rid" (request id) and replies mirror it.
+This plays the role of the reference's gRPC + flatbuffers IPC planes
+(reference: src/ray/rpc/grpc_server.h, src/ray/flatbuffers/node_manager.fbs)
+collapsed into one socket protocol — adequate intra-node; a real RPC layer can
+slot in per-message-type later without changing callers.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct("<Q")
+MAX_FRAME = 1 << 34
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionClosed()
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class MsgConnection:
+    """Thread-safe framed connection; one reader, many writers."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+        self.closed = False
+
+    def send(self, msg: dict) -> None:
+        data = pickle.dumps(msg, protocol=5)
+        if len(data) > MAX_FRAME:
+            raise ValueError(f"frame too large: {len(data)}")
+        with self._send_lock:
+            try:
+                self.sock.sendall(_LEN.pack(len(data)) + data)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                self.closed = True
+                raise ConnectionClosed() from e
+
+    def recv(self) -> dict:
+        try:
+            header = _recv_exact(self.sock, 8)
+            (n,) = _LEN.unpack(header)
+            data = _recv_exact(self.sock, n)
+        except (ConnectionResetError, OSError) as e:
+            self.closed = True
+            raise ConnectionClosed() from e
+        return pickle.loads(data)
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def connect_unix(path: str, timeout: float = 30.0) -> MsgConnection:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    sock.settimeout(None)
+    return MsgConnection(sock)
+
+
+def listen_unix(path: str) -> socket.socket:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        import os
+
+        os.unlink(path)
+    except OSError:
+        pass
+    sock.bind(path)
+    sock.listen(256)
+    return sock

@@ -1,0 +1,64 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Layout of a serialized payload:
+
+    [8-byte little-endian pickle length][pickle bytes]
+    [8-byte n_buffers][for each buffer: 8-byte length][buffer bytes]
+
+Out-of-band buffers let numpy arrays round-trip zero-copy when the payload is
+mmap'd from the shared-memory object store (reference:
+python/ray/_private/serialization.py + arrow_serialization.py do the same via
+pickle protocol 5).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+
+_U64 = struct.Struct("<Q")
+
+
+def dumps(obj: Any) -> bytes:
+    buffers: list[pickle.PickleBuffer] = []
+    pick = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    parts = [_U64.pack(len(pick)), pick, _U64.pack(len(buffers))]
+    for b in buffers:
+        raw = b.raw()
+        parts.append(_U64.pack(raw.nbytes))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def dumps_into(obj: Any) -> tuple[list[bytes | memoryview], int]:
+    """Like dumps but returns (parts, total_size) without joining — lets the
+    object store write directly into shm without an extra copy."""
+    buffers: list[pickle.PickleBuffer] = []
+    pick = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    parts: list[bytes | memoryview] = [_U64.pack(len(pick)), pick, _U64.pack(len(buffers))]
+    total = 8 + len(pick) + 8
+    for b in buffers:
+        raw = b.raw()
+        parts.append(_U64.pack(raw.nbytes))
+        parts.append(raw)
+        total += 8 + raw.nbytes
+    return parts, total
+
+
+def loads(data: bytes | memoryview) -> Any:
+    view = memoryview(data)
+    (pick_len,) = _U64.unpack_from(view, 0)
+    pick = view[8 : 8 + pick_len]
+    off = 8 + pick_len
+    (n_buf,) = _U64.unpack_from(view, off)
+    off += 8
+    buffers = []
+    for _ in range(n_buf):
+        (blen,) = _U64.unpack_from(view, off)
+        off += 8
+        buffers.append(view[off : off + blen])
+        off += blen
+    return pickle.loads(pick, buffers=buffers)

@@ -1,0 +1,465 @@
+"""CoreWorker: the per-process runtime library embedded in driver and workers.
+
+TPU-native analogue of the reference's core_worker
+(reference: src/ray/core_worker/core_worker.h:170 — Put:485, Get:661,
+Wait:701, SubmitTask:858, CreateActor:883, SubmitActorTask:940,
+ExecuteTask:1482). One instance per process; the driver embeds one too (same
+key inversion as the reference: the driver is a peer, not a thin client).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Sequence
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import ShmObjectStore
+from ray_tpu._private.protocol import ConnectionClosed, connect_unix
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    RayTaskError,
+    RayTpuError,
+)
+
+INLINE_LIMIT = 64 * 1024
+ARGS_INLINE_LIMIT = 256 * 1024
+
+
+class ObjectRef:
+    """Handle to a (possibly pending) remote object.
+
+    (reference: python/ray/includes/object_ref.pxi:37)
+    """
+
+    __slots__ = ("_hex",)
+
+    def __init__(self, hex_id: str):
+        self._hex = hex_id
+
+    def hex(self) -> str:
+        return self._hex
+
+    def __repr__(self):
+        return f"ObjectRef({self._hex[:12]}…)"
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._hex == self._hex
+
+    def __hash__(self):
+        return hash(("ObjectRef", self._hex))
+
+    def __reduce__(self):
+        return (ObjectRef, (self._hex,))
+
+
+class _RefMarker:
+    """Placeholder for a top-level ObjectRef argument; resolved pre-execution."""
+
+    __slots__ = ("hex",)
+
+    def __init__(self, hex_id: str):
+        self.hex = hex_id
+
+
+class _Future:
+    __slots__ = ("event", "value")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+
+    def set(self, value):
+        self.value = value
+        self.event.set()
+
+    def wait(self, timeout=None):
+        if not self.event.wait(timeout):
+            raise GetTimeoutError("timed out waiting for reply")
+        return self.value
+
+
+class CoreWorker:
+    def __init__(self, socket_path: str, session_id: str, kind: str):
+        self.kind = kind
+        self.session_id = session_id
+        self.wid = WorkerID().hex()
+        self.store = ShmObjectStore(session_id)
+        self.conn = connect_unix(socket_path)
+        self._rid = itertools.count(1)
+        self._pending: dict[int, _Future] = {}
+        self._pending_lock = threading.Lock()
+        self.exec_queue: queue.SimpleQueue = queue.SimpleQueue()
+        self._memory: dict[str, Any] = {}
+        self._plasma_refs: dict[str, Any] = {}
+        self.actors: dict[str, Any] = {}  # actor instances hosted by this process
+        self.current_actor_id: str | None = None
+        self.current_task_id: str | None = None
+        self._alive = True
+        self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True, name="cw-recv")
+        self._recv_thread.start()
+        self.rpc({"type": "register", "wid": self.wid, "kind": kind, "pid": os.getpid()})
+
+    # ------------------------------------------------------------------- rpc
+
+    def rpc(self, msg: dict, timeout: float | None = 120.0) -> dict:
+        rid = next(self._rid)
+        msg["rid"] = rid
+        fut = _Future()
+        with self._pending_lock:
+            self._pending[rid] = fut
+        self.conn.send(msg)
+        try:
+            return fut.wait(timeout)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+
+    def rpc_async(self, msg: dict) -> _Future:
+        rid = next(self._rid)
+        msg["rid"] = rid
+        fut = _Future()
+        with self._pending_lock:
+            self._pending[rid] = fut
+        self.conn.send(msg)
+        return fut
+
+    def send_no_reply(self, msg: dict) -> None:
+        self.conn.send(msg)
+
+    def _recv_loop(self):
+        try:
+            while True:
+                msg = self.conn.recv()
+                if "rid" in msg and "type" not in msg:
+                    with self._pending_lock:
+                        fut = self._pending.pop(msg["rid"], None)
+                    if fut is not None:
+                        fut.set(msg)
+                elif msg.get("type") == "exec":
+                    self.exec_queue.put(msg["spec"])
+                elif msg.get("type") == "exit":
+                    self.exec_queue.put(None)
+                elif msg.get("type") == "kill_actor":
+                    if msg["aid"] in self.actors:
+                        os._exit(0)
+        except ConnectionClosed:
+            self._alive = False
+            self.exec_queue.put(None)
+            with self._pending_lock:
+                for fut in self._pending.values():
+                    fut.set({"ok": False, "error": "connection to GCS lost"})
+                self._pending.clear()
+
+    # ----------------------------------------------------------------- tasks
+
+    def _serialize_args(self, args: tuple, kwargs: dict) -> tuple[dict, list[str]]:
+        deps: list[str] = []
+
+        def mark(v):
+            if isinstance(v, ObjectRef):
+                deps.append(v.hex())
+                return _RefMarker(v.hex())
+            return v
+
+        marked_args = tuple(mark(a) for a in args)
+        marked_kwargs = {k: mark(v) for k, v in kwargs.items()}
+        payload = ser.dumps((marked_args, marked_kwargs))
+        spec_part: dict = {}
+        if len(payload) > ARGS_INLINE_LIMIT:
+            oid = ObjectID.for_put().hex()
+            self.store.put_parts(oid, [payload], len(payload))
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm", "size": len(payload)})
+            spec_part["args_oid"] = oid
+        else:
+            spec_part["args"] = payload
+        return spec_part, deps
+
+    def submit_task(
+        self,
+        func_blob: bytes,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        max_retries: int = 0,
+        name: str = "",
+    ) -> list[ObjectRef]:
+        task_id = TaskID().hex()
+        spec_part, deps = self._serialize_args(args, kwargs)
+        spec = {
+            "kind": "task",
+            "task_id": task_id,
+            "func": func_blob,
+            "deps": deps,
+            "num_returns": num_returns,
+            "resources": resources or {"CPU": 1.0},
+            "max_retries": max_retries,
+            "retries_used": 0,
+            "name": name,
+            **spec_part,
+        }
+        self.rpc({"type": "submit_task", "spec": spec})
+        return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
+
+    def create_actor(
+        self,
+        cls_blob: bytes,
+        args: tuple,
+        kwargs: dict,
+        *,
+        resources: dict | None = None,
+        max_restarts: int = 0,
+        name: str | None = None,
+    ) -> str:
+        actor_id = ActorID().hex()
+        task_id = TaskID().hex()
+        spec_part, deps = self._serialize_args(args, kwargs)
+        spec = {
+            "kind": "actor_create",
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "func": cls_blob,
+            "deps": deps,
+            "num_returns": 0,
+            "resources": resources or {"CPU": 1.0},
+            "max_restarts": max_restarts,
+            "name": name,
+            **spec_part,
+        }
+        reply = self.rpc({"type": "create_actor", "spec": spec})
+        if not reply.get("ok"):
+            raise ValueError(reply.get("error") or "actor creation rejected")
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+    ) -> list[ObjectRef]:
+        task_id = TaskID().hex()
+        spec_part, deps = self._serialize_args(args, kwargs)
+        spec = {
+            "kind": "actor_task",
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "method": method_name,
+            "deps": deps,
+            "num_returns": num_returns,
+            "resources": {},
+            **spec_part,
+        }
+        reply = self.rpc({"type": "actor_task", "spec": spec})
+        if not reply.get("ok"):
+            raise ActorDiedError(f"actor {actor_id[:8]} is dead")
+        return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
+
+    def wait_actor_ready(self, actor_id: str, timeout: float | None = None):
+        reply = self.rpc({"type": "wait_actor_ready", "aid": actor_id}, timeout=timeout or 120.0)
+        if not reply.get("ok"):
+            raise ActorDiedError(reply.get("error") or "actor failed to start")
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self.rpc({"type": "kill_actor", "aid": actor_id, "no_restart": no_restart})
+
+    # ---------------------------------------------------------------- objects
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put().hex()
+        parts, total = ser.dumps_into(value)
+        if total <= INLINE_LIMIT:
+            blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "inline", "inline": blob, "size": total})
+        else:
+            self.store.put_parts(oid, parts, total)
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm", "size": total})
+        return ObjectRef(oid)
+
+    def _materialize(self, oid: str, reply: dict) -> Any:
+        if reply["where"] == "inline":
+            value = ser.loads(reply["inline"])
+        else:
+            plasma = self.store.get(oid)
+            self._plasma_refs[oid] = plasma
+            value = ser.loads(plasma.buf)
+        if reply["status"] == "error":
+            raise value
+        self._memory[oid] = value
+        return value
+
+    def get_object(self, oid: str, timeout: float | None = None) -> Any:
+        if oid in self._memory:
+            return self._memory[oid]
+        reply = self.rpc({"type": "wait_object", "oid": oid},
+                         timeout=timeout if timeout is not None else 86400.0)
+        return self._materialize(oid, reply)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self.get_object(r.hex(), timeout=remaining))
+        return out[0] if single else out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: float | None = None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        futures: list[tuple[ObjectRef, _Future | None]] = []
+        for r in refs:
+            if r.hex() in self._memory:
+                futures.append((r, None))
+            else:
+                futures.append((r, self.rpc_async({"type": "wait_object", "oid": r.hex()})))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready, not_ready = [], []
+        while True:
+            ready = [r for r, f in futures if f is None or f.event.is_set()]
+            if len(ready) >= num_returns or (deadline is not None and time.monotonic() >= deadline):
+                break
+            time.sleep(0.002)
+        ready_set = set()
+        for r, f in futures:
+            if (f is None or f.event.is_set()) and len(ready_set) < num_returns:
+                ready_set.add(r.hex())
+        ready = [r for r in refs if r.hex() in ready_set]
+        not_ready = [r for r in refs if r.hex() not in ready_set]
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]):
+        oids = [r.hex() for r in refs]
+        for oid in oids:
+            self._memory.pop(oid, None)
+            self._plasma_refs.pop(oid, None)
+            self.store.delete(oid)
+        self.rpc({"type": "free_objects", "oids": oids})
+
+    # ------------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: bytes):
+        self.rpc({"type": "kv_put", "key": key, "value": value})
+
+    def kv_get(self, key: str) -> bytes | None:
+        return self.rpc({"type": "kv_get", "key": key})["value"]
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        return self.rpc({"type": "kv_keys", "prefix": prefix})["keys"]
+
+    def kv_del(self, key: str):
+        self.rpc({"type": "kv_del", "key": key})
+
+    def get_named_actor(self, name: str) -> str | None:
+        reply = self.rpc({"type": "get_named_actor", "name": name})
+        return reply["aid"]
+
+    def cluster_state(self) -> dict:
+        return self.rpc({"type": "cluster_state"})["state"]
+
+    # -------------------------------------------------------------- execution
+
+    def _resolve_args(self, spec: dict) -> tuple[tuple, dict]:
+        if "args_oid" in spec:
+            plasma = self.store.get(spec["args_oid"])
+            args, kwargs = ser.loads(plasma.buf)
+        else:
+            args, kwargs = ser.loads(spec["args"])
+        args = tuple(self.get_object(a.hex) if isinstance(a, _RefMarker) else a for a in args)
+        kwargs = {k: self.get_object(v.hex) if isinstance(v, _RefMarker) else v for k, v in kwargs.items()}
+        return args, kwargs
+
+    def execute_task(self, spec: dict) -> None:
+        kind = spec["kind"]
+        error_blob = None
+        results = []
+        self.current_task_id = spec["task_id"]
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if kind == "task":
+                func = ser.loads(spec["func"])
+                out = func(*args, **kwargs)
+            elif kind == "actor_create":
+                cls = ser.loads(spec["func"])
+                instance = cls(*args, **kwargs)
+                self.actors[spec["actor_id"]] = instance
+                self.current_actor_id = spec["actor_id"]
+                out = None
+            elif kind == "actor_task":
+                instance = self.actors[spec["actor_id"]]
+                out = getattr(instance, spec["method"])(*args, **kwargs)
+            else:
+                raise RayTpuError(f"unknown task kind {kind}")
+            n = spec["num_returns"]
+            values = [out] if n == 1 else (list(out) if n > 0 else [])
+            if n > 1 and len(values) != n:
+                raise ValueError(f"task declared num_returns={n} but returned {len(values)} values")
+            for i, val in enumerate(values):
+                oid = f"{spec['task_id']}r{i:04d}"
+                parts, total = ser.dumps_into(val)
+                if total <= INLINE_LIMIT:
+                    blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
+                    results.append((oid, "inline", blob, total))
+                else:
+                    self.store.put_parts(oid, parts, total)
+                    results.append((oid, "shm", None, total))
+        except Exception as e:  # noqa: BLE001 — task errors must be captured, not crash the worker
+            tb = traceback.format_exc()
+            wrapped = RayTaskError(spec.get("name") or spec.get("method", kind), tb, e)
+            try:
+                blob = ser.dumps(wrapped)
+            except Exception:
+                # the cause (or a return value) wasn't picklable; keep the traceback
+                wrapped = RayTaskError(spec.get("name") or spec.get("method", kind), tb, None)
+                blob = ser.dumps(wrapped)
+            error_blob = repr(e)
+            results = [
+                (f"{spec['task_id']}r{i:04d}", "inline", blob, len(blob))
+                for i in range(spec["num_returns"])
+            ]
+        finally:
+            self.current_task_id = None
+        lite = {k: spec.get(k) for k in ("task_id", "kind", "actor_id", "resources", "num_returns", "max_retries", "retries_used")}
+        self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite, "results": results, "error": error_blob})
+
+    def exec_loop(self):
+        """Main loop of worker processes (driver never calls this)."""
+        while True:
+            spec = self.exec_queue.get()
+            if spec is None:
+                return
+            self.execute_task(spec)
+
+    def disconnect(self):
+        self._alive = False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+_global_worker: CoreWorker | None = None
+
+
+def get_global_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RayTpuError("ray_tpu.init() has not been called in this process")
+    return _global_worker
+
+
+def set_global_worker(w: CoreWorker | None):
+    global _global_worker
+    _global_worker = w

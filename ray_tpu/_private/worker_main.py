@@ -1,0 +1,27 @@
+"""Entry point for worker subprocesses.
+
+(reference: the worker main loop in python/ray/_private/workers/default_worker.py
++ the execute-task callback _raylet.pyx:1823.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    socket_path = os.environ["RAY_TPU_SOCKET"]
+    session_id = os.environ["RAY_TPU_SESSION"]
+    from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+    worker = CoreWorker(socket_path, session_id, kind="worker")
+    set_global_worker(worker)
+    try:
+        worker.exec_loop()
+    finally:
+        worker.disconnect()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""ActorClass / ActorHandle / ActorMethod.
+
+(reference: python/ray/actor.py — ActorClass:1188, ActorHandle:1857. Actor
+method calls are ordered per handle, matching the reference's sequential
+actor submit queue, src/ray/core_worker/task_submission/sequential_actor_submit_queue.h.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import serialization as ser
+from ray_tpu.remote_function import _build_resources
+
+
+class ActorMethod:
+    def __init__(self, actor_id: str, method_name: str, num_returns: int = 1):
+        self._actor_id = actor_id
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns=None, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._actor_id, self._method_name,
+                           self._num_returns if num_returns is None else num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.api import _get_worker
+
+        refs = _get_worker().submit_actor_task(
+            self._actor_id, self._method_name, args, kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str):
+        self._actor_id = actor_id
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self._actor_id, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:8]}…)"
+
+    def __reduce__(self):
+        # Handles rebind to the receiving process's global worker; the GCS
+        # routes calls by actor id regardless of which process submits.
+        return (ActorHandle, (self._actor_id,))
+
+    def __ray_ready__(self, timeout: float | None = None):
+        from ray_tpu._private.api import _get_worker
+
+        _get_worker().wait_actor_ready(self._actor_id, timeout=timeout)
+        return True
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
+                 max_restarts=0, name=None, lifetime=None):
+        self._cls = cls
+        self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
+        self._resources = _build_resources(num_cpus, num_tpus, resources)
+        self._max_restarts = max_restarts
+        self._name = name
+        self._blob: bytes | None = None
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def _get_blob(self):
+        if self._blob is None:
+            self._blob = ser.dumps(self._cls)
+        return self._blob
+
+    def options(self, *, num_cpus=None, num_tpus=None, resources=None,
+                max_restarts=None, name=None, lifetime=None, **_ignored) -> "ActorClass":
+        ac = ActorClass(
+            self._cls,
+            num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
+            num_tpus=self._opts["num_tpus"] if num_tpus is None else num_tpus,
+            resources=self._opts["resources"] if resources is None else resources,
+            max_restarts=self._max_restarts if max_restarts is None else max_restarts,
+            name=name if name is not None else self._name,
+            lifetime=lifetime,
+        )
+        ac._blob = self._blob
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.api import _get_worker
+
+        worker = _get_worker()
+        actor_id = worker.create_actor(
+            self._get_blob() if worker.kind != "local" else self._cls,
+            args,
+            kwargs,
+            resources=self._resources,
+            max_restarts=self._max_restarts,
+            name=self._name,
+        )
+        return ActorHandle(actor_id)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Actor classes must be instantiated with .remote()")
+
+    @property
+    def cls(self):
+        return self._cls

@@ -1,0 +1,43 @@
+"""Public exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class RayTaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task/actor method.
+
+    Re-raised at `get()` on the caller, with the remote traceback appended
+    (reference: python/ray/exceptions.py RayTaskError)."""
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (RayTaskError, (self.function_name, self.traceback_str, self.cause))
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass

@@ -1,0 +1,78 @@
+"""RemoteFunction: the @ray_tpu.remote wrapper for functions.
+
+(reference: python/ray/remote_function.py:41 — options plumbing mirrors
+_remote at remote_function.py:313.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu._private import serialization as ser
+
+
+def _build_resources(num_cpus, num_tpus, resources) -> dict:
+    out = {"CPU": 1.0 if num_cpus is None else float(num_cpus)}
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+    if resources:
+        out.update({k: float(v) for k, v in resources.items()})
+    if out.get("CPU") == 0.0:
+        out.pop("CPU")
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, func, *, num_cpus=None, num_tpus=None, resources=None,
+                 num_returns=1, max_retries=0):
+        self._func = func
+        self._num_returns = num_returns
+        self._max_retries = max_retries
+        self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
+        self._resources = _build_resources(num_cpus, num_tpus, resources)
+        self._blob: bytes | None = None
+        functools.update_wrapper(self, func)
+
+    def _get_blob(self) -> bytes:
+        if self._blob is None:
+            self._blob = ser.dumps(self._func)
+        return self._blob
+
+    def options(self, *, num_cpus=None, num_tpus=None, resources=None,
+                num_returns=None, max_retries=None, **_ignored) -> "RemoteFunction":
+        rf = RemoteFunction(
+            self._func,
+            num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
+            num_tpus=self._opts["num_tpus"] if num_tpus is None else num_tpus,
+            resources=self._opts["resources"] if resources is None else resources,
+            num_returns=self._num_returns if num_returns is None else num_returns,
+            max_retries=self._max_retries if max_retries is None else max_retries,
+        )
+        rf._blob = self._blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.api import _get_worker
+
+        worker = _get_worker()
+        refs = worker.submit_task(
+            self._get_blob() if worker.kind != "local" else self._func,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            resources=self._resources,
+            max_retries=self._max_retries,
+            name=getattr(self._func, "__name__", "task"),
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly; use .remote() "
+            "(or access the original function via .func)."
+        )
+
+    @property
+    def func(self):
+        return self._func

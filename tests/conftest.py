@@ -1,0 +1,38 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+
+(reference test strategy: SURVEY.md §4 — accelerators are tested by env
+simulation without hardware; multi-chip sharding is validated on a virtual
+device mesh the same way the driver's dryrun does.)
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# single-core machine: keep compiled code single-threaded and deterministic
+os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_local():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A real multiprocess session with a small worker pool."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+    yield
+    ray_tpu.shutdown()
