@@ -86,20 +86,24 @@ class Node:
         env = dict(os.environ)
         env["RAY_TPU_SOCKET"] = self.socket_path
         env["RAY_TPU_SESSION"] = self.session_id
-        # Workers default to CPU jax: the driver owns the TPU chip(s) unless a
-        # worker is explicitly given TPU resources (reference: TPU_VISIBLE_CHIPS
-        # isolation in _private/accelerators/tpu.py:36).
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # Workers run CPU jax: the driver owns the TPU chip(s). Hard-set (not
+        # setdefault) because the host env may preset JAX_PLATFORMS to the TPU
+        # platform, and two processes must not fight over one chip
+        # (reference: TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36).
+        env["JAX_PLATFORMS"] = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
         with self._spawn_lock:
             for _ in range(n):
                 log = open(os.path.join(self.session_dir, "logs", f"worker-{len(self._procs)}.log"), "ab")
-                p = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
-                    env=env,
-                    stdout=log,
-                    stderr=subprocess.STDOUT,
-                    cwd=os.getcwd(),
-                )
+                try:
+                    p = subprocess.Popen(
+                        [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                        env=env,
+                        stdout=log,
+                        stderr=subprocess.STDOUT,
+                        cwd=os.getcwd(),
+                    )
+                finally:
+                    log.close()  # Popen dup'd the fd; parent copy would leak
                 self._procs.append(p)
 
     def shutdown(self):

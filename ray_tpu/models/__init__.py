@@ -1,0 +1,18 @@
+from ray_tpu.models import transformer, vit
+from ray_tpu.models.gpt2 import gpt2_config
+from ray_tpu.models.llama import llama_config
+from ray_tpu.models.mixtral import mixtral_config
+from ray_tpu.models.transformer import MoEConfig, TransformerConfig
+from ray_tpu.models.vit import ViTConfig, vit_config
+
+__all__ = [
+    "MoEConfig",
+    "TransformerConfig",
+    "ViTConfig",
+    "gpt2_config",
+    "llama_config",
+    "mixtral_config",
+    "transformer",
+    "vit",
+    "vit_config",
+]

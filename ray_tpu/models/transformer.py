@@ -1,0 +1,299 @@
+"""Decoder-only transformer core shared by the GPT-2 / Llama / Mixtral
+families. Pure-functional: params are pytrees (layers stacked on a leading
+dim and consumed by lax.scan — compile-fast and pipeline-ready), logical axis
+trees drive mesh sharding, compute runs in bf16 with f32 accumulators.
+
+The reference framework contains no model code (models live in user code /
+vLLM); these families exist so the framework's train/serve/bench paths are
+self-contained (BASELINE.md configs 1, 2, 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu import ops
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_coef: float = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int | None = None          # None → MHA
+    d_head: int | None = None              # None → d_model // n_heads
+    d_ff: int = 2048
+    norm: str = "rms"                      # "rms" | "ln"
+    act: str = "swiglu"                    # "swiglu" | "gelu"
+    pos: str = "rope"                      # "rope" | "learned"
+    rope_theta: float = 10000.0
+    max_seq_len: int = 2048
+    tie_embeddings: bool = False
+    bias: bool = False                     # attn/mlp biases (GPT-2 style)
+    moe: MoEConfig | None = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_head or self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        leaves = jax.tree.leaves(jax.eval_shape(lambda: init(jax.random.PRNGKey(0), self)))
+        return sum(math.prod(l.shape) for l in leaves)
+
+
+# ------------------------------------------------------------------ init
+
+def _norm_params(cfg, key):
+    p = {"w": jnp.ones((cfg.d_model,), cfg.param_dtype)}
+    if cfg.norm == "ln":
+        p["b"] = jnp.zeros((cfg.d_model,), cfg.param_dtype)
+    return p
+
+
+def _dense_mlp_params(cfg, key):
+    E, F = cfg.d_model, cfg.d_ff
+    k1, k2, k3 = jax.random.split(key, 3)
+    std = 0.02
+    out_std = 0.02 / math.sqrt(2 * cfg.n_layers)
+    if cfg.act == "swiglu":
+        p = {
+            "wi_gate": jax.random.normal(k1, (E, F), cfg.param_dtype) * std,
+            "wi_up": jax.random.normal(k2, (E, F), cfg.param_dtype) * std,
+            "wo": jax.random.normal(k3, (F, E), cfg.param_dtype) * out_std,
+        }
+    else:
+        p = {
+            "wi": jax.random.normal(k1, (E, F), cfg.param_dtype) * std,
+            "wo": jax.random.normal(k3, (F, E), cfg.param_dtype) * out_std,
+        }
+        if cfg.bias:
+            p["bi"] = jnp.zeros((F,), cfg.param_dtype)
+            p["bo"] = jnp.zeros((E,), cfg.param_dtype)
+    return p
+
+
+def _moe_params(cfg, key):
+    E, F, X = cfg.d_model, cfg.d_ff, cfg.moe.num_experts
+    k0, k1, k2, k3 = jax.random.split(key, 4)
+    std = 0.02
+    out_std = 0.02 / math.sqrt(2 * cfg.n_layers)
+    return {
+        "router": jax.random.normal(k0, (E, X), cfg.param_dtype) * std,
+        "gate": jax.random.normal(k1, (X, E, F), cfg.param_dtype) * std,
+        "up": jax.random.normal(k2, (X, E, F), cfg.param_dtype) * std,
+        "down": jax.random.normal(k3, (X, F, E), cfg.param_dtype) * out_std,
+    }
+
+
+def _layer_params(cfg, key):
+    E, H, Hkv, Dh = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    ks = jax.random.split(key, 6)
+    std = 0.02
+    out_std = 0.02 / math.sqrt(2 * cfg.n_layers)
+    attn = {
+        "wq": jax.random.normal(ks[0], (E, H, Dh), cfg.param_dtype) * std,
+        "wk": jax.random.normal(ks[1], (E, Hkv, Dh), cfg.param_dtype) * std,
+        "wv": jax.random.normal(ks[2], (E, Hkv, Dh), cfg.param_dtype) * std,
+        "wo": jax.random.normal(ks[3], (H, Dh, E), cfg.param_dtype) * out_std,
+    }
+    if cfg.bias:
+        attn["bq"] = jnp.zeros((H, Dh), cfg.param_dtype)
+        attn["bk"] = jnp.zeros((Hkv, Dh), cfg.param_dtype)
+        attn["bv"] = jnp.zeros((Hkv, Dh), cfg.param_dtype)
+        attn["bo"] = jnp.zeros((E,), cfg.param_dtype)
+    layer = {
+        "norm1": _norm_params(cfg, ks[4]),
+        "attn": attn,
+        "norm2": _norm_params(cfg, ks[4]),
+        "mlp": _moe_params(cfg, ks[5]) if cfg.moe else _dense_mlp_params(cfg, ks[5]),
+    }
+    return layer
+
+
+def init(key, cfg: TransformerConfig):
+    k_emb, k_pos, k_layers, k_head = jax.random.split(key, 4)
+    params = {
+        "embed": jax.random.normal(k_emb, (cfg.vocab_size, cfg.d_model), cfg.param_dtype) * 0.02,
+        "layers": jax.vmap(lambda k: _layer_params(cfg, k))(jax.random.split(k_layers, cfg.n_layers)),
+        "final_norm": _norm_params(cfg, k_head),
+    }
+    if cfg.pos == "learned":
+        params["pos_embed"] = jax.random.normal(k_pos, (cfg.max_seq_len, cfg.d_model), cfg.param_dtype) * 0.02
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(k_head, (cfg.d_model, cfg.vocab_size), cfg.param_dtype) * 0.02
+    return params
+
+
+def logical_axes(cfg: TransformerConfig):
+    """Same tree shape as init(), leaves = tuples of logical dim names.
+    Stacked layer params get a leading 'layers' dim."""
+    norm = {"w": ("embed",)} if cfg.norm == "rms" else {"w": ("embed",), "b": ("embed",)}
+    attn = {
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+    }
+    if cfg.bias:
+        attn.update({"bq": ("heads", "head_dim"), "bk": ("kv_heads", "head_dim"),
+                     "bv": ("kv_heads", "head_dim"), "bo": ("embed",)})
+    if cfg.moe:
+        mlp = {"router": ("embed", None), "gate": ("expert", "embed", "mlp"),
+               "up": ("expert", "embed", "mlp"), "down": ("expert", "mlp", "embed")}
+    elif cfg.act == "swiglu":
+        mlp = {"wi_gate": ("embed", "mlp"), "wi_up": ("embed", "mlp"), "wo": ("mlp", "embed")}
+    else:
+        mlp = {"wi": ("embed", "mlp"), "wo": ("mlp", "embed")}
+        if cfg.bias:
+            mlp.update({"bi": ("mlp",), "bo": ("embed",)})
+    layer = {"norm1": norm, "attn": attn, "norm2": norm, "mlp": mlp}
+    stacked = jax.tree.map(lambda t: ("layers",) + t, layer, is_leaf=lambda x: isinstance(x, tuple))
+    out = {
+        "embed": ("vocab", "embed"),
+        "layers": stacked,
+        "final_norm": norm,
+    }
+    if cfg.pos == "learned":
+        out["pos_embed"] = (None, "embed")
+    if not cfg.tie_embeddings:
+        out["lm_head"] = ("embed", "vocab")
+    return out
+
+
+# ----------------------------------------------------------------- apply
+
+def _norm(x, p, cfg):
+    if cfg.norm == "rms":
+        return ops.rms_norm(x, p["w"])
+    return ops.layer_norm(x, p["w"], p.get("b"))
+
+
+def _attn_block(x, p, cfg, cos, sin, sp_axis, attn_impl):
+    dt = cfg.dtype
+    q = jnp.einsum("bte,ehd->bthd", x, p["wq"].astype(dt))
+    k = jnp.einsum("bte,ehd->bthd", x, p["wk"].astype(dt))
+    v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(dt))
+    if cfg.bias:
+        q = q + p["bq"].astype(dt)
+        k = k + p["bk"].astype(dt)
+        v = v + p["bv"].astype(dt)
+    if cfg.pos == "rope":
+        if sp_axis is not None:
+            # sequence-sharded: offset positions by this shard's start
+            idx = jax.lax.axis_index(sp_axis)
+            T = x.shape[1]
+            positions = idx * T + jnp.arange(T)
+            q = ops.apply_rope(q, cos, sin, positions=positions)
+            k = ops.apply_rope(k, cos, sin, positions=positions)
+        else:
+            q = ops.apply_rope(q, cos, sin)
+            k = ops.apply_rope(k, cos, sin)
+    out = ops.attention(q, k, v, causal=True, sp_axis=sp_axis, impl=attn_impl)
+    out = jnp.einsum("bthd,hde->bte", out, p["wo"].astype(dt))
+    if cfg.bias:
+        out = out + p["bo"].astype(dt)
+    return out
+
+
+def _dense_mlp(x, p, cfg):
+    dt = cfg.dtype
+    if cfg.act == "swiglu":
+        h = ops.swiglu(x @ p["wi_gate"].astype(dt), x @ p["wi_up"].astype(dt))
+        return h @ p["wo"].astype(dt)
+    h = x @ p["wi"].astype(dt)
+    if cfg.bias:
+        h = h + p["bi"].astype(dt)
+    h = ops.gelu(h)
+    out = h @ p["wo"].astype(dt)
+    if cfg.bias:
+        out = out + p["bo"].astype(dt)
+    return out
+
+
+def _moe_mlp(x, p, cfg):
+    dt = cfg.dtype
+    B, T, E = x.shape
+    xf = x.reshape(B * T, E)
+    router_logits = (xf @ p["router"].astype(dt)).astype(jnp.float32)
+    routing = ops.topk_routing(router_logits, num_experts=cfg.moe.num_experts,
+                               k=cfg.moe.top_k, capacity_factor=cfg.moe.capacity_factor)
+
+    def expert_fn(pe, xe):
+        h = ops.swiglu(xe @ pe["gate"].astype(dt), xe @ pe["up"].astype(dt))
+        return h @ pe["down"].astype(dt)
+
+    expert_params = {"gate": p["gate"], "up": p["up"], "down": p["down"]}
+    y = ops.moe_apply(xf, routing, expert_fn, expert_params)
+    return y.reshape(B, T, E), routing.aux_loss
+
+
+def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
+            attn_impl: str | None = None):
+    """tokens [B, T] int32 → logits [B, T, V] (cfg.dtype). Returns
+    (logits, aux_loss)."""
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        T = tokens.shape[1]
+        if sp_axis is not None:
+            idx = jax.lax.axis_index(sp_axis)
+            pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], idx * T, T)
+        else:
+            pos = params["pos_embed"][:T]
+        x = x + pos.astype(dt)
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = ops.rope_frequencies(cfg.head_dim, cfg.max_seq_len, theta=cfg.rope_theta)
+
+    aux_total = jnp.zeros((), jnp.float32)
+
+    def block(carry, layer_p):
+        h, aux = carry
+        h = h + _attn_block(_norm(h, layer_p["norm1"], cfg), layer_p["attn"], cfg,
+                            cos, sin, sp_axis, attn_impl)
+        normed = _norm(h, layer_p["norm2"], cfg)
+        if cfg.moe:
+            delta, layer_aux = _moe_mlp(normed, layer_p["mlp"], cfg)
+            aux = aux + layer_aux
+        else:
+            delta = _dense_mlp(normed, layer_p["mlp"], cfg)
+        return (h + delta, aux), None
+
+    (x, aux_total), _ = jax.lax.scan(block, (x, aux_total), params["layers"])
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"].astype(dt)
+    return logits, aux_total
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
+            attn_impl: str | None = None):
+    """Next-token LM loss on tokens [B, T]; positions with label -100 ignored."""
+    logits, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis, attn_impl=attn_impl)
+    labels = tokens[:, 1:]
+    loss, _ = ops.softmax_cross_entropy(logits, labels)
+    if cfg.moe:
+        loss = loss + cfg.moe.aux_coef * aux / cfg.n_layers
+    return loss

@@ -1,0 +1,147 @@
+"""Vision Transformer (BASELINE.md config 3: ViT-L/16 image pipeline)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu import ops
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+SIZES = {
+    "s16": dict(d_model=384, n_layers=12, n_heads=6, d_ff=1536),
+    "b16": dict(d_model=768, n_layers=12, n_heads=12, d_ff=3072),
+    "l16": dict(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+}
+
+
+def vit_config(size: str = "l16", **overrides) -> ViTConfig:
+    base = dict(SIZES[size])
+    base.update(overrides)
+    return ViTConfig(**base)
+
+
+def init(key, cfg: ViTConfig):
+    ks = jax.random.split(key, 8)
+    E, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    patch_dim = 3 * cfg.patch_size ** 2
+    std = 0.02
+    out_std = 0.02 / math.sqrt(2 * cfg.n_layers)
+
+    def layer(k):
+        kk = jax.random.split(k, 6)
+        return {
+            "norm1": {"w": jnp.ones((E,), cfg.param_dtype), "b": jnp.zeros((E,), cfg.param_dtype)},
+            "attn": {
+                "wq": jax.random.normal(kk[0], (E, H, Dh), cfg.param_dtype) * std,
+                "wk": jax.random.normal(kk[1], (E, H, Dh), cfg.param_dtype) * std,
+                "wv": jax.random.normal(kk[2], (E, H, Dh), cfg.param_dtype) * std,
+                "wo": jax.random.normal(kk[3], (H, Dh, E), cfg.param_dtype) * out_std,
+            },
+            "norm2": {"w": jnp.ones((E,), cfg.param_dtype), "b": jnp.zeros((E,), cfg.param_dtype)},
+            "mlp": {
+                "wi": jax.random.normal(kk[4], (E, F), cfg.param_dtype) * std,
+                "bi": jnp.zeros((F,), cfg.param_dtype),
+                "wo": jax.random.normal(kk[5], (F, E), cfg.param_dtype) * out_std,
+                "bo": jnp.zeros((E,), cfg.param_dtype),
+            },
+        }
+
+    return {
+        "patch_embed": jax.random.normal(ks[0], (patch_dim, E), cfg.param_dtype) * std,
+        "patch_bias": jnp.zeros((E,), cfg.param_dtype),
+        "cls_token": jax.random.normal(ks[1], (1, 1, E), cfg.param_dtype) * std,
+        "pos_embed": jax.random.normal(ks[2], (cfg.n_patches + 1, E), cfg.param_dtype) * std,
+        "layers": jax.vmap(layer)(jax.random.split(ks[3], cfg.n_layers)),
+        "final_norm": {"w": jnp.ones((E,), cfg.param_dtype), "b": jnp.zeros((E,), cfg.param_dtype)},
+        "head": jax.random.normal(ks[4], (E, cfg.num_classes), cfg.param_dtype) * std,
+    }
+
+
+def logical_axes(cfg: ViTConfig):
+    norm = {"w": ("embed",), "b": ("embed",)}
+    layer = {
+        "norm1": norm,
+        "attn": {"wq": ("embed", "heads", "head_dim"), "wk": ("embed", "heads", "head_dim"),
+                 "wv": ("embed", "heads", "head_dim"), "wo": ("heads", "head_dim", "embed")},
+        "norm2": norm,
+        "mlp": {"wi": ("embed", "mlp"), "bi": ("mlp",), "wo": ("mlp", "embed"), "bo": ("embed",)},
+    }
+    stacked = jax.tree.map(lambda t: ("layers",) + t, layer, is_leaf=lambda x: isinstance(x, tuple))
+    return {
+        "patch_embed": (None, "embed"),
+        "patch_bias": ("embed",),
+        "cls_token": (None, None, "embed"),
+        "pos_embed": (None, "embed"),
+        "layers": stacked,
+        "final_norm": norm,
+        "head": ("embed", None),
+    }
+
+
+def patchify(images, patch_size: int):
+    """[B, H, W, 3] → [B, n_patches, 3*p*p]."""
+    B, H, W, C = images.shape
+    p = patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def forward(params, images, cfg: ViTConfig):
+    """images [B, H, W, 3] float → logits [B, num_classes]."""
+    dt = cfg.dtype
+    x = patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch_embed"].astype(dt) + params["patch_bias"].astype(dt)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"].astype(dt), (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)
+
+    def block(h, p):
+        hn = ops.layer_norm(h, p["norm1"]["w"], p["norm1"]["b"])
+        q = jnp.einsum("bte,ehd->bthd", hn, p["attn"]["wq"].astype(dt))
+        k = jnp.einsum("bte,ehd->bthd", hn, p["attn"]["wk"].astype(dt))
+        v = jnp.einsum("bte,ehd->bthd", hn, p["attn"]["wv"].astype(dt))
+        a = ops.attention(q, k, v, causal=False)
+        h = h + jnp.einsum("bthd,hde->bte", a, p["attn"]["wo"].astype(dt))
+        hn = ops.layer_norm(h, p["norm2"]["w"], p["norm2"]["b"])
+        m = ops.gelu(hn @ p["mlp"]["wi"].astype(dt) + p["mlp"]["bi"].astype(dt))
+        h = h + (m @ p["mlp"]["wo"].astype(dt) + p["mlp"]["bo"].astype(dt))
+        return h, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = ops.layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
+    return (x[:, 0] @ params["head"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: ViTConfig):
+    images, labels = batch
+    logits = forward(params, images, cfg)
+    loss, _ = ops.softmax_cross_entropy(logits, labels)
+    return loss

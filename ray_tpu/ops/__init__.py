@@ -1,0 +1,24 @@
+from ray_tpu.ops.activations import geglu, gelu, swiglu
+from ray_tpu.ops.attention import attention, repeat_kv
+from ray_tpu.ops.flash_attention import flash_attention, flash_attention_forward
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.moe import RoutingInfo, moe_apply, topk_routing
+from ray_tpu.ops.norms import layer_norm, rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "RoutingInfo",
+    "apply_rope",
+    "attention",
+    "flash_attention",
+    "flash_attention_forward",
+    "geglu",
+    "gelu",
+    "layer_norm",
+    "moe_apply",
+    "repeat_kv",
+    "rms_norm",
+    "rope_frequencies",
+    "softmax_cross_entropy",
+    "swiglu",
+]

@@ -1,0 +1,18 @@
+"""Gated activations used by the model families."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(gate, up):
+    return jax.nn.silu(gate) * up
+
+
+def geglu(gate, up):
+    return jax.nn.gelu(gate) * up
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)

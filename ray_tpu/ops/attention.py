@@ -1,0 +1,54 @@
+"""Attention dispatcher: picks the best implementation for the platform.
+
+Models call `attention(q, k, v, ...)` with [B, T, H, D] activations (GQA
+allowed: fewer KV heads). On TPU the Pallas flash kernel runs; elsewhere (or
+for odd shapes) the XLA reference path does — same numerics, so tests on the
+CPU mesh validate the model code that the TPU executes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.parallel.ring_attention import reference_attention, ring_attention
+
+
+def repeat_kv(k, *, n_rep: int):
+    """[B, T, Hkv, D] → [B, T, Hkv*n_rep, D] by repeating each kv head."""
+    if n_rep == 1:
+        return k
+    B, T, Hkv, D = k.shape
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def _flash_ok(q) -> bool:
+    if q.shape[1] % 256 != 0:  # seq must tile into flash blocks
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+              sp_axis: str | None = None, impl: str | None = None):
+    """q: [B, T, H, D]; k, v: [B, T, Hkv, D]. Returns [B, T, H, D].
+
+    impl: None=auto, "flash", "reference". sp_axis: when set, runs ring
+    attention over that mesh axis (inputs must be sequence-sharded and the
+    call made inside shard_map).
+    """
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    k = repeat_kv(k, n_rep=H // Hkv)
+    v = repeat_kv(v, n_rep=H // Hkv)
+
+    if sp_axis is not None:
+        return ring_attention(q, k, v, axis_name=sp_axis, causal=causal, scale=scale)
+
+    use_flash = impl == "flash" or (impl is None and _flash_ok(q))
+    if use_flash:
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = flash_attention(qt, kt, vt, causal, scale)
+        return out.transpose(0, 2, 1, 3)
+    return reference_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,161 @@
+"""Flash attention forward kernel for TPU (Pallas), with recompute backward.
+
+Blocked online-softmax attention: grid (B, H, nq, nk) with the kv dimension
+innermost so the f32 accumulators live in VMEM scratch across kv steps and
+the MXU sees [block_q, D] x [D, block_k] matmuls. Causal blocks above the
+diagonal are skipped via predication. (The reference framework has no
+attention kernels at all — attention lives in vLLM/torch; this is the
+TPU-native compute path that replaces it.)
+
+Backward is recompute-based (jax.vjp over the reference formulation under
+remat) — a dedicated Pallas backward kernel is a later optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; tests run the kernel via interpret
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: kv block j is live iff its first key position <= last q position
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [block_q, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [block_k, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                     # [block_q, block_k]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                         # [block_q, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention_forward(q, k, v, *, causal: bool = True,
+                            scale: float | None = None,
+                            block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: bool = False):
+    """q,k,v: [B, H, T, D] (heads-major). Returns [B, H, T, D]."""
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"T={T} must be divisible by block sizes {block_q},{block_k}")
+    nq, nk = T // block_q, T // block_k
+    grid = (B, H, nq, nk)
+
+    def qo_map(b, h, i, j):
+        return (b, h, i, 0)
+
+    def kv_map(b, h, i, j):
+        return (b, h, j, 0)
+
+    kwargs = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    if pltpu is None:  # pragma: no cover — dispatcher routes to reference instead
+        raise RuntimeError("pallas TPU backend unavailable; use the reference attention path")
+    scratch = [
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, D), jnp.float32),
+    ]
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), qo_map, **kwargs),
+            pl.BlockSpec((1, 1, block_k, D), kv_map, **kwargs),
+            pl.BlockSpec((1, 1, block_k, D), kv_map, **kwargs),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), qo_map, **kwargs),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_bhtd(q, k, v, *, causal: bool, scale: float):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Differentiable flash attention, [B,H,T,D]. Forward = Pallas kernel on
+    TPU; backward recomputes attention under the reference formulation."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return flash_attention_forward(q, k, v, causal=causal, scale=scale)
+
+
+def _fa_fwd(q, k, v, causal, scale):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out = flash_attention_forward(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q, k, v: _reference_bhtd(q, k, v, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

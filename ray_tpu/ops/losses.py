@@ -1,0 +1,25 @@
+"""Loss ops: cross-entropy with optional z-loss, computed stably in f32."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, *, ignore_index: int = -100,
+                          z_loss: float = 0.0):
+    """logits [..., V] f32/bf16, labels [...] int32. Returns (mean_loss, n_valid).
+
+    Mean is over valid (non-ignored) positions. z_loss penalizes log(Z)^2
+    (PaLM-style) to keep logits from drifting — cheap on TPU, fused by XLA.
+    """
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    label_safe = jnp.where(labels == ignore_index, 0, labels)
+    picked = jnp.take_along_axis(lf, label_safe[..., None], axis=-1)[..., 0]
+    nll = lse - picked
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(lse)
+    valid = (labels != ignore_index).astype(jnp.float32)
+    n_valid = jnp.maximum(valid.sum(), 1.0)
+    return (nll * valid).sum() / n_valid, n_valid

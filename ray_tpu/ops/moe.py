@@ -1,0 +1,72 @@
+"""Mixture-of-experts routing: token-choice top-k with capacity (GShard-style).
+
+Everything is dense einsum over one-hot dispatch tensors — static shapes, no
+gather/scatter with data-dependent sizes, so XLA tiles it onto the MXU and
+the `expert` dimension shards cleanly over the `ep` mesh axis. (The reference
+has no in-repo EP — SURVEY.md §2.6 — it passes knobs to vLLM; this is the
+TPU-native implementation.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RoutingInfo(NamedTuple):
+    dispatch: jax.Array       # [N, E, C] one-hot dispatch mask
+    combine: jax.Array        # [N, E, C] combine weights (softmax-scaled)
+    aux_loss: jax.Array       # load-balancing loss (scalar)
+
+
+def topk_routing(router_logits, *, num_experts: int, k: int,
+                 capacity_factor: float = 1.25) -> RoutingInfo:
+    """router_logits: [N, E] (N = flattened tokens). Top-k token-choice routing
+    with per-expert capacity C = ceil(k * N / E * capacity_factor); tokens over
+    capacity are dropped (their combine weights are zero)."""
+    N, E = router_logits.shape
+    assert E == num_experts
+    capacity = int(max(k * N / E * capacity_factor, 1.0) + 0.9999)
+
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [N, E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)                     # [N, k]
+    # renormalize the selected gates (Mixtral convention)
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) in its expert's queue
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)             # [N, k, E]
+    flat = onehot.reshape(N * k, E)
+    # order: token-major, choice-major — earlier tokens win capacity
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat                     # [N*k, E]
+    pos = (pos_in_expert * flat).sum(-1).reshape(N, k)                  # [N, k]
+    within_cap = pos < capacity
+
+    slot_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)      # [N, k, C]
+    keep = within_cap.astype(jnp.float32)                               # [N, k]
+    # accumulate per choice: peak memory stays at the [N, E, C] output size
+    # instead of materializing a [N, k, E, C] intermediate
+    dispatch = jnp.zeros((N, E, capacity), jnp.float32)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    for c in range(k):
+        d = (onehot[:, c].astype(jnp.float32)[:, :, None]
+             * slot_onehot[:, c][:, None, :]
+             * keep[:, c][:, None, None])                               # [N, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * gate_vals[:, c][:, None, None]
+
+    # Switch-style load-balance aux loss
+    frac_tokens = jnp.mean(onehot[:, 0].astype(jnp.float32), axis=0)    # top-1 assignment share
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return RoutingInfo(dispatch=dispatch, combine=combine, aux_loss=aux)
+
+
+def moe_apply(x, routing: RoutingInfo, expert_fn, expert_params):
+    """x: [N, D]; expert_fn(params_e, xe) applied per expert via vmap.
+
+    expert_params leaves have leading dim E (shardable over 'ep')."""
+    xe = jnp.einsum("nd,nec->ecd", x, routing.dispatch.astype(x.dtype))  # [E, C, D]
+    ye = jax.vmap(expert_fn)(expert_params, xe)                          # [E, C, D]
+    return jnp.einsum("ecd,nec->nd", ye, routing.combine.astype(x.dtype))

@@ -1,0 +1,32 @@
+"""Rotary position embeddings (RoPE), half-rotation convention (Llama-style)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, *, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """[max_len, head_dim//2] cos/sin tables."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, *, positions=None):
+    """x: [B, T, H, D]; cos/sin: [max_len, D//2]; positions: [B, T] or [T]."""
+    B, T, H, D = x.shape
+    if positions is None:
+        c = cos[:T][None, :, None, :]
+        s = sin[:T][None, :, None, :]
+    else:
+        c = cos[positions]
+        s = sin[positions]
+        if c.ndim == 2:  # [T, D/2] → [1, T, 1, D/2]
+            c, s = c[None, :, None, :], s[None, :, None, :]
+        else:            # [B, T, D/2] → [B, T, 1, D/2]
+            c, s = c[:, :, None, :], s[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,29 @@
+from ray_tpu.parallel.mesh import (
+    AXES,
+    DEFAULT_RULES,
+    MeshSpec,
+    ShardingRules,
+    act_sharding,
+    constrain,
+    param_shardings,
+    sharding_for,
+)
+from ray_tpu.parallel import collectives
+from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from ray_tpu.parallel.ring_attention import reference_attention, ring_attention
+
+__all__ = [
+    "AXES",
+    "DEFAULT_RULES",
+    "MeshSpec",
+    "ShardingRules",
+    "act_sharding",
+    "collectives",
+    "constrain",
+    "param_shardings",
+    "pipeline_apply",
+    "reference_attention",
+    "ring_attention",
+    "sharding_for",
+    "stack_stage_params",
+]

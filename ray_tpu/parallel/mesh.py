@@ -1,0 +1,144 @@
+"""Device mesh + sharding vocabulary — the TPU-native parallelism substrate.
+
+Where the reference delegates TP/PP/EP to engines and does DP via NCCL
+process groups (SURVEY.md §2.6), here every strategy is a named axis of one
+`jax.sharding.Mesh` and parallelism is expressed as shardings over it; XLA
+inserts the ICI/DCN collectives. Axes:
+
+  dp    data parallel (gradient psum)
+  fsdp  fully-sharded data parallel (params sharded, batch also split here)
+  ep    expert parallel (MoE experts)
+  pp    pipeline parallel (layer stages)
+  sp    sequence/context parallel (ring attention)
+  tp    tensor parallel (heads / mlp / vocab)
+
+Axis order is outermost→innermost: tp is innermost so its collectives ride
+the shortest ICI hops (scaling-book recipe: mesh → annotate → let XLA insert
+collectives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.fsdp, self.ep, self.pp, self.sp, self.tp)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    @classmethod
+    def auto(cls, n_devices: int | None = None, *, fsdp: int = 1, ep: int = 1,
+             pp: int = 1, sp: int = 1, tp: int = 1) -> "MeshSpec":
+        """Fill dp with whatever devices remain after the explicit axes."""
+        n = n_devices if n_devices is not None else len(jax.devices())
+        rest = fsdp * ep * pp * sp * tp
+        if n % rest != 0:
+            raise ValueError(f"{n} devices not divisible by fsdp*ep*pp*sp*tp={rest}")
+        return cls(dp=n // rest, fsdp=fsdp, ep=ep, pp=pp, sp=sp, tp=tp)
+
+    def build(self, devices: Sequence[Any] | None = None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        n = self.size()
+        if len(devices) < n:
+            raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+        devices = devices[:n]
+        if n > 1 and devices[0].platform == "tpu":
+            # respects ICI torus adjacency when assigning mesh coordinates
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(self.shape, devices=devices)
+        else:
+            dev_array = np.asarray(devices).reshape(self.shape)
+        return Mesh(dev_array, AXES)
+
+
+# ---------------------------------------------------------------- rules
+
+# Logical dimension names used by models; rules map them to mesh axes.
+# Separate tables for parameters vs activations (t5x-style): e.g. "embed" is
+# sharded over fsdp in parameters (ZeRO-3) but replicated in activations.
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    params: Mapping[str, Any]
+    acts: Mapping[str, Any]
+
+    def param_spec(self, logical: Sequence[str | None]) -> P:
+        return P(*(self.params.get(d) if d is not None else None for d in logical))
+
+    def act_spec(self, logical: Sequence[str | None]) -> P:
+        return P(*(self.acts.get(d) if d is not None else None for d in logical))
+
+
+DEFAULT_RULES = ShardingRules(
+    params={
+        "vocab": "tp",
+        "embed": "fsdp",       # ZeRO-3-style weight shard; all-gathered by XLA at use
+        "heads": "tp",
+        "kv_heads": "tp",
+        "head_dim": None,
+        "mlp": "tp",
+        "expert": "ep",
+        "layers": None,
+        "stage": "pp",
+    },
+    acts={
+        "batch": ("dp", "fsdp"),   # global batch split over both data axes
+        "seq": "sp",
+        "embed": None,
+        "heads": "tp",
+        "kv_heads": "tp",
+        "head_dim": None,
+        "mlp": "tp",
+        "vocab": "tp",
+        "expert": "ep",
+        "stage": "pp",
+    },
+)
+
+
+def sharding_for(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def param_shardings(mesh: Mesh, logical_tree, rules: ShardingRules = DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda logical: NamedSharding(mesh, rules.param_spec(logical)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def act_sharding(mesh: Mesh, *logical: str | None,
+                 rules: ShardingRules = DEFAULT_RULES) -> NamedSharding:
+    return NamedSharding(mesh, rules.act_spec(logical))
+
+
+def constrain(x, mesh: Mesh, *logical: str | None, rules: ShardingRules = DEFAULT_RULES):
+    """jax.lax.with_sharding_constraint with logical names."""
+    return jax.lax.with_sharding_constraint(x, act_sharding(mesh, *logical, rules=rules))
+
+
+def local_mesh_devices(platform: str = "cpu", n: int | None = None):
+    devs = [d for d in jax.devices() if d.platform == platform] or jax.devices()
+    return devs if n is None else devs[:n]

@@ -1,0 +1,79 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the `pp` axis.
+
+The reference expresses PP only by passing `pipeline_parallel_size` to vLLM
+or by hand-building compiled DAGs with overlapped stages
+(reference: dag/compiled_dag_node.py:2002 _build_execution_schedule). Here PP
+is a compiled construct: one jitted program per device, activations hop
+stage→stage over ICI via ppermute inside a lax.scan — the schedule is static,
+exactly what XLA wants.
+
+Called INSIDE shard_map over the 'pp' axis. Layer params are stacked on a
+leading `stage` dim and sharded over pp, so each device holds its stage's
+slice; within a stage, layers run under an inner lax.scan.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.collectives import pvary as _pvary
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,                      # [n_micro, micro_batch, ...] same on every stage
+    *,
+    axis_name: str = "pp",
+):
+    """Run microbatches through the pipeline; returns [n_micro, ...] outputs
+    (valid on every device — the final outputs are broadcast over the axis).
+
+    stage_fn(stage_params, h) -> h', applied by each stage to each microbatch.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    x = _pvary(x, (axis_name,))  # replicated input enters the varying world
+    n_micro = x.shape[0]
+    n_ticks = n_micro + n_stages - 1
+    out_shape = jax.eval_shape(stage_fn, stage_params, x[0])
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 feeds from the input stream; others from the previous stage
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0, lax.dynamic_index_in_dim(x, mb_idx, 0, keepdims=False), recv)
+        h = stage_fn(stage_params, inp)
+        # last stage banks its result for microbatch t - (n_stages - 1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_valid = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        outputs = jnp.where(
+            is_valid,
+            lax.dynamic_update_index_in_dim(outputs, h.astype(outputs.dtype), out_idx, 0),
+            outputs,
+        )
+        nxt = lax.ppermute(h, axis_name, perm)
+        return (nxt, outputs), None
+
+    recv0 = _pvary(jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,))
+    outs0 = _pvary(jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype), (axis_name,))
+    (_, outputs), _ = lax.scan(tick, (recv0, outs0), jnp.arange(n_ticks))
+    # broadcast final outputs from the last stage to every stage
+    outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def stack_stage_params(params_per_layer, n_stages: int):
+    """[L, ...] stacked layer params → [pp, L//pp, ...] for sharding over pp."""
+    def reshape(p):
+        L = p.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+
+    return jax.tree.map(reshape, params_per_layer)

@@ -7,12 +7,18 @@ device mesh the same way the driver's dryrun does.)
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set: the host env presets JAX_PLATFORMS (e.g. "axon" for the real TPU)
+# and sitecustomize may pre-import jax, so env vars alone are too late —
+# jax.config.update wins as long as no backend has been initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-# single-core machine: keep compiled code single-threaded and deterministic
 os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

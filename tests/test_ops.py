@@ -1,0 +1,118 @@
+"""Ops tests: numerics vs plain-jax references; flash kernel via interpret."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu import ops
+from ray_tpu.ops.flash_attention import _reference_bhtd, flash_attention_forward
+
+
+def test_rms_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    w = jnp.ones(16) * 2.0
+    y = ops.rms_norm(x, w)
+    ref = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * 2.0
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+
+def test_layer_norm_matches_flax():
+    import flax.linen as nn
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 7, 32))
+    w = jax.random.normal(jax.random.PRNGKey(2), (32,))
+    b = jax.random.normal(jax.random.PRNGKey(3), (32,))
+    y = ops.layer_norm(x, w, b)
+    ln = nn.LayerNorm(epsilon=1e-5)
+    ref = ln.apply({"params": {"scale": w, "bias": b}}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
+
+
+def test_rope_rotation_preserves_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 4, 32))
+    cos, sin = ops.rope_frequencies(32, 64)
+    y = ops.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        atol=1e-4,
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]), atol=1e-5)
+
+
+def test_cross_entropy_matches_optax():
+    import optax
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (6, 11))
+    labels = jnp.array([0, 5, 10, 3, 2, 7])
+    loss, n = ops.softmax_cross_entropy(logits, labels)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    assert n == 6
+    np.testing.assert_allclose(float(loss), float(ref), atol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 5))
+    labels = jnp.array([1, -100, 2, -100])
+    loss, n = ops.softmax_cross_entropy(logits, labels)
+    assert n == 2
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_interpret_matches_reference(causal):
+    B, H, T, D = 2, 2, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D))
+    k = jax.random.normal(ks[1], (B, H, T, D))
+    v = jax.random.normal(ks[2], (B, H, T, D))
+    out = flash_attention_forward(q, k, v, causal=causal, interpret=True,
+                                  block_q=128, block_k=128)
+    ref = _reference_bhtd(q, k, v, causal=causal, scale=D**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_attention_dispatcher_gqa():
+    B, T, H, Hkv, D = 2, 32, 8, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, Hkv, D))
+    v = jax.random.normal(ks[2], (B, T, Hkv, D))
+    out = ops.attention(q, k, v, causal=True)
+    # manual GQA reference
+    kr = jnp.repeat(k, H // Hkv, axis=2)
+    vr = jnp.repeat(v, H // Hkv, axis=2)
+    from ray_tpu.parallel import reference_attention
+
+    ref = reference_attention(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_routing_full_capacity_identity():
+    # with generous capacity and k=1, each token goes to its argmax expert
+    N, E, D = 16, 4, 8
+    logits = jax.random.normal(jax.random.PRNGKey(0), (N, E)) * 5
+    routing = ops.topk_routing(logits, num_experts=E, k=1, capacity_factor=4.0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (N, D))
+
+    def expert_fn(params, xe):
+        return xe * params  # scale by expert-specific constant
+
+    params = jnp.arange(1.0, E + 1.0)[:, None, None]  # broadcastable [E,1,1]
+    y = ops.moe_apply(x, routing, expert_fn, params)
+    top1 = np.argmax(np.asarray(logits), -1)
+    expected = np.asarray(x) * (top1[:, None] + 1.0)
+    np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5)
+
+
+def test_moe_capacity_drops():
+    # all tokens prefer expert 0; capacity forces drops → combine weight 0
+    N, E = 8, 4
+    logits = jnp.tile(jnp.array([[10.0, 0.0, 0.0, 0.0]]), (N, 1))
+    routing = ops.topk_routing(logits, num_experts=E, k=1, capacity_factor=1.0)
+    # capacity = ceil(1*8/4*1.0) = 2 → only 2 tokens kept
+    kept = np.asarray(routing.combine.sum(axis=(1, 2)))
+    assert (kept > 0.5).sum() == 2
+    assert routing.aux_loss > 1.0  # heavily imbalanced → large aux loss

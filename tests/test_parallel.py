@@ -1,0 +1,118 @@
+"""Parallel layer tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ray_tpu.parallel import (
+    MeshSpec,
+    collectives,
+    pipeline_apply,
+    reference_attention,
+    ring_attention,
+    stack_stage_params,
+)
+
+
+def test_mesh_spec_build():
+    spec = MeshSpec.auto(8, tp=2, sp=2)
+    assert spec.dp == 2
+    mesh = spec.build()
+    assert mesh.shape == {"dp": 2, "fsdp": 1, "ep": 1, "pp": 1, "sp": 2, "tp": 2}
+
+
+def test_collectives_under_shard_map():
+    mesh = MeshSpec(dp=8).build()
+    x = jnp.arange(8.0)
+
+    def body(x):
+        s = collectives.allreduce(x, "dp")
+        g = collectives.allgather(x, "dp")
+        b = collectives.broadcast(x, "dp", root=3)
+        return s, g, b
+
+    s, g, b = shard_map(
+        body, mesh=mesh,
+        in_specs=P("dp"),
+        out_specs=(P("dp"), P(None), P("dp")),
+        check_vma=False,
+    )(x)
+    assert float(s[0]) == 28.0
+    np.testing.assert_allclose(np.asarray(g), np.arange(8.0))
+    np.testing.assert_allclose(np.asarray(b), np.full(8, 3.0))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    B, T, H, D = 2, 64, 4, 16
+    sp = 4
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, T, H, D), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, T, H, D), dtype=jnp.float32)
+
+    expected = reference_attention(q, k, v, causal=causal)
+
+    mesh = MeshSpec(sp=sp).build(jax.devices()[:sp])
+    spec = P(None, "sp", None, None)
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+
+def test_pipeline_matches_serial():
+    # 4 layers of y = tanh(x @ W + b), 2 stages, 4 microbatches
+    L, pp, n_micro, mb, dim = 4, 2, 4, 2, 8
+    key = jax.random.PRNGKey(1)
+    Ws = jax.random.normal(key, (L, dim, dim)) * 0.3
+    bs = jnp.zeros((L, dim))
+    x = jax.random.normal(jax.random.PRNGKey(2), (n_micro, mb, dim))
+
+    def layer(h, Wb):
+        W, b = Wb
+        return jnp.tanh(h @ W + b), None
+
+    # serial reference
+    def serial(h):
+        h, _ = jax.lax.scan(layer, h, (Ws, bs))
+        return h
+
+    expected = jax.vmap(serial)(x.reshape(n_micro * mb // mb, mb, dim).reshape(n_micro, mb, dim))
+
+    # pipelined
+    staged = stack_stage_params({"W": Ws, "b": bs}, pp)
+
+    def stage_fn(params, h):
+        # shard_map leaves the sharded stage dim as size 1 — drop it
+        h, _ = jax.lax.scan(layer, h, (params["W"][0], params["b"][0]))
+        return h
+
+    mesh = MeshSpec(pp=pp).build(jax.devices()[:pp])
+    piped = shard_map(
+        lambda p, xx: pipeline_apply(stage_fn, p, xx, axis_name="pp"),
+        mesh=mesh,
+        in_specs=({"W": P("pp"), "b": P("pp")}, P(None)),
+        out_specs=P(None),
+    )
+    out = jax.jit(piped)(staged, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5, rtol=1e-5)
+
+
+def test_fsdp_param_sharding_roundtrip():
+    from ray_tpu.parallel import param_shardings
+
+    mesh = MeshSpec(fsdp=4, dp=2).build()
+    logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    shardings = param_shardings(mesh, logical)
+    w = jnp.ones((16, 32))
+    w_sharded = jax.device_put(w, shardings["w"])
+    assert tuple(w_sharded.sharding.spec)[:1] == ("fsdp",)
+    # a jitted sum over the sharded param works and matches
+    assert float(jax.jit(jnp.sum)(w_sharded)) == 16 * 32
