@@ -1,0 +1,106 @@
+"""Headline benchmark: Llama-class decoder training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": "train_tokens_per_sec_per_chip", "value": N, "unit": "tokens/s",
+   "vs_baseline": R}
+
+North-star metric per BASELINE.md ("Train tokens/sec/chip at 7B Llama-class");
+on this single v5e-lite chip the model is scaled to fit HBM, and we also
+report model FLOPs utilization so the number transfers across model sizes.
+vs_baseline: the reference repo publishes no tokens/sec numbers in-repo
+(BASELINE.md), so the ratio is against the recorded value of our own first
+round once BENCH_r1.json exists; until then 1.0.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    from ray_tpu.models import llama_config, transformer
+
+    if on_tpu:
+        cfg = llama_config(
+            "tiny", vocab_size=32000, max_seq_len=2048, d_model=1024,
+            n_layers=12, n_heads=16, n_kv_heads=8, d_ff=4096, dtype=jnp.bfloat16,
+        )
+        batch, seq, steps = 8, 2048, 30
+    else:  # CPU smoke sizing
+        cfg = llama_config("tiny", vocab_size=512, max_seq_len=256, dtype=jnp.float32)
+        batch, seq, steps = 2, 128, 3
+
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(math.prod(p.shape) for p in jax.tree.leaves(params))
+    opt = optax.adamw(1e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(transformer.loss_fn)(params, tokens, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    tokens = jnp.asarray(
+        np.random.randint(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32))
+
+    # warmup / compile. NOTE: hard-sync with float(loss) — block_until_ready
+    # is a no-op on the axon remote platform and under-reports step time.
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)  # chain of donated params forces sequential execution
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    # 6ND approximation for train FLOPs (fwd+bwd), attention excluded
+    flops_per_token = 6 * n_params
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    # baseline = the earliest recorded round (docstring contract)
+    rounds = []
+    for f in os.listdir("."):
+        if f.startswith("BENCH_r") and f.endswith(".json"):
+            try:
+                n = int(f[len("BENCH_r"):-len(".json")])
+                rec = json.load(open(f))
+                if rec.get("metric") == "train_tokens_per_sec_per_chip":
+                    rounds.append((n, rec["value"]))
+            except Exception:
+                pass
+    prior = min(rounds)[1] if rounds else None
+    vs = round(tokens_per_sec / prior, 3) if prior else 1.0
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": vs,
+        "detail": {
+            "model_params": n_params,
+            "batch": batch, "seq": seq,
+            "step_ms": round(dt * 1e3, 2),
+            "mfu_6nd": round(mfu, 4),
+            "final_loss": round(float(loss), 3),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

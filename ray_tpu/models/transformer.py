@@ -45,6 +45,8 @@ class TransformerConfig:
     tie_embeddings: bool = False
     bias: bool = False                     # attn/mlp biases (GPT-2 style)
     moe: MoEConfig | None = None
+    remat: bool = True                     # checkpoint each layer (HBM for FLOPs)
+    remat_policy: str = "nothing"          # "nothing" | "dots" (save matmul outputs)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -248,9 +250,10 @@ def _moe_mlp(x, p, cfg):
 
 
 def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
-            attn_impl: str | None = None):
+            attn_impl: str | None = None, return_hidden: bool = False):
     """tokens [B, T] int32 → logits [B, T, V] (cfg.dtype). Returns
-    (logits, aux_loss)."""
+    (logits, aux_loss); with return_hidden=True, returns the pre-head hidden
+    states [B, T, E] instead of logits."""
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
     if cfg.pos == "learned":
@@ -279,8 +282,15 @@ def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
             delta = _dense_mlp(normed, layer_p["mlp"], cfg)
         return (h + delta, aux), None
 
+    if cfg.remat:
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots"
+                  else jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(block, policy=policy)
     (x, aux_total), _ = jax.lax.scan(block, (x, aux_total), params["layers"])
     x = _norm(x, params["final_norm"], cfg)
+    if return_hidden:
+        return x, aux_total
     if cfg.tie_embeddings:
         logits = x @ params["embed"].astype(dt).T
     else:
@@ -289,11 +299,24 @@ def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
-            attn_impl: str | None = None):
-    """Next-token LM loss on tokens [B, T]; positions with label -100 ignored."""
-    logits, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis, attn_impl=attn_impl)
+            attn_impl: str | None = None, fused_ce: bool | None = None):
+    """Next-token LM loss on tokens [B, T]; positions with label -100 ignored.
+
+    fused_ce (default: on for vocab >= 8192) streams the lm_head matmul into
+    a chunked cross-entropy so [B,T,V] logits are never materialized."""
+    if fused_ce is None:
+        fused_ce = cfg.vocab_size >= 8192
+    fused_ce = fused_ce and not cfg.tie_embeddings  # fused path needs lm_head
     labels = tokens[:, 1:]
-    loss, _ = ops.softmax_cross_entropy(logits, labels)
+    if fused_ce:
+        hidden, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis,
+                              attn_impl=attn_impl, return_hidden=True)
+        B, T, E = hidden.shape
+        loss, _ = ops.fused_head_cross_entropy(
+            hidden.reshape(B * T, E), params["lm_head"], labels.reshape(B * T))
+    else:
+        logits, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis, attn_impl=attn_impl)
+        loss, _ = ops.softmax_cross_entropy(logits, labels)
     if cfg.moe:
         loss = loss + cfg.moe.aux_coef * aux / cfg.n_layers
     return loss

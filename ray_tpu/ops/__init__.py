@@ -1,7 +1,7 @@
 from ray_tpu.ops.activations import geglu, gelu, swiglu
 from ray_tpu.ops.attention import attention, repeat_kv
 from ray_tpu.ops.flash_attention import flash_attention, flash_attention_forward
-from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.losses import fused_head_cross_entropy, softmax_cross_entropy
 from ray_tpu.ops.moe import RoutingInfo, moe_apply, topk_routing
 from ray_tpu.ops.norms import layer_norm, rms_norm
 from ray_tpu.ops.rope import apply_rope, rope_frequencies
@@ -12,6 +12,7 @@ __all__ = [
     "attention",
     "flash_attention",
     "flash_attention_forward",
+    "fused_head_cross_entropy",
     "geglu",
     "gelu",
     "layer_norm",

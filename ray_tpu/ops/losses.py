@@ -23,3 +23,38 @@ def softmax_cross_entropy(logits, labels, *, ignore_index: int = -100,
     valid = (labels != ignore_index).astype(jnp.float32)
     n_valid = jnp.maximum(valid.sum(), 1.0)
     return (nll * valid).sum() / n_valid, n_valid
+
+
+def fused_head_cross_entropy(hidden, head_w, labels, *, ignore_index: int = -100,
+                             z_loss: float = 0.0, chunk: int = 2048):
+    """CE( hidden @ head_w, labels ) without materializing full logits.
+
+    hidden [N, E] (any float dtype), head_w [E, V], labels [N]. The [N, V]
+    logits tensor never exists at once: lax.map runs the head matmul + lse
+    per chunk and the VJP replays per chunk too. Saves ~2×N×V×4 bytes of HBM
+    on big-vocab models, which is what caps batch size on one chip."""
+    N, E = hidden.shape
+    pad = (-N) % chunk
+    if pad:
+        hidden = jnp.concatenate([hidden, jnp.zeros((pad, E), hidden.dtype)])
+        labels = jnp.concatenate([labels, jnp.full((pad,), ignore_index, labels.dtype)])
+    n_chunks = hidden.shape[0] // chunk
+    hidden = hidden.reshape(n_chunks, chunk, E)
+    labels_c = labels.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def one(args):
+        h, lab = args
+        logits = (h @ head_w.astype(h.dtype)).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        nll = lse - picked
+        if z_loss > 0.0:
+            nll = nll + z_loss * jnp.square(lse)
+        valid = (lab != ignore_index).astype(jnp.float32)
+        return (nll * valid).sum(), valid.sum()
+
+    sums, counts = jax.lax.map(one, (hidden, labels_c))
+    n_valid = jnp.maximum(counts.sum(), 1.0)
+    return sums.sum() / n_valid, n_valid

@@ -23,6 +23,12 @@ def pvary(x, axes):
     return lax.pvary(x, axes)
 
 
+def zeros_varying_like(shape, dtype, ref):
+    """Zeros of `shape` carrying `ref`'s varying-manual-axes type (vma), so
+    scan carries initialized from constants type-check under shard_map."""
+    return jnp.zeros(shape, dtype) + (ref.ravel()[0] * 0).astype(dtype)
+
+
 def allreduce(x, axis_name: str):
     return lax.psum(x, axis_name)
 

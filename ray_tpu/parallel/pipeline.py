@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.parallel.collectives import pvary as _pvary
+from ray_tpu.parallel.collectives import pvary as _pvary, zeros_varying_like
 
 
 def pipeline_apply(
@@ -60,8 +60,10 @@ def pipeline_apply(
         nxt = lax.ppermute(h, axis_name, perm)
         return (nxt, outputs), None
 
-    recv0 = _pvary(jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,))
-    outs0 = _pvary(jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype), (axis_name,))
+    # carries must hold the union vma of x and the stage params
+    ref = x.ravel()[0] * 0 + jax.tree.leaves(stage_params)[0].ravel()[0] * 0
+    recv0 = zeros_varying_like(out_shape.shape, out_shape.dtype, ref[None])
+    outs0 = zeros_varying_like((n_micro,) + out_shape.shape, out_shape.dtype, ref[None])
     (_, outputs), _ = lax.scan(tick, (recv0, outs0), jnp.arange(n_ticks))
     # broadcast final outputs from the last stage to every stage
     outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.parallel.collectives import pvary as _pvary
+from ray_tpu.parallel.collectives import pvary as _pvary, zeros_varying_like
 
 _NEG_INF = -1e30
 
@@ -55,12 +55,12 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     my = lax.axis_index(axis_name)
     q_pos = my * T + jnp.arange(T)
 
-    # init accumulators as varying over the ring axis so the scan carry types
-    # line up with the per-shard outputs (jax vma typing under shard_map)
-    m0 = _pvary(jnp.full((B, H, T), _NEG_INF, dtype=jnp.float32), (axis_name,))
-    l0 = _pvary(jnp.zeros((B, H, T), dtype=jnp.float32), (axis_name,))
-    o0 = _pvary(jnp.zeros((B, T, H, D), dtype=jnp.float32), (axis_name,))
+    # init accumulators carrying q's full vma (not just the ring axis) so the
+    # scan carry types line up with the per-shard outputs under shard_map
     qf = q.astype(jnp.float32)
+    m0 = zeros_varying_like((B, H, T), jnp.float32, qf) + _NEG_INF
+    l0 = zeros_varying_like((B, H, T), jnp.float32, qf)
+    o0 = zeros_varying_like((B, T, H, D), jnp.float32, qf)
 
     def step(carry, idx):
         k_cur, v_cur, m, l, o = carry

@@ -1,0 +1,7 @@
+from ray_tpu.train.spmd import (
+    init_sharded,
+    make_sp_pp_train_step,
+    make_train_step,
+)
+
+__all__ = ["init_sharded", "make_sp_pp_train_step", "make_train_step"]
