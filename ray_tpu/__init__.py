@@ -16,6 +16,7 @@ from ray_tpu._private.api import (
     init,
     is_initialized,
     kill,
+    nodes,
     put,
     remote,
     shutdown,
@@ -25,6 +26,7 @@ from ray_tpu._private.worker import ObjectRef
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu import exceptions
+from ray_tpu import util
 
 __version__ = "0.1.0"
 
@@ -44,9 +46,11 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "nodes",
     "put",
     "remote",
     "shutdown",
     "wait",
+    "util",
     "__version__",
 ]

@@ -156,6 +156,10 @@ def cluster_state() -> dict:
     return _get_worker().cluster_state()
 
 
+def nodes() -> list:
+    return _get_worker().list_nodes()
+
+
 def timeline() -> list:
     return []  # populated once task-event tracing lands
 

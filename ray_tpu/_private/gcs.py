@@ -1,4 +1,5 @@
-"""GCS — the control plane: object directory, scheduler, actor manager, KV.
+"""GCS — the control plane: object directory, scheduler, actor manager, KV,
+virtual nodes, placement groups.
 
 One process-wide server thread accepting unix-socket connections from the
 driver and worker processes. Collapses the reference's head-node GcsServer +
@@ -13,9 +14,11 @@ responsibilities and state machines:
 - actor lifecycle + restarts      (reference: gcs/gcs_actor_manager.h:93)
 - named actors, internal KV       (reference: gcs/gcs_kv_manager.h:34)
 - worker pool scale-up            (reference: raylet/worker_pool.h:280)
-
-Single-node v1: multi-node federation (one GCS + per-node raylets over TCP) is
-the round-2 step; message types are already node-agnostic.
+- virtual nodes                   (reference: one raylet per node; here nodes are
+                                   resource partitions of the host — the same
+                                   mechanism the reference's cluster_utils.Cluster
+                                   test harness relies on, python/ray/cluster_utils.py:135)
+- placement groups                (reference: gcs/gcs_placement_group_manager.h:50)
 """
 
 from __future__ import annotations
@@ -26,21 +29,26 @@ import threading
 import time
 from typing import Callable
 
+from ray_tpu._private import pg_policy
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
 
 logger = logging.getLogger(__name__)
 
 INLINE_LIMIT = 64 * 1024  # results smaller than this are stored in the GCS table
 
+DEFAULT_NODE = "node-0"
+
 
 class _Worker:
-    __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind", "running_task")
+    __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
+                 "running_task", "node_id")
 
-    def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str):
+    def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str):
         self.wid = wid
         self.conn = conn
         self.pid = pid
         self.kind = kind  # "worker" | "driver"
+        self.node_id = node_id
         self.idle = kind == "worker"
         self.actor_id: str | None = None
         self.running_task: dict | None = None
@@ -50,7 +58,7 @@ class _Worker:
 class _Actor:
     __slots__ = (
         "aid", "state", "worker", "queue", "busy", "create_spec", "name",
-        "restarts_left", "waiters", "kill_requested",
+        "restarts_left", "waiters", "kill_requested", "num_restarts",
     )
 
     def __init__(self, aid: str, create_spec: dict):
@@ -62,8 +70,56 @@ class _Actor:
         self.create_spec = create_spec
         self.name: str | None = create_spec.get("name")
         self.restarts_left: int = create_spec.get("max_restarts", 0)
+        self.num_restarts = 0
         self.waiters: list[tuple[MsgConnection, int]] = []  # ready-waiters
         self.kill_requested = False
+
+
+class _VNode:
+    """A virtual node: a resource partition with labels.
+
+    (reference: one raylet per machine registered in gcs_node_manager.h:47;
+    the in-process multi-node harness is how the reference tests multi-node,
+    SURVEY.md §4.2.)"""
+
+    __slots__ = ("node_id", "total", "available", "labels", "alive")
+
+    def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
+        self.node_id = node_id
+        self.total = {k: float(v) for k, v in resources.items()}
+        self.available = dict(self.total)
+        self.labels = dict(labels or {})
+        self.alive = True
+
+
+class _Bundle:
+    __slots__ = ("total", "available", "node_id")
+
+    def __init__(self, resources: dict):
+        self.total = {k: float(v) for k, v in resources.items()}
+        self.available = dict(self.total)
+        self.node_id: str | None = None
+
+
+class _PG:
+    """Placement group state machine: pending → created → removed.
+
+    (reference: gcs/gcs_placement_group_manager.h:50)"""
+
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "waiters", "epoch")
+
+    def __init__(self, pg_id: str, bundles: list[dict], strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = [_Bundle(b) for b in bundles]
+        self.strategy = strategy
+        self.name = name
+        self.state = "pending"
+        self.epoch = 0  # bumped on every (re)placement; stale releases detect it
+        self.waiters: list[tuple[MsgConnection, int]] = []
+
+
+def pg_ready_oid(pg_id: str) -> str:
+    return f"{pg_id}r0000"
 
 
 class GcsServer:
@@ -71,15 +127,19 @@ class GcsServer:
         self,
         socket_path: str,
         total_resources: dict[str, float],
-        spawn_worker_cb: Callable[[int], None],
+        spawn_worker_cb: Callable[[int, str], None],
         max_workers: int = 32,
+        node_labels: dict | None = None,
     ):
         self.socket_path = socket_path
         self.lock = threading.RLock()
-        self.total = dict(total_resources)
-        self.available = dict(total_resources)
         self.spawn_worker_cb = spawn_worker_cb
         self.max_workers = max_workers
+
+        self.nodes: dict[str, _VNode] = {
+            DEFAULT_NODE: _VNode(DEFAULT_NODE, total_resources, node_labels)
+        }
+        self.local_node_id = DEFAULT_NODE
 
         self.objects: dict[str, dict] = {}
         self.object_waiters: dict[str, list[tuple[MsgConnection, int]]] = {}
@@ -88,14 +148,37 @@ class GcsServer:
         self.pending_actor_creations: collections.deque[dict] = collections.deque()
         self.actors: dict[str, _Actor] = {}
         self.named_actors: dict[str, str] = {}
+        self.pgs: dict[str, _PG] = {}
+        self.named_pgs: dict[str, str] = {}
+        self.pending_pgs: collections.deque[str] = collections.deque()
         self.kv: dict[str, bytes] = {}
-        self._spawn_pending: collections.deque[float] = collections.deque()
+        self._spawn_pending: dict[str, collections.deque] = collections.defaultdict(collections.deque)
         self.stopped = False
         self._conn_threads: list[threading.Thread] = []
         self._listener = None
         self._accept_thread: threading.Thread | None = None
         # metrics / introspection
         self.task_counter = collections.Counter()
+        self.task_events: collections.deque = collections.deque(maxlen=10000)
+
+    # aggregate views (cluster_state compatibility)
+    @property
+    def total(self) -> dict:
+        out: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.total.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    @property
+    def available(self) -> dict:
+        out: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
 
     # ------------------------------------------------------------------ server
 
@@ -135,7 +218,18 @@ class GcsServer:
         try:
             while True:
                 msg = conn.recv()
-                wid = self._handle(conn, msg, wid)
+                try:
+                    wid = self._handle(conn, msg, wid)
+                except ConnectionClosed:
+                    raise
+                except Exception:  # noqa: BLE001 — one bad request must not kill the conn thread
+                    logger.exception("gcs: error handling %s", msg.get("type"))
+                    if "rid" in msg:
+                        try:
+                            conn.send({"rid": msg["rid"], "ok": False,
+                                       "error": "internal error; see GCS log"})
+                        except ConnectionClosed:
+                            raise
         except ConnectionClosed:
             if wid is not None:
                 self._on_worker_death(wid)
@@ -147,9 +241,10 @@ class GcsServer:
         if t == "register":
             with self.lock:
                 wid = msg["wid"]
-                self.workers[wid] = _Worker(wid, conn, msg.get("pid", 0), msg["kind"])
-                if msg["kind"] == "worker" and self._spawn_pending:
-                    self._spawn_pending.popleft()
+                node_id = msg.get("node_id") or DEFAULT_NODE
+                self.workers[wid] = _Worker(wid, conn, msg.get("pid", 0), msg["kind"], node_id)
+                if msg["kind"] == "worker" and self._spawn_pending[node_id]:
+                    self._spawn_pending[node_id].popleft()
             conn.send({"rid": msg["rid"], "ok": True})
             self._schedule()
             return wid
@@ -185,6 +280,46 @@ class GcsServer:
         elif t == "kill_actor":
             self._kill_actor(msg["aid"], msg.get("no_restart", True))
             conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "create_pg":
+            err = self._create_pg(msg["spec"])
+            conn.send({"rid": msg["rid"], "ok": err is None, "error": err})
+        elif t == "remove_pg":
+            self._remove_pg(msg["pg_id"])
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "pg_wait":
+            self._pg_wait(conn, msg)
+        elif t == "pg_table":
+            with self.lock:
+                table = {
+                    pg.pg_id: {
+                        "name": pg.name, "state": pg.state, "strategy": pg.strategy,
+                        "bundles": [dict(b.total) for b in pg.bundles],
+                        "bundle_nodes": [b.node_id for b in pg.bundles],
+                    }
+                    for pg in self.pgs.values()
+                }
+            conn.send({"rid": msg["rid"], "table": table})
+        elif t == "get_named_pg":
+            with self.lock:
+                pgid = self.named_pgs.get(msg["name"])
+            conn.send({"rid": msg["rid"], "pg_id": pgid})
+        elif t == "add_node":
+            with self.lock:
+                node_id = msg["node_id"]
+                self.nodes[node_id] = _VNode(node_id, msg["resources"], msg.get("labels"))
+            conn.send({"rid": msg["rid"], "ok": True})
+            self._schedule()
+        elif t == "remove_node":
+            self._remove_node(msg["node_id"])
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "list_nodes":
+            with self.lock:
+                nodes = [
+                    {"node_id": n.node_id, "alive": n.alive, "labels": dict(n.labels),
+                     "total": dict(n.total), "available": dict(n.available)}
+                    for n in self.nodes.values()
+                ]
+            conn.send({"rid": msg["rid"], "nodes": nodes})
         elif t == "kv_put":
             with self.lock:
                 self.kv[msg["key"]] = msg["value"]
@@ -204,15 +339,21 @@ class GcsServer:
         elif t == "cluster_state":
             with self.lock:
                 state = {
-                    "total_resources": dict(self.total),
-                    "available_resources": dict(self.available),
+                    "total_resources": self.total,
+                    "available_resources": self.available,
                     "num_workers": sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead),
                     "num_actors": sum(1 for a in self.actors.values() if a.state == "alive"),
                     "pending_tasks": len(self.pending_tasks),
                     "task_counter": dict(self.task_counter),
                     "actors": {
-                        a.aid: {"state": a.state, "name": a.name, "worker": a.worker}
+                        a.aid: {"state": a.state, "name": a.name, "worker": a.worker,
+                                "num_restarts": a.num_restarts}
                         for a in self.actors.values()
+                    },
+                    "nodes": {
+                        n.node_id: {"alive": n.alive, "labels": dict(n.labels),
+                                    "total": dict(n.total), "available": dict(n.available)}
+                        for n in self.nodes.values()
                     },
                 }
             conn.send({"rid": msg["rid"], "state": state})
@@ -254,15 +395,109 @@ class GcsServer:
                 return
         self._reply_object(conn, msg["rid"], entry)
 
+    # ------------------------------------------------------------- accounting
+
+    def _fits_for(self, spec: dict) -> str | None:
+        """Pick a node for this spec honoring its scheduling strategy.
+        Returns node_id or None if nothing fits right now."""
+        res = spec.get("resources", {})
+        strat = spec.get("strategy")
+        if strat and strat.get("kind") == "pg":
+            pg = self.pgs.get(strat["pg_id"])
+            if pg is None or pg.state != "created":
+                return None
+            idx = strat.get("bundle", -1)
+            if idx != -1 and not (0 <= idx < len(pg.bundles)):
+                return None  # invalid index: rejected at submit time
+            cand = pg.bundles if idx == -1 else [pg.bundles[idx]]
+            for b in cand:
+                if all(b.available.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                    return b.node_id
+            return None
+        if strat and strat.get("kind") == "node_label":
+            hard = strat.get("hard", {})
+            cands = [n for n in self.nodes.values() if n.alive
+                     and all(n.labels.get(k) == v for k, v in hard.items())]
+            return pg_policy.pick_node_hybrid(cands, res, self.local_node_id)
+        if strat and strat.get("kind") == "node_affinity":
+            n = self.nodes.get(strat["node_id"])
+            if n is not None and n.alive and pg_policy._fits(n.available, res):
+                return n.node_id
+            if strat.get("soft"):
+                return pg_policy.pick_node_hybrid(list(self.nodes.values()), res, self.local_node_id)
+            return None
+        return pg_policy.pick_node_hybrid(list(self.nodes.values()), res, self.local_node_id)
+
+    def _acquire_for(self, spec: dict, node_id: str):
+        res = spec.get("resources", {})
+        strat = spec.get("strategy")
+        if strat and strat.get("kind") == "pg":
+            pg = self.pgs[strat["pg_id"]]
+            idx = strat.get("bundle", -1)
+            cands = list(enumerate(pg.bundles)) if idx == -1 else [(idx, pg.bundles[idx])]
+            for i, b in cands:
+                if b.node_id == node_id and all(b.available.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                    for k, v in res.items():
+                        b.available[k] = b.available.get(k, 0.0) - v
+                    spec["_paid"] = {"kind": "bundle", "pg_id": pg.pg_id, "bundle": i,
+                                     "node": node_id, "epoch": pg.epoch}
+                    return
+            raise RuntimeError("bundle vanished between fit-check and acquire")
+        node = self.nodes[node_id]
+        for k, v in res.items():
+            node.available[k] = node.available.get(k, 0.0) - v
+        spec["_paid"] = {"kind": "node", "node": node_id}
+
+    def _release_for(self, spec: dict):
+        res = spec.get("resources", {})
+        paid = spec.pop("_paid", None)
+        if not res or paid is None:
+            return
+        if paid["kind"] == "bundle":
+            pg = self.pgs.get(paid["pg_id"])
+            if (pg is not None and pg.state == "created"
+                    and paid.get("epoch") == pg.epoch):
+                b = pg.bundles[paid["bundle"]]
+                for k, v in res.items():
+                    b.available[k] = b.available.get(k, 0.0) + v
+                return
+            # PG removed (or unplaced+re-placed under a new epoch) while the
+            # task ran: the in-use share was withheld from the original node
+            # at removal/unplacement; return it to that node now.
+        node = self.nodes.get(paid["node"])
+        if node is not None and node.alive:
+            for k, v in res.items():
+                node.available[k] = node.available.get(k, 0.0) + v
+
     # ----------------------------------------------------------------- tasks
+
+    def _invalid_strategy_reason(self, strat: dict | None) -> str | None:
+        """Reject structurally-invalid strategies at submit time (caller holds lock)."""
+        if not strat or strat.get("kind") != "pg":
+            return None
+        pg = self.pgs.get(strat.get("pg_id"))
+        if pg is None:
+            return f"no such placement group {strat.get('pg_id')!r}"
+        if pg.state == "removed":
+            return "placement group has been removed"
+        idx = strat.get("bundle", -1)
+        if idx != -1 and not (0 <= idx < len(pg.bundles)):
+            return (f"placement_group_bundle_index {idx} out of range "
+                    f"for {len(pg.bundles)} bundles")
+        return None
 
     def _submit_task(self, spec: dict):
         with self.lock:
             for i in range(spec["num_returns"]):
                 oid = f"{spec['task_id']}r{i:04d}"
                 self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
-            self.pending_tasks.append(spec)
+            reason = self._invalid_strategy_reason(spec.get("strategy"))
+            if reason is None:
+                self.pending_tasks.append(spec)
             self.task_counter["submitted"] += 1
+        if reason is not None:
+            self._fail_task_objects(spec, reason)
+            return
         self._schedule()
 
     def _deps_ready(self, spec: dict) -> bool:
@@ -272,26 +507,36 @@ class GcsServer:
                 return False
         return True
 
-    def _fits(self, resources: dict) -> bool:
-        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in resources.items())
-
-    def _acquire(self, resources: dict):
-        for k, v in resources.items():
-            self.available[k] = self.available.get(k, 0.0) - v
-
-    def _release(self, resources: dict):
-        for k, v in resources.items():
-            self.available[k] = self.available.get(k, 0.0) + v
-
     def _schedule(self):
         """Dispatch whatever can run; request worker scale-up for the rest."""
         to_send: list[tuple[MsgConnection, dict]] = []
-        want_spawn = 0
+        want_spawn: collections.Counter = collections.Counter()
         with self.lock:
             if self.stopped:
                 return
-            idle = [w for w in self.workers.values()
-                    if w.kind == "worker" and w.idle and not w.dead and w.actor_id is None]
+            self._try_place_pgs_locked()
+            idle_by_node: dict[str, list[_Worker]] = collections.defaultdict(list)
+            for w in self.workers.values():
+                if w.kind == "worker" and w.idle and not w.dead and w.actor_id is None:
+                    idle_by_node[w.node_id].append(w)
+
+            def dispatch(spec) -> bool:
+                node_id = self._fits_for(spec)
+                if node_id is None or not self._deps_ready(spec):
+                    return False
+                if not idle_by_node.get(node_id):
+                    want_spawn[node_id] += 1
+                    return False
+                w = idle_by_node[node_id].pop()
+                self._acquire_for(spec, node_id)
+                w.idle = False
+                w.running_task = spec
+                if spec["kind"] == "actor_create":
+                    w.actor_id = spec["actor_id"]
+                    actor = self.actors[spec["actor_id"]]
+                    actor.worker = w.wid
+                to_send.append((w.conn, {"type": "exec", "spec": spec}))
+                return True
 
             # actor creations first (they pin workers)
             still_pending = collections.deque()
@@ -300,16 +545,7 @@ class GcsServer:
                 actor = self.actors.get(spec["actor_id"])
                 if actor is None or actor.state == "dead":
                     continue
-                res = spec.get("resources", {})
-                if idle and self._fits(res) and self._deps_ready(spec):
-                    w = idle.pop()
-                    self._acquire(res)
-                    w.idle = False
-                    w.actor_id = spec["actor_id"]
-                    w.running_task = spec
-                    actor.worker = w.wid
-                    to_send.append((w.conn, {"type": "exec", "spec": spec}))
-                else:
+                if not dispatch(spec):
                     still_pending.append(spec)
             self.pending_actor_creations = still_pending
 
@@ -317,14 +553,7 @@ class GcsServer:
             still = collections.deque()
             while self.pending_tasks:
                 spec = self.pending_tasks.popleft()
-                res = spec.get("resources", {})
-                if idle and self._fits(res) and self._deps_ready(spec):
-                    w = idle.pop()
-                    self._acquire(res)
-                    w.idle = False
-                    w.running_task = spec
-                    to_send.append((w.conn, {"type": "exec", "spec": spec}))
-                else:
+                if not dispatch(spec):
                     still.append(spec)
             self.pending_tasks = still
 
@@ -339,35 +568,43 @@ class GcsServer:
                     w.running_task = spec
                     to_send.append((w.conn, {"type": "exec", "spec": spec}))
 
-            # scale-up: runnable-if-only-there-were-workers
+            # scale-up: runnable-if-only-there-were-workers, per node
             now = time.monotonic()
-            while self._spawn_pending and now - self._spawn_pending[0] > 60.0:
-                self._spawn_pending.popleft()  # spawn presumed failed; allow retry
-            spawning = len(self._spawn_pending)
-            demand = len(self.pending_tasks) + len(self.pending_actor_creations)
             n_workers = sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead)
-            if demand > 0:
-                headroom = self.max_workers - n_workers - spawning
-                want_spawn = max(0, min(demand - len(idle) - spawning, headroom))
-                for _ in range(want_spawn):
-                    self._spawn_pending.append(now)
+            spawning_total = 0
+            for node_id, dq in self._spawn_pending.items():
+                while dq and now - dq[0] > 60.0:
+                    dq.popleft()  # spawn presumed failed; allow retry
+                spawning_total += len(dq)
+            spawn_plan: list[tuple[str, int]] = []
+            headroom = self.max_workers - n_workers - spawning_total
+            for node_id, demand in want_spawn.items():
+                spawning_here = len(self._spawn_pending[node_id])
+                n = max(0, min(demand - spawning_here, headroom))
+                if n > 0:
+                    headroom -= n
+                    self._spawn_pending[node_id].extend([now] * n)
+                    spawn_plan.append((node_id, n))
 
         for conn, msg in to_send:
             try:
                 conn.send(msg)
             except ConnectionClosed:
                 pass
-        if want_spawn > 0:
-            self.spawn_worker_cb(want_spawn)
+        for node_id, n in spawn_plan:
+            self.spawn_worker_cb(n, node_id)
 
     def _on_task_done(self, msg: dict):
         wid = msg["wid"]
-        ready: list[tuple[str, dict]] = []
         with self.lock:
             w = self.workers.get(wid)
             spec = msg["spec"]
+            # prefer the GCS-side spec: it carries the _paid accounting tag the
+            # worker's lite echo doesn't (the worker never sees reservations)
+            if (w is not None and w.running_task is not None
+                    and w.running_task.get("task_id") == spec.get("task_id")):
+                spec = w.running_task
             kind = spec["kind"]
-            res = spec.get("resources", {})
             if w is not None:
                 w.running_task = None
             error = msg.get("error")
@@ -400,7 +637,7 @@ class GcsServer:
                     if w is not None:
                         w.actor_id = None
                         w.idle = True
-                    self._release(res)
+                    self._release_for(spec)
             else:
                 if kind == "actor_task":
                     actor = self.actors.get(spec["actor_id"])
@@ -409,8 +646,12 @@ class GcsServer:
                 else:
                     if w is not None:
                         w.idle = True
-                    self._release(res)
+                    self._release_for(spec)
             self.task_counter["finished" if error is None else "failed"] += 1
+            self.task_events.append({
+                "task_id": spec.get("task_id"), "kind": kind, "name": spec.get("name"),
+                "worker": wid, "error": error, "ts": time.time(),
+            })
 
             # record results
             for oid, where, inline, size in msg.get("results", ()):
@@ -426,6 +667,9 @@ class GcsServer:
 
     def _create_actor(self, spec: dict) -> str | None:
         with self.lock:
+            reason = self._invalid_strategy_reason(spec.get("strategy"))
+            if reason is not None:
+                return reason
             aid = spec["actor_id"]
             actor = _Actor(aid, spec)
             if actor.name:
@@ -499,6 +743,164 @@ class GcsServer:
                 pass
         # death will be observed via the worker connection closing
 
+    # -------------------------------------------------------- placement groups
+
+    def _create_pg(self, spec: dict) -> str | None:
+        with self.lock:
+            if spec.get("strategy", "PACK") not in pg_policy.STRATEGIES:
+                return (f"unknown placement strategy {spec.get('strategy')!r}; "
+                        f"expected one of {pg_policy.STRATEGIES}")
+            pg = _PG(spec["pg_id"], spec["bundles"], spec.get("strategy", "PACK"),
+                     spec.get("name") or "")
+            # feasibility against cluster totals (infeasible forever → error now;
+            # reference raises on infeasible PGs too)
+            class _TotNode:
+                pass
+            tot_nodes = []
+            for n in self.nodes.values():
+                if n.alive:
+                    t = _TotNode()
+                    t.node_id, t.total, t.available, t.labels, t.alive = (
+                        n.node_id, n.total, dict(n.total), n.labels, True)
+                    tot_nodes.append(t)
+            if pg_policy.place_bundles(tot_nodes, [b.total for b in pg.bundles], pg.strategy) is None:
+                return ("placement group is infeasible: no node set satisfies "
+                        f"{pg.strategy} over {spec['bundles']}")
+            if pg.name:
+                if pg.name in self.named_pgs and self.pgs[self.named_pgs[pg.name]].state != "removed":
+                    return f"a placement group named {pg.name!r} already exists"
+                self.named_pgs[pg.name] = pg.pg_id
+            self.pgs[pg.pg_id] = pg
+            self.objects.setdefault(pg_ready_oid(pg.pg_id),
+                                    {"status": "pending", "where": None, "inline": None, "size": 0})
+            self.pending_pgs.append(pg.pg_id)
+        self._schedule()
+        return None
+
+    def _try_place_pgs_locked(self):
+        """Called under lock from _schedule: try to place each pending PG."""
+        import ray_tpu._private.serialization as ser
+
+        placed: list[str] = []
+        still = collections.deque()
+        while self.pending_pgs:
+            pg_id = self.pending_pgs.popleft()
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state != "pending":
+                continue
+            assignment = pg_policy.place_bundles(
+                list(self.nodes.values()), [b.total for b in pg.bundles], pg.strategy)
+            if assignment is None:
+                still.append(pg_id)
+                continue
+            for b, node_id in zip(pg.bundles, assignment):
+                b.node_id = node_id
+                node = self.nodes[node_id]
+                for k, v in b.total.items():
+                    node.available[k] = node.available.get(k, 0.0) - v
+            pg.state = "created"
+            pg.epoch += 1
+            placed.append(pg_id)
+            for conn, rid in pg.waiters:
+                try:
+                    conn.send({"rid": rid, "ok": True})
+                except ConnectionClosed:
+                    pass
+            pg.waiters = []
+        self.pending_pgs = still
+        for pg_id in placed:
+            blob = ser.dumps(True)
+            oid = pg_ready_oid(pg_id)
+            self.objects[oid] = {"status": "ready", "where": "inline", "inline": blob, "size": len(blob)}
+            for conn, rid in self.object_waiters.pop(oid, []):
+                self._reply_object(conn, rid, self.objects[oid])
+
+    def _remove_pg(self, pg_id: str):
+        import ray_tpu._private.serialization as ser
+        from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+        waiters: list[tuple[MsgConnection, int]] = []
+        with self.lock:
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state == "removed":
+                return
+            if pg.state == "created":
+                # return only the *unused* share now; in-flight tasks return
+                # their share straight to the node on completion (_release_for)
+                for b in pg.bundles:
+                    node = self.nodes.get(b.node_id)
+                    if node is not None and node.alive:
+                        for k, v in b.available.items():
+                            node.available[k] = node.available.get(k, 0.0) + v
+            pg.state = "removed"
+            waiters, pg.waiters = pg.waiters, []
+            if pg.name and self.named_pgs.get(pg.name) == pg_id:
+                del self.named_pgs[pg.name]
+            self.pending_pgs = collections.deque(p for p in self.pending_pgs if p != pg_id)
+        for conn, rid in waiters:
+            try:
+                conn.send({"rid": rid, "ok": False, "error": "placement group removed"})
+            except ConnectionClosed:
+                pass
+        # resolve the ready-object as an error so get(pg.ready()) unblocks
+        blob = ser.dumps(PlacementGroupUnschedulableError("placement group removed"))
+        self._on_object_ready(pg_ready_oid(pg_id), where="inline", inline=blob,
+                              size=len(blob), is_error=True)
+        self._schedule()
+
+    def _pg_wait(self, conn: MsgConnection, msg: dict):
+        with self.lock:
+            pg = self.pgs.get(msg["pg_id"])
+            if pg is None:
+                err = "no such placement group"
+            elif pg.state == "created":
+                conn.send({"rid": msg["rid"], "ok": True})
+                return
+            elif pg.state == "pending":
+                pg.waiters.append((conn, msg["rid"]))
+                return
+            else:
+                err = "placement group removed"
+        try:
+            conn.send({"rid": msg["rid"], "ok": False, "error": err})
+        except ConnectionClosed:
+            pass
+
+    # ----------------------------------------------------------------- nodes
+
+    def _remove_node(self, node_id: str):
+        """Mark a virtual node dead: its workers die, its PG bundles unplace."""
+        to_fail: list[dict] = []
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            doomed = [w for w in self.workers.values()
+                      if w.node_id == node_id and w.kind == "worker" and not w.dead]
+            # PGs with bundles on this node go back to pending (reference: PG
+            # rescheduling on node failure, gcs_placement_group_manager.h)
+            for pg in self.pgs.values():
+                if pg.state == "created" and any(b.node_id == node_id for b in pg.bundles):
+                    for b in pg.bundles:
+                        other = self.nodes.get(b.node_id)
+                        if b.node_id != node_id and other is not None and other.alive:
+                            for k, v in b.available.items():
+                                other.available[k] = other.available.get(k, 0.0) + v
+                        b.available = dict(b.total)
+                        b.node_id = None
+                    pg.state = "pending"
+                    self.pending_pgs.append(pg.pg_id)
+                    oid = pg_ready_oid(pg.pg_id)
+                    self.objects[oid] = {"status": "pending", "where": None, "inline": None, "size": 0}
+        for w in doomed:
+            try:
+                w.conn.send({"type": "exit"})
+            except ConnectionClosed:
+                pass
+            self._on_worker_death(w.wid)
+        self._schedule()
+
     # ------------------------------------------------------------ fault paths
 
     def _fail_task_objects(self, spec: dict, reason: str):
@@ -525,8 +927,8 @@ class GcsServer:
             spec = w.running_task
             aid = w.actor_id
             if aid is None:
-                self._release({} if spec is None else spec.get("resources", {}) if spec["kind"] == "task" else {})
                 if spec is not None and spec["kind"] == "task":
+                    self._release_for(spec)
                     if spec.get("retries_used", 0) < spec.get("max_retries", 0):
                         spec["retries_used"] = spec.get("retries_used", 0) + 1
                         requeue = spec
@@ -534,9 +936,8 @@ class GcsServer:
                         fail.append(spec)
             else:
                 actor = self.actors.get(aid)
-                create_res = actor.create_spec.get("resources", {}) if actor else {}
-                self._release(create_res)
                 if actor is not None:
+                    self._release_for(actor.create_spec)
                     if spec is not None and spec["kind"] in ("actor_task", "actor_create"):
                         fail.append(spec)
                     actor.busy = False
@@ -545,6 +946,7 @@ class GcsServer:
                         if actor.restarts_left > 0:
                             actor.restarts_left -= 1
                         actor.state = "restarting"
+                        actor.num_restarts += 1
                         self.pending_actor_creations.append(actor.create_spec)
                     else:
                         actor.state = "dead"

@@ -66,14 +66,15 @@ class LocalWorker:
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
     def submit_task(self, func_blob, args, kwargs, *, num_returns=1, resources=None,
-                    max_retries=0, name=""):
+                    max_retries=0, name="", strategy=None):
         fn = ser.loads(func_blob) if isinstance(func_blob, bytes) else func_blob
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)
         kwargs = {k: self.get_object(v.hex()) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()}
         return self._run(fn, args, kwargs, TaskID().hex(), num_returns, name)
 
     # actors
-    def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0, name=None):
+    def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0,
+                     name=None, strategy=None):
         cls = ser.loads(cls_blob) if isinstance(cls_blob, bytes) else cls_blob
         aid = ActorID().hex()
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)
@@ -120,6 +121,41 @@ class LocalWorker:
 
     def kv_del(self, key):
         self.__init_kv().pop(key, None)
+
+    # placement groups: trivially satisfied inline
+    def create_pg(self, pg_id, bundles, strategy, name=""):
+        if not hasattr(self, "_pgs"):
+            self._pgs = {}
+        self._pgs[pg_id] = {"name": name, "state": "created", "strategy": strategy,
+                            "bundles": bundles, "bundle_nodes": ["node-0"] * len(bundles)}
+        from ray_tpu._private.gcs import pg_ready_oid
+
+        self._objects[pg_ready_oid(pg_id)] = (False, True)
+        if name:
+            self.__init_kv()[f"__pg_name:{name}"] = pg_id
+
+    def remove_pg(self, pg_id):
+        if hasattr(self, "_pgs") and pg_id in self._pgs:
+            self._pgs[pg_id]["state"] = "removed"
+
+    def pg_wait(self, pg_id, timeout=None):
+        return hasattr(self, "_pgs") and self._pgs.get(pg_id, {}).get("state") == "created"
+
+    def pg_table(self):
+        return dict(getattr(self, "_pgs", {}))
+
+    def get_named_pg(self, name):
+        return self.__init_kv().get(f"__pg_name:{name}")
+
+    def add_node(self, node_id, resources, labels=None):
+        pass
+
+    def remove_node(self, node_id):
+        pass
+
+    def list_nodes(self):
+        return [{"node_id": "node-0", "alive": True, "labels": {},
+                 "total": {"CPU": 1.0}, "available": {"CPU": 1.0}}]
 
     def cluster_state(self):
         return {

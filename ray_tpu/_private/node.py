@@ -48,6 +48,7 @@ class Node:
         num_workers: int = 0,
         max_workers: int = 16,
         session_dir: str | None = None,
+        labels: dict | None = None,
     ):
         self.session_id = uuid.uuid4().hex[:8]
         base = session_dir or os.path.join("/tmp", "ray_tpu")
@@ -70,6 +71,7 @@ class Node:
             total_resources=total,
             spawn_worker_cb=self._spawn_workers,
             max_workers=max_workers,
+            node_labels=labels,
         )
         self.gcs.start()
         # wait for socket
@@ -79,13 +81,15 @@ class Node:
             time.sleep(0.005)
         if num_workers:
             now = time.monotonic()
-            self.gcs._spawn_pending.extend([now] * num_workers)  # counted before spawn to avoid a register race
-            self._spawn_workers(num_workers)
+            # counted before spawn to avoid a register race
+            self.gcs._spawn_pending["node-0"].extend([now] * num_workers)
+            self._spawn_workers(num_workers, "node-0")
 
-    def _spawn_workers(self, n: int):
+    def _spawn_workers(self, n: int, node_id: str = "node-0"):
         env = dict(os.environ)
         env["RAY_TPU_SOCKET"] = self.socket_path
         env["RAY_TPU_SESSION"] = self.session_id
+        env["RAY_TPU_NODE_ID"] = node_id
         # Workers run CPU jax: the driver owns the TPU chip(s). Hard-set (not
         # setdefault) because the host env may preset JAX_PLATFORMS to the TPU
         # platform, and two processes must not fight over one chip

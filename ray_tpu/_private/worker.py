@@ -99,13 +99,16 @@ class CoreWorker:
         self.exec_queue: queue.SimpleQueue = queue.SimpleQueue()
         self._memory: dict[str, Any] = {}
         self._plasma_refs: dict[str, Any] = {}
+        self._obj_waits: dict[str, _Future] = {}  # oid → outstanding wait future
         self.actors: dict[str, Any] = {}  # actor instances hosted by this process
         self.current_actor_id: str | None = None
         self.current_task_id: str | None = None
         self._alive = True
+        self.node_id = os.environ.get("RAY_TPU_NODE_ID", "node-0")
         self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True, name="cw-recv")
         self._recv_thread.start()
-        self.rpc({"type": "register", "wid": self.wid, "kind": kind, "pid": os.getpid()})
+        self.rpc({"type": "register", "wid": self.wid, "kind": kind, "pid": os.getpid(),
+                  "node_id": self.node_id})
 
     # ------------------------------------------------------------------- rpc
 
@@ -192,6 +195,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_retries: int = 0,
         name: str = "",
+        strategy: dict | None = None,
     ) -> list[ObjectRef]:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
@@ -205,6 +209,7 @@ class CoreWorker:
             "max_retries": max_retries,
             "retries_used": 0,
             "name": name,
+            "strategy": strategy,
             **spec_part,
         }
         self.rpc({"type": "submit_task", "spec": spec})
@@ -219,6 +224,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_restarts: int = 0,
         name: str | None = None,
+        strategy: dict | None = None,
     ) -> str:
         actor_id = ActorID().hex()
         task_id = TaskID().hex()
@@ -233,6 +239,7 @@ class CoreWorker:
             "resources": resources or {"CPU": 1.0},
             "max_restarts": max_restarts,
             "name": name,
+            "strategy": strategy,
             **spec_part,
         }
         reply = self.rpc({"type": "create_actor", "spec": spec})
@@ -322,23 +329,37 @@ class CoreWorker:
             raise ValueError("num_returns > len(refs)")
         futures: list[tuple[ObjectRef, _Future | None]] = []
         for r in refs:
-            if r.hex() in self._memory:
+            oid = r.hex()
+            if oid in self._memory:
                 futures.append((r, None))
-            else:
-                futures.append((r, self.rpc_async({"type": "wait_object", "oid": r.hex()})))
+                continue
+            # one outstanding GCS waiter per object, however often wait() polls
+            fut = self._obj_waits.get(oid)
+            if fut is None:
+                fut = self.rpc_async({"type": "wait_object", "oid": oid})
+                self._obj_waits[oid] = fut
+            futures.append((r, fut))
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready, not_ready = [], []
+
+        def is_ready(f: _Future | None) -> bool:
+            # a "connection lost" error reply is NOT object-ready
+            return f is None or (f.event.is_set() and bool(f.value.get("ready")))
+
         while True:
-            ready = [r for r, f in futures if f is None or f.event.is_set()]
+            ready = [r for r, f in futures if is_ready(f)]
             if len(ready) >= num_returns or (deadline is not None and time.monotonic() >= deadline):
+                break
+            if not self._alive:
                 break
             time.sleep(0.002)
         ready_set = set()
         for r, f in futures:
-            if (f is None or f.event.is_set()) and len(ready_set) < num_returns:
+            if is_ready(f) and len(ready_set) < num_returns:
                 ready_set.add(r.hex())
         ready = [r for r in refs if r.hex() in ready_set]
         not_ready = [r for r in refs if r.hex() not in ready_set]
+        for r in ready:
+            self._obj_waits.pop(r.hex(), None)
         return ready, not_ready
 
     def free(self, refs: Sequence[ObjectRef]):
@@ -346,6 +367,7 @@ class CoreWorker:
         for oid in oids:
             self._memory.pop(oid, None)
             self._plasma_refs.pop(oid, None)
+            self._obj_waits.pop(oid, None)
             self.store.delete(oid)
         self.rpc({"type": "free_objects", "oids": oids})
 
@@ -366,6 +388,43 @@ class CoreWorker:
     def get_named_actor(self, name: str) -> str | None:
         reply = self.rpc({"type": "get_named_actor", "name": name})
         return reply["aid"]
+
+    # ------------------------------------------------------- placement groups
+
+    def create_pg(self, pg_id: str, bundles: list[dict], strategy: str, name: str = ""):
+        reply = self.rpc({"type": "create_pg", "spec": {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name}})
+        if not reply.get("ok"):
+            from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+            raise PlacementGroupUnschedulableError(reply.get("error") or "pg rejected")
+
+    def remove_pg(self, pg_id: str):
+        self.rpc({"type": "remove_pg", "pg_id": pg_id})
+
+    def pg_wait(self, pg_id: str, timeout: float | None = None) -> bool:
+        try:
+            reply = self.rpc({"type": "pg_wait", "pg_id": pg_id},
+                             timeout=timeout if timeout is not None else 86400.0)
+        except GetTimeoutError:
+            return False
+        return bool(reply.get("ok"))
+
+    def pg_table(self) -> dict:
+        return self.rpc({"type": "pg_table"})["table"]
+
+    def get_named_pg(self, name: str) -> str | None:
+        return self.rpc({"type": "get_named_pg", "name": name})["pg_id"]
+
+    def add_node(self, node_id: str, resources: dict, labels: dict | None = None):
+        self.rpc({"type": "add_node", "node_id": node_id, "resources": resources,
+                  "labels": labels or {}})
+
+    def remove_node(self, node_id: str):
+        self.rpc({"type": "remove_node", "node_id": node_id})
+
+    def list_nodes(self) -> list[dict]:
+        return self.rpc({"type": "list_nodes"})["nodes"]
 
     def cluster_state(self) -> dict:
         return self.rpc({"type": "cluster_state"})["state"]

@@ -61,14 +61,18 @@ class ActorHandle:
         return True
 
 
+_UNSET = object()
+
+
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, name=None, lifetime=None):
+                 max_restarts=0, name=None, lifetime=None, scheduling_strategy=None):
         self._cls = cls
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
         self._max_restarts = max_restarts
         self._name = name
+        self._strategy = scheduling_strategy
         self._blob: bytes | None = None
         self.__name__ = getattr(cls, "__name__", "Actor")
 
@@ -78,7 +82,8 @@ class ActorClass:
         return self._blob
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
-                max_restarts=None, name=None, lifetime=None, **_ignored) -> "ActorClass":
+                max_restarts=None, name=None, lifetime=None,
+                scheduling_strategy=_UNSET, **_ignored) -> "ActorClass":
         ac = ActorClass(
             self._cls,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -87,12 +92,15 @@ class ActorClass:
             max_restarts=self._max_restarts if max_restarts is None else max_restarts,
             name=name if name is not None else self._name,
             lifetime=lifetime,
+            scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
+                                 else scheduling_strategy),
         )
         ac._blob = self._blob
         return ac
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         from ray_tpu._private.api import _get_worker
+        from ray_tpu.util.scheduling_strategies import strategy_to_spec
 
         worker = _get_worker()
         actor_id = worker.create_actor(
@@ -102,6 +110,7 @@ class ActorClass:
             resources=self._resources,
             max_restarts=self._max_restarts,
             name=self._name,
+            strategy=strategy_to_spec(self._strategy),
         )
         return ActorHandle(actor_id)
 

@@ -1,0 +1,48 @@
+"""ray_tpu.data — streaming datasets for TPU pipelines.
+
+(reference: python/ray/data/ — SURVEY.md §2.4. Lazy logical plans, fused
+physical stages, a pull-based streaming executor over the task runtime, and
+device-prefetching iterators feeding jax device_puts.)
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import (
+    DataIterator,
+    Dataset,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "MaterializedDataset",
+    "ReadTask",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,375 @@
+"""Dataset: the lazy, streaming dataset API.
+
+(reference: python/ray/data/dataset.py:167 — map_batches:450,
+streaming_split:1854, iter_batches:5163, materialize:5994; read_api.py for
+the read_* constructors. Execution is deferred: transformations append
+logical ops; consumption builds fused physical stages and streams blocks
+through the ray_tpu task runtime.)
+"""
+
+from __future__ import annotations
+
+import builtins
+import collections
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_block
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    write_csv_block,
+    write_json_block,
+    write_parquet_block,
+)
+from ray_tpu.data.execution import (
+    StreamingExecutor,
+    _rebatch,
+    build_stages,
+    iter_result_blocks,
+)
+
+DEFAULT_PARALLELISM = 8
+
+
+class Dataset:
+    def __init__(self, last_op: L.LogicalOp):
+        self._op = last_op
+
+    # ------------------------------------------------------------ transforms
+
+    def _append(self, op: L.LogicalOp) -> "Dataset":
+        op.input = self._op
+        return Dataset(op)
+
+    def map_batches(self, fn: Callable, *, batch_size: int | None = None,
+                    batch_format: str = "numpy", fn_kwargs: dict | None = None,
+                    num_cpus: float = 1.0, num_tpus: float = 0.0,
+                    concurrency: int | None = None, compute: str = "tasks") -> "Dataset":
+        return self._append(L.MapBatches(
+            fn, batch_size=batch_size, batch_format=batch_format,
+            fn_kwargs=fn_kwargs or {}, num_cpus=num_cpus, num_tpus=num_tpus,
+            concurrency=concurrency, compute=compute))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._append(L.MapRows(fn, kind="map"))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._append(L.MapRows(fn, kind="filter"))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._append(L.MapRows(fn, kind="flat_map"))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(L.Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(L.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._append(L.RandomShuffle(seed))
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        return self._append(L.Sort(key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = [ray_tpu.put(list(self._materialize_blocks()))]
+        for o in others:
+            refs.append(ray_tpu.put(list(o._materialize_blocks())))
+        return Dataset(L.InputBlocks(refs=refs))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        return self.map_batches(select)
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        def rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self.map_batches(rename)
+
+    # ----------------------------------------------------------- consumption
+
+    def _stages(self):
+        ops = L.optimize(self._op.chain())
+        return build_stages(ops, DEFAULT_PARALLELISM)
+
+    def iter_blocks(self) -> Iterator[Block]:
+        yield from iter_result_blocks(self._stages())
+
+    def _materialize_blocks(self) -> list[Block]:
+        return list(self.iter_blocks())
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        for b in _rebatch(self.iter_blocks(), batch_size):
+            if drop_last and batch_size is not None and BlockAccessor(b).num_rows() < batch_size:
+                continue
+            yield _format_batch(b, batch_format)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for b in self.iter_blocks():
+            yield from BlockAccessor(b).iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256, device=None,
+                         prefetch: int = 2, drop_last: bool = True,
+                         dtypes: dict | None = None) -> Iterator[dict]:
+        """Batches as device arrays with async host→device prefetch.
+
+        (reference: data/iterator.py iter_torch_batches:269 moves batches to
+        GPU with a prefetch window; here the window is a deque of in-flight
+        `jax.device_put` transfers so the TPU never waits on PCIe.)"""
+        import jax
+
+        pending: collections.deque = collections.deque()
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            arrs = {k: np.asarray(v) for k, v in batch.items()}
+            if dtypes:
+                arrs = {k: (v.astype(dtypes[k]) if k in dtypes else v) for k, v in arrs.items()}
+            fut = jax.device_put(arrs, device)  # async dispatch
+            pending.append(fut)
+            while len(pending) > prefetch:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+
+    def take(self, n: int = 20) -> list[dict]:
+        out: list[dict] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self.iter_blocks())
+
+    def schema(self) -> dict[str, str] | None:
+        for b in self.iter_blocks():
+            if BlockAccessor(b).num_rows():
+                return BlockAccessor(b).schema()
+        return None
+
+    def materialize(self) -> "MaterializedDataset":
+        blocks = self._materialize_blocks()
+        refs = [ray_tpu.put([b]) for b in blocks]
+        return MaterializedDataset(L.InputBlocks(refs=refs), blocks_meta=[
+            BlockAccessor(b).num_rows() for b in blocks])
+
+    def split(self, n: int) -> list["Dataset"]:
+        blocks = self._materialize_blocks()
+        merged = concat_blocks(blocks)
+        acc = BlockAccessor(merged)
+        total = acc.num_rows()
+        shards = []
+        step = total // n
+        for i in builtins.range(n):
+            start = i * step
+            end = total if i == n - 1 else (i + 1) * step
+            shards.append(Dataset(L.InputBlocks(refs=[ray_tpu.put([acc.slice(start, end)])])))
+        return shards
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> list["DataIterator"]:
+        """N coordinated iterators backed by one shared executor actor.
+        (reference: dataset.py streaming_split:1854 + output_splitter.py)"""
+        coordinator = _SplitCoordinator.options(name=None).remote(self._op, n)
+        return [DataIterator(coordinator, i) for i in builtins.range(n)]
+
+    # ---------------------------------------------------------------- writes
+
+    def write_parquet(self, path: str) -> list[str]:
+        return self._write(path, write_parquet_block)
+
+    def write_csv(self, path: str) -> list[str]:
+        return self._write(path, write_csv_block)
+
+    def write_json(self, path: str) -> list[str]:
+        return self._write(path, write_json_block)
+
+    def _write(self, path: str, writer) -> list[str]:
+        files = []
+        for i, b in enumerate(self.iter_blocks()):
+            if BlockAccessor(b).num_rows():
+                files.append(writer(b, path, i))
+        return files
+
+    def stats(self) -> str:
+        ops = [type(o).__name__ for o in self._op.chain()]
+        stages = self._stages()
+        return (f"logical: {' -> '.join(ops)}\n"
+                f"physical: {' -> '.join(s.name for s in stages)}")
+
+    def __repr__(self):
+        return f"Dataset({' -> '.join(type(o).__name__ for o in self._op.chain())})"
+
+
+class MaterializedDataset(Dataset):
+    def __init__(self, op, blocks_meta=None):
+        super().__init__(op)
+        self._blocks_meta = blocks_meta or []
+
+    def num_blocks(self) -> int:
+        return len(self._blocks_meta)
+
+
+def _format_batch(block: Block, batch_format: str):
+    if batch_format == "numpy":
+        return BlockAccessor(block).to_numpy()
+    if batch_format == "pandas":
+        return BlockAccessor(block).to_pandas()
+    if batch_format == "pyarrow":
+        return BlockAccessor(block).to_arrow()
+    if batch_format in (None, "native"):
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Actor running the shared executor for streaming_split consumers.
+
+    (reference: _internal/execution/streaming_executor takes this role via
+    OutputSplitter, execution/operators/output_splitter.py — blocks are
+    routed round-robin to N registered consumers with per-split queues.)"""
+
+    def __init__(self, last_op, n: int):
+        self.n = n
+        stages = build_stages(L.optimize(last_op.chain()), DEFAULT_PARALLELISM)
+        self._queues: list[collections.deque] = [collections.deque() for _ in builtins.range(n)]
+        self._ex = StreamingExecutor(stages)
+        self._gen = self._ex.execute()
+        self._rr = 0
+        self._done = False
+
+    def _pump_until(self, split: int) -> None:
+        while not self._queues[split] and not self._done:
+            try:
+                item = next(self._gen)
+            except StopIteration:
+                self._done = True
+                return
+            got = ray_tpu.get(item) if hasattr(item, "hex") else item
+            self._ex._free_if_owned(item)
+            blocks = got if isinstance(got, list) else [got]
+            for b in blocks:
+                if BlockAccessor(b).num_rows():
+                    self._queues[self._rr % self.n].append(b)
+                    self._rr += 1
+
+    def get_next(self, split: int):
+        self._pump_until(split)
+        if self._queues[split]:
+            return self._queues[split].popleft()
+        return None  # exhausted
+
+
+class DataIterator:
+    """Per-consumer handle for one split of a streaming_split.
+
+    (reference: data/iterator.py DataIterator — iter_batches on a shard.)"""
+
+    def __init__(self, coordinator, split: int):
+        self._coord = coordinator
+        self._split = split
+
+    def iter_blocks(self) -> Iterator[Block]:
+        while True:
+            ref = self._coord.get_next.remote(self._split)
+            block = ray_tpu.get(ref)
+            ray_tpu.free([ref])  # actor-returned copies are single-consumer
+            if block is None:
+                return
+            yield block
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        for b in _rebatch(self.iter_blocks(), batch_size):
+            yield _format_batch(b, batch_format)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for b in self.iter_blocks():
+            yield from BlockAccessor(b).iter_rows()
+
+
+# ------------------------------------------------------------------- readers
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001 — mirrors reference name
+    return Dataset(L.Read(RangeDatasource(n), parallelism))
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(ItemsDatasource(items), parallelism))
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(ParquetDatasource(paths, columns), parallelism))
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(CSVDatasource(paths), parallelism))
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(JSONDatasource(paths), parallelism))
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(NumpyDatasource(paths), parallelism))
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(BinaryDatasource(paths), parallelism))
+
+
+def read_images(paths, *, size=None, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(ImageDatasource(paths, size), parallelism))
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(ds, parallelism))
+
+
+def from_numpy(arr) -> Dataset:
+    return Dataset(L.InputBlocks(refs=[ray_tpu.put([{"data": np.asarray(arr)}])]))
+
+
+def from_pandas(df) -> Dataset:
+    from ray_tpu.data.block import normalize_block
+
+    return Dataset(L.InputBlocks(refs=[ray_tpu.put([normalize_block(df)])]))
+
+
+def from_arrow(table) -> Dataset:
+    from ray_tpu.data.block import normalize_block
+
+    return Dataset(L.InputBlocks(refs=[ray_tpu.put([normalize_block(table)])]))

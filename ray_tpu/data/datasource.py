@@ -1,0 +1,249 @@
+"""Datasources and sinks: pluggable readers/writers producing ReadTasks.
+
+(reference: python/ray/data/read_api.py + _internal/datasource/* — each
+datasource yields ReadTasks, one per file/fragment, executed as remote tasks
+by the streaming executor.)
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.data.block import Block, rows_to_block
+
+
+@dataclass
+class ReadTask:
+    """A zero-arg callable returning a list of blocks, plus size metadata."""
+
+    fn: Callable[[], list]
+    num_rows: int | None = None
+    input_files: list = field(default_factory=list)
+
+    def __call__(self) -> list:
+        return self.fn()
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    """(reference: read_api.py range():245)"""
+
+    def __init__(self, n: int, *, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        step = (self.n + parallelism - 1) // parallelism
+        tasks = []
+        for start in range(0, self.n, step):
+            end = min(start + step, self.n)
+            col = self.column
+
+            def fn(start=start, end=end):
+                return [{col: np.arange(start, end)}]
+
+            tasks.append(ReadTask(fn, num_rows=end - start))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: list):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        step = (n + parallelism - 1) // parallelism
+        tasks = []
+        for start in range(0, n, step):
+            chunk = self.items[start:start + step]
+
+            def fn(chunk=chunk):
+                return [rows_to_block(chunk)]
+
+            tasks.append(ReadTask(fn, num_rows=len(chunk)))
+        return tasks
+
+
+def _expand_paths(paths, suffixes: tuple[str, ...]) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for suf in suffixes:
+                out.extend(sorted(_glob.glob(os.path.join(p, f"*{suf}"))))
+        elif _glob.has_magic(p):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class FileDatasource(Datasource):
+    suffixes: tuple[str, ...] = ()
+
+    def __init__(self, paths):
+        self.paths = _expand_paths(paths, self.suffixes)
+
+    def read_file(self, path: str) -> list:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        groups: list[list[str]] = [[] for _ in range(max(1, min(parallelism, len(self.paths))))]
+        for i, p in enumerate(self.paths):
+            groups[i % len(groups)].append(p)
+        tasks = []
+        for grp in groups:
+            if not grp:
+                continue
+
+            def fn(grp=grp, reader=self.read_file):
+                blocks = []
+                for path in grp:
+                    blocks.extend(reader(path))
+                return blocks
+
+            tasks.append(ReadTask(fn, input_files=grp))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    suffixes = (".parquet",)
+
+    def __init__(self, paths, columns=None):
+        super().__init__(paths)
+        self.columns = columns
+
+    def read_file(self, path: str) -> list:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path, columns=self.columns)
+        from ray_tpu.data.block import normalize_block
+
+        return [normalize_block(table)]
+
+
+class CSVDatasource(FileDatasource):
+    suffixes = (".csv",)
+
+    def read_file(self, path: str) -> list:
+        import pyarrow.csv as pacsv
+
+        from ray_tpu.data.block import normalize_block
+
+        return [normalize_block(pacsv.read_csv(path))]
+
+
+class JSONDatasource(FileDatasource):
+    suffixes = (".json", ".jsonl")
+
+    def read_file(self, path: str) -> list:
+        import json
+
+        rows = []
+        with open(path) as f:
+            text = f.read().strip()
+        if text.startswith("["):
+            rows = json.loads(text)
+        else:
+            for line in text.splitlines():
+                if line.strip():
+                    rows.append(json.loads(line))
+        return [rows_to_block(rows)]
+
+
+class NumpyDatasource(FileDatasource):
+    suffixes = (".npy",)
+
+    def read_file(self, path: str) -> list:
+        return [{"data": np.load(path)}]
+
+
+class BinaryDatasource(FileDatasource):
+    suffixes = ()
+
+    def read_file(self, path: str) -> list:
+        with open(path, "rb") as f:
+            return [{"bytes": [f.read()], "path": [path]}]
+
+
+class ImageDatasource(FileDatasource):
+    """Decoded image files → {"image": HWC uint8 array, "path"} rows.
+    (reference: read_api.py read_images:1048)"""
+
+    suffixes = (".png", ".jpg", ".jpeg", ".bmp")
+
+    def __init__(self, paths, size: tuple[int, int] | None = None):
+        super().__init__(paths)
+        self.size = size
+
+    def read_file(self, path: str) -> list:
+        try:
+            from PIL import Image
+        except ImportError as e:  # pillow is optional in this image
+            raise ImportError("read_images requires pillow") from e
+
+        img = Image.open(path).convert("RGB")
+        if self.size is not None:
+            img = img.resize(self.size)
+        arr = np.asarray(img)
+        return [{"image": arr[None, ...], "path": [path]}]
+
+
+# --------------------------------------------------------------------- writes
+
+
+def write_parquet_block(block: Block, path: str, index: int) -> str:
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_csv_block(block: Block, path: str, index: int) -> str:
+    import pyarrow.csv as pacsv
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    pacsv.write_csv(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_json_block(block: Block, path: str, index: int) -> str:
+    import json
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    with open(out, "w") as f:
+        for row in BlockAccessor(block).iter_rows():
+            f.write(json.dumps({k: _json_safe(v) for k, v in row.items()}) + "\n")
+    return out
+
+
+def _json_safe(v: Any):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v

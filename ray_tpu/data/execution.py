@@ -1,0 +1,400 @@
+"""Physical plan + streaming executor.
+
+Stages are fused chains of block transforms executed as remote tasks over the
+ray_tpu runtime; the executor is a driver-side scheduling loop with bounded
+per-stage concurrency and bounded output queues (backpressure), pulling
+blocks through the pipeline as the consumer iterates.
+
+(reference: python/ray/data/_internal/execution/streaming_executor.py:64 —
+the _scheduling_loop_step:444 select/dispatch/process loop;
+operators/map_operator.py:68 for task-pool maps; backpressure policies under
+execution/backpressure_policy/. Ours is deliberately simpler: per-stage
+in-flight caps + output-queue caps give the same streaming property.)
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_block
+
+
+# Transform fns operate on list[Block] → list[Block]; a stage fuses several.
+
+
+def _rows_transform(fn: Callable, kind: str) -> Callable:
+    def transform(blocks: list[Block]) -> list[Block]:
+        out = []
+        for b in blocks:
+            acc = BlockAccessor(b)
+            if kind == "map":
+                out.append(rows_to_block([fn(r) for r in acc.iter_rows()]))
+            elif kind == "filter":
+                out.append(rows_to_block([r for r in acc.iter_rows() if fn(r)]))
+            else:  # flat_map
+                rows: list = []
+                for r in acc.iter_rows():
+                    rows.extend(fn(r))
+                out.append(rows_to_block(rows))
+        return out
+
+    return transform
+
+
+def _batches_transform(fn: Callable, batch_size: int | None, batch_format: str,
+                       fn_kwargs: dict) -> Callable:
+    from ray_tpu.data.block import normalize_block
+
+    def transform(blocks: list[Block]) -> list[Block]:
+        out = []
+        for b in _rebatch(blocks, batch_size):
+            if batch_format == "pandas":
+                b = BlockAccessor(b).to_pandas()
+            elif batch_format == "pyarrow":
+                b = BlockAccessor(b).to_arrow()
+            else:
+                b = BlockAccessor(b).to_numpy()
+            res = fn(b, **fn_kwargs)
+            out.append(normalize_block(res))
+        return out
+
+    return transform
+
+
+def _rebatch(blocks: list[Block], batch_size: int | None) -> Iterator[Block]:
+    if batch_size is None:
+        yield from (b for b in blocks if BlockAccessor(b).num_rows() > 0)
+        return
+    buf: list[Block] = []
+    buffered = 0
+    for b in blocks:
+        n = BlockAccessor(b).num_rows()
+        if n == 0:
+            continue
+        buf.append(b)
+        buffered += n
+        while buffered >= batch_size:
+            merged = concat_blocks(buf)
+            acc = BlockAccessor(merged)
+            yield acc.slice(0, batch_size)
+            rest = acc.slice(batch_size, acc.num_rows())
+            buf = [rest] if BlockAccessor(rest).num_rows() else []
+            buffered = BlockAccessor(rest).num_rows() if buf else 0
+    if buffered:
+        yield concat_blocks(buf)
+
+
+@dataclass
+class Stage:
+    """A fused physical stage: source tasks or a transform over input refs."""
+
+    name: str
+    transforms: list[Callable] = field(default_factory=list)
+    read_tasks: list | None = None        # source stage if set
+    input_refs: list | None = None        # pre-materialized source
+    all_to_all: Callable | None = None    # barrier stage if set
+    resources: dict = field(default_factory=lambda: {"CPU": 1.0})
+    max_in_flight: int = 8
+
+    def run_chain(self, blocks: list[Block]) -> list[Block]:
+        for t in self.transforms:
+            blocks = t(blocks)
+        return blocks
+
+
+def _stage_task(transforms: list[Callable]):
+    def run(payload) -> list[Block]:
+        blocks = payload() if callable(payload) else payload
+        for t in transforms:
+            blocks = t(blocks)
+        return blocks
+
+    return run
+
+
+def build_stages(ops: list[L.LogicalOp], default_parallelism: int) -> list[Stage]:
+    """Logical ops → fused physical stages.
+    (reference: _internal/planner/planner.py + rules/operator_fusion.py)"""
+    stages: list[Stage] = []
+    cur: Stage | None = None
+
+    def flush():
+        nonlocal cur
+        if cur is not None:
+            stages.append(cur)
+            cur = None
+
+    for op in ops:
+        if isinstance(op, L.Read):
+            flush()
+            par = op.parallelism if op.parallelism > 0 else default_parallelism
+            tasks = op.datasource.get_read_tasks(par)
+            if op.limit is not None:
+                tasks = _cap_read_tasks(tasks, op.limit)
+            cur = Stage(name="Read", read_tasks=list(tasks))
+        elif isinstance(op, L.InputBlocks):
+            flush()
+            cur = Stage(name="Input", input_refs=list(op.refs))
+        elif isinstance(op, L.MapBatches):
+            t = _batches_transform(op.fn, op.batch_size, op.batch_format, op.fn_kwargs)
+            res = {"CPU": op.num_cpus}
+            if op.num_tpus:
+                res["TPU"] = op.num_tpus
+            if cur is not None and cur.all_to_all is None and res == cur.resources:
+                cur.name += "->MapBatches"
+                cur.transforms.append(t)
+            else:
+                flush()
+                cur = Stage(name="MapBatches", transforms=[t], resources=res,
+                            max_in_flight=op.concurrency or 8)
+        elif isinstance(op, L.MapRows):
+            t = _rows_transform(op.fn, op.kind)
+            if cur is not None and cur.all_to_all is None:
+                cur.name += f"->{op.kind}"
+                cur.transforms.append(t)
+            else:
+                flush()
+                cur = Stage(name=op.kind, transforms=[t])
+        elif isinstance(op, L.Limit):
+            flush()
+            stages.append(Stage(name="Limit", all_to_all=_limit_fn(op.n)))
+        elif isinstance(op, L.Repartition):
+            flush()
+            stages.append(Stage(name="Repartition", all_to_all=_repartition_fn(op.num_blocks)))
+        elif isinstance(op, L.RandomShuffle):
+            flush()
+            stages.append(Stage(name="RandomShuffle", all_to_all=_shuffle_fn(op.seed)))
+        elif isinstance(op, L.Sort):
+            flush()
+            stages.append(Stage(name="Sort", all_to_all=_sort_fn(op.key, op.descending)))
+        elif isinstance(op, L.Union):
+            pass  # handled at Dataset level by ref concatenation
+        else:
+            raise TypeError(f"unknown logical op {op}")
+    flush()
+    if not stages:
+        stages = [Stage(name="Input", input_refs=[])]
+    return stages
+
+
+def _cap_read_tasks(tasks, n):
+    out, left = [], n
+    for t in tasks:
+        if left <= 0:
+            break
+        out.append(t)
+        if t.num_rows is not None:
+            left -= t.num_rows
+    return out
+
+
+def _limit_fn(n: int):
+    def cut(all_blocks: list[Block]) -> list[list[Block]]:
+        out, left = [], n
+        for b in all_blocks:
+            if left <= 0:
+                break
+            acc = BlockAccessor(b)
+            take = min(left, acc.num_rows())
+            out.append(acc.slice(0, take))
+            left -= take
+        return [out]
+
+    return cut
+
+
+def _repartition_fn(k: int):
+    def repart(all_blocks: list[Block]) -> list[list[Block]]:
+        merged = concat_blocks(all_blocks)
+        total = BlockAccessor(merged).num_rows()
+        step = max(1, (total + k - 1) // k)
+        acc = BlockAccessor(merged)
+        return [[acc.slice(i, min(i + step, total))] for i in range(0, total, step)] or [[{}]]
+
+    return repart
+
+
+def _shuffle_fn(seed):
+    def shuf(all_blocks: list[Block]) -> list[list[Block]]:
+        merged = concat_blocks(all_blocks)
+        acc = BlockAccessor(merged)
+        n = acc.num_rows()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        out = {k: (np.asarray(v)[perm] if isinstance(v, np.ndarray) else [v[i] for i in perm])
+               for k, v in merged.items()}
+        return [[out]]
+
+    return shuf
+
+
+def _sort_fn(key: str, descending: bool):
+    def srt(all_blocks: list[Block]) -> list[list[Block]]:
+        merged = concat_blocks(all_blocks)
+        idx = np.argsort(np.asarray(merged[key]), kind="stable")
+        if descending:
+            idx = idx[::-1]
+        out = {k: (np.asarray(v)[idx] if isinstance(v, np.ndarray) else [v[i] for i in idx])
+               for k, v in merged.items()}
+        return [[out]]
+
+    return srt
+
+
+class StreamingExecutor:
+    """Pull-based streaming executor: yields lists of blocks as they finish.
+
+    Backpressure: per-stage `max_in_flight` remote tasks + `max_queued`
+    finished-but-unconsumed outputs; upstream dispatch stalls while a
+    downstream queue is full.
+    """
+
+    def __init__(self, stages: list[Stage], *, max_queued: int = 16):
+        self.stages = stages
+        self.max_queued = max_queued
+        # refs produced by THIS execution (not caller-owned input refs); safe
+        # to free once consumed — keeps streaming memory bounded instead of
+        # pinning every block in the driver for the run's lifetime
+        self.owned: set[str] = set()
+
+    def _free_if_owned(self, item) -> None:
+        if hasattr(item, "hex") and item.hex() in self.owned:
+            self.owned.discard(item.hex())
+            try:
+                ray_tpu.free([item])
+            except Exception:  # noqa: BLE001 — cleanup must not kill the stream
+                pass
+
+    def execute(self) -> Iterator[list]:
+        """Yield ObjectRefs of list[Block] results of the final stage."""
+        remote_cache: dict[int, Any] = {}
+
+        def stage_remote(i: int, stage: Stage):
+            if i not in remote_cache:
+                res = stage.resources
+                remote_cache[i] = ray_tpu.remote(
+                    num_cpus=res.get("CPU", 1.0),
+                    num_tpus=res.get("TPU", 0.0) or None,
+                )(_stage_task(stage.transforms))
+            return remote_cache[i]
+
+        # Coalesce [source(+fused maps)] [a2a] [maps] ... into pipeline phases.
+        first = self.stages[0]
+        rest = self.stages[1:]
+
+        source_payloads: collections.deque = collections.deque()
+        if first.read_tasks is not None:
+            source_payloads.extend(first.read_tasks)
+            source_is_refs = False
+        else:
+            source_payloads.extend(first.input_refs or [])
+            source_is_refs = True
+
+        # state per downstream stage
+        in_flight: list[dict] = [{} for _ in rest]  # ref -> None
+        queues: list[collections.deque] = [collections.deque() for _ in range(len(rest) + 1)]
+        src_in_flight: dict = {}
+
+        def barrier_positions():
+            return [i for i, s in enumerate(rest) if s.all_to_all is not None]
+
+        a2a_done = [False] * len(rest)
+
+        def pump() -> None:
+            # source dispatch
+            while (source_payloads and len(src_in_flight) < first.max_in_flight
+                   and len(queues[0]) < self.max_queued):
+                payload = source_payloads.popleft()
+                if source_is_refs and not first.transforms:
+                    queues[0].append(payload)
+                    continue
+                fn = stage_remote(-1, first)
+                ref = fn.remote(payload)
+                self.owned.add(ref.hex())
+                src_in_flight[ref.hex()] = ref
+
+            # poll source completions
+            if src_in_flight:
+                refs = list(src_in_flight.values())
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+                for r in ready:
+                    src_in_flight.pop(r.hex(), None)
+                    queues[0].append(r)
+
+            # downstream stages
+            for i, stage in enumerate(rest):
+                if stage.all_to_all is not None:
+                    # barrier: wait until everything upstream drained
+                    upstream_done = (not source_payloads and not src_in_flight
+                                     and all(not f for f in in_flight[:i])
+                                     and all(not queues[j] or j == i for j in range(i + 1)))
+                    if a2a_done[i] or not upstream_done or not _upstream_a2a_done(i):
+                        continue
+                    inputs = list(queues[i])
+                    queues[i].clear()
+                    blocks: list[Block] = []
+                    for item in inputs:
+                        got = ray_tpu.get(item) if hasattr(item, "hex") else item
+                        blocks.extend(got if isinstance(got, list) else [got])
+                        self._free_if_owned(item)
+                    for out_blocks in stage.all_to_all(blocks):
+                        queues[i + 1].append(out_blocks)  # plain lists, not refs
+                    a2a_done[i] = True
+                    continue
+                # map stage
+                while (queues[i] and len(in_flight[i]) < stage.max_in_flight
+                       and len(queues[i + 1]) < self.max_queued):
+                    item = queues[i].popleft()
+                    fn = stage_remote(i, stage)
+                    ref = fn.remote(item)
+                    self.owned.add(ref.hex())
+                    in_flight[i][ref.hex()] = (ref, item)
+                if in_flight[i]:
+                    refs = [r for r, _ in in_flight[i].values()]
+                    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+                    for r in ready:
+                        _, consumed = in_flight[i].pop(r.hex())
+                        self._free_if_owned(consumed)
+                        queues[i + 1].append(r)
+
+        def _upstream_a2a_done(i):
+            return all(a2a_done[j] for j, s in enumerate(rest[:i]) if s.all_to_all is not None)
+
+        def all_done() -> bool:
+            return (not source_payloads and not src_in_flight
+                    and all(not f for f in in_flight)
+                    and all(not q for q in queues[:-1])
+                    and all(a2a_done[i] for i, s in enumerate(rest) if s.all_to_all is not None))
+
+        idle_spin = 0.0
+        while True:
+            pump()
+            if queues[-1]:
+                while queues[-1]:
+                    yield queues[-1].popleft()
+                idle_spin = 0.0
+                continue
+            if all_done():
+                return
+            time.sleep(min(0.05, 0.001 + idle_spin))
+            idle_spin = min(0.05, idle_spin + 0.002)
+
+
+def iter_result_blocks(stages: list[Stage]) -> Iterator[Block]:
+    """Execute and yield individual blocks (driver-side materialized)."""
+    ex = StreamingExecutor(stages)
+    for item in ex.execute():
+        got = ray_tpu.get(item) if hasattr(item, "hex") else item
+        ex._free_if_owned(item)
+        if isinstance(got, list):
+            yield from got
+        else:
+            yield got

@@ -1,0 +1,124 @@
+"""Logical plan: lazy operator DAG + rewrite rules.
+
+(reference: python/ray/data/_internal/logical/operators/* for the op
+vocabulary and _internal/logical/rules/{operator_fusion,limit_pushdown}.py
+for the rules mirrored here.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu.data.datasource import Datasource
+
+
+class LogicalOp:
+    input: "LogicalOp | None" = None
+
+    def chain(self) -> list["LogicalOp"]:
+        ops: list[LogicalOp] = []
+        cur: LogicalOp | None = self
+        while cur is not None:
+            ops.append(cur)
+            cur = cur.input
+        return list(reversed(ops))
+
+
+@dataclass
+class Read(LogicalOp):
+    datasource: Datasource
+    parallelism: int = -1
+    input: LogicalOp | None = None
+    limit: int | None = None  # pushed-down row cap
+
+
+@dataclass
+class InputBlocks(LogicalOp):
+    """Pre-materialized blocks (from_blocks / from_pandas / union output)."""
+
+    refs: list = field(default_factory=list)
+    input: LogicalOp | None = None
+
+
+@dataclass
+class MapBatches(LogicalOp):
+    fn: Callable
+    input: LogicalOp | None = None
+    batch_size: int | None = None
+    fn_kwargs: dict = field(default_factory=dict)
+    compute: str = "tasks"  # "tasks" | "actors"
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    concurrency: int | None = None
+    batch_format: str = "numpy"
+
+
+@dataclass
+class MapRows(LogicalOp):
+    fn: Callable
+    input: LogicalOp | None = None
+    kind: str = "map"  # map | filter | flat_map
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int
+    input: LogicalOp | None = None
+
+
+@dataclass
+class Repartition(LogicalOp):
+    num_blocks: int
+    input: LogicalOp | None = None
+
+
+@dataclass
+class RandomShuffle(LogicalOp):
+    seed: int | None = None
+    input: LogicalOp | None = None
+
+
+@dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+    input: LogicalOp | None = None
+
+
+@dataclass
+class Union(LogicalOp):
+    others: list = field(default_factory=list)  # list[LogicalOp]
+    input: LogicalOp | None = None
+
+
+# ----------------------------------------------------------------- optimizer
+
+
+def apply_limit_pushdown(ops: list[LogicalOp]) -> list[LogicalOp]:
+    """Move a Limit below strictly row-preserving ops (MapRows kind="map"
+    only — map_batches/filter/flat_map may change row counts) and into Read
+    as a row cap. (reference: _internal/logical/rules/limit_pushdown.py)"""
+    out = list(ops)
+    changed = True
+    while changed:
+        changed = False
+        for i in range(1, len(out)):
+            if isinstance(out[i], Limit):
+                prev = out[i - 1]
+                if isinstance(prev, MapRows) and prev.kind == "map":
+                    out[i - 1], out[i] = out[i], out[i - 1]
+                    changed = True
+                elif isinstance(prev, Read) and prev.limit is None:
+                    prev.limit = out[i].n
+                    # keep the Limit too: reads are per-task capped, the
+                    # executor still needs the global cut
+    return out
+
+
+def optimize(ops: list[LogicalOp]) -> list[LogicalOp]:
+    # operate on copies: plans are shared between sibling datasets derived
+    # from the same source, and rules mutate ops (e.g. Read.limit)
+    import copy
+
+    return apply_limit_pushdown([copy.copy(op) for op in ops])

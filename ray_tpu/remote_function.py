@@ -11,6 +11,8 @@ from typing import Any
 
 from ray_tpu._private import serialization as ser
 
+_UNSET = object()
+
 
 def _build_resources(num_cpus, num_tpus, resources) -> dict:
     out = {"CPU": 1.0 if num_cpus is None else float(num_cpus)}
@@ -25,12 +27,13 @@ def _build_resources(num_cpus, num_tpus, resources) -> dict:
 
 class RemoteFunction:
     def __init__(self, func, *, num_cpus=None, num_tpus=None, resources=None,
-                 num_returns=1, max_retries=0):
+                 num_returns=1, max_retries=0, scheduling_strategy=None):
         self._func = func
         self._num_returns = num_returns
         self._max_retries = max_retries
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
+        self._strategy = scheduling_strategy
         self._blob: bytes | None = None
         functools.update_wrapper(self, func)
 
@@ -40,7 +43,8 @@ class RemoteFunction:
         return self._blob
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
-                num_returns=None, max_retries=None, **_ignored) -> "RemoteFunction":
+                num_returns=None, max_retries=None, scheduling_strategy=_UNSET,
+                **_ignored) -> "RemoteFunction":
         rf = RemoteFunction(
             self._func,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -48,12 +52,15 @@ class RemoteFunction:
             resources=self._opts["resources"] if resources is None else resources,
             num_returns=self._num_returns if num_returns is None else num_returns,
             max_retries=self._max_retries if max_retries is None else max_retries,
+            scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
+                                 else scheduling_strategy),
         )
         rf._blob = self._blob
         return rf
 
     def remote(self, *args, **kwargs):
         from ray_tpu._private.api import _get_worker
+        from ray_tpu.util.scheduling_strategies import strategy_to_spec
 
         worker = _get_worker()
         refs = worker.submit_task(
@@ -64,6 +71,7 @@ class RemoteFunction:
             resources=self._resources,
             max_retries=self._max_retries,
             name=getattr(self._func, "__name__", "task"),
+            strategy=strategy_to_spec(self._strategy),
         )
         return refs[0] if self._num_returns == 1 else refs
 

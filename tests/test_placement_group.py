@@ -1,0 +1,210 @@
+"""Placement groups, scheduling strategies, and the virtual-node cluster.
+
+(reference test model: python/ray/tests/test_placement_group*.py + the
+cluster_utils.Cluster harness, SURVEY.md §4.2.)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import pg_policy
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import PlacementGroupUnschedulableError
+from ray_tpu.util import (
+    get_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+class _N:
+    def __init__(self, node_id, total, labels=None, alive=True):
+        self.node_id = node_id
+        self.total = dict(total)
+        self.available = dict(total)
+        self.labels = labels or {}
+        self.alive = alive
+
+
+# ---------------------------------------------------------------- pure policy
+
+
+def test_strict_pack_single_node():
+    nodes = [_N("a", {"CPU": 4}), _N("b", {"CPU": 2})]
+    got = pg_policy.place_bundles(nodes, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+    assert got == ["a", "a"]
+
+
+def test_strict_pack_unplaceable():
+    nodes = [_N("a", {"CPU": 2}), _N("b", {"CPU": 2})]
+    assert pg_policy.place_bundles(nodes, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK") is None
+
+
+def test_strict_spread_needs_distinct_nodes():
+    nodes = [_N("a", {"CPU": 4})]
+    assert pg_policy.place_bundles(nodes, [{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD") is None
+    nodes.append(_N("b", {"CPU": 1}))
+    got = pg_policy.place_bundles(nodes, [{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+    assert got is not None and len(set(got)) == 2
+
+
+def test_pack_spills_when_one_node_cannot_hold_all():
+    nodes = [_N("a", {"CPU": 4}), _N("b", {"CPU": 4})]
+    got = pg_policy.place_bundles(nodes, [{"CPU": 2}] * 3, "PACK")
+    assert got is not None and len(got) == 3 and len(set(got)) == 2
+
+
+def test_spread_distributes():
+    nodes = [_N("a", {"CPU": 4}), _N("b", {"CPU": 4})]
+    got = pg_policy.place_bundles(nodes, [{"CPU": 1}] * 4, "SPREAD")
+    assert got is not None and set(got) == {"a", "b"}
+
+
+def test_slice_strategy_selects_one_slice():
+    nodes = [
+        _N("a", {"CPU": 4, "TPU": 4}, {"ray_tpu.slice": "s0"}),
+        _N("b", {"CPU": 4, "TPU": 4}, {"ray_tpu.slice": "s0"}),
+        _N("c", {"CPU": 4, "TPU": 4}, {"ray_tpu.slice": "s1"}),
+        _N("d", {"CPU": 4}),
+    ]
+    got = pg_policy.place_bundles(nodes, [{"TPU": 4}, {"TPU": 4}], "SLICE")
+    assert got is not None and set(got) == {"a", "b"}
+
+
+def test_slice_strategy_skips_too_small_slices():
+    nodes = [
+        _N("a", {"TPU": 4}, {"ray_tpu.slice": "s0"}),
+        _N("b", {"TPU": 4}, {"ray_tpu.slice": "s1"}),
+        _N("c", {"TPU": 4}, {"ray_tpu.slice": "s1"}),
+    ]
+    got = pg_policy.place_bundles(nodes, [{"TPU": 4}, {"TPU": 4}], "SLICE")
+    assert got is not None and set(got) == {"b", "c"}
+
+
+def test_hybrid_prefers_local_below_threshold():
+    a, b = _N("a", {"CPU": 4}), _N("b", {"CPU": 4})
+    assert pg_policy.pick_node_hybrid([a, b], {"CPU": 1}, "a") == "a"
+    a.available["CPU"] = 1.0  # 75% utilized → past threshold
+    assert pg_policy.pick_node_hybrid([a, b], {"CPU": 1}, "a") == "b"
+
+
+# ------------------------------------------------------------------------ e2e
+
+
+@pytest.fixture
+def tpu_cluster():
+    ray_tpu.shutdown()
+    c = Cluster(head_node_args=dict(num_cpus=2, num_workers=1, max_workers=8))
+    c.add_node(num_cpus=2, num_tpus=4, labels={"ray_tpu.slice": "s0"})
+    c.add_node(num_cpus=2, num_tpus=4, labels={"ray_tpu.slice": "s0"})
+    yield c
+    c.shutdown()
+
+
+def test_pg_e2e_place_run_remove(tpu_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    assert ray_tpu.get(pg.ready()) is True
+
+    @ray_tpu.remote
+    def where():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "node-0")
+
+    refs = [
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg, i)
+        ).remote()
+        for i in range(2)
+    ]
+    hosts = ray_tpu.get(refs)
+    assert len(set(hosts)) == 2
+
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if abs(ray_tpu.available_resources().get("CPU", 0) - 6.0) < 1e-6:
+            break
+        time.sleep(0.05)
+    assert abs(ray_tpu.available_resources()["CPU"] - 6.0) < 1e-6
+
+
+def test_pg_slice_strategy_e2e(tpu_cluster):
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+    assert pg.wait(10)
+    tbl = placement_group_table()
+    assert set(tbl[pg.id]["bundle_nodes"]) == {"node-1", "node-2"}
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_raises(tpu_cluster):
+    with pytest.raises(PlacementGroupUnschedulableError):
+        placement_group([{"CPU": 100}], strategy="STRICT_PACK")
+
+
+def test_pg_named_lookup(tpu_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="my-group")
+    assert pg.wait(10)
+    assert get_placement_group("my-group").id == pg.id
+    remove_placement_group(pg)
+
+
+def test_pg_pending_until_capacity_frees(tpu_cluster):
+    pg1 = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_SPREAD")
+    assert pg1.wait(10)
+    pg2 = placement_group([{"TPU": 4}], strategy="PACK")
+    assert not pg2.wait(0.3)  # all TPUs reserved
+    remove_placement_group(pg1)
+    assert pg2.wait(10)
+    remove_placement_group(pg2)
+
+
+def test_node_affinity_and_labels(tpu_cluster):
+    @ray_tpu.remote
+    def where():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "node-0")
+
+    assert (
+        ray_tpu.get(
+            where.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy("node-2")
+            ).remote()
+        )
+        == "node-2"
+    )
+    got = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy({"ray_tpu.slice": "s0"})
+        ).remote()
+    )
+    assert got in ("node-1", "node-2")
+
+
+def test_node_removal_reschedules_pg(tpu_cluster):
+    pg = placement_group([{"TPU": 2}], strategy="PACK", name="resilient")
+    assert pg.wait(10)
+    placed_on = placement_group_table()[pg.id]["bundle_nodes"][0]
+    tpu_cluster.remove_node(placed_on)
+    assert pg.wait(10)  # re-placed on the surviving TPU node
+    new_node = placement_group_table()[pg.id]["bundle_nodes"][0]
+    assert new_node != placed_on
+    remove_placement_group(pg)
+
+
+def test_nodes_listing(tpu_cluster):
+    ns = ray_tpu.nodes()
+    assert {n["node_id"] for n in ns} == {"node-0", "node-1", "node-2"}
+    n1 = next(n for n in ns if n["node_id"] == "node-1")
+    assert n1["labels"]["ray_tpu.slice"] == "s0"
+    assert n1["total"]["TPU"] == 4.0
