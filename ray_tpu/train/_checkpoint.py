@@ -1,0 +1,41 @@
+"""Checkpoint: a handle to a directory of persisted training state.
+
+(reference: python/ray/train/_checkpoint.py:56 — Checkpoint wraps a
+(filesystem, path) pair with from_directory/to_directory/as_directory;
+here the filesystem is the local/NFS mount used as storage_path.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: str | None = None) -> str:
+        """Copy checkpoint contents into `path` (or a fresh temp dir)."""
+        dest = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        """Zero-copy view when the checkpoint is already local (it is, for
+        local/NFS storage): yields the stored path directly."""
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

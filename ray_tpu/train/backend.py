@@ -1,0 +1,94 @@
+"""Training backends: per-worker process-group/runtime setup hooks.
+
+(reference: Train's pluggable Backend/BackendConfig — torch NCCL/Gloo at
+train/torch/config.py:43,73,122, torch-xla at train/torch/xla/config.py:20,
+and JAX at train/v2/jax/config.py:21 whose on_start runs
+`jax.distributed.initialize(addr, num_processes, rank)` on every worker.
+
+TPU-first inversion: in-program parallelism (dp/fsdp/tp/sp/pp/ep) is
+expressed as shardings over a jax Mesh and compiled by XLA — the backend
+only has to (a) form the multi-host process group when real multi-host TPU
+is present and (b) pin per-worker chip visibility. On a single host (or the
+CPU test mesh) it is a no-op and the full local mesh belongs to worker 0.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class BackendConfig:
+    backend_name = "none"
+
+    def env_for_worker(self, rank: int, world_size: int,
+                      coordinator: str | None) -> dict:
+        return {}
+
+    def on_training_start(self) -> None:
+        """Runs inside each worker before the train fn."""
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """(reference: train/v2/jax/config.py:21 — JaxConfig(use_tpu, topology).)"""
+
+    backend_name = "jax"
+    use_tpu: bool = False
+    topology: str | None = None
+    coordinator_port: int = 8476
+    distributed: bool = False  # True on real multi-host slices
+
+    def env_for_worker(self, rank: int, world_size: int,
+                      coordinator: str | None) -> dict:
+        env = {
+            "RAY_TPU_TRAIN_RANK": str(rank),
+            "RAY_TPU_TRAIN_WORLD_SIZE": str(world_size),
+        }
+        if self.topology:
+            env["TPU_TOPOLOGY"] = self.topology
+        if self.distributed and coordinator:
+            env["JAX_COORDINATOR_ADDRESS"] = f"{coordinator}:{self.coordinator_port}"
+            env["JAX_NUM_PROCESSES"] = str(world_size)
+            env["JAX_PROCESS_ID"] = str(rank)
+        return env
+
+    def on_training_start(self) -> None:
+        if self.distributed and os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+                process_id=int(os.environ["JAX_PROCESS_ID"]),
+            )
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """CPU-only torch process groups (gloo) for parity with torch train fns.
+    (reference: train/torch/config.py:43 — TorchConfig(backend, init_method);
+    the TPU build has no NCCL; device tensors go through JAX/XLA instead.)"""
+
+    backend_name = "torch"
+    backend: str = "gloo"
+    init_port: int = 8477
+
+    def env_for_worker(self, rank: int, world_size: int,
+                      coordinator: str | None) -> dict:
+        return {
+            "RANK": str(rank),
+            "LOCAL_RANK": str(rank),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": coordinator or "127.0.0.1",
+            "MASTER_PORT": str(self.init_port),
+        }
+
+    def on_training_start(self) -> None:
+        try:
+            import torch.distributed as dist
+        except ImportError:
+            return
+        if not dist.is_initialized() and int(os.environ.get("WORLD_SIZE", "1")) > 1:
+            dist.init_process_group(self.backend)

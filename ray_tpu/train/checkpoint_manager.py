@@ -1,0 +1,67 @@
+"""CheckpointManager: registration + retention of reported checkpoints.
+
+(reference: train/v2/_internal/execution/checkpoint/checkpoint_manager.py:71
+— tracks (checkpoint, metrics) pairs, keeps the latest plus the top
+`num_to_keep` by `checkpoint_score_attribute`, deletes the rest from storage.)
+"""
+
+from __future__ import annotations
+
+import shutil
+from dataclasses import dataclass
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+@dataclass
+class _Tracked:
+    checkpoint: Checkpoint
+    metrics: dict
+    index: int
+
+
+class CheckpointManager:
+    def __init__(self, config: CheckpointConfig | None = None):
+        self.config = config or CheckpointConfig()
+        self._tracked: list[_Tracked] = []
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
+        self._tracked.append(_Tracked(checkpoint, dict(metrics), self._counter))
+        self._counter += 1
+        self._enforce_retention()
+
+    def _score(self, t: _Tracked):
+        attr = self.config.checkpoint_score_attribute
+        if attr is None or attr not in t.metrics:
+            return t.index  # fall back to recency
+        v = t.metrics[attr]
+        return v if self.config.checkpoint_score_order == "max" else -v
+
+    def _enforce_retention(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        latest = self._tracked[-1]
+        by_score = sorted(self._tracked, key=self._score, reverse=True)
+        keep_set = {id(t) for t in by_score[:keep]}
+        keep_set.add(id(latest))  # never delete the resume point
+        for t in list(self._tracked):
+            if id(t) not in keep_set and len(self._tracked) > keep:
+                self._tracked.remove(t)
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+
+    @property
+    def latest_checkpoint(self) -> Checkpoint | None:
+        return self._tracked[-1].checkpoint if self._tracked else None
+
+    @property
+    def best_checkpoint(self) -> Checkpoint | None:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=self._score).checkpoint
+
+    @property
+    def best_checkpoints(self) -> list[tuple[Checkpoint, dict]]:
+        return [(t.checkpoint, t.metrics) for t in self._tracked]

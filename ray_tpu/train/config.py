@@ -1,0 +1,82 @@
+"""Train configuration objects.
+
+(reference: python/ray/air/config.py — RunConfig/ScalingConfig/FailureConfig/
+CheckpointConfig; train/v2/api/config.py re-exports the same surface.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers, and what each reserves.
+
+    (reference: air/config.py ScalingConfig — num_workers, use_gpu,
+    resources_per_worker, placement_strategy. TPU-first: `use_tpu` reserves
+    TPU chips per worker and `topology` requests a SLICE placement so every
+    worker of one group lands on one ICI slice.)
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict | None = None
+    placement_strategy: str = "PACK"
+    topology: str | None = None  # e.g. "v5e-8": ask for a slice via SLICE strategy
+
+    def bundle(self) -> dict:
+        if self.resources_per_worker:
+            b = dict(self.resources_per_worker)
+            b.setdefault("CPU", 1.0)
+            return b
+        b = {"CPU": 1.0}
+        if self.use_tpu:
+            b["TPU"] = 1.0
+        return b
+
+    def bundles(self) -> list[dict]:
+        return [self.bundle() for _ in range(self.num_workers)]
+
+    @property
+    def strategy(self) -> str:
+        return "SLICE" if self.topology else self.placement_strategy
+
+
+@dataclass
+class FailureConfig:
+    """(reference: air/config.py FailureConfig; policy applied by
+    train/v2/_internal/execution/failure_handling/default.py:24 —
+    worker-group errors are retried `max_failures` times, -1 = infinite.)"""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """(reference: air/config.py CheckpointConfig — retention by recency or
+    by a score attribute; applied by checkpoint/checkpoint_manager.py:71.)"""
+
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+
+@dataclass
+class RunConfig:
+    """(reference: air/config.py RunConfig — name + storage_path root where
+    experiment dirs and checkpoints are persisted via pyarrow.fs; here a
+    local/NFS filesystem path.)"""
+
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def experiment_dir(self) -> str:
+        root = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(root, name)

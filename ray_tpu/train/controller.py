@@ -1,0 +1,153 @@
+"""TrainController: the detached actor that owns a training run.
+
+(reference: train/v2/_internal/execution/controller/controller.py:99 — the
+async control loop at :474-499 drives INITIALIZING → SCHEDULING → RUNNING →
+(RESTARTING | ERRORED | FINISHED); failure decisions from
+failure_handling/default.py:24, scaling decisions from scaling_policy/fixed.py:13.)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.worker_group import WorkerGroup
+
+POLL_INTERVAL_S = 0.05
+
+
+@ray_tpu.remote
+class TrainController:
+    def __init__(self, train_fn_blob: bytes, config: dict,
+                 scaling_config_blob: bytes, run_config_blob: bytes,
+                 backend_blob: bytes | None, datasets_blob: bytes | None):
+        from ray_tpu._private import serialization as ser
+
+        self.train_fn_blob = train_fn_blob
+        self.config = config or {}
+        self.scaling = ser.loads(scaling_config_blob)
+        self.run_config = ser.loads(run_config_blob)
+        self.backend_blob = backend_blob
+        self.datasets = ser.loads(datasets_blob) if datasets_blob else {}
+        self.state = "INITIALIZING"
+        self.ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
+        self.failures = 0
+        self.latest_metrics: dict = {}
+        self._iter_buffer: dict[int, dict[int, dict]] = {}  # iter → rank → report
+
+    def get_state(self) -> str:
+        return self.state
+
+    def run(self) -> dict:
+        exp_dir = self.run_config.experiment_dir()
+        os.makedirs(exp_dir, exist_ok=True)
+        max_failures = self.run_config.failure_config.max_failures
+        error = None
+        while True:
+            self._recover_checkpoints_from_storage(exp_dir)
+            from ray_tpu._private import serialization as ser
+
+            self.state = "SCHEDULING"
+            backend = ser.loads(self.backend_blob) if self.backend_blob else None
+            group = WorkerGroup(self.scaling, backend)
+            try:
+                group.start()
+                self._start_training(group, exp_dir)
+                self.state = "RUNNING"
+                outcome, error = self._poll_until_done(group)
+            except Exception as e:  # noqa: BLE001 — group startup/poll failure
+                outcome, error = "errored", f"{type(e).__name__}: {e}"
+            finally:
+                group.shutdown()
+            if outcome == "finished":
+                self.state = "FINISHED"
+                break
+            self.failures += 1
+            if max_failures >= 0 and self.failures > max_failures:
+                self.state = "ERRORED"
+                break
+            self.state = "RESTARTING"  # resume from latest checkpoint
+        latest = self.ckpt_manager.latest_checkpoint
+        best = self.ckpt_manager.best_checkpoints
+        return {
+            "state": self.state,
+            "metrics": self.latest_metrics,
+            "checkpoint": latest,
+            "best_checkpoints": best,
+            "error": error if self.state == "ERRORED" else None,
+            "path": exp_dir,
+            "failures": self.failures,
+        }
+
+    def _recover_checkpoints_from_storage(self, exp_dir: str) -> None:
+        """Register complete checkpoints already on storage that the poll loop
+        never saw (worker died with reports undrained). Checkpoints are the
+        durable record; controller memory is not.
+        (reference: checkpoints live in StorageContext-managed storage and
+        survive worker loss — v2/_internal/execution/storage.py.)"""
+        tracked = {t.checkpoint.path for t in self.ckpt_manager._tracked}
+        n = self.scaling.num_workers
+        for name in sorted(os.listdir(exp_dir)):
+            path = os.path.join(exp_dir, name)
+            if not name.startswith("checkpoint_") or path in tracked:
+                continue
+            ranks = [r for r in os.listdir(path)
+                     if r.startswith("rank_") and not r.endswith(".tmp")]
+            if len(ranks) >= n:
+                self.ckpt_manager.register(Checkpoint(path), dict(self.latest_metrics))
+
+    def _start_training(self, group: WorkerGroup, exp_dir: str) -> None:
+        name = self.run_config.name or os.path.basename(exp_dir)
+        shards: dict[int, dict] = {}
+        if self.datasets:
+            n = self.scaling.num_workers
+            split_ds = {}
+            for ds_name, ds in self.datasets.items():
+                split_ds[ds_name] = ds.streaming_split(n)
+            for rank in range(n):
+                shards[rank] = {k: v[rank] for k, v in split_ds.items()}
+        ctx = {
+            "experiment_dir": exp_dir,
+            "experiment_name": name,
+            "checkpoint": self.ckpt_manager.latest_checkpoint,
+            "local_world_size": self.scaling.num_workers,
+            "node_rank": 0,
+        }
+        group.start_training(self.train_fn_blob, self.config, ctx,
+                             self.backend_blob, shards)
+
+    def _poll_until_done(self, group: WorkerGroup) -> tuple[str, str | None]:
+        n = self.scaling.num_workers
+        while True:
+            try:
+                polls = group.poll()
+            except Exception as e:  # worker actor died (node/process loss)
+                return "errored", f"worker group failure: {type(e).__name__}: {e}"
+            for p in polls:
+                for rep in p["reports"]:
+                    self._iter_buffer.setdefault(rep["iter"], {})[rep["rank"]] = rep
+            self._consume_complete_iters(n)
+            statuses = [p["status"] for p in polls]
+            if any(s == "errored" for s in statuses):
+                err = next(p["error"] for p in polls if p["status"] == "errored")
+                return "errored", err
+            if all(s == "finished" for s in statuses):
+                self._consume_complete_iters(n)
+                return "finished", None
+            time.sleep(POLL_INTERVAL_S)
+
+    def _consume_complete_iters(self, n: int) -> None:
+        for idx in sorted(self._iter_buffer):
+            ranks = self._iter_buffer[idx]
+            if len(ranks) < n:
+                break  # iteration not complete on all ranks yet
+            rank0 = ranks.get(0) or next(iter(ranks.values()))
+            self.latest_metrics = rank0["metrics"]
+            ckpt_dir = next((r["checkpoint_dir"] for r in ranks.values()
+                             if r["checkpoint_dir"]), None)
+            if ckpt_dir:
+                self.ckpt_manager.register(Checkpoint(ckpt_dir), rank0["metrics"])
+            del self._iter_buffer[idx]

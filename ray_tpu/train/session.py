@@ -1,0 +1,162 @@
+"""Per-worker training session: the in-train-fn API surface.
+
+(reference: train/v2/api/train_fn_utils.py — report/get_context/
+get_checkpoint/get_dataset_shard; context.py TrainContext. The session is
+process-global inside a training worker; report() persists the checkpoint
+synchronously to storage and enqueues the metrics for the controller to
+drain on its next poll.)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Any
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+_session: "TrainSession | None" = None
+_session_lock = threading.Lock()
+
+
+class TrainContext:
+    """(reference: train/v2/api/context.py — rank/size accessors.)"""
+
+    def __init__(self, session: "TrainSession"):
+        self._s = session
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._s.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._s.experiment_name
+
+    def get_trial_name(self) -> str:  # Tune compatibility
+        return self._s.experiment_name
+
+
+class TrainSession:
+    def __init__(self, *, rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, node_rank: int, experiment_dir: str,
+                 experiment_name: str, datasets: dict | None = None,
+                 checkpoint: Checkpoint | None = None, sync_actor=None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.experiment_dir = experiment_dir
+        self.experiment_name = experiment_name
+        self.datasets = datasets or {}
+        self.starting_checkpoint = checkpoint
+        self.sync_actor = sync_actor
+        self.iteration = 0
+        self.reports: list[dict] = []   # drained by TrainWorker.poll
+        self._lock = threading.Lock()
+        self.stop_requested = False
+
+    # ------------------------------------------------------------------ api
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+        idx = self.iteration
+        persisted = None
+        if checkpoint is not None:
+            dest = os.path.join(self.experiment_dir,
+                                f"checkpoint_{idx:06d}", f"rank_{self.rank}")
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                # stage + atomic rename: a crash mid-copy must never leave a
+                # rank dir that looks complete to controller-side recovery
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                tmp = dest + ".tmp"
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.copytree(checkpoint.path, tmp)
+                shutil.rmtree(dest, ignore_errors=True)
+                os.rename(tmp, dest)
+            persisted = os.path.dirname(dest)
+        with self._lock:
+            self.reports.append({"iter": idx, "rank": self.rank,
+                                 "metrics": dict(metrics),
+                                 "checkpoint_dir": persisted})
+        self.iteration += 1
+        if self.stop_requested:
+            raise _StopTraining()
+
+    def drain_reports(self) -> list[dict]:
+        with self._lock:
+            out, self.reports = self.reports, []
+        return out
+
+
+class _StopTraining(Exception):
+    """Raised inside report() when the controller asked the run to stop."""
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    with _session_lock:
+        _session = TrainSession(**kwargs)
+        return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — this API is only valid inside a "
+            "train_loop_per_worker launched by a Trainer.")
+    return _session
+
+
+# ------------------------------------------------------- public module API
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return TrainContext(get_session())
+
+
+def get_checkpoint() -> Checkpoint | None:
+    return get_session().starting_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().datasets.get(name)
+
+
+def collective_barrier(key: str = "barrier") -> None:
+    """All workers of the group rendezvous. (reference:
+    collective_impl.py barrier:32.)"""
+    from ray_tpu.train import sync
+
+    s = get_session()
+    sync.barrier(s.sync_actor, f"{key}:{s.iteration}", s.rank)
+
+
+def broadcast_from_rank_zero(data: Any = None, key: str = "bcast") -> Any:
+    """(reference: collective_impl.py broadcast_from_rank_zero:16.)"""
+    from ray_tpu.train import sync
+
+    s = get_session()
+    return sync.broadcast_from_rank_zero(
+        s.sync_actor, f"{key}:{s.iteration}", s.rank, data)

@@ -1,0 +1,92 @@
+"""DataParallelTrainer / JaxTrainer: the public training entry points.
+
+(reference: train/v2/api/data_parallel_trainer.py:64 — fit():152 spawns the
+detached TrainController actor and blocks on the run; train/v2/jax/
+jax_trainer.py:19 is the same trainer with JaxConfig as the backend.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+
+
+@dataclass
+class Result:
+    """(reference: train/v2/api/result.py — Result(metrics, checkpoint,
+    error, path, best_checkpoints).)"""
+
+    metrics: dict
+    checkpoint: Checkpoint | None
+    path: str
+    error: str | None = None
+    best_checkpoints: list = field(default_factory=list)
+
+
+class TrainingFailedError(RuntimeError):
+    """(reference: train/v2/api/exceptions.py TrainingFailedError.)"""
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        backend_config: BackendConfig | None = None,
+        datasets: dict | None = None,
+    ):
+        self.train_fn = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        from ray_tpu._private import serialization as ser
+
+        controller = TrainController.options(num_cpus=0.5).remote(
+            ser.dumps(self.train_fn),
+            self.config,
+            ser.dumps(self.scaling_config),
+            ser.dumps(self.run_config),
+            ser.dumps(self.backend_config) if self.backend_config else None,
+            ser.dumps(self.datasets) if self.datasets else None,
+        )
+        out = ray_tpu.get(controller.run.remote(), timeout=3600.0)
+        ray_tpu.kill(controller)
+        result = Result(
+            metrics=out["metrics"],
+            checkpoint=out["checkpoint"],
+            path=out["path"],
+            error=out["error"],
+            best_checkpoints=out["best_checkpoints"],
+        )
+        if out["state"] == "ERRORED":
+            raise TrainingFailedError(
+                f"training failed after {out['failures']} failure(s): "
+                f"{out['error']}\n(Result metrics: {result.metrics})")
+        return result
+
+
+class JaxTrainer(DataParallelTrainer):
+    """(reference: train/v2/jax/jax_trainer.py:19 — DataParallelTrainer with
+    JaxConfig; on TPU each worker is one host of the slice and in-program
+    SPMD owns the mesh, see ray_tpu/train/spmd.py.)"""
+
+    def __init__(self, train_loop_per_worker, *, jax_config: JaxConfig | None = None,
+                 scaling_config: ScalingConfig | None = None, **kwargs):
+        scaling_config = scaling_config or ScalingConfig()
+        jax_config = jax_config or JaxConfig(
+            use_tpu=scaling_config.use_tpu, topology=scaling_config.topology)
+        super().__init__(train_loop_per_worker, backend_config=jax_config,
+                         scaling_config=scaling_config, **kwargs)

@@ -1,0 +1,206 @@
+"""Train subsystem tests: trainer fit, checkpoints, failure recovery, datasets.
+
+(reference test model: python/ray/train/v2/tests/ — controller/worker-group
+tests run against in-process clusters; SURVEY.md §4.3.)
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import CheckpointConfig
+
+
+@pytest.fixture
+def ray_train_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=12)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_basic_fit_two_workers(ray_train_cluster, tmp_path):
+    def train_fn(config):
+        ctx = train.get_context()
+        for i in range(3):
+            train.report({"iter": i, "rank": ctx.get_world_rank(),
+                          "world_size": ctx.get_world_size()})
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="basic", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["iter"] == 2
+    assert result.metrics["rank"] == 0
+    assert result.metrics["world_size"] == 2
+
+
+def test_checkpoint_roundtrip(ray_train_cluster, tmp_path):
+    def train_fn(config):
+        import tempfile
+
+        rank = train.get_context().get_world_rank()
+        for i in range(2):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(f"iter={i}")
+                train.report({"loss": 1.0 - i * 0.1},
+                             checkpoint=Checkpoint.from_directory(d))
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="ckpt", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.checkpoint is not None
+    with result.checkpoint.as_directory() as d:
+        # both ranks persisted their shard of the final checkpoint
+        assert sorted(os.listdir(d)) == ["rank_0", "rank_1"]
+        with open(os.path.join(d, "rank_0", "state.txt")) as f:
+            assert f.read() == "iter=1"
+
+
+def test_failure_recovery_resumes_from_checkpoint(ray_train_cluster, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def train_fn(config):
+        import tempfile
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                with open(os.path.join(d, "rank_0", "iter.txt")) as f:
+                    start = int(f.read()) + 1
+        for i in range(start, 4):
+            if i == 2 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard-kill this worker: actor death, not an exception
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "iter.txt"), "w") as f:
+                    f.write(str(i))
+                train.report({"iter": i, "resumed_from": start},
+                             checkpoint=Checkpoint.from_directory(d))
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            name="ft", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.metrics["iter"] == 3
+    assert result.metrics["resumed_from"] == 2  # resumed, not restarted from 0
+    assert os.path.exists(marker)
+
+
+def test_max_failures_zero_raises(ray_train_cluster, tmp_path):
+    def train_fn(config):
+        raise ValueError("boom")
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(name="err", storage_path=str(tmp_path)),
+    )
+    with pytest.raises(train.TrainingFailedError, match="boom"):
+        trainer.fit()
+
+
+def test_dataset_shards(ray_train_cluster, tmp_path):
+    import ray_tpu.data as rdata
+
+    def train_fn(config):
+        shard = train.get_dataset_shard("train")
+        n = sum(1 for _ in shard.iter_rows())
+        train.report({"rows": n})
+
+    ds = rdata.range(100)
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="data", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    # each worker sees roughly half; rank 0's count is reported
+    assert 0 < result.metrics["rows"] < 100
+
+
+def test_collectives_barrier_and_broadcast(ray_train_cluster, tmp_path):
+    def train_fn(config):
+        rank = train.get_context().get_world_rank()
+        value = train.broadcast_from_rank_zero({"seed": 42} if rank == 0 else None)
+        train.collective_barrier()
+        train.report({"seed": value["seed"]})
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="coll", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["seed"] == 42
+
+
+def test_jax_trainer_spmd_smoke(ray_train_cluster, tmp_path):
+    """JaxTrainer: one worker-host owning the full (CPU test) mesh, running a
+    jitted data-parallel step — BASELINE config 1 shape."""
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        k = jax.random.PRNGKey(0)
+        w = jnp.zeros((4,))
+        x = jax.random.normal(k, (32, 4))
+        y = x @ jnp.array([1.0, -2.0, 3.0, 0.5])
+
+        @jax.jit
+        def step(w, x, y):
+            def loss(w):
+                return jnp.mean((x @ w - y) ** 2)
+
+            l, g = jax.value_and_grad(loss)(w)
+            return w - 0.1 * g, l
+
+        for i in range(20):
+            w, l = step(w, x, y)
+        train.report({"loss": float(l), "n_devices": jax.device_count()})
+
+    trainer = train.JaxTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(name="jax", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["loss"] < 1.0
+    assert result.metrics["n_devices"] >= 1
+
+
+def test_checkpoint_manager_retention(tmp_path):
+    cfg = CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc")
+    mgr = CheckpointManager(cfg)
+    paths = []
+    for i in range(4):
+        p = tmp_path / f"ckpt_{i}"
+        p.mkdir()
+        paths.append(str(p))
+        mgr.register(Checkpoint(str(p)), {"acc": [0.1, 0.9, 0.5, 0.2][i]})
+    kept = [t.checkpoint.path for t in mgr._tracked]
+    assert len(kept) == 2 or (len(kept) == 3 and paths[3] in kept)
+    assert paths[1] in kept          # best score retained
+    assert mgr.latest_checkpoint.path == paths[3]  # resume point retained
+    assert not os.path.exists(paths[0])  # worst + stale deleted from disk
+    assert mgr.best_checkpoint.path == paths[1]
