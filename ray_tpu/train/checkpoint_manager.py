@@ -28,6 +28,10 @@ class CheckpointManager:
         self._counter = 0
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
+        for t in self._tracked:
+            if t.checkpoint.path == checkpoint.path:
+                t.metrics = dict(metrics)  # re-registered (e.g. storage recovery)
+                return
         self._tracked.append(_Tracked(checkpoint, dict(metrics), self._counter))
         self._counter += 1
         self._enforce_retention()

@@ -27,13 +27,10 @@ class ScalingConfig:
     topology: str | None = None  # e.g. "v5e-8": ask for a slice via SLICE strategy
 
     def bundle(self) -> dict:
-        if self.resources_per_worker:
-            b = dict(self.resources_per_worker)
-            b.setdefault("CPU", 1.0)
-            return b
-        b = {"CPU": 1.0}
+        b = dict(self.resources_per_worker or {})
+        b.setdefault("CPU", 1.0)
         if self.use_tpu:
-            b["TPU"] = 1.0
+            b.setdefault("TPU", 1.0)
         return b
 
     def bundles(self) -> list[dict]:

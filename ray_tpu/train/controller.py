@@ -51,6 +51,7 @@ class TrainController:
             from ray_tpu._private import serialization as ser
 
             self.state = "SCHEDULING"
+            self._iter_buffer.clear()  # a crashed attempt's partial iters are void
             backend = ser.loads(self.backend_blob) if self.backend_blob else None
             group = WorkerGroup(self.scaling, backend)
             try:
@@ -109,10 +110,19 @@ class TrainController:
                 split_ds[ds_name] = ds.streaming_split(n)
             for rank in range(n):
                 shards[rank] = {k: v[rank] for k, v in split_ds.items()}
+        latest = self.ckpt_manager.latest_checkpoint
+        start_iteration = 0
+        if latest is not None:
+            # continue numbering past the resume point: checkpoint_NNNNNN of a
+            # prior attempt must never be overwritten by the next one
+            base = os.path.basename(latest.path)
+            if base.startswith("checkpoint_"):
+                start_iteration = int(base.split("_")[1]) + 1
         ctx = {
             "experiment_dir": exp_dir,
             "experiment_name": name,
-            "checkpoint": self.ckpt_manager.latest_checkpoint,
+            "checkpoint": latest,
+            "start_iteration": start_iteration,
             "local_world_size": self.scaling.num_workers,
             "node_rank": 0,
         }

@@ -52,7 +52,8 @@ class TrainSession:
     def __init__(self, *, rank: int, world_size: int, local_rank: int,
                  local_world_size: int, node_rank: int, experiment_dir: str,
                  experiment_name: str, datasets: dict | None = None,
-                 checkpoint: Checkpoint | None = None, sync_actor=None):
+                 checkpoint: Checkpoint | None = None, sync_actor=None,
+                 start_iteration: int = 0):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -63,10 +64,13 @@ class TrainSession:
         self.datasets = datasets or {}
         self.starting_checkpoint = checkpoint
         self.sync_actor = sync_actor
-        self.iteration = 0
+        # restarted attempts continue numbering past the resume checkpoint so
+        # checkpoint_NNNNNN dirs are never overwritten across attempts
+        self.iteration = start_iteration
         self.reports: list[dict] = []   # drained by TrainWorker.poll
         self._lock = threading.Lock()
         self.stop_requested = False
+        self._coll_seq: dict[str, int] = {}  # per-key collective call counter
 
     # ------------------------------------------------------------------ api
 
@@ -144,13 +148,21 @@ def get_dataset_shard(name: str = "train"):
     return get_session().datasets.get(name)
 
 
+def _next_coll_key(s: TrainSession, key: str) -> str:
+    # every rank calls collectives in the same program order, so a per-key
+    # sequence number keeps repeated calls within one iteration distinct
+    seq = s._coll_seq.get(key, 0)
+    s._coll_seq[key] = seq + 1
+    return f"{key}:{seq}"
+
+
 def collective_barrier(key: str = "barrier") -> None:
     """All workers of the group rendezvous. (reference:
     collective_impl.py barrier:32.)"""
     from ray_tpu.train import sync
 
     s = get_session()
-    sync.barrier(s.sync_actor, f"{key}:{s.iteration}", s.rank)
+    sync.barrier(s.sync_actor, _next_coll_key(s, key), s.rank)
 
 
 def broadcast_from_rank_zero(data: Any = None, key: str = "bcast") -> Any:
@@ -159,4 +171,4 @@ def broadcast_from_rank_zero(data: Any = None, key: str = "bcast") -> Any:
 
     s = get_session()
     return sync.broadcast_from_rank_zero(
-        s.sync_actor, f"{key}:{s.iteration}", s.rank, data)
+        s.sync_actor, _next_coll_key(s, key), s.rank, data)

@@ -62,7 +62,7 @@ class DataParallelTrainer:
             ser.dumps(self.backend_config) if self.backend_config else None,
             ser.dumps(self.datasets) if self.datasets else None,
         )
-        out = ray_tpu.get(controller.run.remote(), timeout=3600.0)
+        out = ray_tpu.get(controller.run.remote())  # blocks for the whole run
         ray_tpu.kill(controller)
         result = Result(
             metrics=out["metrics"],

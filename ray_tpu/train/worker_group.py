@@ -34,15 +34,22 @@ class TrainWorker:
         self._session = None
 
     def metadata(self) -> dict:
+        import socket
+
         import ray_tpu._private.worker as w
 
-        return {"rank": self.rank, "pid": os.getpid(),
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
+        return {"rank": self.rank, "pid": os.getpid(), "ip": ip,
                 "node_id": getattr(w._global_worker, "node_id", "node-0")}
 
     def start_train_fn(self, train_fn_blob: bytes, config: dict,
                        context: dict, backend_blob: bytes | None) -> None:
         from ray_tpu._private import serialization as ser
 
+        os.environ.update(context.get("env", {}))
         train_fn = ser.loads(train_fn_blob)
         backend = ser.loads(backend_blob) if backend_blob else None
         self._session = session_mod.init_session(
@@ -55,6 +62,7 @@ class TrainWorker:
             datasets=context.get("datasets"),
             checkpoint=context.get("checkpoint"),
             sync_actor=context.get("sync_actor"),
+            start_iteration=context.get("start_iteration", 0),
         )
         self._status = "running"
         self._error = None
@@ -106,41 +114,43 @@ class WorkerGroup:
                                   strategy=self.scaling.strategy)
         self.pg.wait(timeout_seconds=60.0)
         self.sync_actor = SyncActor.options(num_cpus=0.1).remote(n)
-        env_by_rank = []
-        for rank in range(n):
-            env = (self.backend.env_for_worker(rank, n, "127.0.0.1")
-                   if self.backend else {})
-            env_by_rank.append(env)
         self.workers = [
             TrainWorker.options(
                 num_cpus=self.scaling.bundle().get("CPU", 1.0),
                 num_tpus=self.scaling.bundle().get("TPU", 0.0) or None,
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self.pg, placement_group_bundle_index=i),
-            ).remote(i, n, env_by_rank[i])
+            ).remote(i, n, {})
             for i in range(n)
         ]
-        ray_tpu.get([w.metadata.remote() for w in self.workers])
+        # rank 0's host is the rendezvous coordinator for jax.distributed /
+        # torch process groups (reference: worker_group.py resolves the master
+        # address from the rank-0 worker, not the driver)
+        meta = ray_tpu.get([w.metadata.remote() for w in self.workers])
+        self.coordinator_ip = meta[0].get("ip", "127.0.0.1")
 
     def start_training(self, train_fn_blob: bytes, config: dict,
                       base_context: dict, backend_blob: bytes | None,
                       dataset_shards: dict[int, dict] | None = None) -> None:
+        n = self.scaling.num_workers
         for rank, w in enumerate(self.workers):
             ctx = dict(base_context)
             ctx["sync_actor"] = self.sync_actor
             ctx["datasets"] = (dataset_shards or {}).get(rank, {})
+            ctx["env"] = (self.backend.env_for_worker(rank, n, self.coordinator_ip)
+                          if self.backend else {})
             w.start_train_fn.remote(train_fn_blob, config, ctx, backend_blob)
 
     def poll(self) -> list[dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=60.0)
 
-    def shutdown(self, *, kill: bool = False) -> None:
+    def shutdown(self) -> None:
+        # actors are per-attempt: kill them so their processes and PG shares
+        # are released (a crashed attempt's train thread must not keep
+        # writing checkpoints concurrently with the next attempt)
         for w in self.workers:
             try:
-                if kill:
-                    ray_tpu.kill(w)
-                else:
-                    w.shutdown_worker.remote()
+                ray_tpu.kill(w)
             except Exception:
                 pass
         if self.sync_actor is not None:

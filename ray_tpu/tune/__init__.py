@@ -1,0 +1,52 @@
+"""ray_tpu.tune — hyperparameter tuning.
+
+(reference: python/ray/tune/ — Tuner/TuneConfig at tuner.py:43, search spaces
+in search/sample.py, schedulers in schedulers/, the trial-driving loop in
+execution/tune_controller.py:68.)
+"""
+
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, TuneResult, Tuner
+
+__all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneResult",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

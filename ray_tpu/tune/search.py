@@ -1,0 +1,169 @@
+"""Search spaces + search algorithms.
+
+(reference: python/ray/tune/search/ — sample.py domains, variant generation
+in basic_variant.py BasicVariantGenerator, Searcher base in searcher.py,
+ConcurrencyLimiter in concurrency_limiter.py.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Float(Domain):
+    def __init__(self, lower, upper, log=False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+# public constructors (reference: tune/search/sample.py + tune/__init__.py)
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower, upper) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower, upper) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower, upper) -> Integer:
+    return Integer(lower, upper)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def _split_space(space: dict):
+    grids, domains, constants = {}, {}, {}
+    for k, v in space.items():
+        if isinstance(v, GridSearch) or (isinstance(v, dict) and v.get("grid_search")):
+            grids[k] = v.values if isinstance(v, GridSearch) else v["grid_search"]
+        elif isinstance(v, Domain):
+            domains[k] = v
+        else:
+            constants[k] = v
+    return grids, domains, constants
+
+
+class Searcher:
+    """(reference: tune/search/searcher.py — suggest/on_trial_complete.)"""
+
+    metric: str | None = None
+    mode: str = "max"
+
+    def set_search_properties(self, metric, mode):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random sampling.
+    (reference: tune/search/basic_variant.py.)"""
+
+    def __init__(self, space: dict, num_samples: int = 1, seed: int | None = None):
+        self._rng = random.Random(seed)
+        grids, domains, constants = _split_space(space)
+        keys = list(grids)
+        combos = list(itertools.product(*grids.values())) if keys else [()]
+        self._variants = []
+        for _ in range(num_samples):
+            for combo in combos:
+                cfg = dict(constants)
+                cfg.update(dict(zip(keys, combo)))
+                for k, d in domains.items():
+                    cfg[k] = d.sample(self._rng)
+                self._variants.append(cfg)
+        self._i = 0
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """(reference: tune/search/concurrency_limiter.py — caps in-flight
+    suggestions from the wrapped searcher.)"""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode):
+        self.searcher.set_search_properties(metric, mode)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"  # sentinel: try again later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "PENDING":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

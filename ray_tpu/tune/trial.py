@@ -1,0 +1,42 @@
+"""Trial: one hyperparameter configuration's lifecycle.
+
+(reference: python/ray/tune/experiment/trial.py — status machine
+PENDING/RUNNING/TERMINATED/ERROR; checkpoints + last_result tracked per trial.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    experiment_dir: str
+    status: str = PENDING
+    last_result: dict = field(default_factory=dict)
+    iteration: int = 0
+    error: str | None = None
+    latest_checkpoint: object = None   # train.Checkpoint | None
+    runner: object = None              # TrainWorker actor handle
+    exploit_from: object = None        # set by PBT: donor Trial
+    explore_config: dict | None = None
+    stopping: bool = False             # stop requested, waiting for thread exit
+
+    @property
+    def trial_dir(self) -> str:
+        d = os.path.join(self.experiment_dir, self.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def summary(self) -> dict:
+        return {"trial_id": self.trial_id, "config": self.config,
+                "status": self.status, "last_result": self.last_result,
+                "error": self.error}

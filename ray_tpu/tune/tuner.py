@@ -1,0 +1,301 @@
+"""Tuner + the trial-driving event loop.
+
+(reference: python/ray/tune/tuner.py:43 (fit:312) and
+tune/execution/tune_controller.py:68 — the controller event loop starts trial
+actors, consumes their results, applies scheduler decisions, and snapshots
+experiment state. Trials here run in TrainWorker actors (the same
+run-fn-in-a-thread runner Train uses), one worker per trial.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune import search as search_mod
+from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
+
+POLL_INTERVAL_S = 0.05
+
+
+@dataclass
+class TuneConfig:
+    """(reference: tune/tune_config.py — metric/mode/num_samples/search_alg/
+    scheduler/max_concurrent_trials; `stop` mirrors air.RunConfig(stop=...).)"""
+
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    search_alg: search_mod.Searcher | None = None
+    scheduler: sched_mod.TrialScheduler | None = None
+    max_concurrent_trials: int = 4
+    stop: dict | None = None
+    time_budget_s: float | None = None
+
+
+@dataclass
+class TuneResult:
+    metrics: dict
+    config: dict
+    checkpoint: Checkpoint | None
+    path: str
+    error: str | None = None
+
+    @property
+    def trial_id(self) -> str:
+        return os.path.basename(self.path)
+
+
+class ResultGrid:
+    """(reference: tune/result_grid.py — get_best_result/num_errors/len.)"""
+
+    def __init__(self, results: list[TuneResult], metric: str | None, mode: str):
+        self._results = results
+        self._metric, self._mode = metric, mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TuneResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> list[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None, mode: str | None = None) -> TuneResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric or pass one)")
+        ok = [r for r in self._results if metric in r.metrics]
+        if not ok:
+            raise ValueError("no trial reported metric " + metric)
+        return (max if mode == "max" else min)(ok, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{**r.metrics, **{f"config/{k}": v for k, v in r.config.items()}}
+                             for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune_run")
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        exp_dir = self.run_config.experiment_dir()
+        os.makedirs(exp_dir, exist_ok=True)
+        searcher = tc.search_alg or search_mod.BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples)
+        if tc.metric:
+            searcher.set_search_properties(tc.metric, tc.mode)
+        scheduler = tc.scheduler or sched_mod.FIFOScheduler()
+        scheduler.set_search_properties(tc.metric or "_none_", tc.mode)
+        loop = _TuneLoop(self._as_train_fn(), exp_dir, searcher, scheduler, tc)
+        trials = loop.run()
+        results = [
+            TuneResult(metrics=t.last_result, config=t.config,
+                       checkpoint=t.latest_checkpoint, path=t.trial_dir,
+                       error=t.error)
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)
+
+    def _as_train_fn(self) -> Callable:
+        t = self.trainable
+        from ray_tpu.train.trainer import DataParallelTrainer
+
+        if isinstance(t, DataParallelTrainer):
+            # Train-in-Tune: each trial runs a full (nested) trainer.fit with
+            # the trial config merged into train_loop_config.
+            # (reference: Train runs as a single-trial Tune job, SURVEY §2.4.)
+            def run_trainer(config):
+                import copy
+
+                from ray_tpu.train import session as sess
+
+                trainer = copy.copy(t)
+                trainer.config = {**t.config, **config.get("train_loop_config", config)}
+                trainer.run_config = RunConfig(
+                    name="nested", storage_path=sess.get_session().experiment_dir,
+                    failure_config=t.run_config.failure_config,
+                    checkpoint_config=t.run_config.checkpoint_config)
+                result = trainer.fit()
+                sess.report(result.metrics)
+
+            return run_trainer
+        return t
+
+
+class _TuneLoop:
+    def __init__(self, train_fn, exp_dir, searcher, scheduler, tc: TuneConfig):
+        from ray_tpu._private import serialization as ser
+
+        self.fn_blob = ser.dumps(train_fn)
+        self.exp_dir = exp_dir
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.tc = tc
+        self.trials: list[Trial] = []
+        self._exhausted = False
+        self._seq = 0
+        self._dirty = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def run(self) -> list[Trial]:
+        deadline = (time.monotonic() + self.tc.time_budget_s
+                    if self.tc.time_budget_s else None)
+        while True:
+            self._maybe_launch()
+            self._poll()
+            self._snapshot()
+            live = [t for t in self.trials if t.status == RUNNING]
+            if deadline and time.monotonic() > deadline:
+                for t in live:
+                    self._terminate(t)
+                break
+            if not live and self._exhausted:
+                break
+            time.sleep(POLL_INTERVAL_S)
+        return self.trials
+
+    def _maybe_launch(self):
+        while not self._exhausted:
+            running = sum(1 for t in self.trials if t.status == RUNNING)
+            if running >= self.tc.max_concurrent_trials:
+                return
+            cfg = self.searcher.suggest(f"trial_{self._seq:04d}")
+            if cfg is None:
+                self._exhausted = True
+                return
+            if cfg == "PENDING":
+                return
+            trial = Trial(trial_id=f"trial_{self._seq:04d}", config=cfg,
+                          experiment_dir=self.exp_dir)
+            self._seq += 1
+            self.trials.append(trial)
+            self._start(trial)
+
+    def _start(self, trial: Trial, checkpoint: Checkpoint | None = None):
+        if trial.runner is None:
+            trial.runner = TrainWorker.options(num_cpus=1.0).remote(0, 1, {})
+        ctx = {"experiment_dir": trial.trial_dir, "experiment_name": trial.trial_id,
+               "checkpoint": checkpoint, "local_world_size": 1, "node_rank": 0}
+        trial.runner.start_train_fn.remote(self.fn_blob, trial.config, ctx, None)
+        trial.status = RUNNING
+        trial.stopping = False
+        self._dirty = True
+
+    def _terminate(self, trial: Trial, error: str | None = None):
+        trial.status = ERROR if error else TERMINATED
+        trial.error = error
+        self._dirty = True
+        if trial.runner is not None:
+            try:
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+            trial.runner = None
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result,
+                                        error=bool(error))
+        self.scheduler.on_complete(trial, trial.last_result)
+
+    # ----------------------------------------------------------------- polling
+
+    def _poll(self):
+        for trial in self.trials:
+            if trial.status != RUNNING:
+                continue
+            try:
+                p = ray_tpu.get(trial.runner.poll.remote(), timeout=30.0)
+            except Exception as e:  # runner actor died
+                self._terminate(trial, error=f"{type(e).__name__}: {e}")
+                continue
+            for rep in p["reports"]:
+                self._on_report(trial, rep)
+            if trial.status != RUNNING:
+                continue  # stopped by scheduler decision above
+            if p["status"] == "errored":
+                self._terminate(trial, error=p["error"])
+            elif p["status"] == "finished":
+                if trial.exploit_from is not None:
+                    self._exploit(trial)
+                else:
+                    self._terminate(trial)
+
+    def _on_report(self, trial: Trial, rep: dict):
+        if trial.stopping:
+            return  # decision already made; late reports don't move the result
+        trial.iteration += 1
+        result = dict(rep["metrics"])
+        result.setdefault("training_iteration", trial.iteration)
+        trial.last_result = result
+        self._dirty = True
+        if rep["checkpoint_dir"]:
+            trial.latest_checkpoint = Checkpoint(rep["checkpoint_dir"])
+        if self._should_stop(result):
+            self._request_stop(trial)
+            return
+        decision = self.scheduler.on_result(trial, result)
+        if decision == sched_mod.STOP:
+            self._request_stop(trial)
+        elif trial.exploit_from is not None and not trial.stopping:
+            trial.stopping = True
+            trial.runner.request_stop.remote()  # restart with exploited state
+
+    def _should_stop(self, result: dict) -> bool:
+        for k, v in (self.tc.stop or {}).items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _request_stop(self, trial: Trial):
+        # graceful: the session raises _StopTraining at the next report();
+        # the runner may already be finished, which _poll handles either way.
+        trial.exploit_from = None
+        trial.explore_config = None
+        if trial.runner is not None:
+            trial.runner.request_stop.remote()
+            trial.stopping = True
+        else:
+            self._terminate(trial)
+
+    def _exploit(self, trial: Trial):
+        """PBT hand-off: restart this trial from the donor's checkpoint with
+        the explored config. (reference: tune/schedulers/pbt.py _exploit.)"""
+        donor: Trial = trial.exploit_from
+        trial.exploit_from = None
+        trial.config = trial.explore_config or dict(donor.config)
+        trial.explore_config = None
+        self._start(trial, checkpoint=donor.latest_checkpoint)
+
+    # ---------------------------------------------------------------- state
+
+    def _snapshot(self):
+        """(reference: tune/execution/experiment_state.py — periodic
+        experiment-state snapshot enabling Tuner.restore.)"""
+        if not self._dirty:
+            return
+        self._dirty = False
+        path = os.path.join(self.exp_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump([t.summary() for t in self.trials], f, default=str)
+        os.replace(tmp, path)

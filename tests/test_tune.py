@@ -1,0 +1,180 @@
+"""Tune tests: grid/random search, schedulers, PBT, stop criteria, resume data.
+
+(reference test model: python/ray/tune/tests/ — SURVEY.md §4.3.)
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+@pytest.fixture
+def ray_tune_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=12)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_search_finds_best(ray_tune_cluster, tmp_path):
+    def objective(config):
+        tune.report({"score": -(config["x"] - 3) ** 2})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+    # experiment state snapshot written
+    assert os.path.exists(tmp_path / "grid" / "experiment_state.json")
+
+
+def test_random_search_num_samples(ray_tune_cluster, tmp_path):
+    def objective(config):
+        tune.report({"y": config["lr"]})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(metric="y", mode="min", num_samples=5,
+                                    max_concurrent_trials=3),
+        run_config=RunConfig(name="rand", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    for r in results:
+        assert 1e-4 <= r.config["lr"] <= 1e-1
+
+
+def test_asha_stops_bad_trials(ray_tune_cluster, tmp_path):
+    def objective(config):
+        import time
+
+        for i in range(1, 9):
+            time.sleep(0.05)  # pace the loop so async STOP decisions land
+            tune.report({"acc": config["q"] * i})
+
+    # good trials first: ASHA rung cutoffs are set by earlier finishers
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([2.0, 1.0, 0.1, 0.0])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max",
+            scheduler=tune.AsyncHyperBandScheduler(grace_period=2,
+                                                   reduction_factor=2,
+                                                   max_t=8),
+            max_concurrent_trials=1,  # deterministic rung comparisons
+        ),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 2.0
+    # the q=0 trial must have been culled before finishing all 8 iters
+    worst = next(r for r in results if r.config["q"] == 0.0)
+    assert worst.metrics["training_iteration"] < 8
+
+
+def test_stop_criteria(ray_tune_cluster, tmp_path):
+    def objective(config):
+        for i in range(100):
+            tune.report({"i": i})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="i", mode="max",
+                                    stop={"training_iteration": 5}),
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert results[0].metrics["training_iteration"] <= 6
+
+
+def test_errored_trial_reported(ray_tune_cluster, tmp_path):
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"ok": 1})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    assert "bad trial" in results.errors[0]
+    assert results.get_best_result().config["x"] == 0
+
+
+def test_pbt_exploits_checkpoint(ray_tune_cluster, tmp_path):
+    """Weak trials must adopt a strong trial's checkpointed weight + config."""
+
+    def objective(config):
+        import tempfile
+
+        w = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                w = float(open(os.path.join(d, "rank_0", "w.txt")).read())
+        import time
+
+        for i in range(1, 13):
+            time.sleep(0.05)  # pace so controller polls interleave both trials
+            w += config["lr"]
+            with tempfile.TemporaryDirectory() as d:
+                open(os.path.join(d, "w.txt"), "w").write(str(w))
+                tune.report({"w": w}, checkpoint=Checkpoint.from_directory(d))
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="w", mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=4,
+                hyperparam_mutations={"lr": [0.5, 1.0, 2.0]},
+                quantile_fraction=0.5, seed=0),
+            stop={"training_iteration": 30},
+        ),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    # the lr=0.001 trial exploited the lr=1.0 trial's weights: both end high
+    ws = sorted(r.metrics["w"] for r in results)
+    assert ws[0] > 0.1, f"weak trial never exploited: {ws}"
+
+
+def test_searcher_unit_variant_counts():
+    gen = tune.BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2]), "b": tune.choice([10]), "c": 7},
+        num_samples=3)
+    assert gen.total_trials == 6
+    seen = [gen.suggest(str(i)) for i in range(6)]
+    assert gen.suggest("x") is None
+    assert all(v["c"] == 7 and v["b"] == 10 for v in seen)
+    assert sorted(v["a"] for v in seen) == [1, 1, 1, 2, 2, 2]
+
+
+def test_concurrency_limiter_unit():
+    inner = tune.BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=4)
+    lim = tune.ConcurrencyLimiter(inner, max_concurrent=2)
+    a, b = lim.suggest("t1"), lim.suggest("t2")
+    assert isinstance(a, dict) and isinstance(b, dict)
+    assert lim.suggest("t3") == "PENDING"
+    lim.on_trial_complete("t1", {"x": 1})
+    assert isinstance(lim.suggest("t3"), dict)
