@@ -41,7 +41,7 @@ DEFAULT_NODE = "node-0"
 
 class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
-                 "running_task", "node_id")
+                 "running_tasks", "node_id")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str):
         self.wid = wid
@@ -50,14 +50,15 @@ class _Worker:
         self.kind = kind  # "worker" | "driver"
         self.node_id = node_id
         self.idle = kind == "worker"
+        self.running_tasks: dict[str, dict] = {}  # task_id → spec (GCS-side)
         self.actor_id: str | None = None
-        self.running_task: dict | None = None
         self.dead = False
 
 
 class _Actor:
     __slots__ = (
-        "aid", "state", "worker", "queue", "busy", "create_spec", "name",
+        "aid", "state", "worker", "queue", "in_flight", "max_concurrency",
+        "create_spec", "name",
         "restarts_left", "waiters", "kill_requested", "num_restarts",
     )
 
@@ -66,7 +67,8 @@ class _Actor:
         self.state = "pending"  # pending → alive → (restarting → alive)* → dead
         self.worker: str | None = None
         self.queue: collections.deque[dict] = collections.deque()
-        self.busy = False
+        self.in_flight = 0  # dispatched, not yet done (≤ max_concurrency)
+        self.max_concurrency = int(create_spec.get("max_concurrency") or 1)
         self.create_spec = create_spec
         self.name: str | None = create_spec.get("name")
         self.restarts_left: int = create_spec.get("max_restarts", 0)
@@ -347,7 +349,8 @@ class GcsServer:
                     "task_counter": dict(self.task_counter),
                     "actors": {
                         a.aid: {"state": a.state, "name": a.name, "worker": a.worker,
-                                "num_restarts": a.num_restarts}
+                                "num_restarts": a.num_restarts,
+                                "queued": len(a.queue), "in_flight": a.in_flight}
                         for a in self.actors.values()
                     },
                     "nodes": {
@@ -530,7 +533,7 @@ class GcsServer:
                 w = idle_by_node[node_id].pop()
                 self._acquire_for(spec, node_id)
                 w.idle = False
-                w.running_task = spec
+                w.running_tasks[spec["task_id"]] = spec
                 if spec["kind"] == "actor_create":
                     w.actor_id = spec["actor_id"]
                     actor = self.actors[spec["actor_id"]]
@@ -557,15 +560,16 @@ class GcsServer:
                     still.append(spec)
             self.pending_tasks = still
 
-            # actor method calls
+            # actor method calls (up to max_concurrency in flight per actor)
             for actor in self.actors.values():
-                if actor.state == "alive" and not actor.busy and actor.queue:
+                while (actor.state == "alive" and actor.queue
+                       and actor.in_flight < actor.max_concurrency):
                     w = self.workers.get(actor.worker)
                     if w is None or w.dead:
-                        continue
+                        break
                     spec = actor.queue.popleft()
-                    actor.busy = True
-                    w.running_task = spec
+                    actor.in_flight += 1
+                    w.running_tasks[spec["task_id"]] = spec
                     to_send.append((w.conn, {"type": "exec", "spec": spec}))
 
             # scale-up: runnable-if-only-there-were-workers, per node
@@ -601,12 +605,11 @@ class GcsServer:
             spec = msg["spec"]
             # prefer the GCS-side spec: it carries the _paid accounting tag the
             # worker's lite echo doesn't (the worker never sees reservations)
-            if (w is not None and w.running_task is not None
-                    and w.running_task.get("task_id") == spec.get("task_id")):
-                spec = w.running_task
-            kind = spec["kind"]
             if w is not None:
-                w.running_task = None
+                gcs_spec = w.running_tasks.pop(spec.get("task_id"), None)
+                if gcs_spec is not None:
+                    spec = gcs_spec
+            kind = spec["kind"]
             error = msg.get("error")
             if kind == "actor_create":
                 actor = self.actors.get(spec["actor_id"])
@@ -642,7 +645,7 @@ class GcsServer:
                 if kind == "actor_task":
                     actor = self.actors.get(spec["actor_id"])
                     if actor is not None:
-                        actor.busy = False
+                        actor.in_flight = max(0, actor.in_flight - 1)
                 else:
                     if w is not None:
                         w.idle = True
@@ -924,23 +927,25 @@ class GcsServer:
             w.dead = True
             if w.kind != "worker":
                 return  # driver death handled by node teardown
-            spec = w.running_task
+            specs = list(w.running_tasks.values())
+            w.running_tasks.clear()
             aid = w.actor_id
             if aid is None:
-                if spec is not None and spec["kind"] == "task":
-                    self._release_for(spec)
-                    if spec.get("retries_used", 0) < spec.get("max_retries", 0):
-                        spec["retries_used"] = spec.get("retries_used", 0) + 1
-                        requeue = spec
-                    else:
-                        fail.append(spec)
+                for spec in specs:
+                    if spec["kind"] == "task":
+                        self._release_for(spec)
+                        if spec.get("retries_used", 0) < spec.get("max_retries", 0):
+                            spec["retries_used"] = spec.get("retries_used", 0) + 1
+                            requeue = spec
+                        else:
+                            fail.append(spec)
             else:
                 actor = self.actors.get(aid)
                 if actor is not None:
                     self._release_for(actor.create_spec)
-                    if spec is not None and spec["kind"] in ("actor_task", "actor_create"):
-                        fail.append(spec)
-                    actor.busy = False
+                    fail.extend(s for s in specs
+                                if s["kind"] in ("actor_task", "actor_create"))
+                    actor.in_flight = 0
                     actor.worker = None
                     if actor.restarts_left != 0 and actor.state != "dead":
                         if actor.restarts_left > 0:

@@ -22,6 +22,69 @@ import cloudpickle
 _U64 = struct.Struct("<Q")
 
 
+def load_class_by_ref(module: str, qualname: str, search_path: str | None = None):
+    """Import `module` and return the class named `qualname`, unwrapping an
+    @remote ActorClass wrapper if the module attribute is one. `search_path`
+    (the defining file's directory on the driver) is appended to sys.path as
+    a fallback — workers may lack the driver script's sys.path[0]."""
+    import importlib
+    import sys
+
+    from ray_tpu.actor import ActorClass
+
+    try:
+        mod = importlib.import_module(module)
+    except ModuleNotFoundError:
+        if not search_path or search_path in sys.path:
+            raise
+        sys.path.append(search_path)
+        mod = importlib.import_module(module)
+    obj = getattr(mod, qualname)
+    return obj.cls if isinstance(obj, ActorClass) else obj
+
+
+class ClassByRef:
+    """Pickles as an import reference; loads() yields the class itself.
+
+    Used for actor classes that are importable on workers: @remote rebinds
+    the module attribute to the ActorClass wrapper, which defeats
+    cloudpickle's by-reference logic and forces by-value class pickling
+    (fragile — class bodies referencing unpicklable module globals fail, and
+    blobs are large). (reference: the function/actor export path registers
+    importable code by reference too, _private/function_manager.py.)"""
+
+    def __init__(self, module: str, qualname: str, search_path: str | None = None):
+        self.module = module
+        self.qualname = qualname
+        self.search_path = search_path
+
+    def __reduce__(self):
+        return (load_class_by_ref, (self.module, self.qualname, self.search_path))
+
+
+def class_ref_or_none(cls) -> "ClassByRef | None":
+    """Return a ClassByRef if `cls` is reachable by import, else None."""
+    import sys
+
+    module = getattr(cls, "__module__", None)
+    qualname = getattr(cls, "__qualname__", "")
+    if not module or module == "__main__" or "." in qualname or "<locals>" in qualname:
+        return None
+    mod = sys.modules.get(module)
+    if mod is None:
+        return None
+    try:
+        if load_class_by_ref(module, qualname) is cls:
+            import os
+
+            src = getattr(mod, "__file__", None)
+            return ClassByRef(module, qualname,
+                              os.path.dirname(src) if src else None)
+    except Exception:
+        return None
+    return None
+
+
 def dumps(obj: Any) -> bytes:
     buffers: list[pickle.PickleBuffer] = []
     pick = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)

@@ -101,8 +101,9 @@ class CoreWorker:
         self._plasma_refs: dict[str, Any] = {}
         self._obj_waits: dict[str, _Future] = {}  # oid → outstanding wait future
         self.actors: dict[str, Any] = {}  # actor instances hosted by this process
-        self.current_actor_id: str | None = None
-        self.current_task_id: str | None = None
+        self._actor_pools: dict[str, Any] = {}  # actor_id → ThreadPoolExecutor
+        self.current_actor_id: str | None = None  # one actor per process
+        self._task_ctx = threading.local()  # per-thread: concurrent actors
         self._alive = True
         self.node_id = os.environ.get("RAY_TPU_NODE_ID", "node-0")
         self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True, name="cw-recv")
@@ -225,6 +226,7 @@ class CoreWorker:
         max_restarts: int = 0,
         name: str | None = None,
         strategy: dict | None = None,
+        max_concurrency: int = 1,
     ) -> str:
         actor_id = ActorID().hex()
         task_id = TaskID().hex()
@@ -240,6 +242,7 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "name": name,
             "strategy": strategy,
+            "max_concurrency": max_concurrency,
             **spec_part,
         }
         reply = self.rpc({"type": "create_actor", "spec": spec})
@@ -441,11 +444,15 @@ class CoreWorker:
         kwargs = {k: self.get_object(v.hex) if isinstance(v, _RefMarker) else v for k, v in kwargs.items()}
         return args, kwargs
 
+    @property
+    def current_task_id(self) -> str | None:
+        return getattr(self._task_ctx, "task_id", None)
+
     def execute_task(self, spec: dict) -> None:
         kind = spec["kind"]
         error_blob = None
         results = []
-        self.current_task_id = spec["task_id"]
+        self._task_ctx.task_id = spec["task_id"]
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
@@ -456,6 +463,15 @@ class CoreWorker:
                 instance = cls(*args, **kwargs)
                 self.actors[spec["actor_id"]] = instance
                 self.current_actor_id = spec["actor_id"]
+                conc = int(spec.get("max_concurrency") or 1)
+                if conc > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    # concurrent actor: method calls run in this pool
+                    # (reference: threaded actors / concurrency groups,
+                    # src/ray/core_worker/task_execution/concurrency_group_manager.h)
+                    self._actor_pools[spec["actor_id"]] = ThreadPoolExecutor(
+                        max_workers=conc, thread_name_prefix="actor-exec")
                 out = None
             elif kind == "actor_task":
                 instance = self.actors[spec["actor_id"]]
@@ -490,7 +506,7 @@ class CoreWorker:
                 for i in range(spec["num_returns"])
             ]
         finally:
-            self.current_task_id = None
+            self._task_ctx.task_id = None
         lite = {k: spec.get(k) for k in ("task_id", "kind", "actor_id", "resources", "num_returns", "max_retries", "retries_used")}
         self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite, "results": results, "error": error_blob})
 
@@ -500,7 +516,12 @@ class CoreWorker:
             spec = self.exec_queue.get()
             if spec is None:
                 return
-            self.execute_task(spec)
+            pool = (self._actor_pools.get(spec.get("actor_id"))
+                    if spec["kind"] == "actor_task" else None)
+            if pool is not None:
+                pool.submit(self.execute_task, spec)
+            else:
+                self.execute_task(spec)
 
     def disconnect(self):
         self._alive = False

@@ -66,24 +66,28 @@ _UNSET = object()
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, name=None, lifetime=None, scheduling_strategy=None):
+                 max_restarts=0, name=None, lifetime=None, scheduling_strategy=None,
+                 max_concurrency=1):
         self._cls = cls
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
         self._max_restarts = max_restarts
         self._name = name
         self._strategy = scheduling_strategy
+        self._max_concurrency = max_concurrency
         self._blob: bytes | None = None
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def _get_blob(self):
         if self._blob is None:
-            self._blob = ser.dumps(self._cls)
+            ref = ser.class_ref_or_none(self._cls)
+            self._blob = ser.dumps(ref if ref is not None else self._cls)
         return self._blob
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
                 max_restarts=None, name=None, lifetime=None,
-                scheduling_strategy=_UNSET, **_ignored) -> "ActorClass":
+                scheduling_strategy=_UNSET, max_concurrency=None,
+                **_ignored) -> "ActorClass":
         ac = ActorClass(
             self._cls,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -94,6 +98,8 @@ class ActorClass:
             lifetime=lifetime,
             scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
                                  else scheduling_strategy),
+            max_concurrency=(self._max_concurrency if max_concurrency is None
+                             else max_concurrency),
         )
         ac._blob = self._blob
         return ac
@@ -111,6 +117,7 @@ class ActorClass:
             max_restarts=self._max_restarts,
             name=self._name,
             strategy=strategy_to_spec(self._strategy),
+            max_concurrency=self._max_concurrency,
         )
         return ActorHandle(actor_id)
 

@@ -1,0 +1,44 @@
+"""ray_tpu.serve — model serving.
+
+(reference: python/ray/serve/ — deployments + controller-reconciled replica
+actors, DeploymentHandles with power-of-two routing, per-node HTTP proxy,
+ongoing-request autoscaling, dynamic batching, model multiplexing.)
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import multiplexed
+from ray_tpu.serve.replica import get_multiplexed_model_id
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "http_address",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,131 @@
+"""serve public API: run / delete / status / shutdown / handles / proxy.
+
+(reference: python/ray/serve/api.py — serve.run:694 deploys an Application
+through the controller and returns the ingress DeploymentHandle; serve.start
+brings up the proxy; serve.status/delete/shutdown manage lifecycle.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application
+from ray_tpu.serve.handle import DeploymentHandle
+
+_proxy = None
+
+
+def _get_controller(create: bool = False):
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise RuntimeError("serve is not running; call serve.run/start first") from None
+        return ServeController.options(
+            name=CONTROLLER_NAME, num_cpus=0.5).remote()
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
+          proxy: bool = True):
+    """Ensure controller (and optionally the HTTP proxy) are up."""
+    global _proxy
+    controller = _get_controller(create=True)
+    if proxy and _proxy is None:
+        from ray_tpu.serve.proxy import ProxyActor
+
+        _proxy = ProxyActor.options(num_cpus=0.5, max_concurrency=32).remote(
+            http_host, http_port)
+        ray_tpu.get(_proxy.address.remote())  # wait until listening
+    return controller
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: str | None = "/", _blocking: bool = False,
+        proxy: bool = False) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment."""
+    from ray_tpu._private import serialization as ser
+
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects a bound deployment: d.bind(...)")
+    controller = start(proxy=proxy) if proxy else _get_controller(create=True)
+
+    apps = target.flatten()
+    specs = []
+    for app in apps:
+        # replace nested Applications in init args with handles to them
+        def to_handle(a):
+            if isinstance(a, Application):
+                return DeploymentHandle(f"{name}_{a.deployment.name}", controller)
+            return a
+
+        args = tuple(to_handle(a) for a in app.init_args)
+        kwargs = {k: to_handle(v) for k, v in app.init_kwargs.items()}
+        cfg = app.deployment.config
+        cfg_dict = {
+            "initial_replicas": cfg.initial_replicas,
+            "max_ongoing_requests": cfg.max_ongoing_requests,
+            "ray_actor_options": cfg.ray_actor_options,
+            "user_config": cfg.user_config,
+            "autoscaling_config": (dataclasses.asdict(cfg.autoscaling_config)
+                                   if cfg.autoscaling_config else None),
+        }
+        specs.append({
+            "name": app.deployment.name,
+            "callable_blob": ser.dumps(app.deployment.func_or_class),
+            "init_args_blob": ser.dumps((args, kwargs)),
+            "config": cfg_dict,
+        })
+    ingress = target.deployment.name
+    ray_tpu.get(controller.deploy_application.remote(name, specs, route_prefix, ingress))
+    handle = DeploymentHandle(f"{name}_{ingress}", controller)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _get_controller()
+    table = ray_tpu.get(controller.get_routing_table.remote(-1))
+    ingress = table.get("apps", {}).get(name)
+    if ingress is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(ingress, controller)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(f"{app_name}_{deployment_name}", _get_controller())
+
+
+def status() -> dict:
+    return ray_tpu.get(_get_controller().status.remote())
+
+
+def delete(name: str = "default"):
+    ray_tpu.get(_get_controller().delete_application.remote(name))
+
+
+def http_address() -> tuple[str, int] | None:
+    if _proxy is None:
+        return None
+    return tuple(ray_tpu.get(_proxy.address.remote()))
+
+
+def shutdown():
+    global _proxy
+    try:
+        controller = _get_controller()
+    except RuntimeError:
+        controller = None
+    if _proxy is not None:
+        try:
+            ray_tpu.get(_proxy.shutdown.remote())
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+        _proxy = None
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote())
+            ray_tpu.kill(controller)
+        except Exception:
+            pass

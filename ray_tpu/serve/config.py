@@ -1,0 +1,40 @@
+"""Serve configuration schemas.
+
+(reference: python/ray/serve/config.py — AutoscalingConfig, DeploymentConfig
+pydantic models; here plain dataclasses with the same knobs.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    """(reference: serve/config.py AutoscalingConfig + policy in
+    serve/_private/autoscaling_policy.py — desired = ceil(total ongoing /
+    target_ongoing_requests), clamped, with down-scale smoothing.)"""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int | None = 1
+    max_ongoing_requests: int = 8
+    ray_actor_options: dict = field(default_factory=dict)
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: dict | None = None
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    @property
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas or 1

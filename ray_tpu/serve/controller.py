@@ -1,0 +1,249 @@
+"""ServeController: the control-plane actor reconciling deployments.
+
+(reference: python/ray/serve/_private/controller.py:102 — owns
+ApplicationState / DeploymentStateManager (deployment_state.py:1713,2957)
+whose reconcile loop creates/kills replica actors to match the target, and
+the autoscaling state (autoscaling_state.py:838) that turns ongoing-request
+metrics into new targets. Routing-table push via LongPoll is replaced by
+versioned pull: routers poll get_routing_table and cache by version.)
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+RECONCILE_INTERVAL_S = 0.1
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, name: str, callable_blob: bytes,
+                 init_args_blob: bytes, config: dict):
+        self.app_name = app_name
+        self.name = name
+        self.callable_blob = callable_blob
+        self.init_args_blob = init_args_blob
+        self.config = config          # dict form of DeploymentConfig
+        self.replicas: dict[str, object] = {}  # tag → ActorHandle
+        self.draining: dict[str, tuple[object, float]] = {}  # tag → (handle, deadline)
+        self.target = config["initial_replicas"]
+        self.next_idx = 0
+        self.status = "UPDATING"
+        self.last_scale_down_ok: float = 0.0
+        self.deleted = False
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        self.deployments: dict[str, _DeploymentState] = {}  # full_name → state
+        self.routes: dict[str, str] = {}  # route_prefix → full deployment name
+        self.apps: dict[str, str] = {}    # app name → ingress full name
+        self.version = 0
+        self._lock = threading.RLock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True,
+                                        name="serve-reconcile")
+        self._thread.start()
+
+    # ------------------------------------------------------------------- api
+
+    def deploy_application(self, app_name: str, deployments: list[dict],
+                           route_prefix: str | None, ingress: str) -> None:
+        with self._lock:
+            for d in deployments:
+                full = f"{app_name}_{d['name']}"
+                existing = self.deployments.get(full)
+                if (existing is not None
+                        and existing.callable_blob == d["callable_blob"]
+                        and existing.init_args_blob == d["init_args_blob"]):
+                    # config-only update: adjust target / user_config in place
+                    existing.config = d["config"]
+                    existing.target = d["config"]["initial_replicas"]
+                    if d["config"].get("user_config") is not None:
+                        for r in existing.replicas.values():
+                            r.reconfigure.remote(d["config"]["user_config"])
+                    continue
+                if existing is not None:
+                    self._drop_replicas(existing, list(existing.replicas))
+                new_state = _DeploymentState(
+                    app_name, d["name"], d["callable_blob"],
+                    d["init_args_blob"], d["config"])
+                if existing is not None:
+                    new_state.draining = dict(existing.draining)  # finish drains
+                self.deployments[full] = new_state
+            if route_prefix is not None:
+                self.routes[route_prefix] = f"{app_name}_{ingress}"
+            self.apps[app_name] = f"{app_name}_{ingress}"
+            self.version += 1
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for full, st in list(self.deployments.items()):
+                if st.app_name == app_name:
+                    st.deleted = True
+                    st.target = 0
+            self.routes = {p: d for p, d in self.routes.items()
+                           if not d.startswith(app_name + "_")}
+            self.apps.pop(app_name, None)
+            self.version += 1
+
+    def get_routing_table(self, known_version: int = -1) -> dict | None:
+        """Replica actor ids per deployment; None if caller is up to date."""
+        with self._lock:
+            if known_version == self.version:
+                return None
+            return {
+                "version": self.version,
+                "routes": dict(self.routes),
+                "apps": dict(self.apps),
+                "deployments": {
+                    full: {"replicas": [h.actor_id for h in st.replicas.values()],
+                           "max_ongoing": st.config["max_ongoing_requests"]}
+                    for full, st in self.deployments.items()
+                },
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                full: {"status": st.status, "replicas": len(st.replicas),
+                       "target": st.target, "app": st.app_name}
+                for full, st in self.deployments.items()
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stop = True
+            for st in self.deployments.values():
+                st.deleted = True
+                st.target = 0
+            self._do_reconcile()
+
+    # -------------------------------------------------------------- reconcile
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            try:
+                self._do_reconcile()
+                self._do_autoscale()
+            except Exception:
+                pass  # reconcile must never die; next tick retries
+            time.sleep(RECONCILE_INTERVAL_S)
+
+    def _do_reconcile(self):
+        try:
+            actor_stats = ray_tpu.cluster_state()["actors"]
+        except Exception:
+            actor_stats = {}
+        now = time.monotonic()
+        with self._lock:
+            for full, st in list(self.deployments.items()):
+                # replica death detection: drop handles whose actor the GCS
+                # marks dead so they're replaced below and leave the routing
+                # table (reference: DeploymentState reconciles against actor
+                # liveness, serve/_private/deployment_state.py:1713)
+                dead = [tag for tag, h in st.replicas.items()
+                        if actor_stats.get(h.actor_id, {}).get("state") == "dead"]
+                for tag in dead:
+                    st.replicas.pop(tag)
+                    self.version += 1
+                # drain completion: kill once idle or past the grace deadline
+                for tag, (h, deadline) in list(st.draining.items()):
+                    s = actor_stats.get(h.actor_id, {})
+                    idle = s.get("queued", 0) + s.get("in_flight", 0) == 0
+                    if idle or now > deadline or s.get("state") == "dead":
+                        st.draining.pop(tag)
+                        self._kill_replica(h)
+                live = len(st.replicas)
+                if live < st.target:
+                    for _ in range(st.target - live):
+                        self._start_replica(st)
+                    self.version += 1
+                elif live > st.target:
+                    drop = list(st.replicas)[: live - st.target]
+                    self._drop_replicas(st, drop)
+                    self.version += 1
+                st.status = ("HEALTHY" if len(st.replicas) == st.target
+                             else "UPDATING")
+                if st.deleted and not st.replicas and not st.draining:
+                    del self.deployments[full]
+                    self.version += 1
+
+    def _start_replica(self, st: _DeploymentState):
+        tag = f"{st.name}#{st.next_idx}"
+        st.next_idx += 1
+        opts = dict(st.config.get("ray_actor_options") or {})
+        handle = ReplicaActor.options(
+            num_cpus=opts.get("num_cpus", 1.0),
+            num_tpus=opts.get("num_tpus"),
+            resources=opts.get("resources"),
+            max_concurrency=st.config["max_ongoing_requests"],
+        ).remote(st.name, tag, st.callable_blob, st.init_args_blob,
+                 st.config.get("user_config"))
+        st.replicas[tag] = handle
+
+    def _drop_replicas(self, st: _DeploymentState, tags: list[str]):
+        """Remove replicas from routing and drain: they keep serving queued
+        requests until idle (or the graceful timeout), then die.
+        (reference: graceful_shutdown_timeout_s draining in replica teardown,
+        serve/_private/deployment_state.py.)"""
+        grace = st.config.get("graceful_shutdown_timeout_s", 5.0)
+        deadline = time.monotonic() + grace
+        for tag in tags:
+            h = st.replicas.pop(tag, None)
+            if h is not None:
+                st.draining[tag] = (h, deadline)
+
+    def _kill_replica(self, h):
+        try:
+            h.shutdown.remote()
+            ray_tpu.kill(h)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- autoscale
+
+    def _do_autoscale(self):
+        """(reference: serve/_private/autoscaling_state.py:838 +
+        autoscaling_policy.py — replicas_needed = ceil(total_ongoing /
+        target_ongoing_requests), immediate upscale, delayed downscale.
+
+        Ongoing = queued + executing per replica actor, read from GCS actor
+        state — NOT probed through the replicas' own (possibly saturated)
+        request queues, mirroring the reference where metrics are pushed out
+        of band rather than pulled through the data path.)"""
+        with self._lock:
+            states = [st for st in self.deployments.values()
+                      if st.config.get("autoscaling_config") and not st.deleted]
+        if not states:
+            return
+        try:
+            actor_stats = ray_tpu.cluster_state()["actors"]
+        except Exception:
+            return
+        for st in states:
+            cfg = st.config["autoscaling_config"]
+            with self._lock:
+                aids = [h.actor_id for h in st.replicas.values()]
+            total = sum(actor_stats.get(a, {}).get("queued", 0)
+                        + actor_stats.get(a, {}).get("in_flight", 0)
+                        for a in aids)
+            desired = max(cfg["min_replicas"],
+                          min(cfg["max_replicas"],
+                              math.ceil(total / cfg["target_ongoing_requests"])))
+            now = time.monotonic()
+            with self._lock:
+                if desired > st.target:
+                    st.target = desired
+                    st.last_scale_down_ok = now + cfg["downscale_delay_s"]
+                elif desired < st.target:
+                    if now >= st.last_scale_down_ok:
+                        st.target = desired
+                else:
+                    st.last_scale_down_ok = now + cfg["downscale_delay_s"]

@@ -1,0 +1,145 @@
+"""DeploymentHandle: the Python-native way to call a deployment.
+
+(reference: python/ray/serve/handle.py:692 DeploymentHandle →
+_private/router.py:877 AsyncioRouter.assign_request → power-of-two-choices
+replica selection (request_router/pow_2_router.py:27). Here the router keeps
+a client-side in-flight count per replica (decremented when the response is
+resolved or garbage-collected) and picks the lighter of two random replicas.)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import weakref
+
+import ray_tpu
+from ray_tpu.actor import ActorHandle
+
+ROUTING_REFRESH_S = 1.0
+
+
+class DeploymentResponse:
+    """(reference: serve/handle.py DeploymentResponse — resolvable future;
+    passing it to another .remote() call chains without blocking.)"""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._finalizer = weakref.finalize(self, on_done)
+
+    def result(self, timeout_s: float | None = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._finalizer()
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class _Router:
+    def __init__(self, deployment_full_name: str, controller):
+        self.name = deployment_full_name
+        self.controller = controller
+        self.version = -1
+        self.replicas: list[str] = []
+        self.inflight: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < ROUTING_REFRESH_S:
+            return
+        self._last_refresh = now
+        table = ray_tpu.get(
+            self.controller.get_routing_table.remote(self.version), timeout=10.0)
+        if table is None:
+            return
+        with self._lock:
+            self.version = table["version"]
+            dep = table["deployments"].get(self.name)
+            self.replicas = dep["replicas"] if dep else []
+            self.inflight = {r: self.inflight.get(r, 0) for r in self.replicas}
+
+    def pick(self) -> str:
+        """Power-of-two-choices on client-side in-flight counts."""
+        self._refresh()
+        deadline = time.monotonic() + 30.0
+        backoff = 0.02
+        while not self.replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"no replicas for deployment {self.name}")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.5)  # don't hammer the controller
+            self._refresh(force=True)
+        with self._lock:
+            if len(self.replicas) == 1:
+                choice = self.replicas[0]
+            else:
+                a, b = random.sample(self.replicas, 2)
+                choice = a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) else b
+            self.inflight[choice] = self.inflight.get(choice, 0) + 1
+            return choice
+
+    def done(self, replica: str):
+        with self._lock:
+            if replica in self.inflight and self.inflight[replica] > 0:
+                self.inflight[replica] -= 1
+
+    def drop(self, replica: str):
+        """Replica died: force a table refresh next pick."""
+        with self._lock:
+            self.replicas = [r for r in self.replicas if r != replica]
+        self._last_refresh = 0.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_full_name: str, controller=None,
+                 method_name: str = "__call__", multiplexed_model_id: str | None = None):
+        from ray_tpu.serve.api import _get_controller
+
+        self._name = deployment_full_name
+        self._controller = controller or _get_controller()
+        self._method = method_name
+        self._model_id = multiplexed_model_id
+        self._router = _Router(deployment_full_name, self._controller)
+
+    def options(self, *, method_name: str | None = None,
+                multiplexed_model_id: str | None = None, **_ignored) -> "DeploymentHandle":
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h._name = self._name
+        h._controller = self._controller
+        h._method = method_name or self._method
+        h._model_id = multiplexed_model_id or self._model_id
+        h._router = self._router  # share in-flight state across method views
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+                     for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        last_err = None
+        for _ in range(3):  # retry on replica death with a fresh table
+            replica_id = self._router.pick()
+            replica = ActorHandle(replica_id)
+            try:
+                ref = replica.handle_request.remote(self._method, args, kwargs,
+                                                    self._model_id)
+                return DeploymentResponse(
+                    ref, lambda r=replica_id: self._router.done(r))
+            except Exception as e:
+                last_err = e
+                self._router.done(replica_id)
+                self._router.drop(replica_id)
+        raise RuntimeError(f"could not assign request to {self._name}: {last_err}")
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, None, self._method, self._model_id))

@@ -1,0 +1,95 @@
+"""HTTP proxy actor: the data-plane ingress.
+
+(reference: python/ray/serve/_private/proxy.py — ProxyActor per node runs a
+uvicorn HTTP server (:706) and a gRPC server (:530), routes by longest
+matching route prefix, and forwards to DeploymentHandles. Here: a stdlib
+ThreadingHTTPServer inside the proxy actor (no uvicorn in the image), JSON
+in/out, same longest-prefix routing.)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.serve.api import _get_controller
+
+        self.controller = _get_controller()
+        self._routes: dict[str, str] = {}
+        self._version = -1
+        self._handles: dict[str, object] = {}
+        self._lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # no stderr spam in workers
+                pass
+
+            def _run(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                try:
+                    status, payload = proxy._dispatch(self.path, self.command, body)
+                except Exception as e:  # noqa: BLE001 — proxy must answer
+                    status, payload = 500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _run
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    def address(self) -> tuple[str, int]:
+        return self.server.server_address[0], self.port
+
+    def _refresh_routes(self):
+        table = ray_tpu.get(
+            self.controller.get_routing_table.remote(self._version), timeout=10.0)
+        if table is not None:
+            with self._lock:
+                self._version = table["version"]
+                self._routes = table["routes"]
+
+    def _dispatch(self, path: str, method: str, body: bytes) -> tuple[int, bytes]:
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._refresh_routes()
+        with self._lock:
+            match = max((p for p in self._routes
+                         if path == p or path.startswith(p.rstrip("/") + "/")
+                         or p == "/"),
+                        key=len, default=None)
+            dep = self._routes.get(match) if match else None
+        if dep is None:
+            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+        handle = self._handles.get(dep)
+        if handle is None:
+            handle = self._handles[dep] = DeploymentHandle(dep, self.controller)
+        request = {
+            "path": path, "method": method,
+            "body": json.loads(body) if body else None,
+        }
+        result = handle.remote(request).result(timeout_s=60.0)
+        return 200, json.dumps(result, default=str).encode()
+
+    def shutdown(self):
+        self.server.shutdown()

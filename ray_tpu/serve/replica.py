@@ -1,0 +1,90 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+(reference: python/ray/serve/_private/replica.py — UserCallableWrapper runs
+the user method; replicas track ongoing requests for the router and the
+autoscaler. Concurrency: the reference replica is an asyncio event loop with
+max_ongoing_requests admission; here the actor runs with
+max_concurrency=max_ongoing_requests threads.)
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+
+import ray_tpu
+
+_replica_ctx = threading.local()
+
+
+def get_multiplexed_model_id() -> str | None:
+    """(reference: serve/api.py get_multiplexed_model_id — valid inside a
+    replica handling a multiplexed request.)"""
+    return getattr(_replica_ctx, "model_id", None)
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 callable_blob: bytes, init_args_blob: bytes,
+                 user_config: dict | None = None):
+        from ray_tpu._private import serialization as ser
+
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        target = ser.loads(callable_blob)
+        args, kwargs = ser.loads(init_args_blob)
+        if inspect.isclass(target):
+            self.user = target(*args, **kwargs)
+        else:
+            self.user = target  # function deployment: called directly
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       model_id: str | None = None):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _replica_ctx.model_id = model_id
+        try:
+            fn = getattr(self.user, method, None)
+            if fn is None:
+                raise AttributeError(
+                    f"deployment {self.deployment_name} has no method {method!r}")
+            return fn(*args, **kwargs)
+        finally:
+            _replica_ctx.model_id = None
+            with self._lock:
+                self._ongoing -= 1
+
+    def ongoing(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> dict:
+        return {"replica": self.replica_tag, "ongoing": self._ongoing,
+                "total": self._total}
+
+    def reconfigure(self, user_config: dict) -> None:
+        """(reference: replicas call the user's reconfigure() on user_config
+        updates without restarting, serve/_private/replica.py.)"""
+        fn = getattr(self.user, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def check_health(self) -> bool:
+        fn = getattr(self.user, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def shutdown(self) -> None:
+        fn = getattr(self.user, "__del__", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                pass

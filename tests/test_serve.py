@@ -1,0 +1,224 @@
+"""Serve tests: deployments, routing, composition, autoscaling, batching, HTTP.
+
+(reference test model: python/ray/serve/tests/ — e2e on single-process
+clusters; SURVEY.md §4.3.)
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=16)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_basic_deployment_and_handle(serve_cluster):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+        def shout(self, name):
+            return f"{self.greeting.upper()}, {name.upper()}!"
+
+    handle = serve.run(Greeter.bind("Hello"), name="greet", route_prefix="/greet")
+    assert handle.remote("world").result(timeout_s=30) == "Hello, world!"
+    assert handle.shout.remote("world").result(timeout_s=30) == "HELLO, WORLD!"
+    st = serve.status()
+    assert st["greet_Greeter"]["status"] == "HEALTHY"
+    serve.delete("greet")
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="fn", route_prefix="/fn")
+    assert handle.remote(21).result(timeout_s=30) == 42
+    serve.delete("fn")
+
+
+def test_num_replicas_and_routing(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Who.bind(), name="who", route_prefix="/who")
+    pids = {handle.remote(None).result(timeout_s=30) for _ in range(20)}
+    assert len(pids) == 2, f"expected 2 replicas, saw pids {pids}"
+    serve.delete("who")
+
+
+def test_composition_nested_handles(serve_cluster):
+    @serve.deployment
+    class Adder:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            return self.adder.remote(x).result(timeout_s=30) * 10
+
+    handle = serve.run(Pipeline.bind(Adder.bind()), name="pipe", route_prefix="/pipe")
+    assert handle.remote(4).result(timeout_s=30) == 50
+    serve.delete("pipe")
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            # a real model would vectorize; prove batching by echoing size
+            n = len(items)
+            return [(x, n) for x in items]
+
+    handle = serve.run(Batched.bind(), name="batch", route_prefix="/batch")
+    responses = [handle.remote(i) for i in range(8)]
+    out = [r.result(timeout_s=30) for r in responses]
+    assert sorted(x for x, _ in out) == list(range(8))
+    assert max(n for _, n in out) > 1, f"no batching observed: {out}"
+    serve.delete("batch")
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "downscale_delay_s": 30.0})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.8)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="auto", route_prefix="/auto")
+    handle.remote(None).result(timeout_s=30)  # warm up: 1 replica live
+    responses = [handle.remote(None) for _ in range(12)]
+    deadline = time.monotonic() + 30
+    scaled = False
+    while time.monotonic() < deadline:
+        st = serve.status()["auto_Slow"]
+        if st["replicas"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    for r in responses:
+        r.result(timeout_s=60)
+    assert scaled, f"never scaled up: {serve.status()}"
+    serve.delete("auto")
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            return {"path": request["path"], "echo": request["body"]}
+
+    serve.start(http_port=0)  # ephemeral port
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    host, port = serve.http_address()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/echo", data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out == {"path": "/echo", "echo": {"x": 1}}
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=30)
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised
+    serve.delete("echo")
+
+
+def test_user_config_reconfigure(serve_cluster):
+    @serve.deployment(user_config={"threshold": 5})
+    class Configurable:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Configurable.bind(), name="cfg", route_prefix="/cfg")
+    assert handle.remote(None).result(timeout_s=30) == 5
+    serve.delete("cfg")
+
+
+def test_multiplexed_model_loading(serve_cluster):
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads += 1
+            return f"model:{model_id}"
+
+        def __call__(self, model_id):
+            assert serve.get_multiplexed_model_id() == model_id
+            return (self.get_model(model_id), self.loads)
+
+    handle = serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+    m1, loads1 = handle.options(multiplexed_model_id="a").remote("a").result(timeout_s=30)
+    m2, loads2 = handle.options(multiplexed_model_id="a").remote("a").result(timeout_s=30)
+    assert m1 == m2 == "model:a"
+    assert loads2 == loads1  # cached, not reloaded
+    serve.delete("mux")
+
+
+def test_replica_death_recovery(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, action):
+            import os
+
+            if action == "die":
+                os._exit(1)
+            return os.getpid()
+
+    handle = serve.run(Fragile.bind(), name="frag", route_prefix="/frag")
+    pid1 = handle.remote("ok").result(timeout_s=30)
+    try:
+        handle.remote("die").result(timeout_s=30)
+    except Exception:
+        pass  # the dying request fails; the deployment must recover
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = handle.remote("ok").result(timeout_s=5)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1, f"no recovery: {pid1} → {pid2}"
+    serve.delete("frag")
