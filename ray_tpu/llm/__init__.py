@@ -1,0 +1,27 @@
+"""ray_tpu.llm — LLM serving and batch inference, TPU-native.
+
+(reference: python/ray/llm/ — vLLM-backed LLMServer + OpenAI ingress, PD
+disaggregation, Ray-Data batch processor. The engine here is the in-repo TPU
+continuous-batching engine (ray_tpu/llm/engine.py) instead of vLLM.)
+"""
+
+from ray_tpu.llm.batch import Processor, build_llm_processor
+from ray_tpu.llm.config import LLMConfig, ModelLoadingConfig
+from ray_tpu.llm.engine import SamplingParams, TPUEngine
+from ray_tpu.llm.pd import build_pd_openai_app
+from ray_tpu.llm.server import LLMServer, build_openai_app
+from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+
+__all__ = [
+    "ByteTokenizer",
+    "LLMConfig",
+    "LLMServer",
+    "ModelLoadingConfig",
+    "Processor",
+    "SamplingParams",
+    "TPUEngine",
+    "build_llm_processor",
+    "build_openai_app",
+    "build_pd_openai_app",
+    "load_tokenizer",
+]

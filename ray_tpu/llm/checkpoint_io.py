@@ -1,0 +1,41 @@
+"""Param checkpoint IO: flat .npz with /-joined tree paths.
+
+(reference capability: model loading from cloud/local storage,
+llm/_internal/serve/... model_loading_config; orbax is available in the image
+but a flat npz keeps checkpoints dependency-free and mmap-friendly.)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def _flatten(params) -> dict:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_params(params, path: str) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path if path.endswith(".npz") else path + ".npz", **_flatten(params))
+    return path
+
+
+def load_params(path: str):
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    data = np.load(path)
+    nested: dict = {}
+    for key in data.files:
+        parts = key.split("/")
+        node = nested
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return nested

@@ -1,0 +1,50 @@
+"""LLMConfig — the single config object for serve + batch LLM stacks.
+
+(reference: llm/_internal/serve/core/configs/llm_config.py LLMConfig —
+model_loading_config, engine_kwargs (tensor_parallel_size etc. forwarded to
+vLLM at vllm_models.py:215,219), accelerator_type, deployment_config. Here
+engine_kwargs drive the TPU engine and mesh axes instead of vLLM.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ModelLoadingConfig:
+    model_id: str = "tiny"  # a size key of the chosen model family
+    # checkpoint directory (orbax/npz) or None → random init of `model_cfg`
+    model_source: str | None = None
+    tokenizer: str | None = "byte"
+
+
+@dataclass
+class LLMConfig:
+    model_loading_config: ModelLoadingConfig = field(default_factory=ModelLoadingConfig)
+    # TransformerConfig kwargs for the built-in families (gpt2/llama/mixtral)
+    model_family: str = "llama"
+    model_kwargs: dict = field(default_factory=dict)
+    engine_kwargs: dict = field(default_factory=dict)  # max_slots, max_len, min_bucket,
+                                                       # tensor_parallel_size, seed
+    deployment_config: dict = field(default_factory=dict)  # serve options
+    accelerator_type: str | None = "TPU"
+
+    def build_model(self):
+        """Returns (TransformerConfig, params). Cited families live in
+        ray_tpu/models; random init unless model_source points at a checkpoint."""
+        import jax
+
+        from ray_tpu import models
+
+        factory = {"llama": models.llama_config, "gpt2": models.gpt2_config,
+                   "mixtral": models.mixtral_config}[self.model_family]
+        cfg = factory(self.model_loading_config.model_id, **self.model_kwargs)
+        src = self.model_loading_config.model_source
+        if src:
+            from ray_tpu.llm import checkpoint_io
+
+            params = checkpoint_io.load_params(src)
+        else:
+            params = models.transformer.init(jax.random.PRNGKey(0), cfg)
+        return cfg, params

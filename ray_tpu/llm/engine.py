@@ -1,0 +1,265 @@
+"""TPUEngine: continuous-batching inference on one chip/mesh.
+
+The scheduler thread owns the device state and runs the classic
+continuous-batching loop (admit → prefill into a free slot → global
+decode_step → emit/eject), all on static shapes:
+
+- prompt lengths are padded to power-of-two buckets → a handful of prefill
+  compilations, cached forever,
+- the decode hot loop is ONE jitted fixed-shape program regardless of which
+  rows are live — joins/leaves are slot bookkeeping, not recompiles,
+- sampling is on-device; only the sampled token ids cross PCIe each step.
+
+(reference capability: vLLM engine wrapped at
+llm/_internal/serve/engines/vllm/vllm_engine.py:114; TPU design is
+greenfield per SURVEY.md §7 — static-shape bucketing + slot cache instead of
+paged CUDA kernels.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import decoding
+from ray_tpu.models.transformer import TransformerConfig
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    tokens: list
+    params: SamplingParams
+    out_queue: queue.SimpleQueue = dataclasses.field(default_factory=queue.SimpleQueue)
+    slot: int = -1
+    generated: int = 0
+    kv_pack: dict | None = None  # prefilled elsewhere (PD disaggregation)
+
+
+_SENTINEL = object()
+
+
+def bucket_for(n: int, min_bucket: int, max_len: int) -> int:
+    """Smallest power-of-two bucket ≥ n (starting at min_bucket, capped at
+    max_len). Shared by the engine and the PD prefill server so the two can
+    never disagree on padded shapes."""
+    b = min_bucket
+    while b < n and b < max_len:
+        b *= 2
+    return min(b, max_len)
+
+
+class TPUEngine:
+    def __init__(self, cfg: TransformerConfig, params: Any, *,
+                 max_slots: int = 8, max_len: int | None = None,
+                 min_bucket: int = 32, seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len or cfg.max_seq_len
+        self.max_slots = max_slots
+        self.buckets = []
+        b = min_bucket
+        while b < self.max_len:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(self.max_len)
+        self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
+        self.key = jax.random.PRNGKey(seed)
+        self._free = list(range(max_slots))
+        self._by_slot: dict[int, _Request] = {}
+        self._waiting: queue.SimpleQueue = queue.SimpleQueue()
+        self._rid = itertools.count()
+        self._work = threading.Event()
+        self._stop = False
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpu-engine")
+        self._thread.start()
+
+    # ---------------------------------------------------------------- public
+
+    @classmethod
+    def from_config(cls, llm_config) -> "TPUEngine":
+        """Single construction point for server/PD/batch paths."""
+        cfg, params = llm_config.build_model()
+        ek = dict(llm_config.engine_kwargs)
+        return cls(cfg, params,
+                   max_slots=ek.get("max_slots", 8),
+                   max_len=ek.get("max_len", cfg.max_seq_len),
+                   min_bucket=ek.get("min_bucket", 32),
+                   seed=ek.get("seed", 0))
+
+    def _check_alive(self):
+        if self._error is not None:
+            raise RuntimeError("engine scheduler died") from self._error
+        if self._stop:
+            raise RuntimeError("engine is shut down")
+
+    def submit(self, token_ids: list, params: SamplingParams | None = None) -> _Request:
+        self._check_alive()
+        params = params or SamplingParams()
+        limit = self.max_len - params.max_tokens - 1
+        if limit <= 0:
+            raise ValueError("max_tokens leaves no room for the prompt")
+        token_ids = list(token_ids)[-limit:]
+        req = _Request(next(self._rid), token_ids, params)
+        self._waiting.put(req)
+        self._work.set()
+        return req
+
+    def submit_prefilled(self, k, v, length: int, first_token: int,
+                         params: SamplingParams | None = None) -> _Request:
+        """Admit a sequence whose prefill ran elsewhere (PD disaggregation):
+        k/v are [L, T, Hkv, Dh] host arrays for the prompt prefix."""
+        self._check_alive()
+        params = params or SamplingParams()
+        if k.shape[1] > self.max_len:
+            raise ValueError(
+                f"transferred prefix bucket {k.shape[1]} exceeds engine "
+                f"max_len {self.max_len}")
+        if int(length) + params.max_tokens >= self.max_len:
+            raise ValueError(
+                f"prefix length {int(length)} + max_tokens {params.max_tokens} "
+                f"does not fit engine max_len {self.max_len}")
+        req = _Request(next(self._rid), [], params)
+        req.kv_pack = {"k": k, "v": v, "length": int(length),
+                       "first_token": int(first_token)}
+        req.generated = 1  # the transferred first token counts
+        self._waiting.put(req)
+        self._work.set()
+        return req
+
+    def generate(self, token_ids: list, params: SamplingParams | None = None) -> list:
+        """Blocking: returns the generated token ids."""
+        return list(self.stream(token_ids, params))
+
+    def stream(self, token_ids: list, params: SamplingParams | None = None):
+        """Yields token ids as they are produced."""
+        req = self.submit(token_ids, params)
+        while True:
+            tok = req.out_queue.get()
+            if tok is _SENTINEL:
+                return
+            yield tok
+
+    def shutdown(self):
+        self._stop = True
+        self._work.set()
+        self._thread.join(timeout=5.0)
+        # unblock anyone still waiting on tokens
+        for req in list(self._by_slot.values()):
+            req.out_queue.put(_SENTINEL)
+        while True:
+            try:
+                self._waiting.get_nowait().out_queue.put(_SENTINEL)
+            except queue.Empty:
+                break
+
+    # ------------------------------------------------------------- scheduler
+
+    def _bucket(self, n: int) -> int:
+        return bucket_for(n, self.buckets[0], self.max_len)
+
+    def _admit(self):
+        while self._free:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            slot = self._free.pop()
+            req.slot = slot
+            if req.kv_pack is not None:
+                # PD path: KV arrived from a prefill server over the host plane
+                kv = {"k": jnp.asarray(req.kv_pack["k"], self.state["k"].dtype),
+                      "v": jnp.asarray(req.kv_pack["v"], self.state["v"].dtype)}
+                self.state = decoding.insert_sequence(
+                    self.state, slot, kv, jnp.int32(req.kv_pack["length"]),
+                    jnp.int32(req.kv_pack["first_token"]), self.cfg)
+                self._by_slot[slot] = req
+                continue
+            n = len(req.tokens)
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.tokens
+            logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
+                                          jnp.int32(n), self.cfg)
+            self.key, sub = jax.random.split(self.key)
+            first = decoding.sample(logits[None, :], sub,
+                                    req.params.temperature, req.params.top_k)
+            first_id = int(first[0])
+            self.state = decoding.insert_sequence(
+                self.state, slot, kv, jnp.int32(n), first[0], self.cfg)
+            self._by_slot[slot] = req
+            self._emit(req, first_id)
+
+    def _emit(self, req: _Request, token_id: int):
+        req.generated += 1
+        stops = set(req.params.stop_token_ids)
+        eos = token_id in stops
+        if not eos:
+            req.out_queue.put(token_id)
+        if eos or req.generated >= req.params.max_tokens:
+            self.state = decoding.release_slot(self.state, req.slot)
+            self._free.append(req.slot)
+            del self._by_slot[req.slot]
+            req.out_queue.put(_SENTINEL)
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — engine death must unblock callers
+            self._error = e
+            for req in self._by_slot.values():
+                req.out_queue.put(_SENTINEL)
+            while True:
+                try:
+                    self._waiting.get_nowait().out_queue.put(_SENTINEL)
+                except queue.Empty:
+                    break
+            raise
+
+    def _loop_inner(self):
+        while not self._stop:
+            if not self._by_slot and self._waiting.empty():
+                self._work.wait(timeout=0.1)
+                self._work.clear()
+                continue
+            self._admit()
+            if not self._by_slot:
+                continue
+            self.state, logits = decoding.decode_step(self.params, self.state, self.cfg)
+            self.key, sub = jax.random.split(self.key)
+            # per-row sampling params, applied vectorized on device
+            temps = np.zeros((self.max_slots,), np.float32)
+            top_ks = np.zeros((self.max_slots,), np.int32)
+            for slot, req in self._by_slot.items():
+                temps[slot] = req.params.temperature
+                top_ks[slot] = req.params.top_k
+            toks = decoding.sample_per_row(logits, sub, jnp.asarray(temps),
+                                           jnp.asarray(top_ks))
+            self.state = decoding.commit_tokens(self.state, toks)
+            toks_host = np.asarray(toks)
+            for slot, req in list(self._by_slot.items()):
+                self._emit(req, int(toks_host[slot]))
+
+    # ---------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {"free_slots": len(self._free), "active": len(self._by_slot),
+                "waiting": self._waiting.qsize(), "max_slots": self.max_slots,
+                "buckets": list(self.buckets)}

@@ -1,0 +1,108 @@
+"""Prefill/decode disaggregation.
+
+(reference: llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py
+— a PDProxyServer sends each request to a prefill deployment, transfers the
+KV cache to a decode deployment (NIXL/LMCache over RDMA in the reference),
+and streams tokens from the decoder. TPU mapping: prefill replicas own
+prefill-shaped meshes, decode replicas own the slot cache; KV crosses via the
+host object plane here (ICI remote-DMA is the on-pod fast path).)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.engine import SamplingParams
+from ray_tpu.llm.tokenizer import load_tokenizer
+
+
+@serve.deployment(max_ongoing_requests=8)
+class PrefillServer:
+    """Prompt-only forward: returns the packed KV + the first sampled token."""
+
+    def __init__(self, llm_config: LLMConfig):
+        import jax
+
+        from ray_tpu.models import decoding
+
+        self.cfg, self.params = llm_config.build_model()
+        self._decoding = decoding
+        self._jax = jax
+        ek = llm_config.engine_kwargs
+        self.min_bucket = ek.get("min_bucket", 32)
+        self.max_len = ek.get("max_len", self.cfg.max_seq_len)
+        self.key = jax.random.PRNGKey(ek.get("seed", 0))
+
+    def prefill(self, token_ids: list, temperature: float = 0.0) -> dict:
+        from ray_tpu.llm.engine import bucket_for
+
+        jax, decoding = self._jax, self._decoding
+        import jax.numpy as jnp
+
+        n = len(token_ids)
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        if n > bucket:
+            raise ValueError(f"prompt of {n} tokens exceeds max_len {self.max_len}")
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = token_ids
+        logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
+                                      jnp.int32(n), self.cfg)
+        self.key, sub = jax.random.split(self.key)
+        first = int(decoding.sample(logits[None, :], sub, temperature)[0])
+        return {"k": np.asarray(kv["k"]), "v": np.asarray(kv["v"]),
+                "length": n, "first_token": first}
+
+
+@serve.deployment(max_ongoing_requests=8)
+class DecodeServer:
+    """Continues generation from a transferred KV prefix."""
+
+    def __init__(self, llm_config: LLMConfig):
+        from ray_tpu.llm.engine import TPUEngine
+
+        self.engine = TPUEngine.from_config(llm_config)
+
+    def decode(self, kv_pack: dict, params: dict | None = None) -> list:
+        sp = SamplingParams(**(params or {}))
+        req = self.engine.submit_prefilled(
+            kv_pack["k"], kv_pack["v"], kv_pack["length"],
+            kv_pack["first_token"], sp)
+        out = [kv_pack["first_token"]]
+        from ray_tpu.llm.engine import _SENTINEL
+
+        while True:
+            tok = req.out_queue.get()
+            if tok is _SENTINEL:
+                return out
+            out.append(tok)
+
+
+@serve.deployment
+class PDProxyServer:
+    """(reference: pd_server.py PDProxyServer — composes the two pools.)"""
+
+    def __init__(self, prefill_handle, decode_handle, tokenizer_spec="byte"):
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+        self.tokenizer = load_tokenizer(tokenizer_spec)
+
+    def __call__(self, request: dict) -> dict:
+        body = request.get("body") or request
+        ids = self.tokenizer.encode(body.get("prompt", ""))
+        kv = self.prefill.prefill.remote(
+            ids, float(body.get("temperature", 0.0))).result(timeout_s=120)
+        out_ids = self.decode.decode.remote(
+            kv, {"max_tokens": int(body.get("max_tokens", 32)),
+                 "temperature": float(body.get("temperature", 0.0))}
+        ).result(timeout_s=120)
+        return {"choices": [{"text": self.tokenizer.decode(out_ids)}],
+                "usage": {"prompt_tokens": len(ids),
+                          "completion_tokens": len(out_ids)}}
+
+
+def build_pd_openai_app(llm_config: LLMConfig) -> serve.Application:
+    return PDProxyServer.bind(PrefillServer.bind(llm_config),
+                              DecodeServer.bind(llm_config),
+                              llm_config.model_loading_config.tokenizer or "byte")

@@ -1,0 +1,84 @@
+"""LLMServer deployment + OpenAI-compatible ingress.
+
+(reference: llm/_internal/serve/core/server/llm_server.py:97 LLMServer wraps
+the engine as a Serve deployment; core/ingress/ provides the OpenAI-style
+/v1/completions + /v1/chat/completions routes; build_openai_app composes
+them. Same layering here over the TPU engine.)
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.engine import SamplingParams, TPUEngine
+from ray_tpu.llm.tokenizer import load_tokenizer
+
+
+@serve.deployment(max_ongoing_requests=16)
+class LLMServer:
+    """One engine per replica; requests ride replica threads and park on the
+    engine's continuous-batching queue."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.config = llm_config
+        self.engine = TPUEngine.from_config(llm_config)
+        self.tokenizer = load_tokenizer(llm_config.model_loading_config.tokenizer)
+
+    def _params(self, body: dict) -> SamplingParams:
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token_ids=(eos,) if eos is not None else (),
+        )
+
+    def completions(self, body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        t0 = time.monotonic()
+        ids = self.tokenizer.encode(prompt)
+        out_ids = self.engine.generate(ids, self._params(body))
+        dt = time.monotonic() - t0
+        return {
+            "object": "text_completion",
+            "model": self.config.model_loading_config.model_id,
+            "choices": [{"index": 0, "text": self.tokenizer.decode(out_ids),
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": len(ids),
+                      "completion_tokens": len(out_ids),
+                      "total_time_s": round(dt, 4)},
+        }
+
+    def chat(self, body: dict) -> dict:
+        msgs = body.get("messages", [])
+        prompt = "".join(f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+                         for m in msgs) + "<assistant>"
+        out = self.completions({**body, "prompt": prompt})
+        out["object"] = "chat.completion"
+        out["choices"] = [{"index": 0, "finish_reason": "stop",
+                           "message": {"role": "assistant",
+                                       "content": out["choices"][0]["text"]}}]
+        return out
+
+    def engine_stats(self) -> dict:
+        return self.engine.stats()
+
+    def __call__(self, request: dict) -> dict:
+        """HTTP entry: route by path suffix (OpenAI wire shapes)."""
+        path = request.get("path", "")
+        body = request.get("body") or {}
+        if path.endswith("/chat/completions"):
+            return self.chat(body)
+        return self.completions(body)
+
+
+def build_openai_app(llm_config: LLMConfig) -> serve.Application:
+    """(reference: llm serve builds an ingress app from LLMConfig —
+    serve/core/ingress; deployment options come from deployment_config.)"""
+    dep = LLMServer
+    opts = dict(llm_config.deployment_config)
+    if opts:
+        dep = dep.options(**opts)
+    return dep.bind(llm_config)

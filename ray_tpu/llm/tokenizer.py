@@ -1,0 +1,40 @@
+"""Tokenizers for the LLM stack.
+
+ByteTokenizer is the built-in default (self-contained, vocab 259). HF
+tokenizers (transformers is in the image) load from a local path when given —
+remote downloads are not assumed.
+(reference: the LLM stack tokenizes via the model's HF tokenizer inside vLLM;
+llm/_internal/batch/stages/ tokenize stages.)
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + BOS/EOS/PAD. vocab = 256 + 3 specials."""
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+    vocab_size = 259
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.EOS
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] + ids) if add_bos else ids
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_tokenizer(spec: str | None):
+    if spec is None or spec == "byte":
+        return ByteTokenizer()
+    # local HF tokenizer directory
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(spec)

@@ -1,0 +1,219 @@
+"""KV-cache decoding for the shared transformer core: the TPU inference path.
+
+Design (JetStream-style, XLA-first — everything static-shape):
+- one global decode state of `max_slots` rows; each row is an independent
+  sequence with its own length counter (continuous batching = rows join and
+  leave between jitted `decode_step` calls, no recompilation),
+- `prefill` runs the prompt at a bucketed length and returns per-layer KV to
+  be inserted into a free row (`insert_sequence`, donated buffers → in-place
+  dynamic-update-slice in HBM),
+- `decode_step` advances ALL rows one token with per-row masks; inactive rows
+  are masked out, so the hot loop is one fixed-shape program on the MXU.
+
+The reference delegates all of this to vLLM (paged attention, CUDA);
+(reference: python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py:114 —
+capability parity target, not a design source). A contiguous [slots, max_len]
+cache replaces vLLM's paged KV: XLA prefers static dense layouts, and HBM
+capacity planning is done by slot count instead of page tables.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu import ops
+from ray_tpu.models.transformer import TransformerConfig, _dense_mlp, _moe_mlp, _norm
+
+
+def init_decode_state(cfg: TransformerConfig, max_slots: int, max_len: int) -> dict:
+    """Allocate the global decode state: per-layer KV + per-row bookkeeping."""
+    L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, max_slots, max_len, Hkv, Dh), cfg.dtype),
+        "v": jnp.zeros((L, max_slots, max_len, Hkv, Dh), cfg.dtype),
+        "length": jnp.zeros((max_slots,), jnp.int32),     # tokens in cache
+        "last_token": jnp.zeros((max_slots,), jnp.int32),  # next input per row
+        "active": jnp.zeros((max_slots,), jnp.bool_),
+    }
+
+
+def _rope(cfg):
+    if cfg.pos == "rope":
+        return ops.rope_frequencies(cfg.head_dim, cfg.max_seq_len, theta=cfg.rope_theta)
+    return None, None
+
+
+def _attn_qkv(x, p, cfg):
+    dt = cfg.dtype
+    q = jnp.einsum("bte,ehd->bthd", x, p["wq"].astype(dt))
+    k = jnp.einsum("bte,ehd->bthd", x, p["wk"].astype(dt))
+    v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(dt))
+    if cfg.bias:
+        q = q + p["bq"].astype(dt)
+        k = k + p["bk"].astype(dt)
+        v = v + p["bv"].astype(dt)
+    return q, k, v
+
+
+def _mlp_block(normed, layer_p, cfg):
+    if cfg.moe:
+        delta, _aux = _moe_mlp(normed, layer_p["mlp"], cfg)
+        return delta
+    return _dense_mlp(normed, layer_p["mlp"], cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(params, tokens, length, cfg: TransformerConfig):
+    """Run one prompt [1, T] (T = bucket size, padded; true length `length`).
+
+    Returns (logits_at_last [V], kv {k,v: [L, T, Hkv, Dh]}).
+    """
+    dt = cfg.dtype
+    B, T = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][:T].astype(dt)
+    cos, sin = _rope(cfg)
+
+    def block(h, layer_p):
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin)
+            k = ops.apply_rope(k, cos, sin)
+        out = ops.attention(q, k, v, causal=True)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return h, (k[0], v[0])
+
+    x, kv = jax.lax.scan(block, x, params["layers"])
+    x = _norm(x, params["final_norm"], cfg)
+    last = x[0, length - 1]
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"].astype(dt).T
+    else:
+        logits = last @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), {"k": kv[0], "v": kv[1]}
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
+def insert_sequence(state, slot, kv, length, first_token, cfg: TransformerConfig):
+    """Graft a prefilled sequence into decode row `slot` (in place: donated)."""
+    T = kv["k"].shape[1]
+    pad = state["k"].shape[2] - T
+    k_new = jnp.pad(kv["k"], ((0, 0), (0, pad), (0, 0), (0, 0)))[:, None]
+    v_new = jnp.pad(kv["v"], ((0, 0), (0, pad), (0, 0), (0, 0)))[:, None]
+    state = dict(state)
+    state["k"] = jax.lax.dynamic_update_slice_in_dim(state["k"], k_new.astype(state["k"].dtype), slot, axis=1)
+    state["v"] = jax.lax.dynamic_update_slice_in_dim(state["v"], v_new.astype(state["v"].dtype), slot, axis=1)
+    state["length"] = state["length"].at[slot].set(length)
+    state["last_token"] = state["last_token"].at[slot].set(first_token)
+    state["active"] = state["active"].at[slot].set(True)
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
+def decode_step(params, state, cfg: TransformerConfig):
+    """Advance every active row one token. Returns (state, logits [slots, V])."""
+    dt = cfg.dtype
+    S = state["k"].shape[2]
+    B = state["length"].shape[0]
+    tokens = state["last_token"][:, None]                      # [B, 1]
+    pos = state["length"]                                      # [B]
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos][:, None]
+    cos, sin = _rope(cfg)
+
+    def block(carry, layer_in):
+        h, = carry
+        layer_p, k_cache, v_cache = layer_in                   # caches [B, S, Hkv, Dh]
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)      # [B, 1, H, Dh]
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin, positions=pos[:, None])
+            k = ops.apply_rope(k, cos, sin, positions=pos[:, None])
+        # write this step's K/V at each row's position
+        onehot = jax.nn.one_hot(pos, S, dtype=dt)              # [B, S]
+        k_cache = k_cache * (1 - onehot)[..., None, None] + onehot[..., None, None] * k[:, 0][:, None]
+        v_cache = v_cache * (1 - onehot)[..., None, None] + onehot[..., None, None] * v[:, 0][:, None]
+        # grouped-query attention against the cache
+        G = cfg.n_heads // cfg.kv_heads
+        qh = q[:, 0].reshape(B, cfg.kv_heads, G, cfg.head_dim)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qh, k_cache.astype(dt)) / (cfg.head_dim ** 0.5)
+        mask = jnp.arange(S)[None, :] <= pos[:, None]          # [B, S]
+        scores = jnp.where(mask[:, None, None, :], scores.astype(jnp.float32), -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bkgs,bskd->bkgd", w, v_cache.astype(dt))
+        out = out.reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return (h,), (k_cache, v_cache)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["layers"], state["k"], state["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x[:, 0] @ params["embed"].astype(dt).T
+    else:
+        logits = x[:, 0] @ params["lm_head"].astype(dt)
+    state = dict(state)
+    state["k"], state["v"] = k_new, v_new
+    state["length"] = jnp.where(state["active"], state["length"] + 1, state["length"])
+    return state, logits.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def commit_tokens(state, next_tokens):
+    """Record sampled tokens as the next decode inputs (active rows only)."""
+    state = dict(state)
+    state["last_token"] = jnp.where(state["active"], next_tokens, state["last_token"])
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def release_slot(state, slot):
+    state = dict(state)
+    state["active"] = state["active"].at[slot].set(False)
+    state["length"] = state["length"].at[slot].set(0)
+    return state
+
+
+@jax.jit
+def sample_per_row(logits, key, temperatures, top_ks):
+    """Row-wise temperature + top-k sampling for the decode hot loop.
+    logits [B, V], temperatures [B] (0 → greedy), top_ks [B] int32 (0 → off)."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperatures, 1e-6)[:, None]
+    # per-row k-th largest as the cutoff (k=0 → cutoff -inf, i.e. no cut)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    idx = jnp.clip(top_ks - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    kth = jnp.where(top_ks[:, None] > 0, kth, -jnp.inf)
+    scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperatures <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def sample(logits, key, temperature: float, top_k: int = 0):
+    """Greedy when temperature == 0, else (top-k) categorical. [B, V] → [B]."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t
+    if top_k and top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -67,9 +67,11 @@ class _BatchQueue:
 _create_lock = threading.Lock()
 
 
-def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01,
+          result_timeout_s: float | None = None):
     """Decorator for methods/functions taking a single request; the wrapped
-    implementation receives a list and returns a list."""
+    implementation receives a list and returns a list. `result_timeout_s`
+    bounds each caller's wait (None = wait for the batch however long)."""
 
     def wrap(fn):
         state: dict = {"queue": None}  # per-process queue, created on first call
@@ -90,11 +92,11 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
 
         @functools.wraps(fn)
         def method_wrapper(self, item):
-            return get_queue().submit(self, item).result(timeout=60.0)
+            return get_queue().submit(self, item).result(timeout=result_timeout_s)
 
         @functools.wraps(fn)
         def fn_wrapper(item):
-            return get_queue().submit(None, item).result(timeout=60.0)
+            return get_queue().submit(None, item).result(timeout=result_timeout_s)
 
         import inspect
 

@@ -120,27 +120,33 @@ class ServeController:
     def shutdown(self) -> None:
         with self._lock:
             self._stop = True
+            # hard teardown: kill every replica now — the reconcile loop that
+            # would finish a graceful drain is about to exit
             for st in self.deployments.values():
-                st.deleted = True
-                st.target = 0
-            self._do_reconcile()
+                for h in st.replicas.values():
+                    self._kill_replica(h)
+                for h, _deadline in st.draining.values():
+                    self._kill_replica(h)
+                st.replicas.clear()
+                st.draining.clear()
+            self.deployments.clear()
 
     # -------------------------------------------------------------- reconcile
 
     def _reconcile_loop(self):
         while not self._stop:
             try:
-                self._do_reconcile()
-                self._do_autoscale()
+                try:
+                    actor_stats = ray_tpu.cluster_state()["actors"]
+                except Exception:
+                    actor_stats = {}
+                self._do_reconcile(actor_stats)
+                self._do_autoscale(actor_stats)
             except Exception:
                 pass  # reconcile must never die; next tick retries
             time.sleep(RECONCILE_INTERVAL_S)
 
-    def _do_reconcile(self):
-        try:
-            actor_stats = ray_tpu.cluster_state()["actors"]
-        except Exception:
-            actor_stats = {}
+    def _do_reconcile(self, actor_stats: dict):
         now = time.monotonic()
         with self._lock:
             for full, st in list(self.deployments.items()):
@@ -209,7 +215,7 @@ class ServeController:
 
     # ------------------------------------------------------------- autoscale
 
-    def _do_autoscale(self):
+    def _do_autoscale(self, actor_stats: dict):
         """(reference: serve/_private/autoscaling_state.py:838 +
         autoscaling_policy.py — replicas_needed = ceil(total_ongoing /
         target_ongoing_requests), immediate upscale, delayed downscale.
@@ -221,12 +227,6 @@ class ServeController:
         with self._lock:
             states = [st for st in self.deployments.values()
                       if st.config.get("autoscaling_config") and not st.deleted]
-        if not states:
-            return
-        try:
-            actor_stats = ray_tpu.cluster_state()["actors"]
-        except Exception:
-            return
         for st in states:
             cfg = st.config["autoscaling_config"]
             with self._lock:
