@@ -94,7 +94,14 @@ class Node:
         # setdefault) because the host env may preset JAX_PLATFORMS to the TPU
         # platform, and two processes must not fight over one chip
         # (reference: TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36).
-        env["JAX_PLATFORMS"] = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+        platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+        env["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            # CPU workers must not register a TPU-plugin session at interpreter
+            # start (sitecustomize triggers on this env var): the per-process
+            # registration dials the device-pool relay, and a worker blocking
+            # on (or wedging) the single-chip grant takes the whole pool down.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         with self._spawn_lock:
             for _ in range(n):
                 log = open(os.path.join(self.session_dir, "logs", f"worker-{len(self._procs)}.log"), "ab")

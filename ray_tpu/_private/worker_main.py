@@ -21,6 +21,10 @@ def main():
         worker.exec_loop()
     finally:
         worker.disconnect()
+        # hard exit: concurrent-actor pool threads are non-daemon and may be
+        # mid-task (or blocked on a dead GCS); threading._shutdown would join
+        # them forever and leak this process past driver death
+        os._exit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+"""Lazy DAG API + compiled execution.
+
+(reference: python/ray/dag/ — DAGNode/InputNode/MultiOutputNode
+(dag_node.py, input_node.py, output_node.py), .bind() builders on tasks and
+actor methods, experimental_compile → CompiledDAG
+(compiled_dag_node.py:805).)
+"""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    CompiledDAG,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGNode",
+    "FunctionNode",
+    "InputNode",
+    "MultiOutputNode",
+]

@@ -1,0 +1,156 @@
+"""DAG nodes: build lazily with .bind(), run with .execute() or compile.
+
+(reference: python/ray/dag/dag_node.py (base), input_node.py:InputNode,
+output_node.py:MultiOutputNode, class_node.py (actor-method binding),
+compiled_dag_node.py:805 CompiledDAG — compile pre-plans a static execution
+schedule (topological, per-actor serialized) so repeated executions skip
+graph traversal and argument re-resolution (:2002 _build_execution_schedule).
+
+Execution maps each node to the existing task/actor planes: FunctionNode →
+task submit, ClassMethodNode → ordered actor submit; intermediate values
+never return to the driver — downstream nodes consume upstream ObjectRefs.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import ray_tpu
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # ------------------------------------------------------------- traversal
+
+    def _upstream(self) -> list["DAGNode"]:
+        ups = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        ups += [v for v in self._bound_kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def _topo(self) -> list["DAGNode"]:
+        order: list[DAGNode] = []
+        seen: set[int] = set()
+
+        def visit(n: DAGNode):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            for u in n._upstream():
+                visit(u)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    # ------------------------------------------------------------- execution
+
+    def _resolve(self, values: dict, input_value) -> tuple[tuple, dict]:
+        def sub(a):
+            return values[id(a)] if isinstance(a, DAGNode) else a
+
+        args = tuple(sub(a) for a in self._bound_args)
+        kwargs = {k: sub(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _submit(self, args: tuple, kwargs: dict):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Eager one-shot execution; returns ObjectRef(s) of this node."""
+        values: dict[int, Any] = {}
+        for node in self._topo():
+            if isinstance(node, InputNode):
+                values[id(node)] = input_value
+            elif isinstance(node, MultiOutputNode):
+                values[id(node)] = [values[id(u)] for u in node._upstream()]
+            else:
+                args, kwargs = node._resolve(values, input_value)
+                values[id(node)] = node._submit(args, kwargs)
+        return values[id(self)]
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """(reference: dag/input_node.py — context-manager style `with InputNode()
+    as inp:`.)"""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _submit(self, args, kwargs):
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_method, args, kwargs):
+        super().__init__(args, kwargs)
+        self._method = actor_method
+
+    def _submit(self, args, kwargs):
+        return self._method.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """(reference: dag/output_node.py — groups several leaves.)"""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+
+class CompiledDAG:
+    """(reference: dag/compiled_dag_node.py:805 — the compiled form caches
+    the schedule; execute() is the steady-state entry point (:2546).)"""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+        self._schedule = root._topo()  # static schedule, computed once
+        self._input_nodes = [n for n in self._schedule if isinstance(n, InputNode)]
+
+    def execute(self, input_value: Any = None):
+        values: dict[int, Any] = {}
+        for node in self._schedule:
+            if isinstance(node, InputNode):
+                values[id(node)] = input_value
+            elif isinstance(node, MultiOutputNode):
+                values[id(node)] = [values[id(u)] for u in node._upstream()]
+            else:
+                args, kwargs = node._resolve(values, input_value)
+                values[id(node)] = node._submit(args, kwargs)
+        return values[id(self._root)]
+
+    def teardown(self):
+        self._schedule = []
+
+
+def _function_bind(self, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(self, args, kwargs)
+
+
+def _method_bind(self, *args, **kwargs) -> ClassMethodNode:
+    return ClassMethodNode(self, args, kwargs)
+
+
+# graft .bind onto the existing handle types (the reference defines bind on
+# RemoteFunction and ActorMethod the same way)
+from ray_tpu.actor import ActorMethod  # noqa: E402
+from ray_tpu.remote_function import RemoteFunction  # noqa: E402
+
+RemoteFunction.bind = _function_bind
+ActorMethod.bind = _method_bind

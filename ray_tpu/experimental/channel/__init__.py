@@ -1,0 +1,13 @@
+"""Zero-copy-ish actor↔actor channels.
+
+(reference: python/ray/experimental/channel/ — shm `Channel` over mutable
+plasma objects (shared_memory_channel.py:151), buffered/composite variants,
+and the pluggable AcceleratorContext (accelerator_context.py:222). Here a
+channel is a bounded SPSC pipe: payloads ride the shm object store, only the
+refs pass through the rendezvous actor, and reads free the slot — the same
+backpressure contract without the mutable-buffer C++ plane.)
+"""
+
+from ray_tpu.experimental.channel.channel import Channel, ChannelClosed, create_channel
+
+__all__ = ["Channel", "ChannelClosed", "create_channel"]

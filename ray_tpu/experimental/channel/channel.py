@@ -1,0 +1,100 @@
+"""Bounded SPSC channel: object-store payloads, actor-brokered refs.
+
+(reference: experimental/channel/shared_memory_channel.py:151 — write blocks
+when the buffer is full until the reader consumes (backpressure); close
+raises in blocked peers. The C++ mutable-object plane
+(src/ray/core_worker/experimental_mutable_object_manager.h:44) is collapsed
+into ref-passing through a tiny broker actor; numpy payloads still move
+zero-copy through shm via pickle-5 buffers.)
+"""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _Broker:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.items: list = []
+        self.closed = False
+
+    def offer(self, ref_hex: str) -> bool:
+        if self.closed:
+            return False
+        if len(self.items) >= self.maxsize:
+            return None  # full: caller retries (backpressure)
+        self.items.append(ref_hex)
+        return True
+
+    def take(self):
+        if self.items:
+            return self.items.pop(0)
+        return False if self.closed else None
+
+    def close(self):
+        self.closed = True
+
+    def size(self) -> int:
+        return len(self.items)
+
+
+class Channel:
+    def __init__(self, broker, maxsize: int):
+        self._broker = broker
+        self.maxsize = maxsize
+
+    def write(self, value, timeout: float | None = 60.0) -> None:
+        ref = ray_tpu.put(value)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        poll_s = 0.0005
+        while True:
+            ok = ray_tpu.get(self._broker.offer.remote(ref.hex()))
+            if ok is True:
+                return
+            if ok is False:
+                raise ChannelClosed("channel closed")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out (reader too slow)")
+            time.sleep(poll_s)
+            poll_s = min(poll_s * 2, 0.02)
+
+    def read(self, timeout: float | None = 60.0):
+        from ray_tpu._private.worker import ObjectRef
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        poll_s = 0.0005
+        while True:
+            got = ray_tpu.get(self._broker.take.remote())
+            if isinstance(got, str):
+                ref = ObjectRef(got)
+                value = ray_tpu.get(ref)
+                ray_tpu.free([ref])  # slot consumed: single-consumer semantics
+                return value
+            if got is False:
+                raise ChannelClosed("channel closed and drained")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(poll_s)
+            poll_s = min(poll_s * 2, 0.02)
+
+    def close(self) -> None:
+        try:
+            ray_tpu.get(self._broker.close.remote())
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        return (Channel, (self._broker, self.maxsize))
+
+
+def create_channel(maxsize: int = 2) -> Channel:
+    broker = _Broker.options(num_cpus=0.1).remote(maxsize)
+    return Channel(broker, maxsize)

@@ -30,20 +30,11 @@ class _EngineWorker:
         self.tokenizer = load_tokenizer(llm_config.model_loading_config.tokenizer)
 
     def generate_batch(self, prompts: list, sampling: dict) -> list:
+        from ray_tpu.llm.engine import _iter_request
+
         sp = SamplingParams(**sampling)
         reqs = [self.engine.submit(self.tokenizer.encode(p), sp) for p in prompts]
-        out = []
-        from ray_tpu.llm.engine import _SENTINEL
-
-        for r in reqs:
-            ids = []
-            while True:
-                tok = r.out_queue.get()
-                if tok is _SENTINEL:
-                    break
-                ids.append(tok)
-            out.append(self.tokenizer.decode(ids))
-        return out
+        return [self.tokenizer.decode(list(_iter_request(r))) for r in reqs]
 
 
 class Processor:

@@ -54,6 +54,24 @@ class _Request:
 _SENTINEL = object()
 
 
+class _EngineError:
+    """End-of-stream marker carrying the scheduler's failure."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _iter_request(req: "_Request"):
+    """Yield a request's tokens; raise if the engine died mid-stream."""
+    while True:
+        tok = req.out_queue.get()
+        if tok is _SENTINEL:
+            return
+        if isinstance(tok, _EngineError):
+            raise RuntimeError("engine scheduler died mid-generation") from tok.exc
+        yield tok
+
+
 def bucket_for(n: int, min_bucket: int, max_len: int) -> int:
     """Smallest power-of-two bucket ≥ n (starting at min_bucket, capped at
     max_len). Shared by the engine and the PD prefill server so the two can
@@ -71,6 +89,11 @@ class TPUEngine:
         self.cfg = cfg
         self.params = params
         self.max_len = max_len or cfg.max_seq_len
+        if self.max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"engine max_len {self.max_len} exceeds the model's "
+                f"max_seq_len {cfg.max_seq_len} (rope/pos tables are sized "
+                "by the model config)")
         self.max_slots = max_slots
         self.buckets = []
         b = min_bucket
@@ -113,10 +136,13 @@ class TPUEngine:
     def submit(self, token_ids: list, params: SamplingParams | None = None) -> _Request:
         self._check_alive()
         params = params or SamplingParams()
+        token_ids = list(token_ids)
+        if not token_ids:
+            raise ValueError("empty prompt: at least one token is required")
         limit = self.max_len - params.max_tokens - 1
         if limit <= 0:
             raise ValueError("max_tokens leaves no room for the prompt")
-        token_ids = list(token_ids)[-limit:]
+        token_ids = token_ids[-limit:]
         req = _Request(next(self._rid), token_ids, params)
         self._waiting.put(req)
         self._work.set()
@@ -151,22 +177,22 @@ class TPUEngine:
     def stream(self, token_ids: list, params: SamplingParams | None = None):
         """Yields token ids as they are produced."""
         req = self.submit(token_ids, params)
-        while True:
-            tok = req.out_queue.get()
-            if tok is _SENTINEL:
-                return
-            yield tok
+        yield from _iter_request(req)
 
     def shutdown(self):
         self._stop = True
         self._work.set()
         self._thread.join(timeout=5.0)
-        # unblock anyone still waiting on tokens
+        self._drain_all(None)
+
+    def _drain_all(self, error: BaseException | None):
+        """Unblock every waiting caller: end-of-stream, or the failure."""
+        marker = _EngineError(error) if error is not None else _SENTINEL
         for req in list(self._by_slot.values()):
-            req.out_queue.put(_SENTINEL)
+            req.out_queue.put(marker)
         while True:
             try:
-                self._waiting.get_nowait().out_queue.put(_SENTINEL)
+                self._waiting.get_nowait().out_queue.put(marker)
             except queue.Empty:
                 break
 
@@ -184,6 +210,11 @@ class TPUEngine:
             slot = self._free.pop()
             req.slot = slot
             if req.kv_pack is not None:
+                if req.generated >= req.params.max_tokens:
+                    # budget already spent by the transferred first token
+                    self._free.append(slot)
+                    req.out_queue.put(_SENTINEL)
+                    continue
                 # PD path: KV arrived from a prefill server over the host plane
                 kv = {"k": jnp.asarray(req.kv_pack["k"], self.state["k"].dtype),
                       "v": jnp.asarray(req.kv_pack["v"], self.state["v"].dtype)}
@@ -224,13 +255,7 @@ class TPUEngine:
             self._loop_inner()
         except BaseException as e:  # noqa: BLE001 — engine death must unblock callers
             self._error = e
-            for req in self._by_slot.values():
-                req.out_queue.put(_SENTINEL)
-            while True:
-                try:
-                    self._waiting.get_nowait().out_queue.put(_SENTINEL)
-                except queue.Empty:
-                    break
+            self._drain_all(e)
             raise
 
     def _loop_inner(self):

@@ -66,17 +66,14 @@ class DecodeServer:
 
     def decode(self, kv_pack: dict, params: dict | None = None) -> list:
         sp = SamplingParams(**(params or {}))
+        from ray_tpu.llm.engine import _iter_request
+
         req = self.engine.submit_prefilled(
             kv_pack["k"], kv_pack["v"], kv_pack["length"],
             kv_pack["first_token"], sp)
         out = [kv_pack["first_token"]]
-        from ray_tpu.llm.engine import _SENTINEL
-
-        while True:
-            tok = req.out_queue.get()
-            if tok is _SENTINEL:
-                return out
-            out.append(tok)
+        out.extend(_iter_request(req))
+        return out
 
 
 @serve.deployment

@@ -1,0 +1,103 @@
+"""Vectorized environments (numpy, dependency-free).
+
+(reference: RLlib consumes gymnasium envs via EnvRunners
+(rllib/env/single_agent_env_runner.py:68); the framework ships a built-in
+vectorized CartPole so rollout/learning paths are self-contained — physics
+per the classic control formulation.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class VectorEnv:
+    """Batch-first env API: reset()->obs [N,obs]; step(actions [N]) ->
+    (obs, reward [N], done [N], info). Auto-resets finished sub-envs."""
+
+    num_envs: int
+    obs_dim: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        raise NotImplementedError
+
+
+class CartPoleVecEnv(VectorEnv):
+    """N independent CartPole-v1 dynamics, vectorized over numpy."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * np.pi / 180
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 16, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = 4
+        self.num_actions = 2
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros((num_envs, 4), np.float64)
+        self.steps = np.zeros(num_envs, np.int64)
+        self.episode_returns = np.zeros(num_envs, np.float64)
+        self.completed_returns: list[float] = []
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = self.rng.uniform(-0.05, 0.05, (self.num_envs, 4))
+        self.steps[:] = 0
+        self.episode_returns[:] = 0
+        return self.state.astype(np.float32)
+
+    def _reset_rows(self, rows: np.ndarray):
+        self.state[rows] = self.rng.uniform(-0.05, 0.05, (rows.sum(), 4))
+        self.steps[rows] = 0
+        self.episode_returns[rows] = 0
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, th, th_dot = self.state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + pole_ml * th_dot**2 * sin) / total_mass
+        th_acc = (self.GRAVITY * sin - cos * tmp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0 - self.POLE_MASS * cos**2 / total_mass))
+        x_acc = tmp - pole_ml * th_acc * cos / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        th = th + self.DT * th_dot
+        th_dot = th_dot + self.DT * th_acc
+        self.state = np.stack([x, x_dot, th, th_dot], axis=1)
+        self.steps += 1
+        terminated = (np.abs(x) > self.X_LIMIT) | (np.abs(th) > self.THETA_LIMIT)
+        truncated = self.steps >= self.MAX_STEPS
+        done = terminated | truncated
+        reward = np.ones(self.num_envs, np.float32)
+        self.episode_returns += reward
+        for r in self.episode_returns[done]:
+            self.completed_returns.append(float(r))
+        if done.any():
+            self._reset_rows(done)
+        return self.state.astype(np.float32), reward, done, {}
+
+    def drain_episode_returns(self) -> list[float]:
+        out, self.completed_returns = self.completed_returns, []
+        return out
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPoleVecEnv}
+
+
+def make_vec_env(env_id, num_envs: int, seed: int = 0) -> VectorEnv:
+    if callable(env_id):
+        return env_id(num_envs=num_envs, seed=seed)
+    return ENV_REGISTRY[env_id](num_envs=num_envs, seed=seed)

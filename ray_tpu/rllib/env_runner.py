@@ -1,0 +1,99 @@
+"""EnvRunner: the rollout actor.
+
+(reference: rllib/env/single_agent_env_runner.py:68 — owns a vector env +
+inference-only module copy; sample() returns batched trajectories;
+EnvRunnerGroup (env/env_runner_group.py:69) fans out across actors and
+restarts failed ones (FaultAwareApply, env/env_runner.py:36).)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class EnvRunner:
+    def __init__(self, env_id, num_envs: int, seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib.env import make_vec_env
+
+        self.env = make_vec_env(env_id, num_envs, seed)
+        self.obs = self.env.reset(seed)
+        self.key = jax.random.PRNGKey(seed)
+        self.num_envs = num_envs
+
+    def sample(self, params_blob: bytes, num_steps: int) -> dict:
+        """Roll `num_steps` per sub-env; returns time-major arrays
+        [T, N, ...] plus bootstrap values for GAE."""
+        import jax
+
+        from ray_tpu._private import serialization as ser
+        from ray_tpu.rllib import rl_module
+
+        params = ser.loads(params_blob)
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        for t in range(T):
+            self.key, sub = jax.random.split(self.key)
+            action, logp, value = rl_module.forward_exploration(
+                params, self.obs, sub)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rew_buf[t], done_buf[t], _ = self.env.step(action)
+        _, last_value = rl_module.forward(params, self.obs)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_value": np.asarray(last_value),
+            "episode_returns": self.env.drain_episode_returns(),
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """(reference: env/env_runner_group.py:69 — healthy-set management +
+    restart of dead runners.)"""
+
+    def __init__(self, env_id, *, num_runners: int = 2, num_envs_per_runner: int = 8,
+                 seed: int = 0):
+        self.env_id = env_id
+        self.num_envs_per_runner = num_envs_per_runner
+        self.seed = seed
+        self.runners = [
+            EnvRunner.remote(env_id, num_envs_per_runner, seed + 1000 * i)
+            for i in range(num_runners)
+        ]
+
+    def sample(self, params_blob: bytes, num_steps: int) -> list[dict]:
+        refs = [(i, r.sample.remote(params_blob, num_steps))
+                for i, r in enumerate(self.runners)]
+        out = []
+        for i, ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=120.0))
+            except Exception:
+                # fault tolerance: replace the dead runner; its sample is lost
+                # this iteration (reference: FaultAwareApply restart semantics)
+                self.runners[i] = EnvRunner.remote(
+                    self.env_id, self.num_envs_per_runner, self.seed + 7777 + i)
+        return out
+
+    def shutdown(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

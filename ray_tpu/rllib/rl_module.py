@@ -1,0 +1,54 @@
+"""RLModule: the policy/value network abstraction, pure-functional jax.
+
+(reference: rllib/core/rl_module/ — RLModule defines forward_inference /
+forward_exploration / forward_train over the checkpointable module state;
+here the module is (init, forward) over a params pytree so the learner can
+jit/shard it like any other ray_tpu model.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key, obs_dim: int, num_actions: int, hidden: tuple = (64, 64)) -> dict:
+    sizes = (obs_dim, *hidden)
+    params: dict = {"layers": []}
+    keys = jax.random.split(key, len(sizes))
+    for i in range(len(sizes) - 1):
+        k1, _ = jax.random.split(keys[i])
+        params["layers"].append({
+            "w": jax.random.normal(k1, (sizes[i], sizes[i + 1])) * jnp.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],)),
+        })
+    kp, kv = jax.random.split(keys[-1])
+    params["pi"] = {"w": jax.random.normal(kp, (sizes[-1], num_actions)) * 0.01,
+                    "b": jnp.zeros((num_actions,))}
+    params["vf"] = {"w": jax.random.normal(kv, (sizes[-1], 1)) * 1.0,
+                    "b": jnp.zeros((1,))}
+    return params
+
+
+def forward(params: dict, obs: jnp.ndarray):
+    """obs [B, obs_dim] → (logits [B, A], value [B])."""
+    x = obs
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value
+
+
+@jax.jit
+def forward_inference(params, obs):
+    logits, _ = forward(params, obs)
+    return jnp.argmax(logits, axis=-1)
+
+
+@jax.jit
+def forward_exploration(params, obs, key):
+    logits, value = forward(params, obs)
+    action = jax.random.categorical(key, logits, axis=-1)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), action]
+    return action, logp, value

@@ -139,30 +139,35 @@ class ServeController:
                 try:
                     actor_stats = ray_tpu.cluster_state()["actors"]
                 except Exception:
-                    actor_stats = {}
+                    actor_stats = None  # stats unavailable: no liveness/load info
                 self._do_reconcile(actor_stats)
-                self._do_autoscale(actor_stats)
+                if actor_stats is not None:
+                    self._do_autoscale(actor_stats)
             except Exception:
                 pass  # reconcile must never die; next tick retries
             time.sleep(RECONCILE_INTERVAL_S)
 
-    def _do_reconcile(self, actor_stats: dict):
+    def _do_reconcile(self, actor_stats: dict | None):
+        stats_ok = actor_stats is not None
+        lookup = actor_stats or {}
         now = time.monotonic()
         with self._lock:
             for full, st in list(self.deployments.items()):
                 # replica death detection: drop handles whose actor the GCS
                 # marks dead so they're replaced below and leave the routing
                 # table (reference: DeploymentState reconciles against actor
-                # liveness, serve/_private/deployment_state.py:1713)
-                dead = [tag for tag, h in st.replicas.items()
-                        if actor_stats.get(h.actor_id, {}).get("state") == "dead"]
-                for tag in dead:
-                    st.replicas.pop(tag)
-                    self.version += 1
+                # liveness, serve/_private/deployment_state.py:1713). Skipped
+                # when stats are unavailable — absence of data is not death.
+                if stats_ok:
+                    dead = [tag for tag, h in st.replicas.items()
+                            if lookup.get(h.actor_id, {}).get("state") == "dead"]
+                    for tag in dead:
+                        st.replicas.pop(tag)
+                        self.version += 1
                 # drain completion: kill once idle or past the grace deadline
                 for tag, (h, deadline) in list(st.draining.items()):
-                    s = actor_stats.get(h.actor_id, {})
-                    idle = s.get("queued", 0) + s.get("in_flight", 0) == 0
+                    s = lookup.get(h.actor_id, {})
+                    idle = stats_ok and s.get("queued", 0) + s.get("in_flight", 0) == 0
                     if idle or now > deadline or s.get("state") == "dead":
                         st.draining.pop(tag)
                         self._kill_replica(h)

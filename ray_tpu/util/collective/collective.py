@@ -1,0 +1,242 @@
+"""Actor-oriented collectives over the host plane.
+
+(reference: python/ray/util/collective/collective.py —
+init_collective_group:180, create_collective_group:217, ops :325-738,
+GroupManager:75. The reference backends are NCCL/Gloo/NIXL; the TPU mapping
+(SURVEY §2.7) is two-plane:
+
+- DEVICE tensors: collectives belong *inside* jitted programs as XLA
+  collectives over ICI — build them with ray_tpu.parallel (psum/all_gather
+  via shard_map meshes). This module intentionally does not move device
+  arrays.
+- HOST tensors (numpy): this module — a gloo-equivalent over the shared
+  rendezvous actor, used for control-plane sync, CPU preprocessing, and
+  cross-slice glue.
+
+Every rank calls the same ops in the same order (the standard collective
+contract), so a per-group monotonically increasing sequence number names
+each operation's rendezvous.)
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import ray_tpu
+
+_groups: dict[str, "_GroupHandle"] = {}  # group_name → this process's handle
+
+
+@ray_tpu.remote
+class _Rendezvous:
+    """Per-group state: contributions keyed by (seq, rank)."""
+
+    def __init__(self, world_size: int):
+        self.n = world_size
+        self.contribs: dict[int, dict[int, bytes]] = {}
+        self.consumed: dict[int, set[int]] = {}
+
+    def put(self, seq: int, rank: int, blob: bytes) -> None:
+        self.contribs.setdefault(seq, {})[rank] = blob
+
+    def poll(self, seq: int, rank: int):
+        """All contributions if complete (marking this rank's read), else None."""
+        got = self.contribs.get(seq, {})
+        if len(got) < self.n:
+            return None
+        out = dict(got)
+        done = self.consumed.setdefault(seq, set())
+        done.add(rank)
+        if len(done) >= self.n:  # everyone has read: free the slot
+            self.contribs.pop(seq, None)
+            self.consumed.pop(seq, None)
+        return out
+
+    def put_p2p(self, seq: int, src: int, dst: int, blob: bytes) -> None:
+        self.contribs.setdefault(seq, {})[src * self.n + dst] = blob
+
+    def poll_p2p(self, seq: int, src: int, dst: int):
+        got = self.contribs.get(seq, {})
+        key = src * self.n + dst
+        if key not in got:
+            return None
+        blob = got.pop(key)
+        if not got:
+            self.contribs.pop(seq, None)
+        return blob
+
+
+class _GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.actor = actor
+        self.seq = 0
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+
+def _rendezvous_name(group_name: str) -> str:
+    return f"__collective::{group_name}"
+
+
+def init_collective_group(world_size: int, rank: int, *, backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join (rank 0 creates) the named group. Called by each participant.
+    (reference: collective.py:180.)"""
+    if group_name in _groups:
+        raise ValueError(f"already in collective group {group_name!r}")
+    name = _rendezvous_name(group_name)
+    if rank == 0:
+        actor = _Rendezvous.options(name=name, num_cpus=0.1).remote(world_size)
+        actor.__ray_ready__()
+    else:
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                actor = ray_tpu.get_actor(name)
+                break
+            except ValueError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"group {group_name!r} was never created") from None
+                time.sleep(0.02)
+    _groups[group_name] = _GroupHandle(group_name, world_size, rank, actor)
+
+
+def create_collective_group(actors: list, world_size: int, ranks: list[int], *,
+                            backend: str = "host", group_name: str = "default"):
+    """Declarative setup from the driver: tells every actor to join.
+    The actors must expose the conventional `init_collective_group(world_size,
+    rank, backend, group_name)` method (reference: collective.py:217 uses the
+    same information-push pattern)."""
+    refs = [a.init_collective_group.remote(world_size, r, backend, group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.actor)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def _group(group_name: str) -> _GroupHandle:
+    if group_name not in _groups:
+        raise ValueError(
+            f"not a member of collective group {group_name!r}; call "
+            "init_collective_group first")
+    return _groups[group_name]
+
+
+def _exchange(g: _GroupHandle, payload: np.ndarray | None, timeout: float) -> dict:
+    from ray_tpu._private import serialization as ser
+
+    seq = g.next_seq()
+    g.actor.put.remote(seq, g.rank, ser.dumps(payload))
+    deadline = time.monotonic() + timeout
+    poll_s = 0.001
+    while True:
+        got = ray_tpu.get(g.actor.poll.remote(seq, g.rank))
+        if got is not None:
+            return {r: ser.loads(b) for r, b in got.items()}
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"collective seq {seq} timed out on rank {g.rank}")
+        time.sleep(poll_s)
+        poll_s = min(poll_s * 2, 0.05)
+
+
+def allreduce(tensor: np.ndarray, *, op: str = "sum",
+              group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
+    """(reference: collective.py allreduce:325.)"""
+    g = _group(group_name)
+    parts = _exchange(g, np.asarray(tensor), timeout)
+    stack = np.stack([parts[r] for r in range(g.world_size)])
+    if op == "sum":
+        return stack.sum(axis=0)
+    if op == "mean":
+        return stack.mean(axis=0)
+    if op == "max":
+        return stack.max(axis=0)
+    if op == "min":
+        return stack.min(axis=0)
+    if op == "prod":
+        return stack.prod(axis=0)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def reduce(tensor: np.ndarray, *, dst_rank: int = 0, op: str = "sum",
+           group_name: str = "default", timeout: float = 60.0):
+    """Result lands on dst_rank; others get None. (reference: :414.)"""
+    out = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
+    return out if _group(group_name).rank == dst_rank else None
+
+
+def broadcast(tensor: np.ndarray | None, *, src_rank: int = 0,
+              group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
+    """(reference: :482.)"""
+    g = _group(group_name)
+    payload = np.asarray(tensor) if g.rank == src_rank else None
+    parts = _exchange(g, payload, timeout)
+    return parts[src_rank]
+
+
+def allgather(tensor: np.ndarray, *, group_name: str = "default",
+              timeout: float = 60.0) -> list[np.ndarray]:
+    """(reference: :554.)"""
+    g = _group(group_name)
+    parts = _exchange(g, np.asarray(tensor), timeout)
+    return [parts[r] for r in range(g.world_size)]
+
+
+def reducescatter(tensor: np.ndarray, *, op: str = "sum",
+                  group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
+    """Reduce then return this rank's 1/world shard along axis 0.
+    (reference: :629.)"""
+    g = _group(group_name)
+    total = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
+    shards = np.array_split(total, g.world_size, axis=0)
+    return shards[g.rank]
+
+
+def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
+    """(reference: :738.)"""
+    _exchange(_group(group_name), None, timeout)
+
+
+def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
+         tag: int = 0) -> None:
+    """P2P send; pairs with recv on dst. (reference: :666.)"""
+    from ray_tpu._private import serialization as ser
+
+    g = _group(group_name)
+    g.actor.put_p2p.remote(tag, g.rank, dst_rank, ser.dumps(np.asarray(tensor)))
+
+
+def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
+         timeout: float = 60.0) -> np.ndarray:
+    """(reference: :702.)"""
+    from ray_tpu._private import serialization as ser
+
+    g = _group(group_name)
+    deadline = time.monotonic() + timeout
+    poll_s = 0.001
+    while True:
+        blob = ray_tpu.get(g.actor.poll_p2p.remote(tag, src_rank, g.rank))
+        if blob is not None:
+            return ser.loads(blob)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv from rank {src_rank} timed out")
+        time.sleep(poll_s)
+        poll_s = min(poll_s * 2, 0.05)
