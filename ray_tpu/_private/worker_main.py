@@ -17,14 +17,20 @@ def main():
 
     worker = CoreWorker(socket_path, session_id, kind="worker")
     set_global_worker(worker)
+    code = 0
     try:
         worker.exec_loop()
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()  # worker log captures stderr
+        code = 1
     finally:
         worker.disconnect()
         # hard exit: concurrent-actor pool threads are non-daemon and may be
         # mid-task (or blocked on a dead GCS); threading._shutdown would join
         # them forever and leak this process past driver death
-        os._exit(0)
+        os._exit(code)
 
 
 if __name__ == "__main__":

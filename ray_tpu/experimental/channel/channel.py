@@ -10,7 +10,6 @@ zero-copy through shm via pickle-5 buffers.)
 
 from __future__ import annotations
 
-import time
 
 import ray_tpu
 
@@ -39,8 +38,12 @@ class _Broker:
             return self.items.pop(0)
         return False if self.closed else None
 
-    def close(self):
+    def close(self, drain: bool = False) -> list:
         self.closed = True
+        if not drain:
+            return []  # readers consume (and free) what's queued, then see closed
+        leftover, self.items = self.items, []
+        return leftover  # refs the closer must free (no reader will)
 
     def size(self) -> int:
         return len(self.items)
@@ -52,42 +55,48 @@ class Channel:
         self.maxsize = maxsize
 
     def write(self, value, timeout: float | None = 60.0) -> None:
+        from ray_tpu._private.poll import poll_until
+
         ref = ray_tpu.put(value)
-        deadline = None if timeout is None else time.monotonic() + timeout
-        poll_s = 0.0005
-        while True:
+
+        def offer():
             ok = ray_tpu.get(self._broker.offer.remote(ref.hex()))
-            if ok is True:
-                return
             if ok is False:
                 raise ChannelClosed("channel closed")
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("channel write timed out (reader too slow)")
-            time.sleep(poll_s)
-            poll_s = min(poll_s * 2, 0.02)
+            return True if ok else None
+
+        try:
+            poll_until(offer, timeout, "channel write timed out (reader too slow)")
+        except (ChannelClosed, TimeoutError):
+            ray_tpu.free([ref])  # never enqueued: don't leak the payload
+            raise
 
     def read(self, timeout: float | None = 60.0):
+        from ray_tpu._private.poll import poll_until
         from ray_tpu._private.worker import ObjectRef
 
-        deadline = None if timeout is None else time.monotonic() + timeout
-        poll_s = 0.0005
-        while True:
+        def take():
             got = ray_tpu.get(self._broker.take.remote())
-            if isinstance(got, str):
-                ref = ObjectRef(got)
-                value = ray_tpu.get(ref)
-                ray_tpu.free([ref])  # slot consumed: single-consumer semantics
-                return value
             if got is False:
                 raise ChannelClosed("channel closed and drained")
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("channel read timed out")
-            time.sleep(poll_s)
-            poll_s = min(poll_s * 2, 0.02)
+            return got  # str ref hex, or None → keep polling
 
-    def close(self) -> None:
+        hex_id = poll_until(take, timeout, "channel read timed out")
+        ref = ObjectRef(hex_id)
+        value = ray_tpu.get(ref)
+        ray_tpu.free([ref])  # slot consumed: single-consumer semantics
+        return value
+
+    def close(self, drain: bool = False) -> None:
+        """Graceful by default: queued items remain readable, then readers see
+        ChannelClosed. `drain=True` abandons unread items (frees their
+        payloads) — use when no reader will ever come."""
+        from ray_tpu._private.worker import ObjectRef
+
         try:
-            ray_tpu.get(self._broker.close.remote())
+            leftover = ray_tpu.get(self._broker.close.remote(drain))
+            if leftover:
+                ray_tpu.free([ObjectRef(h) for h in leftover])
         except Exception:
             pass
 

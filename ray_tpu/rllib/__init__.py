@@ -1,0 +1,26 @@
+"""ray_tpu.rllib — reinforcement learning.
+
+(reference: rllib/ — Algorithm/AlgorithmConfig, EnvRunnerGroup rollout
+actors, Learner SGD; PPO first (rllib/algorithms/ppo/). The learner update
+is a jitted XLA program that scales by mesh sharding instead of torch DDP.)
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_vec_env
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.learner import Learner, compute_gae
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleVecEnv",
+    "EnvRunner",
+    "EnvRunnerGroup",
+    "Learner",
+    "PPO",
+    "PPOConfig",
+    "VectorEnv",
+    "compute_gae",
+    "make_vec_env",
+]

@@ -1,0 +1,121 @@
+"""AlgorithmConfig builder + Algorithm base.
+
+(reference: rllib/algorithms/algorithm_config.py — the fluent
+.environment()/.env_runners()/.training() builder; algorithm.py:213
+Algorithm with train() → result dict and save/restore via Checkpointable.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env_id: Any = "CartPole-v1"
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 8
+        self.rollout_fragment_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.minibatch_size = 256
+        self.num_epochs = 4
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.model_hidden = (64, 64)
+        self.seed = 0
+
+    def environment(self, env=None, **_ignored) -> "AlgorithmConfig":
+        if env is not None:
+            self.env_id = env
+        return self
+
+    def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None, **_ignored) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, lambda_=None, minibatch_size=None,
+                 num_epochs=None, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, model=None, **_ignored) -> "AlgorithmConfig":
+        for name, v in (("lr", lr), ("gamma", gamma), ("lam", lambda_),
+                        ("minibatch_size", minibatch_size),
+                        ("num_epochs", num_epochs), ("clip_param", clip_param),
+                        ("vf_loss_coeff", vf_loss_coeff),
+                        ("entropy_coeff", entropy_coeff)):
+            if v is not None:
+                setattr(self, name, v)
+        if model and "fcnet_hiddens" in model:
+            self.model_hidden = tuple(model["fcnet_hiddens"])
+        return self
+
+    def debugging(self, *, seed=None, **_ignored) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """(reference: rllib/algorithms/algorithm.py:213 — iteration =
+    training_step(); results carry env_runners/learner metric trees.)"""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._episode_returns: list[float] = []
+        self.rng = np.random.default_rng(config.seed)
+        self._setup()
+
+    def _setup(self):
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def train(self) -> dict:
+        self.iteration += 1
+        metrics = self.training_step()
+        recent = self._episode_returns[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "env_runners": {
+                "episode_return_mean": float(np.mean(recent)) if recent else float("nan"),
+                "num_episodes": len(self._episode_returns),
+            },
+            "learners": metrics,
+        }
+
+    def save(self, path: str) -> str:
+        from ray_tpu.llm import checkpoint_io
+
+        os.makedirs(path, exist_ok=True)
+        checkpoint_io.save_params(self.learner.params,
+                                  os.path.join(path, "module"))
+        return path
+
+    def restore(self, path: str) -> None:
+        import jax
+
+        from ray_tpu.llm import checkpoint_io
+
+        loaded = checkpoint_io.load_params(os.path.join(path, "module"))
+        self.learner.params = jax.tree.map(
+            lambda old, new: new.astype(old.dtype) if hasattr(old, "dtype") else new,
+            self.learner.params, loaded)
+
+    def stop(self):
+        if hasattr(self, "runner_group"):
+            self.runner_group.shutdown()

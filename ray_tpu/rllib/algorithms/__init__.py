@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig"]

@@ -1,0 +1,65 @@
+"""PPO: clipped-surrogate policy optimization.
+
+(reference: rllib/algorithms/ppo/ — PPOConfig + PPO(Algorithm);
+training_step (algorithm.py:2274 pattern): sample from EnvRunnerGroup →
+GAE → epochs of minibatch SGD on the Learner → sync weights back to
+runners. The update itself is one jitted XLA program (learner.py).)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib import learner as learner_mod
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+class PPOConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+
+class PPO(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        probe = make_vec_env(cfg.env_id, 1, cfg.seed)
+        self.learner = learner_mod.Learner(
+            probe.obs_dim, probe.num_actions, lr=cfg.lr,
+            hidden=cfg.model_hidden, clip=cfg.clip_param,
+            vf_coef=cfg.vf_loss_coeff, ent_coef=cfg.entropy_coeff,
+            seed=cfg.seed)
+        self.runner_group = EnvRunnerGroup(
+            cfg.env_id, num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner, seed=cfg.seed)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        blob = self.learner.get_weights_blob()
+        samples = self.runner_group.sample(blob, cfg.rollout_fragment_length)
+        if not samples:
+            return {}
+        batches = []
+        import jax.numpy as jnp
+
+        for s in samples:
+            advs, rets = learner_mod.compute_gae(
+                jnp.asarray(s["rewards"]), jnp.asarray(s["values"]),
+                jnp.asarray(s["dones"]), jnp.asarray(s["last_value"]),
+                gamma=cfg.gamma, lam=cfg.lam)
+            T, N = s["rewards"].shape
+            batches.append({
+                "obs": s["obs"].reshape(T * N, -1),
+                "actions": s["actions"].reshape(T * N),
+                "logp_old": s["logp"].reshape(T * N),
+                "advantages": np.asarray(advs).reshape(T * N),
+                "returns": np.asarray(rets).reshape(T * N),
+            })
+            self._episode_returns.extend(s["episode_returns"])
+        batch = {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+        mb = min(cfg.minibatch_size, batch["obs"].shape[0])
+        return self.learner.update(batch, minibatch_size=mb,
+                                   num_epochs=cfg.num_epochs, rng=self.rng)
+
+
+PPOConfig.algo_class = PPO

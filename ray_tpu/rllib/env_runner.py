@@ -85,8 +85,13 @@ class EnvRunnerGroup:
             try:
                 out.append(ray_tpu.get(ref, timeout=120.0))
             except Exception:
-                # fault tolerance: replace the dead runner; its sample is lost
-                # this iteration (reference: FaultAwareApply restart semantics)
+                # fault tolerance: replace the failed runner; its sample is lost
+                # this iteration (reference: FaultAwareApply restart semantics).
+                # Kill first — a merely-slow runner would otherwise leak alive.
+                try:
+                    ray_tpu.kill(self.runners[i])
+                except Exception:
+                    pass
                 self.runners[i] = EnvRunner.remote(
                     self.env_id, self.num_envs_per_runner, self.seed + 7777 + i)
         return out

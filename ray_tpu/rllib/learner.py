@@ -1,0 +1,102 @@
+"""Learner / LearnerGroup: the SGD side of RL training.
+
+(reference: rllib/core/learner/learner.py:112 + learner_group.py:101 — the
+reference scales learners with torch DDP; here the PPO update is ONE jitted
+program and scales across chips by data-parallel sharding of the minibatch
+over a jax Mesh (XLA inserts the gradient psum — SPMD, not DDP).)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import rl_module
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "lam"))
+def compute_gae(rewards, values, dones, last_value, *, gamma: float = 0.99,
+                lam: float = 0.95):
+    """Time-major [T, N] inputs → (advantages, returns) [T, N] via a reverse
+    lax.scan (XLA-friendly: no Python loop over T)."""
+
+    def step(carry, xs):
+        adv_next = carry
+        r, v, d, v_next = xs
+        nonterminal = 1.0 - d.astype(jnp.float32)
+        delta = r + gamma * v_next * nonterminal - v
+        adv = delta + gamma * lam * nonterminal * adv_next
+        return adv, adv
+
+    v_next_seq = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    _, advs = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (rewards, values, dones, v_next_seq), reverse=True)
+    return advs, advs + values
+
+
+def make_ppo_update(optimizer, *, clip: float = 0.2, vf_coef: float = 0.5,
+                    ent_coef: float = 0.01):
+    @jax.jit
+    def update(params, opt_state, batch):
+        def loss_fn(p):
+            logits, value = rl_module.forward(p, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+            vf = 0.5 * jnp.mean((value - batch["returns"]) ** 2)
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pg + vf_coef * vf - ent_coef * ent
+            return total, {"policy_loss": pg, "vf_loss": vf, "entropy": ent,
+                           "approx_kl": jnp.mean(batch["logp_old"] - logp)}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        return params, opt_state, metrics
+
+    return update
+
+
+class Learner:
+    """Single-controller learner owning params + optimizer state on device.
+    (reference: core/learner/learner.py:112 — update(batch) → metrics.)"""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 3e-4,
+                 hidden=(64, 64), clip: float = 0.2, vf_coef: float = 0.5,
+                 ent_coef: float = 0.01, seed: int = 0):
+        self.params = rl_module.init(jax.random.PRNGKey(seed), obs_dim,
+                                     num_actions, hidden)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_ppo_update(self.optimizer, clip=clip,
+                                       vf_coef=vf_coef, ent_coef=ent_coef)
+
+    def update(self, batch: dict, *, minibatch_size: int, num_epochs: int,
+               rng: np.random.Generator) -> dict:
+        n = batch["obs"].shape[0]
+        metrics = {}
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - minibatch_size + 1, minibatch_size):
+                idx = perm[start:start + minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights_blob(self) -> bytes:
+        from ray_tpu._private import serialization as ser
+
+        return ser.dumps(jax.device_get(self.params))

@@ -14,14 +14,15 @@ import jax.numpy as jnp
 
 def init(key, obs_dim: int, num_actions: int, hidden: tuple = (64, 64)) -> dict:
     sizes = (obs_dim, *hidden)
-    params: dict = {"layers": []}
+    # dict-of-dicts (not a list) so flat path checkpoints round-trip
+    params: dict = {"layers": {}}
     keys = jax.random.split(key, len(sizes))
     for i in range(len(sizes) - 1):
         k1, _ = jax.random.split(keys[i])
-        params["layers"].append({
+        params["layers"][str(i)] = {
             "w": jax.random.normal(k1, (sizes[i], sizes[i + 1])) * jnp.sqrt(2.0 / sizes[i]),
             "b": jnp.zeros((sizes[i + 1],)),
-        })
+        }
     kp, kv = jax.random.split(keys[-1])
     params["pi"] = {"w": jax.random.normal(kp, (sizes[-1], num_actions)) * 0.01,
                     "b": jnp.zeros((num_actions,))}
@@ -33,7 +34,8 @@ def init(key, obs_dim: int, num_actions: int, hidden: tuple = (64, 64)) -> dict:
 def forward(params: dict, obs: jnp.ndarray):
     """obs [B, obs_dim] → (logits [B, A], value [B])."""
     x = obs
-    for layer in params["layers"]:
+    for i in sorted(params["layers"], key=int):
+        layer = params["layers"][i]
         x = jnp.tanh(x @ layer["w"] + layer["b"])
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]

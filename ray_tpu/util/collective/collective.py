@@ -35,8 +35,9 @@ class _Rendezvous:
 
     def __init__(self, world_size: int):
         self.n = world_size
-        self.contribs: dict[int, dict[int, bytes]] = {}
+        self.contribs: dict[int, dict[int, bytes]] = {}    # collectives by seq
         self.consumed: dict[int, set[int]] = {}
+        self.mailbox: dict[tuple, bytes] = {}              # p2p: disjoint namespace
 
     def put(self, seq: int, rank: int, blob: bytes) -> None:
         self.contribs.setdefault(seq, {})[rank] = blob
@@ -54,18 +55,16 @@ class _Rendezvous:
             self.consumed.pop(seq, None)
         return out
 
-    def put_p2p(self, seq: int, src: int, dst: int, blob: bytes) -> None:
-        self.contribs.setdefault(seq, {})[src * self.n + dst] = blob
+    def put_p2p(self, tag: int, src: int, dst: int, blob: bytes) -> bool:
+        """False while the slot is occupied (an unconsumed earlier send)."""
+        key = (tag, src, dst)
+        if key in self.mailbox:
+            return False
+        self.mailbox[key] = blob
+        return True
 
-    def poll_p2p(self, seq: int, src: int, dst: int):
-        got = self.contribs.get(seq, {})
-        key = src * self.n + dst
-        if key not in got:
-            return None
-        blob = got.pop(key)
-        if not got:
-            self.contribs.pop(seq, None)
-        return blob
+    def poll_p2p(self, tag: int, src: int, dst: int):
+        return self.mailbox.pop((tag, src, dst), None)
 
 
 class _GroupHandle:
@@ -142,19 +141,13 @@ def _group(group_name: str) -> _GroupHandle:
 
 def _exchange(g: _GroupHandle, payload: np.ndarray | None, timeout: float) -> dict:
     from ray_tpu._private import serialization as ser
+    from ray_tpu._private.poll import poll_until
 
     seq = g.next_seq()
     g.actor.put.remote(seq, g.rank, ser.dumps(payload))
-    deadline = time.monotonic() + timeout
-    poll_s = 0.001
-    while True:
-        got = ray_tpu.get(g.actor.poll.remote(seq, g.rank))
-        if got is not None:
-            return {r: ser.loads(b) for r, b in got.items()}
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"collective seq {seq} timed out on rank {g.rank}")
-        time.sleep(poll_s)
-        poll_s = min(poll_s * 2, 0.05)
+    got = poll_until(lambda: ray_tpu.get(g.actor.poll.remote(seq, g.rank)),
+                     timeout, f"collective seq {seq} timed out on rank {g.rank}")
+    return {r: ser.loads(b) for r, b in got.items()}
 
 
 def allreduce(tensor: np.ndarray, *, op: str = "sum",
@@ -216,27 +209,28 @@ def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
 
 
 def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
-         tag: int = 0) -> None:
-    """P2P send; pairs with recv on dst. (reference: :666.)"""
+         tag: int = 0, timeout: float = 60.0) -> None:
+    """P2P send; pairs with recv on dst. Blocks while an earlier same-tag
+    send to the same peer is unconsumed (mailbox backpressure).
+    (reference: :666.)"""
     from ray_tpu._private import serialization as ser
+    from ray_tpu._private.poll import poll_until
 
     g = _group(group_name)
-    g.actor.put_p2p.remote(tag, g.rank, dst_rank, ser.dumps(np.asarray(tensor)))
+    blob = ser.dumps(np.asarray(tensor))
+    poll_until(
+        lambda: ray_tpu.get(g.actor.put_p2p.remote(tag, g.rank, dst_rank, blob)) or None,
+        timeout, f"send to rank {dst_rank} (tag {tag}) timed out: receiver never drained")
 
 
 def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
          timeout: float = 60.0) -> np.ndarray:
     """(reference: :702.)"""
     from ray_tpu._private import serialization as ser
+    from ray_tpu._private.poll import poll_until
 
     g = _group(group_name)
-    deadline = time.monotonic() + timeout
-    poll_s = 0.001
-    while True:
-        blob = ray_tpu.get(g.actor.poll_p2p.remote(tag, src_rank, g.rank))
-        if blob is not None:
-            return ser.loads(blob)
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"recv from rank {src_rank} timed out")
-        time.sleep(poll_s)
-        poll_s = min(poll_s * 2, 0.05)
+    blob = poll_until(
+        lambda: ray_tpu.get(g.actor.poll_p2p.remote(tag, src_rank, g.rank)),
+        timeout, f"recv from rank {src_rank} timed out")
+    return ser.loads(blob)
