@@ -1,0 +1,378 @@
+// Shared-memory arena object store — the native core of the per-node object
+// plane. One mmap'd tmpfs file holds a header (robust process-shared mutex +
+// object index + free list) and a data region; every process of a session
+// maps the same file, so sealed objects are zero-copy readable everywhere.
+//
+// (reference capability: src/ray/object_manager/plasma/ — PlasmaStore over
+// dlmalloc'd shm with LRU eviction (eviction_policy.h:159) and fd passing
+// (fling.cc). Design here is arena+offsets instead of fd-per-object: tmpfs
+// is the transport, offsets are the handles, a robust pthread mutex replaces
+// the store-server event loop for intra-node coordination.)
+//
+// Build: g++ -O2 -shared -fPIC -o libshmstore.so shm_store.cc -lpthread
+//
+// All functions return >=0 on success; negative codes:
+//   -1 not found / no space (create: even after eviction)
+//   -2 already exists / state error
+//   -3 internal capacity (index or free-list full)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055414E4131ULL;  // "RTPUANA1"
+constexpr uint32_t kOidLen = 40;
+constexpr uint32_t kMaxSlots = 32768;
+constexpr uint32_t kMaxHoles = 8192;
+
+enum State : uint32_t { kFree = 0, kCreating = 1, kSealed = 2, kDeleting = 3 };
+
+struct Entry {
+  char oid[kOidLen];
+  uint64_t offset;
+  uint64_t size;
+  uint32_t state;
+  uint32_t refcount;
+  uint64_t lru_tick;
+};
+
+struct Hole {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;      // bytes in the data region
+  uint64_t data_start;    // file offset where data begins
+  uint64_t bump;          // next never-used byte (relative to data_start)
+  uint64_t tick;          // LRU clock
+  uint64_t used;          // live bytes (creating+sealed)
+  uint32_t n_slots;
+  uint32_t n_holes;
+  pthread_mutex_t mutex;
+  Entry slots[kMaxSlots];
+  Hole holes[kMaxHoles];
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;          // mapping base
+  uint64_t map_len;
+  int fd;
+};
+
+void lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mutex);  // holder died
+}
+
+void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
+
+Entry* find(Header* h, const char* oid) {
+  for (uint32_t i = 0; i < h->n_slots; i++) {
+    Entry& e = h->slots[i];
+    if (e.state != kFree && strncmp(e.oid, oid, kOidLen) == 0) return &e;
+  }
+  return nullptr;
+}
+
+Entry* free_slot(Header* h) {
+  for (uint32_t i = 0; i < h->n_slots; i++)
+    if (h->slots[i].state == kFree) return &h->slots[i];
+  if (h->n_slots < kMaxSlots) return &h->slots[h->n_slots++];
+  return nullptr;
+}
+
+// return a hole to the free list, merging with adjacent holes
+void add_hole(Header* h, uint64_t offset, uint64_t size) {
+  if (size == 0) return;
+  if (offset + size == h->bump) {  // tail hole: give back to the bump region
+    h->bump = offset;
+    // absorb any hole now adjacent to the (moved) bump pointer
+    bool merged = true;
+    while (merged) {
+      merged = false;
+      for (uint32_t i = 0; i < h->n_holes; i++) {
+        if (h->holes[i].offset + h->holes[i].size == h->bump) {
+          h->bump = h->holes[i].offset;
+          h->holes[i] = h->holes[--h->n_holes];
+          merged = true;
+          break;
+        }
+      }
+    }
+    return;
+  }
+  for (uint32_t i = 0; i < h->n_holes; i++) {
+    Hole& o = h->holes[i];
+    if (o.offset + o.size == offset) {        // extend o rightward
+      o.size += size;
+      return;
+    }
+    if (offset + size == o.offset) {          // extend o leftward
+      o.offset = offset;
+      o.size += size;
+      return;
+    }
+  }
+  if (h->n_holes < kMaxHoles) h->holes[h->n_holes++] = {offset, size};
+  // else: the space is leaked until session cleanup — counted, not fatal
+}
+
+// best-fit from the free list, else bump; -1 if no contiguous run fits
+int64_t carve(Header* h, uint64_t size) {
+  uint32_t best = kMaxHoles;
+  uint64_t best_sz = UINT64_MAX;
+  for (uint32_t i = 0; i < h->n_holes; i++) {
+    if (h->holes[i].size >= size && h->holes[i].size < best_sz) {
+      best = i;
+      best_sz = h->holes[i].size;
+    }
+  }
+  if (best != kMaxHoles) {
+    Hole& o = h->holes[best];
+    uint64_t off = o.offset;
+    o.offset += size;
+    o.size -= size;
+    if (o.size == 0) h->holes[best] = h->holes[--h->n_holes];
+    return (int64_t)off;
+  }
+  if (h->bump + size <= h->capacity) {
+    uint64_t off = h->bump;
+    h->bump += size;
+    return (int64_t)off;
+  }
+  return -1;
+}
+
+// evict ONE least-recently-used sealed+unpinned object; false if none
+bool evict_lru(Header* h) {
+  Entry* victim = nullptr;
+  for (uint32_t i = 0; i < h->n_slots; i++) {
+    Entry& e = h->slots[i];
+    if (e.state == kSealed && e.refcount == 0 &&
+        (!victim || e.lru_tick < victim->lru_tick))
+      victim = &e;
+  }
+  if (!victim) return false;
+  add_hole(h, victim->offset, victim->size);
+  h->used -= victim->size;
+  victim->state = kFree;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (create=1: initialize if new) the arena at `path` with `capacity`
+// data bytes. Returns an opaque handle or null.
+void* rtpu_store_open(const char* path, uint64_t capacity, int create) {
+  int fd = open(path, create ? (O_RDWR | O_CREAT) : O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(Header) + capacity;
+  flock(fd, LOCK_EX);  // serialize first-time initialization
+  struct stat st;
+  fstat(fd, &st);
+  bool fresh = st.st_size == 0;
+  if (fresh) {
+    if (!create || ftruncate(fd, (off_t)total) != 0) {
+      flock(fd, LOCK_UN);
+      close(fd);
+      return nullptr;
+    }
+  } else {
+    total = (uint64_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return nullptr;
+  }
+  Header* hdr = (Header*)mem;
+  if (fresh) {
+    memset(hdr, 0, sizeof(Header));
+    hdr->magic = kMagic;
+    hdr->capacity = total - sizeof(Header);
+    hdr->data_start = sizeof(Header);
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hdr->mutex, &attr);
+    pthread_mutexattr_destroy(&attr);
+  } else if (hdr->magic != kMagic) {
+    munmap(mem, total);
+    flock(fd, LOCK_UN);
+    close(fd);
+    return nullptr;
+  }
+  flock(fd, LOCK_UN);
+  Store* s = new Store{hdr, (uint8_t*)mem, total, fd};
+  return s;
+}
+
+void rtpu_store_close(void* handle) {
+  Store* s = (Store*)handle;
+  munmap(s->base, s->map_len);
+  close(s->fd);
+  delete s;
+}
+
+// Allocate `size` bytes for `oid`. Evicts LRU sealed objects as needed.
+// Returns file offset of the data, or a negative code.
+int64_t rtpu_store_create(void* handle, const char* oid, uint64_t size) {
+  Store* s = (Store*)handle;
+  Header* h = s->hdr;
+  lock(h);
+  if (find(h, oid)) {
+    unlock(h);
+    return -2;
+  }
+  if (size > h->capacity) {
+    unlock(h);
+    return -1;
+  }
+  int64_t off;
+  while ((off = carve(h, size)) < 0) {
+    if (!evict_lru(h)) {
+      unlock(h);
+      return -1;
+    }
+  }
+  Entry* e = free_slot(h);
+  if (!e) {
+    add_hole(h, (uint64_t)off, size);
+    unlock(h);
+    return -3;
+  }
+  strncpy(e->oid, oid, kOidLen);
+  e->offset = (uint64_t)off;
+  e->size = size;
+  e->state = kCreating;
+  e->refcount = 0;
+  e->lru_tick = ++h->tick;
+  h->used += size;
+  int64_t abs_off = (int64_t)(h->data_start + (uint64_t)off);
+  unlock(h);
+  return abs_off;
+}
+
+int rtpu_store_seal(void* handle, const char* oid) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  if (!e || e->state != kCreating) {
+    unlock(h);
+    return e ? -2 : -1;
+  }
+  e->state = kSealed;
+  e->lru_tick = ++h->tick;
+  unlock(h);
+  return 0;
+}
+
+// Pin + locate a sealed object. Returns absolute offset, fills *size_out.
+int64_t rtpu_store_get(void* handle, const char* oid, uint64_t* size_out) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  if (!e || e->state != kSealed) {
+    unlock(h);
+    return -1;
+  }
+  e->refcount++;
+  e->lru_tick = ++h->tick;
+  *size_out = e->size;
+  int64_t off = (int64_t)(h->data_start + e->offset);
+  unlock(h);
+  return off;
+}
+
+int rtpu_store_release(void* handle, const char* oid) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  if (e && e->refcount > 0) {
+    e->refcount--;
+    if (e->refcount == 0 && e->state == kDeleting) {
+      // deferred delete: last reader unpinned
+      add_hole(h, e->offset, e->size);
+      h->used -= e->size;
+      e->state = kFree;
+    }
+  }
+  unlock(h);
+  return e ? 0 : -1;
+}
+
+int rtpu_store_contains(void* handle, const char* oid) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int ok = (e && e->state == kSealed) ? 1 : 0;
+  unlock(h);
+  return ok;
+}
+
+int64_t rtpu_store_size(void* handle, const char* oid) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int64_t out = (e && e->state == kSealed) ? (int64_t)e->size : -1;
+  unlock(h);
+  return out;
+}
+
+int rtpu_store_delete(void* handle, const char* oid) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  if (!e || e->state == kDeleting) {
+    unlock(h);
+    return -1;
+  }
+  if (e->refcount > 0) {
+    e->state = kDeleting;  // space reclaimed when the last reader releases
+  } else {
+    add_hole(h, e->offset, e->size);
+    h->used -= e->size;
+    e->state = kFree;
+  }
+  unlock(h);
+  return 0;
+}
+
+uint64_t rtpu_store_used(void* handle) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  uint64_t u = h->used;
+  unlock(h);
+  return u;
+}
+
+uint64_t rtpu_store_capacity(void* handle) {
+  return ((Store*)handle)->hdr->capacity;
+}
+
+uint32_t rtpu_store_num_objects(void* handle) {
+  Header* h = ((Store*)handle)->hdr;
+  lock(h);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->n_slots; i++)
+    if (h->slots[i].state == kSealed) n++;
+  unlock(h);
+  return n;
+}
+
+}  // extern "C"
