@@ -18,16 +18,58 @@ import functools
 import json
 import math
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+_TPU_PROBE_CODE = "import jax; d = jax.devices(); assert d; print(d[0].platform)"
+
+
+def _probe_tpu(attempts: int = 3, timeout: float = 300.0) -> tuple[bool, str]:
+    """Check in a SUBPROCESS that the TPU backend can initialize.
+
+    Round-1 failure mode: a wedged device-pool grant made jax backend init
+    raise Unavailable (or hang for minutes) — and a failed in-process init is
+    cached by jax, so we probe out-of-process with a hard timeout and retry
+    with backoff before committing this process to the TPU platform.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False, "JAX_PLATFORMS=cpu preset"
+    err = ""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _TPU_PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout)
+            if r.returncode == 0:
+                plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+                if plat not in ("cpu",):
+                    return True, plat
+                return False, f"probe found platform {plat!r}"
+            err = (r.stderr or "").strip().splitlines()[-1:] or ["rc=%d" % r.returncode]
+            err = err[0][-300:]
+        except subprocess.TimeoutExpired:
+            err = f"TPU backend init hung >{timeout:.0f}s"
+        if i + 1 < attempts:
+            time.sleep(10 * (i + 1))
+    return False, err
 
 
 def main():
+    tpu_ok, tpu_note = _probe_tpu()
+    if not tpu_ok:
+        # fall back to a CPU run so the artifact still records a number,
+        # with the TPU failure reason in detail.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+
     on_tpu = jax.default_backend() == "tpu"
     from ray_tpu.models import llama_config, transformer
 
@@ -98,6 +140,7 @@ def main():
             "mfu_6nd": round(mfu, 4),
             "final_loss": round(float(loss), 3),
             "backend": jax.default_backend(),
+            **({} if tpu_ok else {"tpu_unavailable": tpu_note}),
         },
     }))
 

@@ -16,6 +16,7 @@
 //   -2 already exists / state error
 //   -3 internal capacity (index or free-list full)
 
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
@@ -235,9 +236,19 @@ int64_t rtpu_store_create(void* handle, const char* oid, uint64_t size) {
   Store* s = (Store*)handle;
   Header* h = s->hdr;
   lock(h);
-  if (find(h, oid)) {
-    unlock(h);
-    return -2;
+  Entry* prev = find(h, oid);
+  if (prev) {
+    if (prev->state == kCreating) {
+      // orphaned create: ids are single-writer, so a kCreating entry for a
+      // new create means the previous writer died mid-put (the robust mutex
+      // already recovered the lock). Reclaim and start over.
+      add_hole(h, prev->offset, prev->size);
+      h->used -= prev->size;
+      prev->state = kFree;
+    } else {
+      unlock(h);
+      return -2;
+    }
   }
   if (size > h->capacity) {
     unlock(h);

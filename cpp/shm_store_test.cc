@@ -1,0 +1,129 @@
+// C-level test for the shm arena store: create/seal/get/release/delete,
+// eviction under pressure, pin semantics, hole coalescing, multi-process
+// sharing through fork. Exits 0 on success; any failed check aborts.
+//
+// Build+run (also driven by tests/test_shm_arena.py):
+//   g++ -O2 -o shm_store_test shm_store_test.cc -ldl -lpthread && ./shm_store_test
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dlfcn.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+typedef void* (*open_fn)(const char*, uint64_t, int);
+typedef void (*close_fn)(void*);
+typedef int64_t (*create_fn)(void*, const char*, uint64_t);
+typedef int (*seal_fn)(void*, const char*);
+typedef int64_t (*get_fn)(void*, const char*, uint64_t*);
+typedef int (*rel_fn)(void*, const char*);
+typedef int (*contains_fn)(void*, const char*);
+typedef int (*del_fn)(void*, const char*);
+typedef uint64_t (*used_fn)(void*);
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+int main(int argc, char** argv) {
+  const char* libpath = argc > 1 ? argv[1] : "./libshmstore.so";
+  const char* arena = argc > 2 ? argv[2] : "/dev/shm/rtpu_test_arena";
+  unlink(arena);
+
+  void* dl = dlopen(libpath, RTLD_NOW);
+  CHECK(dl != nullptr);
+  auto store_open = (open_fn)dlsym(dl, "rtpu_store_open");
+  auto store_close = (close_fn)dlsym(dl, "rtpu_store_close");
+  auto store_create = (create_fn)dlsym(dl, "rtpu_store_create");
+  auto store_seal = (seal_fn)dlsym(dl, "rtpu_store_seal");
+  auto store_get = (get_fn)dlsym(dl, "rtpu_store_get");
+  auto store_release = (rel_fn)dlsym(dl, "rtpu_store_release");
+  auto store_contains = (contains_fn)dlsym(dl, "rtpu_store_contains");
+  auto store_delete = (del_fn)dlsym(dl, "rtpu_store_delete");
+  auto store_used = (used_fn)dlsym(dl, "rtpu_store_used");
+  CHECK(store_open && store_create && store_seal && store_get);
+
+  // 1 MiB arena
+  void* s = store_open(arena, 1 << 20, 1);
+  CHECK(s != nullptr);
+
+  // basic create/seal/get roundtrip
+  int64_t off = store_create(s, "obj_a", 1000);
+  CHECK(off > 0);
+  CHECK(store_contains(s, "obj_a") == 0);  // not sealed yet
+  CHECK(store_seal(s, "obj_a") == 0);
+  CHECK(store_contains(s, "obj_a") == 1);
+  uint64_t sz = 0;
+  int64_t goff = store_get(s, "obj_a", &sz);
+  CHECK(goff == off && sz == 1000);
+  CHECK(store_create(s, "obj_a", 10) == -2);  // duplicate
+  CHECK(store_release(s, "obj_a") == 0);
+
+  // delete frees space
+  uint64_t used0 = store_used(s);
+  CHECK(store_delete(s, "obj_a") == 0);
+  CHECK(store_used(s) == used0 - 1000);
+  CHECK(store_contains(s, "obj_a") == 0);
+
+  // eviction: fill the arena with unpinned objects, then demand more
+  for (int i = 0; i < 7; i++) {
+    char oid[32];
+    snprintf(oid, sizeof oid, "fill_%d", i);
+    CHECK(store_create(s, oid, 128 * 1024) > 0);
+    CHECK(store_seal(s, oid) == 0);
+  }
+  // 7*128K = 896K used; another 256K must evict the two oldest
+  CHECK(store_create(s, "big", 256 * 1024) > 0);
+  CHECK(store_seal(s, "big") == 0);
+  CHECK(store_contains(s, "fill_0") == 0);  // LRU-evicted
+  CHECK(store_contains(s, "big") == 1);
+
+  // pinned objects survive eviction pressure
+  uint64_t bsz;
+  CHECK(store_get(s, "big", &bsz) > 0);  // pin
+  for (int i = 0; i < 10; i++) {
+    char oid[32];
+    snprintf(oid, sizeof oid, "press_%d", i);
+    int64_t r = store_create(s, oid, 128 * 1024);
+    if (r > 0) store_seal(s, oid);
+  }
+  CHECK(store_contains(s, "big") == 1);  // still pinned, never evicted
+  CHECK(store_release(s, "big") == 0);
+
+  // deferred delete: delete-while-pinned reclaims at release
+  CHECK(store_create(s, "pinned", 1024) > 0);
+  CHECK(store_seal(s, "pinned") == 0);
+  CHECK(store_get(s, "pinned", &sz) > 0);
+  CHECK(store_delete(s, "pinned") == 0);
+  CHECK(store_contains(s, "pinned") == 0);      // gone from the index
+  uint64_t used1 = store_used(s);
+  CHECK(store_release(s, "pinned") == 0);       // space returns now
+  CHECK(store_used(s) == used1 - 1024);
+
+  // cross-process: child writes, parent reads the same arena
+  pid_t pid = fork();
+  if (pid == 0) {
+    void* cs = store_open(arena, 1 << 20, 0);
+    if (!cs) _exit(2);
+    int64_t o = store_create(cs, "from_child", 64);
+    if (o <= 0) _exit(3);
+    if (store_seal(cs, "from_child") != 0) _exit(4);
+    store_close(cs);
+    _exit(0);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  CHECK(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  CHECK(store_contains(s, "from_child") == 1);
+
+  store_close(s);
+  unlink(arena);
+  printf("shm_store_test: all checks passed\n");
+  return 0;
+}

@@ -46,6 +46,7 @@ def is_initialized() -> bool:
 
 def init(
     *,
+    address: str | None = None,
     local_mode: bool = False,
     num_cpus: float | None = None,
     num_tpus: float | None = None,
@@ -54,7 +55,10 @@ def init(
     max_workers: int = 16,
     ignore_reinit_error: bool = True,
 ):
-    """Start (or connect to) a session. Returns a context dict."""
+    """Start a new session, or join an existing one with `address=` (a GCS
+    `host:port` / `unix:<path>`, or env RAY_TPU_ADDRESS — how submitted jobs
+    and remote drivers attach; reference: ray.init(address=...)).
+    Returns a context dict."""
     global _node, _worker
     with _lock:
         if _worker is not None:
@@ -65,6 +69,12 @@ def init(
             _worker = LocalWorker()
             set_global_worker(None)
             return {"session_id": "local"}
+        address = address or os.environ.get("RAY_TPU_ADDRESS")
+        if address:
+            _worker = CoreWorker(address, os.environ.get("RAY_TPU_SESSION"),
+                                 kind="driver")
+            atexit.register(shutdown)
+            return {"session_id": _worker.session_id, "address": address}
         _node = Node(
             num_cpus=num_cpus,
             num_tpus=num_tpus,

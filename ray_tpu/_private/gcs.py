@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Callable
 
-from ray_tpu._private import pg_policy
+from ray_tpu._private import accelerators, pg_policy
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
 
 logger = logging.getLogger(__name__)
@@ -37,13 +37,19 @@ logger = logging.getLogger(__name__)
 INLINE_LIMIT = 64 * 1024  # results smaller than this are stored in the GCS table
 
 DEFAULT_NODE = "node-0"
+HEAD_HOST = "host-0"
+# chip spawns can block minutes in TPU plugin init; plain spawns are fast
+SPAWN_TIMEOUT_S = 60.0
+CHIP_SPAWN_TIMEOUT_S = 300.0
 
 
 class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
-                 "running_tasks", "node_id")
+                 "running_tasks", "node_id", "tpu_chips", "host_id")
 
-    def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str):
+    def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
+                 tpu_chips: tuple = (), host_id: str = "host-0"):
+        self.host_id = host_id
         self.wid = wid
         self.conn = conn
         self.pid = pid
@@ -53,6 +59,9 @@ class _Worker:
         self.running_tasks: dict[str, dict] = {}  # task_id → spec (GCS-side)
         self.actor_id: str | None = None
         self.dead = False
+        # chips bound to this process at spawn via TPU_VISIBLE_CHIPS; fixed
+        # for the process lifetime (jax backend init reads env once)
+        self.tpu_chips = tuple(tpu_chips)
 
 
 class _Actor:
@@ -84,7 +93,7 @@ class _VNode:
     the in-process multi-node harness is how the reference tests multi-node,
     SURVEY.md §4.2.)"""
 
-    __slots__ = ("node_id", "total", "available", "labels", "alive")
+    __slots__ = ("node_id", "total", "available", "labels", "alive", "chip_pool")
 
     def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
         self.node_id = node_id
@@ -92,6 +101,10 @@ class _VNode:
         self.available = dict(self.total)
         self.labels = dict(labels or {})
         self.alive = True
+        # unbound TPU chip ids; chips leave the pool when a worker is spawned
+        # with them visible and return when that worker dies (reference:
+        # TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36)
+        self.chip_pool: list[int] = list(range(int(self.total.get("TPU", 0.0))))
 
 
 class _Bundle:
@@ -129,11 +142,13 @@ class GcsServer:
         self,
         socket_path: str,
         total_resources: dict[str, float],
-        spawn_worker_cb: Callable[[int, str], None],
+        spawn_worker_cb: Callable[[int, str, list], None],
         max_workers: int = 32,
         node_labels: dict | None = None,
+        session_id: str = "",
     ):
         self.socket_path = socket_path
+        self.session_id = session_id
         self.lock = threading.RLock()
         self.spawn_worker_cb = spawn_worker_cb
         self.max_workers = max_workers
@@ -142,6 +157,10 @@ class GcsServer:
             DEFAULT_NODE: _VNode(DEFAULT_NODE, total_resources, node_labels)
         }
         self.local_node_id = DEFAULT_NODE
+        # cross-host state (reference: gcs_node_manager.h:47 node registry +
+        # ownership_object_directory.h locations). "host-0" is the head.
+        self.hosts: dict[str, dict] = {HEAD_HOST: {"object_addr": None, "conn": None}}
+        self.node_hosts: dict[str, str] = {}  # node_id → host_id (default head)
 
         self.objects: dict[str, dict] = {}
         self.object_waiters: dict[str, list[tuple[MsgConnection, int]]] = {}
@@ -186,8 +205,25 @@ class GcsServer:
 
     def start(self):
         self._listener = listen_unix(self.socket_path)
-        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True, name="gcs-accept")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(self._listener,), daemon=True,
+            name="gcs-accept")
         self._accept_thread.start()
+        # always also listen on TCP so follower hosts / remote drivers can
+        # join (reference capability: gRPC control plane, rpc/grpc_server.h).
+        # Loopback by default — the protocol executes pickled code, so only
+        # bind externally (RAY_TPU_BIND_HOST=0.0.0.0) on trusted networks.
+        import os as _os
+
+        from ray_tpu._private.protocol import listen_tcp
+
+        self._tcp_listener = listen_tcp(
+            _os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"), 0)
+        self.tcp_port = self._tcp_listener.getsockname()[1]
+        self._tcp_accept_thread = threading.Thread(
+            target=self._accept_loop, args=(self._tcp_listener,), daemon=True,
+            name="gcs-accept-tcp")
+        self._tcp_accept_thread.start()
 
     def stop(self):
         with self.lock:
@@ -198,22 +234,57 @@ class GcsServer:
                         w.conn.send({"type": "exit"})
                     except ConnectionClosed:
                         pass
-        if self._listener is not None:
+        # Wake the accept threads WITHOUT closing the fds: close() here would
+        # free the fd numbers while the accept threads may be entering
+        # accept(2), and a new session's listener can reuse those numbers —
+        # the stale thread then steals the new listener's connections and
+        # serves them with this stopped GCS (observed: drivers registering
+        # into a dead session and hanging). shutdown() unblocks accept but
+        # keeps the fd allocated; the owning accept thread closes it.
+        import socket as _socket
+
+        for listener in (self._listener, getattr(self, "_tcp_listener", None)):
+            if listener is not None:
+                try:
+                    listener.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        # belt-and-braces: a no-op connect unblocks accept() even where
+        # shutdown() on a listening socket doesn't
+        try:
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.settimeout(0.2)
+            s.connect(self.socket_path)
+            s.close()
+        except OSError:
+            pass
+        if getattr(self, "tcp_port", None):
             try:
-                self._listener.close()
+                s = _socket.create_connection(("127.0.0.1", self.tcp_port), timeout=0.2)
+                s.close()
             except OSError:
                 pass
 
-    def _accept_loop(self):
+    def _accept_loop(self, listener):
         while not self.stopped:
             try:
-                sock, _ = self._listener.accept()
+                sock, _ = listener.accept()
             except OSError:
-                return
+                break
+            if self.stopped:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                break
             conn = MsgConnection(sock)
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True, name="gcs-conn")
             t.start()
             self._conn_threads.append(t)
+        try:
+            listener.close()  # sole closer: no fd reuse while accept may run
+        except OSError:
+            pass
 
     def _serve_conn(self, conn: MsgConnection):
         wid = None
@@ -235,6 +306,10 @@ class GcsServer:
         except ConnectionClosed:
             if wid is not None:
                 self._on_worker_death(wid)
+            host_id = next((h for h, info in self.hosts.items()
+                            if info.get("conn") is conn), None)
+            if host_id is not None:
+                self._remove_host(host_id)
 
     # --------------------------------------------------------------- dispatch
 
@@ -244,11 +319,82 @@ class GcsServer:
             with self.lock:
                 wid = msg["wid"]
                 node_id = msg.get("node_id") or DEFAULT_NODE
-                self.workers[wid] = _Worker(wid, conn, msg.get("pid", 0), msg["kind"], node_id)
-                if msg["kind"] == "worker" and self._spawn_pending[node_id]:
-                    self._spawn_pending[node_id].popleft()
+                chips = tuple(msg.get("tpu_chips") or ())
+                accepted = True
+                if msg["kind"] == "worker":
+                    # retire the spawn-accounting entry for this worker,
+                    # matching by chip assignment so a chip spawn isn't
+                    # credited to a plain-CPU registration (or vice versa)
+                    dq = self._spawn_pending[node_id]
+                    for i, (_, c) in enumerate(dq):
+                        if tuple(c or ()) == chips:
+                            del dq[i]
+                            break
+                    else:
+                        if chips:
+                            # no pending entry: this chip spawn was presumed
+                            # failed and its chips refunded. Accept only if
+                            # the chips are still unbound — otherwise another
+                            # worker holds them and admitting this one would
+                            # double-bind the physical chips.
+                            node = self.nodes.get(node_id)
+                            pool = node.chip_pool if (node and node.alive) else []
+                            if all(c in pool for c in chips):
+                                for c in chips:
+                                    pool.remove(c)
+                            else:
+                                accepted = False
+                        elif dq:
+                            dq.popleft()
+                if accepted:
+                    self.workers[wid] = _Worker(
+                        wid, conn, msg.get("pid", 0), msg["kind"], node_id,
+                        tpu_chips=chips, host_id=msg.get("host") or HEAD_HOST)
+            if not accepted:
+                conn.send({"rid": msg["rid"], "ok": False,
+                           "error": "stale chip binding; exit"})
+                try:
+                    conn.send({"type": "exit"})
+                except ConnectionClosed:
+                    pass
+                return None
             conn.send({"rid": msg["rid"], "ok": True})
             self._schedule()
+            return wid
+        if t == "get_session":
+            conn.send({"rid": msg["rid"], "session_id": self.session_id})
+            return wid
+        if t == "register_host":
+            with self.lock:
+                host_id = msg["host_id"]
+                node_id = msg.get("node_id") or host_id
+                self.hosts[host_id] = {
+                    "object_addr": msg.get("object_addr"), "conn": conn}
+                self.node_hosts[node_id] = host_id
+                self.nodes[node_id] = _VNode(
+                    node_id, msg["resources"], msg.get("labels"))
+            conn.send({"rid": msg["rid"], "ok": True,
+                       "session_id": self.session_id})
+            self._schedule()
+            return wid
+        if t == "log_line":
+            # fan out to every driver (reference: log_monitor republishing
+            # worker logs to drivers via GCS pubsub)
+            with self.lock:
+                drivers = [w.conn for w in self.workers.values()
+                           if w.kind == "driver" and not w.dead]
+            for dconn in drivers:
+                try:
+                    dconn.send({"type": "log_line", "source": msg["source"],
+                                "line": msg["line"]})
+                except ConnectionClosed:
+                    pass
+            return wid
+        if t == "object_locations":
+            with self.lock:
+                entry = self.objects.get(msg["oid"]) or {}
+                locs = self._object_locations_locked(entry)
+            conn.send({"rid": msg["rid"], "locations": locs})
             return wid
         if t == "submit_task":
             self._submit_task(msg["spec"])
@@ -258,7 +404,7 @@ class GcsServer:
         elif t == "object_put":
             self._on_object_ready(msg["oid"], where=msg.get("where", "shm"),
                                   inline=msg.get("inline"), size=msg.get("size", 0),
-                                  is_error=False)
+                                  is_error=False, host=msg.get("host") or HEAD_HOST)
         elif t == "wait_object":
             self._wait_object(conn, msg)
         elif t == "free_objects":
@@ -366,13 +512,23 @@ class GcsServer:
 
     # --------------------------------------------------------------- objects
 
-    def _on_object_ready(self, oid: str, where: str, inline, size: int, is_error: bool):
+    def _on_object_ready(self, oid: str, where: str, inline, size: int,
+                         is_error: bool, host: str = HEAD_HOST):
         with self.lock:
+            prev = self.objects.get(oid)
+            if (prev is not None and prev["status"] == "ready"
+                    and prev["where"] == "shm" and where == "shm"):
+                # an additional shm copy on another host: extend the location
+                # set, keep the entry (reference: object directory adding a
+                # location, ownership_object_directory.h)
+                prev.setdefault("hosts", set()).add(host)
+                return
             self.objects[oid] = {
                 "status": "error" if is_error else "ready",
                 "where": where,
                 "inline": inline,
                 "size": size,
+                "hosts": {host} if where == "shm" else set(),
             }
             waiters = self.object_waiters.pop(oid, [])
             entry = self.objects[oid]
@@ -380,11 +536,18 @@ class GcsServer:
             self._reply_object(conn, rid, entry)
         self._schedule()
 
+    def _object_locations_locked(self, entry: dict) -> list:
+        return [(h, self.hosts[h]["object_addr"])
+                for h in entry.get("hosts", ()) if h in self.hosts]
+
     def _reply_object(self, conn: MsgConnection, rid: int, entry: dict):
+        with self.lock:
+            locs = self._object_locations_locked(entry)
         try:
             conn.send({
                 "rid": rid, "ready": True, "status": entry["status"],
                 "where": entry["where"], "inline": entry["inline"], "size": entry["size"],
+                "locations": locs,
             })
         except ConnectionClosed:
             pass
@@ -513,7 +676,7 @@ class GcsServer:
     def _schedule(self):
         """Dispatch whatever can run; request worker scale-up for the rest."""
         to_send: list[tuple[MsgConnection, dict]] = []
-        want_spawn: collections.Counter = collections.Counter()
+        want_spawn: collections.Counter = collections.Counter()  # (node, n_chips) → demand
         with self.lock:
             if self.stopped:
                 return
@@ -527,10 +690,16 @@ class GcsServer:
                 node_id = self._fits_for(spec)
                 if node_id is None or not self._deps_ready(spec):
                     return False
-                if not idle_by_node.get(node_id):
-                    want_spawn[node_id] += 1
+                # whole-chip TPU specs need a worker spawned with exactly
+                # that many chips visible; CPU specs need a chipless worker
+                # (a chip worker must stay free for TPU demand)
+                need = accelerators.chips_required(spec.get("resources", {}))
+                pool = idle_by_node.get(node_id, [])
+                w = next((x for x in pool if len(x.tpu_chips) == need), None)
+                if w is None:
+                    want_spawn[(node_id, need)] += 1
                     return False
-                w = idle_by_node[node_id].pop()
+                pool.remove(w)
                 self._acquire_for(spec, node_id)
                 w.idle = False
                 w.running_tasks[spec["task_id"]] = spec
@@ -572,31 +741,109 @@ class GcsServer:
                     w.running_tasks[spec["task_id"]] = spec
                     to_send.append((w.conn, {"type": "exec", "spec": spec}))
 
-            # scale-up: runnable-if-only-there-were-workers, per node
+            # scale-up: runnable-if-only-there-were-workers, per (node, chips)
             now = time.monotonic()
             n_workers = sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead)
             spawning_total = 0
             for node_id, dq in self._spawn_pending.items():
-                while dq and now - dq[0] > 60.0:
+                while dq:
+                    ts, chips = dq[0]
+                    limit = CHIP_SPAWN_TIMEOUT_S if chips else SPAWN_TIMEOUT_S
+                    if now - ts <= limit:
+                        break
                     dq.popleft()  # spawn presumed failed; allow retry
+                    if chips:
+                        node = self.nodes.get(node_id)
+                        if node is not None and node.alive:
+                            node.chip_pool.extend(chips)
                 spawning_total += len(dq)
-            spawn_plan: list[tuple[str, int]] = []
+            spawn_plan: list[tuple[str, list]] = []  # node_id, [chips|None per worker]
+            reclaim: list[_Worker] = []
             headroom = self.max_workers - n_workers - spawning_total
-            for node_id, demand in want_spawn.items():
-                spawning_here = len(self._spawn_pending[node_id])
-                n = max(0, min(demand - spawning_here, headroom))
-                if n > 0:
-                    headroom -= n
-                    self._spawn_pending[node_id].extend([now] * n)
-                    spawn_plan.append((node_id, n))
+            for (node_id, need), demand in want_spawn.items():
+                spawning_here = sum(
+                    1 for _, c in self._spawn_pending[node_id]
+                    if len(c or ()) == need)
+                want = demand - spawning_here
+                if want <= 0:
+                    continue
+                node = self.nodes.get(node_id)
+                # free headroom and/or chips by retiring idle workers whose
+                # binding can't serve this demand (a process can't change
+                # its visible chips after jax backend init)
+                short_headroom = want - headroom
+                short_chips = (need > 0 and node is not None
+                               and len(node.chip_pool) < need * want)
+                if short_headroom > 0 or short_chips:
+                    got = self._reclaim_mismatched_idle_locked(
+                        node_id, need, max(short_headroom, want))
+                    headroom += len(got)
+                    reclaim.extend(got)
+                n = max(0, min(want, headroom))
+                if n <= 0:
+                    continue
+                assignments: list = []
+                for _ in range(n):
+                    if need == 0:
+                        assignments.append(None)
+                        continue
+                    if node is None or not node.alive or len(node.chip_pool) < need:
+                        break
+                    chips = tuple(node.chip_pool[:need])
+                    del node.chip_pool[:need]
+                    assignments.append(chips)
+                if assignments:
+                    headroom -= len(assignments)
+                    self._spawn_pending[node_id].extend((now, c) for c in assignments)
+                    spawn_plan.append((node_id, assignments))
+            agent_sends = []
+            for node_id, assignments in spawn_plan:
+                host = self.node_hosts.get(node_id, HEAD_HOST)
+                agent_conn = self.hosts.get(host, {}).get("conn")
+                if agent_conn is not None:
+                    agent_sends.append((agent_conn, node_id, assignments))
+            spawn_plan = [(nid, a) for nid, a in spawn_plan
+                          if self.hosts.get(self.node_hosts.get(nid, HEAD_HOST), {}).get("conn") is None]
 
         for conn, msg in to_send:
             try:
                 conn.send(msg)
             except ConnectionClosed:
                 pass
-        for node_id, n in spawn_plan:
-            self.spawn_worker_cb(n, node_id)
+        for w in reclaim:
+            try:
+                w.conn.send({"type": "exit"})
+            except ConnectionClosed:
+                pass
+        for agent_conn, node_id, assignments in agent_sends:
+            try:
+                agent_conn.send({"type": "spawn_workers", "node_id": node_id,
+                                 "assignments": assignments})
+            except ConnectionClosed:
+                pass
+        for node_id, assignments in spawn_plan:
+            self.spawn_worker_cb(len(assignments), node_id, assignments)
+
+    def _reclaim_mismatched_idle_locked(self, node_id: str, need: int,
+                                        max_count: int) -> list[_Worker]:
+        """Retire idle workers on a node whose chip binding differs from the
+        wanted one (chip workers blocking CPU demand, or CPU/odd-size chip
+        workers blocking chip demand). Runs after all dispatch for this
+        round, so anything still idle here failed to match current demand.
+        Caller sends the exit messages."""
+        out: list[_Worker] = []
+        node = self.nodes.get(node_id)
+        for w in self.workers.values():
+            if len(out) >= max_count:
+                break
+            if (w.kind == "worker" and not w.dead and w.idle
+                    and w.actor_id is None and w.node_id == node_id
+                    and len(w.tpu_chips) != need):
+                w.dead = True
+                if w.tpu_chips and node is not None and node.alive:
+                    node.chip_pool.extend(w.tpu_chips)
+                out.append(w)
+        return out
 
     def _on_task_done(self, msg: dict):
         wid = msg["wid"]
@@ -656,11 +903,14 @@ class GcsServer:
                 "worker": wid, "error": error, "ts": time.time(),
             })
 
-            # record results
+            # record results, with the producing host as the shm location so
+            # cross-host consumers know where to pull from
+            host = w.host_id if w is not None else HEAD_HOST
             for oid, where, inline, size in msg.get("results", ()):
                 self.objects[oid] = {
                     "status": "error" if error is not None else "ready",
                     "where": where, "inline": inline, "size": size,
+                    "hosts": {host} if where == "shm" else set(),
                 }
                 for conn, rid in self.object_waiters.pop(oid, []):
                     self._reply_object(conn, rid, self.objects[oid])
@@ -871,6 +1121,23 @@ class GcsServer:
 
     # ----------------------------------------------------------------- nodes
 
+    def set_head_object_addr(self, addr: str) -> None:
+        with self.lock:
+            self.hosts[HEAD_HOST]["object_addr"] = addr
+
+    def _remove_host(self, host_id: str):
+        """A follower host's agent connection died: its nodes die with it."""
+        with self.lock:
+            if host_id not in self.hosts or host_id == HEAD_HOST:
+                return
+            self.hosts.pop(host_id, None)
+            doomed_nodes = [n for n, h in self.node_hosts.items() if h == host_id]
+            # drop the host from every object's location set
+            for entry in self.objects.values():
+                entry.get("hosts", set()).discard(host_id)
+        for node_id in doomed_nodes:
+            self._remove_node(node_id)
+
     def _remove_node(self, node_id: str):
         """Mark a virtual node dead: its workers die, its PG bundles unplace."""
         to_fail: list[dict] = []
@@ -927,6 +1194,10 @@ class GcsServer:
             w.dead = True
             if w.kind != "worker":
                 return  # driver death handled by node teardown
+            if w.tpu_chips:
+                node = self.nodes.get(w.node_id)
+                if node is not None and node.alive:
+                    node.chip_pool.extend(w.tpu_chips)
             specs = list(w.running_tasks.values())
             w.running_tasks.clear()
             aid = w.actor_id

@@ -16,26 +16,10 @@ import threading
 import time
 import uuid
 
+from ray_tpu._private import accelerators
+from ray_tpu._private.accelerators import detect_num_tpu_chips  # noqa: F401 (re-export)
 from ray_tpu._private.gcs import GcsServer
 from ray_tpu._private.object_store import ShmObjectStore
-
-
-def detect_num_tpu_chips() -> int:
-    """TPU chip count without importing jax (reference:
-    python/ray/_private/accelerators/tpu.py:100 chips-per-host logic — there
-    via GKE env vars / GCE metadata; here via env override or device files)."""
-    env = os.environ.get("RAY_TPU_CHIPS")
-    if env:
-        return int(env)
-    try:
-        import glob
-
-        accel = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
-        if accel:
-            return len(accel)
-    except OSError:
-        pass
-    return 0
 
 
 class Node:
@@ -56,12 +40,8 @@ class Node:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.socket_path = os.path.join(self.session_dir, "gcs.sock")
 
-        total = {"CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))}
-        ntpu = num_tpus if num_tpus is not None else detect_num_tpu_chips()
-        if ntpu:
-            total["TPU"] = float(ntpu)
-        if resources:
-            total.update({k: float(v) for k, v in resources.items()})
+        total, labels = accelerators.detect_host_resources(
+            num_cpus, num_tpus, resources, labels)
         self.total_resources = total
 
         self._procs: list[subprocess.Popen] = []
@@ -72,8 +52,26 @@ class Node:
             spawn_worker_cb=self._spawn_workers,
             max_workers=max_workers,
             node_labels=labels,
+            session_id=self.session_id,
         )
         self.gcs.start()
+        # the head host's object-plane server: follower hosts pull shm
+        # objects from here (and vice versa) over chunked TCP
+        from ray_tpu._private.object_store import make_object_store
+        from ray_tpu._private.object_transfer import ObjectPlaneServer
+
+        self.object_server = ObjectPlaneServer(make_object_store(self.session_id))
+        self.gcs.set_head_object_addr(self.object_server.address)
+        # cross-host control-plane address (follower agents, remote drivers)
+        self.address = f"127.0.0.1:{self.gcs.tcp_port}"
+        # stream worker logs to the driver's stderr (reference:
+        # _private/log_monitor.py); RAY_TPU_LOG_TO_DRIVER=0 disables
+        self.log_monitor = None
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            self.log_monitor = LogMonitor(
+                os.path.join(self.session_dir, "logs")).start()
         # wait for socket
         for _ in range(500):
             if os.path.exists(self.socket_path):
@@ -82,28 +80,41 @@ class Node:
         if num_workers:
             now = time.monotonic()
             # counted before spawn to avoid a register race
-            self.gcs._spawn_pending["node-0"].extend([now] * num_workers)
+            self.gcs._spawn_pending["node-0"].extend([(now, None)] * num_workers)
             self._spawn_workers(num_workers, "node-0")
 
-    def _spawn_workers(self, n: int, node_id: str = "node-0"):
-        env = dict(os.environ)
-        env["RAY_TPU_SOCKET"] = self.socket_path
-        env["RAY_TPU_SESSION"] = self.session_id
-        env["RAY_TPU_NODE_ID"] = node_id
-        # Workers run CPU jax: the driver owns the TPU chip(s). Hard-set (not
-        # setdefault) because the host env may preset JAX_PLATFORMS to the TPU
-        # platform, and two processes must not fight over one chip
-        # (reference: TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36).
-        platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
-        env["JAX_PLATFORMS"] = platform
-        if platform == "cpu":
-            # CPU workers must not register a TPU-plugin session at interpreter
-            # start (sitecustomize triggers on this env var): the per-process
-            # registration dials the device-pool relay, and a worker blocking
-            # on (or wedging) the single-chip grant takes the whole pool down.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+    def _spawn_workers(self, n: int, node_id: str = "node-0", chip_assignments=None):
+        """Spawn n workers; chip_assignments[i] is a tuple of chip ids (the
+        worker owns those chips via TPU_VISIBLE_CHIPS and runs real-TPU jax)
+        or None (plain CPU worker)."""
+        base = dict(os.environ)
+        base["RAY_TPU_SOCKET"] = self.socket_path
+        base["RAY_TPU_SESSION"] = self.session_id
+        base["RAY_TPU_NODE_ID"] = node_id
         with self._spawn_lock:
-            for _ in range(n):
+            for i in range(n):
+                chips = chip_assignments[i] if chip_assignments else None
+                env = dict(base)
+                if chips:
+                    # chip worker: keep the host's TPU platform env (incl.
+                    # device-pool vars) and restrict it to its chip subset
+                    # before any jax import in the child
+                    # (reference: TPU_VISIBLE_CHIPS, accelerators/tpu.py:36)
+                    accelerators.apply_chip_env(env, chips)
+                else:
+                    # CPU workers must not own the chip: hard-set (not
+                    # setdefault) because the host env may preset
+                    # JAX_PLATFORMS to the TPU platform, and two processes
+                    # must not fight over one chip.
+                    platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+                    env["JAX_PLATFORMS"] = platform
+                    if platform == "cpu":
+                        # CPU workers must not register a TPU-plugin session
+                        # at interpreter start (sitecustomize triggers on this
+                        # env var): the per-process registration dials the
+                        # device-pool relay, and a worker blocking on (or
+                        # wedging) the single-chip grant takes the pool down.
+                        env.pop("PALLAS_AXON_POOL_IPS", None)
                 log = open(os.path.join(self.session_dir, "logs", f"worker-{len(self._procs)}.log"), "ab")
                 try:
                     p = subprocess.Popen(
@@ -118,6 +129,9 @@ class Node:
                 self._procs.append(p)
 
     def shutdown(self):
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
+        self.object_server.stop()
         self.gcs.stop()
         deadline = time.monotonic() + 3.0
         for p in self._procs:

@@ -1,0 +1,159 @@
+"""Follower-host agent: joins a remote GCS and hosts a local worker pool.
+
+`python -m ray_tpu._private.node_agent --address <gcs host:port> [...]`
+
+Plays the reference raylet's cluster-facing role on a non-head machine:
+registers the host and its resources with the GCS over TCP, spawns worker
+processes on demand when the GCS asks, runs the host's object-plane server
+(chunked TCP pulls from the local shm store), and forwards worker log lines
+to the GCS for driver-side streaming
+(reference capability: raylet registration gcs_node_manager.h:47 + worker
+pool worker_pool.h:280 + object manager object_manager.h:128 + log monitor
+_private/log_monitor.py, collapsed into one agent process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from ray_tpu._private import accelerators
+from ray_tpu._private.log_monitor import LogMonitor
+from ray_tpu._private.object_store import make_object_store
+from ray_tpu._private.object_transfer import ObjectPlaneServer
+from ray_tpu._private.protocol import ConnectionClosed, connect_address
+
+
+class NodeAgent:
+    def __init__(self, *, address: str, host_id: str | None = None,
+                 num_cpus: float | None = None, num_tpus: float | None = None,
+                 resources: dict | None = None, labels: dict | None = None,
+                 session_dir: str | None = None):
+        self.gcs_address = address
+        self.host_id = host_id or f"host-{uuid.uuid4().hex[:8]}"
+        self.conn = connect_address(address)
+        self._rid = 1
+
+        # handshake: learn the session id before anything store-related
+        hello = self._rpc({"type": "get_session"})
+        self.session_id = hello["session_id"]
+
+        # this host's own shm namespace (a real second machine gets this for
+        # free; on one machine the namespace keeps the stores honest-disjoint)
+        self.store_ns = f"{self.session_id}_{self.host_id}"
+        self.store = make_object_store(self.store_ns)
+        self.obj_server = ObjectPlaneServer(self.store)
+
+        base = session_dir or os.path.join("/tmp", "ray_tpu")
+        self.session_dir = os.path.join(
+            base, f"session_{self.session_id}", f"agent_{self.host_id}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+
+        total, labels = accelerators.detect_host_resources(
+            num_cpus, num_tpus, resources, labels)
+
+        self._procs: list[subprocess.Popen] = []
+        self._rpc({
+            "type": "register_host",
+            "host_id": self.host_id,
+            "node_id": self.host_id,  # one vnode per follower host
+            "resources": total,
+            "labels": labels,
+            "object_addr": self.obj_server.address,
+        })
+        self.log_monitor = LogMonitor(
+            os.path.join(self.session_dir, "logs"), sink=self._forward_log).start()
+
+    def _rpc(self, msg: dict) -> dict:
+        msg["rid"] = self._rid
+        self._rid += 1
+        self.conn.send(msg)
+        while True:
+            reply = self.conn.recv()
+            if reply.get("rid") == msg["rid"]:
+                return reply
+            self._dispatch(reply)
+
+    def _forward_log(self, source: str, line: str):
+        try:
+            self.conn.send({"type": "log_line",
+                            "source": f"{self.host_id}/{source}", "line": line})
+        except ConnectionClosed:
+            pass
+
+    def serve_forever(self):
+        try:
+            while True:
+                self._dispatch(self.conn.recv())
+        except ConnectionClosed:
+            pass
+        finally:
+            self.shutdown()
+
+    def _dispatch(self, msg: dict):
+        t = msg.get("type")
+        if t == "spawn_workers":
+            self._spawn_workers(msg["assignments"], msg.get("node_id", self.host_id))
+        elif t == "exit":
+            raise ConnectionClosed()
+
+    def _spawn_workers(self, assignments: list, node_id: str):
+        base = dict(os.environ)
+        base["RAY_TPU_ADDRESS"] = self.gcs_address
+        base["RAY_TPU_SESSION"] = self.session_id
+        base["RAY_TPU_NODE_ID"] = node_id
+        base["RAY_TPU_HOST_ID"] = self.host_id
+        base["RAY_TPU_STORE_NS"] = self.store_ns
+        for chips in assignments:
+            env = dict(base)
+            if chips:
+                accelerators.apply_chip_env(env, chips)
+            else:
+                platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+                env["JAX_PLATFORMS"] = platform
+                if platform == "cpu":
+                    env.pop("PALLAS_AXON_POOL_IPS", None)
+            log = open(os.path.join(self.session_dir, "logs",
+                                    f"worker-{len(self._procs)}.log"), "ab")
+            try:
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=os.getcwd())
+            finally:
+                log.close()
+            self._procs.append(p)
+
+    def shutdown(self):
+        self.log_monitor.stop()
+        self.obj_server.stop()
+        deadline = time.monotonic() + 3.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.store.cleanup_session()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--address", required=True, help="GCS address host:port or unix:<path>")
+    p.add_argument("--host-id", default=None)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    args = p.parse_args(argv)
+    agent = NodeAgent(address=args.address, host_id=args.host_id,
+                      num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    print(f"node agent {agent.host_id} joined {args.address} "
+          f"(objects at {agent.obj_server.address})", flush=True)
+    agent.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,17 @@ from typing import Iterable
 SHM_DIR = "/dev/shm"
 
 
+def make_object_store(session_id: str):
+    """Backend selector: RAY_TPU_STORE_BACKEND=arena uses the native C++
+    arena (bounded capacity + LRU eviction, cpp/shm_store.cc); the default
+    is one tmpfs file per object."""
+    if os.environ.get("RAY_TPU_STORE_BACKEND") == "arena":
+        from ray_tpu._private.shm_arena import ArenaStore
+
+        return ArenaStore(session_id)
+    return ShmObjectStore(session_id)
+
+
 class PlasmaObject:
     """A sealed object: keeps the mmap alive while consumers hold views."""
 

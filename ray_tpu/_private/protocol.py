@@ -92,3 +92,39 @@ def listen_unix(path: str) -> socket.socket:
     sock.bind(path)
     sock.listen(256)
     return sock
+
+
+def connect_tcp(host: str, port: int, timeout: float = 30.0) -> MsgConnection:
+    """TCP variant of the framed connection — the cross-host control plane
+    (reference capability: gRPC services, src/ray/rpc/grpc_server.h)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return MsgConnection(sock)
+
+
+def listen_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+    """Listening TCP socket; port 0 picks a free port (read via getsockname)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(256)
+    return sock
+
+
+def parse_address(address: str) -> tuple[str, str | tuple[str, int]]:
+    """'unix:<path>' → ("unix", path); 'host:port' or 'tcp:host:port' →
+    ("tcp", (host, port))."""
+    if address.startswith("unix:"):
+        return "unix", address[len("unix:"):]
+    if address.startswith("tcp:"):
+        address = address[len("tcp:"):]
+    host, _, port = address.rpartition(":")
+    return "tcp", (host or "127.0.0.1", int(port))
+
+
+def connect_address(address: str, timeout: float = 30.0) -> MsgConnection:
+    kind, target = parse_address(address)
+    if kind == "unix":
+        return connect_unix(target, timeout)
+    return connect_tcp(target[0], target[1], timeout)

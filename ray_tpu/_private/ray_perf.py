@@ -1,0 +1,118 @@
+"""Core runtime microbenchmarks — `python -m ray_tpu._private.ray_perf`.
+
+Measures the same op classes as the reference's `ray microbenchmark`
+(reference: python/ray/_private/ray_perf.py:95-330 — put/get latency, task
+throughput sync/async, 1:1/1:n actor calls) and writes MICROBENCH.json at the
+repo root so numbers are committed and compared round-over-round
+(VERDICT.md round-1 item 7).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, multiplier: int = 1, min_seconds: float = 2.0) -> dict:
+    """Run fn repeatedly for >= min_seconds, report ops/s (fn = 1*multiplier ops)."""
+    fn()  # warmup
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_seconds:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    ops = count * multiplier / dt
+    rec = {"name": name, "ops_per_s": round(ops, 1),
+           "us_per_op": round(1e6 / ops, 1)}
+    print(f"{name:48s} {ops:12.1f} ops/s   {1e6 / ops:10.1f} us/op")
+    return rec
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4), num_workers=4, max_workers=8)
+    results = []
+
+    try:
+        # ---- object plane -------------------------------------------------
+        small = b"x" * 1024
+        results.append(timeit(
+            "put_small_1KiB", lambda: ray_tpu.put(small)))
+
+        arr = np.zeros(1 << 18, dtype=np.float64)  # 2 MiB → shm path
+        results.append(timeit(
+            "put_numpy_2MiB", lambda: ray_tpu.put(arr)))
+
+        ref_small = ray_tpu.put(small)
+        results.append(timeit(
+            "get_small_1KiB", lambda: ray_tpu.get(ref_small)))
+
+        ref_big = ray_tpu.put(arr)
+        results.append(timeit(
+            "get_numpy_2MiB_zero_copy", lambda: ray_tpu.get(ref_big)))
+
+        # ---- tasks --------------------------------------------------------
+        @ray_tpu.remote
+        def nop():
+            return b"ok"
+
+        results.append(timeit(
+            "task_sync_roundtrip", lambda: ray_tpu.get(nop.remote())))
+
+        def batch_tasks():
+            ray_tpu.get([nop.remote() for _ in range(100)])
+
+        results.append(timeit(
+            "task_async_batch100", batch_tasks, multiplier=100))
+
+        # ---- actors -------------------------------------------------------
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.remote()
+        ray_tpu.get(a.inc.remote())
+        results.append(timeit(
+            "actor_call_sync_1to1", lambda: ray_tpu.get(a.inc.remote())))
+
+        def actor_batch():
+            ray_tpu.get([a.inc.remote() for _ in range(100)])
+
+        results.append(timeit(
+            "actor_call_async_batch100_1to1", actor_batch, multiplier=100))
+
+        actors = [Counter.remote() for _ in range(4)]
+        ray_tpu.get([x.inc.remote() for x in actors])
+
+        def scatter():
+            ray_tpu.get([x.inc.remote() for x in actors for _ in range(25)])
+
+        results.append(timeit(
+            "actor_call_async_batch100_1toN", scatter, multiplier=100))
+    finally:
+        ray_tpu.shutdown()
+
+    out = {
+        "recorded_at_round": os.environ.get("RAY_TPU_BENCH_ROUND", ""),
+        "results": results,
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "MICROBENCH.json")
+    with open(os.path.abspath(path), "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"\nwrote {os.path.abspath(path)}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""ctypes binding for the native shm arena store (cpp/shm_store.cc).
+
+One mmap'd tmpfs arena per (session, host): the C side owns metadata (index,
+free-list, robust process-shared mutex, LRU eviction, pin counts); Python maps
+the same file MAP_SHARED and reads/writes object bytes at the offsets the C
+side hands out — zero-copy for consumers, exactly like the file-per-object
+backend but with bounded memory and eviction.
+
+(reference capability: src/ray/object_manager/plasma/ — store over dlmalloc'd
+shm with LRU eviction_policy.h:159; here arena+offsets instead of fds.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "cpp", "shm_store.cc")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp", "build")
+_LIB = os.path.join(_LIB_DIR, "libshmstore.so")
+
+_build_lock = threading.Lock()
+_lib = None
+
+DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_CAPACITY", 1 << 30))
+
+
+class ArenaFullError(Exception):
+    """No contiguous run fits even after evicting every unpinned object."""
+
+
+def _ensure_lib() -> ctypes.CDLL:
+    """Build (if missing/stale) and load the native library, once per process."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.abspath(_SRC)
+        lib = os.path.abspath(_LIB)
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
+            os.makedirs(os.path.dirname(lib), exist_ok=True)
+            tmp = lib + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src, "-lpthread"],
+                check=True, capture_output=True)
+            os.replace(tmp, lib)  # atomic: concurrent builders don't collide
+        dll = ctypes.CDLL(lib)
+        dll.rtpu_store_open.restype = ctypes.c_void_p
+        dll.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+        dll.rtpu_store_close.argtypes = [ctypes.c_void_p]
+        dll.rtpu_store_create.restype = ctypes.c_int64
+        dll.rtpu_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        dll.rtpu_store_seal.restype = ctypes.c_int
+        dll.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        dll.rtpu_store_get.restype = ctypes.c_int64
+        dll.rtpu_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        dll.rtpu_store_release.restype = ctypes.c_int
+        dll.rtpu_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        dll.rtpu_store_contains.restype = ctypes.c_int
+        dll.rtpu_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        dll.rtpu_store_size.restype = ctypes.c_int64
+        dll.rtpu_store_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        dll.rtpu_store_delete.restype = ctypes.c_int
+        dll.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        dll.rtpu_store_used.restype = ctypes.c_uint64
+        dll.rtpu_store_used.argtypes = [ctypes.c_void_p]
+        dll.rtpu_store_capacity.restype = ctypes.c_uint64
+        dll.rtpu_store_capacity.argtypes = [ctypes.c_void_p]
+        dll.rtpu_store_num_objects.restype = ctypes.c_uint32
+        dll.rtpu_store_num_objects.argtypes = [ctypes.c_void_p]
+        _lib = dll
+        return dll
+
+
+class _ArenaObject:
+    """A pinned view into the arena; unpins on GC (plasma release)."""
+
+    __slots__ = ("buf", "_store", "_oid", "_released")
+
+    def __init__(self, buf: memoryview, store: "ArenaStore", oid: str):
+        self.buf = buf
+        self._store = store
+        self._oid = oid
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self.buf = None
+            self._store._release(self._oid)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class ArenaStore:
+    """Drop-in for ShmObjectStore, backed by the native arena.
+
+    All processes of a session on one host share one arena file; `get`
+    returns pinned zero-copy views, `put_parts` may evict LRU sealed objects
+    to make room (the file backend instead grows until tmpfs fills).
+    """
+
+    def __init__(self, session_id: str, capacity: int = 0):
+        self.session_id = session_id
+        self.path = os.path.join("/dev/shm", f"rtpu_{session_id}_arena")
+        self._dll = _ensure_lib()
+        cap = capacity or DEFAULT_CAPACITY
+        self._handle = self._dll.rtpu_store_open(self.path.encode(), cap, 1)
+        if not self._handle:
+            raise OSError(f"cannot open shm arena at {self.path}")
+        f = open(self.path, "r+b")
+        try:
+            total = os.fstat(f.fileno()).st_size
+            self._mm = mmap.mmap(f.fileno(), total)
+        finally:
+            f.close()
+        self._lock = threading.Lock()
+
+    # -- interface shared with ShmObjectStore ------------------------------
+
+    def put_parts(self, object_hex: str, parts, total: int) -> int:
+        oid = object_hex.encode()
+        off = self._dll.rtpu_store_create(self._handle, oid, max(total, 1))
+        if off == -2:
+            return total  # already present (idempotent re-put)
+        if off < 0:
+            raise ArenaFullError(
+                f"object {object_hex} ({total} B) does not fit in the arena "
+                f"(capacity {self._dll.rtpu_store_capacity(self._handle)} B, "
+                f"used {self._dll.rtpu_store_used(self._handle)} B)")
+        pos = off
+        for p in parts:
+            n = len(p) if isinstance(p, bytes) else p.nbytes
+            self._mm[pos:pos + n] = p
+            pos += n
+        rc = self._dll.rtpu_store_seal(self._handle, oid)
+        if rc != 0:
+            raise OSError(f"seal({object_hex}) failed: {rc}")
+        return total
+
+    def get(self, object_hex: str) -> _ArenaObject:
+        oid = object_hex.encode()
+        size = ctypes.c_uint64()
+        off = self._dll.rtpu_store_get(self._handle, oid, ctypes.byref(size))
+        if off < 0:
+            raise FileNotFoundError(f"object {object_hex} not in arena (evicted?)")
+        view = memoryview(self._mm)[off:off + size.value]
+        return _ArenaObject(view, self, object_hex)
+
+    def contains(self, object_hex: str) -> bool:
+        return bool(self._dll.rtpu_store_contains(self._handle, object_hex.encode()))
+
+    def size(self, object_hex: str) -> int:
+        n = self._dll.rtpu_store_size(self._handle, object_hex.encode())
+        if n < 0:
+            raise FileNotFoundError(object_hex)
+        return n
+
+    def delete(self, object_hex: str) -> None:
+        self._dll.rtpu_store_delete(self._handle, object_hex.encode())
+
+    def cleanup_session(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- arena-specific ----------------------------------------------------
+
+    def _release(self, object_hex: str) -> None:
+        self._dll.rtpu_store_release(self._handle, object_hex.encode())
+
+    def used(self) -> int:
+        return self._dll.rtpu_store_used(self._handle)
+
+    def capacity(self) -> int:
+        return self._dll.rtpu_store_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return self._dll.rtpu_store_num_objects(self._handle)

@@ -20,8 +20,8 @@ from typing import Any, Sequence
 
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
-from ray_tpu._private.object_store import ShmObjectStore
-from ray_tpu._private.protocol import ConnectionClosed, connect_unix
+from ray_tpu._private.object_store import make_object_store
+from ray_tpu._private.protocol import ConnectionClosed, connect_address
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -87,12 +87,12 @@ class _Future:
 
 
 class CoreWorker:
-    def __init__(self, socket_path: str, session_id: str, kind: str):
+    def __init__(self, address: str, session_id: str | None, kind: str):
         self.kind = kind
-        self.session_id = session_id
         self.wid = WorkerID().hex()
-        self.store = ShmObjectStore(session_id)
-        self.conn = connect_unix(socket_path)
+        if address.startswith("/"):
+            address = f"unix:{address}"
+        self.conn = connect_address(address)
         self._rid = itertools.count(1)
         self._pending: dict[int, _Future] = {}
         self._pending_lock = threading.Lock()
@@ -106,10 +106,26 @@ class CoreWorker:
         self._task_ctx = threading.local()  # per-thread: concurrent actors
         self._alive = True
         self.node_id = os.environ.get("RAY_TPU_NODE_ID", "node-0")
+        self.host_id = os.environ.get("RAY_TPU_HOST_ID", "host-0")
         self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True, name="cw-recv")
         self._recv_thread.start()
-        self.rpc({"type": "register", "wid": self.wid, "kind": kind, "pid": os.getpid(),
-                  "node_id": self.node_id})
+        if session_id is None:
+            # joining an existing cluster by address: learn the session first
+            session_id = self.rpc({"type": "get_session"})["session_id"]
+        self.session_id = session_id
+        # this host's store namespace: followers get their own (a real second
+        # machine is naturally disjoint; on one box the env keeps it honest)
+        self.store = make_object_store(
+            os.environ.get("RAY_TPU_STORE_NS", session_id))
+        self._fetcher = None  # lazy ObjectFetcher for cross-host pulls
+        from ray_tpu._private.accelerators import current_worker_chips
+
+        reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
+                          "pid": os.getpid(), "node_id": self.node_id,
+                          "host": self.host_id,
+                          "tpu_chips": current_worker_chips()})
+        if reply.get("ok") is False:
+            raise RayTpuError(f"registration rejected: {reply.get('error')}")
 
     # ------------------------------------------------------------------- rpc
 
@@ -154,6 +170,9 @@ class CoreWorker:
                 elif msg.get("type") == "kill_actor":
                     if msg["aid"] in self.actors:
                         os._exit(0)
+                elif msg.get("type") == "log_line":
+                    # remote-host worker logs republished via GCS
+                    print(f"({msg['source']}) {msg['line']}", file=sys.stderr)
         except ConnectionClosed:
             self._alive = False
             self.exec_queue.put(None)
@@ -180,7 +199,8 @@ class CoreWorker:
         if len(payload) > ARGS_INLINE_LIMIT:
             oid = ObjectID.for_put().hex()
             self.store.put_parts(oid, [payload], len(payload))
-            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm", "size": len(payload)})
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
+                                "size": len(payload), "host": self.host_id})
             spec_part["args_oid"] = oid
         else:
             spec_part["args"] = payload
@@ -294,13 +314,16 @@ class CoreWorker:
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "inline", "inline": blob, "size": total})
         else:
             self.store.put_parts(oid, parts, total)
-            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm", "size": total})
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
+                                "size": total, "host": self.host_id})
         return ObjectRef(oid)
 
     def _materialize(self, oid: str, reply: dict) -> Any:
         if reply["where"] == "inline":
             value = ser.loads(reply["inline"])
         else:
+            if not self.store.contains(oid):
+                self._pull_remote(oid, reply)
             plasma = self.store.get(oid)
             self._plasma_refs[oid] = plasma
             value = ser.loads(plasma.buf)
@@ -308,6 +331,27 @@ class CoreWorker:
             raise value
         self._memory[oid] = value
         return value
+
+    def _pull_remote(self, oid: str, reply: dict) -> None:
+        """Object is in shm on another host: chunk-pull it into the local
+        store and register the new copy (reference: pull-on-demand,
+        object_manager.h:128)."""
+        from ray_tpu._private.object_transfer import ObjectFetcher
+
+        if self._fetcher is None:
+            self._fetcher = ObjectFetcher(self.store)
+        locations = reply.get("locations") or []
+        for host, addr in locations:
+            if host == self.host_id or not addr:
+                continue
+            if self._fetcher.fetch(oid, addr):
+                self.send_no_reply({"type": "object_put", "oid": oid,
+                                    "where": "shm", "size": reply.get("size", 0),
+                                    "host": self.host_id})
+                return
+        raise RayTpuError(
+            f"object {oid[:12]}… is not in the local store and could not be "
+            f"pulled from {[h for h, _ in locations]}")
 
     def get_object(self, oid: str, timeout: float | None = None) -> Any:
         if oid in self._memory:
@@ -436,7 +480,12 @@ class CoreWorker:
 
     def _resolve_args(self, spec: dict) -> tuple[tuple, dict]:
         if "args_oid" in spec:
-            plasma = self.store.get(spec["args_oid"])
+            oid = spec["args_oid"]
+            if not self.store.contains(oid):
+                # oversized args submitted from another host: pull first
+                reply = self.rpc({"type": "wait_object", "oid": oid}, timeout=300.0)
+                self._pull_remote(oid, reply)
+            plasma = self.store.get(oid)
             args, kwargs = ser.loads(plasma.buf)
         else:
             args, kwargs = ser.loads(spec["args"])

@@ -11,11 +11,13 @@ import sys
 
 
 def main():
-    socket_path = os.environ["RAY_TPU_SOCKET"]
+    # head-host workers get the session unix socket; follower-host workers
+    # (spawned by a node agent) get the GCS TCP address instead
+    address = os.environ.get("RAY_TPU_ADDRESS") or f"unix:{os.environ['RAY_TPU_SOCKET']}"
     session_id = os.environ["RAY_TPU_SESSION"]
     from ray_tpu._private.worker import CoreWorker, set_global_worker
 
-    worker = CoreWorker(socket_path, session_id, kind="worker")
+    worker = CoreWorker(address, session_id, kind="worker")
     set_global_worker(worker)
     code = 0
     try:

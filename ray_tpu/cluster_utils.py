@@ -13,6 +13,7 @@ reference tests multi-node without a cluster, SURVEY.md §4.2.)
 from __future__ import annotations
 
 import itertools
+import os
 
 import ray_tpu
 from ray_tpu._private import api as _api
@@ -23,6 +24,8 @@ class Cluster:
         self._counter = itertools.count(1)
         self.head_args = head_node_args or {}
         self.node_ids: list[str] = []
+        self.host_ids: list[str] = []
+        self._agents: dict = {}
         if initialize_head:
             ray_tpu.init(**self.head_args)
             self.node_ids.append("node-0")
@@ -45,5 +48,59 @@ class Cluster:
         if node_id in self.node_ids:
             self.node_ids.remove(node_id)
 
+    def add_host(self, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
+                 host_id: str | None = None, wait: bool = True,
+                 env: dict | None = None) -> str:
+        """Start a follower-HOST process: a real node agent subprocess with
+        its own shm namespace and worker pool, joined over TCP — the closest
+        one machine gets to a second machine. (reference: cluster_utils
+        add_node runs real raylet processes per node, SURVEY.md §4.2.)"""
+        import subprocess
+        import sys
+        import time
+
+        host_id = host_id or f"host-{next(self._counter)}"
+        node = _api._node
+        assert node is not None, "head must be initialized first"
+        args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+                "--address", node.address, "--host-id", host_id,
+                "--num-cpus", str(num_cpus)]
+        if num_tpus:
+            args += ["--num-tpus", str(num_tpus)]
+        child_env = dict(os.environ)
+        child_env.pop("RAY_TPU_ADDRESS", None)  # agent dials --address
+        if env:
+            child_env.update(env)
+        log = open(os.path.join(node.session_dir, "logs", f"agent-{host_id}.log"), "ab")
+        try:
+            p = subprocess.Popen(args, env=child_env, stdout=log,
+                                 stderr=subprocess.STDOUT, cwd=os.getcwd())
+        finally:
+            log.close()
+        self._agents[host_id] = p
+        if wait:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                nodes = _api._get_worker().list_nodes()
+                if any(n["node_id"] == host_id and n["alive"] for n in nodes):
+                    break
+                time.sleep(0.05)
+            else:
+                raise TimeoutError(f"host {host_id} did not register")
+        self.host_ids.append(host_id)
+        return host_id
+
+    def remove_host(self, host_id: str):
+        """Kill the agent process; the GCS notices the dead connection and
+        fails the host's nodes/workers (host-failure path)."""
+        p = self._agents.pop(host_id, None)
+        if p is not None:
+            p.kill()
+            p.wait(timeout=10)
+        if host_id in self.host_ids:
+            self.host_ids.remove(host_id)
+
     def shutdown(self):
+        for host_id in list(self._agents):
+            self.remove_host(host_id)
         ray_tpu.shutdown()

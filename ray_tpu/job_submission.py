@@ -1,0 +1,187 @@
+"""Job submission: run a driver command on the cluster under supervision.
+
+(reference capability: python/ray/dashboard/modules/job/ — REST+SDK
+`JobSubmissionClient.submit_job` (sdk.py:36,126), `JobManager` (job_manager.py:60)
+spawning a per-job `JobSupervisor` actor (job_supervisor.py:56) that runs the
+entrypoint, streams its logs, and exposes status. Here the SDK talks straight
+to the session (no dashboard hop): job state lives in GCS KV under `job:<id>`,
+logs under `<session>/logs/job-<id>.log`, and the supervisor is an actor.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+
+import ray_tpu
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@ray_tpu.remote(num_cpus=0, max_concurrency=4)
+class JobSupervisor:
+    """Owns one job subprocess: spawn, pump logs, record status in GCS KV."""
+
+    def __init__(self, job_id: str, entrypoint: str, metadata: dict,
+                 session_dir: str, socket_path: str, session_id: str):
+        import subprocess
+        import threading
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_path = os.path.join(session_dir, "logs", f"job-{job_id}.log")
+        self._status = RUNNING
+        self._record(metadata)
+        env = dict(os.environ)
+        # the job's driver joins THIS session instead of starting its own
+        env["RAY_TPU_ADDRESS"] = f"unix:{socket_path}"
+        env["RAY_TPU_SESSION"] = session_id
+        self._log_f = open(self.log_path, "ab")
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=self._log_f,
+            stderr=subprocess.STDOUT, cwd=os.getcwd(), env=env,
+            start_new_session=True)  # own pgid: stop() kills the whole tree
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _record(self, metadata: dict | None = None):
+        from ray_tpu._private.worker import get_global_worker
+
+        rec = {"job_id": self.job_id, "status": self._status,
+               "entrypoint": self.entrypoint, "updated_at": time.time()}
+        if metadata:
+            rec["metadata"] = metadata
+        get_global_worker().kv_put(f"job:{self.job_id}", json.dumps(rec))
+
+    def _wait(self):
+        rc = self._proc.wait()
+        self._log_f.close()
+        if self._status != STOPPED:
+            self._status = SUCCEEDED if rc == 0 else FAILED
+        self._record()
+
+    def status(self) -> str:
+        return self._status
+
+    def stop(self) -> None:
+        import signal
+
+        if self._proc.poll() is None:
+            self._status = STOPPED
+            try:
+                os.killpg(self._proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            self._record()
+
+    def logs(self) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """SDK mirroring the reference's (sdk.py): submit/status/logs/stop/list.
+
+    Uses the already-initialized session if any, else joins the newest live
+    session on this host as a secondary driver."""
+
+    def __init__(self, session_dir: str | None = None):
+        if ray_tpu.is_initialized():
+            ctx = ray_tpu.init()  # returns existing context
+            self.session_dir = ctx.get("session_dir") or self._newest(session_dir)
+        else:
+            self.session_dir = session_dir or self._newest(None)
+            socket_path = os.path.join(self.session_dir, "gcs.sock")
+            session_id = os.path.basename(self.session_dir)[len("session_"):]
+            os.environ["RAY_TPU_ADDRESS"] = f"unix:{socket_path}"
+            os.environ["RAY_TPU_SESSION"] = session_id
+            ray_tpu.init()
+        self.socket_path = os.path.join(self.session_dir, "gcs.sock")
+        self.session_id = os.path.basename(self.session_dir)[len("session_"):]
+
+    @staticmethod
+    def _newest(hint: str | None) -> str:
+        if hint:
+            return hint
+        from ray_tpu.scripts.cli import find_sessions
+
+        sessions = find_sessions()
+        if not sessions:
+            raise RuntimeError("no live ray_tpu session to submit to")
+        return sessions[0]
+
+    # -- API ---------------------------------------------------------------
+
+    def submit_job(self, *, entrypoint: str, metadata: dict | None = None,
+                   submission_id: str | None = None) -> str:
+        job_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
+        sup = JobSupervisor.options(name=f"_job_supervisor:{job_id}").remote(
+            job_id, entrypoint, metadata or {}, self.session_dir,
+            self.socket_path, self.session_id)
+        ray_tpu.get(sup.ping.remote())  # surface spawn errors here
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        try:
+            return ray_tpu.get(self._supervisor(job_id).status.remote())
+        except Exception:
+            rec = self._kv_record(job_id)
+            if rec:
+                return rec["status"]
+            raise
+
+    def get_job_logs(self, job_id: str) -> str:
+        try:
+            return ray_tpu.get(self._supervisor(job_id).logs.remote())
+        except Exception:
+            path = os.path.join(self.session_dir, "logs", f"job-{job_id}.log")
+            try:
+                with open(path, "rb") as f:
+                    return f.read().decode("utf-8", "replace")
+            except OSError:
+                return ""
+
+    def stop_job(self, job_id: str) -> None:
+        ray_tpu.get(self._supervisor(job_id).stop.remote())
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in TERMINAL:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still {status} after {timeout}s")
+
+    def _kv_record(self, job_id: str) -> dict | None:
+        from ray_tpu._private.api import _get_worker
+
+        raw = _get_worker().kv_get(f"job:{job_id}")
+        return json.loads(raw) if raw else None
+
+    def list_jobs(self) -> list[dict]:
+        from ray_tpu._private.api import _get_worker
+
+        w = _get_worker()
+        out = []
+        for key in w.kv_keys("job:"):
+            raw = w.kv_get(key)
+            if raw:
+                out.append(json.loads(raw))
+        return out

@@ -17,6 +17,9 @@ _UNSET = object()
 def _build_resources(num_cpus, num_tpus, resources) -> dict:
     out = {"CPU": 1.0 if num_cpus is None else float(num_cpus)}
     if num_tpus:
+        from ray_tpu._private.accelerators import validate_num_tpus
+
+        validate_num_tpus(num_tpus)
         out["TPU"] = float(num_tpus)
     if resources:
         out.update({k: float(v) for k, v in resources.items()})

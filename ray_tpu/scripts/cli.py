@@ -1,0 +1,229 @@
+"""`ray_tpu` ops CLI — status / list / logs / microbenchmark / job submit.
+
+Run as `python -m ray_tpu.scripts.cli <command>` (or the `ray-tpu` shim).
+
+(reference capability: python/ray/scripts/scripts.py — `ray status`/`ray
+list`/`ray logs`/`ray submit`; state listing mirrors util/state/state_cli.py
+but reads the GCS `cluster_state`/`list_nodes` messages directly over the
+session socket instead of a dashboard head.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import itertools
+import json
+import os
+import sys
+import time
+
+
+def find_sessions(base: str = "/tmp/ray_tpu") -> list[str]:
+    """Session dirs with a live GCS socket, newest first."""
+    dirs = sorted(glob.glob(os.path.join(base, "session_*")),
+                  key=os.path.getmtime, reverse=True)
+    return [d for d in dirs if os.path.exists(os.path.join(d, "gcs.sock"))]
+
+
+class GcsClient:
+    """Thin read-only client on the session socket (no worker registration)."""
+
+    def __init__(self, session_dir: str):
+        from ray_tpu._private.protocol import connect_unix
+
+        self.session_dir = session_dir
+        self.conn = connect_unix(os.path.join(session_dir, "gcs.sock"), timeout=5.0)
+        self._rid = itertools.count(1)
+
+    def rpc(self, msg: dict) -> dict:
+        msg["rid"] = next(self._rid)
+        self.conn.send(msg)
+        return self.conn.recv()
+
+    def close(self):
+        self.conn.close()
+
+
+def _pick_session(args) -> str:
+    if getattr(args, "session", None):
+        return args.session
+    sessions = find_sessions()
+    if not sessions:
+        print("no live ray_tpu session found under /tmp/ray_tpu", file=sys.stderr)
+        sys.exit(1)
+    return sessions[0]
+
+
+def cmd_status(args):
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        state = c.rpc({"type": "cluster_state"})["state"]
+    finally:
+        c.close()
+    if args.json:
+        print(json.dumps(state, indent=1, default=str))
+        return
+    print(f"session: {os.path.basename(sd)}")
+    print(f"workers: {state['num_workers']}   live actors: {state['num_actors']}   "
+          f"pending tasks: {state['pending_tasks']}")
+    print("resources:")
+    total, avail = state["total_resources"], state["available_resources"]
+    for k in sorted(total):
+        print(f"  {k:24s} {total[k] - avail.get(k, 0):.1f} / {total[k]:.1f} used")
+    tc = state.get("task_counter", {})
+    if tc:
+        print("tasks: " + "  ".join(f"{k}={v}" for k, v in sorted(tc.items())))
+    pend = {a: i for a, i in state.get("actors", {}).items()
+            if i["state"] not in ("alive", "dead")}
+    if pend:
+        print("non-running actors:")
+        for aid, info in pend.items():
+            print(f"  {aid}  {info['state']}  name={info.get('name')}")
+
+
+def cmd_list(args):
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        if args.kind == "nodes":
+            rows = c.rpc({"type": "list_nodes"})["nodes"]
+        elif args.kind == "actors":
+            state = c.rpc({"type": "cluster_state"})["state"]
+            rows = [{"actor_id": aid, **info}
+                    for aid, info in state.get("actors", {}).items()]
+        elif args.kind == "placement-groups":
+            rows_map = c.rpc({"type": "pg_table"})["table"]
+            rows = [{"pg_id": k, **v} for k, v in rows_map.items()]
+        elif args.kind == "jobs":
+            keys = c.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
+            rows = []
+            for k in keys:
+                v = c.rpc({"type": "kv_get", "key": k})["value"]
+                if v:
+                    rows.append(json.loads(v) if isinstance(v, (str, bytes)) else v)
+        else:
+            print(f"unknown kind {args.kind}", file=sys.stderr)
+            sys.exit(2)
+    finally:
+        c.close()
+    print(json.dumps(rows, indent=1, default=str))
+
+
+def cmd_logs(args):
+    sd = _pick_session(args)
+    log_dir = os.path.join(sd, "logs")
+    names = sorted(os.listdir(log_dir)) if os.path.isdir(log_dir) else []
+    if args.source is None:
+        for n in names:
+            path = os.path.join(log_dir, n)
+            print(f"{n}\t{os.path.getsize(path)} bytes")
+        return
+    matches = [n for n in names if n.startswith(args.source)]
+    if not matches:
+        print(f"no log matching {args.source!r} (have: {', '.join(names)})",
+              file=sys.stderr)
+        sys.exit(1)
+    path = os.path.join(log_dir, matches[0])
+    with open(path, "rb") as f:
+        if args.follow:
+            f.seek(0, os.SEEK_END if args.tail == 0 else os.SEEK_SET)
+            if args.tail:
+                _print_tail(f, args.tail)
+            while True:
+                chunk = f.read()
+                if chunk:
+                    sys.stdout.write(chunk.decode("utf-8", "replace"))
+                    sys.stdout.flush()
+                else:
+                    time.sleep(0.25)
+        elif args.tail:
+            _print_tail(f, args.tail)
+        else:
+            sys.stdout.write(f.read().decode("utf-8", "replace"))
+
+
+def _print_tail(f, n_lines: int):
+    f.seek(0)
+    lines = f.read().decode("utf-8", "replace").splitlines()
+    for line in lines[-n_lines:]:
+        print(line)
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu._private import ray_perf
+
+    ray_perf.main()
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=" ".join(args.entrypoint),
+        metadata={"submitted_via": "cli"})
+    print(f"submitted job {job_id}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finished(job_id)
+    for line in client.get_job_logs(job_id).splitlines():
+        print(line)
+    print(f"job {job_id}: {status}")
+    sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.action == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.action == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.action == "stop":
+        client.stop_job(args.job_id)
+        print(f"stop requested for {args.job_id}")
+    elif args.action == "list":
+        print(json.dumps(client.list_jobs(), indent=1, default=str))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    p.add_argument("--session", help="session dir (default: newest live one)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("status", help="cluster resources / actors / tasks")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "placement-groups", "jobs"])
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("logs", help="show/tail a process log")
+    sp.add_argument("source", nargs="?", help="e.g. worker-0 (omit to list)")
+    sp.add_argument("-f", "--follow", action="store_true")
+    sp.add_argument("-n", "--tail", type=int, default=0)
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("microbenchmark", help="run core runtime microbenchmarks")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("submit", help="submit a job (command) to the cluster")
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="job status / logs / stop / list")
+    sp.add_argument("action", choices=["status", "logs", "stop", "list"])
+    sp.add_argument("job_id", nargs="?")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

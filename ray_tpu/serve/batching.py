@@ -38,13 +38,32 @@ class _BatchQueue:
             with self.not_empty:
                 while not self.items:
                     self.not_empty.wait()
-                # wait for more work up to the batch window
-                if len(self.items) < self.max_batch_size:
+                # wait for more work up to the batch window; only items for
+                # the instance at the head of the queue count toward a full
+                # batch (that's all the flush below will take)
+                head = self.items[0][0]
+
+                def _head_count():
+                    return sum(1 for inst, _, _ in self.items if inst is head)
+
+                if _head_count() < self.max_batch_size:
                     self.not_empty.wait_for(
-                        lambda: len(self.items) >= self.max_batch_size,
+                        lambda: _head_count() >= self.max_batch_size,
                         timeout=self.timeout_s)
-                batch = self.items[: self.max_batch_size]
-                del self.items[: len(batch)]
+                # flush only items bound to the same instance — a queue is
+                # per-function per-process, but a decorated method may be
+                # called on several instances, and a batch must run against
+                # the instance its callers used
+                inst0 = self.items[0][0]
+                batch, rest = [], []
+                for tup in self.items:
+                    if len(batch) < self.max_batch_size and tup[0] is inst0:
+                        batch.append(tup)
+                    else:
+                        rest.append(tup)
+                self.items = rest
+                if rest:
+                    self.not_empty.notify()
             instance = batch[0][0]
             inputs = [item for _, item, _ in batch]
             futures = [f for _, _, f in batch]

@@ -7,8 +7,13 @@
 
 from __future__ import annotations
 
+import os
 import shutil
 from dataclasses import dataclass
+
+# written into a checkpoint dir when the controller registers it; recovery
+# after a crash trusts only marked dirs (or fully-populated multi-rank ones)
+COMPLETE_MARKER = ".complete"
 
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.config import CheckpointConfig
@@ -32,6 +37,11 @@ class CheckpointManager:
             if t.checkpoint.path == checkpoint.path:
                 t.metrics = dict(metrics)  # re-registered (e.g. storage recovery)
                 return
+        try:  # durable completion marker for crash recovery
+            with open(os.path.join(checkpoint.path, COMPLETE_MARKER), "w"):
+                pass
+        except OSError:
+            pass
         self._tracked.append(_Tracked(checkpoint, dict(metrics), self._counter))
         self._counter += 1
         self._enforce_retention()

@@ -95,9 +95,18 @@ class TrainController:
             path = os.path.join(exp_dir, name)
             if not name.startswith("checkpoint_") or path in tracked:
                 continue
+            # trust the durable completion marker (written at live
+            # registration, which happens only once the iteration completed
+            # on all ranks); fall back to the fully-populated shape so older
+            # checkpoints without markers still recover. A torn dir (crash
+            # mid-save: some rank_* complete, some .tmp) matches neither.
+            from ray_tpu.train.checkpoint_manager import COMPLETE_MARKER
+
             ranks = [r for r in os.listdir(path)
                      if r.startswith("rank_") and not r.endswith(".tmp")]
-            if len(ranks) >= n:
+            complete = (os.path.exists(os.path.join(path, COMPLETE_MARKER))
+                        or len(ranks) >= n)
+            if complete and ranks:
                 self.ckpt_manager.register(Checkpoint(path), dict(self.latest_metrics))
 
     def _start_training(self, group: WorkerGroup, exp_dir: str) -> None:

@@ -197,7 +197,10 @@ class _TuneLoop:
         if trial.runner is None:
             trial.runner = TrainWorker.options(num_cpus=1.0).remote(0, 1, {})
         ctx = {"experiment_dir": trial.trial_dir, "experiment_name": trial.trial_id,
-               "checkpoint": checkpoint, "local_world_size": 1, "node_rank": 0}
+               "checkpoint": checkpoint, "local_world_size": 1, "node_rank": 0,
+               # continue numbering past prior iterations so a PBT restart
+               # never overwrites this trial's earlier checkpoint_* dirs
+               "start_iteration": trial.iteration}
         trial.runner.start_train_fn.remote(self.fn_blob, trial.config, ctx, None)
         trial.status = RUNNING
         trial.stopping = False
@@ -284,6 +287,15 @@ class _TuneLoop:
         trial.exploit_from = None
         trial.config = trial.explore_config or dict(donor.config)
         trial.explore_config = None
+        # kill the old runner rather than reuse it: its train thread stops
+        # only at its next report() and could still write checkpoints into
+        # the trial dir concurrently with the new session
+        if trial.runner is not None:
+            try:
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+            trial.runner = None
         self._start(trial, checkpoint=donor.latest_checkpoint)
 
     # ---------------------------------------------------------------- state

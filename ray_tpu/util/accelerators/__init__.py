@@ -1,0 +1,3 @@
+from ray_tpu.util.accelerators import tpu
+
+__all__ = ["tpu"]

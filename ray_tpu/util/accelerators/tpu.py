@@ -1,0 +1,61 @@
+"""TPU pod utilities — schedule work per TPU VM of a slice.
+
+(reference capability: python/ray/util/accelerators/tpu.py —
+get_current_pod_name (:8), get_current_pod_worker_count (:22),
+get_num_tpu_chips_on_node (:34). Detection is env-var driven, matching the
+GKE/GCE TPU VM environment and the reference's env-simulated test strategy.)
+"""
+
+from __future__ import annotations
+
+import os
+
+from ray_tpu._private.accelerators import (
+    current_worker_chips,
+    detect_num_tpu_chips,
+    tpu_head_resource_name,
+)
+
+__all__ = [
+    "get_current_pod_name",
+    "get_current_pod_worker_count",
+    "get_num_tpu_chips_on_node",
+    "get_current_process_visible_chip_ids",
+    "slice_head_resource",
+]
+
+
+def get_current_pod_name() -> str | None:
+    """Name of the TPU pod slice this host belongs to (None off-TPU)."""
+    return os.environ.get("TPU_NAME") or None
+
+
+def get_current_pod_worker_count() -> int | None:
+    """Number of TPU-VM workers in this host's pod slice (None off-TPU)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts:
+        return len([h for h in hosts.split(",") if h])
+    bounds = os.environ.get("TPU_HOST_BOUNDS")
+    if bounds:
+        n = 1
+        for d in bounds.split(","):
+            n *= int(d)
+        return n
+    return None
+
+
+def get_num_tpu_chips_on_node() -> int:
+    """TPU chips on this host (0 off-TPU)."""
+    return detect_num_tpu_chips()
+
+
+def get_current_process_visible_chip_ids() -> list[int]:
+    """Chip ids bound to this worker process ([] for CPU workers)."""
+    return current_worker_chips()
+
+
+def slice_head_resource(accelerator_type: str) -> str:
+    """Resource name held only by worker 0 of a slice: request 1 unit of it
+    to place exactly one coordinating actor per pod slice
+    (reference: tpu.py:170, TPU-{pod_type}-head)."""
+    return tpu_head_resource_name(accelerator_type)

@@ -20,6 +20,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+# debugging aid: `kill -USR1 <pytest pid>` dumps all thread stacks
+faulthandler.register(signal.SIGUSR1, all_threads=True)
+
 import pytest  # noqa: E402
 
 

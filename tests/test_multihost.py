@@ -1,0 +1,146 @@
+"""Multi-host: TCP control plane, follower agents, cross-host object pulls.
+
+(reference test strategy: python/ray/tests/ multi-node tests run real
+GCS/raylet processes per node on one machine via cluster_utils — SURVEY.md
+§4.2; here a follower HOST is a real node-agent subprocess with its own shm
+namespace joined over TCP, per VERDICT round-1 item 3.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster(head_node_args=dict(num_cpus=2, num_workers=1, max_workers=8))
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+def where_am_i():
+    return {"host": os.environ.get("RAY_TPU_HOST_ID", "host-0"),
+            "node": os.environ.get("RAY_TPU_NODE_ID", "node-0")}
+
+
+@ray_tpu.remote
+def put_big_array(n):
+    arr = np.full((n,), 7, dtype=np.float32)
+    return ray_tpu.put(arr)
+
+
+@ray_tpu.remote
+def sum_array(arr):
+    return float(arr.sum())
+
+
+def _on(host_id):
+    return NodeAffinitySchedulingStrategy(node_id=host_id)
+
+
+def test_follower_host_registers_and_runs_tasks(cluster):
+    host = cluster.add_host(num_cpus=2)
+    info = ray_tpu.get(
+        where_am_i.options(scheduling_strategy=_on(host)).remote(), timeout=60)
+    assert info["host"] == host
+    nodes = {n["node_id"]: n for n in ray_tpu.nodes()}
+    assert nodes[host]["alive"]
+
+
+def test_cross_host_object_pull_to_driver(cluster):
+    host = cluster.add_host(num_cpus=2)
+    # object created in the follower's shm namespace
+    ref_of_ref = put_big_array.options(scheduling_strategy=_on(host)).remote(
+        300_000)  # ~1.2 MB -> shm path
+    inner = ray_tpu.get(ref_of_ref, timeout=60)
+    arr = ray_tpu.get(inner, timeout=60)  # driver pulls over TCP
+    assert arr.shape == (300_000,) and float(arr[0]) == 7.0
+
+
+def test_cross_host_object_pull_to_worker(cluster):
+    host = cluster.add_host(num_cpus=2)
+    big = np.arange(400_000, dtype=np.float64)  # ~3.2 MB in head namespace
+    ref = ray_tpu.put(big)
+    # follower-host worker must pull the arg from the head's object server
+    total = ray_tpu.get(
+        sum_array.options(scheduling_strategy=_on(host)).remote(ref), timeout=60)
+    assert total == float(big.sum())
+
+
+def test_two_followers_object_flow(cluster):
+    h1 = cluster.add_host(num_cpus=1, host_id="host-a")
+    h2 = cluster.add_host(num_cpus=1, host_id="host-b")
+    inner = ray_tpu.get(
+        put_big_array.options(scheduling_strategy=_on(h1)).remote(200_000),
+        timeout=60)
+    # host-b pulls an object living on host-a (via its object server)
+    total = ray_tpu.get(
+        sum_array.options(scheduling_strategy=_on(h2)).remote(inner), timeout=60)
+    assert total == 7.0 * 200_000
+
+
+@ray_tpu.remote
+def make_big(n):
+    # >64KB return value: goes through the task-result shm path, not inline
+    return np.full((n,), 3, dtype=np.float32)
+
+
+def test_large_task_result_from_follower(cluster):
+    host = cluster.add_host(num_cpus=2)
+    arr = ray_tpu.get(
+        make_big.options(scheduling_strategy=_on(host)).remote(100_000),
+        timeout=60)
+    assert arr.shape == (100_000,) and float(arr[-1]) == 3.0
+
+
+def test_oversized_args_to_follower(cluster):
+    host = cluster.add_host(num_cpus=2)
+    big = np.ones((200_000,), dtype=np.float64)  # > ARGS_INLINE_LIMIT
+    total = ray_tpu.get(
+        sum_array.options(scheduling_strategy=_on(host)).remote(big), timeout=60)
+    assert total == 200_000.0
+
+
+def test_host_failure_fails_its_node(cluster):
+    host = cluster.add_host(num_cpus=1)
+    assert any(n["node_id"] == host and n["alive"] for n in ray_tpu.nodes())
+    cluster.remove_host(host)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any(n["node_id"] == host and not n["alive"] for n in ray_tpu.nodes()):
+            return
+        time.sleep(0.1)
+    raise AssertionError("dead host's node still alive")
+
+
+def test_remote_driver_joins_by_tcp_address(cluster):
+    from ray_tpu._private import api as _api
+
+    address = _api._node.address
+    script = (
+        "import ray_tpu, os\n"
+        f"ray_tpu.init(address={address!r})\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('joined-result', ray_tpu.get(f.remote(14), timeout=60))\n"
+    )
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+    env.pop("RAY_TPU_STORE_NS", None)
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=120, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "joined-result 42" in r.stdout

@@ -1,0 +1,127 @@
+"""Native shm arena store: C-level test binary + Python binding + session
+end-to-end under RAY_TPU_STORE_BACKEND=arena.
+
+(reference test pattern: plasma has its own C++ unit tests plus Python
+integration through the store provider — SURVEY.md §4.1/4.2.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import shm_arena
+
+CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "cpp")
+
+
+def test_c_level_suite(tmp_path):
+    """Compile and run the native test binary against the built library."""
+    shm_arena._ensure_lib()  # builds cpp/build/libshmstore.so
+    test_bin = str(tmp_path / "shm_store_test")
+    subprocess.run(
+        ["g++", "-O2", "-o", test_bin,
+         os.path.join(CPP_DIR, "shm_store_test.cc"), "-ldl", "-lpthread"],
+        check=True, capture_output=True)
+    arena = f"/dev/shm/rtpu_ctest_{uuid.uuid4().hex[:8]}"
+    r = subprocess.run(
+        [test_bin, os.path.abspath(shm_arena._LIB), arena],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.fixture
+def arena():
+    sid = f"t{uuid.uuid4().hex[:8]}"
+    st = shm_arena.ArenaStore(sid, capacity=1 << 20)
+    yield st
+    st.cleanup_session()
+
+
+def test_roundtrip_bytes(arena):
+    data = os.urandom(4096)
+    arena.put_parts("obj1", [data], len(data))
+    got = arena.get("obj1")
+    assert bytes(got.buf) == data
+    assert arena.contains("obj1")
+    assert arena.size("obj1") == 4096
+    got.release()
+    arena.delete("obj1")
+    assert not arena.contains("obj1")
+
+
+def test_zero_copy_numpy_view(arena):
+    a = np.arange(1000, dtype=np.float32)
+    raw = a.tobytes()
+    arena.put_parts("arr", [raw], len(raw))
+    obj = arena.get("arr")
+    view = np.frombuffer(obj.buf, dtype=np.float32)
+    np.testing.assert_array_equal(view, a)
+    del view
+    obj.release()
+
+
+def test_eviction_under_pressure(arena):
+    # 1 MiB arena: 12 x 128 KiB puts must evict early objects, not fail
+    for i in range(12):
+        data = bytes([i]) * (128 * 1024)
+        arena.put_parts(f"o{i}", [data], len(data))
+    assert not arena.contains("o0")  # LRU gone
+    assert arena.contains("o11")
+    assert bytes(arena.get("o11").buf[:1]) == bytes([11])
+
+
+def test_too_large_raises(arena):
+    with pytest.raises(shm_arena.ArenaFullError):
+        arena.put_parts("huge", [b"x" * (2 << 20)], 2 << 20)
+
+
+def test_pinned_object_survives(arena):
+    data = b"p" * (256 * 1024)
+    arena.put_parts("pin", [data], len(data))
+    held = arena.get("pin")  # pinned
+    for i in range(12):
+        try:
+            arena.put_parts(f"f{i}", [b"f" * (128 * 1024)], 128 * 1024)
+        except shm_arena.ArenaFullError:
+            pass
+    assert arena.contains("pin")
+    assert bytes(held.buf[:1]) == b"p"
+    held.release()
+
+
+def test_session_end_to_end_on_arena_backend():
+    """Full ray_tpu session with the arena as the object store."""
+    env_key = "RAY_TPU_STORE_BACKEND"
+    old = os.environ.get(env_key)
+    os.environ[env_key] = "arena"
+    try:
+        import ray_tpu
+
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+        try:
+            @ray_tpu.remote
+            def double(x):
+                return x * 2
+
+            big = np.ones((512, 512), dtype=np.float32)  # 1 MiB: via shm
+            ref = ray_tpu.put(big)
+            out = ray_tpu.get(double.remote(ray_tpu.get(ref)[0, 0]))
+            assert out == 2.0
+            np.testing.assert_array_equal(ray_tpu.get(ref), big)
+
+            refs = [double.remote(i) for i in range(20)]
+            assert ray_tpu.get(refs) == [i * 2 for i in range(20)]
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old

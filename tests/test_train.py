@@ -63,7 +63,9 @@ def test_checkpoint_roundtrip(ray_train_cluster, tmp_path):
     assert result.checkpoint is not None
     with result.checkpoint.as_directory() as d:
         # both ranks persisted their shard of the final checkpoint
-        assert sorted(os.listdir(d)) == ["rank_0", "rank_1"]
+        # both rank shards present, plus the durable completion marker
+        assert sorted(x for x in os.listdir(d) if not x.startswith(".")) == \
+            ["rank_0", "rank_1"]
         with open(os.path.join(d, "rank_0", "state.txt")) as f:
             assert f.read() == "iter=1"
 
