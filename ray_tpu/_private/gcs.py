@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 from typing import Callable
@@ -38,6 +39,8 @@ INLINE_LIMIT = 64 * 1024  # results smaller than this are stored in the GCS tabl
 
 DEFAULT_NODE = "node-0"
 HEAD_HOST = "host-0"
+MAX_RECONSTRUCTIONS = 3
+MAX_LINEAGE = int(os.environ.get("RAY_TPU_MAX_LINEAGE", "10000"))
 # chip spawns can block minutes in TPU plugin init; plain spawns are fast
 SPAWN_TIMEOUT_S = 60.0
 CHIP_SPAWN_TIMEOUT_S = 300.0
@@ -173,6 +176,16 @@ class GcsServer:
         self.named_pgs: dict[str, str] = {}
         self.pending_pgs: collections.deque[str] = collections.deque()
         self.kv: dict[str, bytes] = {}
+        # retained specs of stateless tasks, for lineage reconstruction of
+        # their outputs (reference: TaskManager lineage pinning)
+        self.lineage: dict[str, dict] = {}
+        # live streaming-generator tasks: task_id → stream state
+        # (reference: streaming generators, _raylet.pyx:299)
+        self.streams: dict[str, dict] = {}
+        # per-host live tmpfs bytes; over RAY_TPU_OBJECT_STORE_CAPACITY the
+        # LRU objects are spilled to disk (reference: local_object_manager.h:43)
+        self.host_shm_bytes: collections.Counter = collections.Counter()
+        self.spill_capacity = int(os.environ.get("RAY_TPU_OBJECT_STORE_CAPACITY", "0") or 0)
         self._spawn_pending: dict[str, collections.deque] = collections.defaultdict(collections.deque)
         self.stopped = False
         self._conn_threads: list[threading.Thread] = []
@@ -241,7 +254,7 @@ class GcsServer:
         # serves them with this stopped GCS (observed: drivers registering
         # into a dead session and hanging). shutdown() unblocks accept but
         # keeps the fd allocated; the owning accept thread closes it.
-        import socket as _socket
+        import socket as _socket  # local: protocol owns all other socket use
 
         for listener in (self._listener, getattr(self, "_tcp_listener", None)):
             if listener is not None:
@@ -396,6 +409,84 @@ class GcsServer:
                 locs = self._object_locations_locked(entry)
             conn.send({"rid": msg["rid"], "locations": locs})
             return wid
+        if t == "ref_delta":
+            self._on_ref_delta(msg["deltas"])
+            return wid
+        if t == "stream_item":
+            with self.lock:
+                st = self.streams.get(msg["task_id"])
+            if st is None:
+                # consumer released the stream: drop the orphan item's shm
+                # copy and tell the producer to stop generating
+                if msg.get("where") == "shm":
+                    self._delete_host_copy(msg["oid"], msg.get("host") or HEAD_HOST)
+                with self.lock:
+                    prod = self.workers.get(msg.get("wid") or "")
+                if prod is not None and not prod.dead:
+                    try:
+                        prod.conn.send({"type": "stream_cancel",
+                                        "task_id": msg["task_id"]})
+                    except ConnectionClosed:
+                        pass
+                return wid
+            self._on_object_ready(
+                msg["oid"], where=msg.get("where", "shm"),
+                inline=msg.get("inline"), size=msg.get("size", 0),
+                is_error=False, host=msg.get("host") or HEAD_HOST,
+                contained=msg.get("contained"))
+            with self.lock:
+                st = self.streams.get(msg["task_id"])
+                if st is not None:
+                    st["producer"] = msg.get("wid") or st["producer"]
+                    st["items"].append(msg["oid"])
+                    waiters, st["waiters"] = st["waiters"], []
+                else:
+                    waiters = []
+            for wconn, rid, idx in waiters:
+                self._answer_stream_next(wconn, rid, msg["task_id"], idx)
+            return wid
+        if t == "stream_end":
+            with self.lock:
+                st = self.streams.get(msg["task_id"])
+                if st is not None:
+                    st["done"] = True
+                    st["error"] = msg.get("error")
+                    st["producer"] = msg.get("wid") or st["producer"]
+                    waiters, st["waiters"] = st["waiters"], []
+                else:
+                    waiters = []
+            for wconn, rid, idx in waiters:
+                self._answer_stream_next(wconn, rid, msg["task_id"], idx)
+            return wid
+        if t == "stream_next":
+            self._answer_stream_next(conn, msg["rid"], msg["task_id"], msg["index"])
+            return wid
+        if t == "stream_consumed":
+            with self.lock:
+                st = self.streams.get(msg["task_id"])
+                if st is None:
+                    return wid
+                st["consumed"] = max(st["consumed"], msg["index"])
+                prod = self.workers.get(st["producer"]) if st["producer"] else None
+            if prod is not None and not prod.dead:
+                try:
+                    prod.conn.send({"type": "stream_ack", "task_id": msg["task_id"],
+                                    "consumed": msg["index"]})
+                except ConnectionClosed:
+                    pass
+            return wid
+        if t == "stream_release":
+            # consumer dropped the generator: free whatever it didn't take
+            with self.lock:
+                st = self.streams.pop(msg["task_id"], None)
+                leftover = st["items"][st["consumed"]:] if st else []
+            if leftover:
+                self._free_objects(leftover)
+            return wid
+        if t == "object_lost":
+            action = self._reconstruct_or_report(msg["oid"])
+            conn.send({"rid": msg["rid"], "action": action})
+            return wid
         if t == "submit_task":
             self._submit_task(msg["spec"])
             conn.send({"rid": msg["rid"], "ok": True})
@@ -404,13 +495,15 @@ class GcsServer:
         elif t == "object_put":
             self._on_object_ready(msg["oid"], where=msg.get("where", "shm"),
                                   inline=msg.get("inline"), size=msg.get("size", 0),
-                                  is_error=False, host=msg.get("host") or HEAD_HOST)
+                                  is_error=False, host=msg.get("host") or HEAD_HOST,
+                                  pin=msg.get("pin", False),
+                                  contained=msg.get("contained"))
         elif t == "wait_object":
             self._wait_object(conn, msg)
         elif t == "free_objects":
-            with self.lock:
-                for oid in msg["oids"]:
-                    self.objects.pop(oid, None)
+            # manual free: drop entries and every host copy, cascading to
+            # nested refs (reference: ray._private.internal_api.free)
+            self._free_objects(list(msg["oids"]))
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "create_actor":
             err = self._create_actor(msg["spec"])
@@ -513,7 +606,8 @@ class GcsServer:
     # --------------------------------------------------------------- objects
 
     def _on_object_ready(self, oid: str, where: str, inline, size: int,
-                         is_error: bool, host: str = HEAD_HOST):
+                         is_error: bool, host: str = HEAD_HOST,
+                         pin: bool = False, contained=None):
         with self.lock:
             prev = self.objects.get(oid)
             if (prev is not None and prev["status"] == "ready"
@@ -521,24 +615,305 @@ class GcsServer:
                 # an additional shm copy on another host: extend the location
                 # set, keep the entry (reference: object directory adding a
                 # location, ownership_object_directory.h)
-                prev.setdefault("hosts", set()).add(host)
+                if host not in prev.setdefault("hosts", set()):
+                    prev["hosts"].add(host)
+                    self._note_shm_copy_locked(prev, host)
                 return
-            self.objects[oid] = {
+            if prev is not None:
+                self._drop_shm_copies_locked(prev)  # stale copies of an overwrite
+            entry = self.objects[oid] = {
+                **(prev or {}),  # keep refcount state accumulated while pending
                 "status": "error" if is_error else "ready",
                 "where": where,
                 "inline": inline,
                 "size": size,
                 "hosts": {host} if where == "shm" else set(),
             }
+            if where == "shm":
+                entry["shm_live"] = set()
+                self._note_shm_copy_locked(entry, host)
+            if pin:
+                entry["pinned"] = True
+            if contained and "contained" not in entry:
+                entry["contained"] = list(contained)
+                self._sys_hold_locked(contained, +1)
             waiters = self.object_waiters.pop(oid, [])
-            entry = self.objects[oid]
         for conn, rid in waiters:
             self._reply_object(conn, rid, entry)
+        if where == "shm":
+            self._maybe_spill(host)
         self._schedule()
+
+    def _note_shm_copy_locked(self, entry: dict, host: str) -> None:
+        entry.setdefault("shm_live", set()).add(host)
+        entry["last_access"] = time.monotonic()
+        self.host_shm_bytes[host] += entry.get("size", 0)
+
+    def _drop_shm_copies_locked(self, entry: dict) -> None:
+        """Undo the tmpfs accounting for every live copy of an entry (host
+        loss, reconstruction reset, entry overwrite)."""
+        for h in entry.get("shm_live", ()):
+            self.host_shm_bytes[h] -= entry.get("size", 0)
+        entry["shm_live"] = set()
+
+    def _maybe_spill(self, host: str) -> None:
+        """Spill LRU tmpfs objects on `host` down to disk until under the
+        budget (reference: raylet/local_object_manager.h:43)."""
+        if not self.spill_capacity:
+            return
+        to_spill: list[str] = []
+        with self.lock:
+            used = self.host_shm_bytes.get(host, 0)
+            if used <= self.spill_capacity:
+                return
+            target = int(self.spill_capacity * 0.7)
+            cands = sorted(
+                (e.get("last_access", 0.0), oid, e)
+                for oid, e in self.objects.items()
+                if e.get("status") == "ready" and host in e.get("shm_live", ()))
+            for _, oid, e in cands:
+                if used <= target:
+                    break
+                e["shm_live"].discard(host)
+                used -= e.get("size", 0)
+                to_spill.append(oid)
+            self.host_shm_bytes[host] = used
+            agent = (self.hosts.get(host) or {}).get("conn")
+        if not to_spill:
+            return
+        if agent is not None:
+            try:
+                agent.send({"type": "spill_objects", "oids": to_spill})
+            except ConnectionClosed:
+                pass
+        elif self.session_id:
+            for oid in to_spill:
+                try:
+                    self._head_store().spill(oid)
+                except Exception:
+                    logger.exception("spill of %s failed", oid)
 
     def _object_locations_locked(self, entry: dict) -> list:
         return [(h, self.hosts[h]["object_addr"])
                 for h in entry.get("hosts", ()) if h in self.hosts]
+
+    # ---------------------------------------------------- reference counting
+    # GCS-arbitered equivalent of the reference's distributed ReferenceCounter
+    # (src/ray/core_worker/reference_counter.h:43): workers report process-
+    # level ref transitions; the GCS adds system holds for in-flight task
+    # dependencies and refs nested inside stored objects, and frees an object
+    # cluster-wide when every hold is gone.
+
+    def _on_ref_delta(self, deltas: dict):
+        free: list[str] = []
+        with self.lock:
+            for oid, n in deltas.items():
+                e = self.objects.get(oid)
+                if e is None:
+                    continue  # stale ref from a prior session / already freed
+                e["count"] = e.get("count", 0) + n
+                # any delta (including a within-window +1/-1 cancel, sent as
+                # 0) proves the object has been user-referenced
+                e["counted"] = True
+                if self._freeable_locked(oid, e):
+                    free.append(oid)
+        if free:
+            self._free_objects(free)
+
+    def _freeable_locked(self, oid: str, e: dict) -> bool:
+        return (e.get("counted", False)
+                and e.get("count", 0) <= 0
+                and e.get("sys", 0) <= 0
+                and not e.get("pinned", False)
+                and e.get("status") != "pending"
+                # PG-ready sentinels are owned by the PG state machine
+                and not (oid.endswith("r0000") and oid[:-5] in self.pgs))
+
+    def _sys_hold_locked(self, oids, n: int) -> list[str]:
+        """Adjust system holds; returns oids that became freeable."""
+        out = []
+        for oid in oids:
+            e = self.objects.get(oid)
+            if e is None:
+                continue
+            e["sys"] = e.get("sys", 0) + n
+            if n < 0 and self._freeable_locked(oid, e):
+                out.append(oid)
+        return out
+
+    def _actor_dead_cleanup_locked(self, create_spec: dict) -> list[str]:
+        """Permanent actor death: release creation-arg holds and the pinned
+        creation-args blob. Returns oids to free."""
+        out = self._sys_hold_locked(create_spec.pop("_actor_holds", ()), -1)
+        args_oid = create_spec.get("args_oid")
+        if args_oid and args_oid in self.objects:
+            self.objects[args_oid]["pinned"] = False
+            out.append(args_oid)
+        return out
+
+    def _drop_lineage_locked(self, tid: str) -> list[str]:
+        """Forget a task's retained spec; its (pinned, otherwise-unowned)
+        args blob goes with it. Returns oids to free."""
+        spec = self.lineage.pop(tid, None)
+        if spec is None:
+            return []
+        args_oid = spec.get("args_oid")
+        if args_oid and args_oid in self.objects:
+            self.objects[args_oid]["pinned"] = False
+            return [args_oid]
+        return []
+
+    def _head_store(self):
+        if getattr(self, "_head_store_obj", None) is None:
+            from ray_tpu._private.object_store import make_object_store
+
+            self._head_store_obj = make_object_store(self.session_id)
+        return self._head_store_obj
+
+    def _free_objects(self, oids: list[str]):
+        """Drop entries and delete every host's shm copy; cascades to refs
+        nested inside the freed objects (reference: plasma delete +
+        reference_counter release cascades)."""
+        by_host: dict[str, list[str]] = collections.defaultdict(list)
+        cascade: list[str] = []
+        agent_msgs = []
+        with self.lock:
+            for oid in oids:
+                e = self.objects.pop(oid, None)
+                if e is None:
+                    continue
+                self.object_waiters.pop(oid, None)
+                self._drop_shm_copies_locked(e)
+                for h in e.get("hosts", ()):
+                    by_host[h].append(oid)
+                cascade.extend(self._sys_hold_locked(e.get("contained", ()), -1))
+                # drop retained lineage once a task's outputs are all gone
+                tid = oid[:-5]
+                spec = self.lineage.get(tid)
+                if spec is not None and not any(
+                        f"{tid}r{i:04d}" in self.objects
+                        for i in range(spec["num_returns"])):
+                    cascade.extend(self._drop_lineage_locked(tid))
+            for h, lst in by_host.items():
+                info = self.hosts.get(h)
+                if info is not None and info.get("conn") is not None:
+                    agent_msgs.append((info["conn"], lst))
+        if self.session_id:
+            for oid in by_host.get(HEAD_HOST, ()):
+                try:
+                    self._head_store().delete(oid)
+                except Exception:
+                    pass
+        for conn, lst in agent_msgs:
+            try:
+                conn.send({"type": "delete_objects", "oids": lst})
+            except ConnectionClosed:
+                pass
+        if cascade:
+            self._free_objects(cascade)
+
+    # ------------------------------------------------- lineage reconstruction
+
+    def _delete_host_copy(self, oid: str, host: str) -> None:
+        """Delete one host's store copy of an object with no table entry."""
+        info = self.hosts.get(host)
+        if info is not None and info.get("conn") is not None:
+            try:
+                info["conn"].send({"type": "delete_objects", "oids": [oid]})
+            except ConnectionClosed:
+                pass
+        elif host == HEAD_HOST and self.session_id:
+            try:
+                self._head_store().delete(oid)
+            except Exception:
+                pass
+
+    def _answer_stream_next(self, conn: MsgConnection, rid: int,
+                            task_id: str, index: int) -> None:
+        with self.lock:
+            st = self.streams.get(task_id)
+            if st is None:
+                reply = {"rid": rid, "done": True, "error": None}
+            elif index < len(st["items"]):
+                reply = {"rid": rid, "oid": st["items"][index]}
+            elif st["done"]:
+                reply = {"rid": rid, "done": True, "error": st["error"]}
+            else:
+                st["waiters"].append((conn, rid, index))
+                return
+        try:
+            conn.send(reply)
+        except ConnectionClosed:
+            pass
+
+    def _reconstruct_or_report(self, oid: str) -> str:
+        """A consumer failed to materialize `oid` from any advertised copy.
+        Resubmit the creating task — and, recursively, any upstream task
+        whose outputs it needs that are also gone — if specs were retained
+        (reference: object_recovery_manager.h:41 — the owner resubmits the
+        creating task; lineage pinning keeps ancestors recoverable).
+        Returns the action the consumer should take."""
+        plan: list[dict] = []
+        with self.lock:
+            e = self.objects.get(oid)
+            if e is None:
+                return "gone"
+            if e["status"] == "pending":
+                return "pending"  # reconstruction already in flight
+            if e.get("where") == "inline":
+                return "ready"
+            tid = oid[:-5] if len(oid) > 5 else ""
+            if not self._collect_recon_locked(tid, plan, set(), 0):
+                return "lost"
+        # resubmit upstream-first: _deps_ready gates execution order anyway
+        for spec in plan:
+            self._submit_task(spec)
+        return "reconstructing"
+
+    def _collect_recon_locked(self, tid: str, plan: list, seen: set,
+                              depth: int) -> bool:
+        """Plan reconstruction of task `tid`'s outputs, recursing into
+        missing upstream dependencies. Resets the involved return entries to
+        pending (so concurrent reporters dedupe on 'pending')."""
+        if tid in seen:
+            return True
+        if depth > 8:
+            return False
+        spec = self.lineage.get(tid)
+        if spec is None or spec.get("recons_used", 0) >= MAX_RECONSTRUCTIONS:
+            return False
+        for dep in list(spec.get("deps", ())) + list(spec.get("ref_holds", ())):
+            de = self.objects.get(dep)
+            missing = (
+                de is None
+                or (de["status"] == "ready" and de.get("where") == "shm"
+                    and not de.get("hosts")))
+            if missing and not self._collect_recon_locked(
+                    dep[:-5], plan, seen, depth + 1):
+                return False
+        spec["recons_used"] = spec.get("recons_used", 0) + 1
+        seen.add(tid)
+        for i in range(spec["num_returns"]):
+            roid = f"{tid}r{i:04d}"
+            re_ = self.objects.get(roid)
+            if re_ is None:
+                self.objects[roid] = {"status": "pending", "where": None,
+                                      "inline": None, "size": 0}
+            elif re_["status"] != "pending":
+                self._drop_shm_copies_locked(re_)
+                re_.update(status="pending", inline=None)
+                re_["hosts"] = set()
+        newspec = {k: v for k, v in spec.items()
+                   if k not in ("_paid", "_holds", "retries_used", "recons_used")}
+        # a hard affinity to a dead node would make reconstruction
+        # unschedulable forever; the data matters more than the placement
+        strat = newspec.get("strategy")
+        if strat and strat.get("kind") == "node_affinity":
+            node = self.nodes.get(strat.get("node_id"))
+            if node is None or not node.alive:
+                newspec.pop("strategy", None)
+        plan.append(newspec)
+        return True
 
     def _reply_object(self, conn: MsgConnection, rid: int, entry: dict):
         with self.lock:
@@ -559,6 +934,7 @@ class GcsServer:
             if entry is None or entry["status"] == "pending":
                 self.object_waiters.setdefault(oid, []).append((conn, msg["rid"]))
                 return
+            entry["last_access"] = time.monotonic()  # LRU signal for the spiller
         self._reply_object(conn, msg["rid"], entry)
 
     # ------------------------------------------------------------- accounting
@@ -654,16 +1030,43 @@ class GcsServer:
 
     def _submit_task(self, spec: dict):
         with self.lock:
-            for i in range(spec["num_returns"]):
-                oid = f"{spec['task_id']}r{i:04d}"
-                self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+            if spec["num_returns"] == "streaming":
+                self.streams[spec["task_id"]] = {
+                    "items": [], "done": False, "error": None,
+                    "consumed": 0, "producer": None, "waiters": []}
+            else:
+                for i in range(spec["num_returns"]):
+                    oid = f"{spec['task_id']}r{i:04d}"
+                    self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
             reason = self._invalid_strategy_reason(spec.get("strategy"))
             if reason is None:
+                # hold every object this task needs (args + refs nested in
+                # args) until it completes, so a caller dropping its handles
+                # mid-flight can't free them under the task
+                holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
+                spec["_holds"] = holds
+                self._sys_hold_locked(holds, +1)
+                evicted: list[str] = []
+                if spec["kind"] == "task" and isinstance(spec["num_returns"], int):
+                    # retain the spec for lineage reconstruction of outputs,
+                    # under a bounded budget (reference: lineage eviction).
+                    # A reconstruction resubmit must keep its spent budget.
+                    prev_lin = self.lineage.get(spec["task_id"])
+                    lin = {k: v for k, v in spec.items()
+                           if k not in ("_paid", "_holds", "retries_used")}
+                    if prev_lin is not None:
+                        lin["recons_used"] = prev_lin.get("recons_used", 0)
+                    self.lineage[spec["task_id"]] = lin
+                    while len(self.lineage) > MAX_LINEAGE:
+                        evicted.extend(
+                            self._drop_lineage_locked(next(iter(self.lineage))))
                 self.pending_tasks.append(spec)
             self.task_counter["submitted"] += 1
         if reason is not None:
             self._fail_task_objects(spec, reason)
             return
+        if evicted:
+            self._free_objects(evicted)
         self._schedule()
 
     def _deps_ready(self, spec: dict) -> bool:
@@ -903,17 +1306,48 @@ class GcsServer:
                 "worker": wid, "error": error, "ts": time.time(),
             })
 
+            # the task is over: release its holds on args/nested refs
+            free_now = self._sys_hold_locked(spec.pop("_holds", ()), -1)
+            if kind == "actor_task" and spec.get("args_oid"):
+                ao = spec["args_oid"]
+                if ao in self.objects:
+                    self.objects[ao]["pinned"] = False
+                    free_now.append(ao)
+            if kind == "actor_create" and error is not None:
+                # creation failed permanently: creation-arg holds + args blob
+                free_now.extend(self._actor_dead_cleanup_locked(spec))
+
             # record results, with the producing host as the shm location so
             # cross-host consumers know where to pull from
             host = w.host_id if w is not None else HEAD_HOST
+            contained_map = msg.get("contained") or {}
+            any_shm = False
             for oid, where, inline, size in msg.get("results", ()):
-                self.objects[oid] = {
+                prev = self.objects.get(oid)
+                if prev is not None:
+                    self._drop_shm_copies_locked(prev)
+                entry = self.objects[oid] = {
+                    **(prev or {}),
                     "status": "error" if error is not None else "ready",
                     "where": where, "inline": inline, "size": size,
                     "hosts": {host} if where == "shm" else set(),
                 }
+                if where == "shm":
+                    entry["shm_live"] = set()
+                    self._note_shm_copy_locked(entry, host)
+                    any_shm = True
+                refs = contained_map.get(oid)
+                if refs and "contained" not in (prev or {}):
+                    entry["contained"] = list(refs)
+                    self._sys_hold_locked(refs, +1)
                 for conn, rid in self.object_waiters.pop(oid, []):
-                    self._reply_object(conn, rid, self.objects[oid])
+                    self._reply_object(conn, rid, entry)
+                if self._freeable_locked(oid, entry):
+                    free_now.append(oid)
+        if free_now:
+            self._free_objects(free_now)
+        if any_shm:
+            self._maybe_spill(host)
         self._schedule()
 
     # ---------------------------------------------------------------- actors
@@ -931,6 +1365,11 @@ class GcsServer:
                     return f"an actor named {actor.name!r} already exists"
                 self.named_actors[actor.name] = aid
             self.actors[aid] = actor
+            # creation args stay holdable for the actor's whole life (it may
+            # be restarted from the same spec)
+            holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
+            spec["_actor_holds"] = holds
+            self._sys_hold_locked(holds, +1)
             self.pending_actor_creations.append(spec)
         self._schedule()
         return None
@@ -940,9 +1379,17 @@ class GcsServer:
             actor = self.actors.get(spec["actor_id"])
             if actor is None or actor.state == "dead":
                 return False, "ActorDiedError"
-            for i in range(spec["num_returns"]):
-                oid = f"{spec['task_id']}r{i:04d}"
-                self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+            if spec["num_returns"] == "streaming":
+                self.streams[spec["task_id"]] = {
+                    "items": [], "done": False, "error": None,
+                    "consumed": 0, "producer": None, "waiters": []}
+            else:
+                for i in range(spec["num_returns"]):
+                    oid = f"{spec['task_id']}r{i:04d}"
+                    self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+            holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
+            spec["_holds"] = holds
+            self._sys_hold_locked(holds, +1)
             actor.queue.append(spec)
         self._schedule()
         return True, None
@@ -973,6 +1420,7 @@ class GcsServer:
                 actor.restarts_left = 0
             actor.kill_requested = True
             w = self.workers.get(actor.worker) if actor.worker else None
+            free_now: list[str] = []
             if w is None and actor.state in ("pending", "restarting"):
                 # creation not yet dispatched: cancel it outright
                 actor.state = "dead"
@@ -987,6 +1435,9 @@ class GcsServer:
                     except ConnectionClosed:
                         pass
                 actor.waiters = []
+                free_now = self._actor_dead_cleanup_locked(actor.create_spec)
+        if free_now:
+            self._free_objects(free_now)
         for spec in fail:
             self._fail_task_objects(spec, "actor killed before creation")
         if w is not None and not w.dead:
@@ -1132,9 +1583,11 @@ class GcsServer:
                 return
             self.hosts.pop(host_id, None)
             doomed_nodes = [n for n, h in self.node_hosts.items() if h == host_id]
-            # drop the host from every object's location set
+            # drop the host from every object's location set + accounting
             for entry in self.objects.values():
                 entry.get("hosts", set()).discard(host_id)
+                entry.get("shm_live", set()).discard(host_id)
+            self.host_shm_bytes.pop(host_id, None)
         for node_id in doomed_nodes:
             self._remove_node(node_id)
 
@@ -1180,6 +1633,22 @@ class GcsServer:
 
         exc = ActorDiedError(reason) if spec["kind"] == "actor_task" else WorkerCrashedError(reason)
         blob = ser.dumps(exc)
+        with self.lock:
+            free_now = self._sys_hold_locked(spec.pop("_holds", ()), -1)
+        if free_now:
+            self._free_objects(free_now)
+        if spec["num_returns"] == "streaming":
+            with self.lock:
+                st = self.streams.get(spec["task_id"])
+                if st is not None:
+                    st["done"] = True
+                    st["error"] = blob
+                    waiters, st["waiters"] = st["waiters"], []
+                else:
+                    waiters = []
+            for wconn, rid, idx in waiters:
+                self._answer_stream_next(wconn, rid, spec["task_id"], idx)
+            return
         for i in range(spec["num_returns"]):
             oid = f"{spec['task_id']}r{i:04d}"
             self._on_object_ready(oid, where="inline", inline=blob, size=len(blob), is_error=True)
@@ -1187,6 +1656,7 @@ class GcsServer:
     def _on_worker_death(self, wid: str):
         requeue: dict | None = None
         fail: list[dict] = []
+        death_free: list[str] = []
         with self.lock:
             w = self.workers.get(wid)
             if w is None or w.dead:
@@ -1205,7 +1675,10 @@ class GcsServer:
                 for spec in specs:
                     if spec["kind"] == "task":
                         self._release_for(spec)
-                        if spec.get("retries_used", 0) < spec.get("max_retries", 0):
+                        # a partially-emitted stream can't be retried (its
+                        # items are already consumed); fail it instead
+                        if (spec["num_returns"] != "streaming"
+                                and spec.get("retries_used", 0) < spec.get("max_retries", 0)):
                             spec["retries_used"] = spec.get("retries_used", 0) + 1
                             requeue = spec
                         else:
@@ -1234,6 +1707,9 @@ class GcsServer:
                             except ConnectionClosed:
                                 pass
                         actor.waiters = []
+                        death_free = self._actor_dead_cleanup_locked(actor.create_spec)
+        if death_free:
+            self._free_objects(death_free)
         for spec in fail:
             self._fail_task_objects(spec, f"worker {wid} died")
         if requeue is not None:

@@ -27,7 +27,7 @@ class LocalWorker:
         self._dead_actors: set[str] = set()
 
     # objects
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, pin: bool = False) -> ObjectRef:
         oid = ObjectID.for_put().hex()
         self._objects[oid] = (False, value)
         return ObjectRef(oid)

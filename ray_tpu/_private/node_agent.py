@@ -99,6 +99,18 @@ class NodeAgent:
         t = msg.get("type")
         if t == "spawn_workers":
             self._spawn_workers(msg["assignments"], msg.get("node_id", self.host_id))
+        elif t == "delete_objects":
+            for oid in msg["oids"]:
+                try:
+                    self.store.delete(oid)
+                except Exception:
+                    pass
+        elif t == "spill_objects":
+            for oid in msg["oids"]:
+                try:
+                    self.store.spill(oid)
+                except Exception:
+                    pass
         elif t == "exit":
             raise ConnectionClosed()
 

@@ -40,53 +40,94 @@ class PlasmaObject:
         self._f = f
 
 
+def spill_dir_for(ns: str) -> str:
+    return os.path.join("/tmp", "ray_tpu", f"spill_{ns}")
+
+
 class ShmObjectStore:
-    """One store per session; all processes of the session share the prefix."""
+    """One store per session; all processes of the session share the prefix.
+
+    Two tiers: tmpfs (hot, zero-copy) and a disk spill directory (cold).
+    Reads fall back to the spill tier transparently; the GCS-driven spiller
+    moves LRU objects down when the host's tmpfs budget is exceeded
+    (reference: spill orchestration, raylet/local_object_manager.h:43)."""
 
     def __init__(self, session_id: str):
         self.prefix = f"rtpu_{session_id}_"
+        self.spill_dir = spill_dir_for(session_id)
         self._created: set[str] = set()
 
     def _path(self, object_hex: str) -> str:
         return os.path.join(SHM_DIR, self.prefix + object_hex)
 
+    def _spill_path(self, object_hex: str) -> str:
+        return os.path.join(self.spill_dir, object_hex)
+
     def put_parts(self, object_hex: str, parts: Iterable[bytes | memoryview], total: int) -> int:
         """Create+seal an object from pre-serialized parts. Returns size."""
         path = self._path(object_hex)
         tmp = path + ".tmp"
-        with open(tmp, "w+b", buffering=0) as f:
-            if total > 0:
-                f.truncate(total)
-            mm = mmap.mmap(f.fileno(), max(total, 1))
-            off = 0
-            for p in parts:
-                n = len(p) if isinstance(p, bytes) else p.nbytes
-                mm[off : off + n] = p
-                off += n
-            mm.flush()
-            mm.close()
-        os.rename(tmp, path)  # atomic seal: readers never see partial objects
+        try:
+            self._write(tmp, path, parts, total)
+        except OSError:  # tmpfs full: create straight into the spill tier
+            try:
+                os.unlink(tmp)  # don't strand a truncated file on full tmpfs
+            except OSError:
+                pass
+            os.makedirs(self.spill_dir, exist_ok=True)
+            spath = self._spill_path(object_hex)
+            self._write(spath + ".tmp", spath, parts, total)
         self._created.add(object_hex)
         return total
 
+    @staticmethod
+    def _write(tmp: str, path: str, parts, total: int) -> None:
+        # plain write(2), NOT an mmap store: tmpfs allocates lazily, so a
+        # faulting mmap write on a full tmpfs raises SIGBUS (kills the
+        # process) while write() returns ENOSPC — which the spill fallback
+        # in put_parts can actually catch
+        with open(tmp, "wb") as f:
+            for p in parts:
+                f.write(p)
+        os.rename(tmp, path)  # atomic seal: readers never see partial objects
+
     def get(self, object_hex: str) -> PlasmaObject:
-        path = self._path(object_hex)
-        f = open(path, "rb")
+        try:
+            f = open(self._path(object_hex), "rb")
+        except FileNotFoundError:
+            f = open(self._spill_path(object_hex), "rb")
         size = os.fstat(f.fileno()).st_size
         mm = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
         return PlasmaObject(memoryview(mm), mm, f)
 
     def contains(self, object_hex: str) -> bool:
-        return os.path.exists(self._path(object_hex))
+        return (os.path.exists(self._path(object_hex))
+                or os.path.exists(self._spill_path(object_hex)))
 
     def size(self, object_hex: str) -> int:
-        return os.stat(self._path(object_hex)).st_size
+        try:
+            return os.stat(self._path(object_hex)).st_size
+        except FileNotFoundError:
+            return os.stat(self._spill_path(object_hex)).st_size
+
+    def spill(self, object_hex: str) -> bool:
+        """Move an object from tmpfs to the disk tier (no-op if absent)."""
+        src = self._path(object_hex)
+        if not os.path.exists(src):
+            return False
+        os.makedirs(self.spill_dir, exist_ok=True)
+        import shutil
+
+        dst = self._spill_path(object_hex)
+        shutil.move(src, dst)  # cross-device: copy + unlink
+        return True
 
     def delete(self, object_hex: str) -> None:
-        try:
-            os.unlink(self._path(object_hex))
-        except FileNotFoundError:
-            pass
+        for path in (self._path(object_hex), self._spill_path(object_hex)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
         self._created.discard(object_hex)
 
     def cleanup_session(self) -> None:
@@ -101,3 +142,6 @@ class ShmObjectStore:
                     os.unlink(os.path.join(SHM_DIR, name))
                 except OSError:
                     pass
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)

@@ -126,6 +126,9 @@ class ObjectFetcher:
         self._conns: dict[str, MsgConnection] = {}
         self._lock = threading.Lock()
         self._inflight: dict[str, threading.Event] = {}
+        # one request/response conversation per connection at a time — two
+        # threads interleaving frames on one socket would cross-read payloads
+        self._addr_locks: dict[str, threading.Lock] = {}
 
     def fetch(self, oid: str, address: str) -> bool:
         """Pull `oid` from the object server at `address` into the local
@@ -151,6 +154,12 @@ class ObjectFetcher:
         return ok
 
     def _fetch_once(self, oid: str, address: str) -> bool:
+        with self._lock:
+            alock = self._addr_locks.setdefault(address, threading.Lock())
+        with alock:
+            return self._fetch_conversation(oid, address)
+
+    def _fetch_conversation(self, oid: str, address: str) -> bool:
         try:
             conn = self._conn(address)
             conn.send({"type": "fetch", "oid": oid})

@@ -10,10 +10,13 @@ slot in per-message-type later without changing callers.
 
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
 
 _LEN = struct.Struct("<Q")
 MAX_FRAME = 1 << 34
@@ -21,6 +24,38 @@ MAX_FRAME = 1 << 34
 
 class ConnectionClosed(Exception):
     pass
+
+
+class _Chaos:
+    """Test-only fault injection, off unless env-configured (reference:
+    src/ray/rpc/rpc_chaos.h:24, env RAY_testing_rpc_failure).
+
+    RAY_TPU_TESTING_MSG_DROP="type_a,type_b:0.2" drops listed outbound
+    message types with the given probability; RAY_TPU_TESTING_MSG_DELAY_MS=N
+    sleeps up to N ms before every send (latency/reordering pressure).
+    """
+
+    def __init__(self):
+        self.drop_types: set[str] = set()
+        self.drop_prob = 0.0
+        self.delay_ms = 0.0
+        spec = os.environ.get("RAY_TPU_TESTING_MSG_DROP", "")
+        if spec:
+            types, _, prob = spec.partition(":")
+            self.drop_types = {t for t in types.split(",") if t}
+            self.drop_prob = float(prob or 0.1)
+        self.delay_ms = float(os.environ.get("RAY_TPU_TESTING_MSG_DELAY_MS", "0") or 0)
+        self.enabled = bool(self.drop_types or self.delay_ms)
+
+    def intercept(self, msg: dict) -> bool:
+        """True → drop the message."""
+        if self.delay_ms:
+            time.sleep(random.random() * self.delay_ms / 1000.0)
+        return (msg.get("type") in self.drop_types
+                and random.random() < self.drop_prob)
+
+
+_chaos = _Chaos()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -44,6 +79,8 @@ class MsgConnection:
         self.closed = False
 
     def send(self, msg: dict) -> None:
+        if _chaos.enabled and _chaos.intercept(msg):
+            return  # injected drop
         data = pickle.dumps(msg, protocol=5)
         if len(data) > MAX_FRAME:
             raise ValueError(f"frame too large: {len(data)}")

@@ -112,8 +112,11 @@ class ArenaStore:
     """
 
     def __init__(self, session_id: str, capacity: int = 0):
+        from ray_tpu._private.object_store import spill_dir_for
+
         self.session_id = session_id
         self.path = os.path.join("/dev/shm", f"rtpu_{session_id}_arena")
+        self.spill_dir = spill_dir_for(session_id)
         self._dll = _ensure_lib()
         cap = capacity or DEFAULT_CAPACITY
         self._handle = self._dll.rtpu_store_open(self.path.encode(), cap, 1)
@@ -129,16 +132,23 @@ class ArenaStore:
 
     # -- interface shared with ShmObjectStore ------------------------------
 
+    def _spill_path(self, object_hex: str) -> str:
+        return os.path.join(self.spill_dir, object_hex)
+
     def put_parts(self, object_hex: str, parts, total: int) -> int:
         oid = object_hex.encode()
         off = self._dll.rtpu_store_create(self._handle, oid, max(total, 1))
         if off == -2:
             return total  # already present (idempotent re-put)
         if off < 0:
-            raise ArenaFullError(
-                f"object {object_hex} ({total} B) does not fit in the arena "
-                f"(capacity {self._dll.rtpu_store_capacity(self._handle)} B, "
-                f"used {self._dll.rtpu_store_used(self._handle)} B)")
+            # no room even after eviction: create straight in the spill tier
+            os.makedirs(self.spill_dir, exist_ok=True)
+            tmp = self._spill_path(object_hex) + ".tmp"
+            with open(tmp, "wb") as f:
+                for p in parts:
+                    f.write(p)
+            os.replace(tmp, self._spill_path(object_hex))
+            return total
         pos = off
         for p in parts:
             n = len(p) if isinstance(p, bytes) else p.nbytes
@@ -149,32 +159,71 @@ class ArenaStore:
             raise OSError(f"seal({object_hex}) failed: {rc}")
         return total
 
-    def get(self, object_hex: str) -> _ArenaObject:
+    def get(self, object_hex: str):
         oid = object_hex.encode()
         size = ctypes.c_uint64()
         off = self._dll.rtpu_store_get(self._handle, oid, ctypes.byref(size))
         if off < 0:
-            raise FileNotFoundError(f"object {object_hex} not in arena (evicted?)")
+            # spill-tier fallback (mmap'd from disk)
+            try:
+                f = open(self._spill_path(object_hex), "rb")
+            except FileNotFoundError:
+                raise FileNotFoundError(
+                    f"object {object_hex} not in arena (evicted?)") from None
+            from ray_tpu._private.object_store import PlasmaObject
+
+            n = os.fstat(f.fileno()).st_size
+            mm = mmap.mmap(f.fileno(), n, prot=mmap.PROT_READ)
+            return PlasmaObject(memoryview(mm), mm, f)
         view = memoryview(self._mm)[off:off + size.value]
         return _ArenaObject(view, self, object_hex)
 
     def contains(self, object_hex: str) -> bool:
-        return bool(self._dll.rtpu_store_contains(self._handle, object_hex.encode()))
+        return (bool(self._dll.rtpu_store_contains(self._handle, object_hex.encode()))
+                or os.path.exists(self._spill_path(object_hex)))
 
     def size(self, object_hex: str) -> int:
         n = self._dll.rtpu_store_size(self._handle, object_hex.encode())
         if n < 0:
-            raise FileNotFoundError(object_hex)
+            try:
+                return os.stat(self._spill_path(object_hex)).st_size
+            except FileNotFoundError:
+                raise FileNotFoundError(object_hex) from None
         return n
+
+    def spill(self, object_hex: str) -> bool:
+        """Copy an arena object to the disk tier, then drop it from the arena."""
+        oid = object_hex.encode()
+        size = ctypes.c_uint64()
+        off = self._dll.rtpu_store_get(self._handle, oid, ctypes.byref(size))
+        if off < 0:
+            return False
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            tmp = self._spill_path(object_hex) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(self._mm[off:off + size.value])
+            os.replace(tmp, self._spill_path(object_hex))
+        finally:
+            self._dll.rtpu_store_release(self._handle, oid)
+        self._dll.rtpu_store_delete(self._handle, oid)
+        return True
 
     def delete(self, object_hex: str) -> None:
         self._dll.rtpu_store_delete(self._handle, object_hex.encode())
+        try:
+            os.unlink(self._spill_path(object_hex))
+        except FileNotFoundError:
+            pass
 
     def cleanup_session(self) -> None:
         try:
             os.unlink(self.path)
         except OSError:
             pass
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
 
     # -- arena-specific ----------------------------------------------------
 

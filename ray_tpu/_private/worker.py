@@ -25,24 +25,54 @@ from ray_tpu._private.protocol import ConnectionClosed, connect_address
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
     RayTaskError,
     RayTpuError,
 )
 
 INLINE_LIMIT = 64 * 1024
 ARGS_INLINE_LIMIT = 256 * 1024
+MAX_RECON_ATTEMPTS = 4
+
+
+# the process's CoreWorker, for ObjectRef lifecycle hooks (None in local
+# mode and before init; distinct from _global_worker which is worker-only)
+_ref_tracker = None
+
+# thread-local capture: while serializing a value, ObjectRef.__reduce__
+# appends every ref pickled inside, so stored containers can declare the
+# refs they keep alive (reference: the serializer's contained-object-ids)
+_reduce_capture = threading.local()
+
+
+def _serialize_capturing(fn, *args):
+    """Run a serialization call, returning (result, contained_ref_hexes)."""
+    prev = getattr(_reduce_capture, "refs", None)
+    _reduce_capture.refs = []
+    try:
+        out = fn(*args)
+        return out, list(dict.fromkeys(_reduce_capture.refs))
+    finally:
+        _reduce_capture.refs = prev
 
 
 class ObjectRef:
-    """Handle to a (possibly pending) remote object.
+    """Handle to a (possibly pending) remote object. Refcounted: creating one
+    registers a local reference, GC drops it; when a process's last local
+    reference to an oid disappears the GCS is told, and an object whose
+    references are all gone is freed cluster-wide.
 
-    (reference: python/ray/includes/object_ref.pxi:37)
+    (reference: python/ray/includes/object_ref.pxi:37 + the distributed
+    ReferenceCounter, src/ray/core_worker/reference_counter.h:43 — here the
+    count is GCS-arbitered rather than owner-distributed.)
     """
 
-    __slots__ = ("_hex",)
+    __slots__ = ("_hex", "_tracked")
 
     def __init__(self, hex_id: str):
         self._hex = hex_id
+        tracker = _ref_tracker
+        self._tracked = tracker is not None and tracker.incref(hex_id)
 
     def hex(self) -> str:
         return self._hex
@@ -57,7 +87,66 @@ class ObjectRef:
         return hash(("ObjectRef", self._hex))
 
     def __reduce__(self):
+        cap = getattr(_reduce_capture, "refs", None)
+        if cap is not None:
+            cap.append(self._hex)
         return (ObjectRef, (self._hex,))
+
+    def __del__(self):
+        if self._tracked:
+            tracker = _ref_tracker
+            if tracker is not None:
+                try:
+                    tracker.decref(self._hex)
+                except Exception:
+                    pass  # interpreter/worker teardown
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs of a `num_returns="streaming"` task;
+    refs arrive as the producer yields, with producer-side backpressure.
+
+    (reference: python/ray/_raylet.pyx:299 ObjectRefGenerator /
+    _private/object_ref_generator.py — the substrate of Ray Data map tasks.)
+    """
+
+    def __init__(self, task_id: str, worker: "CoreWorker"):
+        self._task_id = task_id
+        self._worker = worker
+        self._index = 0
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._done:
+            raise StopIteration
+        reply = self._worker.rpc(
+            {"type": "stream_next", "task_id": self._task_id,
+             "index": self._index}, timeout=86400.0)
+        if reply.get("done"):
+            self._done = True
+            err = reply.get("error")
+            if err is not None:
+                raise ser.loads(err)
+            raise StopIteration
+        self._index += 1
+        # consumption signal releases producer backpressure
+        self._worker.send_no_reply(
+            {"type": "stream_consumed", "task_id": self._task_id,
+             "index": self._index})
+        return ObjectRef(reply["oid"])
+
+    def completed(self) -> bool:
+        return self._done
+
+    def __del__(self):
+        try:
+            self._worker.send_no_reply(
+                {"type": "stream_release", "task_id": self._task_id})
+        except Exception:
+            pass
 
 
 class _RefMarker:
@@ -118,6 +207,9 @@ class CoreWorker:
         self.store = make_object_store(
             os.environ.get("RAY_TPU_STORE_NS", session_id))
         self._fetcher = None  # lazy ObjectFetcher for cross-host pulls
+        self._stream_acks: dict[str, int] = {}  # producing streams: consumed idx
+        self._stream_events: dict[str, threading.Event] = {}
+        self._stream_cancelled: set[str] = set()
         from ray_tpu._private.accelerators import current_worker_chips
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
@@ -126,6 +218,65 @@ class CoreWorker:
                           "tpu_chips": current_worker_chips()})
         if reply.get("ok") is False:
             raise RayTpuError(f"registration rejected: {reply.get('error')}")
+        # reference counting: per-process local counts, process-level
+        # transitions batched to the GCS (reference: reference_counter.h:43)
+        self._local_refs: dict[str, int] = {}
+        # reentrant: a cyclic-GC run triggered by an allocation inside
+        # incref/decref can finalize an ObjectRef on the same thread, whose
+        # __del__ re-enters decref while the lock is held
+        self._ref_lock = threading.RLock()
+        self._ref_deltas: dict[str, int] = {}
+        self._gc_enabled = os.environ.get("RAY_TPU_AUTO_GC", "1") != "0"
+        self._ref_flush_thread = threading.Thread(
+            target=self._ref_flush_loop, daemon=True, name="cw-refs")
+        self._ref_flush_thread.start()
+        global _ref_tracker
+        _ref_tracker = self
+
+    # -------------------------------------------------------------- refcounts
+
+    def incref(self, oid: str) -> bool:
+        if not self._gc_enabled:
+            return False
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) + 1
+            self._local_refs[oid] = n
+            if n == 1:  # first local ref in this process
+                self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
+        return True
+
+    def decref(self, oid: str) -> None:
+        drop_cache = False
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n <= 0:
+                self._local_refs.pop(oid, None)
+                self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) - 1
+                drop_cache = True
+            else:
+                self._local_refs[oid] = n
+        if drop_cache:
+            self._memory.pop(oid, None)
+            self._plasma_refs.pop(oid, None)
+            self._obj_waits.pop(oid, None)
+
+    def _ref_flush_loop(self):
+        while self._alive:
+            time.sleep(0.2)
+            self._flush_ref_deltas()
+
+    def _flush_ref_deltas(self):
+        with self._ref_lock:
+            deltas = dict(self._ref_deltas)
+            self._ref_deltas.clear()
+        # zero entries still ship: a +1/-1 that cancelled within one flush
+        # window must still tell the GCS the object was referenced (and is
+        # no longer) — otherwise it can never become freeable
+        if deltas:
+            try:
+                self.send_no_reply({"type": "ref_delta", "deltas": deltas})
+            except ConnectionClosed:
+                pass
 
     # ------------------------------------------------------------------- rpc
 
@@ -173,6 +324,21 @@ class CoreWorker:
                 elif msg.get("type") == "log_line":
                     # remote-host worker logs republished via GCS
                     print(f"({msg['source']}) {msg['line']}", file=sys.stderr)
+                elif msg.get("type") == "stream_ack":
+                    # consumer progress: release producer backpressure
+                    tid = msg["task_id"]
+                    self._stream_acks[tid] = max(
+                        self._stream_acks.get(tid, 0), msg["consumed"])
+                    ev = self._stream_events.get(tid)
+                    if ev is not None:
+                        ev.set()
+                elif msg.get("type") == "stream_cancel":
+                    # consumer released the generator: stop producing
+                    tid = msg["task_id"]
+                    self._stream_cancelled.add(tid)
+                    ev = self._stream_events.get(tid)
+                    if ev is not None:
+                        ev.set()
         except ConnectionClosed:
             self._alive = False
             self.exec_queue.put(None)
@@ -194,13 +360,21 @@ class CoreWorker:
 
         marked_args = tuple(mark(a) for a in args)
         marked_kwargs = {k: mark(v) for k, v in kwargs.items()}
-        payload = ser.dumps((marked_args, marked_kwargs))
+        # refs nested inside args (top-level ones became _RefMarkers/deps):
+        # the GCS holds them until the task completes
+        payload, ref_holds = _serialize_capturing(
+            ser.dumps, (marked_args, marked_kwargs))
         spec_part: dict = {}
+        if ref_holds:
+            spec_part["ref_holds"] = ref_holds
         if len(payload) > ARGS_INLINE_LIMIT:
             oid = ObjectID.for_put().hex()
             self.store.put_parts(oid, [payload], len(payload))
+            # pinned: no user ref ever exists for an args blob — the GCS
+            # frees it with the task's retained lineage (or at actor death)
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
-                                "size": len(payload), "host": self.host_id})
+                                "size": len(payload), "host": self.host_id,
+                                "pin": True})
             spec_part["args_oid"] = oid
         else:
             spec_part["args"] = payload
@@ -234,6 +408,8 @@ class CoreWorker:
             **spec_part,
         }
         self.rpc({"type": "submit_task", "spec": spec})
+        if num_returns == "streaming":
+            return ObjectRefGenerator(task_id, self)
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
     def create_actor(
@@ -294,6 +470,8 @@ class CoreWorker:
         reply = self.rpc({"type": "actor_task", "spec": spec})
         if not reply.get("ok"):
             raise ActorDiedError(f"actor {actor_id[:8]} is dead")
+        if num_returns == "streaming":
+            return ObjectRefGenerator(task_id, self)
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
     def wait_actor_ready(self, actor_id: str, timeout: float | None = None):
@@ -306,24 +484,52 @@ class CoreWorker:
 
     # ---------------------------------------------------------------- objects
 
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, pin: bool = False) -> ObjectRef:
+        """Store a value; `pin=True` exempts it from automatic GC (for
+        infrastructure objects handed around by raw id, e.g. channels)."""
         oid = ObjectID.for_put().hex()
-        parts, total = ser.dumps_into(value)
+        (parts, total), contained = _serialize_capturing(ser.dumps_into, value)
         if total <= INLINE_LIMIT:
             blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
-            self.send_no_reply({"type": "object_put", "oid": oid, "where": "inline", "inline": blob, "size": total})
+            self.send_no_reply({"type": "object_put", "oid": oid, "where": "inline",
+                                "inline": blob, "size": total, "pin": pin,
+                                "contained": contained})
         else:
             self.store.put_parts(oid, parts, total)
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
-                                "size": total, "host": self.host_id})
+                                "size": total, "host": self.host_id, "pin": pin,
+                                "contained": contained})
         return ObjectRef(oid)
 
+    def _ensure_local(self, oid: str, reply: dict) -> dict:
+        """Guarantee `oid` is readable in this process (inline payload or a
+        local store copy), pulling cross-host and triggering lineage
+        reconstruction as needed. Returns the final wait_object reply.
+        (reference: object_recovery_manager.h:41.)"""
+        for _ in range(MAX_RECON_ATTEMPTS):
+            if reply["where"] == "inline":
+                return reply
+            if self.store.contains(oid) or self._pull_remote(oid, reply):
+                return reply
+            # every advertised copy is gone (host died / store evicted): ask
+            # the GCS to reconstruct from lineage, then wait again
+            action = self.rpc({"type": "object_lost", "oid": oid})["action"]
+            if action in ("reconstructing", "pending", "ready"):
+                reply = self.rpc({"type": "wait_object", "oid": oid},
+                                 timeout=600.0)
+                continue
+            raise ObjectLostError(
+                f"object {oid[:12]}… lost: all copies gone and no lineage "
+                f"to reconstruct it (action={action})")
+        raise ObjectLostError(
+            f"object {oid[:12]}… unrecoverable after "
+            f"{MAX_RECON_ATTEMPTS} reconstruction attempts")
+
     def _materialize(self, oid: str, reply: dict) -> Any:
+        reply = self._ensure_local(oid, reply)
         if reply["where"] == "inline":
             value = ser.loads(reply["inline"])
         else:
-            if not self.store.contains(oid):
-                self._pull_remote(oid, reply)
             plasma = self.store.get(oid)
             self._plasma_refs[oid] = plasma
             value = ser.loads(plasma.buf)
@@ -332,10 +538,10 @@ class CoreWorker:
         self._memory[oid] = value
         return value
 
-    def _pull_remote(self, oid: str, reply: dict) -> None:
+    def _pull_remote(self, oid: str, reply: dict) -> bool:
         """Object is in shm on another host: chunk-pull it into the local
         store and register the new copy (reference: pull-on-demand,
-        object_manager.h:128)."""
+        object_manager.h:128). Returns False when no copy is reachable."""
         from ray_tpu._private.object_transfer import ObjectFetcher
 
         if self._fetcher is None:
@@ -348,10 +554,8 @@ class CoreWorker:
                 self.send_no_reply({"type": "object_put", "oid": oid,
                                     "where": "shm", "size": reply.get("size", 0),
                                     "host": self.host_id})
-                return
-        raise RayTpuError(
-            f"object {oid[:12]}… is not in the local store and could not be "
-            f"pulled from {[h for h, _ in locations]}")
+                return True
+        return False
 
     def get_object(self, oid: str, timeout: float | None = None) -> Any:
         if oid in self._memory:
@@ -482,9 +686,10 @@ class CoreWorker:
         if "args_oid" in spec:
             oid = spec["args_oid"]
             if not self.store.contains(oid):
-                # oversized args submitted from another host: pull first
+                # oversized args submitted from another host: pull (with the
+                # same lost-object recovery as normal gets)
                 reply = self.rpc({"type": "wait_object", "oid": oid}, timeout=300.0)
-                self._pull_remote(oid, reply)
+                self._ensure_local(oid, reply)
             plasma = self.store.get(oid)
             args, kwargs = ser.loads(plasma.buf)
         else:
@@ -497,10 +702,58 @@ class CoreWorker:
     def current_task_id(self) -> str | None:
         return getattr(self._task_ctx, "task_id", None)
 
+    def _stream_results(self, spec: dict, out) -> None:
+        """Drive a streaming task: each yielded value becomes its own object,
+        reported incrementally; the producer pauses when it runs more than
+        `backpressure` items ahead of the consumer (reference:
+        _raylet.pyx:299 streaming generators with backpressure)."""
+        task_id = spec["task_id"]
+        bp = int(spec.get("backpressure") or 16)
+        produced = 0
+        try:
+            for val in out:
+                if task_id in self._stream_cancelled:
+                    break  # consumer dropped the generator
+                oid = f"{task_id}s{produced:06d}"
+                (parts, total), refs = _serialize_capturing(ser.dumps_into, val)
+                msg = {"type": "stream_item", "wid": self.wid, "task_id": task_id,
+                       "index": produced, "oid": oid, "size": total,
+                       "contained": refs}
+                if total <= INLINE_LIMIT:
+                    blob = b"".join(bytes(p) if not isinstance(p, bytes) else p
+                                    for p in parts)
+                    msg.update(where="inline", inline=blob)
+                else:
+                    self.store.put_parts(oid, parts, total)
+                    msg.update(where="shm", host=self.host_id)
+                self.send_no_reply(msg)
+                produced += 1
+                stalled = False
+                while True:
+                    if (task_id in self._stream_cancelled
+                            or produced - self._stream_acks.get(task_id, 0) <= bp):
+                        break
+                    ev = self._stream_events.setdefault(task_id, threading.Event())
+                    ev.clear()
+                    if produced - self._stream_acks.get(task_id, 0) <= bp:
+                        break  # ack raced the clear
+                    if not ev.wait(60.0):
+                        stalled = True  # consumer gone/stalled: stop, don't
+                        break           # produce unboundedly past it
+                if stalled:
+                    break
+            self.send_no_reply({"type": "stream_end", "wid": self.wid,
+                                "task_id": task_id, "error": None})
+        finally:
+            self._stream_acks.pop(task_id, None)
+            self._stream_events.pop(task_id, None)
+            self._stream_cancelled.discard(task_id)
+
     def execute_task(self, spec: dict) -> None:
         kind = spec["kind"]
         error_blob = None
         results = []
+        contained_map: dict = {}
         self._task_ctx.task_id = spec["task_id"]
         try:
             args, kwargs = self._resolve_args(spec)
@@ -528,12 +781,19 @@ class CoreWorker:
             else:
                 raise RayTpuError(f"unknown task kind {kind}")
             n = spec["num_returns"]
-            values = [out] if n == 1 else (list(out) if n > 0 else [])
-            if n > 1 and len(values) != n:
+            if n == "streaming":
+                self._stream_results(spec, out)
+                values = []
+                n = 0
+            else:
+                values = [out] if n == 1 else (list(out) if n > 0 else [])
+            if isinstance(n, int) and n > 1 and len(values) != n:
                 raise ValueError(f"task declared num_returns={n} but returned {len(values)} values")
             for i, val in enumerate(values):
                 oid = f"{spec['task_id']}r{i:04d}"
-                parts, total = ser.dumps_into(val)
+                (parts, total), refs = _serialize_capturing(ser.dumps_into, val)
+                if refs:
+                    contained_map[oid] = refs
                 if total <= INLINE_LIMIT:
                     blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
                     results.append((oid, "inline", blob, total))
@@ -550,14 +810,31 @@ class CoreWorker:
                 wrapped = RayTaskError(spec.get("name") or spec.get("method", kind), tb, None)
                 blob = ser.dumps(wrapped)
             error_blob = repr(e)
-            results = [
-                (f"{spec['task_id']}r{i:04d}", "inline", blob, len(blob))
-                for i in range(spec["num_returns"])
-            ]
+            if spec["num_returns"] == "streaming":
+                # mid-stream failure: already-yielded items stay readable,
+                # the consumer's next() raises the error
+                self.send_no_reply({"type": "stream_end", "wid": self.wid,
+                                    "task_id": spec["task_id"], "error": blob})
+                results = []
+            else:
+                results = [
+                    (f"{spec['task_id']}r{i:04d}", "inline", blob, len(blob))
+                    for i in range(spec["num_returns"])
+                ]
         finally:
             self._task_ctx.task_id = None
+            # drop arg-value caches this task materialized unless user code
+            # in this process also holds refs to them
+            for dep in spec.get("deps", ()):
+                with self._ref_lock:
+                    held = self._local_refs.get(dep, 0) > 0
+                if not held:
+                    self._memory.pop(dep, None)
+                    self._plasma_refs.pop(dep, None)
         lite = {k: spec.get(k) for k in ("task_id", "kind", "actor_id", "resources", "num_returns", "max_retries", "retries_used")}
-        self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite, "results": results, "error": error_blob})
+        self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite,
+                            "results": results, "error": error_blob,
+                            "contained": contained_map})
 
     def exec_loop(self):
         """Main loop of worker processes (driver never calls this)."""
@@ -573,7 +850,14 @@ class CoreWorker:
                 self.execute_task(spec)
 
     def disconnect(self):
+        global _ref_tracker
+        if _ref_tracker is self:
+            _ref_tracker = None
         self._alive = False
+        try:
+            self._flush_ref_deltas()
+        except Exception:
+            pass
         try:
             self.conn.close()
         except Exception:

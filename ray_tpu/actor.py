@@ -30,6 +30,8 @@ class ActorMethod:
             self._actor_id, self._method_name, args, kwargs,
             num_returns=self._num_returns,
         )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
 

@@ -57,7 +57,11 @@ class Channel:
     def write(self, value, timeout: float | None = 60.0) -> None:
         from ray_tpu._private.poll import poll_until
 
-        ref = ray_tpu.put(value)
+        # pinned: the payload travels broker→reader as a raw id, invisible to
+        # the reference counter; the reader (or close) frees it explicitly
+        from ray_tpu._private.api import _get_worker
+
+        ref = _get_worker().put(value, pin=True)
 
         def offer():
             ok = ray_tpu.get(self._broker.offer.remote(ref.hex()))

@@ -65,6 +65,42 @@ class LLMServer:
     def engine_stats(self) -> dict:
         return self.engine.stats()
 
+    def completions_stream(self, body: dict):
+        """Token-by-token SSE chunks, OpenAI text_completion.chunk shape
+        (reference: llm serve streams engine tokens through the replica —
+        llm_server.py + proxy streaming)."""
+        prompt = body.get("prompt", "")
+        model = self.config.model_loading_config.model_id
+        ids = self.tokenizer.encode(prompt)
+        for tok in self.engine.stream(ids, self._params(body)):
+            yield {
+                "object": "text_completion.chunk",
+                "model": model,
+                "choices": [{"index": 0, "text": self.tokenizer.decode([tok]),
+                             "finish_reason": None}],
+            }
+        yield {"object": "text_completion.chunk", "model": model,
+               "choices": [{"index": 0, "text": "", "finish_reason": "stop"}]}
+
+    def chat_stream(self, body: dict):
+        msgs = body.get("messages", [])
+        prompt = "".join(f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+                         for m in msgs) + "<assistant>"
+        for chunk in self.completions_stream({**body, "prompt": prompt}):
+            text = chunk["choices"][0].pop("text")
+            chunk["object"] = "chat.completion.chunk"
+            chunk["choices"][0]["delta"] = {"content": text}
+            yield chunk
+
+    def stream_request(self, request: dict):
+        """Streaming HTTP entry (SSE through the proxy)."""
+        path = request.get("path", "")
+        body = request.get("body") or {}
+        if path.endswith("/chat/completions"):
+            yield from self.chat_stream(body)
+        else:
+            yield from self.completions_stream(body)
+
     def __call__(self, request: dict) -> dict:
         """HTTP entry: route by path suffix (OpenAI wire shapes)."""
         path = request.get("path", "")
@@ -79,6 +115,9 @@ def build_openai_app(llm_config: LLMConfig) -> serve.Application:
     serve/core/ingress; deployment options come from deployment_config.)"""
     dep = LLMServer
     opts = dict(llm_config.deployment_config)
-    if opts:
-        dep = dep.options(**opts)
+    # LLM serving defaults to prefix-aware routing: requests sharing a prompt
+    # prefix hit the same replica for KV reuse (reference: llm request_router/
+    # prefix_aware/prefix_tree.py)
+    opts.setdefault("request_router", "prefix_aware")
+    dep = dep.options(**opts)
     return dep.bind(llm_config)

@@ -76,6 +76,8 @@ class RemoteFunction:
             name=getattr(self._func, "__name__", "task"),
             strategy=strategy_to_spec(self._strategy),
         )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator (reference: _raylet.pyx:299)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

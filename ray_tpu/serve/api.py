@@ -70,6 +70,7 @@ def run(target: Application, *, name: str = "default",
             "user_config": cfg.user_config,
             "autoscaling_config": (dataclasses.asdict(cfg.autoscaling_config)
                                    if cfg.autoscaling_config else None),
+            "request_router": cfg.request_router,
         }
         specs.append({
             "name": app.deployment.name,

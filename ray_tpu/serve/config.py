@@ -32,6 +32,9 @@ class DeploymentConfig:
     user_config: dict | None = None
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 5.0
+    # "pow2" | "prefix_aware" (reference: pluggable RequestRouter —
+    # request_router/pow_2_router.py, llm prefix_aware/prefix_tree.py)
+    request_router: str = "pow2"
 
     @property
     def initial_replicas(self) -> int:

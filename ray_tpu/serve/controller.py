@@ -104,7 +104,8 @@ class ServeController:
                 "apps": dict(self.apps),
                 "deployments": {
                     full: {"replicas": [h.actor_id for h in st.replicas.values()],
-                           "max_ongoing": st.config["max_ongoing_requests"]}
+                           "max_ongoing": st.config["max_ongoing_requests"],
+                           "request_router": st.config.get("request_router", "pow2")}
                     for full, st in self.deployments.items()
                 },
             }

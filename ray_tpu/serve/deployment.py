@@ -21,7 +21,7 @@ class Deployment:
 
     def options(self, *, name=None, num_replicas=None, max_ongoing_requests=None,
                 ray_actor_options=None, autoscaling_config=None,
-                user_config=None, **_ignored) -> "Deployment":
+                user_config=None, request_router=None, **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(self.config.num_replicas if num_replicas is None
                           else (None if num_replicas == "auto" else num_replicas)),
@@ -35,6 +35,8 @@ class Deployment:
                                  if isinstance(autoscaling_config, dict)
                                  else autoscaling_config)),
             user_config=self.config.user_config if user_config is None else user_config,
+            request_router=(self.config.request_router if request_router is None
+                            else request_router),
         )
         if num_replicas == "auto" and cfg.autoscaling_config is None:
             cfg.autoscaling_config = AutoscalingConfig()
@@ -74,7 +76,8 @@ class Application:
 def deployment(func_or_class=None, *, name=None, num_replicas=1,
                max_ongoing_requests=8, ray_actor_options=None,
                autoscaling_config=None, user_config=None,
-               health_check_period_s: float = 2.0):
+               health_check_period_s: float = 2.0,
+               request_router: str = "pow2"):
     """Decorator usable bare or with options.
     (reference: serve/api.py:333.)"""
 
@@ -90,6 +93,7 @@ def deployment(func_or_class=None, *, name=None, num_replicas=1,
                                 else autoscaling_config),
             user_config=user_config,
             health_check_period_s=health_check_period_s,
+            request_router=request_router,
         )
         if num_replicas == "auto" and cfg.autoscaling_config is None:
             cfg.autoscaling_config = AutoscalingConfig()

@@ -38,6 +38,32 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterates values as the replica yields them.
+
+    (reference: serve handles return DeploymentResponseGenerator for
+    stream=True — serve/handle.py; transport here is the runtime's
+    streaming-generator task.)"""
+
+    def __init__(self, ref_gen, on_done):
+        self._gen = ref_gen
+        self._finalizer = weakref.finalize(self, on_done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finalizer()
+            raise
+        except Exception:
+            self._finalizer()
+            raise
+        return ray_tpu.get(ref)
+
+
 class _Router:
     def __init__(self, deployment_full_name: str, controller):
         self.name = deployment_full_name
@@ -47,6 +73,7 @@ class _Router:
         self.inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._prefix_policy = None  # created when the table asks for it
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -62,9 +89,16 @@ class _Router:
             dep = table["deployments"].get(self.name)
             self.replicas = dep["replicas"] if dep else []
             self.inflight = {r: self.inflight.get(r, 0) for r in self.replicas}
+            if dep and dep.get("request_router") == "prefix_aware" \
+                    and self._prefix_policy is None:
+                from ray_tpu.serve.request_router import PrefixAwarePolicy
 
-    def pick(self) -> str:
-        """Power-of-two-choices on client-side in-flight counts."""
+                self._prefix_policy = PrefixAwarePolicy()
+
+    def pick(self, hint: str | None = None) -> str:
+        """Power-of-two-choices on client-side in-flight counts; deployments
+        configured with request_router="prefix_aware" prefer the replica
+        that last served the request's prompt prefix (KV reuse)."""
         self._refresh()
         deadline = time.monotonic() + 30.0
         backoff = 0.02
@@ -75,11 +109,17 @@ class _Router:
             backoff = min(backoff * 2, 0.5)  # don't hammer the controller
             self._refresh(force=True)
         with self._lock:
-            if len(self.replicas) == 1:
-                choice = self.replicas[0]
-            else:
+            def pow2():
+                if len(self.replicas) == 1:
+                    return self.replicas[0]
                 a, b = random.sample(self.replicas, 2)
-                choice = a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) else b
+                return a if self.inflight.get(a, 0) <= self.inflight.get(b, 0) else b
+
+            if self._prefix_policy is not None:
+                choice = self._prefix_policy.pick(
+                    self.replicas, self.inflight, hint, pow2)
+            else:
+                choice = pow2()
             self.inflight[choice] = self.inflight.get(choice, 0) + 1
             return choice
 
@@ -92,27 +132,33 @@ class _Router:
         """Replica died: force a table refresh next pick."""
         with self._lock:
             self.replicas = [r for r in self.replicas if r != replica]
+            if self._prefix_policy is not None:
+                self._prefix_policy.on_replica_dead(replica)
         self._last_refresh = 0.0
 
 
 class DeploymentHandle:
     def __init__(self, deployment_full_name: str, controller=None,
-                 method_name: str = "__call__", multiplexed_model_id: str | None = None):
+                 method_name: str = "__call__", multiplexed_model_id: str | None = None,
+                 stream: bool = False):
         from ray_tpu.serve.api import _get_controller
 
         self._name = deployment_full_name
         self._controller = controller or _get_controller()
         self._method = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router = _Router(deployment_full_name, self._controller)
 
     def options(self, *, method_name: str | None = None,
-                multiplexed_model_id: str | None = None, **_ignored) -> "DeploymentHandle":
+                multiplexed_model_id: str | None = None,
+                stream: bool | None = None, **_ignored) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h._name = self._name
         h._controller = self._controller
         h._method = method_name or self._method
         h._model_id = multiplexed_model_id or self._model_id
+        h._stream = self._stream if stream is None else stream
         h._router = self._router  # share in-flight state across method views
         return h
 
@@ -121,16 +167,23 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a
                      for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
+        hint = kwargs.pop("_routing_hint", None)
         last_err = None
         for _ in range(3):  # retry on replica death with a fresh table
-            replica_id = self._router.pick()
+            replica_id = self._router.pick(hint)
             replica = ActorHandle(replica_id)
             try:
+                if self._stream:
+                    gen = replica.handle_request_stream.options(
+                        num_returns="streaming").remote(
+                        self._method, args, kwargs, self._model_id)
+                    return DeploymentResponseGenerator(
+                        gen, lambda r=replica_id: self._router.done(r))
                 ref = replica.handle_request.remote(self._method, args, kwargs,
                                                     self._model_id)
                 return DeploymentResponse(
@@ -142,4 +195,5 @@ class DeploymentHandle:
         raise RuntimeError(f"could not assign request to {self._name}: {last_err}")
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, None, self._method, self._model_id))
+        return (DeploymentHandle,
+                (self._name, None, self._method, self._model_id, self._stream))

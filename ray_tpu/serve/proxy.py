@@ -36,9 +36,20 @@ class ProxyActor:
             def log_message(self, *a):  # no stderr spam in workers
                 pass
 
+            def _wants_stream(self, body: bytes) -> bool:
+                if "text/event-stream" in (self.headers.get("Accept") or ""):
+                    return True
+                try:
+                    return bool(body and json.loads(body).get("stream"))
+                except Exception:
+                    return False
+
             def _run(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
+                if self._wants_stream(body):
+                    self._run_stream(body)
+                    return
                 try:
                     status, payload = proxy._dispatch(self.path, self.command, body)
                 except Exception as e:  # noqa: BLE001 — proxy must answer
@@ -49,6 +60,41 @@ class ProxyActor:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _run_stream(self, body: bytes):
+                """SSE: one `data:` event per yielded chunk, chunked framing
+                (reference: streaming responses through the proxy,
+                serve/_private/proxy.py:706)."""
+                try:
+                    gen = proxy._dispatch_stream(self.path, self.command, body)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for item in gen:
+                        chunk(b"data: " + json.dumps(item, default=str).encode()
+                              + b"\n\n")
+                    chunk(b"data: [DONE]\n\n")
+                except Exception as e:  # noqa: BLE001 — mid-stream failure
+                    chunk(b"data: " + json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode() + b"\n\n")
+                finally:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
 
             do_GET = do_POST = do_PUT = do_DELETE = _run
 
@@ -70,6 +116,30 @@ class ProxyActor:
                 self._routes = table["routes"]
 
     def _dispatch(self, path: str, method: str, body: bytes) -> tuple[int, bytes]:
+        handle = self._resolve_handle(path)
+        if handle is None:
+            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+        request = {
+            "path": path, "method": method,
+            "body": json.loads(body) if body else None,
+        }
+        result = handle.remote(
+            request, _routing_hint=self._routing_hint(request)).result(timeout_s=60.0)
+        return 200, json.dumps(result, default=str).encode()
+
+    @staticmethod
+    def _routing_hint(request: dict) -> str | None:
+        """Prompt text for prefix-aware routing (None falls back to pow2)."""
+        body = request.get("body") or {}
+        if isinstance(body, dict):
+            if body.get("prompt"):
+                return str(body["prompt"])
+            msgs = body.get("messages")
+            if msgs:
+                return "".join(str(m.get("content", "")) for m in msgs)
+        return None
+
+    def _resolve_handle(self, path: str):
         from ray_tpu.serve.handle import DeploymentHandle
 
         self._refresh_routes()
@@ -80,16 +150,22 @@ class ProxyActor:
                         key=len, default=None)
             dep = self._routes.get(match) if match else None
         if dep is None:
-            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+            return None
         handle = self._handles.get(dep)
         if handle is None:
             handle = self._handles[dep] = DeploymentHandle(dep, self.controller)
+        return handle
+
+    def _dispatch_stream(self, path: str, method: str, body: bytes):
+        handle = self._resolve_handle(path)
+        if handle is None:
+            raise ValueError(f"no route for {path}")
         request = {
             "path": path, "method": method,
             "body": json.loads(body) if body else None,
         }
-        result = handle.remote(request).result(timeout_s=60.0)
-        return 200, json.dumps(result, default=str).encode()
+        return handle.options(stream=True, method_name="stream_request").remote(
+            request, _routing_hint=self._routing_hint(request))
 
     def shutdown(self):
         self.server.shutdown()

@@ -61,6 +61,26 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
+                              model_id: str | None = None):
+        """Streaming variant: the user method is a generator; each yielded
+        item ships incrementally via the runtime's streaming-generator task
+        (reference: serve replicas stream generator chunks back — replica.py)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _replica_ctx.model_id = model_id
+        try:
+            fn = getattr(self.user, method, None)
+            if fn is None:
+                raise AttributeError(
+                    f"deployment {self.deployment_name} has no method {method!r}")
+            yield from fn(*args, **kwargs)
+        finally:
+            _replica_ctx.model_id = None
+            with self._lock:
+                self._ongoing -= 1
+
     def ongoing(self) -> int:
         return self._ongoing
 

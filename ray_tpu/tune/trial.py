@@ -39,4 +39,5 @@ class Trial:
     def summary(self) -> dict:
         return {"trial_id": self.trial_id, "config": self.config,
                 "status": self.status, "last_result": self.last_result,
-                "error": self.error}
+                "error": self.error, "iteration": self.iteration,
+                "checkpoint_path": getattr(self.latest_checkpoint, "path", None)}

@@ -96,18 +96,82 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig(name="tune_run")
+        self._restore_summaries: list[dict] | None = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                param_space: dict | None = None,
+                tune_config: TuneConfig | None = None,
+                run_config: RunConfig | None = None) -> "Tuner":
+        """Resume a crashed/interrupted experiment from its snapshot:
+        finished trials keep their results without re-running, unfinished
+        trials restart from their latest checkpoint, and the remaining
+        sample budget is generated fresh (reference:
+        tune/execution/experiment_state.py + Tuner.restore)."""
+        state_path = os.path.join(path, "experiment_state.json")
+        with open(state_path) as f:
+            summaries = json.load(f)
+        # the search space / tune config were pickled at fit() start
+        # (reference: tuner.pkl written by Tuner for restore)
+        pkl_path = os.path.join(path, "tuner.pkl")
+        if (param_space is None or tune_config is None) and os.path.exists(pkl_path):
+            import cloudpickle
+
+            with open(pkl_path, "rb") as f:
+                saved = cloudpickle.load(f)
+            param_space = param_space or saved.get("param_space")
+            tune_config = tune_config or saved.get("tune_config")
+        if run_config is None:
+            run_config = RunConfig(name=os.path.basename(path.rstrip("/")),
+                                   storage_path=os.path.dirname(path.rstrip("/")))
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=run_config)
+        tuner._restore_summaries = summaries
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
         exp_dir = self.run_config.experiment_dir()
         os.makedirs(exp_dir, exist_ok=True)
+        try:  # durable search space for Tuner.restore (reference: tuner.pkl)
+            import cloudpickle
+
+            with open(os.path.join(exp_dir, "tuner.pkl"), "wb") as f:
+                cloudpickle.dump({"param_space": self.param_space,
+                                  "tune_config": self.tune_config}, f)
+        except Exception:
+            pass  # unpicklable user objects: restore needs explicit args
+        restored_done: list[Trial] = []
+        restored_pending: list[Trial] = []
+        if self._restore_summaries:
+            for s in self._restore_summaries:
+                t = Trial(trial_id=s["trial_id"], config=s["config"],
+                          experiment_dir=exp_dir,
+                          last_result=s.get("last_result") or {},
+                          iteration=s.get("iteration", 0),
+                          error=s.get("error"))
+                ckpt_path = s.get("checkpoint_path")
+                if ckpt_path and os.path.isdir(ckpt_path):
+                    t.latest_checkpoint = Checkpoint(ckpt_path)
+                if s["status"] == TERMINATED:
+                    t.status = TERMINATED
+                    restored_done.append(t)
+                else:
+                    t.status = PENDING
+                    restored_pending.append(t)
+        # the searcher replays the FULL variant space; the loop skips
+        # suggestions whose config matches a restored trial (exact for grid
+        # search, which enumerates deterministically; unseeded random
+        # domains may regenerate up to num_samples fresh configs)
         searcher = tc.search_alg or search_mod.BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples)
         if tc.metric:
             searcher.set_search_properties(tc.metric, tc.mode)
         scheduler = tc.scheduler or sched_mod.FIFOScheduler()
         scheduler.set_search_properties(tc.metric or "_none_", tc.mode)
-        loop = _TuneLoop(self._as_train_fn(), exp_dir, searcher, scheduler, tc)
+        loop = _TuneLoop(self._as_train_fn(), exp_dir, searcher, scheduler, tc,
+                         restored_done=restored_done,
+                         restored_pending=restored_pending)
         trials = loop.run()
         results = [
             TuneResult(metrics=t.last_result, config=t.config,
@@ -144,7 +208,9 @@ class Tuner:
 
 
 class _TuneLoop:
-    def __init__(self, train_fn, exp_dir, searcher, scheduler, tc: TuneConfig):
+    def __init__(self, train_fn, exp_dir, searcher, scheduler, tc: TuneConfig,
+                 restored_done: list[Trial] | None = None,
+                 restored_pending: list[Trial] | None = None):
         from ray_tpu._private import serialization as ser
 
         self.fn_blob = ser.dumps(train_fn)
@@ -152,9 +218,15 @@ class _TuneLoop:
         self.searcher = searcher
         self.scheduler = scheduler
         self.tc = tc
-        self.trials: list[Trial] = []
+        # finished trials from a restored snapshot keep their results
+        self.trials: list[Trial] = list(restored_done or [])
+        self._restored_pending = list(restored_pending or [])
+        # configs already covered by the snapshot: matching searcher
+        # suggestions are consumed without creating a duplicate trial
+        self._restored_configs: list[dict] = [
+            t.config for t in self.trials + self._restored_pending]
         self._exhausted = False
-        self._seq = 0
+        self._seq = len(self.trials) + len(self._restored_pending)
         self._dirty = False
 
     # ------------------------------------------------------------- lifecycle
@@ -177,6 +249,14 @@ class _TuneLoop:
         return self.trials
 
     def _maybe_launch(self):
+        # restored unfinished trials restart first, from their checkpoints
+        while self._restored_pending:
+            running = sum(1 for t in self.trials if t.status == RUNNING)
+            if running >= self.tc.max_concurrent_trials:
+                return
+            trial = self._restored_pending.pop(0)
+            self.trials.append(trial)
+            self._start(trial, checkpoint=trial.latest_checkpoint)
         while not self._exhausted:
             running = sum(1 for t in self.trials if t.status == RUNNING)
             if running >= self.tc.max_concurrent_trials:
@@ -187,6 +267,9 @@ class _TuneLoop:
                 return
             if cfg == "PENDING":
                 return
+            if cfg in self._restored_configs:
+                self._restored_configs.remove(cfg)
+                continue  # already covered by the restored snapshot
             trial = Trial(trial_id=f"trial_{self._seq:04d}", config=cfg,
                           experiment_dir=self.exp_dir)
             self._seq += 1

@@ -1,0 +1,170 @@
+"""Core chaos: SIGKILL workers under load; message delay/drop injection.
+
+(reference test strategy: ResourceKillerActor killing random components
+during workloads, _private/test_utils.py:1357; rpc fault injection via
+RAY_testing_rpc_failure, src/ray/rpc/rpc_chaos.h:24. VERDICT round-1 item 10
+acceptance: randomly kill 1 of 4 workers every second under load and the
+workload still converges.)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _worker_pids() -> list[int]:
+    out = subprocess.run(
+        ["pgrep", "-f", "ray_tpu._private.worker_main"],
+        capture_output=True, text=True)
+    return [int(p) for p in out.stdout.split()]
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=4, max_workers=12)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_tasks_converge_under_worker_slaughter(session):
+    """Kill a random worker every 0.5s while 60 retryable tasks run."""
+    @ray_tpu.remote(max_retries=20)
+    def compute(i):
+        time.sleep(0.25)
+        return i * i
+
+    stop = threading.Event()
+    kills = []
+
+    def killer():
+        while not stop.is_set():
+            pids = _worker_pids()
+            if pids:
+                victim = random.choice(pids)
+                try:
+                    os.kill(victim, signal.SIGKILL)
+                    kills.append(victim)
+                except ProcessLookupError:
+                    pass
+            stop.wait(0.5)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        refs = [compute.remote(i) for i in range(60)]
+        results = ray_tpu.get(refs, timeout=180)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert results == [i * i for i in range(60)]
+    assert kills, "chaos killer never fired"
+
+
+def test_actor_calls_survive_restarts(session):
+    """An infinitely-restartable actor keeps serving across SIGKILLs; the
+    caller retries in-flight failures (at-least-once under chaos)."""
+    @ray_tpu.remote(max_restarts=-1)
+    class Echo:
+        def pid(self):
+            return os.getpid()
+
+        def double(self, x):
+            return 2 * x
+
+    a = Echo.remote()
+    seen_pids = set()
+    for round_no in range(6):
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                seen_pids.add(ray_tpu.get(a.pid.remote(), timeout=30))
+                assert ray_tpu.get(a.double.remote(round_no), timeout=30) == 2 * round_no
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.3)
+        if round_no % 2 == 0:
+            # kill the actor's current process
+            for pid in list(seen_pids):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+    assert len(seen_pids) >= 2, "actor never restarted on a fresh process"
+
+
+def test_workload_correct_under_message_delay():
+    """Latency injection on every control-plane send; results still exact."""
+    env_key = "RAY_TPU_TESTING_MSG_DELAY_MS"
+    script = """
+import os, sys
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+refs = [add.remote(i, i) for i in range(30)]
+assert ray_tpu.get(refs, timeout=120) == [2 * i for i in range(30)]
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self): self.n = 0
+    def inc(self):
+        self.n += 1
+        return self.n
+
+a = Acc.remote()
+vals = [ray_tpu.get(a.inc.remote(), timeout=60) for _ in range(10)]
+assert vals == list(range(1, 11)), vals
+ray_tpu.shutdown()
+print("DELAY-CHAOS-OK")
+"""
+    env = dict(os.environ)
+    env[env_key] = "5"
+    r = subprocess.run(["python", "-c", script], capture_output=True,
+                       text=True, timeout=300, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DELAY-CHAOS-OK" in r.stdout
+
+
+def test_droppable_message_chaos():
+    """Dropping best-effort messages (log lines, stream acks) must not
+    break correctness — backpressure has timeouts, logs are advisory."""
+    script = """
+import os, sys
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+
+@ray_tpu.remote(num_returns="streaming")
+def gen(n):
+    for i in range(n):
+        yield i
+
+out = [ray_tpu.get(r) for r in gen.remote(25)]
+assert out == list(range(25)), out
+ray_tpu.shutdown()
+print("DROP-CHAOS-OK")
+"""
+    env = dict(os.environ)
+    env["RAY_TPU_TESTING_MSG_DROP"] = "log_line,stream_ack:0.5"
+    r = subprocess.run(["python", "-c", script], capture_output=True,
+                       text=True, timeout=300, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "DROP-CHAOS-OK" in r.stdout

@@ -1,0 +1,85 @@
+"""Distributed all-to-all: shuffle/sort/repartition run as partition+merge
+task graphs — the driver routes refs, never the blocks.
+
+(reference: python/ray/data/_internal/execution/operators/hash_shuffle.py;
+VERDICT round-1 item 6 acceptance: all_to_all never materializes on the
+driver.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_sort_distributed_correctness(session):
+    n = 5000
+    ds = rdata.range(n, parallelism=8).random_shuffle(seed=7).sort("id")
+    ids = [r["id"] for r in ds.iter_rows()]
+    assert ids == list(range(n))
+
+
+def test_sort_descending(session):
+    ds = rdata.range(1000, parallelism=4).sort("id", descending=True)
+    ids = [r["id"] for r in ds.iter_rows()]
+    assert ids == list(range(999, -1, -1))
+
+
+def test_shuffle_preserves_multiset(session):
+    n = 3000
+    ds = rdata.range(n, parallelism=6).random_shuffle(seed=3)
+    ids = sorted(r["id"] for r in ds.iter_rows())
+    assert ids == list(range(n))
+    # same seed → deterministic permutation, different from identity
+    ds2 = rdata.range(n, parallelism=6).random_shuffle(seed=3)
+    order1 = [r["id"] for r in ds.iter_rows()]
+    order2 = [r["id"] for r in ds2.iter_rows()]
+    assert order1 == order2
+    assert order1 != list(range(n))
+
+
+def test_repartition_balances_rows(session):
+    ds = rdata.range(1000, parallelism=5).repartition(4)
+    blocks = list(ds.iter_blocks()) if hasattr(ds, "iter_blocks") else None
+    ids = sorted(r["id"] for r in ds.iter_rows())
+    assert ids == list(range(1000))
+
+
+def test_driver_never_materializes_shuffle_blocks(session, monkeypatch):
+    """The executor must not ray_tpu.get() data blocks during a distributed
+    barrier — only the tiny sort samples / row counts."""
+    from ray_tpu.data import execution
+
+    real_get = ray_tpu.get
+    pulled_big = []
+
+    def spy_get(refs, **kw):
+        out = real_get(refs, **kw)
+        for v in (out if isinstance(out, list) else [out]):
+            if isinstance(v, list) and v and isinstance(v[0], dict):
+                nbytes = sum(
+                    getattr(col, "nbytes", 0)
+                    for b in v if isinstance(b, dict) for col in b.values())
+                if nbytes > 100_000:
+                    pulled_big.append(nbytes)
+        return out
+
+    monkeypatch.setattr(execution.ray_tpu, "get", spy_get)
+    n = 200_000  # ~1.6 MB of ids
+    ds = rdata.range(n, parallelism=8).random_shuffle(seed=1)
+    total = 0
+    for batch in ds.iter_batches(batch_size=50_000):
+        total += len(batch["id"])
+    assert total == n
+    assert not pulled_big, f"driver pulled {pulled_big} bytes of shuffle blocks"

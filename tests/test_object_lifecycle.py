@@ -1,0 +1,200 @@
+"""Object lifecycle: automatic reference counting, cascading frees, holds for
+in-flight tasks, and lineage reconstruction of lost objects.
+
+(reference capability: src/ray/core_worker/reference_counter.h:43 distributed
+refcounting, object_recovery_manager.h:41 lineage reconstruction — VERDICT
+round-1 item 4.)
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=2, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _gcs():
+    return _api._node.gcs
+
+
+def _entry(oid):
+    with _gcs().lock:
+        return _gcs().objects.get(oid)
+
+
+def _wait_gone(oid, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _entry(oid) is None:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _store_has(oid):
+    return _api._worker.store.contains(oid)
+
+
+def test_put_object_freed_when_ref_dropped(session):
+    big = np.ones((300_000,), dtype=np.float64)  # 2.4 MB -> shm
+    ref = ray_tpu.put(big)
+    oid = ref.hex()
+    assert _store_has(oid)
+    assert _entry(oid) is not None
+    del ref
+    gc.collect()
+    assert _wait_gone(oid), "GCS entry not freed after last ref dropped"
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and _store_has(oid):
+        time.sleep(0.05)
+    assert not _store_has(oid), "shm copy not deleted"
+
+
+def test_task_result_freed_when_ref_dropped(session):
+    @ray_tpu.remote
+    def make():
+        return np.zeros((200_000,), dtype=np.float64)
+
+    ref = make.remote()
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (200_000,)
+    oid = ref.hex()
+    del ref, arr
+    gc.collect()
+    assert _wait_gone(oid)
+
+
+def test_object_survives_while_ref_held(session):
+    ref = ray_tpu.put(np.ones((200_000,), dtype=np.float64))
+    oid = ref.hex()
+    time.sleep(1.0)  # several flush cycles
+    assert _entry(oid) is not None
+    assert np.all(ray_tpu.get(ref) == 1.0)
+
+
+def test_inflight_task_arg_not_freed(session):
+    @ray_tpu.remote
+    def slow_sum(arr):
+        import time as _t
+
+        _t.sleep(1.5)
+        return float(arr.sum())
+
+    ref = ray_tpu.put(np.ones((200_000,), dtype=np.float64))
+    out = slow_sum.remote(ref)
+    oid = ref.hex()
+    del ref  # only the in-flight task holds it now
+    gc.collect()
+    assert ray_tpu.get(out, timeout=30) == 200_000.0
+    # after completion and handle drop, it must go
+    del out
+    gc.collect()
+    assert _wait_gone(oid)
+
+
+def test_contained_refs_cascade(session):
+    inner = ray_tpu.put(np.ones((150_000,), dtype=np.float64))
+    inner_oid = inner.hex()
+    outer = ray_tpu.put({"payload": inner})
+    del inner  # only the stored container references it now
+    gc.collect()
+    time.sleep(0.6)
+    assert _entry(inner_oid) is not None, "contained ref freed under container"
+    got = ray_tpu.get(outer)
+    assert float(ray_tpu.get(got["payload"])[0]) == 1.0
+    del got
+    outer_oid = outer.hex()
+    del outer
+    gc.collect()
+    assert _wait_gone(outer_oid)
+    assert _wait_gone(inner_oid), "cascade free of contained ref"
+
+
+def test_manual_free_still_works(session):
+    ref = ray_tpu.put(np.ones((200_000,), dtype=np.float64))
+    oid = ref.hex()
+    ray_tpu.free([ref])
+    assert _entry(oid) is None
+
+
+def test_gc_opt_out(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_AUTO_GC", "0")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_workers=1, max_workers=4)
+    try:
+        ref = ray_tpu.put(np.ones((200_000,), dtype=np.float64))
+        oid = ref.hex()
+        del ref
+        gc.collect()
+        time.sleep(0.6)
+        assert _entry(oid) is not None  # no auto-free when disabled
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_reconstruction_after_host_loss():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(num_cpus=2, num_workers=1, max_workers=8))
+    try:
+        host = cluster.add_host(num_cpus=2)
+
+        @ray_tpu.remote
+        def make_data(n):
+            return np.full((n,), 5, dtype=np.float64)
+
+        ref = make_data.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=host)
+        ).remote(200_000)
+        # ensure produced (but do NOT pull to the head: the follower holds
+        # the only copy)
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+        assert ready
+        cluster.remove_host(host)  # the only copy dies with the host
+        time.sleep(0.5)
+        arr = ray_tpu.get(ref, timeout=60)  # lineage re-runs make_data
+        assert float(arr[0]) == 5.0 and arr.shape == (200_000,)
+    finally:
+        cluster.shutdown()
+
+
+def test_put_object_lost_is_an_error():
+    """put() objects have no lineage: losing the only copy is a hard error."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import ObjectLostError
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(num_cpus=2, num_workers=1, max_workers=8))
+    try:
+        host = cluster.add_host(num_cpus=2)
+
+        @ray_tpu.remote
+        def putter(n):
+            return ray_tpu.put(np.ones((n,), dtype=np.float64))
+
+        inner = ray_tpu.get(putter.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=host)
+        ).remote(200_000), timeout=30)
+        cluster.remove_host(host)
+        time.sleep(0.5)
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(inner, timeout=30)
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,131 @@
+"""Serve data plane: SSE streaming end-to-end + prefix-aware routing.
+
+(reference capability: serve/_private/proxy.py:706 streaming responses;
+llm/_internal/serve/request_router/prefix_aware/prefix_tree.py;
+VERDICT round-1 item 8.)
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=10)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class Streamer:
+    def stream_request(self, request: dict):
+        n = int((request.get("body") or {}).get("n", 4))
+        for i in range(n):
+            yield {"token": f"t{i}"}
+            time.sleep(0.3)
+
+    def __call__(self, request: dict):
+        return {"ok": True}
+
+
+def _sse_request(port: int, path: str, body: dict):
+    """Returns (events, inter-arrival gaps) from a chunked SSE response."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    payload = json.dumps(body)
+    conn.request("POST", path, body=payload,
+                 headers={"Content-Type": "application/json",
+                          "Accept": "text/event-stream",
+                          "Content-Length": str(len(payload))})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events, stamps = [], []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            if raw.startswith(b"data: "):
+                events.append(raw[len(b"data: "):].decode())
+                stamps.append(time.monotonic())
+    conn.close()
+    return events, stamps
+
+
+def test_sse_streams_incrementally(serve_session):
+    serve.start(http_port=0)  # ephemeral port
+    handle = serve.run(Streamer.bind(), name="sse", route_prefix="/sse")
+    host, port = serve.http_address()
+
+    events, stamps = _sse_request(port, "/sse", {"n": 4})
+    assert events[:-1] == [json.dumps({"token": f"t{i}"}) for i in range(4)]
+    assert events[-1] == "[DONE]"
+    # tokens must ARRIVE over time, not in one flush at the end
+    spread = stamps[-2] - stamps[0]
+    assert spread > 0.5, f"all events arrived within {spread:.3f}s — not streamed"
+
+
+def test_handle_stream_api(serve_session):
+    handle = serve.run(Streamer.bind(), name="hstream", route_prefix="/hstream")
+    out = list(handle.options(stream=True, method_name="stream_request").remote(
+        {"body": {"n": 3}}))
+    assert out == [{"token": "t0"}, {"token": "t1"}, {"token": "t2"}]
+
+
+@serve.deployment(num_replicas=2, request_router="prefix_aware")
+class WhoAmI:
+    def __init__(self):
+        import os
+
+        self.pid = os.getpid()
+
+    def __call__(self, request: dict):
+        return {"pid": self.pid}
+
+
+def test_prefix_aware_routing_sticks(serve_session):
+    handle = serve.run(WhoAmI.bind(), name="pfx", route_prefix="/pfx")
+    time.sleep(0.5)
+
+    def ask(prompt):
+        return handle.remote({"body": {"prompt": prompt}},
+                             _routing_hint=prompt).result(timeout_s=30)["pid"]
+
+    base = "Once upon a time in a land far away, "
+    pids_same = {ask(base + str(i)) for i in range(6)}
+    assert len(pids_same) == 1, f"shared prefix spread across {pids_same}"
+
+    # distinct prefixes may use both replicas (no hard assert on 2 — pow2 is
+    # probabilistic — but the sticky set must not force everything together)
+    other = ask("Completely different prompt " * 3)
+    assert isinstance(other, int)
+
+
+def test_prefix_tree_unit():
+    from ray_tpu.serve.request_router import PrefixTree
+
+    t = PrefixTree()
+    t.insert("hello world", "r1")
+    t.insert("hello there", "r2")
+    depth, rep = t.match("hello world, how are you")
+    assert rep == "r1" and depth == len("hello world")
+    depth, rep = t.match("hello thx")
+    assert rep == "r2"  # longest known prefix "hello th"
+    depth, rep = t.match("goodbye")
+    assert rep is None
+    t.drop_replica("r1")
+    _, rep = t.match("hello world")
+    assert rep != "r1"

@@ -76,9 +76,13 @@ def test_eviction_under_pressure(arena):
     assert bytes(arena.get("o11").buf[:1]) == bytes([11])
 
 
-def test_too_large_raises(arena):
-    with pytest.raises(shm_arena.ArenaFullError):
-        arena.put_parts("huge", [b"x" * (2 << 20)], 2 << 20)
+def test_too_large_goes_to_spill_tier(arena):
+    # larger than the whole arena: lands on disk, stays readable
+    data = b"x" * (2 << 20)
+    arena.put_parts("huge", [data], len(data))
+    assert arena.contains("huge")
+    assert bytes(arena.get("huge").buf[:4]) == b"xxxx"
+    assert arena.size("huge") == len(data)
 
 
 def test_pinned_object_survives(arena):

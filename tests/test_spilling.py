@@ -1,0 +1,85 @@
+"""Object spilling: bounded tmpfs budget with LRU spill to disk.
+
+(reference capability: raylet/local_object_manager.h:43 spill orchestration +
+plasma fallback allocation; acceptance per VERDICT round-1 item 4: a loop
+creating 2x store-capacity of objects completes with everything readable.)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+
+
+@pytest.fixture
+def small_budget_session(monkeypatch):
+    # ~1.6 MB tmpfs budget; each test object is 0.8 MB
+    monkeypatch.setenv("RAY_TPU_OBJECT_STORE_CAPACITY", str(1_600_000))
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=1, max_workers=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _shm_bytes(session_id: str) -> int:
+    total = 0
+    for name in os.listdir("/dev/shm"):
+        if name.startswith(f"rtpu_{session_id}_"):
+            total += os.path.getsize(os.path.join("/dev/shm", name))
+    return total
+
+
+def test_twice_capacity_of_live_objects(small_budget_session):
+    """Hold refs to 2x the budget: everything stays readable, tmpfs stays
+    bounded, the overflow lives in the spill tier."""
+    refs = []
+    for i in range(8):  # 8 x 0.8 MB = 6.4 MB >> 1.6 MB budget
+        refs.append(ray_tpu.put(np.full((100_000,), i, dtype=np.float64)))
+    time.sleep(0.3)  # let the spiller drain
+    session = _api._node.session_id
+    assert _shm_bytes(session) <= 2 * 1_600_000, "tmpfs not bounded"
+    spill_dir = _api._worker.store.spill_dir
+    assert os.path.isdir(spill_dir) and os.listdir(spill_dir), "nothing spilled"
+    for i, r in enumerate(refs):
+        arr = ray_tpu.get(r)
+        assert float(arr[0]) == float(i), f"object {i} corrupted after spill"
+
+
+def test_spilled_object_still_pullable_by_worker(small_budget_session):
+    @ray_tpu.remote
+    def total(arr):
+        return float(arr.sum())
+
+    refs = [ray_tpu.put(np.ones((100_000,), dtype=np.float64)) for _ in range(6)]
+    time.sleep(0.3)
+    # the earliest object is the LRU spill victim; a worker task reads it
+    assert ray_tpu.get(total.remote(refs[0]), timeout=30) == 100_000.0
+
+
+def test_spill_and_free_interact(small_budget_session):
+    import gc
+
+    refs = [ray_tpu.put(np.ones((100_000,), dtype=np.float64)) for _ in range(6)]
+    time.sleep(0.3)
+    oids = [r.hex() for r in refs]
+    spill_dir = _api._worker.store.spill_dir
+    del refs
+    gc.collect()
+    deadline = time.monotonic() + 10
+    gcs = _api._node.gcs
+    while time.monotonic() < deadline:
+        with gcs.lock:
+            if all(o not in gcs.objects for o in oids):
+                break
+        time.sleep(0.1)
+    time.sleep(0.2)
+    # freed objects vanish from BOTH tiers
+    leftovers = [o for o in oids
+                 if os.path.exists(os.path.join(spill_dir, o))]
+    assert not leftovers, f"spilled copies leaked: {leftovers}"

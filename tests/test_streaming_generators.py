@@ -1,0 +1,120 @@
+"""Streaming generators: num_returns="streaming" tasks yield ObjectRefs
+incrementally with producer-side backpressure.
+
+(reference capability: _raylet.pyx:299 ObjectRefGenerator — the substrate of
+Ray Data map tasks; VERDICT round-1 item 6.)
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayTaskError
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=2, max_workers=6)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_stream_basic(session):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_stream_incremental_arrival(session):
+    """Early items are consumable long before the producer finishes."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        import time as _t
+
+        for i in range(4):
+            yield i
+            _t.sleep(0.8)
+
+    g = slow_gen.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(iter(g)))
+    first_latency = time.monotonic() - t0
+    assert first == 0
+    assert first_latency < 2.5, f"first item took {first_latency:.1f}s (not streamed)"
+    rest = [ray_tpu.get(r) for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_stream_large_items_via_shm(session):
+    @ray_tpu.remote(num_returns="streaming")
+    def blocks(n):
+        for i in range(n):
+            yield np.full((50_000,), i, dtype=np.float64)  # 400 KB each
+
+    vals = [float(ray_tpu.get(r)[0]) for r in blocks.remote(6)]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_stream_error_mid_way(session):
+    @ray_tpu.remote(num_returns="streaming")
+    def fails():
+        yield 1
+        yield 2
+        raise ValueError("boom mid-stream")
+
+    g = fails.remote()
+    it = iter(g)
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises(RayTaskError):
+        next(it)
+
+
+def test_stream_backpressure(session):
+    """Producer must not run unboundedly ahead of a slow consumer."""
+    @ray_tpu.remote(num_returns="streaming")
+    def fast_gen():
+        import time as _t
+
+        for i in range(64):
+            yield (i, _t.monotonic())
+
+    g = fast_gen.remote()
+    it = iter(g)
+    first_i, _ = ray_tpu.get(next(it))
+    time.sleep(2.0)  # consumer stalls; producer should pause at ~backpressure
+    got = [ray_tpu.get(r)[0] for r in it]
+    assert [first_i] + got == list(range(64))
+
+
+def test_stream_empty(session):
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        return
+        yield  # pragma: no cover
+
+    assert list(empty.remote()) == []
+
+
+def test_stream_as_task_pipeline(session):
+    """Refs from a stream feed downstream tasks without materializing."""
+    @ray_tpu.remote(num_returns="streaming")
+    def produce(n):
+        for i in range(n):
+            yield np.full((30_000,), i, dtype=np.float64)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    totals = ray_tpu.get([consume.remote(r) for r in produce.remote(4)])
+    assert totals == [0.0, 30_000.0, 60_000.0, 90_000.0]
