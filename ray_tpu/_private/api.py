@@ -54,6 +54,7 @@ def init(
     num_workers: int = 0,
     max_workers: int = 16,
     ignore_reinit_error: bool = True,
+    runtime_env: dict | None = None,
 ):
     """Start a new session, or join an existing one with `address=` (a GCS
     `host:port` / `unix:<path>`, or env RAY_TPU_ADDRESS — how submitted jobs
@@ -73,6 +74,8 @@ def init(
         if address:
             _worker = CoreWorker(address, os.environ.get("RAY_TPU_SESSION"),
                                  kind="driver")
+            if runtime_env:
+                _worker.default_runtime_env = runtime_env
             atexit.register(shutdown)
             return {"session_id": _worker.session_id, "address": address}
         _node = Node(
@@ -83,6 +86,10 @@ def init(
             max_workers=max_workers,
         )
         _worker = CoreWorker(_node.socket_path, _node.session_id, kind="driver")
+        if runtime_env:
+            # job-level default: every task/actor without its own runtime_env
+            # inherits it (reference: ray.init(runtime_env=...))
+            _worker.default_runtime_env = runtime_env
         atexit.register(shutdown)
         if num_workers:
             # block until the pre-spawned pool registers (slow interpreters on
@@ -171,7 +178,12 @@ def nodes() -> list:
 
 
 def timeline() -> list:
-    return []  # populated once task-event tracing lands
+    """All task events collected by the GCS (reference: `ray timeline` /
+    GcsTaskManager task-event store)."""
+    w = _get_worker()
+    if not hasattr(w, "rpc"):
+        return []  # local mode keeps no event store
+    return w.rpc({"type": "task_events"}).get("events", [])
 
 
 class RuntimeContext:

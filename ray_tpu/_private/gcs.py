@@ -25,22 +25,23 @@ from __future__ import annotations
 
 import collections
 import logging
-import os
+import queue as _queue
 import threading
 import time
 from typing import Callable
 
 from ray_tpu._private import accelerators, pg_policy
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
+from ray_tpu._private.ray_config import RayConfig
 
 logger = logging.getLogger(__name__)
 
-INLINE_LIMIT = 64 * 1024  # results smaller than this are stored in the GCS table
+INLINE_LIMIT = RayConfig.get("inline_object_limit")  # results below this live in the GCS table
 
 DEFAULT_NODE = "node-0"
 HEAD_HOST = "host-0"
 MAX_RECONSTRUCTIONS = 3
-MAX_LINEAGE = int(os.environ.get("RAY_TPU_MAX_LINEAGE", "10000"))
+MAX_LINEAGE = RayConfig.get("max_lineage")
 # chip spawns can block minutes in TPU plugin init; plain spawns are fast
 SPAWN_TIMEOUT_S = 60.0
 CHIP_SPAWN_TIMEOUT_S = 300.0
@@ -48,10 +49,12 @@ CHIP_SPAWN_TIMEOUT_S = 300.0
 
 class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
-                 "running_tasks", "node_id", "tpu_chips", "host_id")
+                 "running_tasks", "node_id", "tpu_chips", "host_id",
+                 "ref_balance", "renv_hash")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
-                 tpu_chips: tuple = (), host_id: str = "host-0"):
+                 tpu_chips: tuple = (), host_id: str = "host-0",
+                 renv_hash: str = ""):
         self.host_id = host_id
         self.wid = wid
         self.conn = conn
@@ -65,6 +68,13 @@ class _Worker:
         # chips bound to this process at spawn via TPU_VISIBLE_CHIPS; fixed
         # for the process lifetime (jax backend init reads env once)
         self.tpu_chips = tuple(tpu_chips)
+        # net process-level ref contributions, so a SIGKILLed process's
+        # outstanding +1s can be reclaimed (reference: reference_counter
+        # borrower death handling)
+        self.ref_balance: dict[str, int] = {}
+        # runtime-env fingerprint baked into the process at spawn
+        # (reference: worker pool keyed by runtime-env hash)
+        self.renv_hash = renv_hash
 
 
 class _Actor:
@@ -149,6 +159,7 @@ class GcsServer:
         max_workers: int = 32,
         node_labels: dict | None = None,
         session_id: str = "",
+        storage_path: str | None = None,
     ):
         self.socket_path = socket_path
         self.session_id = session_id
@@ -185,15 +196,40 @@ class GcsServer:
         # per-host live tmpfs bytes; over RAY_TPU_OBJECT_STORE_CAPACITY the
         # LRU objects are spilled to disk (reference: local_object_manager.h:43)
         self.host_shm_bytes: collections.Counter = collections.Counter()
-        self.spill_capacity = int(os.environ.get("RAY_TPU_OBJECT_STORE_CAPACITY", "0") or 0)
+        self.spill_capacity = RayConfig.get("object_store_capacity")
         self._spawn_pending: dict[str, collections.deque] = collections.defaultdict(collections.deque)
+        # normalized runtime envs by hash, for spawning matching workers
+        self.runtime_envs: dict[str, dict] = {}
         self.stopped = False
         self._conn_threads: list[threading.Thread] = []
         self._listener = None
         self._accept_thread: threading.Thread | None = None
+        # fault tolerance: optional write-through table persistence so a
+        # restarted GCS rebuilds its managers from storage (reference: Redis
+        # store client + gcs_init_data rebuild, redis_store_client.h:126)
+        self.storage = None
+        sp = storage_path if storage_path is not None else RayConfig.get("gcs_storage_path")
+        if sp:
+            from ray_tpu._private.gcs_storage import GcsStorage
+
+            self.storage = GcsStorage(sp)
         # metrics / introspection
         self.task_counter = collections.Counter()
         self.task_events: collections.deque = collections.deque(maxlen=10000)
+        # cluster-wide user/system metrics, keyed by metric name; per-source
+        # series so restarts/re-reports replace instead of double-count
+        # (reference: metrics agent aggregation, _private/metrics_agent.py:628)
+        self.metrics: dict[str, dict] = {}
+        # general long-poll pubsub: channel → list of (conn, rid) pollers and
+        # buffered per-subscriber queues (reference: src/ray/pubsub/publisher.h:159)
+        self.pubsub_queues: dict[tuple[str, str], collections.deque] = {}
+        self.pubsub_pollers: dict[tuple[str, str], tuple[MsgConnection, int]] = {}
+        self.pubsub_conns: dict[tuple[str, str], MsgConnection] = {}
+        # publish() is called from paths holding self.lock — a slow
+        # subscriber socket must not stall the control plane, so replies to
+        # parked pollers go through this queue to a dedicated sender thread
+        self._pub_sendq: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._pub_thread: threading.Thread | None = None
 
     # aggregate views (cluster_state compatibility)
     @property
@@ -216,7 +252,61 @@ class GcsServer:
 
     # ------------------------------------------------------------------ server
 
+    def _restore_from_storage(self):
+        """Rebuild manager state from persisted tables (reference:
+        gcs_init_data.h — GCS restart rebuild in Redis mode)."""
+        if self.storage is None:
+            return
+        with self.lock:
+            for k, v in self.storage.items("kv"):
+                self.kv[k] = v
+        for _, spec in self.storage.items("pgs"):
+            self._create_pg(dict(spec), _persist=False)
+        for _, spec in self.storage.items("actors"):
+            # actors restart from their creation spec on the rebuilt cluster
+            # (fresh state, same identity/name — reference restarts actors
+            # whose processes died with the old GCS's nodes)
+            self._create_actor(dict(spec), _persist=False)
+
+    def _health_loop(self):
+        """Actively ping follower-host agents; hosts missing too many pongs
+        are declared dead (reference: gcs_health_check_manager.h:45, config
+        thresholds in ray_config_def.h:877). Same-host worker death is
+        already observed through connection close."""
+        period = RayConfig.get("health_check_period_s")
+        thresh = RayConfig.get("health_check_failure_threshold")
+        while not self.stopped:
+            time.sleep(period)
+            now = time.monotonic()
+            dead_hosts = []
+            with self.lock:
+                targets = [(hid, info) for hid, info in self.hosts.items()
+                           if hid != HEAD_HOST and info.get("conn") is not None]
+                for hid, info in targets:
+                    last = info.get("last_pong")
+                    if last is None:
+                        info["last_pong"] = now  # first check cycle
+                    elif now - last > period * thresh:
+                        dead_hosts.append(hid)
+            for hid in dead_hosts:
+                logger.warning("host %s failed health checks; removing", hid)
+                self._remove_host(hid)
+            for hid, info in targets:
+                if hid in dead_hosts:
+                    continue
+                try:
+                    info["conn"].send({"type": "ping"})
+                except (ConnectionClosed, Exception):
+                    self._remove_host(hid)
+
     def start(self):
+        self._restore_from_storage()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="gcs-health")
+        self._health_thread.start()
+        self._pub_thread = threading.Thread(
+            target=self._pub_send_loop, daemon=True, name="gcs-pubsub")
+        self._pub_thread.start()
         self._listener = listen_unix(self.socket_path)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, args=(self._listener,), daemon=True,
@@ -230,15 +320,57 @@ class GcsServer:
 
         from ray_tpu._private.protocol import listen_tcp
 
-        self._tcp_listener = listen_tcp(
-            _os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1"), 0)
+        self._tcp_listener = listen_tcp(RayConfig.get("bind_host"), 0)
         self.tcp_port = self._tcp_listener.getsockname()[1]
         self._tcp_accept_thread = threading.Thread(
             target=self._accept_loop, args=(self._tcp_listener,), daemon=True,
             name="gcs-accept-tcp")
         self._tcp_accept_thread.start()
 
+    def crash_for_testing(self):
+        """Abruptly drop every connection and listener WITHOUT the graceful
+        worker-exit handshake — simulates a GCS process crash for fault-
+        tolerance tests (reference: GCS restart tests with external Redis,
+        test_gcs_fault_tolerance.py)."""
+        import socket as _socket
+
+        self._pub_sendq.put(None)  # stop the pubsub sender thread
+        with self.lock:
+            self.stopped = True
+            conns = [w.conn for w in self.workers.values() if not w.dead]
+            conns += [h["conn"] for h in self.hosts.values() if h.get("conn")]
+        if self.storage is not None:
+            self.storage.close()
+        for listener in (self._listener, getattr(self, "_tcp_listener", None)):
+            if listener is not None:
+                try:
+                    listener.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        try:
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.settimeout(0.2)
+            s.connect(self.socket_path)
+            s.close()
+        except OSError:
+            pass
+        if getattr(self, "tcp_port", None):
+            try:  # wake the TCP accept thread so it closes its listener too
+                s = _socket.create_connection(("127.0.0.1", self.tcp_port),
+                                              timeout=0.2)
+                s.close()
+            except OSError:
+                pass
+
     def stop(self):
+        if self.storage is not None:
+            self.storage.close()
+        self._pub_sendq.put(None)
         with self.lock:
             self.stopped = True
             for w in self.workers.values():
@@ -323,6 +455,14 @@ class GcsServer:
                             if info.get("conn") is conn), None)
             if host_id is not None:
                 self._remove_host(host_id)
+            # drop pubsub subscriber state owned by this connection — a
+            # crashed subscriber must not leave queues accumulating forever
+            with self.lock:
+                dead_keys = [k for k, c in self.pubsub_conns.items() if c is conn]
+                for k in dead_keys:
+                    self.pubsub_conns.pop(k, None)
+                    self.pubsub_queues.pop(k, None)
+                    self.pubsub_pollers.pop(k, None)
 
     # --------------------------------------------------------------- dispatch
 
@@ -333,14 +473,15 @@ class GcsServer:
                 wid = msg["wid"]
                 node_id = msg.get("node_id") or DEFAULT_NODE
                 chips = tuple(msg.get("tpu_chips") or ())
+                renv_hash = msg.get("renv_hash", "")
                 accepted = True
                 if msg["kind"] == "worker":
                     # retire the spawn-accounting entry for this worker,
-                    # matching by chip assignment so a chip spawn isn't
-                    # credited to a plain-CPU registration (or vice versa)
+                    # matching by chip assignment + runtime-env hash so a
+                    # specialized spawn isn't credited to a plain registration
                     dq = self._spawn_pending[node_id]
-                    for i, (_, c) in enumerate(dq):
-                        if tuple(c or ()) == chips:
+                    for i, (_, c, rh) in enumerate(dq):
+                        if tuple(c or ()) == chips and rh == renv_hash:
                             del dq[i]
                             break
                     else:
@@ -362,7 +503,8 @@ class GcsServer:
                 if accepted:
                     self.workers[wid] = _Worker(
                         wid, conn, msg.get("pid", 0), msg["kind"], node_id,
-                        tpu_chips=chips, host_id=msg.get("host") or HEAD_HOST)
+                        tpu_chips=chips, host_id=msg.get("host") or HEAD_HOST,
+                        renv_hash=renv_hash)
             if not accepted:
                 conn.send({"rid": msg["rid"], "ok": False,
                            "error": "stale chip binding; exit"})
@@ -390,6 +532,12 @@ class GcsServer:
                        "session_id": self.session_id})
             self._schedule()
             return wid
+        if t == "pong":
+            with self.lock:
+                info = self.hosts.get(msg.get("host_id"))
+                if info is not None:
+                    info["last_pong"] = time.monotonic()
+            return wid
         if t == "log_line":
             # fan out to every driver (reference: log_monitor republishing
             # worker logs to drivers via GCS pubsub)
@@ -410,7 +558,7 @@ class GcsServer:
             conn.send({"rid": msg["rid"], "locations": locs})
             return wid
         if t == "ref_delta":
-            self._on_ref_delta(msg["deltas"])
+            self._on_ref_delta(msg["deltas"], wid)
             return wid
         if t == "stream_item":
             with self.lock:
@@ -433,7 +581,7 @@ class GcsServer:
                 msg["oid"], where=msg.get("where", "shm"),
                 inline=msg.get("inline"), size=msg.get("size", 0),
                 is_error=False, host=msg.get("host") or HEAD_HOST,
-                contained=msg.get("contained"))
+                contained=msg.get("contained"), tier=msg.get("tier", "shm"))
             with self.lock:
                 st = self.streams.get(msg["task_id"])
                 if st is not None:
@@ -497,7 +645,8 @@ class GcsServer:
                                   inline=msg.get("inline"), size=msg.get("size", 0),
                                   is_error=False, host=msg.get("host") or HEAD_HOST,
                                   pin=msg.get("pin", False),
-                                  contained=msg.get("contained"))
+                                  contained=msg.get("contained"),
+                                  tier=msg.get("tier", "shm"))
         elif t == "wait_object":
             self._wait_object(conn, msg)
         elif t == "free_objects":
@@ -564,6 +713,8 @@ class GcsServer:
         elif t == "kv_put":
             with self.lock:
                 self.kv[msg["key"]] = msg["value"]
+            if self.storage is not None:
+                self.storage.put("kv", msg["key"], msg["value"])
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "kv_get":
             with self.lock:
@@ -576,6 +727,8 @@ class GcsServer:
         elif t == "kv_del":
             with self.lock:
                 self.kv.pop(msg["key"], None)
+            if self.storage is not None:
+                self.storage.delete("kv", msg["key"])
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "cluster_state":
             with self.lock:
@@ -599,15 +752,146 @@ class GcsServer:
                     },
                 }
             conn.send({"rid": msg["rid"], "state": state})
+        elif t == "resource_demand":
+            # unplaceable load summary for the autoscaler (reference: GCS
+            # autoscaler state API, gcs_autoscaler_state_manager.h +
+            # autoscaler.proto cluster_resource_state)
+            with self.lock:
+                demands = []
+                for spec in self.pending_tasks:
+                    demands.append(dict(spec.get("resources") or {}))
+                for spec in self.pending_actor_creations:
+                    demands.append(dict(spec.get("resources") or {}))
+                pg_demands = []
+                for pgid in self.pending_pgs:
+                    pg = self.pgs.get(pgid)
+                    if pg is not None and pg.state == "pending":
+                        pg_demands.append({"strategy": pg.strategy,
+                                           "bundles": [dict(b.total)
+                                                       for b in pg.bundles]})
+                state = {
+                    "demands": demands,
+                    "pg_demands": pg_demands,
+                    "total_resources": self.total,
+                    "available_resources": self.available,
+                    "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                }
+            conn.send({"rid": msg["rid"], "demand": state})
+        elif t == "metrics_report":
+            # per-source replace so a worker's repeated reports (cumulative
+            # local values) don't double-count in the aggregate
+            source = msg.get("source") or wid or "unknown"
+            with self.lock:
+                for m in msg.get("metrics", []):
+                    rec = self.metrics.setdefault(
+                        m["name"], {"kind": m["kind"],
+                                    "description": m.get("description", ""),
+                                    "series": {}})
+                    rec["series"][source] = m["series"]
+        elif t == "metrics_snapshot":
+            with self.lock:
+                snap = {name: {"kind": r["kind"],
+                               "description": r["description"],
+                               "series": {s: list(v) for s, v in r["series"].items()}}
+                        for name, r in self.metrics.items()}
+                # fold in internal runtime stats as gauges
+                snap["ray_tpu_pending_tasks"] = {
+                    "kind": "gauge", "description": "tasks queued in the GCS",
+                    "series": {"gcs": [[[], float(len(self.pending_tasks))]]}}
+                snap["ray_tpu_live_actors"] = {
+                    "kind": "gauge", "description": "actors in state alive",
+                    "series": {"gcs": [[[], float(sum(
+                        1 for a in self.actors.values() if a.state == "alive"))]]}}
+                snap["ray_tpu_object_store_bytes"] = {
+                    "kind": "gauge", "description": "live shm bytes per host",
+                    "series": {"gcs": [[[["host", h]], float(v)]
+                                       for h, v in self.host_shm_bytes.items()]}}
+                for k, v in self.task_counter.items():
+                    snap.setdefault("ray_tpu_tasks_total", {
+                        "kind": "counter",
+                        "description": "task terminal states",
+                        "series": {"gcs": []}})["series"]["gcs"].append(
+                            [[["state", k]], float(v)])
+            conn.send({"rid": msg["rid"], "metrics": snap})
+        elif t == "events_report":
+            with self.lock:
+                for ev in msg.get("events", []):
+                    ev.setdefault("worker_id", wid or "")
+                    self.task_events.append(ev)
+        elif t == "task_events":
+            with self.lock:
+                events = list(self.task_events)
+            conn.send({"rid": msg["rid"], "events": events})
+        elif t == "subscribe":
+            key = (msg["channel"], msg["sub_id"])
+            with self.lock:
+                self.pubsub_queues.setdefault(key, collections.deque(maxlen=10000))
+                self.pubsub_conns[key] = conn
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "unsubscribe":
+            key = (msg["channel"], msg["sub_id"])
+            with self.lock:
+                self.pubsub_queues.pop(key, None)
+                self.pubsub_conns.pop(key, None)
+                poller = self.pubsub_pollers.pop(key, None)
+            if poller is not None:
+                try:
+                    poller[0].send({"rid": poller[1], "items": [], "closed": True})
+                except ConnectionClosed:
+                    pass
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "publish":
+            self.publish(msg["channel"], msg["data"])
+        elif t == "pubsub_poll":
+            key = (msg["channel"], msg["sub_id"])
+            with self.lock:
+                q = self.pubsub_queues.get(key)
+                if q is None:
+                    conn.send({"rid": msg["rid"], "items": [], "closed": True})
+                elif q:
+                    items = list(q)
+                    q.clear()
+                    conn.send({"rid": msg["rid"], "items": items})
+                else:
+                    # long-poll: park until the next publish on the channel
+                    # (reference: pubsub long-poll, src/ray/pubsub/publisher.h)
+                    self.pubsub_pollers[key] = (conn, msg["rid"])
         else:
             logger.warning("gcs: unknown message type %s", t)
         return wid
+
+    def publish(self, channel: str, data) -> None:
+        """Fan a message out to every subscriber of `channel`. Callers may
+        hold self.lock: sends happen on the pubsub sender thread."""
+        with self.lock:
+            for (ch, sub), q in self.pubsub_queues.items():
+                if ch != channel:
+                    continue
+                key = (ch, sub)
+                poller = self.pubsub_pollers.pop(key, None)
+                if poller is not None:
+                    self._pub_sendq.put((poller[0], {"rid": poller[1],
+                                                     "items": [data]}))
+                else:
+                    q.append(data)
+
+    def _pub_send_loop(self):
+        while True:
+            item = self._pub_sendq.get()
+            if item is None:
+                return
+            conn, msg = item
+            try:
+                conn.send(msg)
+            except (ConnectionClosed, Exception):
+                pass
 
     # --------------------------------------------------------------- objects
 
     def _on_object_ready(self, oid: str, where: str, inline, size: int,
                          is_error: bool, host: str = HEAD_HOST,
-                         pin: bool = False, contained=None):
+                         pin: bool = False, contained=None,
+                         tier: str = "shm"):
         with self.lock:
             prev = self.objects.get(oid)
             if (prev is not None and prev["status"] == "ready"
@@ -615,10 +899,21 @@ class GcsServer:
                 # an additional shm copy on another host: extend the location
                 # set, keep the entry (reference: object directory adding a
                 # location, ownership_object_directory.h)
+                added_copy = False
                 if host not in prev.setdefault("hosts", set()):
                     prev["hosts"].add(host)
-                    self._note_shm_copy_locked(prev, host)
-                return
+                    if tier == "shm":
+                        self._note_shm_copy_locked(prev, host)
+                        added_copy = True
+            else:
+                added_copy = None
+        if added_copy is not None:
+            if added_copy:
+                # pull-heavy consumer hosts must hit the spill budget too
+                self._maybe_spill(host)
+            return
+        with self.lock:
+            prev = self.objects.get(oid)
             if prev is not None:
                 self._drop_shm_copies_locked(prev)  # stale copies of an overwrite
             entry = self.objects[oid] = {
@@ -631,7 +926,8 @@ class GcsServer:
             }
             if where == "shm":
                 entry["shm_live"] = set()
-                self._note_shm_copy_locked(entry, host)
+                if tier == "shm":
+                    self._note_shm_copy_locked(entry, host)
             if pin:
                 entry["pinned"] = True
             if contained and "contained" not in entry:
@@ -640,7 +936,7 @@ class GcsServer:
             waiters = self.object_waiters.pop(oid, [])
         for conn, rid in waiters:
             self._reply_object(conn, rid, entry)
-        if where == "shm":
+        if where == "shm" and tier == "shm":
             self._maybe_spill(host)
         self._schedule()
 
@@ -704,9 +1000,15 @@ class GcsServer:
     # dependencies and refs nested inside stored objects, and frees an object
     # cluster-wide when every hold is gone.
 
-    def _on_ref_delta(self, deltas: dict):
+    def _on_ref_delta(self, deltas: dict, wid: str | None = None):
         free: list[str] = []
         with self.lock:
+            w = self.workers.get(wid) if wid else None
+            if w is not None and w.dead:
+                # this process was already declared dead and its ref balance
+                # reclaimed — applying its late in-flight deltas would double
+                # count (e.g. a -1 drained from the socket after host removal)
+                return
             for oid, n in deltas.items():
                 e = self.objects.get(oid)
                 if e is None:
@@ -715,6 +1017,12 @@ class GcsServer:
                 # any delta (including a within-window +1/-1 cancel, sent as
                 # 0) proves the object has been user-referenced
                 e["counted"] = True
+                if w is not None and n:
+                    bal = w.ref_balance.get(oid, 0) + n
+                    if bal:
+                        w.ref_balance[oid] = bal
+                    else:
+                        w.ref_balance.pop(oid, None)
                 if self._freeable_locked(oid, e):
                     free.append(oid)
         if free:
@@ -903,6 +1211,11 @@ class GcsServer:
                 self._drop_shm_copies_locked(re_)
                 re_.update(status="pending", inline=None)
                 re_["hosts"] = set()
+                # the re-run will report fresh nested refs; keeping the old
+                # 'contained' would make task_done skip taking holds on them
+                stale = re_.pop("contained", None)
+                if stale:
+                    self._sys_hold_locked(stale, -1)
         newspec = {k: v for k, v in spec.items()
                    if k not in ("_paid", "_holds", "retries_used", "recons_used")}
         # a hard affinity to a dead node would make reconstruction
@@ -1030,6 +1343,8 @@ class GcsServer:
 
     def _submit_task(self, spec: dict):
         with self.lock:
+            if spec.get("renv_hash"):
+                self.runtime_envs[spec["renv_hash"]] = spec.get("runtime_env") or {}
             if spec["num_returns"] == "streaming":
                 self.streams[spec["task_id"]] = {
                     "items": [], "done": False, "error": None,
@@ -1057,9 +1372,20 @@ class GcsServer:
                     if prev_lin is not None:
                         lin["recons_used"] = prev_lin.get("recons_used", 0)
                     self.lineage[spec["task_id"]] = lin
-                    while len(self.lineage) > MAX_LINEAGE:
-                        evicted.extend(
-                            self._drop_lineage_locked(next(iter(self.lineage))))
+                    if len(self.lineage) > MAX_LINEAGE:
+                        # evict oldest-first, but never a task that is still
+                        # queued/running — dropping one would free its pinned
+                        # args blob under it and hang the dispatch
+                        active = {s["task_id"] for s in self.pending_tasks}
+                        for w_ in self.workers.values():
+                            active.update(w_.running_tasks.keys())
+                        active.add(spec["task_id"])
+                        for tid in list(self.lineage):
+                            if len(self.lineage) <= MAX_LINEAGE:
+                                break
+                            if tid in active:
+                                continue
+                            evicted.extend(self._drop_lineage_locked(tid))
                 self.pending_tasks.append(spec)
             self.task_counter["submitted"] += 1
         if reason is not None:
@@ -1097,10 +1423,12 @@ class GcsServer:
                 # that many chips visible; CPU specs need a chipless worker
                 # (a chip worker must stay free for TPU demand)
                 need = accelerators.chips_required(spec.get("resources", {}))
+                rh = spec.get("renv_hash", "")
                 pool = idle_by_node.get(node_id, [])
-                w = next((x for x in pool if len(x.tpu_chips) == need), None)
+                w = next((x for x in pool if len(x.tpu_chips) == need
+                          and x.renv_hash == rh), None)
                 if w is None:
-                    want_spawn[(node_id, need)] += 1
+                    want_spawn[(node_id, need, rh)] += 1
                     return False
                 pool.remove(w)
                 self._acquire_for(spec, node_id)
@@ -1150,7 +1478,7 @@ class GcsServer:
             spawning_total = 0
             for node_id, dq in self._spawn_pending.items():
                 while dq:
-                    ts, chips = dq[0]
+                    ts, chips, _rh = dq[0]
                     limit = CHIP_SPAWN_TIMEOUT_S if chips else SPAWN_TIMEOUT_S
                     if now - ts <= limit:
                         break
@@ -1163,10 +1491,10 @@ class GcsServer:
             spawn_plan: list[tuple[str, list]] = []  # node_id, [chips|None per worker]
             reclaim: list[_Worker] = []
             headroom = self.max_workers - n_workers - spawning_total
-            for (node_id, need), demand in want_spawn.items():
+            for (node_id, need, rh), demand in want_spawn.items():
                 spawning_here = sum(
-                    1 for _, c in self._spawn_pending[node_id]
-                    if len(c or ()) == need)
+                    1 for _, c, prh in self._spawn_pending[node_id]
+                    if len(c or ()) == need and prh == rh)
                 want = demand - spawning_here
                 if want <= 0:
                     continue
@@ -1179,7 +1507,7 @@ class GcsServer:
                                and len(node.chip_pool) < need * want)
                 if short_headroom > 0 or short_chips:
                     got = self._reclaim_mismatched_idle_locked(
-                        node_id, need, max(short_headroom, want))
+                        node_id, need, max(short_headroom, want), rh)
                     headroom += len(got)
                     reclaim.extend(got)
                 n = max(0, min(want, headroom))
@@ -1197,15 +1525,18 @@ class GcsServer:
                     assignments.append(chips)
                 if assignments:
                     headroom -= len(assignments)
-                    self._spawn_pending[node_id].extend((now, c) for c in assignments)
-                    spawn_plan.append((node_id, assignments))
+                    self._spawn_pending[node_id].extend(
+                        (now, c, rh) for c in assignments)
+                    spawn_plan.append((node_id, assignments, rh))
             agent_sends = []
-            for node_id, assignments in spawn_plan:
+            for node_id, assignments, rh in spawn_plan:
                 host = self.node_hosts.get(node_id, HEAD_HOST)
                 agent_conn = self.hosts.get(host, {}).get("conn")
                 if agent_conn is not None:
-                    agent_sends.append((agent_conn, node_id, assignments))
-            spawn_plan = [(nid, a) for nid, a in spawn_plan
+                    agent_sends.append(
+                        (agent_conn, node_id, assignments,
+                         self.runtime_envs.get(rh) if rh else None))
+            spawn_plan = [(nid, a, rh) for nid, a, rh in spawn_plan
                           if self.hosts.get(self.node_hosts.get(nid, HEAD_HOST), {}).get("conn") is None]
 
         for conn, msg in to_send:
@@ -1218,17 +1549,20 @@ class GcsServer:
                 w.conn.send({"type": "exit"})
             except ConnectionClosed:
                 pass
-        for agent_conn, node_id, assignments in agent_sends:
+        for agent_conn, node_id, assignments, renv in agent_sends:
             try:
                 agent_conn.send({"type": "spawn_workers", "node_id": node_id,
-                                 "assignments": assignments})
+                                 "assignments": assignments,
+                                 "runtime_env": renv})
             except ConnectionClosed:
                 pass
-        for node_id, assignments in spawn_plan:
-            self.spawn_worker_cb(len(assignments), node_id, assignments)
+        for node_id, assignments, rh in spawn_plan:
+            self.spawn_worker_cb(len(assignments), node_id, assignments,
+                                 self.runtime_envs.get(rh) if rh else None)
 
     def _reclaim_mismatched_idle_locked(self, node_id: str, need: int,
-                                        max_count: int) -> list[_Worker]:
+                                        max_count: int,
+                                        renv_hash: str = "") -> list[_Worker]:
         """Retire idle workers on a node whose chip binding differs from the
         wanted one (chip workers blocking CPU demand, or CPU/odd-size chip
         workers blocking chip demand). Runs after all dispatch for this
@@ -1241,7 +1575,8 @@ class GcsServer:
                 break
             if (w.kind == "worker" and not w.dead and w.idle
                     and w.actor_id is None and w.node_id == node_id
-                    and len(w.tpu_chips) != need):
+                    and (len(w.tpu_chips) != need
+                         or w.renv_hash != renv_hash)):
                 w.dead = True
                 if w.tpu_chips and node is not None and node.alive:
                     node.chip_pool.extend(w.tpu_chips)
@@ -1266,6 +1601,8 @@ class GcsServer:
                 if error is None:
                     if actor is not None:
                         actor.state = "alive"
+                        self.publish("actor_state",
+                                     {"actor_id": actor.aid, "state": "alive"})
                         waiters, actor.waiters = actor.waiters, []
                         for conn, rid in waiters:
                             try:
@@ -1281,6 +1618,9 @@ class GcsServer:
                     # creation failed → actor dead, release worker
                     if actor is not None:
                         actor.state = "dead"
+                        self._unpersist_actor(actor.aid)
+                        self.publish("actor_state",
+                                     {"actor_id": actor.aid, "state": "dead"})
                         for conn, rid in actor.waiters:
                             try:
                                 conn.send({"rid": rid, "ok": False, "error": error})
@@ -1305,6 +1645,13 @@ class GcsServer:
                 "task_id": spec.get("task_id"), "kind": kind, "name": spec.get("name"),
                 "worker": wid, "error": error, "ts": time.time(),
             })
+            if error is not None:
+                # error channel (reference: GCS pubsub error_info channel
+                # surfaced by drivers' error pollers)
+                self.publish("errors", {
+                    "task_id": spec.get("task_id"), "kind": kind,
+                    "name": spec.get("name"), "worker": wid,
+                    "error": error, "ts": time.time()})
 
             # the task is over: release its holds on args/nested refs
             free_now = self._sys_hold_locked(spec.pop("_holds", ()), -1)
@@ -1322,7 +1669,11 @@ class GcsServer:
             host = w.host_id if w is not None else HEAD_HOST
             contained_map = msg.get("contained") or {}
             any_shm = False
-            for oid, where, inline, size in msg.get("results", ()):
+            for res in msg.get("results", ()):
+                oid, where, inline, size = res[:4]
+                # 5th element: actual tier ("spill" = landed on disk because
+                # tmpfs was full — a readable host copy, but not tmpfs bytes)
+                tier = res[4] if len(res) > 4 else "shm"
                 prev = self.objects.get(oid)
                 if prev is not None:
                     self._drop_shm_copies_locked(prev)
@@ -1334,8 +1685,9 @@ class GcsServer:
                 }
                 if where == "shm":
                     entry["shm_live"] = set()
-                    self._note_shm_copy_locked(entry, host)
-                    any_shm = True
+                    if tier == "shm":
+                        self._note_shm_copy_locked(entry, host)
+                        any_shm = True
                 refs = contained_map.get(oid)
                 if refs and "contained" not in (prev or {}):
                     entry["contained"] = list(refs)
@@ -1352,11 +1704,13 @@ class GcsServer:
 
     # ---------------------------------------------------------------- actors
 
-    def _create_actor(self, spec: dict) -> str | None:
+    def _create_actor(self, spec: dict, _persist: bool = True) -> str | None:
         with self.lock:
             reason = self._invalid_strategy_reason(spec.get("strategy"))
             if reason is not None:
                 return reason
+            if spec.get("renv_hash"):
+                self.runtime_envs[spec["renv_hash"]] = spec.get("runtime_env") or {}
             aid = spec["actor_id"]
             actor = _Actor(aid, spec)
             if actor.name:
@@ -1371,6 +1725,10 @@ class GcsServer:
             spec["_actor_holds"] = holds
             self._sys_hold_locked(holds, +1)
             self.pending_actor_creations.append(spec)
+        if _persist and self.storage is not None:
+            clean = {k: v for k, v in spec.items()
+                     if k not in ("_actor_holds", "_paid")}
+            self.storage.put("actors", aid, clean)
         self._schedule()
         return None
 
@@ -1410,8 +1768,15 @@ class GcsServer:
         except ConnectionClosed:
             pass
 
+    def _unpersist_actor(self, aid: str) -> None:
+        if self.storage is not None:
+            self.storage.delete("actors", aid)
+
     def _kill_actor(self, aid: str, no_restart: bool):
         fail: list[dict] = []
+        # a kill with no_restart must stick across GCS restarts too
+        if no_restart:
+            self._unpersist_actor(aid)
         with self.lock:
             actor = self.actors.get(aid)
             if actor is None:
@@ -1424,6 +1789,9 @@ class GcsServer:
             if w is None and actor.state in ("pending", "restarting"):
                 # creation not yet dispatched: cancel it outright
                 actor.state = "dead"
+                self._unpersist_actor(actor.aid)
+                self.publish("actor_state",
+                             {"actor_id": actor.aid, "state": "dead"})
                 self.pending_actor_creations = collections.deque(
                     s for s in self.pending_actor_creations if s["actor_id"] != aid
                 )
@@ -1449,7 +1817,7 @@ class GcsServer:
 
     # -------------------------------------------------------- placement groups
 
-    def _create_pg(self, spec: dict) -> str | None:
+    def _create_pg(self, spec: dict, _persist: bool = True) -> str | None:
         with self.lock:
             if spec.get("strategy", "PACK") not in pg_policy.STRATEGIES:
                 return (f"unknown placement strategy {spec.get('strategy')!r}; "
@@ -1467,7 +1835,9 @@ class GcsServer:
                     t.node_id, t.total, t.available, t.labels, t.alive = (
                         n.node_id, n.total, dict(n.total), n.labels, True)
                     tot_nodes.append(t)
-            if pg_policy.place_bundles(tot_nodes, [b.total for b in pg.bundles], pg.strategy) is None:
+            if (_persist  # restore path: nodes re-register after start
+                    and pg_policy.place_bundles(
+                        tot_nodes, [b.total for b in pg.bundles], pg.strategy) is None):
                 return ("placement group is infeasible: no node set satisfies "
                         f"{pg.strategy} over {spec['bundles']}")
             if pg.name:
@@ -1478,6 +1848,8 @@ class GcsServer:
             self.objects.setdefault(pg_ready_oid(pg.pg_id),
                                     {"status": "pending", "where": None, "inline": None, "size": 0})
             self.pending_pgs.append(pg.pg_id)
+        if _persist and self.storage is not None:
+            self.storage.put("pgs", spec["pg_id"], dict(spec))
         self._schedule()
         return None
 
@@ -1520,6 +1892,8 @@ class GcsServer:
                 self._reply_object(conn, rid, self.objects[oid])
 
     def _remove_pg(self, pg_id: str):
+        if self.storage is not None:
+            self.storage.delete("pgs", pg_id)
         import ray_tpu._private.serialization as ser
         from ray_tpu.exceptions import PlacementGroupUnschedulableError
 
@@ -1662,8 +2036,31 @@ class GcsServer:
             if w is None or w.dead:
                 return
             w.dead = True
+            # reclaim the process's outstanding ref contributions: a SIGKILL
+            # (or a secondary driver disconnecting) must not pin objects its
+            # flushed +1s were holding (reference: reference_counter borrower
+            # death)
+            for oid, bal in w.ref_balance.items():
+                if not bal:
+                    continue
+                e = self.objects.get(oid)
+                if e is None:
+                    continue
+                e["count"] = e.get("count", 0) - bal
+                if self._freeable_locked(oid, e):
+                    death_free.append(oid)
+            w.ref_balance.clear()
             if w.kind != "worker":
-                return  # driver death handled by node teardown
+                # driver death: free its refs (outside the lock below); the
+                # rest of the teardown is the node's job
+                driver_death = True
+            else:
+                driver_death = False
+        if driver_death:
+            if death_free:
+                self._free_objects(death_free)
+            return
+        with self.lock:
             if w.tpu_chips:
                 node = self.nodes.get(w.node_id)
                 if node is not None and node.alive:
@@ -1696,9 +2093,14 @@ class GcsServer:
                             actor.restarts_left -= 1
                         actor.state = "restarting"
                         actor.num_restarts += 1
+                        self.publish("actor_state", {"actor_id": actor.aid,
+                                                     "state": "restarting"})
                         self.pending_actor_creations.append(actor.create_spec)
                     else:
                         actor.state = "dead"
+                        self._unpersist_actor(actor.aid)
+                        self.publish("actor_state",
+                                     {"actor_id": actor.aid, "state": "dead"})
                         while actor.queue:
                             fail.append(actor.queue.popleft())
                         for conn, rid in actor.waiters:
@@ -1707,7 +2109,8 @@ class GcsServer:
                             except ConnectionClosed:
                                 pass
                         actor.waiters = []
-                        death_free = self._actor_dead_cleanup_locked(actor.create_spec)
+                        death_free.extend(
+                            self._actor_dead_cleanup_locked(actor.create_spec))
         if death_free:
             self._free_objects(death_free)
         for spec in fail:

@@ -66,7 +66,7 @@ class LocalWorker:
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
     def submit_task(self, func_blob, args, kwargs, *, num_returns=1, resources=None,
-                    max_retries=0, name="", strategy=None):
+                    max_retries=0, name="", strategy=None, runtime_env=None):
         fn = ser.loads(func_blob) if isinstance(func_blob, bytes) else func_blob
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)
         kwargs = {k: self.get_object(v.hex()) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()}
@@ -74,7 +74,7 @@ class LocalWorker:
 
     # actors
     def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0,
-                     name=None, strategy=None, max_concurrency=1):
+                     name=None, strategy=None, max_concurrency=1, runtime_env=None):
         cls = ser.loads(cls_blob) if isinstance(cls_blob, bytes) else cls_blob
         aid = ActorID().hex()
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)

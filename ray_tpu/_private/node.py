@@ -19,6 +19,7 @@ import uuid
 from ray_tpu._private import accelerators
 from ray_tpu._private.accelerators import detect_num_tpu_chips  # noqa: F401 (re-export)
 from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ray_config import RayConfig
 from ray_tpu._private.object_store import ShmObjectStore
 
 
@@ -43,6 +44,7 @@ class Node:
         total, labels = accelerators.detect_host_resources(
             num_cpus, num_tpus, resources, labels)
         self.total_resources = total
+        self.node_labels = labels
 
         self._procs: list[subprocess.Popen] = []
         self._spawn_lock = threading.Lock()
@@ -67,7 +69,7 @@ class Node:
         # stream worker logs to the driver's stderr (reference:
         # _private/log_monitor.py); RAY_TPU_LOG_TO_DRIVER=0 disables
         self.log_monitor = None
-        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        if RayConfig.get("log_to_driver"):
             from ray_tpu._private.log_monitor import LogMonitor
 
             self.log_monitor = LogMonitor(
@@ -80,17 +82,27 @@ class Node:
         if num_workers:
             now = time.monotonic()
             # counted before spawn to avoid a register race
-            self.gcs._spawn_pending["node-0"].extend([(now, None)] * num_workers)
+            self.gcs._spawn_pending["node-0"].extend([(now, None, "")] * num_workers)
             self._spawn_workers(num_workers, "node-0")
 
-    def _spawn_workers(self, n: int, node_id: str = "node-0", chip_assignments=None):
+    def _spawn_workers(self, n: int, node_id: str = "node-0", chip_assignments=None,
+                       runtime_env: dict | None = None):
         """Spawn n workers; chip_assignments[i] is a tuple of chip ids (the
         worker owns those chips via TPU_VISIBLE_CHIPS and runs real-TPU jax)
-        or None (plain CPU worker)."""
+        or None (plain CPU worker). `runtime_env` is a normalized runtime
+        env baked into the processes (env_vars at spawn; packages
+        materialized by worker_main)."""
+        import json as _json
+
         base = dict(os.environ)
         base["RAY_TPU_SOCKET"] = self.socket_path
         base["RAY_TPU_SESSION"] = self.session_id
         base["RAY_TPU_NODE_ID"] = node_id
+        if runtime_env:
+            base["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env, sort_keys=True)
+            base.update(runtime_env.get("env_vars") or {})
+        else:
+            base.pop("RAY_TPU_RUNTIME_ENV", None)
         with self._spawn_lock:
             for i in range(n):
                 chips = chip_assignments[i] if chip_assignments else None
@@ -106,7 +118,7 @@ class Node:
                     # setdefault) because the host env may preset
                     # JAX_PLATFORMS to the TPU platform, and two processes
                     # must not fight over one chip.
-                    platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+                    platform = RayConfig.get("worker_platform")
                     env["JAX_PLATFORMS"] = platform
                     if platform == "cpu":
                         # CPU workers must not register a TPU-plugin session
@@ -127,6 +139,23 @@ class Node:
                 finally:
                     log.close()  # Popen dup'd the fd; parent copy would leak
                 self._procs.append(p)
+
+    def restart_gcs(self) -> None:
+        """Stand up a fresh GCS on the same socket after a (simulated) crash,
+        rebuilding from persistent storage (reference: GCS restart with
+        external Redis — gcs_init_data.h rebuild; clients reconnect via
+        retryable channels). The old GCS must already be stopped/crashed."""
+        self.gcs = GcsServer(
+            self.socket_path,
+            total_resources=self.total_resources,
+            spawn_worker_cb=self._spawn_workers,
+            max_workers=self.gcs.max_workers,
+            node_labels=self.node_labels,
+            session_id=self.session_id,
+        )
+        self.gcs.start()
+        self.gcs.set_head_object_addr(self.object_server.address)
+        self.address = f"127.0.0.1:{self.gcs.tcp_port}"
 
     def shutdown(self):
         if self.log_monitor is not None:

@@ -98,7 +98,8 @@ class NodeAgent:
     def _dispatch(self, msg: dict):
         t = msg.get("type")
         if t == "spawn_workers":
-            self._spawn_workers(msg["assignments"], msg.get("node_id", self.host_id))
+            self._spawn_workers(msg["assignments"], msg.get("node_id", self.host_id),
+                                msg.get("runtime_env"))
         elif t == "delete_objects":
             for oid in msg["oids"]:
                 try:
@@ -111,22 +112,37 @@ class NodeAgent:
                     self.store.spill(oid)
                 except Exception:
                     pass
+        elif t == "ping":
+            # GCS active health check (reference: gcs_health_check_manager.h)
+            try:
+                self.conn.send({"type": "pong", "host_id": self.host_id})
+            except ConnectionClosed:
+                pass
         elif t == "exit":
             raise ConnectionClosed()
 
-    def _spawn_workers(self, assignments: list, node_id: str):
+    def _spawn_workers(self, assignments: list, node_id: str,
+                       runtime_env: dict | None = None):
+        import json as _json
+
         base = dict(os.environ)
         base["RAY_TPU_ADDRESS"] = self.gcs_address
         base["RAY_TPU_SESSION"] = self.session_id
         base["RAY_TPU_NODE_ID"] = node_id
         base["RAY_TPU_HOST_ID"] = self.host_id
         base["RAY_TPU_STORE_NS"] = self.store_ns
+        if runtime_env:
+            base["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env, sort_keys=True)
+            base.update(runtime_env.get("env_vars") or {})
+        else:
+            base.pop("RAY_TPU_RUNTIME_ENV", None)
         for chips in assignments:
             env = dict(base)
             if chips:
                 accelerators.apply_chip_env(env, chips)
             else:
-                platform = os.environ.get("RAY_TPU_WORKER_PLATFORM", "cpu")
+                from ray_tpu._private.ray_config import RayConfig
+                platform = RayConfig.get("worker_platform")
                 env["JAX_PLATFORMS"] = platform
                 if platform == "cpu":
                     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -22,7 +22,9 @@ def make_object_store(session_id: str):
     """Backend selector: RAY_TPU_STORE_BACKEND=arena uses the native C++
     arena (bounded capacity + LRU eviction, cpp/shm_store.cc); the default
     is one tmpfs file per object."""
-    if os.environ.get("RAY_TPU_STORE_BACKEND") == "arena":
+    from ray_tpu._private.ray_config import RayConfig
+
+    if RayConfig.get("store_backend") == "arena":
         from ray_tpu._private.shm_arena import ArenaStore
 
         return ArenaStore(session_id)
@@ -63,10 +65,13 @@ class ShmObjectStore:
     def _spill_path(self, object_hex: str) -> str:
         return os.path.join(self.spill_dir, object_hex)
 
-    def put_parts(self, object_hex: str, parts: Iterable[bytes | memoryview], total: int) -> int:
-        """Create+seal an object from pre-serialized parts. Returns size."""
+    def put_parts(self, object_hex: str, parts: Iterable[bytes | memoryview], total: int) -> str:
+        """Create+seal an object from pre-serialized parts. Returns the tier
+        it actually landed on: "shm" (tmpfs) or "spill" (disk fallback) — so
+        callers report true tmpfs usage to the GCS accountant."""
         path = self._path(object_hex)
         tmp = path + ".tmp"
+        tier = "shm"
         try:
             self._write(tmp, path, parts, total)
         except OSError:  # tmpfs full: create straight into the spill tier
@@ -77,8 +82,9 @@ class ShmObjectStore:
             os.makedirs(self.spill_dir, exist_ok=True)
             spath = self._spill_path(object_hex)
             self._write(spath + ".tmp", spath, parts, total)
+            tier = "spill"
         self._created.add(object_hex)
-        return total
+        return tier
 
     @staticmethod
     def _write(tmp: str, path: str, parts, total: int) -> None:
@@ -104,6 +110,14 @@ class ShmObjectStore:
         return (os.path.exists(self._path(object_hex))
                 or os.path.exists(self._spill_path(object_hex)))
 
+    def tier_of(self, object_hex: str) -> str | None:
+        """Which tier holds the object right now ("shm" | "spill" | None)."""
+        if os.path.exists(self._path(object_hex)):
+            return "shm"
+        if os.path.exists(self._spill_path(object_hex)):
+            return "spill"
+        return None
+
     def size(self, object_hex: str) -> int:
         try:
             return os.stat(self._path(object_hex)).st_size
@@ -111,7 +125,9 @@ class ShmObjectStore:
             return os.stat(self._spill_path(object_hex)).st_size
 
     def spill(self, object_hex: str) -> bool:
-        """Move an object from tmpfs to the disk tier (no-op if absent)."""
+        """Move an object from tmpfs to the disk tier (no-op if absent).
+        tmp-copy + atomic replace: a crash mid-spill must never leave a
+        truncated file where readers expect a sealed object."""
         src = self._path(object_hex)
         if not os.path.exists(src):
             return False
@@ -119,7 +135,17 @@ class ShmObjectStore:
         import shutil
 
         dst = self._spill_path(object_hex)
-        shutil.move(src, dst)  # cross-device: copy + unlink
+        tmp = dst + f".tmp{os.getpid()}"
+        try:
+            shutil.copyfile(src, tmp)
+            os.replace(tmp, dst)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.unlink(src)
         return True
 
     def delete(self, object_hex: str) -> None:

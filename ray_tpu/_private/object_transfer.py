@@ -24,7 +24,9 @@ from ray_tpu._private.protocol import (
 
 logger = logging.getLogger(__name__)
 
-CHUNK = 4 << 20  # 4 MiB frames
+from ray_tpu._private.ray_config import RayConfig as _RayConfig
+
+CHUNK = _RayConfig.get("object_transfer_chunk")
 
 
 class ObjectPlaneServer:
@@ -36,7 +38,9 @@ class ObjectPlaneServer:
 
         self.store = store
         # loopback by default; RAY_TPU_BIND_HOST=0.0.0.0 for real multi-host
-        self.bind_host = host or os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
+        from ray_tpu._private.ray_config import RayConfig
+
+        self.bind_host = host or RayConfig.get("bind_host")
         self.sock = listen_tcp(self.bind_host, 0)
         self.port = self.sock.getsockname()[1]
         self._stop = False
@@ -130,9 +134,11 @@ class ObjectFetcher:
         # threads interleaving frames on one socket would cross-read payloads
         self._addr_locks: dict[str, threading.Lock] = {}
 
-    def fetch(self, oid: str, address: str) -> bool:
+    def fetch(self, oid: str, address: str) -> "str | bool":
         """Pull `oid` from the object server at `address` into the local
-        store. Returns True on success. Safe to call concurrently."""
+        store. Returns the landing tier ("shm"/"spill") on a fresh pull,
+        True when already/concurrently fetched, False on failure. Safe to
+        call concurrently."""
         with self._lock:
             if self.store.contains(oid):
                 return True
@@ -174,8 +180,8 @@ class ObjectFetcher:
                 data = frame["data"]
                 parts.append(data)
                 got += len(data)
-            self.store.put_parts(oid, parts, size)
-            return True
+            tier = self.store.put_parts(oid, parts, size)
+            return tier or "shm"
         except (ConnectionClosed, OSError, KeyError):
             with self._lock:
                 self._conns.pop(address, None)

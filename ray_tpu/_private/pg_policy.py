@@ -139,10 +139,14 @@ def place_bundles(nodes: list, bundles: list[dict], strategy: str) -> list[str] 
 
 
 def pick_node_hybrid(nodes: list, res: dict, local_node_id: str | None,
-                     threshold: float = 0.5) -> str | None:
+                     threshold: float | None = None) -> str | None:
     """Hybrid pack/spread for ordinary tasks: prefer the local node, pack onto
     low-utilization nodes until the threshold, then least-utilized first.
     (reference: raylet/scheduling/policy/scheduling_policy.h:66)"""
+    if threshold is None:
+        from ray_tpu._private.ray_config import RayConfig
+
+        threshold = RayConfig.instance().hybrid_threshold
     alive = [n for n in nodes if n.alive]
     ordered = sorted(alive, key=lambda n: (n.node_id != local_node_id, n.node_id))
     for n in ordered:

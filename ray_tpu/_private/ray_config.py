@@ -1,0 +1,154 @@
+"""Central runtime-flag registry.
+
+Single definition file for every tunable, typed, env-var-overridable flag,
+playing the role of the reference's ``RAY_CONFIG(type, name, default)``
+registry (reference: src/ray/common/ray_config_def.h, ray_config.h:60 — 229
+entries materialized as a process singleton, overridable via RAY_<name> env
+vars forwarded at process spawn).
+
+Usage::
+
+    from ray_tpu._private.ray_config import RayConfig
+    if RayConfig.instance().auto_gc:
+        ...
+
+Each flag reads ``RAY_TPU_<NAME>`` (upper-cased field name) at first access;
+`spawn_env()` returns the subset of flags explicitly set in this process's
+environment so parent processes can forward their overrides to children the
+same way the reference's `services.py` forwards `RAY_*` vars.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, fields
+
+
+def _parse(typ, raw: str):
+    if typ is bool:
+        return raw.strip().lower() not in ("0", "false", "no", "")
+    return typ(raw)
+
+
+@dataclass
+class RayConfig:
+    # --- object store ---------------------------------------------------
+    # Per-host shm store capacity in bytes before LRU spill kicks in (0 = no
+    # limit). Mirrors plasma's capacity + eviction threshold.
+    object_store_capacity: int = 0
+    # Arena-backend capacity (cpp/shm_store.cc) in bytes.
+    store_capacity: int = 1 << 30
+    # Store backend: "file" (tmpfs file-per-object) or "arena" (native C++).
+    store_backend: str = "file"
+    # Inline-object threshold: values ≤ this many bytes live in the GCS
+    # table instead of shm (reference: memory_store small-object tier).
+    inline_object_limit: int = 64 * 1024
+    # Chunk size for cross-host object pulls.
+    object_transfer_chunk: int = 5 * 1024 * 1024
+
+    # --- core worker ----------------------------------------------------
+    # Distributed reference counting on ObjectRef drop (0 = manual free()).
+    auto_gc: bool = True
+    # Max retained task specs for lineage reconstruction (LRU).
+    max_lineage: int = 10000
+    # Seconds between batched refcount-delta flushes to the GCS.
+    ref_flush_interval_s: float = 0.2
+
+    # --- scheduling -----------------------------------------------------
+    # Utilization threshold past which the hybrid policy spreads instead of
+    # packing (reference: scheduling_policy.h:66 ~50%).
+    hybrid_threshold: float = 0.5
+    # Default max task retries on worker death.
+    default_max_retries: int = 3
+
+    # --- cluster / transport --------------------------------------------
+    # Host interface the TCP planes bind (control + object transfer).
+    bind_host: str = "127.0.0.1"
+    # Worker JAX platform ("cpu" keeps workers off the TPU plugin unless a
+    # chip is explicitly assigned; see node.py chip isolation).
+    worker_platform: str = "cpu"
+    # Stream worker stdout/stderr to the driver.
+    log_to_driver: bool = True
+    # GCS → node-agent / worker health-check period and miss budget
+    # (reference: gcs_health_check_manager.h:45, ray_config_def.h:877).
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+
+    # --- GCS persistence ------------------------------------------------
+    # Path for the GCS write-ahead table store; empty = in-memory only
+    # (reference: redis_store_client.h — Redis mode = fault tolerance).
+    gcs_storage_path: str = ""
+    # How long a DRIVER keeps retrying to reconnect + re-register after the
+    # GCS connection drops (reference: retryable_grpc_client.h). Workers
+    # never reconnect — they exit and the restarted GCS respawns actors.
+    gcs_reconnect_timeout_s: float = 10.0
+
+    # --- metrics / tracing ----------------------------------------------
+    # Enable task timeline events (reference: ray_config_def.h:615).
+    enable_timeline: bool = True
+    # Max buffered task events per process before oldest are dropped.
+    task_events_max: int = 10000
+    # Metrics report period from workers/agents to the GCS.
+    metrics_report_interval_s: float = 2.0
+
+    _singleton = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "RayConfig":
+        if cls._singleton is None:
+            with cls._lock:
+                if cls._singleton is None:
+                    cls._singleton = cls._from_env()
+        return cls._singleton
+
+    @classmethod
+    def _from_env(cls) -> "RayConfig":
+        cfg = cls()
+        for f in fields(cls):
+            if f.name.startswith("_"):
+                continue
+            raw = os.environ.get("RAY_TPU_" + f.name.upper())
+            if raw is not None:
+                try:
+                    setattr(cfg, f.name, _parse(f.type if isinstance(f.type, type)
+                                                else type(f.default), raw))
+                except (TypeError, ValueError):
+                    pass  # malformed override: keep the default
+        return cfg
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton (tests set env vars then re-read)."""
+        with cls._lock:
+            cls._singleton = None
+
+    @classmethod
+    def get(cls, name: str):
+        """Fresh typed read of one flag (env consulted every call — for
+        construction-time reads where tests change env between sessions
+        within one process; use instance() on hot paths)."""
+        for f in fields(cls):
+            if f.name == name:
+                raw = os.environ.get("RAY_TPU_" + name.upper())
+                if raw is None:
+                    return f.default
+                try:
+                    return _parse(f.type if isinstance(f.type, type)
+                                  else type(f.default), raw)
+                except (TypeError, ValueError):
+                    return f.default
+        raise AttributeError(f"unknown ray config flag {name!r}")
+
+    @staticmethod
+    def spawn_env() -> dict:
+        """Flags explicitly set in this process's env, for child processes."""
+        out = {}
+        for f in fields(RayConfig):
+            if f.name.startswith("_"):
+                continue
+            key = "RAY_TPU_" + f.name.upper()
+            if key in os.environ:
+                out[key] = os.environ[key]
+        return out

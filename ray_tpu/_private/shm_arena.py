@@ -25,7 +25,9 @@ _LIB = os.path.join(_LIB_DIR, "libshmstore.so")
 _build_lock = threading.Lock()
 _lib = None
 
-DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_CAPACITY", 1 << 30))
+from ray_tpu._private.ray_config import RayConfig
+
+DEFAULT_CAPACITY = RayConfig.get("store_capacity")
 
 
 class ArenaFullError(Exception):
@@ -135,11 +137,13 @@ class ArenaStore:
     def _spill_path(self, object_hex: str) -> str:
         return os.path.join(self.spill_dir, object_hex)
 
-    def put_parts(self, object_hex: str, parts, total: int) -> int:
+    def put_parts(self, object_hex: str, parts, total: int) -> str:
+        """Returns the tier the object landed on ("shm" | "spill"),
+        matching ShmObjectStore.put_parts."""
         oid = object_hex.encode()
         off = self._dll.rtpu_store_create(self._handle, oid, max(total, 1))
         if off == -2:
-            return total  # already present (idempotent re-put)
+            return "shm"  # already present (idempotent re-put)
         if off < 0:
             # no room even after eviction: create straight in the spill tier
             os.makedirs(self.spill_dir, exist_ok=True)
@@ -148,7 +152,7 @@ class ArenaStore:
                 for p in parts:
                     f.write(p)
             os.replace(tmp, self._spill_path(object_hex))
-            return total
+            return "spill"
         pos = off
         for p in parts:
             n = len(p) if isinstance(p, bytes) else p.nbytes
@@ -157,7 +161,7 @@ class ArenaStore:
         rc = self._dll.rtpu_store_seal(self._handle, oid)
         if rc != 0:
             raise OSError(f"seal({object_hex}) failed: {rc}")
-        return total
+        return "shm"
 
     def get(self, object_hex: str):
         oid = object_hex.encode()
@@ -181,6 +185,13 @@ class ArenaStore:
     def contains(self, object_hex: str) -> bool:
         return (bool(self._dll.rtpu_store_contains(self._handle, object_hex.encode()))
                 or os.path.exists(self._spill_path(object_hex)))
+
+    def tier_of(self, object_hex: str) -> "str | None":
+        if self._dll.rtpu_store_contains(self._handle, object_hex.encode()):
+            return "shm"
+        if os.path.exists(self._spill_path(object_hex)):
+            return "spill"
+        return None
 
     def size(self, object_hex: str) -> int:
         n = self._dll.rtpu_store_size(self._handle, object_hex.encode())

@@ -1,0 +1,100 @@
+"""Task/profile event buffering and chrome-trace timeline export.
+
+Reference capability: workers emit ProfileEvents batched by TaskEventBuffer
+to the GCS task-event store, exported by `ray timeline` as a chrome trace
+(reference: src/ray/core_worker/profile_event.h,
+src/ray/core_worker/task_event_buffer.h, src/ray/gcs/gcs_task_manager.h;
+gated by RAY_CONFIG enable_timeline, ray_config_def.h:615).
+
+Design: each process keeps a bounded buffer of timeline spans; the
+CoreWorker's background flusher ships batches to the GCS piggybacked on the
+refcount-delta channel, the GCS appends them to its task-event deque, and
+``ray_tpu timeline`` renders everything as chrome://tracing JSON
+(one row per worker process).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from .ray_config import RayConfig
+
+_lock = threading.Lock()
+_buffer: collections.deque = collections.deque(maxlen=10000)
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = RayConfig.instance().enable_timeline
+        with _lock:
+            _buffer.__init__(maxlen=RayConfig.instance().task_events_max)
+    return _enabled
+
+
+def emit(event: str, *, task_id: str = "", name: str = "",
+         start: float | None = None, end: float | None = None,
+         **extra) -> None:
+    """Record one completed span (start/end in time.time() seconds)."""
+    if not enabled():
+        return
+    rec = {"event": event, "task_id": task_id, "name": name,
+           "pid": os.getpid(), "start": start, "end": end}
+    if extra:
+        rec.update(extra)
+    with _lock:
+        _buffer.append(rec)
+
+
+@contextmanager
+def span(event: str, *, task_id: str = "", name: str = "", **extra):
+    if not enabled():
+        yield
+        return
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        emit(event, task_id=task_id, name=name, start=t0, end=time.time(),
+             **extra)
+
+
+def drain() -> list:
+    """Pop all buffered events (called by the worker's flush loop)."""
+    with _lock:
+        out = list(_buffer)
+        _buffer.clear()
+    return out
+
+
+def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
+    """Render GCS-collected events as chrome://tracing 'traceEvents' JSON.
+
+    Rows: one per (worker-id, pid). Durations become complete ('X') events
+    with microsecond timestamps, matching what chrome://tracing / Perfetto
+    ingests from the reference's `ray timeline` output.
+    """
+    worker_names = worker_names or {}
+    trace = []
+    for ev in events:
+        if ev.get("start") is None:
+            continue
+        wid = ev.get("worker_id", "") or str(ev.get("pid", 0))
+        trace.append({
+            "name": ev.get("name") or ev.get("event", ""),
+            "cat": ev.get("event", "task"),
+            "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": max(0.0, ((ev.get("end") or ev["start"]) - ev["start"])) * 1e6,
+            "pid": worker_names.get(wid, wid),
+            "tid": ev.get("pid", 0),
+            "args": {k: v for k, v in ev.items()
+                     if k not in ("start", "end", "name", "event", "pid")},
+        })
+    return json.dumps({"traceEvents": trace, "displayTimeUnit": "ms"})

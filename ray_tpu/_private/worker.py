@@ -30,8 +30,10 @@ from ray_tpu.exceptions import (
     RayTpuError,
 )
 
-INLINE_LIMIT = 64 * 1024
-ARGS_INLINE_LIMIT = 256 * 1024
+from ray_tpu._private.ray_config import RayConfig as _RayConfig
+
+INLINE_LIMIT = _RayConfig.get("inline_object_limit")
+ARGS_INLINE_LIMIT = 4 * INLINE_LIMIT
 MAX_RECON_ATTEMPTS = 4
 
 
@@ -181,6 +183,8 @@ class CoreWorker:
         self.wid = WorkerID().hex()
         if address.startswith("/"):
             address = f"unix:{address}"
+        self._address = address
+        self._disconnecting = False
         self.conn = connect_address(address)
         self._rid = itertools.count(1)
         self._pending: dict[int, _Future] = {}
@@ -210,11 +214,24 @@ class CoreWorker:
         self._stream_acks: dict[str, int] = {}  # producing streams: consumed idx
         self._stream_events: dict[str, threading.Event] = {}
         self._stream_cancelled: set[str] = set()
+        # this process's runtime-env fingerprint: set at spawn, used by the
+        # scheduler to match tasks to compatible workers (reference: worker
+        # pool keyed by runtime-env hash, worker_pool.h:280)
+        self.renv_hash = ""
+        renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+        if renv_json:
+            import json as _json
+
+            from ray_tpu.runtime_env import env_hash as _env_hash
+
+            self.renv_hash = _env_hash(_json.loads(renv_json))
+        self._renv_cache: dict[str, tuple[dict, str]] = {}
+        self.default_runtime_env: dict | None = None  # job-level default
         from ray_tpu._private.accelerators import current_worker_chips
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
                           "pid": os.getpid(), "node_id": self.node_id,
-                          "host": self.host_id,
+                          "host": self.host_id, "renv_hash": self.renv_hash,
                           "tpu_chips": current_worker_chips()})
         if reply.get("ok") is False:
             raise RayTpuError(f"registration rejected: {reply.get('error')}")
@@ -225,8 +242,12 @@ class CoreWorker:
         # incref/decref can finalize an ObjectRef on the same thread, whose
         # __del__ re-enters decref while the lock is held
         self._ref_lock = threading.RLock()
+        self._flush_order_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
         self._ref_deltas: dict[str, int] = {}
-        self._gc_enabled = os.environ.get("RAY_TPU_AUTO_GC", "1") != "0"
+        from ray_tpu._private.ray_config import RayConfig
+
+        self._gc_enabled = RayConfig.get("auto_gc")
         self._ref_flush_thread = threading.Thread(
             target=self._ref_flush_loop, daemon=True, name="cw-refs")
         self._ref_flush_thread.start()
@@ -261,22 +282,57 @@ class CoreWorker:
             self._obj_waits.pop(oid, None)
 
     def _ref_flush_loop(self):
+        from ray_tpu._private.ray_config import RayConfig
+
+        cfg = RayConfig.instance()
+        last_metrics = 0.0
         while self._alive:
-            time.sleep(0.2)
+            time.sleep(cfg.ref_flush_interval_s)
             self._flush_ref_deltas()
+            now = time.time()
+            if now - last_metrics >= cfg.metrics_report_interval_s:
+                last_metrics = now
+                self._flush_telemetry()
+
+    def _flush_telemetry(self):
+        """Ship user metrics + task/profile events to the GCS (reference:
+        task_event_buffer.h batching; metrics agent reporting)."""
+        try:
+            from ray_tpu._private import task_events as _te
+            from ray_tpu.util import metrics as _met
+
+            events = _te.drain()
+            if events:
+                for ev in events:
+                    ev["worker_id"] = self.wid
+                self.send_no_reply({"type": "events_report", "events": events})
+            snap = _met.snapshot()
+            if snap:
+                self.send_no_reply({"type": "metrics_report",
+                                    "source": self.wid, "metrics": snap})
+        except ConnectionClosed:
+            pass
+        except Exception:
+            pass  # telemetry must never take down the worker
 
     def _flush_ref_deltas(self):
-        with self._ref_lock:
-            deltas = dict(self._ref_deltas)
-            self._ref_deltas.clear()
-        # zero entries still ship: a +1/-1 that cancelled within one flush
-        # window must still tell the GCS the object was referenced (and is
-        # no longer) — otherwise it can never become freeable
-        if deltas:
-            try:
-                self.send_no_reply({"type": "ref_delta", "deltas": deltas})
-            except ConnectionClosed:
-                pass
+        # _flush_order_lock spans snapshot AND send: without it, the periodic
+        # flusher could snapshot deltas, get preempted, and an exec thread's
+        # pre-task_done flush would see an empty dict and emit task_done
+        # before the snapshot's +1s hit the wire (breaking the borrower
+        # ordering guarantee in execute_task)
+        with self._flush_order_lock:
+            with self._ref_lock:
+                deltas = dict(self._ref_deltas)
+                self._ref_deltas.clear()
+            # zero entries still ship: a +1/-1 that cancelled within one
+            # flush window must still tell the GCS the object was referenced
+            # (and is no longer) — otherwise it can never become freeable
+            if deltas:
+                try:
+                    self.send_no_reply({"type": "ref_delta", "deltas": deltas})
+                except ConnectionClosed:
+                    pass
 
     # ------------------------------------------------------------------- rpc
 
@@ -340,12 +396,73 @@ class CoreWorker:
                     if ev is not None:
                         ev.set()
         except ConnectionClosed:
+            if self.kind == "driver" and not self._disconnecting:
+                # drivers outlive a GCS restart: retry connect + re-register
+                # within the configured window (reference: retryable grpc
+                # clients + GCS fault tolerance, retryable_grpc_client.h).
+                # If another thread already owns the reconnect, this stale
+                # recv thread just exits — it must NOT mark the worker dead.
+                if not self._reconnect_lock.acquire(blocking=False):
+                    return
+                try:
+                    if self._try_reconnect():
+                        return  # a fresh recv thread owns the new connection
+                finally:
+                    self._reconnect_lock.release()
             self._alive = False
             self.exec_queue.put(None)
             with self._pending_lock:
                 for fut in self._pending.values():
                     fut.set({"ok": False, "error": "connection to GCS lost"})
                 self._pending.clear()
+
+    def _try_reconnect(self) -> bool:
+        """Dial + re-register on a fresh connection. The register handshake
+        runs synchronously on the candidate socket (no recv thread until it
+        succeeds), so a drop mid-handshake can't spawn competing reconnect
+        loops. Caller holds self._reconnect_lock."""
+        from ray_tpu._private.accelerators import current_worker_chips
+        from ray_tpu._private.ray_config import RayConfig
+
+        window = RayConfig.get("gcs_reconnect_timeout_s")
+        # in-flight RPCs died with the old connection; fail them so callers
+        # can retry at their level (their rids are unknown to the new GCS)
+        with self._pending_lock:
+            for fut in self._pending.values():
+                fut.set({"ok": False, "error": "GCS connection reset; retry"})
+            self._pending.clear()
+        deadline = time.monotonic() + window
+        while time.monotonic() < deadline and not self._disconnecting:
+            conn = None
+            try:
+                conn = connect_address(self._address, timeout=2.0)
+                rid = next(self._rid)
+                conn.sock.settimeout(10.0)
+                conn.send({"type": "register", "rid": rid, "wid": self.wid,
+                           "kind": self.kind, "pid": os.getpid(),
+                           "node_id": self.node_id, "host": self.host_id,
+                           "renv_hash": self.renv_hash,
+                           "tpu_chips": current_worker_chips()})
+                reply = conn.recv()
+                while reply.get("rid") != rid:
+                    reply = conn.recv()  # skip stray non-handshake frames
+                if not reply.get("ok"):
+                    conn.close()
+                    return False
+                conn.sock.settimeout(None)
+                self.conn = conn
+                self._recv_thread = threading.Thread(
+                    target=self._recv_loop, daemon=True, name="cw-recv")
+                self._recv_thread.start()
+                return True
+            except (ConnectionClosed, OSError):
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                time.sleep(0.2)
+        return False
 
     # ----------------------------------------------------------------- tasks
 
@@ -369,16 +486,35 @@ class CoreWorker:
             spec_part["ref_holds"] = ref_holds
         if len(payload) > ARGS_INLINE_LIMIT:
             oid = ObjectID.for_put().hex()
-            self.store.put_parts(oid, [payload], len(payload))
+            tier = self.store.put_parts(oid, [payload], len(payload))
             # pinned: no user ref ever exists for an args blob — the GCS
             # frees it with the task's retained lineage (or at actor death)
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
                                 "size": len(payload), "host": self.host_id,
-                                "pin": True})
+                                "pin": True, "tier": tier})
             spec_part["args_oid"] = oid
         else:
             spec_part["args"] = payload
         return spec_part, deps
+
+    def _prepare_runtime_env(self, runtime_env) -> tuple[dict, str]:
+        """Normalize + package a runtime_env once per distinct input
+        (reference: URI-cached packaging, runtime_env/packaging.py)."""
+        if not runtime_env:
+            runtime_env = self.default_runtime_env
+            if not runtime_env:
+                return {}, ""
+        import json as _json
+
+        from ray_tpu import runtime_env as renv_mod
+
+        key = _json.dumps(runtime_env, sort_keys=True, default=str)
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            norm = renv_mod.package(runtime_env, self.kv_put, self.kv_get)
+            cached = (norm, renv_mod.env_hash(norm))
+            self._renv_cache[key] = cached
+        return cached
 
     def submit_task(
         self,
@@ -391,9 +527,16 @@ class CoreWorker:
         max_retries: int = 0,
         name: str = "",
         strategy: dict | None = None,
+        runtime_env: dict | None = None,
     ) -> list[ObjectRef]:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
+        renv, rhash = self._prepare_runtime_env(runtime_env)
+        # submitter's refs must be counted at the GCS before the task can
+        # possibly complete: otherwise a borrower's death could free an
+        # object whose only counted ref was the borrower's (the submitter's
+        # +1 still in its 0.2s flush window)
+        self._flush_ref_deltas()
         spec = {
             "kind": "task",
             "task_id": task_id,
@@ -405,6 +548,7 @@ class CoreWorker:
             "retries_used": 0,
             "name": name,
             "strategy": strategy,
+            **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
         self.rpc({"type": "submit_task", "spec": spec})
@@ -423,10 +567,13 @@ class CoreWorker:
         name: str | None = None,
         strategy: dict | None = None,
         max_concurrency: int = 1,
+        runtime_env: dict | None = None,
     ) -> str:
         actor_id = ActorID().hex()
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
+        renv, rhash = self._prepare_runtime_env(runtime_env)
+        self._flush_ref_deltas()  # see submit_task: count refs before submit
         spec = {
             "kind": "actor_create",
             "task_id": task_id,
@@ -439,6 +586,7 @@ class CoreWorker:
             "name": name,
             "strategy": strategy,
             "max_concurrency": max_concurrency,
+            **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
         reply = self.rpc({"type": "create_actor", "spec": spec})
@@ -457,6 +605,7 @@ class CoreWorker:
     ) -> list[ObjectRef]:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
+        self._flush_ref_deltas()  # see submit_task: count refs before submit
         spec = {
             "kind": "actor_task",
             "task_id": task_id,
@@ -495,10 +644,10 @@ class CoreWorker:
                                 "inline": blob, "size": total, "pin": pin,
                                 "contained": contained})
         else:
-            self.store.put_parts(oid, parts, total)
+            tier = self.store.put_parts(oid, parts, total)
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
                                 "size": total, "host": self.host_id, "pin": pin,
-                                "contained": contained})
+                                "contained": contained, "tier": tier})
         return ObjectRef(oid)
 
     def _ensure_local(self, oid: str, reply: dict) -> dict:
@@ -550,10 +699,15 @@ class CoreWorker:
         for host, addr in locations:
             if host == self.host_id or not addr:
                 continue
-            if self._fetcher.fetch(oid, addr):
+            tier = self._fetcher.fetch(oid, addr)
+            if tier:
+                if tier not in ("shm", "spill"):
+                    # fetch dedup'd into a concurrent pull: ask the store
+                    # which tier the winner actually landed on
+                    tier = self.store.tier_of(oid) or "shm"
                 self.send_no_reply({"type": "object_put", "oid": oid,
                                     "where": "shm", "size": reply.get("size", 0),
-                                    "host": self.host_id})
+                                    "host": self.host_id, "tier": tier})
                 return True
         return False
 
@@ -710,6 +864,7 @@ class CoreWorker:
         task_id = spec["task_id"]
         bp = int(spec.get("backpressure") or 16)
         produced = 0
+        stalled = False
         try:
             for val in out:
                 if task_id in self._stream_cancelled:
@@ -724,8 +879,8 @@ class CoreWorker:
                                     for p in parts)
                     msg.update(where="inline", inline=blob)
                 else:
-                    self.store.put_parts(oid, parts, total)
-                    msg.update(where="shm", host=self.host_id)
+                    tier = self.store.put_parts(oid, parts, total)
+                    msg.update(where="shm", host=self.host_id, tier=tier)
                 self.send_no_reply(msg)
                 produced += 1
                 stalled = False
@@ -742,8 +897,20 @@ class CoreWorker:
                         break           # produce unboundedly past it
                 if stalled:
                     break
-            self.send_no_reply({"type": "stream_end", "wid": self.wid,
-                                "task_id": task_id, "error": None})
+            if stalled:
+                # a merely-slow consumer must see an ERROR, not a clean
+                # StopIteration with silently truncated results
+                err = ser.dumps(RayTaskError(
+                    spec.get("name") or "stream", "",
+                    TimeoutError(
+                        f"streaming producer stalled: consumer took no item "
+                        f"for 60s with the producer {bp} items ahead "
+                        f"(produced {produced})")))
+                self.send_no_reply({"type": "stream_end", "wid": self.wid,
+                                    "task_id": task_id, "error": err})
+            else:
+                self.send_no_reply({"type": "stream_end", "wid": self.wid,
+                                    "task_id": task_id, "error": None})
         finally:
             self._stream_acks.pop(task_id, None)
             self._stream_events.pop(task_id, None)
@@ -755,6 +922,7 @@ class CoreWorker:
         results = []
         contained_map: dict = {}
         self._task_ctx.task_id = spec["task_id"]
+        _t_exec0 = time.time()
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
@@ -798,8 +966,8 @@ class CoreWorker:
                     blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
                     results.append((oid, "inline", blob, total))
                 else:
-                    self.store.put_parts(oid, parts, total)
-                    results.append((oid, "shm", None, total))
+                    tier = self.store.put_parts(oid, parts, total)
+                    results.append((oid, "shm", None, total, tier))
         except Exception as e:  # noqa: BLE001 — task errors must be captured, not crash the worker
             tb = traceback.format_exc()
             wrapped = RayTaskError(spec.get("name") or spec.get("method", kind), tb, e)
@@ -831,7 +999,18 @@ class CoreWorker:
                 if not held:
                     self._memory.pop(dep, None)
                     self._plasma_refs.pop(dep, None)
+        from ray_tpu._private import task_events as _te
+
+        _te.emit("task:execute", task_id=spec["task_id"],
+                 name=spec.get("name") or spec.get("method") or kind,
+                 start=_t_exec0, end=time.time(), kind=kind,
+                 ok=error_blob is None)
         lite = {k: spec.get(k) for k in ("task_id", "kind", "actor_id", "resources", "num_returns", "max_retries", "retries_used")}
+        # flush ref deltas BEFORE task_done on the same ordered connection:
+        # refs this task deserialized/retained must reach the GCS before it
+        # releases the task's system holds, or a borrowed ref could be freed
+        # under us (reference: borrower protocol, reference_counter.h:43)
+        self._flush_ref_deltas()
         self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite,
                             "results": results, "error": error_blob,
                             "contained": contained_map})
@@ -853,6 +1032,7 @@ class CoreWorker:
         global _ref_tracker
         if _ref_tracker is self:
             _ref_tracker = None
+        self._disconnecting = True
         self._alive = False
         try:
             self._flush_ref_deltas()

@@ -19,6 +19,16 @@ def main():
 
     worker = CoreWorker(address, session_id, kind="worker")
     set_global_worker(worker)
+    renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if renv_json:
+        # materialize working_dir / py_modules from the GCS package cache
+        # before any task runs (reference: worker start through the
+        # runtime-env agent, runtime_env_agent.py:303)
+        import json
+
+        from ray_tpu import runtime_env as _renv
+
+        _renv.apply_to_process(json.loads(renv_json), worker.kv_get)
     code = 0
     try:
         worker.exec_loop()

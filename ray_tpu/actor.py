@@ -69,7 +69,7 @@ _UNSET = object()
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
                  max_restarts=0, name=None, lifetime=None, scheduling_strategy=None,
-                 max_concurrency=1):
+                 max_concurrency=1, runtime_env=None):
         self._cls = cls
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
@@ -77,6 +77,7 @@ class ActorClass:
         self._name = name
         self._strategy = scheduling_strategy
         self._max_concurrency = max_concurrency
+        self._runtime_env = runtime_env
         self._blob: bytes | None = None
         self.__name__ = getattr(cls, "__name__", "Actor")
 
@@ -89,7 +90,7 @@ class ActorClass:
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
                 max_restarts=None, name=None, lifetime=None,
                 scheduling_strategy=_UNSET, max_concurrency=None,
-                **_ignored) -> "ActorClass":
+                runtime_env=_UNSET, **_ignored) -> "ActorClass":
         ac = ActorClass(
             self._cls,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -102,6 +103,8 @@ class ActorClass:
                                  else scheduling_strategy),
             max_concurrency=(self._max_concurrency if max_concurrency is None
                              else max_concurrency),
+            runtime_env=(self._runtime_env if runtime_env is _UNSET
+                         else runtime_env),
         )
         ac._blob = self._blob
         return ac
@@ -120,6 +123,7 @@ class ActorClass:
             name=self._name,
             strategy=strategy_to_spec(self._strategy),
             max_concurrency=self._max_concurrency,
+            runtime_env=self._runtime_env,
         )
         return ActorHandle(actor_id)
 

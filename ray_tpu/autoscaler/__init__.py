@@ -1,0 +1,4 @@
+from .autoscaler import Autoscaler, NodeType
+from .node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["Autoscaler", "NodeType", "NodeProvider", "LocalNodeProvider"]

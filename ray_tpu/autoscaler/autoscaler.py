@@ -1,0 +1,199 @@
+"""Declarative autoscaler: reconcile node count against pending demand.
+
+Reference capability: autoscaler v2's reconciler
+(reference: python/ray/autoscaler/v2/autoscaler.py:47, scheduler.py,
+instance_manager/reconciler.py) consuming the GCS autoscaler-state API
+(src/ray/gcs/gcs_autoscaler_state_manager.h), and v1's demand bin-packing
+(autoscaler/_private/resource_demand_scheduler.py:100).
+
+Loop: read pending demand from the GCS → bin-pack unplaceable demand onto
+configured node types (respecting min/max counts) → create/terminate via the
+NodeProvider → repeat. TPU slices scale atomically: a `NodeType` with TPU
+resources is created/terminated as one unit, never partially.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu._private.protocol import ConnectionClosed, connect_address
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeType:
+    name: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    min_nodes: int = 0
+    max_nodes: int = 10
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _deduct(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class Autoscaler:
+    """One reconciler per cluster, connected to the GCS as a client."""
+
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 node_types: List[NodeType], *, interval_s: float = 2.0,
+                 idle_timeout_s: float = 60.0):
+        self.provider = provider
+        self.node_types = {nt.name: nt for nt in node_types}
+        self.interval_s = interval_s
+        self.idle_timeout_s = idle_timeout_s
+        self._conn = connect_address(gcs_address)
+        self._rid = itertools.count(1)
+        self._nodes: Dict[str, str] = {}  # provider node id → type name
+        self._launch_times: Dict[str, float] = {}
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- GCS I/O -----------------------------------------------------------
+
+    def _demand(self) -> dict:
+        msg = {"type": "resource_demand", "rid": next(self._rid)}
+        self._conn.send(msg)
+        while True:
+            reply = self._conn.recv()
+            if reply.get("rid") == msg["rid"]:
+                return reply["demand"]
+
+    # -- reconciliation ----------------------------------------------------
+
+    def reconcile_once(self) -> dict:
+        """One reconcile pass; returns a summary (for tests/introspection)."""
+        demand = self._demand()
+        actions = {"launched": [], "terminated": []}
+
+        # 1. unplaceable demand = demands that don't fit current availability
+        avail = dict(demand["available_resources"])
+        unmet: List[Dict[str, float]] = []
+        for d in demand["demands"]:
+            if _fits(avail, d):
+                _deduct(avail, d)
+            else:
+                unmet.append(d)
+        for pg in demand["pg_demands"]:
+            for b in pg["bundles"]:
+                if _fits(avail, b):
+                    _deduct(avail, b)
+                else:
+                    unmet.append(b)
+
+        # 2. min_nodes floors
+        counts: Dict[str, int] = {}
+        for nid, tname in self._nodes.items():
+            counts[tname] = counts.get(tname, 0) + 1
+        for nt in self.node_types.values():
+            while counts.get(nt.name, 0) < nt.min_nodes:
+                nid = self._launch(nt)
+                actions["launched"].append((nt.name, nid))
+                counts[nt.name] = counts.get(nt.name, 0) + 1
+
+        # 3. bin-pack unmet demand onto new nodes — several demands may share
+        #    one planned node (reference: ResourceDemandScheduler bin-packing)
+        planned: List[tuple] = []  # (NodeType, remaining capacity)
+        for d in sorted(unmet, key=lambda d: -sum(d.values())):
+            for _, rem in planned:
+                if _fits(rem, d):
+                    _deduct(rem, d)
+                    break
+            else:
+                for nt in self.node_types.values():
+                    count_now = (counts.get(nt.name, 0)
+                                 + sum(1 for p, _ in planned
+                                       if p.name == nt.name))
+                    if count_now >= nt.max_nodes:
+                        continue
+                    if _fits(dict(nt.resources), d):
+                        rem = dict(nt.resources)
+                        _deduct(rem, d)
+                        planned.append((nt, rem))
+                        break
+        for nt, _ in planned:
+            nid = self._launch(nt)
+            actions["launched"].append((nt.name, nid))
+
+        # 4. terminate idle above-min nodes (no demand and nothing running
+        #    on them — approximated by zero unmet demand + full availability)
+        if not unmet and not demand["pg_demands"]:
+            now = time.monotonic()
+            for nid, tname in list(self._nodes.items()):
+                nt = self.node_types.get(tname)
+                if nt is None:
+                    continue
+                alive_of_type = sum(1 for t in self._nodes.values() if t == tname)
+                if alive_of_type <= nt.min_nodes:
+                    self._idle_since.pop(nid, None)
+                    continue
+                since = self._idle_since.setdefault(nid, now)
+                if now - since >= self.idle_timeout_s:
+                    self._terminate(nid)
+                    actions["terminated"].append((tname, nid))
+        else:
+            self._idle_since.clear()
+
+        # reap externally-died nodes
+        live = set(self.provider.non_terminated_nodes())
+        for nid in list(self._nodes):
+            if nid not in live:
+                self._nodes.pop(nid, None)
+                self._idle_since.pop(nid, None)
+        return actions
+
+    def _launch(self, nt: NodeType) -> str:
+        nid = self.provider.create_node(nt.name, nt.resources, nt.labels)
+        self._nodes[nid] = nt.name
+        self._launch_times[nid] = time.monotonic()
+        logger.info("autoscaler: launched %s node %s", nt.name, nid)
+        return nid
+
+    def _terminate(self, nid: str) -> None:
+        self.provider.terminate_node(nid)
+        tname = self._nodes.pop(nid, "?")
+        self._idle_since.pop(nid, None)
+        logger.info("autoscaler: terminated %s node %s", tname, nid)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reconcile_once()
+            except ConnectionClosed:
+                return
+            except Exception:
+                logger.exception("autoscaler reconcile failed")
+
+    def stop(self, terminate_nodes: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if terminate_nodes:
+            for nid in list(self._nodes):
+                self._terminate(nid)
+        try:
+            self._conn.close()
+        except Exception:
+            pass

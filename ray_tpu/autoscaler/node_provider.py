@@ -1,0 +1,83 @@
+"""Node providers: how the autoscaler actually creates/terminates nodes.
+
+Reference capability: autoscaler NodeProvider plugins (AWS/GCP/KubeRay/...,
+reference: python/ray/autoscaler/node_provider.py + autoscaler/_private/*/
+— create_node/terminate_node/non_terminated_nodes) and the v2 cloud
+providers (autoscaler/v2/instance_manager/cloud_providers/).
+
+Two in-tree providers:
+- `LocalNodeProvider` spawns node-agent subprocesses joining the live GCS —
+  the single-machine analogue of launching a VM (how the reference's fake
+  multi-node provider works, autoscaler/_private/fake_multi_node/).
+- Custom providers subclass NodeProvider (e.g. a GKE TPU-slice provider
+  where one "node" is an atomic TPU slice).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Interface. Node ids are provider-scoped strings."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def is_ready(self, node_id: str) -> bool:
+        """Has the node joined the cluster?"""
+        return True
+
+    def shutdown(self) -> None:
+        for nid in list(self.non_terminated_nodes()):
+            self.terminate_node(nid)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launches follower node agents as subprocesses against a live GCS."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        host_id = f"as-{node_type}-{uuid.uuid4().hex[:6]}"
+        cmd = [sys.executable, "-m", "ray_tpu._private.node_agent",
+               "--address", self.gcs_address, "--host-id", host_id]
+        if "CPU" in resources:
+            cmd += ["--num-cpus", str(resources["CPU"])]
+        if "TPU" in resources:
+            cmd += ["--num-tpus", str(resources["TPU"])]
+        p = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        with self._lock:
+            self._procs[host_id] = p
+        return host_id
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            p = self._procs.pop(node_id, None)
+        if p is not None:
+            p.terminate()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return [nid for nid, p in self._procs.items() if p.poll() is None]

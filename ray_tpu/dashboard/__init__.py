@@ -1,0 +1,3 @@
+from .head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

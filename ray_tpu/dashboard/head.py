@@ -1,0 +1,224 @@
+"""Dashboard head: HTTP server over cluster state, logs, metrics, timeline.
+
+Reference capability: the aiohttp dashboard head + state aggregator + metrics
+and log modules (reference: python/ray/dashboard/head.py,
+dashboard/http_server_head.py, dashboard/state_aggregator.py,
+dashboard/modules/{log,metrics,job}/). TPU build keeps it dependency-free:
+a stdlib ThreadingHTTPServer reading the GCS over the session socket.
+
+Endpoints:
+  GET /                      — HTML overview
+  GET /api/cluster           — cluster_state JSON
+  GET /api/nodes|actors|placement_groups|jobs|tasks
+  GET /api/logs              — list log files; /api/logs/<name>?tail=N
+  GET /api/timeline          — chrome://tracing JSON of task events
+  GET /metrics               — Prometheus text format
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ray_tpu._private.protocol import connect_unix
+
+
+class _Gcs:
+    """Small resilient GCS client (reconnects on failure)."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self._conn = None
+        self._rid = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def rpc(self, msg: dict) -> dict:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._conn is None:
+                        self._conn = connect_unix(
+                            os.path.join(self.session_dir, "gcs.sock"),
+                            timeout=5.0)
+                    m = dict(msg)
+                    m["rid"] = next(self._rid)
+                    self._conn.send(m)
+                    return self._conn.recv()
+                except Exception:
+                    try:
+                        if self._conn is not None:
+                            self._conn.close()
+                    finally:
+                        self._conn = None
+                    if attempt:
+                        raise
+        raise RuntimeError("unreachable")
+
+
+_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #999;padding:4px 8px;text-align:left}}</style></head>
+<body><h2>ray_tpu — {session}</h2>
+<p>workers: {num_workers} &nbsp; actors: {num_actors} &nbsp;
+pending tasks: {pending_tasks}</p>
+<h3>resources</h3><table><tr><th>resource</th><th>used</th><th>total</th></tr>
+{resources}</table>
+<h3>endpoints</h3><ul>
+<li><a href="/api/cluster">/api/cluster</a></li>
+<li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/actors">/api/actors</a></li>
+<li><a href="/api/placement_groups">/api/placement_groups</a></li>
+<li><a href="/api/jobs">/api/jobs</a></li>
+<li><a href="/api/tasks">/api/tasks</a></li>
+<li><a href="/api/logs">/api/logs</a></li>
+<li><a href="/api/timeline">/api/timeline</a></li>
+<li><a href="/metrics">/metrics</a></li>
+</ul></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ray_tpu_dashboard/1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, body: bytes, ctype: str = "application/json",
+              code: int = 200):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200):
+        self._send(json.dumps(obj, indent=1, default=str).encode(),
+                   "application/json", code)
+
+    def do_GET(self):  # noqa: N802
+        gcs: _Gcs = self.server.gcs  # type: ignore[attr-defined]
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        q = urllib.parse.parse_qs(parsed.query)
+        try:
+            if path == "/":
+                st = gcs.rpc({"type": "cluster_state"})["state"]
+                total, avail = st["total_resources"], st["available_resources"]
+                rows = "".join(
+                    f"<tr><td>{k}</td><td>{total[k]-avail.get(k,0):.1f}</td>"
+                    f"<td>{total[k]:.1f}</td></tr>" for k in sorted(total))
+                html = _INDEX.format(
+                    session=os.path.basename(gcs.session_dir),
+                    num_workers=st["num_workers"], num_actors=st["num_actors"],
+                    pending_tasks=st["pending_tasks"], resources=rows)
+                self._send(html.encode(), "text/html")
+            elif path == "/api/cluster":
+                self._json(gcs.rpc({"type": "cluster_state"})["state"])
+            elif path == "/api/nodes":
+                self._json(gcs.rpc({"type": "list_nodes"})["nodes"])
+            elif path == "/api/actors":
+                st = gcs.rpc({"type": "cluster_state"})["state"]
+                self._json(st.get("actors", {}))
+            elif path == "/api/placement_groups":
+                self._json(gcs.rpc({"type": "pg_table"})["table"])
+            elif path == "/api/tasks":
+                self._json(gcs.rpc({"type": "task_events"}).get("events", []))
+            elif path == "/api/timeline":
+                from ray_tpu._private.task_events import to_chrome_trace
+
+                evs = gcs.rpc({"type": "task_events"}).get("events", [])
+                self._send(to_chrome_trace(evs).encode())
+            elif path == "/api/jobs":
+                keys = gcs.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
+                jobs = []
+                for k in keys:
+                    v = gcs.rpc({"type": "kv_get", "key": k}).get("value")
+                    if v:
+                        try:
+                            jobs.append(json.loads(v))
+                        except Exception:
+                            pass
+                self._json(jobs)
+            elif path == "/api/logs":
+                log_dir = os.path.join(gcs.session_dir, "logs")
+                names = sorted(os.listdir(log_dir)) if os.path.isdir(log_dir) else []
+                self._json([{"name": n, "size": os.path.getsize(
+                    os.path.join(log_dir, n))} for n in names])
+            elif path.startswith("/api/logs/"):
+                name = os.path.basename(path[len("/api/logs/"):])
+                fp = os.path.join(gcs.session_dir, "logs", name)
+                if not os.path.isfile(fp):
+                    self._json({"error": f"no such log {name!r}"}, 404)
+                    return
+                with open(fp, "rb") as f:
+                    data = f.read()
+                tail = int(q.get("tail", [0])[0] or 0)
+                if tail:
+                    data = b"\n".join(data.splitlines()[-tail:])
+                self._send(data, "text/plain")
+            elif path == "/metrics":
+                from ray_tpu.util.metrics import to_prometheus
+
+                agg = gcs.rpc({"type": "metrics_snapshot"}).get("metrics", {})
+                self._send(to_prometheus(agg).encode(),
+                           "text/plain; version=0.0.4")
+            else:
+                self._json({"error": "not found"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # surface GCS errors as 503
+            try:
+                self._json({"error": repr(e)}, 503)
+            except Exception:
+                pass
+
+
+class DashboardHead:
+    def __init__(self, session_dir: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.gcs = _Gcs(session_dir)  # type: ignore[attr-defined]
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "DashboardHead":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="dashboard", daemon=True)
+        self._thread.start()
+        # advertise for CLI / users
+        try:
+            with open(os.path.join(self.httpd.gcs.session_dir,  # type: ignore
+                                   "dashboard_url"), "w") as f:
+                f.write(f"http://127.0.0.1:{self.port}")
+        except OSError:
+            pass
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_dashboard(session_dir: str, host: str = "127.0.0.1",
+                    port: int = 0) -> DashboardHead:
+    return DashboardHead(session_dir, host, port).start()
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    args = p.parse_args(argv)
+    head = DashboardHead(args.session_dir, args.host, args.port)
+    print(f"dashboard on http://{args.host}:{head.port}")
+    head.httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -30,13 +30,19 @@ def _build_resources(num_cpus, num_tpus, resources) -> dict:
 
 class RemoteFunction:
     def __init__(self, func, *, num_cpus=None, num_tpus=None, resources=None,
-                 num_returns=1, max_retries=0, scheduling_strategy=None):
+                 num_returns=1, max_retries=None, scheduling_strategy=None,
+                 runtime_env=None):
         self._func = func
         self._num_returns = num_returns
+        if max_retries is None:
+            from ray_tpu._private.ray_config import RayConfig
+
+            max_retries = RayConfig.get("default_max_retries")
         self._max_retries = max_retries
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
         self._strategy = scheduling_strategy
+        self._runtime_env = runtime_env
         self._blob: bytes | None = None
         functools.update_wrapper(self, func)
 
@@ -47,7 +53,7 @@ class RemoteFunction:
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
                 num_returns=None, max_retries=None, scheduling_strategy=_UNSET,
-                **_ignored) -> "RemoteFunction":
+                runtime_env=_UNSET, **_ignored) -> "RemoteFunction":
         rf = RemoteFunction(
             self._func,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -57,6 +63,8 @@ class RemoteFunction:
             max_retries=self._max_retries if max_retries is None else max_retries,
             scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
                                  else scheduling_strategy),
+            runtime_env=(self._runtime_env if runtime_env is _UNSET
+                         else runtime_env),
         )
         rf._blob = self._blob
         return rf
@@ -75,6 +83,7 @@ class RemoteFunction:
             max_retries=self._max_retries,
             name=getattr(self._func, "__name__", "task"),
             strategy=strategy_to_spec(self._strategy),
+            runtime_env=self._runtime_env,
         )
         if self._num_returns == "streaming":
             return refs  # an ObjectRefGenerator (reference: _raylet.pyx:299)

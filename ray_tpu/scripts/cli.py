@@ -151,6 +151,79 @@ def _print_tail(f, n_lines: int):
         print(line)
 
 
+def cmd_start(args):
+    """Start a head session (`ray_tpu start --head`) or join an existing one
+    as a follower host (`ray_tpu start --address host:port`) and block.
+    (reference capability: `ray start` head/worker modes, scripts.py:679.)"""
+    if args.head:
+        from ray_tpu._private.node import Node
+
+        node = Node(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    num_workers=args.num_workers,
+                    max_workers=args.max_workers)
+        print(f"head started: session={node.session_id}")
+        print(f"  session dir: {node.session_dir}")
+        print(f"  address:     {node.address}")
+        print(f"  join:        ray_tpu start --address {node.address}")
+        print(f"  driver:      ray_tpu.init(address={node.address!r})")
+        if args.dashboard:
+            from ray_tpu.dashboard import start_dashboard
+
+            head = start_dashboard(node.session_dir, port=args.dashboard_port)
+            print(f"  dashboard:   http://127.0.0.1:{head.port}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            node.shutdown()
+    elif args.address:
+        from ray_tpu._private.node_agent import NodeAgent
+
+        agent = NodeAgent(address=args.address,
+                          num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+        print(f"node agent {agent.host_id} joined {args.address}")
+        agent.serve_forever()
+    else:
+        print("specify --head or --address", file=sys.stderr)
+        sys.exit(2)
+
+
+def cmd_timeline(args):
+    """Export collected task events as a chrome://tracing JSON file
+    (reference capability: `ray timeline`, GcsTaskManager + profile events)."""
+    from ray_tpu._private.task_events import to_chrome_trace
+
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        events = c.rpc({"type": "task_events"}).get("events", [])
+    finally:
+        c.close()
+    # normalize GCS-side completion records (ts only) into spans
+    for ev in events:
+        if "start" not in ev and "ts" in ev:
+            ev["start"] = ev["ts"]
+            ev["end"] = ev["ts"]
+            ev.setdefault("event", "task:done")
+            ev.setdefault("worker_id", ev.get("worker", ""))
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        f.write(to_chrome_trace(events))
+    print(f"wrote {len(events)} events to {out} (open in chrome://tracing)")
+
+
+def cmd_dashboard(args):
+    from ray_tpu.dashboard.head import DashboardHead
+
+    sd = _pick_session(args)
+    head = DashboardHead(sd, args.host, args.port)
+    print(f"dashboard on http://{args.host}:{head.port}")
+    try:
+        head.httpd.serve_forever()
+    except KeyboardInterrupt:
+        head.stop()
+
+
 def cmd_microbenchmark(args):
     from ray_tpu._private import ray_perf
 
@@ -210,6 +283,26 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="run core runtime microbenchmarks")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("start", help="start a head session or join as follower")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS host:port to join as follower")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--num-workers", type=int, default=0)
+    sp.add_argument("--max-workers", type=int, default=16)
+    sp.add_argument("--dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=0)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("timeline", help="export task timeline (chrome trace)")
+    sp.add_argument("-o", "--output", help="output path (default timeline.json)")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("submit", help="submit a job (command) to the cluster")
     sp.add_argument("--no-wait", action="store_true")

@@ -1,0 +1,73 @@
+"""joblib backend running on ray_tpu (reference capability:
+python/ray/util/joblib/ — `register_ray()` + `parallel_backend("ray")`).
+
+Usage::
+
+    import joblib
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+
+def register_ray() -> None:
+    """Register the 'ray_tpu' joblib parallel backend (no-op if joblib is
+    not installed)."""
+    try:
+        from joblib import register_parallel_backend
+    except ImportError:  # joblib optional
+        return
+    register_parallel_backend("ray_tpu", _make_backend)
+
+
+def _make_backend():
+    from joblib._parallel_backends import ThreadingBackend
+
+    import ray_tpu
+
+    class RayTpuBackend(ThreadingBackend):
+        """Tasks go to the cluster; joblib's batching/thread plumbing is
+        reused with apply_async redirected to remote tasks (the reference's
+        backend subclasses a pool backend the same way)."""
+
+        supports_timeout = True
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self.parallel = parallel
+            return super().configure(n_jobs, parallel, **kwargs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == -1:
+                return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            return super().effective_n_jobs(n_jobs)
+
+        def apply_async(self, func, callback=None):
+            @ray_tpu.remote
+            def run_batch(f):
+                return f()
+
+            ref = run_batch.remote(func)
+
+            class _Future:
+                def get(self, timeout=None):
+                    return ray_tpu.get(ref, timeout=timeout)
+
+            fut = _Future()
+            if callback is not None:
+                import threading
+
+                def waiter():
+                    try:
+                        callback(fut.get())
+                    except Exception:
+                        pass
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return fut
+
+    return RayTpuBackend()

@@ -1,0 +1,202 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Reference capability: ``ray.util.metrics`` (reference:
+python/ray/util/metrics.py) backed by the C++ ``DECLARE_stats`` pipeline
+(reference: src/ray/stats/metric.h:104,480) exporting through a per-node
+metrics agent to Prometheus (reference: _private/metrics_agent.py:628,757).
+
+TPU-native design: metrics are recorded into a process-local registry with
+nanosecond-cheap local updates (no lock on the hot path beyond a dict GIL
+op); a background flusher in the CoreWorker ships deltas to the GCS, which
+aggregates across the cluster and serves both a JSON snapshot and a
+Prometheus text-format scrape endpoint on the dashboard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+
+def _tag_key(tags: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    if not tags:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in tags.items()))
+
+
+class Metric:
+    """Base: named metric with static default tags + per-record tags."""
+
+    kind = "base"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name or not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name: {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+        # series: tag-tuple -> value (float for counter/gauge, list for hist)
+        self._series: Dict[Tuple, object] = {}
+        self._series_lock = threading.Lock()
+        with _lock:
+            prev = _registry.get(name)
+            if prev is not None and prev.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {prev.kind}")
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[dict]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return _tag_key(merged)
+
+    def _snapshot_series(self) -> List[tuple]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        key = self._merged(tags)
+        with self._series_lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def _snapshot_series(self):
+        with self._series_lock:
+            return [(list(k), v) for k, v in self._series.items()]
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None) -> None:
+        with self._series_lock:
+            self._series[self._merged(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        key = self._merged(tags)
+        with self._series_lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        self.inc(-value, tags)
+
+    def _snapshot_series(self):
+        with self._series_lock:
+            return [(list(k), v) for k, v in self._series.items()]
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries or DEFAULT_BUCKETS)
+
+    def observe(self, value: float, tags: Optional[dict] = None) -> None:
+        key = self._merged(tags)
+        with self._series_lock:
+            st = self._series.get(key)
+            if st is None:
+                st = self._series[key] = {
+                    "buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0, "count": 0}
+            i = 0
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    break
+            else:
+                i = len(self.boundaries)
+            st["buckets"][i] += 1
+            st["sum"] += value
+            st["count"] += 1
+
+    def _snapshot_series(self):
+        with self._series_lock:
+            return [(list(k), {"buckets": list(v["buckets"]),
+                               "sum": v["sum"], "count": v["count"],
+                               "boundaries": list(self.boundaries)})
+                    for k, v in self._series.items()]
+
+
+def snapshot() -> list:
+    """Serializable dump of every metric in this process (for the flusher)."""
+    with _lock:
+        metrics = list(_registry.values())
+    out = []
+    for m in metrics:
+        out.append({"name": m.name, "kind": m.kind,
+                    "description": m.description,
+                    "series": m._snapshot_series(),
+                    "ts": time.time()})
+    return out
+
+
+def clear_registry() -> None:
+    """Test helper."""
+    with _lock:
+        _registry.clear()
+
+
+def to_prometheus(agg: dict) -> str:
+    """Render a GCS-side aggregate ({name: {kind, description, series:
+    {source: [(tags, value), ...]}}}) as Prometheus text format."""
+    lines = []
+    for name, rec in sorted(agg.items()):
+        kind = rec["kind"]
+        if rec.get("description"):
+            lines.append(f"# HELP {name} {rec['description']}")
+        lines.append(f"# TYPE {name} {kind}")
+        # merge across sources: counters/hist sum, gauges take latest
+        merged: dict = {}
+        for source, series in rec["series"].items():
+            for tags, val in series:
+                key = tuple(tuple(t) for t in tags)
+                if kind == "gauge":
+                    merged[key] = val
+                elif kind == "histogram":
+                    cur = merged.get(key)
+                    if cur is None:
+                        merged[key] = {k: (list(v) if isinstance(v, list) else v)
+                                       for k, v in val.items()}
+                    else:
+                        cur["sum"] += val["sum"]
+                        cur["count"] += val["count"]
+                        cur["buckets"] = [a + b for a, b in
+                                          zip(cur["buckets"], val["buckets"])]
+                else:
+                    merged[key] = merged.get(key, 0.0) + val
+        for key, val in merged.items():
+            label = ",".join(f'{k}="{v}"' for k, v in key)
+            label = "{" + label + "}" if label else ""
+            if kind == "histogram":
+                acc = 0
+                for b, n in zip(val["boundaries"], val["buckets"]):
+                    acc += n
+                    lb = ("{" + (label[1:-1] + "," if label else "")
+                          + f'le="{b}"' + "}")
+                    lines.append(f"{name}_bucket{lb} {acc}")
+                lb = ("{" + (label[1:-1] + "," if label else "")
+                      + 'le="+Inf"' + "}")
+                lines.append(f"{name}_bucket{lb} {val['count']}")
+                lines.append(f"{name}_sum{label} {val['sum']}")
+                lines.append(f"{name}_count{label} {val['count']}")
+            else:
+                lines.append(f"{name}{label} {val}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,209 @@
+"""Drop-in `multiprocessing.Pool` over ray_tpu tasks.
+
+Reference capability: ray.util.multiprocessing.Pool
+(reference: python/ray/util/multiprocessing/pool.py) — the same subset of
+the stdlib Pool API (apply/apply_async/map/map_async/imap/imap_unordered/
+starmap), with work shipped to cluster workers instead of forked children.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    """stdlib-compatible handle over one or more ObjectRefs."""
+
+    def __init__(self, refs, single: bool, callback=None, error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._done = threading.Event()
+        self._value = None
+        self._error = None
+        t = threading.Thread(target=self._collect, daemon=True)
+        t.start()
+
+    def _collect(self):
+        try:
+            vals = ray_tpu.get(list(self._refs))
+            self._value = vals[0] if self._single else vals
+            if self._callback is not None:
+                try:
+                    self._callback(self._value)
+                except Exception:
+                    pass
+        except Exception as e:  # noqa: BLE001 — surfaced via get()
+            self._error = e
+            if self._error_callback is not None:
+                try:
+                    self._error_callback(e)
+                except Exception:
+                    pass
+        finally:
+            self._done.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result not ready")
+        return self._error is None
+
+
+class Pool:
+    """Process pool over the cluster. `processes` bounds in-flight tasks
+    (defaults to the cluster's CPU count)."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs: tuple = (), ray_address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=ray_address)
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        self._processes = processes
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+        self._closed = False
+
+    # -- helpers ----------------------------------------------------------
+
+    def _remote_fn(self, func: Callable) -> Any:
+        init, initargs = self._initializer, self._initargs
+        if init is None:
+            return ray_tpu.remote(func)
+
+        def wrapped(*a, **kw):
+            # stdlib semantics: initializer runs once per worker process
+            import builtins
+
+            flag = f"_rtpu_pool_init_{id(init)}"
+            if not getattr(builtins, flag, False):
+                init(*initargs)
+                setattr(builtins, flag, True)
+            return func(*a, **kw)
+
+        return ray_tpu.remote(wrapped)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _submit_chunked(self, func, iterable, chunksize):
+        rf = self._remote_fn(_apply_chunk)
+        fblob = self._remote_fn(func)
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        chunks = [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+        del fblob  # func ships inside the chunk task's closure
+        refs = []
+        window = self._processes * 2
+        for chunk in chunks:
+            if len(refs) >= window:
+                ray_tpu.wait(refs[-window:], num_returns=1)
+            refs.append(rf.remote(func, chunk))
+        return refs, chunksize
+
+    # -- stdlib API -------------------------------------------------------
+
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (), kwds: Optional[dict] = None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        ref = self._remote_fn(func).remote(*args, **(kwds or {}))
+        return AsyncResult([ref], single=True, callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, func, iterable: Iterable, chunksize: Optional[int] = None) -> List:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable: Iterable,
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check_open()
+        refs, _ = self._submit_chunked(func, iterable, chunksize)
+        return _ChunkedResult(refs, callback=callback,
+                              error_callback=error_callback)
+
+    def starmap(self, func, iterable: Iterable, chunksize: Optional[int] = None) -> List:
+        return self.map(lambda args: func(*args), list(iterable), chunksize)
+
+    def imap(self, func, iterable: Iterable, chunksize: Optional[int] = None):
+        self._check_open()
+        refs, _ = self._submit_chunked(func, iterable, chunksize)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        self._check_open()
+        refs, _ = self._submit_chunked(func, iterable, chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in ready:
+                yield from ray_tpu.get(ref)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _ChunkedResult(AsyncResult):
+    def __init__(self, refs, callback=None, error_callback=None):
+        super().__init__(refs, single=False, callback=callback,
+                         error_callback=error_callback)
+
+    def _collect(self):
+        try:
+            chunks = ray_tpu.get(list(self._refs))
+            self._value = list(itertools.chain.from_iterable(chunks))
+            if self._callback is not None:
+                try:
+                    self._callback(self._value)
+                except Exception:
+                    pass
+        except Exception as e:  # noqa: BLE001
+            self._error = e
+            if self._error_callback is not None:
+                try:
+                    self._error_callback(e)
+                except Exception:
+                    pass
+        finally:
+            self._done.set()
+
+
+def _apply_chunk(func, chunk):
+    return [func(x) for x in chunk]

@@ -1,0 +1,132 @@
+"""Autoscaler reconciler: demand-driven scale-up, min floors, idle scale-down.
+
+(reference capability: autoscaler v2 reconciler — autoscaler/v2/autoscaler.py:47,
+resource_demand_scheduler.py:100 bin-packing; fake provider pattern from
+autoscaler/_private/fake_multi_node/.)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeType
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class FakeProvider(NodeProvider):
+    """Records launches/terminations without real processes."""
+
+    def __init__(self):
+        self.nodes: Dict[str, str] = {}
+        self._n = 0
+
+    def create_node(self, node_type, resources, labels):
+        self._n += 1
+        nid = f"fake-{self._n}"
+        self.nodes[nid] = node_type
+        return nid
+
+    def terminate_node(self, node_id):
+        self.nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_workers=1, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _mk(provider, types, **kw):
+    return Autoscaler(f"unix:{_api._node.socket_path}", provider, types,
+                      idle_timeout_s=kw.pop("idle_timeout_s", 0.2), **kw)
+
+
+def test_scale_up_on_pending_demand(session):
+    # saturate: demand 8 CPUs on a 2-CPU cluster
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(30)
+
+    refs = [hog.remote() for _ in range(4)]
+    time.sleep(0.5)
+    provider = FakeProvider()
+    a = _mk(provider, [NodeType("cpu4", {"CPU": 4}, max_nodes=5)])
+    actions = a.reconcile_once()
+    # 3 unmet 2-CPU demands (one fits the 2-CPU head when idle... at least
+    # one new node must be planned; bin-packing puts 2 demands per cpu4 node)
+    assert actions["launched"], actions
+    assert len(provider.nodes) >= 1
+    a.stop()
+    del refs
+
+
+def test_min_nodes_floor(session):
+    provider = FakeProvider()
+    a = _mk(provider, [NodeType("warm", {"CPU": 2}, min_nodes=2, max_nodes=4)])
+    actions = a.reconcile_once()
+    assert len([x for x in actions["launched"] if x[0] == "warm"]) == 2
+    # floor is maintained, never terminated below min
+    time.sleep(0.3)
+    actions2 = a.reconcile_once()
+    assert not actions2["terminated"]
+    assert len(provider.nodes) == 2
+    a.stop(terminate_nodes=False)
+
+
+def test_idle_scale_down(session):
+    provider = FakeProvider()
+    nt = NodeType("burst", {"CPU": 4}, min_nodes=0, max_nodes=3)
+    a = _mk(provider, [nt], idle_timeout_s=0.2)
+    # manually launch one (as if demand had spiked earlier)
+    a._launch(nt)
+    assert len(provider.nodes) == 1
+    a.reconcile_once()  # idle clock starts
+    time.sleep(0.3)
+    actions = a.reconcile_once()
+    assert actions["terminated"], "idle above-min node must be terminated"
+    assert len(provider.nodes) == 0
+    a.stop()
+
+
+def test_max_nodes_cap(session):
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        time.sleep(30)
+
+    refs = [big.remote() for _ in range(10)]
+    time.sleep(0.5)
+    provider = FakeProvider()
+    a = _mk(provider, [NodeType("cpu4", {"CPU": 4}, max_nodes=2)])
+    a.reconcile_once()
+    assert len(provider.nodes) <= 2
+    a.stop()
+    del refs
+
+
+def test_local_provider_joins_real_cluster(session):
+    """End-to-end: the LocalNodeProvider launches a real node agent that
+    registers with the GCS and runs tasks."""
+    provider = LocalNodeProvider(_api._node.address)
+    a = Autoscaler(f"unix:{_api._node.socket_path}", provider,
+                   [NodeType("worker", {"CPU": 2}, min_nodes=1, max_nodes=2)])
+    try:
+        a.reconcile_once()  # min floor launches one agent
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 4:
+                break
+            time.sleep(0.3)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 4, \
+            "autoscaled node never joined"
+    finally:
+        a.stop()

@@ -198,3 +198,64 @@ def test_put_object_lost_is_an_error():
             ray_tpu.get(inner, timeout=30)
     finally:
         cluster.shutdown()
+
+
+def test_sigkilled_borrower_refs_reclaimed(session):
+    """A SIGKILLed worker's outstanding +1 ref contributions are reclaimed on
+    death, so the objects it borrowed don't leak (reference: borrower death
+    handling in reference_counter.h)."""
+    big = ray_tpu.put(np.ones((300_000,), dtype=np.float64))
+    oid = big.hex()
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.kept = None
+
+        def keep(self, refs):
+            self.kept = refs  # deserializes + retains the inner ObjectRef
+            return os.getpid()
+
+    h = Holder.options(max_restarts=0).remote()
+    pid = ray_tpu.get(h.keep.remote([big]), timeout=30)
+    # actor holds a borrowed ref; its +1 was flushed before task_done
+    os.kill(pid, 9)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        with _gcs().lock:
+            dead = all(w.dead for w in _gcs().workers.values()
+                       if w.pid == pid)
+        if dead:
+            break
+        time.sleep(0.1)
+    # driver still holds `big`: object must survive the borrower's death
+    assert _entry(oid) is not None
+    arr = ray_tpu.get(big, timeout=10)
+    assert arr.shape == (300_000,)
+    # now drop the driver's ref: the dead borrower's +1 must not pin it
+    del big, arr
+    gc.collect()
+    assert _wait_gone(oid, 15), "dead borrower's +1 leaked the object"
+
+
+def test_spill_tier_accounting(session, monkeypatch, tmp_path):
+    """Objects that land on the disk tier (tmpfs-full fallback) must not be
+    counted as tmpfs bytes by the GCS spill accountant."""
+    w = _api._worker
+    tier = w.store.put_parts("deadbeef00", [b"x" * 1000], 1000)
+    assert tier == "shm"
+    # simulate a tmpfs-full landing: report a put with tier="spill"
+    w.send_no_reply({"type": "object_put", "oid": "deadbeef01", "where": "shm",
+                     "size": 1 << 40, "host": w.host_id, "tier": "spill"})
+    w.send_no_reply({"type": "object_put", "oid": "deadbeef02", "where": "shm",
+                     "size": 2048, "host": w.host_id, "tier": "shm"})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if _entry("deadbeef02") is not None and _entry("deadbeef01") is not None:
+            break
+        time.sleep(0.05)
+    with _gcs().lock:
+        used = _gcs().host_shm_bytes.get(w.host_id, 0)
+    assert used < (1 << 40), "spill-tier object counted as tmpfs bytes"
+    # the spill copy is still a pullable host location
+    assert w.host_id in _entry("deadbeef01").get("hosts", set())

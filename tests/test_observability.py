@@ -1,0 +1,184 @@
+"""Metrics, pubsub, task events/timeline, dashboard, config registry.
+
+(reference test strategy: SURVEY.md §4 — dashboard/state tests in
+dashboard/tests/, metrics pipeline _private/metrics_agent.py, pubsub
+channels for errors/actor state.)
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.pubsub import Subscriber, publish
+from ray_tpu.util import metrics as met
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def test_config_registry_env_override(monkeypatch):
+    from ray_tpu._private.ray_config import RayConfig
+
+    monkeypatch.setenv("RAY_TPU_MAX_LINEAGE", "123")
+    monkeypatch.setenv("RAY_TPU_AUTO_GC", "0")
+    monkeypatch.setenv("RAY_TPU_HYBRID_THRESHOLD", "0.75")
+    RayConfig.reset()
+    cfg = RayConfig.instance()
+    assert cfg.max_lineage == 123
+    assert cfg.auto_gc is False
+    assert cfg.hybrid_threshold == 0.75
+    # spawn_env forwards only explicitly-set flags
+    env = RayConfig.spawn_env()
+    assert env["RAY_TPU_MAX_LINEAGE"] == "123"
+    assert "RAY_TPU_STORE_BACKEND" not in env
+    RayConfig.reset()
+
+
+def test_metrics_local_registry():
+    met.clear_registry()
+    c = met.Counter("test_requests_total", "requests")
+    c.inc()
+    c.inc(2, tags={"route": "/a"})
+    g = met.Gauge("test_inflight", "in flight")
+    g.set(5)
+    g.dec()
+    h = met.Histogram("test_latency_seconds", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = {m["name"]: m for m in met.snapshot()}
+    assert snap["test_requests_total"]["kind"] == "counter"
+    assert sum(v for _, v in snap["test_requests_total"]["series"]) == 3
+    (_, gval), = [s for s in snap["test_inflight"]["series"]]
+    assert gval == 4
+    (_, hval), = snap["test_latency_seconds"]["series"]
+    assert hval["count"] == 3 and hval["buckets"] == [1, 1, 1]
+    met.clear_registry()
+
+
+def test_prometheus_rendering():
+    agg = {
+        "reqs": {"kind": "counter", "description": "d",
+                 "series": {"w1": [[[["a", "b"]], 2.0]],
+                            "w2": [[[["a", "b"]], 3.0]]}},
+        "lat": {"kind": "histogram", "description": "",
+                "series": {"w1": [[[], {"buckets": [1, 2, 0], "sum": 1.5,
+                                        "count": 3,
+                                        "boundaries": [0.1, 1.0]}]]}},
+    }
+    text = met.to_prometheus(agg)
+    assert 'reqs{a="b"} 5.0' in text
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+
+
+class TestClusterObservability:
+    def test_metrics_events_dashboard(self, ray_start_regular):
+        met.clear_registry()
+        c = met.Counter("driver_side_total", "driver metric")
+        c.inc(7)
+
+        @ray_tpu.remote
+        def work(i):
+            from ray_tpu.util import metrics as m
+
+            cnt = m.Counter("task_side_total", "task metric")
+            cnt.inc()
+            return i
+
+        assert ray_tpu.get([work.remote(i) for i in range(4)]) == list(range(4))
+
+        from ray_tpu._private import api as _api
+
+        w = _api._worker
+        w._flush_telemetry()  # force the driver's report now
+
+        # workers flush on a 2s cadence; poll the GCS until both arrive
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            snap = w.rpc({"type": "metrics_snapshot"})["metrics"]
+            if "task_side_total" in snap and "driver_side_total" in snap:
+                break
+            time.sleep(0.3)
+        assert "driver_side_total" in snap
+        assert "task_side_total" in snap
+        # internal gauges folded in
+        assert "ray_tpu_tasks_total" in snap
+
+        # task events recorded with execution spans
+        events = w.rpc({"type": "task_events"})["events"]
+        assert any(ev.get("event") == "task:execute" for ev in events)
+        assert any(ev.get("task_id") for ev in events)
+
+        # dashboard over the live session
+        from ray_tpu._private import api as _api
+
+        session_dir = _api._node.session_dir
+        from ray_tpu.dashboard import start_dashboard
+
+        head = start_dashboard(session_dir)
+        try:
+            base = f"http://127.0.0.1:{head.port}"
+            cluster = json.loads(_get(base + "/api/cluster"))
+            assert "total_resources" in cluster
+            prom = _get(base + "/metrics").decode()
+            assert "driver_side_total" in prom
+            assert "ray_tpu_pending_tasks" in prom
+            tl = json.loads(_get(base + "/api/timeline"))
+            assert isinstance(tl["traceEvents"], list) and tl["traceEvents"]
+            html = _get(base + "/").decode()
+            assert "ray_tpu" in html
+            logs = json.loads(_get(base + "/api/logs"))
+            assert isinstance(logs, list)
+        finally:
+            head.stop()
+        met.clear_registry()
+
+    def test_pubsub_channels(self, ray_start_regular):
+        sub = Subscriber("custom")
+        publish("custom", {"hello": 1})
+        items = sub.poll(timeout=10)
+        assert items == [{"hello": 1}]
+        # buffered while not polling
+        publish("custom", "a")
+        publish("custom", "b")
+        assert sub.poll(timeout=10) == ["a", "b"]
+        sub.close()
+        assert sub.poll() == []
+
+    def test_error_and_actor_state_channels(self, ray_start_regular):
+        err_sub = Subscriber("errors")
+        state_sub = Subscriber("actor_state")
+
+        @ray_tpu.remote(max_retries=0)
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(Exception):
+            ray_tpu.get(boom.remote())
+
+        items = err_sub.poll(timeout=10)
+        assert items and "kaboom" in str(items[0].get("error"))
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        deadline = time.time() + 10
+        seen = []
+        while time.time() < deadline:
+            seen += state_sub.poll(timeout=2)
+            if any(s.get("state") == "alive" for s in seen):
+                break
+        assert any(s.get("state") == "alive" for s in seen)
+        err_sub.close()
+        state_sub.close()

@@ -1,0 +1,87 @@
+"""multiprocessing.Pool and joblib shims over the cluster.
+
+(reference capability: python/ray/util/multiprocessing/pool.py,
+python/ray/util/joblib/.)
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=2, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_sq():
+    # defined inside a function so cloudpickle ships it by value (workers
+    # can't import the test module)
+    def _sq(x):
+        return x * x
+
+    return _sq
+
+
+def test_pool_map(session):
+    _sq = _make_sq()
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+
+def test_pool_apply_and_async(session):
+    from ray_tpu.util.multiprocessing import Pool
+
+    _sq = _make_sq()
+    with Pool(processes=2) as p:
+        assert p.apply(_sq, (7,)) == 49
+        r = p.apply_async(_sq, (8,))
+        assert r.get(timeout=60) == 64
+        assert r.successful()
+
+
+def test_pool_imap_unordered(session):
+    from ray_tpu.util.multiprocessing import Pool
+
+    _sq = _make_sq()
+    with Pool(processes=2) as p:
+        out = sorted(p.imap_unordered(_sq, range(8), chunksize=2))
+        assert out == sorted(x * x for x in range(8))
+
+
+def test_pool_starmap_and_errors(session):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=2) as p:
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+
+        def boom(x):
+            raise ValueError("pool-boom")
+
+        r = p.map_async(boom, [1])
+        with pytest.raises(Exception):
+            r.get(timeout=60)
+        p.close()
+        with pytest.raises(ValueError):
+            p.apply(_make_sq(), (1,))
+
+
+def test_joblib_backend(session):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    _sq = _make_sq()
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [x * x for x in range(6)]
