@@ -23,7 +23,7 @@ from ray_tpu._private.api import (
     wait,
 )
 from ray_tpu._private.worker import ObjectRef
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu import exceptions
 from ray_tpu import util
@@ -46,6 +46,7 @@ __all__ = [
     "init",
     "is_initialized",
     "kill",
+    "method",
     "nodes",
     "put",
     "remote",

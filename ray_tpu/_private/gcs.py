@@ -225,6 +225,8 @@ class GcsServer:
         self.pubsub_queues: dict[tuple[str, str], collections.deque] = {}
         self.pubsub_pollers: dict[tuple[str, str], tuple[MsgConnection, int]] = {}
         self.pubsub_conns: dict[tuple[str, str], MsgConnection] = {}
+        # in-flight RDT exports: token → (requester conn, rid)
+        self._tensor_exports: dict[str, tuple] = {}
         # publish() is called from paths holding self.lock — a slow
         # subscriber socket must not stall the control plane, so replies to
         # parked pollers go through this queue to a dedicated sender thread
@@ -649,6 +651,8 @@ class GcsServer:
                                   tier=msg.get("tier", "shm"))
         elif t == "wait_object":
             self._wait_object(conn, msg)
+        elif t == "free_objects_async":
+            self._free_objects(list(msg["oids"]))
         elif t == "free_objects":
             # manual free: drop entries and every host copy, cascading to
             # nested refs (reference: ray._private.internal_api.free)
@@ -777,6 +781,46 @@ class GcsServer:
                     "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
                 }
             conn.send({"rid": msg["rid"], "demand": state})
+        elif t == "export_tensor":
+            # RDT cross-process fetch: relay to the owner worker and park
+            # the requester until export_tensor_done (reference: RDT
+            # transport coordination, gpu_object_manager.py)
+            with self.lock:
+                owner = self.workers.get(msg["owner_wid"])
+                if owner is None or owner.dead:
+                    owner = None
+                else:
+                    token = f"tx-{msg['rid']}-{id(conn) & 0xffffff}"
+                    self._tensor_exports[token] = (conn, msg["rid"],
+                                                   msg["owner_wid"])
+            if owner is None:
+                conn.send({"rid": msg["rid"], "ok": False,
+                           "error": "owner process is gone"})
+            else:
+                try:
+                    owner.conn.send({"type": "do_export_tensor",
+                                     "tensor_id": msg["tensor_id"],
+                                     "token": token})
+                except ConnectionClosed:
+                    with self.lock:
+                        self._tensor_exports.pop(token, None)
+                    conn.send({"rid": msg["rid"], "ok": False,
+                               "error": "owner connection lost"})
+        elif t == "export_tensor_done":
+            with self.lock:
+                waiter = self._tensor_exports.pop(msg["token"], None)
+            if waiter is not None:
+                wconn, wrid = waiter[0], waiter[1]
+                try:
+                    if msg.get("oid"):
+                        wconn.send({"rid": wrid, "ok": True,
+                                    "oid": msg["oid"]})
+                    else:
+                        wconn.send({"rid": wrid, "ok": False,
+                                    "error": msg.get("error")
+                                    or "tensor not found in owner registry"})
+                except ConnectionClosed:
+                    pass
         elif t == "metrics_report":
             # per-source replace so a worker's repeated reports (cumulative
             # local values) don't double-count in the aggregate
@@ -1085,11 +1129,15 @@ class GcsServer:
         by_host: dict[str, list[str]] = collections.defaultdict(list)
         cascade: list[str] = []
         agent_msgs = []
+        dev_frees: dict = collections.defaultdict(list)  # wid → tensor ids
         with self.lock:
             for oid in oids:
                 e = self.objects.pop(oid, None)
                 if e is None:
                     continue
+                dt = e.get("device_tensors")
+                if dt:
+                    dev_frees[dt[0]].extend(dt[1])
                 self.object_waiters.pop(oid, None)
                 self._drop_shm_copies_locked(e)
                 for h in e.get("hosts", ()):
@@ -1117,6 +1165,18 @@ class GcsServer:
                 conn.send({"type": "delete_objects", "oids": lst})
             except ConnectionClosed:
                 pass
+        if dev_frees:
+            # tell owners to drop the freed objects' HBM registry entries
+            with self.lock:
+                targets = [(self.workers.get(w), tids)
+                           for w, tids in dev_frees.items()]
+            for w, tids in targets:
+                if w is not None and not w.dead:
+                    try:
+                        w.conn.send({"type": "free_device_tensors",
+                                     "tensor_ids": tids})
+                    except ConnectionClosed:
+                        pass
         if cascade:
             self._free_objects(cascade)
 
@@ -1668,6 +1728,7 @@ class GcsServer:
             # cross-host consumers know where to pull from
             host = w.host_id if w is not None else HEAD_HOST
             contained_map = msg.get("contained") or {}
+            dev_tids = msg.get("device_tensors") or []
             any_shm = False
             for res in msg.get("results", ()):
                 oid, where, inline, size = res[:4]
@@ -1692,6 +1753,10 @@ class GcsServer:
                 if refs and "contained" not in (prev or {}):
                     entry["contained"] = list(refs)
                     self._sys_hold_locked(refs, +1)
+                if dev_tids:
+                    # RDT: result carries markers into wid's HBM registry;
+                    # freeing this object must free those entries too
+                    entry["device_tensors"] = (wid, list(dev_tids))
                 for conn, rid in self.object_waiters.pop(oid, []):
                     self._reply_object(conn, rid, entry)
                 if self._freeable_locked(oid, entry):
@@ -2050,12 +2115,24 @@ class GcsServer:
                 if self._freeable_locked(oid, e):
                     death_free.append(oid)
             w.ref_balance.clear()
+            # fail parked RDT exports that were waiting on this process
+            stale_exports = [(tok, waiter) for tok, waiter
+                             in self._tensor_exports.items()
+                             if waiter[2] == wid]
+            for tok, _ in stale_exports:
+                self._tensor_exports.pop(tok, None)
             if w.kind != "worker":
                 # driver death: free its refs (outside the lock below); the
                 # rest of the teardown is the node's job
                 driver_death = True
             else:
                 driver_death = False
+        for _, (rconn, rrid, _owner) in stale_exports:
+            try:
+                rconn.send({"rid": rrid, "ok": False,
+                            "error": "owner process died during export"})
+            except ConnectionClosed:
+                pass
         if driver_death:
             if death_free:
                 self._free_objects(death_free)

@@ -74,7 +74,8 @@ class LocalWorker:
 
     # actors
     def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0,
-                     name=None, strategy=None, max_concurrency=1, runtime_env=None):
+                     name=None, strategy=None, max_concurrency=1, runtime_env=None,
+                     concurrency_groups=None):
         cls = ser.loads(cls_blob) if isinstance(cls_blob, bytes) else cls_blob
         aid = ActorID().hex()
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)

@@ -388,6 +388,34 @@ class CoreWorker:
                     ev = self._stream_events.get(tid)
                     if ev is not None:
                         ev.set()
+                elif msg.get("type") == "free_device_tensors":
+                    from ray_tpu.experimental import device_objects
+
+                    device_objects.free_device_tensors(
+                        msg.get("tensor_ids", ()), worker=self)
+                elif msg.get("type") == "do_export_tensor":
+                    # RDT: another process needs one of our HBM tensors —
+                    # export runs off the recv thread (device→host copy)
+                    def _export(m=msg):
+                        from ray_tpu.experimental import device_objects
+
+                        try:
+                            oid = device_objects.export_to_store(
+                                m["tensor_id"], self)
+                            self.send_no_reply(
+                                {"type": "export_tensor_done",
+                                 "token": m["token"], "oid": oid})
+                        except Exception as e:  # noqa: BLE001
+                            try:
+                                self.send_no_reply(
+                                    {"type": "export_tensor_done",
+                                     "token": m["token"], "oid": None,
+                                     "error": repr(e)})
+                            except ConnectionClosed:
+                                pass
+
+                    threading.Thread(target=_export, daemon=True,
+                                     name="rdt-export").start()
                 elif msg.get("type") == "stream_cancel":
                     # consumer released the generator: stop producing
                     tid = msg["task_id"]
@@ -568,6 +596,7 @@ class CoreWorker:
         strategy: dict | None = None,
         max_concurrency: int = 1,
         runtime_env: dict | None = None,
+        concurrency_groups: dict | None = None,
     ) -> str:
         actor_id = ActorID().hex()
         task_id = TaskID().hex()
@@ -585,7 +614,12 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "name": name,
             "strategy": strategy,
-            "max_concurrency": max_concurrency,
+            # the GCS gates dispatch on total concurrency: named groups
+            # add their limits on top of the default pool (reference:
+            # concurrency groups have independent limits)
+            "max_concurrency": max_concurrency + sum(
+                (concurrency_groups or {}).values()),
+            "concurrency_groups": concurrency_groups or {},
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
@@ -677,14 +711,26 @@ class CoreWorker:
     def _materialize(self, oid: str, reply: dict) -> Any:
         reply = self._ensure_local(oid, reply)
         if reply["where"] == "inline":
-            value = ser.loads(reply["inline"])
+            value = self._loads_restoring(reply["inline"])
         else:
             plasma = self.store.get(oid)
             self._plasma_refs[oid] = plasma
-            value = ser.loads(plasma.buf)
+            value = self._loads_restoring(plasma.buf)
         if reply["status"] == "error":
             raise value
         self._memory[oid] = value
+        return value
+
+    def _loads_restoring(self, buf):
+        """Deserialize, resolving RDT markers when (and only when) the
+        payload constructed one during unpickling — exact detection at any
+        nesting depth (reference: RDT materialization on get)."""
+        from ray_tpu.experimental.device_objects import marker_capture, restore
+
+        with marker_capture() as saw:
+            value = ser.loads(buf)
+        if saw():
+            value = restore(value, self)
         return value
 
     def _pull_remote(self, oid: str, reply: dict) -> bool:
@@ -845,9 +891,9 @@ class CoreWorker:
                 reply = self.rpc({"type": "wait_object", "oid": oid}, timeout=300.0)
                 self._ensure_local(oid, reply)
             plasma = self.store.get(oid)
-            args, kwargs = ser.loads(plasma.buf)
+            args, kwargs = self._loads_restoring(plasma.buf)
         else:
-            args, kwargs = ser.loads(spec["args"])
+            args, kwargs = self._loads_restoring(spec["args"])
         args = tuple(self.get_object(a.hex) if isinstance(a, _RefMarker) else a for a in args)
         kwargs = {k: self.get_object(v.hex) if isinstance(v, _RefMarker) else v for k, v in kwargs.items()}
         return args, kwargs
@@ -921,6 +967,7 @@ class CoreWorker:
         error_blob = None
         results = []
         contained_map: dict = {}
+        _dev_tids: list = []
         self._task_ctx.task_id = spec["task_id"]
         _t_exec0 = time.time()
         try:
@@ -933,19 +980,36 @@ class CoreWorker:
                 instance = cls(*args, **kwargs)
                 self.actors[spec["actor_id"]] = instance
                 self.current_actor_id = spec["actor_id"]
-                conc = int(spec.get("max_concurrency") or 1)
-                if conc > 1:
-                    from concurrent.futures import ThreadPoolExecutor
+                from ray_tpu._private.actor_executor import ActorExecutor
 
-                    # concurrent actor: method calls run in this pool
-                    # (reference: threaded actors / concurrency groups,
-                    # src/ray/core_worker/task_execution/concurrency_group_manager.h)
-                    self._actor_pools[spec["actor_id"]] = ThreadPoolExecutor(
-                        max_workers=conc, thread_name_prefix="actor-exec")
+                # concurrency groups + threaded/async execution
+                # (reference: concurrency_group_manager.h, fiber.h async
+                # actors, actor_scheduling_queue.h)
+                self._actor_pools[spec["actor_id"]] = ActorExecutor(
+                    instance,
+                    max_concurrency=int(spec.get("max_concurrency") or 1),
+                    concurrency_groups=spec.get("concurrency_groups") or {})
                 out = None
             elif kind == "actor_task":
                 instance = self.actors[spec["actor_id"]]
-                out = getattr(instance, spec["method"])(*args, **kwargs)
+                method = getattr(instance, spec["method"])
+                import inspect as _inspect
+
+                if _inspect.iscoroutinefunction(
+                        getattr(method, "__func__", method)):
+                    # async method reached execute_task directly (pool
+                    # routing already ran it on the loop when enabled)
+                    execer = self._actor_pools.get(spec["actor_id"])
+                    out = execer.run_coroutine_sync(method(*args, **kwargs))
+                else:
+                    out = method(*args, **kwargs)
+                if getattr(getattr(method, "__func__", method),
+                           "__ray_tpu_tensor_transport__", None):
+                    # RDT: returned jax.Arrays stay in this process's HBM;
+                    # only small markers cross the control plane
+                    from ray_tpu.experimental import device_objects
+
+                    out, _dev_tids = device_objects.extract(out, self.wid)
             else:
                 raise RayTpuError(f"unknown task kind {kind}")
             n = spec["num_returns"]
@@ -1011,9 +1075,14 @@ class CoreWorker:
         # releases the task's system holds, or a borrowed ref could be freed
         # under us (reference: borrower protocol, reference_counter.h:43)
         self._flush_ref_deltas()
-        self.send_no_reply({"type": "task_done", "wid": self.wid, "spec": lite,
-                            "results": results, "error": error_blob,
-                            "contained": contained_map})
+        done = {"type": "task_done", "wid": self.wid, "spec": lite,
+                "results": results, "error": error_blob,
+                "contained": contained_map}
+        if _dev_tids:
+            # registry lifetime rides the result object: the GCS tells us to
+            # drop these HBM entries when the enclosing object is freed
+            done["device_tensors"] = _dev_tids
+        self.send_no_reply(done)
 
     def exec_loop(self):
         """Main loop of worker processes (driver never calls this)."""
@@ -1021,10 +1090,10 @@ class CoreWorker:
             spec = self.exec_queue.get()
             if spec is None:
                 return
-            pool = (self._actor_pools.get(spec.get("actor_id"))
-                    if spec["kind"] == "actor_task" else None)
-            if pool is not None:
-                pool.submit(self.execute_task, spec)
+            execer = (self._actor_pools.get(spec.get("actor_id"))
+                      if spec["kind"] == "actor_task" else None)
+            if execer is not None:
+                execer.submit(spec, self.execute_task)
             else:
                 self.execute_task(spec)
 

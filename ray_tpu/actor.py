@@ -13,6 +13,30 @@ from ray_tpu._private import serialization as ser
 from ray_tpu.remote_function import _build_resources
 
 
+def method(*, concurrency_group: str | None = None,
+           tensor_transport: str | None = None):
+    """Per-method options on actor classes (reference: @ray.method,
+    python/ray/actor.py). `concurrency_group` routes the method to a named
+    pool declared via @remote(concurrency_groups={...});
+    `tensor_transport="device"` keeps returned jax.Arrays in the owner's
+    HBM and passes them by reference (reference: RDT
+    @ray.method(tensor_transport=...), gpu_object_manager.py:84).
+    Return arity is set per call with `.options(num_returns=N)`."""
+
+    def decorate(fn):
+        if concurrency_group is not None:
+            fn.__ray_tpu_concurrency_group__ = concurrency_group
+        if tensor_transport is not None:
+            if tensor_transport not in ("device", "tpu"):
+                raise ValueError(
+                    f"tensor_transport must be 'device' (alias 'tpu'), got "
+                    f"{tensor_transport!r}")
+            fn.__ray_tpu_tensor_transport__ = tensor_transport
+        return fn
+
+    return decorate
+
+
 class ActorMethod:
     def __init__(self, actor_id: str, method_name: str, num_returns: int = 1):
         self._actor_id = actor_id
@@ -69,7 +93,7 @@ _UNSET = object()
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
                  max_restarts=0, name=None, lifetime=None, scheduling_strategy=None,
-                 max_concurrency=1, runtime_env=None):
+                 max_concurrency=1, runtime_env=None, concurrency_groups=None):
         self._cls = cls
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
@@ -78,6 +102,7 @@ class ActorClass:
         self._strategy = scheduling_strategy
         self._max_concurrency = max_concurrency
         self._runtime_env = runtime_env
+        self._concurrency_groups = dict(concurrency_groups or {})
         self._blob: bytes | None = None
         self.__name__ = getattr(cls, "__name__", "Actor")
 
@@ -90,7 +115,8 @@ class ActorClass:
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
                 max_restarts=None, name=None, lifetime=None,
                 scheduling_strategy=_UNSET, max_concurrency=None,
-                runtime_env=_UNSET, **_ignored) -> "ActorClass":
+                runtime_env=_UNSET, concurrency_groups=None,
+                **_ignored) -> "ActorClass":
         ac = ActorClass(
             self._cls,
             num_cpus=self._opts["num_cpus"] if num_cpus is None else num_cpus,
@@ -105,6 +131,9 @@ class ActorClass:
                              else max_concurrency),
             runtime_env=(self._runtime_env if runtime_env is _UNSET
                          else runtime_env),
+            concurrency_groups=(self._concurrency_groups
+                                if concurrency_groups is None
+                                else concurrency_groups),
         )
         ac._blob = self._blob
         return ac
@@ -124,6 +153,7 @@ class ActorClass:
             strategy=strategy_to_spec(self._strategy),
             max_concurrency=self._max_concurrency,
             runtime_env=self._runtime_env,
+            concurrency_groups=self._concurrency_groups,
         )
         return ActorHandle(actor_id)
 
