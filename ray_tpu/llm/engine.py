@@ -82,12 +82,70 @@ def bucket_for(n: int, min_bucket: int, max_len: int) -> int:
     return min(b, max_len)
 
 
+def _shard_params_tp(params, mesh):
+    """Tensor-parallel placement of the transformer parameter tree over a
+    1-axis mesh: attention head dims and MLP hidden dims split, everything
+    else replicated. XLA propagates + inserts the collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+
+    def spec_for(path, x):
+        name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                        for p in path)
+        nd = x.ndim
+        def pad(spec):
+            return P(*(list(spec) + [None] * (nd - len(spec))))
+        if "wq" in name or "wk" in name or "wv" in name:
+            # stacked [L, E, H, Dh] → split heads
+            return pad([None, None, axis])
+        if "wo" in name:
+            # [L, H, Dh, E] → split heads
+            return pad([None, axis])
+        if "bq" in name or "bk" in name or "bv" in name:
+            return pad([None, axis])
+        if "mlp" in name and ("w1" in name or "wg" in name or "w_in" in name):
+            return pad([None, None, axis])  # [L, E, F] → split F
+        if "mlp" in name and ("w2" in name or "w_out" in name):
+            return pad([None, axis])        # [L, F, E] → split F
+        if "mlp" in name and "b1" in name:
+            return pad([None, axis])
+        return P()  # replicate
+
+    def place(path, x):
+        import jax as _jax
+
+        return _jax.device_put(x, NamedSharding(mesh, spec_for(path, x)))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def _shard_state_tp(state, mesh):
+    """KV caches split on the kv-head dim; bookkeeping replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    specs = {}
+    for k, v in state.items():
+        if k in ("k", "v"):          # [L, slots, S, Hkv, Dh]
+            specs[k] = P(None, None, None, axis)
+        elif k in ("kp", "vp"):      # [L, pages, P, Hkv, Dh]
+            specs[k] = P(None, None, None, axis)
+        else:
+            specs[k] = P()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in state.items()}
+
+
 class TPUEngine:
     def __init__(self, cfg: TransformerConfig, params: Any, *,
                  max_slots: int = 8, max_len: int | None = None,
-                 min_bucket: int = 32, seed: int = 0):
+                 min_bucket: int = 32, seed: int = 0,
+                 kv_layout: str = "slot", page_size: int = 64,
+                 num_pages: int | None = None,
+                 max_prefills_per_step: int = 2,
+                 mesh=None):
         self.cfg = cfg
-        self.params = params
         self.max_len = max_len or cfg.max_seq_len
         if self.max_len > cfg.max_seq_len:
             raise ValueError(
@@ -95,17 +153,59 @@ class TPUEngine:
                 f"max_seq_len {cfg.max_seq_len} (rope/pos tables are sized "
                 "by the model config)")
         self.max_slots = max_slots
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        if kv_layout == "paged":
+            if page_size <= 0 or (page_size & (page_size - 1)):
+                raise ValueError("page_size must be a positive power of two")
+            if self.max_len % page_size:
+                raise ValueError(
+                    f"max_len {self.max_len} must be a multiple of "
+                    f"page_size {page_size} (buckets reshape into whole pages)")
+            min_bucket = max(min_bucket, page_size)
         self.buckets = []
         b = min_bucket
         while b < self.max_len:
             self.buckets.append(b)
             b *= 2
         self.buckets.append(self.max_len)
-        self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
+        # multi-chip serving: tensor-parallel sharding over a 1-axis mesh —
+        # params' head/ff dims and the KV caches' kv-head dim are split
+        # across chips; XLA inserts the collectives (reference capability:
+        # vLLM tensor_parallel_size via PG bundles, vllm_models.py:215 —
+        # here it's jax.sharding over ICI instead of NCCL)
+        self.mesh = mesh
+        if mesh is not None:
+            params = _shard_params_tp(params, mesh)
+        self.params = params
+        if kv_layout == "paged":
+            from ray_tpu.models import decoding_paged as dp
+
+            self._dp = dp
+            self.page_size = page_size
+            self.max_pages_per_seq = -(-self.max_len // page_size)
+            # default pool = full reservation (+1 scratch); pass num_pages
+            # lower to oversubscribe HBM against short real sequences
+            self.num_pages = num_pages or (max_slots * self.max_pages_per_seq + 1)
+            self.state = dp.init_paged_state(
+                cfg, max_slots, self.max_len, self.num_pages, page_size)
+            self._free_pages = list(range(1, self.num_pages))  # 0 = scratch
+            self._slot_pages: dict[int, list] = {}
+        else:
+            self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
+        if mesh is not None:
+            self.state = _shard_state_tp(self.state, mesh)
+        # device-resident per-row sampling params: updated only on admit,
+        # not rebuilt/re-uploaded every decode step
+        self._temps = jnp.zeros((max_slots,), jnp.float32)
+        self._topks = jnp.zeros((max_slots,), jnp.int32)
+        self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         self.key = jax.random.PRNGKey(seed)
         self._free = list(range(max_slots))
         self._by_slot: dict[int, _Request] = {}
         self._waiting: queue.SimpleQueue = queue.SimpleQueue()
+        self._backlog: list = []  # paged: admitted-later queue (page pressure)
         self._rid = itertools.count()
         self._work = threading.Event()
         self._stop = False
@@ -125,7 +225,12 @@ class TPUEngine:
                    max_slots=ek.get("max_slots", 8),
                    max_len=ek.get("max_len", cfg.max_seq_len),
                    min_bucket=ek.get("min_bucket", 32),
-                   seed=ek.get("seed", 0))
+                   seed=ek.get("seed", 0),
+                   kv_layout=ek.get("kv_layout", "slot"),
+                   page_size=ek.get("page_size", 64),
+                   num_pages=ek.get("num_pages"),
+                   max_prefills_per_step=ek.get("max_prefills_per_step", 2),
+                   mesh=ek.get("mesh"))
 
     def _check_alive(self):
         if self._error is not None:
@@ -143,6 +248,15 @@ class TPUEngine:
         if limit <= 0:
             raise ValueError("max_tokens leaves no room for the prompt")
         token_ids = token_ids[-limit:]
+        if self.kv_layout == "paged":
+            need = self._pages_needed(len(token_ids),
+                                      self._bucket(len(token_ids)),
+                                      params.max_tokens)
+            if need > self.num_pages - 1:  # page 0 is scratch
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool only has "
+                    f"{self.num_pages - 1}; raise num_pages or shrink "
+                    f"prompt/max_tokens")
         req = _Request(next(self._rid), token_ids, params)
         self._waiting.put(req)
         self._work.set()
@@ -158,6 +272,18 @@ class TPUEngine:
             raise ValueError(
                 f"transferred prefix bucket {k.shape[1]} exceeds engine "
                 f"max_len {self.max_len}")
+        if self.kv_layout == "paged":
+            if k.shape[1] % self.page_size:
+                raise ValueError(
+                    f"transferred prefix bucket {k.shape[1]} is not a "
+                    f"multiple of page_size {self.page_size}: configure the "
+                    f"prefill server with min_bucket >= page_size")
+            need = self._pages_needed(int(length), k.shape[1],
+                                      (params or SamplingParams()).max_tokens)
+            if need > self.num_pages - 1:
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool only has "
+                    f"{self.num_pages - 1}")
         if int(length) + params.max_tokens >= self.max_len:
             raise ValueError(
                 f"prefix length {int(length)} + max_tokens {params.max_tokens} "
@@ -190,6 +316,9 @@ class TPUEngine:
         marker = _EngineError(error) if error is not None else _SENTINEL
         for req in list(self._by_slot.values()):
             req.out_queue.put(marker)
+        for req in self._backlog:
+            req.out_queue.put(marker)
+        self._backlog.clear()
         while True:
             try:
                 self._waiting.get_nowait().out_queue.put(marker)
@@ -201,11 +330,54 @@ class TPUEngine:
     def _bucket(self, n: int) -> int:
         return bucket_for(n, self.buckets[0], self.max_len)
 
+    def _pages_needed(self, prompt_len: int, bucket: int, max_tokens: int) -> int:
+        """All pages this sequence will EVER touch, granted up front (no
+        mid-flight allocation → no page-starvation deadlock): the prompt
+        bucket plus generated positions up to prompt_len + max_tokens."""
+        last_pos = min(prompt_len + max_tokens, self.max_len - 1)
+        return max(bucket // self.page_size, last_pos // self.page_size + 1)
+
+    def _set_row_sampling(self, slot: int, params: SamplingParams):
+        self._temps = self._temps.at[slot].set(params.temperature)
+        self._topks = self._topks.at[slot].set(params.top_k)
+
+    def _insert(self, req: _Request, slot: int, kv, length: int, first_token):
+        """Layout-dispatching sequence insertion. Returns False when the
+        paged pool can't host the sequence right now (caller backlogs)."""
+        if self.kv_layout == "paged":
+            bucket = kv["k"].shape[1]
+            need = self._pages_needed(length, bucket, req.params.max_tokens)
+            if need > len(self._free_pages):
+                return False
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self._slot_pages[slot] = pages
+            padded_pages = np.zeros((self.max_pages_per_seq,), np.int32)
+            padded_pages[:need] = pages
+            self.state = self._dp.insert_sequence_paged(
+                self.state, slot, kv, jnp.int32(length),
+                jnp.asarray(first_token, jnp.int32),
+                jnp.asarray(padded_pages), self.cfg)
+        else:
+            self.state = decoding.insert_sequence(
+                self.state, slot, kv, jnp.int32(length),
+                jnp.asarray(first_token, jnp.int32), self.cfg)
+        self._set_row_sampling(slot, req.params)
+        self._by_slot[slot] = req
+        return True
+
+    def _next_waiting(self):
+        if self._backlog:
+            return self._backlog.pop(0)
+        try:
+            return self._waiting.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit(self):
-        while self._free:
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
+        admitted = 0
+        while self._free and admitted < self.max_prefills_per_step:
+            req = self._next_waiting()
+            if req is None:
                 return
             slot = self._free.pop()
             req.slot = slot
@@ -216,15 +388,25 @@ class TPUEngine:
                     req.out_queue.put(_SENTINEL)
                     continue
                 # PD path: KV arrived from a prefill server over the host plane
-                kv = {"k": jnp.asarray(req.kv_pack["k"], self.state["k"].dtype),
-                      "v": jnp.asarray(req.kv_pack["v"], self.state["v"].dtype)}
-                self.state = decoding.insert_sequence(
-                    self.state, slot, kv, jnp.int32(req.kv_pack["length"]),
-                    jnp.int32(req.kv_pack["first_token"]), self.cfg)
-                self._by_slot[slot] = req
+                ktmpl = self.state["k" if self.kv_layout == "slot" else "kp"]
+                kv = {"k": jnp.asarray(req.kv_pack["k"], ktmpl.dtype),
+                      "v": jnp.asarray(req.kv_pack["v"], ktmpl.dtype)}
+                if not self._insert(req, slot, kv, req.kv_pack["length"],
+                                    req.kv_pack["first_token"]):
+                    self._free.append(slot)
+                    self._backlog.append(req)
+                    return  # page pressure: stop admitting this round
+                admitted += 1
                 continue
             n = len(req.tokens)
             bucket = self._bucket(n)
+            if self.kv_layout == "paged":
+                # cheap feasibility check BEFORE paying for the prefill
+                if (self._pages_needed(n, bucket, req.params.max_tokens)
+                        > len(self._free_pages)):
+                    self._free.append(slot)
+                    self._backlog.append(req)
+                    return
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = req.tokens
             logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
@@ -233,9 +415,11 @@ class TPUEngine:
             first = decoding.sample(logits[None, :], sub,
                                     req.params.temperature, req.params.top_k)
             first_id = int(first[0])
-            self.state = decoding.insert_sequence(
-                self.state, slot, kv, jnp.int32(n), first[0], self.cfg)
-            self._by_slot[slot] = req
+            if not self._insert(req, slot, kv, n, first[0]):
+                self._free.append(slot)
+                self._backlog.append(req)
+                return
+            admitted += 1
             self._emit(req, first_id)
 
     def _emit(self, req: _Request, token_id: int):
@@ -245,7 +429,11 @@ class TPUEngine:
         if not eos:
             req.out_queue.put(token_id)
         if eos or req.generated >= req.params.max_tokens:
-            self.state = decoding.release_slot(self.state, req.slot)
+            if self.kv_layout == "paged":
+                self.state = self._dp.release_slot_paged(self.state, req.slot)
+                self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
+            else:
+                self.state = decoding.release_slot(self.state, req.slot)
             self._free.append(req.slot)
             del self._by_slot[req.slot]
             req.out_queue.put(_SENTINEL)
@@ -260,23 +448,23 @@ class TPUEngine:
 
     def _loop_inner(self):
         while not self._stop:
-            if not self._by_slot and self._waiting.empty():
+            if (not self._by_slot and self._waiting.empty()
+                    and not self._backlog):
                 self._work.wait(timeout=0.1)
                 self._work.clear()
                 continue
             self._admit()
             if not self._by_slot:
                 continue
-            self.state, logits = decoding.decode_step(self.params, self.state, self.cfg)
+            if self.kv_layout == "paged":
+                self.state, logits = self._dp.decode_step_paged(
+                    self.params, self.state, self.cfg)
+            else:
+                self.state, logits = decoding.decode_step(
+                    self.params, self.state, self.cfg)
             self.key, sub = jax.random.split(self.key)
-            # per-row sampling params, applied vectorized on device
-            temps = np.zeros((self.max_slots,), np.float32)
-            top_ks = np.zeros((self.max_slots,), np.int32)
-            for slot, req in self._by_slot.items():
-                temps[slot] = req.params.temperature
-                top_ks[slot] = req.params.top_k
-            toks = decoding.sample_per_row(logits, sub, jnp.asarray(temps),
-                                           jnp.asarray(top_ks))
+            # sampling params live on device, updated only at admission
+            toks = decoding.sample_per_row(logits, sub, self._temps, self._topks)
             self.state = decoding.commit_tokens(self.state, toks)
             toks_host = np.asarray(toks)
             for slot, req in list(self._by_slot.items()):
@@ -285,6 +473,12 @@ class TPUEngine:
     # ---------------------------------------------------------------- stats
 
     def stats(self) -> dict:
-        return {"free_slots": len(self._free), "active": len(self._by_slot),
-                "waiting": self._waiting.qsize(), "max_slots": self.max_slots,
-                "buckets": list(self.buckets)}
+        out = {"free_slots": len(self._free), "active": len(self._by_slot),
+               "waiting": self._waiting.qsize() + len(self._backlog),
+               "max_slots": self.max_slots, "buckets": list(self.buckets),
+               "kv_layout": self.kv_layout}
+        if self.kv_layout == "paged":
+            out["free_pages"] = len(self._free_pages)
+            out["num_pages"] = self.num_pages
+            out["page_size"] = self.page_size
+        return out

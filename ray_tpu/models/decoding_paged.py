@@ -1,0 +1,139 @@
+"""Paged KV-cache decoding: block-table attention with static shapes.
+
+The contiguous backend (models/decoding.py) reserves `max_len` tokens of KV
+per slot — fine for uniform sequence lengths, wasteful for mixed ones. This
+backend carves HBM into a shared **page pool**; each slot owns just the
+pages its sequence actually needs, tracked in a block table, so the same
+HBM serves many more concurrent sequences at typical length distributions.
+
+All shapes stay static (XLA-first, like everything here): the pool is
+[L, num_pages, page, Hkv, Dh]; per-step writes are scatters at
+(page_id, offset) and attention gathers each row's pages with a take along
+the page axis. Page allocation/free is host-side bookkeeping in the engine
+(a free list), mirroring how vLLM's scheduler owns its block tables.
+
+(reference capability: vLLM paged attention behind
+llm/_internal/serve/engines/vllm/vllm_engine.py:114; design here is
+TPU-native — dense static gathers, no custom CUDA.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.decoding import _attn_qkv, _mlp_block, _rope
+from ray_tpu.models.transformer import TransformerConfig, _norm
+from ray_tpu import ops
+
+
+def init_paged_state(cfg: TransformerConfig, max_slots: int, max_len: int,
+                     num_pages: int, page_size: int) -> dict:
+    """Page pool + block tables. `num_pages * page_size` is the total token
+    capacity shared by all slots (oversubscribable vs max_slots*max_len)."""
+    L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+    max_pages_per_seq = (max_len + page_size - 1) // page_size
+    return {
+        "kp": jnp.zeros((L, num_pages, page_size, Hkv, Dh), cfg.dtype),
+        "vp": jnp.zeros((L, num_pages, page_size, Hkv, Dh), cfg.dtype),
+        # page ids per slot; unused entries point at page 0 (masked anyway)
+        "block": jnp.zeros((max_slots, max_pages_per_seq), jnp.int32),
+        "length": jnp.zeros((max_slots,), jnp.int32),
+        "last_token": jnp.zeros((max_slots,), jnp.int32),
+        "active": jnp.zeros((max_slots,), jnp.bool_),
+    }
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
+def insert_sequence_paged(state, slot, kv, length, first_token, pages,
+                          cfg: TransformerConfig):
+    """Write a prefilled [L, T, Hkv, Dh] KV into the first T/page_size of
+    this slot's `pages` (int32 [max_pages_per_seq], padded with 0 — the
+    engine grants ALL pages the sequence will ever need up front, so no
+    mid-flight allocation) and activate the row."""
+    P = state["kp"].shape[2]
+    L, T = kv["k"].shape[0], kv["k"].shape[1]
+    n = T // P  # static: T is the prompt bucket
+    k_pages = kv["k"].reshape(L, n, P, kv["k"].shape[2], kv["k"].shape[3])
+    v_pages = kv["v"].reshape(L, n, P, kv["v"].shape[2], kv["v"].shape[3])
+    state = dict(state)
+    state["kp"] = state["kp"].at[:, pages[:n]].set(k_pages.astype(state["kp"].dtype))
+    state["vp"] = state["vp"].at[:, pages[:n]].set(v_pages.astype(state["vp"].dtype))
+    state["block"] = jax.lax.dynamic_update_slice_in_dim(
+        state["block"], pages[None], slot, axis=0)
+    state["length"] = state["length"].at[slot].set(length)
+    state["last_token"] = state["last_token"].at[slot].set(first_token)
+    state["active"] = state["active"].at[slot].set(True)
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
+def decode_step_paged(params, state, cfg: TransformerConfig):
+    """Advance every active row one token against its paged cache."""
+    dt = cfg.dtype
+    B, MP = state["block"].shape
+    P = state["kp"].shape[2]
+    S = MP * P
+    tokens = state["last_token"][:, None]
+    pos = state["length"]                                      # [B]
+    page_ids = jnp.take_along_axis(state["block"],
+                                   (pos // P)[:, None], axis=1)[:, 0]  # [B]
+    # inactive rows scatter into page 0 — RESERVED as scratch (the engine's
+    # allocator never hands out page 0), so they can't corrupt live pages
+    page_ids = jnp.where(state["active"], page_ids, 0)
+    offsets = pos % P                                          # [B]
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos][:, None]
+    cos, sin = _rope(cfg)
+
+    def block(carry, layer_in):
+        h, = carry
+        layer_p, kp, vp = layer_in               # pools [num_pages, P, Hkv, Dh]
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)      # [B, 1, H, Dh]
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin, positions=pos[:, None])
+            k = ops.apply_rope(k, cos, sin, positions=pos[:, None])
+        # scatter this step's K/V at (page, offset) per row
+        kp = kp.at[page_ids, offsets].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page_ids, offsets].set(v[:, 0].astype(vp.dtype))
+        # gather each row's pages → a contiguous [B, S] view for attention
+        k_cache = kp[state["block"]].reshape(B, S, cfg.kv_heads, cfg.head_dim)
+        v_cache = vp[state["block"]].reshape(B, S, cfg.kv_heads, cfg.head_dim)
+        G = cfg.n_heads // cfg.kv_heads
+        qh = q[:, 0].reshape(B, cfg.kv_heads, G, cfg.head_dim)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qh, k_cache.astype(dt)) / (cfg.head_dim ** 0.5)
+        mask = jnp.arange(S)[None, :] <= pos[:, None]
+        scores = jnp.where(mask[:, None, None, :], scores.astype(jnp.float32), -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bkgs,bskd->bkgd", w, v_cache.astype(dt))
+        out = out.reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return (h,), (kp, vp)
+
+    (x,), (kp_new, vp_new) = jax.lax.scan(
+        block, (x,), (params["layers"], state["kp"], state["vp"]))
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x[:, 0] @ params["embed"].astype(dt).T
+    else:
+        logits = x[:, 0] @ params["lm_head"].astype(dt)
+    state = dict(state)
+    state["kp"], state["vp"] = kp_new, vp_new
+    state["length"] = jnp.where(state["active"], state["length"] + 1, state["length"])
+    return state, logits.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def release_slot_paged(state, slot):
+    state = dict(state)
+    state["active"] = state["active"].at[slot].set(False)
+    state["length"] = state["length"].at[slot].set(0)
+    return state

@@ -1,0 +1,192 @@
+"""Paged KV cache + engine upgrades: correctness vs the full forward,
+page-pool pressure/backlog, and tensor-parallel multi-chip serving.
+
+(reference capability: vLLM paged attention + tensor_parallel_size —
+llm/_internal/serve/engines/vllm/vllm_engine.py:114, vllm_models.py:215 —
+re-designed TPU-first: static-shape page pool + jax.sharding TP.)
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.models import transformer
+from ray_tpu.models.transformer import TransformerConfig
+
+TINY = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(**TINY)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _naive_greedy(params, cfg, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits, _ = transformer.forward(params, jnp.asarray([toks]), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_paged_engine_matches_full_forward(tiny_model):
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    eng = TPUEngine(cfg, params, max_slots=4, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8)
+    try:
+        prompt = [1, 5, 9, 2, 7]
+        out = eng.generate(prompt, SamplingParams(max_tokens=8, temperature=0.0))
+        assert out == _naive_greedy(params, cfg, prompt, 8)
+        st = eng.stats()
+        assert st["kv_layout"] == "paged"
+        assert st["free_pages"] == st["num_pages"] - 1  # all returned (0=scratch)
+    finally:
+        eng.shutdown()
+
+
+def test_paged_concurrent_sequences_isolated(tiny_model):
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    eng = TPUEngine(cfg, params, max_slots=4, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8)
+    try:
+        prompts = [[1, 5, 9], [3, 3, 8, 2], [7], [2, 4, 6, 8, 10]]
+        want = [_naive_greedy(params, cfg, p, 6) for p in prompts]
+        got = [None] * len(prompts)
+
+        def run(i):
+            got[i] = eng.generate(prompts[i], SamplingParams(max_tokens=6))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert got == want
+    finally:
+        eng.shutdown()
+
+
+def test_paged_pool_pressure_backlogs_then_completes(tiny_model):
+    """With a pool too small for all sequences at once, later requests wait
+    for pages and still complete correctly (vLLM-style admission control)."""
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    # each sequence needs ~3 pages (bucket 8 + 16 generated → pages to pos 24
+    # at page 8); pool of 7 usable pages → only 2 sequences fit at once
+    eng = TPUEngine(cfg, params, max_slots=4, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8, num_pages=8)
+    try:
+        prompts = [[1, 5, 9], [3, 3, 8, 2], [7, 1], [2, 4, 6]]
+        want = [_naive_greedy(params, cfg, p, 16) for p in prompts]
+        got = [None] * len(prompts)
+
+        def run(i):
+            got[i] = eng.generate(prompts[i], SamplingParams(max_tokens=16))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert got == want
+        assert eng.stats()["free_pages"] == 7
+    finally:
+        eng.shutdown()
+
+
+def test_tensor_parallel_engine_matches_single_chip(tiny_model):
+    """TP over a 2-device mesh produces identical greedy tokens."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(devs[:2], ("tp",))
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=64, min_bucket=8,
+                    mesh=mesh)
+    try:
+        prompt = [1, 5, 9, 2, 7, 4]
+        out = eng.generate(prompt, SamplingParams(max_tokens=8, temperature=0.0))
+        assert out == _naive_greedy(params, cfg, prompt, 8)
+    finally:
+        eng.shutdown()
+
+
+def test_tensor_parallel_paged_engine(tiny_model):
+    from jax.sharding import Mesh
+
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(devs[:2], ("tp",))
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8, mesh=mesh)
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        out = eng.generate(prompt, SamplingParams(max_tokens=6, temperature=0.0))
+        assert out == _naive_greedy(params, cfg, prompt, 6)
+    finally:
+        eng.shutdown()
+
+
+def test_paged_infeasible_request_rejected_up_front(tiny_model):
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8, num_pages=4)
+    try:
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit(list(range(40)), SamplingParams(max_tokens=16))
+        # feasible work still runs afterwards (no wedged admission)
+        out = eng.generate([1, 2, 3], SamplingParams(max_tokens=4))
+        assert len(out) <= 4
+    finally:
+        eng.shutdown()
+
+
+def test_paged_backlog_revived_after_idle(tiny_model):
+    """A request backlogged under page pressure must be admitted once pages
+    free, even if the engine went fully idle in between."""
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=64, min_bucket=8,
+                    kv_layout="paged", page_size=8, num_pages=7)
+    try:
+        # first request takes most pages; second must wait, then complete
+        a = eng.submit(list(range(20)), SamplingParams(max_tokens=20))
+        b = eng.submit(list(range(18)), SamplingParams(max_tokens=8))
+        out_a = list(__import__("ray_tpu.llm.engine", fromlist=["_iter_request"])._iter_request(a))
+        out_b = list(__import__("ray_tpu.llm.engine", fromlist=["_iter_request"])._iter_request(b))
+        assert len(out_a) <= 20 and len(out_b) <= 8
+    finally:
+        eng.shutdown()
+
+
+def test_paged_constructor_validation(tiny_model):
+    from ray_tpu.llm import TPUEngine
+
+    cfg, params = tiny_model
+    with pytest.raises(ValueError, match="power of two"):
+        TPUEngine(cfg, params, kv_layout="paged", page_size=0, max_len=64)
+    with pytest.raises(ValueError, match="multiple of"):
+        TPUEngine(cfg, params, kv_layout="paged", page_size=32, max_len=72)
