@@ -71,8 +71,9 @@ class DAGNode:
                 values[id(node)] = node._submit(args, kwargs)
         return values[id(self)]
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(self, *, max_inflight_executions: int = 10) -> "CompiledDAG":
+        return CompiledDAG(self,
+                           max_inflight_executions=max_inflight_executions)
 
 
 class InputNode(DAGNode):
@@ -114,16 +115,56 @@ class MultiOutputNode(DAGNode):
         super().__init__(tuple(outputs), {})
 
 
+class DAGFuture:
+    """Handle to one in-flight compiled-DAG execution: blocking `.result()`
+    or `await` (reference: compiled execute_async returns an awaitable,
+    compiled_dag_node.py:2627)."""
+
+    def __init__(self, output):
+        self._output = output
+
+    def _refs(self):
+        return (self._output if isinstance(self._output, list)
+                else [self._output])
+
+    def done(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs(),
+                                num_returns=len(self._refs()), timeout=0)
+        return len(ready) == len(self._refs())
+
+    def result(self, timeout: float | None = None):
+        vals = ray_tpu.get(self._refs(), timeout=timeout)
+        return vals if isinstance(self._output, list) else vals[0]
+
+    @property
+    def refs(self):
+        return self._output
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, self.result)
+        return fut.__await__()
+
+
 class CompiledDAG:
     """(reference: dag/compiled_dag_node.py:805 — the compiled form caches
-    the schedule; execute() is the steady-state entry point (:2546).)"""
+    a static execution schedule; execute()/execute_async() are the
+    steady-state entry points (:2546, :2627); in-flight executions overlap
+    up to max_inflight_executions, pipelining the actors.)"""
 
-    def __init__(self, root: DAGNode):
+    def __init__(self, root: DAGNode, *, max_inflight_executions: int = 10):
         self._root = root
-        self._schedule = root._topo()  # static schedule, computed once
+        self._max_inflight = max(1, int(max_inflight_executions))
+        self._inflight: list[DAGFuture] = []
+        # static schedule, computed once: topological, with per-actor op
+        # lists so repeated executions skip traversal entirely
+        # (reference: _build_execution_schedule, compiled_dag_node.py:2002)
+        self._schedule = root._topo()
         self._input_nodes = [n for n in self._schedule if isinstance(n, InputNode)]
 
-    def execute(self, input_value: Any = None):
+    def _submit_once(self, input_value):
         values: dict[int, Any] = {}
         for node in self._schedule:
             if isinstance(node, InputNode):
@@ -135,7 +176,53 @@ class CompiledDAG:
                 values[id(node)] = node._submit(args, kwargs)
         return values[id(self._root)]
 
+    def _reap_inflight(self):
+        self._inflight = [f for f in self._inflight if not f.done()]
+        while len(self._inflight) >= self._max_inflight:
+            # backpressure: wait on the oldest execution's refs without
+            # materializing its outputs on the driver
+            oldest = self._inflight[0]
+            ray_tpu.wait(oldest._refs(), num_returns=len(oldest._refs()))
+            self._inflight = [f for f in self._inflight if not f.done()]
+
+    def execute(self, input_value: Any = None):
+        """Submit one execution; returns the output ObjectRef(s). Submits
+        overlap with previous in-flight executions up to the cap."""
+        self._reap_inflight()
+        out = self._submit_once(input_value)
+        self._inflight.append(DAGFuture(out))
+        return out
+
+    def execute_async(self, input_value: Any = None) -> DAGFuture:
+        """Submit one execution; returns a DAGFuture (`.result()`/`await`)."""
+        self._reap_inflight()
+        fut = DAGFuture(self._submit_once(input_value))
+        self._inflight.append(fut)
+        return fut
+
+    def visualize(self) -> str:
+        """Text rendering of the static schedule (reference: CompiledDAG
+        visualize)."""
+        lines = []
+        for i, n in enumerate(self._schedule):
+            kind = type(n).__name__
+            deps = [self._schedule.index(u) for u in n._upstream()]
+            label = ""
+            if isinstance(n, FunctionNode):
+                label = getattr(n._fn, "__name__", "fn")
+            elif isinstance(n, ClassMethodNode):
+                label = (f"{getattr(n._method, '_actor_id', '?')[:8]}."
+                         f"{getattr(n._method, '_method_name', '?')}")
+            lines.append(f"{i:3d} {kind:16s} {label:24s} deps={deps}")
+        return "\n".join(lines)
+
     def teardown(self):
+        for f in self._inflight:
+            try:
+                f.result(timeout=5)
+            except Exception:
+                pass
+        self._inflight = []
         self._schedule = []
 
 

@@ -96,19 +96,21 @@ def _shard_params_tp(params, mesh):
         nd = x.ndim
         def pad(spec):
             return P(*(list(spec) + [None] * (nd - len(spec))))
+        if "mlp" in name:
+            # transformer.py MLP names: wi / wi_gate / wi_up [L, E, F],
+            # wo [L, F, E], bi [L, F] — split the hidden (F) dim
+            if "wi" in name:
+                return pad([None, None, axis])
+            if "wo" in name or name.endswith("bi"):
+                return pad([None, axis])
+            return P()
         if "wq" in name or "wk" in name or "wv" in name:
             # stacked [L, E, H, Dh] → split heads
             return pad([None, None, axis])
         if "wo" in name:
-            # [L, H, Dh, E] → split heads
+            # attention out [L, H, Dh, E] → split heads
             return pad([None, axis])
         if "bq" in name or "bk" in name or "bv" in name:
-            return pad([None, axis])
-        if "mlp" in name and ("w1" in name or "wg" in name or "w_in" in name):
-            return pad([None, None, axis])  # [L, E, F] → split F
-        if "mlp" in name and ("w2" in name or "w_out" in name):
-            return pad([None, axis])        # [L, F, E] → split F
-        if "mlp" in name and "b1" in name:
             return pad([None, axis])
         return P()  # replicate
 
@@ -164,6 +166,11 @@ class TPUEngine:
                     f"max_len {self.max_len} must be a multiple of "
                     f"page_size {page_size} (buckets reshape into whole pages)")
             min_bucket = max(min_bucket, page_size)
+            if min_bucket % page_size:
+                raise ValueError(
+                    f"min_bucket {min_bucket} must be a multiple of "
+                    f"page_size {page_size} (every prompt bucket reshapes "
+                    f"into whole pages)")
         self.buckets = []
         b = min_bucket
         while b < self.max_len:

@@ -6,7 +6,10 @@ is a jitted XLA program that scales by mesh sharding instead of torch DDP.)
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_vec_env
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.learner import Learner, compute_gae
@@ -14,6 +17,11 @@ from ray_tpu.rllib.learner import Learner, compute_gae
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
     "CartPoleVecEnv",
     "EnvRunner",
     "EnvRunnerGroup",

@@ -1,0 +1,115 @@
+"""BC — behavior cloning: offline RL on a ray_tpu.data Dataset.
+
+(reference: rllib/algorithms/bc/ + the offline-RL pipeline on Ray Data,
+rllib/offline/ — trains a policy by supervised imitation of logged
+(obs, action) pairs streamed from a dataset.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class BCConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data = None       # ray_tpu.data Dataset | list[dict]
+        self.obs_dim = None            # required (no env probe offline)
+        self.num_actions = None
+        self.train_batch_size = 256
+
+    def offline(self, *, offline_data=None, obs_dim=None, num_actions=None,
+                train_batch_size=None, **_ignored) -> "BCConfig":
+        if offline_data is not None:
+            self.offline_data = offline_data
+        if obs_dim is not None:
+            self.obs_dim = obs_dim
+        if num_actions is not None:
+            self.num_actions = num_actions
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        return self
+
+
+def make_bc_update(optimizer):
+    @jax.jit
+    def update(params, opt_state, batch):
+        def loss_fn(p):
+            logits, _ = rl_module.forward(p, batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, batch["actions"][:, None],
+                                       axis=1)[:, 0]
+            loss = jnp.mean(nll)
+            acc = jnp.mean((jnp.argmax(logits, axis=-1)
+                            == batch["actions"]).astype(jnp.float32))
+            return loss, {"imitation_accuracy": acc}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        return params, opt_state, metrics
+
+    return update
+
+
+class BC(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        if cfg.offline_data is None or cfg.obs_dim is None or cfg.num_actions is None:
+            raise ValueError(
+                "BC needs .offline(offline_data=..., obs_dim=..., "
+                "num_actions=...)")
+        self.params = rl_module.init(jax.random.PRNGKey(cfg.seed),
+                                     cfg.obs_dim, cfg.num_actions,
+                                     cfg.model_hidden)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_bc_update(self.optimizer)
+
+    def _batches(self):
+        """Stream (obs, actions) batches from the configured source: a
+        ray_tpu.data Dataset of {'obs': ..., 'action': ...} rows, or an
+        in-memory list of such dicts."""
+        cfg = self.config
+        data = cfg.offline_data
+        bs = cfg.train_batch_size
+        rows_iter = (data.iter_rows() if hasattr(data, "iter_rows")
+                     else iter(data))
+        obs, acts = [], []
+        for row in rows_iter:
+            obs.append(np.asarray(row["obs"], np.float32))
+            acts.append(int(row["action"]))
+            if len(obs) >= bs:
+                yield {"obs": jnp.asarray(np.stack(obs)),
+                       "actions": jnp.asarray(np.asarray(acts, np.int32))}
+                obs, acts = [], []
+        if obs:
+            yield {"obs": jnp.asarray(np.stack(obs)),
+                   "actions": jnp.asarray(np.asarray(acts, np.int32))}
+
+    def training_step(self) -> dict:
+        metrics: dict = {}
+        n = 0
+        for batch in self._batches():
+            self.params, self.opt_state, m = self._update(
+                self.params, self.opt_state, batch)
+            n += int(batch["actions"].shape[0])
+            metrics = {k: float(v) for k, v in m.items()}
+        metrics["num_samples_trained"] = n
+        return metrics
+
+    def predict(self, obs) -> np.ndarray:
+        return np.asarray(rl_module.forward_inference(
+            self.params, jnp.asarray(obs, jnp.float32)))
+
+
+BCConfig.algo_class = BC

@@ -1,0 +1,173 @@
+"""DQN: double Q-learning with a target network and replay buffer.
+
+(reference: rllib/algorithms/dqn/ — DQNConfig/DQN with replay + target-net
+sync + double-Q; Rainbow extensions out of scope. The TD update is one
+jitted XLA program; rollout exploration is epsilon-greedy on the runners.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.replay import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.buffer_size = 50_000
+        self.train_batch_size = 64
+        self.target_update_freq = 200     # updates between target syncs
+        self.num_updates_per_step = 8
+        self.learning_starts = 500        # min transitions before updates
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 5_000  # env steps to anneal over
+        self.double_q = True
+
+    def training(self, *, buffer_size=None, train_batch_size=None,
+                 target_update_freq=None, num_updates_per_step=None,
+                 learning_starts=None, epsilon_initial=None,
+                 epsilon_final=None, epsilon_decay_steps=None,
+                 double_q=None, **kwargs) -> "DQNConfig":
+        super().training(**kwargs)
+        for name, val in (("buffer_size", buffer_size),
+                          ("train_batch_size", train_batch_size),
+                          ("target_update_freq", target_update_freq),
+                          ("num_updates_per_step", num_updates_per_step),
+                          ("learning_starts", learning_starts),
+                          ("epsilon_initial", epsilon_initial),
+                          ("epsilon_final", epsilon_final),
+                          ("epsilon_decay_steps", epsilon_decay_steps),
+                          ("double_q", double_q)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def make_dqn_update(optimizer, *, gamma: float, double_q: bool):
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        def loss_fn(p):
+            q_all, _ = rl_module.forward(p, batch["obs"])      # [B, A]
+            q = jnp.take_along_axis(q_all, batch["actions"][:, None],
+                                    axis=1)[:, 0]
+            qt_all, _ = rl_module.forward(target_params, batch["next_obs"])
+            if double_q:
+                qo_all, _ = rl_module.forward(p, batch["next_obs"])
+                a_star = jnp.argmax(qo_all, axis=-1)
+                q_next = jnp.take_along_axis(qt_all, a_star[:, None],
+                                             axis=1)[:, 0]
+            else:
+                q_next = jnp.max(qt_all, axis=-1)
+            q_next = jax.lax.stop_gradient(q_next)
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = batch["rewards"] + gamma * nonterminal * q_next
+            td = q - target
+            loss = jnp.mean(optax.huber_loss(td))
+            return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                          "q_mean": jnp.mean(q)}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        return params, opt_state, metrics
+
+    return update
+
+
+class DQN(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        probe = make_vec_env(cfg.env_id, 1, cfg.seed)
+        self.obs_dim, self.num_actions = probe.obs_dim, probe.num_actions
+        self.params = rl_module.init(jax.random.PRNGKey(cfg.seed),
+                                     self.obs_dim, self.num_actions,
+                                     cfg.model_hidden)
+        self.target_params = self.params
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_dqn_update(self.optimizer, gamma=cfg.gamma,
+                                       double_q=cfg.double_q)
+        self.buffer = ReplayBuffer(cfg.buffer_size, self.obs_dim,
+                                   seed=cfg.seed)
+        self.runner_group = EnvRunnerGroup(
+            cfg.env_id, num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner, seed=cfg.seed)
+        self._env_steps = 0
+        self._num_updates = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        from ray_tpu._private import serialization as ser
+
+        blob = ser.dumps(jax.device_get(self.params))
+        samples = self.runner_group.sample_epsilon_greedy(
+            blob, cfg.rollout_fragment_length, self._epsilon())
+        for s in samples:
+            T, N = s["rewards"].shape
+            self.buffer.add_batch(
+                s["obs"].reshape(T * N, -1), s["actions"].reshape(T * N),
+                s["rewards"].reshape(T * N),
+                s["next_obs"].reshape(T * N, -1), s["dones"].reshape(T * N))
+            self._env_steps += T * N
+            self._episode_returns.extend(s["episode_returns"])
+        metrics: dict = {"epsilon": self._epsilon(),
+                         "buffer_size": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return metrics
+        m: dict = {}
+        for _ in range(cfg.num_updates_per_step):
+            batch = {k: jnp.asarray(v)
+                     for k, v in self.buffer.sample(cfg.train_batch_size).items()}
+            self.params, self.opt_state, m = self._update(
+                self.params, self.target_params, self.opt_state, batch)
+            self._num_updates += 1
+            if self._num_updates % cfg.target_update_freq == 0:
+                self.target_params = self.params
+        metrics.update({k: float(v) for k, v in m.items()})
+        metrics["num_updates"] = self._num_updates
+        return metrics
+
+
+    def save(self, path: str) -> str:
+        import os
+
+        from ray_tpu.llm import checkpoint_io
+
+        os.makedirs(path, exist_ok=True)
+        checkpoint_io.save_params(self.params, os.path.join(path, "module"))
+        return path
+
+    def restore(self, path: str) -> None:
+        import os
+
+        from ray_tpu.llm import checkpoint_io
+
+        loaded = checkpoint_io.load_params(os.path.join(path, "module"))
+        self.params = jax.tree.map(
+            lambda old, new: new.astype(old.dtype) if hasattr(old, "dtype") else new,
+            self.params, loaded)
+        self.target_params = self.params
+        self.opt_state = self.optimizer.init(self.params)
+
+
+DQNConfig.algo_class = DQN

@@ -59,6 +59,42 @@ class EnvRunner:
             "episode_returns": self.env.drain_episode_returns(),
         }
 
+    def sample_epsilon_greedy(self, params_blob: bytes, num_steps: int,
+                              epsilon: float) -> dict:
+        """Off-policy rollout: epsilon-greedy over Q-values (the module's
+        pi head doubles as the Q head). Returns transitions incl. next_obs
+        for replay (reference: DQN rollout workers)."""
+        import jax
+        import numpy as np  # noqa: F811 — module-level np also imported
+
+        from ray_tpu._private import serialization as ser
+        from ray_tpu.rllib import rl_module
+
+        params = ser.loads(params_blob)
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        rew_buf = np.zeros((T, N), np.float32)
+        next_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        rng = np.random.default_rng(int(jax.random.randint(
+            self.key, (), 0, 2**31 - 1)))
+        self.key, _ = jax.random.split(self.key)
+        for t in range(T):
+            greedy = np.asarray(rl_module.forward_inference(params, self.obs))
+            explore = rng.random(N) < epsilon
+            random_a = rng.integers(0, self.env.num_actions, N)
+            action = np.where(explore, random_a, greedy).astype(np.int32)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            self.obs, rew_buf[t], done_buf[t], _ = self.env.step(action)
+            next_buf[t] = self.obs
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "next_obs": next_buf, "dones": done_buf,
+            "episode_returns": self.env.drain_episode_returns(),
+        }
+
     def ping(self) -> bool:
         return True
 
@@ -77,9 +113,7 @@ class EnvRunnerGroup:
             for i in range(num_runners)
         ]
 
-    def sample(self, params_blob: bytes, num_steps: int) -> list[dict]:
-        refs = [(i, r.sample.remote(params_blob, num_steps))
-                for i, r in enumerate(self.runners)]
+    def _collect(self, refs) -> list[dict]:
         out = []
         for i, ref in refs:
             try:
@@ -95,6 +129,16 @@ class EnvRunnerGroup:
                 self.runners[i] = EnvRunner.remote(
                     self.env_id, self.num_envs_per_runner, self.seed + 7777 + i)
         return out
+
+    def sample(self, params_blob: bytes, num_steps: int) -> list[dict]:
+        return self._collect([(i, r.sample.remote(params_blob, num_steps))
+                              for i, r in enumerate(self.runners)])
+
+    def sample_epsilon_greedy(self, params_blob: bytes, num_steps: int,
+                              epsilon: float) -> list[dict]:
+        return self._collect(
+            [(i, r.sample_epsilon_greedy.remote(params_blob, num_steps, epsilon))
+             for i, r in enumerate(self.runners)])
 
     def shutdown(self):
         for r in self.runners:

@@ -25,6 +25,11 @@ class ScalingConfig:
     resources_per_worker: dict | None = None
     placement_strategy: str = "PACK"
     topology: str | None = None  # e.g. "v5e-8": ask for a slice via SLICE strategy
+    # elastic scaling: with min_workers set, each (re)start sizes the group
+    # to what the cluster can actually place in [min_workers, num_workers]
+    # instead of failing (reference: elastic ScalingPolicy + restart resize,
+    # train/v2/.../scaling_policy/)
+    min_workers: int | None = None
 
     def bundle(self) -> dict:
         b = dict(self.resources_per_worker or {})

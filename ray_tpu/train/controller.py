@@ -33,6 +33,7 @@ class TrainController:
         self.backend_blob = backend_blob
         self.datasets = ser.loads(datasets_blob) if datasets_blob else {}
         self.state = "INITIALIZING"
+        self.current_workers = self.scaling.num_workers
         self.ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
         self.failures = 0
         self.latest_metrics: dict = {}
@@ -47,13 +48,16 @@ class TrainController:
         max_failures = self.run_config.failure_config.max_failures
         error = None
         while True:
+            # size THIS attempt first: recovery's completeness fallback
+            # compares rank-dir counts against the attempt's world size
+            scaling = self._resolve_scaling()
             self._recover_checkpoints_from_storage(exp_dir)
             from ray_tpu._private import serialization as ser
 
             self.state = "SCHEDULING"
             self._iter_buffer.clear()  # a crashed attempt's partial iters are void
             backend = ser.loads(self.backend_blob) if self.backend_blob else None
-            group = WorkerGroup(self.scaling, backend)
+            group = WorkerGroup(scaling, backend)
             try:
                 group.start()
                 self._start_training(group, exp_dir)
@@ -83,6 +87,26 @@ class TrainController:
             "failures": self.failures,
         }
 
+    def _resolve_scaling(self):
+        """Elastic restart sizing: with min_workers set, size this attempt
+        to what the cluster can place right now, in
+        [min_workers, num_workers] (reference: elastic ScalingPolicy —
+        train/v2/.../scaling_policy; resize happens at attempt boundaries)."""
+        import dataclasses
+
+        sc = self.scaling
+        if sc.min_workers is None:
+            self.current_workers = sc.num_workers
+            return sc
+        avail = ray_tpu.available_resources()
+        per = sc.bundle()
+        fit = min((int(avail.get(k, 0.0) // v)
+                   for k, v in per.items() if v > 0),
+                  default=sc.num_workers)
+        n = max(sc.min_workers, min(sc.num_workers, fit))
+        self.current_workers = n
+        return dataclasses.replace(sc, num_workers=n)
+
     def _recover_checkpoints_from_storage(self, exp_dir: str) -> None:
         """Register complete checkpoints already on storage that the poll loop
         never saw (worker died with reports undrained). Checkpoints are the
@@ -90,7 +114,7 @@ class TrainController:
         (reference: checkpoints live in StorageContext-managed storage and
         survive worker loss — v2/_internal/execution/storage.py.)"""
         tracked = {t.checkpoint.path for t in self.ckpt_manager._tracked}
-        n = self.scaling.num_workers
+        n = self.current_workers
         for name in sorted(os.listdir(exp_dir)):
             path = os.path.join(exp_dir, name)
             if not name.startswith("checkpoint_") or path in tracked:
@@ -113,7 +137,7 @@ class TrainController:
         name = self.run_config.name or os.path.basename(exp_dir)
         shards: dict[int, dict] = {}
         if self.datasets:
-            n = self.scaling.num_workers
+            n = self.current_workers
             split_ds = {}
             for ds_name, ds in self.datasets.items():
                 split_ds[ds_name] = ds.streaming_split(n)
@@ -132,14 +156,14 @@ class TrainController:
             "experiment_name": name,
             "checkpoint": latest,
             "start_iteration": start_iteration,
-            "local_world_size": self.scaling.num_workers,
+            "local_world_size": self.current_workers,
             "node_rank": 0,
         }
         group.start_training(self.train_fn_blob, self.config, ctx,
                              self.backend_blob, shards)
 
     def _poll_until_done(self, group: WorkerGroup) -> tuple[str, str | None]:
-        n = self.scaling.num_workers
+        n = self.current_workers
         while True:
             try:
                 polls = group.poll()

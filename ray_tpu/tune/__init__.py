@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    TPESearcher,
     ConcurrencyLimiter,
     Searcher,
     choice,
@@ -30,6 +31,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, TuneResult, Tuner
 __all__ = [
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
+    "TPESearcher",
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "HyperBandScheduler",

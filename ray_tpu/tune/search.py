@@ -167,3 +167,103 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (independent per-dimension):
+    completed trials split into good/bad by metric quantile; candidates are
+    scored by the ratio of good/bad kernel densities and the best of
+    `n_candidates` is suggested. Covers the reference's model-based
+    searchers (Optuna's default sampler is TPE; reference: tune/search/optuna/)
+    without the external dependency.
+    """
+
+    def __init__(self, space: dict, num_samples: int = 32, *,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 n_startup: int = 8, seed: int | None = None):
+        self._rng = random.Random(seed)
+        grids, domains, constants = _split_space(space)
+        if grids:
+            raise ValueError("TPESearcher does not take grid_search dims; "
+                             "use choice(...) instead")
+        self._domains = domains
+        self._constants = constants
+        self._num_samples = num_samples
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._n_startup = n_startup
+        self._suggested = 0
+        self._configs: dict[str, dict] = {}
+        self._history: list[tuple[dict, float]] = []
+
+    @property
+    def total_trials(self) -> int:
+        return self._num_samples
+
+    def _random_config(self) -> dict:
+        cfg = dict(self._constants)
+        for k, d in self._domains.items():
+            cfg[k] = d.sample(self._rng)
+        return cfg
+
+    def _split_history(self):
+        ordered = sorted(self._history, key=lambda t: t[1],
+                         reverse=(self.mode == "max"))
+        n_good = max(1, int(len(ordered) * self._gamma))
+        good = [c for c, _ in ordered[:n_good]]
+        bad = [c for c, _ in ordered[n_good:]] or good
+        return good, bad
+
+    def _dim_score(self, key, domain, value, good, bad) -> float:
+        """log(density under good) - log(density under bad), per dimension."""
+        import math
+
+        gvals = [c[key] for c in good]
+        bvals = [c[key] for c in bad]
+        if isinstance(domain, Categorical):
+            eps = 0.5
+            pg = (gvals.count(value) + eps) / (len(gvals) + eps * len(domain.categories))
+            pb = (bvals.count(value) + eps) / (len(bvals) + eps * len(domain.categories))
+            return math.log(pg) - math.log(pb)
+        # numeric: gaussian KDE with Silverman-ish bandwidth
+        def kde(vals):
+            if not vals:
+                return 1e-12
+            lo = min(vals); hi = max(vals)
+            bw = max((hi - lo) / max(len(vals) ** 0.5, 1.0), 1e-9)
+            s = sum(math.exp(-0.5 * ((value - v) / bw) ** 2) / bw for v in vals)
+            return max(s / len(vals), 1e-12)
+
+        return math.log(kde(gvals)) - math.log(kde(bvals))
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self.metric is None:
+            raise ValueError(
+                "TPESearcher needs TuneConfig(metric=..., mode=...) — "
+                "without a metric it can only sample at random")
+        if self._suggested >= self._num_samples:
+            return None
+        self._suggested += 1
+        if len(self._history) < self._n_startup:
+            cfg = self._random_config()
+        else:
+            good, bad = self._split_history()
+            best_cfg, best_score = None, None
+            for _ in range(self._n_candidates):
+                cand = self._random_config()
+                score = sum(
+                    self._dim_score(k, d, cand[k], good, bad)
+                    for k, d in self._domains.items())
+                if best_score is None or score > best_score:
+                    best_cfg, best_score = cand, score
+            cfg = best_cfg
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or error or not result:
+            return
+        val = result.get(self.metric)
+        if val is not None:
+            self._history.append((cfg, float(val)))

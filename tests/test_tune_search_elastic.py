@@ -1,0 +1,123 @@
+"""TPE searcher and elastic train scaling.
+
+(reference: tune/search/optuna (TPE default sampler) — model-based search;
+train/v2 elastic ScalingPolicy — resize at restart boundaries.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=1, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_tpe_searcher_beats_random_on_quadratic():
+    """On min (x-3)^2 + (y+1)^2, TPE's later suggestions concentrate near
+    the optimum compared to its random-startup phase."""
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    space = {"x": uniform(-10, 10), "y": uniform(-10, 10)}
+    s = TPESearcher(space, num_samples=60, n_startup=10, seed=0)
+    s.set_search_properties("loss", "min")
+
+    def loss(cfg):
+        return (cfg["x"] - 3) ** 2 + (cfg["y"] + 1) ** 2
+
+    early, late = [], []
+    for i in range(60):
+        cfg = s.suggest(f"t{i}")
+        assert cfg is not None
+        val = loss(cfg)
+        (early if i < 10 else late).append(val)
+        s.on_trial_complete(f"t{i}", {"loss": val})
+    assert s.suggest("t61") is None  # budget exhausted
+    assert np.mean(sorted(late)[:10]) < np.mean(sorted(early)[:10]), \
+        "TPE did not concentrate samples near the optimum"
+
+
+def test_tpe_with_categorical_and_int():
+    from ray_tpu.tune.search import TPESearcher, choice, randint
+
+    space = {"act": choice(["relu", "tanh"]), "width": randint(8, 64)}
+    s = TPESearcher(space, num_samples=20, n_startup=5, seed=1)
+    s.set_search_properties("score", "max")
+    for i in range(20):
+        cfg = s.suggest(f"t{i}")
+        score = (1.0 if cfg["act"] == "tanh" else 0.0) + cfg["width"] / 64.0
+        s.on_trial_complete(f"t{i}", {"score": score})
+    # the model should strongly favor tanh in the post-startup phase
+    tanh_late = [c for (c, v) in s._history[10:] if c["act"] == "tanh"]
+    assert len(tanh_late) >= len(s._history[10:]) // 2
+
+
+def test_tuner_runs_with_tpe(session):
+    from ray_tpu.tune import TPESearcher, TuneConfig, Tuner
+    from ray_tpu.tune.search import uniform
+
+    space = {"lr": uniform(0.001, 1.0)}
+
+    def objective(config):
+        from ray_tpu import train
+
+        train.report({"loss": (config["lr"] - 0.3) ** 2})
+
+    tuner = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               search_alg=TPESearcher(space, num_samples=8,
+                                                      n_startup=3, seed=0)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 1.0
+    assert len(results) == 8
+
+
+def test_elastic_trainer_downsizes_to_available(session):
+    """num_workers=8 with min_workers=1 on a 4-CPU cluster: the controller
+    sizes the group to what fits instead of hanging/failing."""
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    def train_fn(config):
+        ctx = train.get_context()
+        train.report({"world": ctx.get_world_size(),
+                      "rank": ctx.get_world_rank()})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=8, min_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="elastic_test"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # sized down: 8 never fit on a 4-CPU cluster (controller takes a slot too)
+    assert 1 <= result.metrics["world"] < 8
+
+
+def test_fixed_scaling_unchanged(session):
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    def train_fn(config):
+        train.report({"world": train.get_context().get_world_size()})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="fixed_test"),
+    )
+    result = trainer.fit()
+    assert result.metrics["world"] == 2
