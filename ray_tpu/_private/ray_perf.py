@@ -37,7 +37,11 @@ def main():
     import ray_tpu
 
     ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4), num_workers=4, max_workers=8)
+    # 8 logical CPUs regardless of host cores: the suite holds 5 actors
+    # live at once (1 + a 4-actor scatter group) plus task workers — a
+    # 4-CPU session would park the 5th creation forever
+    ray_tpu.init(num_cpus=max(8, os.cpu_count() or 8), num_workers=4,
+                 max_workers=10)
     results = []
 
     try:

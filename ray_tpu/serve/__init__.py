@@ -21,6 +21,7 @@ from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.replica import get_multiplexed_model_id
+from ray_tpu.serve.rpc_ingress import RPCClient, start_rpc_ingress
 
 __all__ = [
     "Application",
@@ -40,5 +41,7 @@ __all__ = [
     "run",
     "shutdown",
     "start",
+    "start_rpc_ingress",
+    "RPCClient",
     "status",
 ]

@@ -1,0 +1,148 @@
+"""Binary RPC ingress: the gRPC-equivalent data plane for Serve.
+
+Reference capability: Serve's gRPC proxy alongside HTTP
+(reference: serve/_private/proxy.py:530 gRPCProxy, serve/grpc_util.py) —
+typed binary calls into deployments without HTTP framing overhead.
+
+TPU build: the framed message protocol (protocol.py) doubles as the wire
+format — one `RPCProxyActor` per cluster accepts TCP connections carrying
+{"app", "method", "payload"} frames, routes through the same
+DeploymentHandle plane as HTTP, and streams multi-part responses for
+generator endpoints. `RPCClient` is the matching client stub.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+import ray_tpu
+from ray_tpu._private.protocol import (
+    ConnectionClosed,
+    MsgConnection,
+    connect_tcp,
+    listen_tcp,
+)
+
+
+@ray_tpu.remote
+class RPCProxyActor:
+    """(reference: proxy.py gRPCProxy — one per node; here one per cluster,
+    num_cpus=0 so it never competes with replicas.)"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.sock = listen_tcp(host, port)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="rpc-ingress")
+        self._thread.start()
+
+    def address(self) -> tuple:
+        import socket as _socket
+
+        return (_socket.gethostbyname(_socket.gethostname())
+                if False else "127.0.0.1", self.port)
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                raw, _ = self.sock.accept()
+            except OSError:
+                return
+            conn = MsgConnection(raw)
+            threading.Thread(target=self._serve, args=(conn,), daemon=True,
+                             name="rpc-conn").start()
+
+    def _serve(self, conn: MsgConnection):
+        from ray_tpu.serve.api import get_app_handle
+
+        try:
+            while True:
+                msg = conn.recv()
+                rid = msg.get("rid")
+                try:
+                    handle = get_app_handle(msg.get("app") or "default")
+                    if msg.get("method"):
+                        handle = getattr(handle, msg["method"])
+                    payload = pickle.loads(msg["payload"])
+                    if msg.get("stream"):
+                        for item in handle.options(stream=True).remote(payload):
+                            conn.send({"rid": rid, "chunk": pickle.dumps(item)})
+                        conn.send({"rid": rid, "done": True})
+                    else:
+                        result = handle.remote(payload).result(timeout_s=120)
+                        conn.send({"rid": rid, "ok": True,
+                                   "payload": pickle.dumps(result)})
+                except ConnectionClosed:
+                    raise
+                except Exception as e:  # noqa: BLE001 — surface to the caller
+                    try:
+                        conn.send({"rid": rid, "ok": False, "error": repr(e)})
+                    except ConnectionClosed:
+                        raise
+        except ConnectionClosed:
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Client stub for the RPC ingress (reference: the generated gRPC stubs
+    over serve's RayServeAPIService)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._conn = connect_tcp(host, int(port), timeout=timeout)
+        self._rid = 0
+        self._lock = threading.Lock()
+
+    def call(self, data, *, app: str = "default", method: str | None = None):
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._conn.send({"rid": rid, "app": app, "method": method,
+                             "payload": pickle.dumps(data)})
+            reply = self._conn.recv()
+        if not reply.get("ok"):
+            raise RuntimeError(f"rpc call failed: {reply.get('error')}")
+        return pickle.loads(reply["payload"])
+
+    def stream(self, data, *, app: str = "default", method: str | None = None):
+        """Yield streamed chunks from a generator endpoint."""
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._conn.send({"rid": rid, "app": app, "method": method,
+                             "payload": pickle.dumps(data), "stream": True})
+            while True:
+                reply = self._conn.recv()
+                if reply.get("done"):
+                    return
+                if "error" in reply:
+                    raise RuntimeError(f"rpc stream failed: {reply['error']}")
+                yield pickle.loads(reply["chunk"])
+
+    def close(self):
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
+    """Start (or return) the cluster's RPC ingress actor; returns
+    (actor_handle, (host, port))."""
+    proxy = RPCProxyActor.options(num_cpus=0, max_concurrency=32).remote(
+        host, port)
+    addr = ray_tpu.get(proxy.address.remote())
+    return proxy, addr

@@ -129,3 +129,34 @@ def test_prefix_tree_unit():
     t.drop_replica("r1")
     _, rep = t.match("hello world")
     assert rep != "r1"
+
+
+def test_rpc_ingress_unary_and_stream(serve_session):
+    """Binary RPC ingress (the gRPC-equivalent data plane): unary calls and
+    streamed generator responses (reference: serve gRPC proxy, proxy.py:530)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.rpc_ingress import RPCClient, start_rpc_ingress
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                def gen():
+                    for i in range(payload["n"]):
+                        yield {"i": i}
+                return gen()
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), name="rpc_echo")
+    proxy, (host, port) = start_rpc_ingress()
+    client = RPCClient(host, port)
+    try:
+        out = client.call({"x": 41}, app="rpc_echo")
+        assert out == {"echo": {"x": 41}}
+        chunks = list(client.stream({"stream": True, "n": 4}, app="rpc_echo"))
+        assert chunks == [{"i": i} for i in range(4)]
+        with pytest.raises(RuntimeError, match="rpc call failed"):
+            client.call({"x": 1}, app="nonexistent_app")
+    finally:
+        client.close()
+        serve.delete("rpc_echo")
