@@ -32,6 +32,7 @@ class RPCProxyActor:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.sock = listen_tcp(host, port)
+        self.host = host
         self.port = self.sock.getsockname()[1]
         self._stop = False
         self._thread = threading.Thread(target=self._accept_loop,
@@ -39,10 +40,11 @@ class RPCProxyActor:
         self._thread.start()
 
     def address(self) -> tuple:
-        import socket as _socket
+        if self.host == "0.0.0.0":
+            import socket as _socket
 
-        return (_socket.gethostbyname(_socket.gethostname())
-                if False else "127.0.0.1", self.port)
+            return (_socket.gethostbyname(_socket.gethostname()), self.port)
+        return (self.host, self.port)
 
     def _accept_loop(self):
         while not self._stop:
@@ -105,11 +107,20 @@ class RPCClient:
         self._conn = connect_tcp(host, int(port), timeout=timeout)
         self._rid = 0
         self._lock = threading.Lock()
+        self._streaming = False  # a framed stream owns the connection
+
+    def _begin(self) -> int:
+        if self._streaming:
+            raise RuntimeError(
+                "an in-progress stream owns this RPCClient connection; "
+                "exhaust or close() the stream generator first (or use a "
+                "second RPCClient for concurrent calls)")
+        self._rid += 1
+        return self._rid
 
     def call(self, data, *, app: str = "default", method: str | None = None):
         with self._lock:
-            self._rid += 1
-            rid = self._rid
+            rid = self._begin()
             self._conn.send({"rid": rid, "app": app, "method": method,
                              "payload": pickle.dumps(data)})
             reply = self._conn.recv()
@@ -118,19 +129,37 @@ class RPCClient:
         return pickle.loads(reply["payload"])
 
     def stream(self, data, *, app: str = "default", method: str | None = None):
-        """Yield streamed chunks from a generator endpoint."""
+        """Yield streamed chunks from a generator endpoint. The connection
+        is owned by the stream until it finishes: an abandoned generator
+        drains the remaining frames on close so later calls never read
+        stale chunks (framed protocol = strictly serial per connection)."""
         with self._lock:
-            self._rid += 1
-            rid = self._rid
+            rid = self._begin()
+            self._streaming = True
             self._conn.send({"rid": rid, "app": app, "method": method,
                              "payload": pickle.dumps(data), "stream": True})
+        done = False
+        try:
             while True:
                 reply = self._conn.recv()
                 if reply.get("done"):
+                    done = True
                     return
                 if "error" in reply:
+                    done = True  # server sent no further frames
                     raise RuntimeError(f"rpc stream failed: {reply['error']}")
                 yield pickle.loads(reply["chunk"])
+        finally:
+            if not done:
+                # abandoned mid-stream: drain to the end marker
+                try:
+                    while True:
+                        reply = self._conn.recv()
+                        if reply.get("done") or "error" in reply:
+                            break
+                except ConnectionClosed:
+                    pass
+            self._streaming = False
 
     def close(self):
         try:
@@ -139,10 +168,20 @@ class RPCClient:
             pass
 
 
+_INGRESS_NAME = "_serve_rpc_ingress"
+
+
 def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
     """Start (or return) the cluster's RPC ingress actor; returns
-    (actor_handle, (host, port))."""
-    proxy = RPCProxyActor.options(num_cpus=0, max_concurrency=32).remote(
-        host, port)
+    (actor_handle, (host, port)). One per cluster, by name."""
+    try:
+        proxy = ray_tpu.get_actor(_INGRESS_NAME)
+    except ValueError:
+        try:
+            proxy = RPCProxyActor.options(
+                name=_INGRESS_NAME, num_cpus=0,
+                max_concurrency=32).remote(host, port)
+        except ValueError:
+            proxy = ray_tpu.get_actor(_INGRESS_NAME)  # lost the create race
     addr = ray_tpu.get(proxy.address.remote())
     return proxy, addr

@@ -160,3 +160,37 @@ def test_rpc_ingress_unary_and_stream(serve_session):
     finally:
         client.close()
         serve.delete("rpc_echo")
+
+
+def test_rpc_ingress_abandoned_stream_and_singleton(serve_session):
+    """An abandoned stream generator must not desync the framed connection;
+    start_rpc_ingress returns the same named actor on repeat calls."""
+    from ray_tpu import serve
+    from ray_tpu.serve.rpc_ingress import RPCClient, start_rpc_ingress
+
+    @serve.deployment
+    class Gen:
+        def __call__(self, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                def gen():
+                    for i in range(10):
+                        yield i
+                return gen()
+            return "unary"
+
+    serve.run(Gen.bind(), name="rpc_gen")
+    proxy1, addr1 = start_rpc_ingress()
+    proxy2, addr2 = start_rpc_ingress()
+    assert addr1 == addr2, "repeat start must return the shared ingress"
+    client = RPCClient(*addr1)
+    try:
+        g = client.stream({"stream": True}, app="rpc_gen")
+        assert next(g) == 0
+        g.close()  # abandon mid-stream: client must drain the frames
+        # the connection still works for subsequent calls
+        assert client.call({"x": 1}, app="rpc_gen") == "unary"
+        chunks = list(client.stream({"stream": True}, app="rpc_gen"))
+        assert chunks == list(range(10))
+    finally:
+        client.close()
+        serve.delete("rpc_gen")
