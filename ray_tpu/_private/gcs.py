@@ -280,6 +280,20 @@ class GcsServer:
         while not self.stopped:
             time.sleep(period)
             now = time.monotonic()
+            # expire parked relay waiters (stack dumps / tensor exports) so
+            # a worker wedged in native code can't hang the requester forever
+            with self.lock:
+                expired = [(tok, w) for tok, w in self._tensor_exports.items()
+                           if now - w[3] > 30.0]
+                for tok, _ in expired:
+                    self._tensor_exports.pop(tok, None)
+            for _, (wconn, wrid, *_rest) in expired:
+                try:
+                    wconn.send({"rid": wrid, "ok": False,
+                                "error": "target did not answer within 30s "
+                                         "(wedged in native code?)"})
+                except ConnectionClosed:
+                    pass
             dead_hosts = []
             with self.lock:
                 targets = [(hid, info) for hid, info in self.hosts.items()
@@ -781,6 +795,66 @@ class GcsServer:
                     "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
                 }
             conn.send({"rid": msg["rid"], "demand": state})
+        elif t == "worker_stacks":
+            # live thread stacks of one worker process (reference:
+            # dashboard/modules/reporter on-demand profiling)
+            with self.lock:
+                target = self.workers.get(msg["wid"])
+                if target is not None and not target.dead:
+                    token = f"st-{msg['rid']}-{id(conn) & 0xffffff}"
+                    self._tensor_exports[token] = (conn, msg["rid"], msg["wid"],
+                                                   time.monotonic())
+                else:
+                    target = None
+            if target is None:
+                conn.send({"rid": msg["rid"], "ok": False,
+                           "error": "no such live worker"})
+            else:
+                try:
+                    target.conn.send({"type": "dump_stacks", "token": token})
+                except ConnectionClosed:
+                    with self.lock:
+                        self._tensor_exports.pop(token, None)
+                    conn.send({"rid": msg["rid"], "ok": False,
+                               "error": "worker connection lost"})
+        elif t == "stacks_reply":
+            with self.lock:
+                waiter = self._tensor_exports.pop(msg["token"], None)
+            if waiter is not None:
+                try:
+                    waiter[0].send({"rid": waiter[1], "ok": True,
+                                    "stacks": msg.get("text", "")})
+                except ConnectionClosed:
+                    pass
+        elif t == "list_objects":
+            # object-directory summary (reference: `ray list objects`,
+            # util/state/state_cli.py backed by GCS/raylet introspection)
+            import itertools as _it
+
+            limit = int(msg.get("limit", 1000))
+            with self.lock:
+                total = len(self.objects)
+                rows = []
+                for oid, e in _it.islice(self.objects.items(), limit):
+                    rows.append({
+                        "object_id": oid, "status": e.get("status"),
+                        "where": e.get("where"), "size": e.get("size", 0),
+                        "ref_count": e.get("count", 0),
+                        "sys_holds": e.get("sys", 0),
+                        "pinned": bool(e.get("pinned")),
+                        "hosts": sorted(e.get("hosts", ())),
+                    })
+            conn.send({"rid": msg["rid"], "objects": rows, "total": total,
+                       "truncated": total > limit})
+        elif t == "list_workers":
+            with self.lock:
+                rows = [{"wid": w.wid, "pid": w.pid, "kind": w.kind,
+                         "node_id": w.node_id, "host": w.host_id,
+                         "dead": w.dead, "idle": w.idle,
+                         "actor_id": w.actor_id,
+                         "tpu_chips": list(w.tpu_chips)}
+                        for w in self.workers.values()]
+            conn.send({"rid": msg["rid"], "workers": rows})
         elif t == "export_tensor":
             # RDT cross-process fetch: relay to the owner worker and park
             # the requester until export_tensor_done (reference: RDT
@@ -792,7 +866,8 @@ class GcsServer:
                 else:
                     token = f"tx-{msg['rid']}-{id(conn) & 0xffffff}"
                     self._tensor_exports[token] = (conn, msg["rid"],
-                                                   msg["owner_wid"])
+                                                   msg["owner_wid"],
+                                                   time.monotonic())
             if owner is None:
                 conn.send({"rid": msg["rid"], "ok": False,
                            "error": "owner process is gone"})
@@ -2127,7 +2202,7 @@ class GcsServer:
                 driver_death = True
             else:
                 driver_death = False
-        for _, (rconn, rrid, _owner) in stale_exports:
+        for _, (rconn, rrid, _owner, _ts) in stale_exports:
             try:
                 rconn.send({"rid": rrid, "ok": False,
                             "error": "owner process died during export"})

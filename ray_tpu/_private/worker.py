@@ -388,6 +388,23 @@ class CoreWorker:
                     ev = self._stream_events.get(tid)
                     if ev is not None:
                         ev.set()
+                elif msg.get("type") == "dump_stacks":
+                    # on-demand live inspection (reference capability:
+                    # dashboard reporter's py-spy/memray on-demand profiling)
+                    import traceback as _tb
+
+                    frames = sys._current_frames()
+                    names = {t.ident: t.name for t in threading.enumerate()}
+                    parts = []
+                    for tid, frame in frames.items():
+                        parts.append(f"--- thread {names.get(tid, tid)} ---")
+                        parts.append("".join(_tb.format_stack(frame)))
+                    try:
+                        self.send_no_reply({"type": "stacks_reply",
+                                            "token": msg["token"],
+                                            "text": "\n".join(parts)})
+                    except ConnectionClosed:
+                        pass
                 elif msg.get("type") == "free_device_tensors":
                     from ray_tpu.experimental import device_objects
 

@@ -96,6 +96,12 @@ def cmd_list(args):
         elif args.kind == "placement-groups":
             rows_map = c.rpc({"type": "pg_table"})["table"]
             rows = [{"pg_id": k, **v} for k, v in rows_map.items()]
+        elif args.kind == "tasks":
+            rows = c.rpc({"type": "task_events"})["events"]
+        elif args.kind == "objects":
+            rows = c.rpc({"type": "list_objects"})["objects"]
+        elif args.kind == "workers":
+            rows = c.rpc({"type": "list_workers"})["workers"]
         elif args.kind == "jobs":
             keys = c.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
             rows = []
@@ -149,6 +155,34 @@ def _print_tail(f, n_lines: int):
     lines = f.read().decode("utf-8", "replace").splitlines()
     for line in lines[-n_lines:]:
         print(line)
+
+
+def cmd_stack(args):
+    """Dump live thread stacks of a worker (reference capability: dashboard
+    on-demand py-spy profiling of live workers)."""
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        workers = c.rpc({"type": "list_workers"})["workers"]
+        live = [w for w in workers if not w["dead"]]
+        if args.worker is None:
+            for w in live:
+                print(f"{w['wid'][:12]}  pid={w['pid']:<7} kind={w['kind']:<7} "
+                      f"node={w['node_id']} actor={w['actor_id'] or '-'}")
+            return
+        target = next((w for w in live
+                       if w["wid"].startswith(args.worker)
+                       or str(w["pid"]) == args.worker), None)
+        if target is None:
+            print(f"no live worker matching {args.worker!r}", file=sys.stderr)
+            sys.exit(1)
+        reply = c.rpc({"type": "worker_stacks", "wid": target["wid"]})
+        if not reply.get("ok"):
+            print(f"stack dump failed: {reply.get('error')}", file=sys.stderr)
+            sys.exit(1)
+        print(reply["stacks"])
+    finally:
+        c.close()
 
 
 def cmd_start(args):
@@ -272,7 +306,8 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("list", help="list cluster state")
-    sp.add_argument("kind", choices=["nodes", "actors", "placement-groups", "jobs"])
+    sp.add_argument("kind", choices=["nodes", "actors", "placement-groups",
+                                     "jobs", "tasks", "objects", "workers"])
     sp.set_defaults(fn=cmd_list)
 
     sp = sub.add_parser("logs", help="show/tail a process log")
@@ -283,6 +318,10 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="run core runtime microbenchmarks")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("stack", help="live thread stacks of a worker")
+    sp.add_argument("worker", nargs="?", help="wid prefix or pid (omit to list)")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("start", help="start a head session or join as follower")
     sp.add_argument("--head", action="store_true")

@@ -104,6 +104,7 @@ class RPCClient:
     over serve's RayServeAPIService)."""
 
     def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._host, self._port = host, int(port)
         self._conn = connect_tcp(host, int(port), timeout=timeout)
         self._rid = 0
         self._lock = threading.Lock()
@@ -151,14 +152,29 @@ class RPCClient:
                 yield pickle.loads(reply["chunk"])
         finally:
             if not done:
-                # abandoned mid-stream: drain to the end marker
+                # abandoned mid-stream: drain briefly; an unbounded stream
+                # never sends 'done', so past the deadline we RESET the
+                # connection — the server's next send fails and it stops
+                # producing (the cancellation signal)
+                drained = False
                 try:
+                    self._conn.sock.settimeout(2.0)
                     while True:
                         reply = self._conn.recv()
                         if reply.get("done") or "error" in reply:
+                            drained = True
                             break
-                except ConnectionClosed:
+                except (ConnectionClosed, OSError):
                     pass
+                if drained:
+                    self._conn.sock.settimeout(None)
+                else:
+                    try:
+                        self._conn.close()
+                    except Exception:
+                        pass
+                    self._conn = connect_tcp(self._host, self._port,
+                                             timeout=30.0)
             self._streaming = False
 
     def close(self):
@@ -174,6 +190,7 @@ _INGRESS_NAME = "_serve_rpc_ingress"
 def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
     """Start (or return) the cluster's RPC ingress actor; returns
     (actor_handle, (host, port)). One per cluster, by name."""
+    created = False
     try:
         proxy = ray_tpu.get_actor(_INGRESS_NAME)
     except ValueError:
@@ -181,7 +198,13 @@ def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
             proxy = RPCProxyActor.options(
                 name=_INGRESS_NAME, num_cpus=0,
                 max_concurrency=32).remote(host, port)
+            created = True
         except ValueError:
             proxy = ray_tpu.get_actor(_INGRESS_NAME)  # lost the create race
     addr = ray_tpu.get(proxy.address.remote())
+    if not created and ((host not in ("127.0.0.1", addr[0]))
+                        or (port not in (0, addr[1]))):
+        raise ValueError(
+            f"RPC ingress already running at {addr[0]}:{addr[1]}; cannot "
+            f"rebind to {host}:{port} (stop the existing ingress first)")
     return proxy, addr

@@ -138,3 +138,55 @@ def test_job_stop(session):
         time.sleep(0.1)
     client.stop_job(job_id)
     assert client.wait_until_finished(job_id, timeout=30) == "STOPPED"
+
+
+def test_worker_stack_dump(session):
+    """On-demand live thread stacks from a worker through the control plane
+    (reference capability: dashboard reporter py-spy profiling)."""
+    import time
+
+    from ray_tpu._private import api as _api
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self):
+            time.sleep(5)
+            return "done"
+
+    s = Sleeper.remote()
+    ref = s.nap.remote()
+    time.sleep(0.5)  # ensure the method is mid-sleep
+    w = _api._worker
+    workers = w.rpc({"type": "list_workers"})["workers"]
+    target = next(x for x in workers if x["actor_id"])
+    reply = w.rpc({"type": "worker_stacks", "wid": target["wid"]})
+    assert reply["ok"], reply
+    assert "nap" in reply["stacks"] or "sleep" in reply["stacks"]
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    # dead-worker error path
+    bad = w.rpc({"type": "worker_stacks", "wid": "nonexistent"})
+    assert not bad.get("ok")
+
+
+def test_cli_list_tasks_objects_workers(session):
+    """State API breadth: `ray_tpu list tasks|objects|workers`
+    (reference: util/state/state_cli.py `ray list`)."""
+    import json as _json
+
+    import numpy as np
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1), timeout=30) == 2
+    big = ray_tpu.put(np.zeros(300_000))
+    sd = session["session_dir"]
+    out = _run_cli(["--session", sd, "list", "objects"])
+    rows = _json.loads(out)
+    assert any(r["object_id"] == big.hex() for r in rows)
+    out = _run_cli(["--session", sd, "list", "workers"])
+    assert any(w["kind"] == "driver" for w in _json.loads(out))
+    out = _run_cli(["--session", sd, "list", "tasks"])
+    assert isinstance(_json.loads(out), list)
+    del big

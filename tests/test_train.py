@@ -206,3 +206,50 @@ def test_checkpoint_manager_retention(tmp_path):
     assert mgr.latest_checkpoint.path == paths[3]  # resume point retained
     assert not os.path.exists(paths[0])  # worst + stale deleted from disk
     assert mgr.best_checkpoint.path == paths[1]
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_torch_backend_gloo_allreduce(ray_train_cluster, tmp_path):
+    """TorchConfig forms a gloo process group across train workers; a torch
+    all_reduce across ranks proves the group is real (reference:
+    train/torch/config.py:122 init_process_group)."""
+    from ray_tpu import train
+    from ray_tpu.train import (
+        DataParallelTrainer,
+        RunConfig,
+        ScalingConfig,
+        TorchConfig,
+    )
+
+    pytest.importorskip("torch")
+
+    def train_fn(config):
+        import torch
+        import torch.distributed as dist
+
+        ctx = train.get_context()
+        t = torch.ones(4) * (ctx.get_world_rank() + 1)
+        if dist.is_initialized():
+            dist.all_reduce(t)  # sum over 2 ranks: (1 + 2) * ones
+        train.report({"sum0": float(t[0]),
+                      "initialized": dist.is_initialized()})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch_gloo"),
+        backend_config=TorchConfig(init_port=_free_port()),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["initialized"] is True
+    assert result.metrics["sum0"] == 3.0
