@@ -1546,9 +1546,21 @@ class GcsServer:
                 return
             self._try_place_pgs_locked()
             idle_by_node: dict[str, list[_Worker]] = collections.defaultdict(list)
+            n_alive = 0
             for w in self.workers.values():
-                if w.kind == "worker" and w.idle and not w.dead and w.actor_id is None:
-                    idle_by_node[w.node_id].append(w)
+                if w.kind == "worker" and not w.dead:
+                    n_alive += 1
+                    if w.idle and w.actor_id is None:
+                        idle_by_node[w.node_id].append(w)
+            # scalability early-exit (reference envelope: 1M queued tasks on
+            # a node — BASELINE.md): when nothing can possibly dispatch (no
+            # idle worker) and nothing can spawn (no headroom), scanning the
+            # whole pending queue per event would make submission O(queue²).
+            # Actor METHOD dispatch doesn't need idle workers, so that loop
+            # still runs below.
+            spawning_now = sum(len(dq) for dq in self._spawn_pending.values())
+            can_place = (any(idle_by_node.values())
+                         or self.max_workers - n_alive - spawning_now > 0)
 
             def dispatch(spec) -> bool:
                 node_id = self._fits_for(spec)
@@ -1576,24 +1588,41 @@ class GcsServer:
                 to_send.append((w.conn, {"type": "exec", "spec": spec}))
                 return True
 
-            # actor creations first (they pin workers)
-            still_pending = collections.deque()
-            while self.pending_actor_creations:
-                spec = self.pending_actor_creations.popleft()
-                actor = self.actors.get(spec["actor_id"])
-                if actor is None or actor.state == "dead":
-                    continue
-                if not dispatch(spec):
-                    still_pending.append(spec)
-            self.pending_actor_creations = still_pending
+            if can_place:
+                # bounded scan: mostly-FIFO dispatch that gives up after a
+                # run of consecutive non-dispatchable specs — per-event work
+                # stays O(idle + K) instead of O(queue), which is what keeps
+                # deep queues (reference envelope: 1M pending) from turning
+                # every completion into a full rescan. K>1 so heterogeneous
+                # resource shapes behind a stuck head still make progress.
+                K = 64
 
-            # normal tasks
-            still = collections.deque()
-            while self.pending_tasks:
-                spec = self.pending_tasks.popleft()
-                if not dispatch(spec):
-                    still.append(spec)
-            self.pending_tasks = still
+                # actor creations first (they pin workers)
+                still_pending = collections.deque()
+                misses = 0
+                while self.pending_actor_creations and misses < K:
+                    spec = self.pending_actor_creations.popleft()
+                    actor = self.actors.get(spec["actor_id"])
+                    if actor is None or actor.state == "dead":
+                        continue
+                    if dispatch(spec):
+                        misses = 0
+                    else:
+                        still_pending.append(spec)
+                        misses += 1
+                self.pending_actor_creations.extendleft(reversed(still_pending))
+
+                # normal tasks
+                still = collections.deque()
+                misses = 0
+                while self.pending_tasks and misses < K:
+                    spec = self.pending_tasks.popleft()
+                    if dispatch(spec):
+                        misses = 0
+                    else:
+                        still.append(spec)
+                        misses += 1
+                self.pending_tasks.extendleft(reversed(still))
 
             # actor method calls (up to max_concurrency in flight per actor)
             for actor in self.actors.values():

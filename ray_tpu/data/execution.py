@@ -53,7 +53,17 @@ def _batches_transform(fn: Callable, batch_size: int | None, batch_format: str,
                        fn_kwargs: dict) -> Callable:
     from ray_tpu.data.block import normalize_block
 
+    # a CLASS fn is a stateful UDF: instantiate lazily, once per process —
+    # expensive setup (model load) happens once per map actor/worker
+    # (reference: ActorPoolMapOperator with callable-class UDFs)
+    is_class_fn = isinstance(fn, type)
+    state: dict = {}
+
     def transform(blocks: list[Block]) -> list[Block]:
+        nonlocal fn
+        if is_class_fn and "inst" not in state:
+            state["inst"] = fn()
+        call = state["inst"] if is_class_fn else fn
         out = []
         for b in _rebatch(blocks, batch_size):
             if batch_format == "pandas":
@@ -62,7 +72,7 @@ def _batches_transform(fn: Callable, batch_size: int | None, batch_format: str,
                 b = BlockAccessor(b).to_arrow()
             else:
                 b = BlockAccessor(b).to_numpy()
-            res = fn(b, **fn_kwargs)
+            res = call(b, **fn_kwargs)
             out.append(normalize_block(res))
         return out
 
@@ -104,6 +114,7 @@ class Stage:
     a2a_refs: Callable | None = None      # distributed barrier: refs -> refs
     resources: dict = field(default_factory=lambda: {"CPU": 1.0})
     max_in_flight: int = 8
+    compute: str = "tasks"  # "tasks" | "actors" (stateful UDF pool)
 
     def run_chain(self, blocks: list[Block]) -> list[Block]:
         for t in self.transforms:
@@ -149,13 +160,16 @@ def build_stages(ops: list[L.LogicalOp], default_parallelism: int) -> list[Stage
             res = {"CPU": op.num_cpus}
             if op.num_tpus:
                 res["TPU"] = op.num_tpus
-            if cur is not None and cur.all_to_all is None and res == cur.resources:
+            if (cur is not None and cur.all_to_all is None
+                    and res == cur.resources
+                    and cur.compute == (op.compute or "tasks")):
                 cur.name += "->MapBatches"
                 cur.transforms.append(t)
             else:
                 flush()
                 cur = Stage(name="MapBatches", transforms=[t], resources=res,
-                            max_in_flight=op.concurrency or 8)
+                            max_in_flight=op.concurrency or 8,
+                            compute=op.compute or "tasks")
         elif isinstance(op, L.MapRows):
             t = _rows_transform(op.fn, op.kind)
             if cur is not None and cur.all_to_all is None:
@@ -399,6 +413,49 @@ def _dist_repartition_refs(k: int):
     return run
 
 
+@ray_tpu.remote
+class _MapPoolActor:
+    """Stateful map worker: holds the stage's transform chain (a callable-
+    class UDF instantiates ONCE here) and applies it per input."""
+
+    def __init__(self, transforms_blob: bytes):
+        from ray_tpu._private import serialization as ser
+
+        self._run = _stage_task(ser.loads(transforms_blob))
+
+    def run(self, payload):
+        return self._run(payload)
+
+
+class _ActorPool:
+    """Round-robin pool exposing the task-API shape (`.remote(payload)`)
+    so the executor dispatch path is compute-agnostic (reference:
+    execution/operators/actor_pool_map_operator.py:47)."""
+
+    def __init__(self, stage: "Stage", size: int):
+        from ray_tpu._private import serialization as ser
+
+        res = stage.resources
+        blob = ser.dumps(stage.transforms)
+        cls = _MapPoolActor.options(
+            num_cpus=res.get("CPU", 1.0),
+            num_tpus=res.get("TPU", 0.0) or None)
+        self.actors = [cls.remote(blob) for _ in range(max(1, int(size)))]
+        self._i = 0
+
+    def remote(self, payload):
+        actor = self.actors[self._i % len(self.actors)]
+        self._i += 1
+        return actor.run.remote(payload)
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
 class StreamingExecutor:
     """Pull-based streaming executor: yields lists of blocks as they finish.
 
@@ -426,14 +483,23 @@ class StreamingExecutor:
     def execute(self) -> Iterator[list]:
         """Yield ObjectRefs of list[Block] results of the final stage."""
         remote_cache: dict[int, Any] = {}
+        actor_pools: list = []
 
         def stage_remote(i: int, stage: Stage):
             if i not in remote_cache:
                 res = stage.resources
-                remote_cache[i] = ray_tpu.remote(
-                    num_cpus=res.get("CPU", 1.0),
-                    num_tpus=res.get("TPU", 0.0) or None,
-                )(_stage_task(stage.transforms))
+                if stage.compute == "actors":
+                    # stateful UDF pool (reference: ActorPoolMapOperator,
+                    # execution/operators/actor_pool_map_operator.py:47):
+                    # one actor per concurrency slot, round-robin dispatch
+                    pool = _ActorPool(stage, size=stage.max_in_flight)
+                    actor_pools.append(pool)
+                    remote_cache[i] = pool
+                else:
+                    remote_cache[i] = ray_tpu.remote(
+                        num_cpus=res.get("CPU", 1.0),
+                        num_tpus=res.get("TPU", 0.0) or None,
+                    )(_stage_task(stage.transforms))
             return remote_cache[i]
 
         # Coalesce [source(+fused maps)] [a2a] [maps] ... into pipeline phases.
@@ -545,17 +611,21 @@ class StreamingExecutor:
                     and all(a2a_done[i] for i, s in enumerate(rest) if is_barrier(s)))
 
         idle_spin = 0.0
-        while True:
-            pump()
-            if queues[-1]:
-                while queues[-1]:
-                    yield queues[-1].popleft()
-                idle_spin = 0.0
-                continue
-            if all_done():
-                return
-            time.sleep(min(0.05, 0.001 + idle_spin))
-            idle_spin = min(0.05, idle_spin + 0.002)
+        try:
+            while True:
+                pump()
+                if queues[-1]:
+                    while queues[-1]:
+                        yield queues[-1].popleft()
+                    idle_spin = 0.0
+                    continue
+                if all_done():
+                    return
+                time.sleep(min(0.05, 0.001 + idle_spin))
+                idle_spin = min(0.05, idle_spin + 0.002)
+        finally:
+            for pool in actor_pools:
+                pool.shutdown()
 
 
 def iter_result_blocks(stages: list[Stage]) -> Iterator[Block]:

@@ -197,3 +197,33 @@ def test_backpressure_bounded_inflight(ray_session):
         for b in got if isinstance(got, list) else [got]:
             total += BlockAccessor(b).num_rows()
     assert total == 2000
+
+
+def test_map_batches_actor_pool_stateful(ray_session):
+    """compute="actors": a callable-class UDF instantiates once per pool
+    actor — expensive setup is amortized across batches (reference:
+    ActorPoolMapOperator, actor_pool_map_operator.py:47)."""
+    import os
+
+    import numpy as np
+
+    import ray_tpu.data as rtd
+
+    class AddPid:
+        def __init__(self):
+            self.pid = os.getpid()  # once per actor
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"], "pid": np.full(len(batch["id"]), self.pid),
+                    "call": np.full(len(batch["id"]), self.calls)}
+
+    ds = (rtd.range(64)
+          .map_batches(AddPid, batch_size=8, compute="actors", concurrency=2))
+    rows = list(ds.iter_rows())
+    assert len(rows) == 64
+    pids = {r["pid"] for r in rows}
+    assert 1 <= len(pids) <= 2, f"expected <=2 pool actors, saw pids {pids}"
+    # statefulness: calls increments across batches within one actor
+    assert max(r["call"] for r in rows) > 1
