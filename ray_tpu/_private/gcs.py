@@ -1552,6 +1552,22 @@ class GcsServer:
                     n_alive += 1
                     if w.idle and w.actor_id is None:
                         idle_by_node[w.node_id].append(w)
+            # purge timed-out spawn requests FIRST: a silently failed spawn
+            # must free its headroom before the feasibility decision below,
+            # or it suppresses both scanning and respawn until an unrelated
+            # event
+            now = time.monotonic()
+            for node_id_, dq in self._spawn_pending.items():
+                while dq:
+                    ts_, chips_, _rh_ = dq[0]
+                    limit_ = CHIP_SPAWN_TIMEOUT_S if chips_ else SPAWN_TIMEOUT_S
+                    if now - ts_ <= limit_:
+                        break
+                    dq.popleft()  # spawn presumed failed; allow retry
+                    if chips_:
+                        node_ = self.nodes.get(node_id_)
+                        if node_ is not None and node_.alive:
+                            node_.chip_pool.extend(chips_)
             # scalability early-exit (reference envelope: 1M queued tasks on
             # a node — BASELINE.md): when nothing can possibly dispatch (no
             # idle worker) and nothing can spawn (no headroom), scanning the
@@ -1597,10 +1613,18 @@ class GcsServer:
                 # resource shapes behind a stuck head still make progress.
                 K = 64
 
+                def keep_scanning(misses: int) -> bool:
+                    # the miss cap bounds work only once every idle worker is
+                    # consumed — while one remains, a dispatchable spec may
+                    # sit deeper in the queue behind infeasible/dep-waiting
+                    # heads, and capping would starve it forever
+                    return (misses < K
+                            or any(idle_by_node.get(n) for n in idle_by_node))
+
                 # actor creations first (they pin workers)
                 still_pending = collections.deque()
                 misses = 0
-                while self.pending_actor_creations and misses < K:
+                while self.pending_actor_creations and keep_scanning(misses):
                     spec = self.pending_actor_creations.popleft()
                     actor = self.actors.get(spec["actor_id"])
                     if actor is None or actor.state == "dead":
@@ -1615,7 +1639,7 @@ class GcsServer:
                 # normal tasks
                 still = collections.deque()
                 misses = 0
-                while self.pending_tasks and misses < K:
+                while self.pending_tasks and keep_scanning(misses):
                     spec = self.pending_tasks.popleft()
                     if dispatch(spec):
                         misses = 0
@@ -1637,21 +1661,10 @@ class GcsServer:
                     to_send.append((w.conn, {"type": "exec", "spec": spec}))
 
             # scale-up: runnable-if-only-there-were-workers, per (node, chips)
+            # (stale spawn requests were purged at the top of this pass)
             now = time.monotonic()
             n_workers = sum(1 for w in self.workers.values() if w.kind == "worker" and not w.dead)
-            spawning_total = 0
-            for node_id, dq in self._spawn_pending.items():
-                while dq:
-                    ts, chips, _rh = dq[0]
-                    limit = CHIP_SPAWN_TIMEOUT_S if chips else SPAWN_TIMEOUT_S
-                    if now - ts <= limit:
-                        break
-                    dq.popleft()  # spawn presumed failed; allow retry
-                    if chips:
-                        node = self.nodes.get(node_id)
-                        if node is not None and node.alive:
-                            node.chip_pool.extend(chips)
-                spawning_total += len(dq)
+            spawning_total = sum(len(dq) for dq in self._spawn_pending.values())
             spawn_plan: list[tuple[str, list]] = []  # node_id, [chips|None per worker]
             reclaim: list[_Worker] = []
             headroom = self.max_workers - n_workers - spawning_total

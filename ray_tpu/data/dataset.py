@@ -56,6 +56,9 @@ class Dataset:
                     batch_format: str = "numpy", fn_kwargs: dict | None = None,
                     num_cpus: float = 1.0, num_tpus: float = 0.0,
                     concurrency: int | None = None, compute: str = "tasks") -> "Dataset":
+        if compute not in ("tasks", "actors"):
+            raise ValueError(
+                f"compute must be 'tasks' or 'actors', got {compute!r}")
         return self._append(L.MapBatches(
             fn, batch_size=batch_size, batch_format=batch_format,
             fn_kwargs=fn_kwargs or {}, num_cpus=num_cpus, num_tpus=num_tpus,

@@ -60,7 +60,6 @@ def _batches_transform(fn: Callable, batch_size: int | None, batch_format: str,
     state: dict = {}
 
     def transform(blocks: list[Block]) -> list[Block]:
-        nonlocal fn
         if is_class_fn and "inst" not in state:
             state["inst"] = fn()
         call = state["inst"] if is_class_fn else fn

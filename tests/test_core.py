@@ -241,3 +241,23 @@ def test_get_total_deadline(ray_start_regular):
     with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
         ray_tpu.get(refs, timeout=2)
     assert time.monotonic() - t0 < 5  # total deadline, not per-ref
+
+
+def test_dispatchable_task_behind_infeasible_queue(ray_start_regular):
+    """A feasible task queued behind >64 forever-infeasible specs must still
+    dispatch (bounded scheduler scans must not starve deep entries)."""
+    refs_infeasible = []
+
+    @ray_tpu.remote(num_tpus=1)
+    def needs_tpu():
+        return "tpu"
+
+    @ray_tpu.remote
+    def cpu_task():
+        return "ok"
+
+    # no TPU resource in this session: these queue forever
+    refs_infeasible = [needs_tpu.remote() for _ in range(80)]
+    ref = cpu_task.remote()
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+    del refs_infeasible
