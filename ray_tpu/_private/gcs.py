@@ -1613,40 +1613,47 @@ class GcsServer:
                 # resource shapes behind a stuck head still make progress.
                 K = 64
 
+                # liveness vs bound: while idle workers remain, scan deeper
+                # (up to K_IDLE) so dispatchable specs behind stuck heads are
+                # reached; if we STILL stop early with idle workers left, the
+                # scanned misses rotate to the tail so successive events make
+                # eventual progress through the whole queue instead of
+                # re-hitting the same head forever. O(1) idle tracking: a
+                # counter decremented where dispatch consumes a worker.
+                K_IDLE = 1024
+                idle_left = sum(len(v) for v in idle_by_node.values())
+
                 def keep_scanning(misses: int) -> bool:
-                    # the miss cap bounds work only once every idle worker is
-                    # consumed — while one remains, a dispatchable spec may
-                    # sit deeper in the queue behind infeasible/dep-waiting
-                    # heads, and capping would starve it forever
-                    return (misses < K
-                            or any(idle_by_node.get(n) for n in idle_by_node))
+                    if misses < K:
+                        return True
+                    return idle_left > 0 and misses < K_IDLE
+
+                def scan(queue: collections.deque, skip=None) -> None:
+                    nonlocal idle_left
+                    still = collections.deque()
+                    misses = 0
+                    while queue and keep_scanning(misses):
+                        spec = queue.popleft()
+                        if skip is not None and skip(spec):
+                            continue
+                        if dispatch(spec):
+                            idle_left -= 1  # creations/tasks consume a worker
+                            misses = 0
+                        else:
+                            still.append(spec)
+                            misses += 1
+                    if still and queue and idle_left > 0:
+                        queue.extend(still)  # rotate: different specs next event
+                    else:
+                        queue.extendleft(reversed(still))
 
                 # actor creations first (they pin workers)
-                still_pending = collections.deque()
-                misses = 0
-                while self.pending_actor_creations and keep_scanning(misses):
-                    spec = self.pending_actor_creations.popleft()
+                def _dead_actor(spec):
                     actor = self.actors.get(spec["actor_id"])
-                    if actor is None or actor.state == "dead":
-                        continue
-                    if dispatch(spec):
-                        misses = 0
-                    else:
-                        still_pending.append(spec)
-                        misses += 1
-                self.pending_actor_creations.extendleft(reversed(still_pending))
+                    return actor is None or actor.state == "dead"
 
-                # normal tasks
-                still = collections.deque()
-                misses = 0
-                while self.pending_tasks and keep_scanning(misses):
-                    spec = self.pending_tasks.popleft()
-                    if dispatch(spec):
-                        misses = 0
-                    else:
-                        still.append(spec)
-                        misses += 1
-                self.pending_tasks.extendleft(reversed(still))
+                scan(self.pending_actor_creations, skip=_dead_actor)
+                scan(self.pending_tasks)
 
             # actor method calls (up to max_concurrency in flight per actor)
             for actor in self.actors.values():

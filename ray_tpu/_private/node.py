@@ -60,9 +60,9 @@ class Node:
         # the head host's object-plane server: follower hosts pull shm
         # objects from here (and vice versa) over chunked TCP
         from ray_tpu._private.object_store import make_object_store
-        from ray_tpu._private.object_transfer import ObjectPlaneServer
+        from ray_tpu._private.object_transfer import make_object_server
 
-        self.object_server = ObjectPlaneServer(make_object_store(self.session_id))
+        self.object_server = make_object_server(make_object_store(self.session_id))
         self.gcs.set_head_object_addr(self.object_server.address)
         # cross-host control-plane address (follower agents, remote drivers)
         self.address = f"127.0.0.1:{self.gcs.tcp_port}"

@@ -25,7 +25,7 @@ import uuid
 from ray_tpu._private import accelerators
 from ray_tpu._private.log_monitor import LogMonitor
 from ray_tpu._private.object_store import make_object_store
-from ray_tpu._private.object_transfer import ObjectPlaneServer
+from ray_tpu._private.object_transfer import make_object_server
 from ray_tpu._private.protocol import ConnectionClosed, connect_address
 
 
@@ -47,7 +47,7 @@ class NodeAgent:
         # free; on one machine the namespace keeps the stores honest-disjoint)
         self.store_ns = f"{self.session_id}_{self.host_id}"
         self.store = make_object_store(self.store_ns)
-        self.obj_server = ObjectPlaneServer(self.store)
+        self.obj_server = make_object_server(self.store)
 
         base = session_dir or os.path.join("/tmp", "ray_tpu")
         self.session_dir = os.path.join(

@@ -29,6 +29,23 @@ from ray_tpu._private.ray_config import RayConfig as _RayConfig
 CHUNK = _RayConfig.get("object_transfer_chunk")
 
 
+def make_object_server(store, host: str | None = None):
+    """Backend selector: RAY_TPU_OBJECT_SERVER_BACKEND=native runs the C++
+    server (cpp/object_server.cc) for file-backed stores; default is the
+    in-process Python server below."""
+    from ray_tpu._private.ray_config import RayConfig
+
+    if RayConfig.get("object_server_backend") == "native":
+        from ray_tpu._private.native_object_server import NativeObjectServer
+        from ray_tpu._private.object_store import ShmObjectStore
+
+        if isinstance(store, ShmObjectStore):
+            return NativeObjectServer(store, host)
+        logger.warning("native object server needs the file store backend; "
+                       "falling back to the python server")
+    return ObjectPlaneServer(store, host)
+
+
 class ObjectPlaneServer:
     """Serves local shm objects to other hosts. One thread per connection
     (an agent/worker keeps its connection open and pipelines fetches)."""
@@ -166,6 +183,14 @@ class ObjectFetcher:
             return self._fetch_conversation(oid, address)
 
     def _fetch_conversation(self, oid: str, address: str) -> bool:
+        if address.startswith("native:"):
+            # remote host runs the C++ server: binary codec, one connection
+            # per fetch (the server is cheap-threaded; keep the client simple)
+            from ray_tpu._private.native_object_server import fetch_native
+
+            host, _, port = address[len("native:"):].rpartition(":")
+            return fetch_native(self.store, oid, host or "127.0.0.1",
+                                int(port))
         try:
             conn = self._conn(address)
             conn.send({"type": "fetch", "oid": oid})

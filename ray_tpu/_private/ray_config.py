@@ -46,6 +46,10 @@ class RayConfig:
     inline_object_limit: int = 64 * 1024
     # Chunk size for cross-host object pulls.
     object_transfer_chunk: int = 5 * 1024 * 1024
+    # Object-plane server: "python" (framed MsgConnection) or "native"
+    # (C++ cpp/object_server.cc — zero Python on the transfer hot path;
+    # file-backed store only).
+    object_server_backend: str = "python"
 
     # --- core worker ----------------------------------------------------
     # Distributed reference counting on ObjectRef drop (0 = manual free()).
