@@ -33,6 +33,7 @@ from ray_tpu.train.spmd import (
 from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
+    TorchTrainer,
     Result,
     TrainingFailedError,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "FailureConfig",
     "JaxConfig",
     "JaxTrainer",
+    "TorchTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",

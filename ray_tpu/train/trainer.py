@@ -78,6 +78,22 @@ class DataParallelTrainer:
         return result
 
 
+class TorchTrainer(DataParallelTrainer):
+    """(reference: train/torch/torch_trainer.py — DataParallelTrainer with
+    TorchConfig; CPU gloo process groups here — device tensors belong to the
+    JAX/XLA path on TPU, see JaxTrainer.)"""
+
+    def __init__(self, train_loop_per_worker, *,
+                 torch_config: "TorchConfig | None" = None,
+                 scaling_config: ScalingConfig | None = None, **kwargs):
+        from ray_tpu.train.backend import TorchConfig
+
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         scaling_config=scaling_config or ScalingConfig(),
+                         **kwargs)
+
+
 class JaxTrainer(DataParallelTrainer):
     """(reference: train/v2/jax/jax_trainer.py:19 — DataParallelTrainer with
     JaxConfig; on TPU each worker is one host of the slice and in-program

@@ -243,11 +243,13 @@ def test_torch_backend_gloo_allreduce(ray_train_cluster, tmp_path):
         train.report({"sum0": float(t[0]),
                       "initialized": dist.is_initialized()})
 
-    trainer = DataParallelTrainer(
+    from ray_tpu.train import TorchTrainer
+
+    trainer = TorchTrainer(
         train_fn,
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="torch_gloo"),
-        backend_config=TorchConfig(init_port=_free_port()),
+        torch_config=TorchConfig(init_port=_free_port()),
     )
     result = trainer.fit()
     assert result.error is None
