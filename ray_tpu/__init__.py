@@ -7,6 +7,7 @@ TPU meshes. See SURVEY.md for the reference analysis this build follows.
 
 from ray_tpu._private.api import (
     available_resources,
+    cancel,
     cluster_resources,
     cluster_state,
     free,
@@ -36,6 +37,7 @@ __all__ = [
     "ObjectRef",
     "RemoteFunction",
     "available_resources",
+    "cancel",
     "cluster_resources",
     "cluster_state",
     "exceptions",

@@ -157,6 +157,17 @@ def get_actor(name: str) -> ActorHandle:
     return ActorHandle(aid)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task that produces `ref` (reference: ray.cancel).
+    Queued → dequeued, outputs raise a cancellation error. Running →
+    interrupted only with force=True (the worker process is killed; the
+    task is NOT retried)."""
+    w = _get_worker()
+    if not hasattr(w, "cancel_task"):
+        return False  # local mode runs tasks synchronously
+    return w.cancel_task(ref, force=force)
+
+
 def free(refs: Sequence[ObjectRef]):
     _get_worker().free(refs)
 

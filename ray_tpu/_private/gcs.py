@@ -667,6 +667,45 @@ class GcsServer:
             self._wait_object(conn, msg)
         elif t == "free_objects_async":
             self._free_objects(list(msg["oids"]))
+        elif t == "cancel_task":
+            # reference: ray.cancel (core_worker CancelTask) — a queued task
+            # is dequeued and its outputs fail with TaskCancelledError; a
+            # RUNNING plain task is interrupted only with force=True, by
+            # telling its worker process to die over the worker connection
+            # (host-agnostic, serializes with completion messages — the same
+            # route kill_actor uses). Actor tasks are never force-killed:
+            # that would destroy unrelated callers' state (Ray rejects
+            # force-cancel on actor tasks too).
+            tid = msg["task_id"]
+            cancelled = False
+            die_conn = None
+            with self.lock:
+                before = len(self.pending_tasks)
+                removed = [s for s in self.pending_tasks if s["task_id"] == tid]
+                self.pending_tasks = collections.deque(
+                    s for s in self.pending_tasks if s["task_id"] != tid)
+                cancelled = len(self.pending_tasks) < before
+                for spec in removed:
+                    spec["_cancelled"] = True
+                if not cancelled and msg.get("force"):
+                    for w in self.workers.values():
+                        spec = w.running_tasks.get(tid)
+                        if (spec is not None and not w.dead
+                                and spec["kind"] == "task"):
+                            # never retried, and fails as cancelled
+                            spec["max_retries"] = 0
+                            spec["_cancelled"] = True
+                            die_conn = w.conn
+                            cancelled = True
+                            break
+            for spec in removed:
+                self._fail_task_objects(spec, "task was cancelled")
+            if die_conn is not None:
+                try:
+                    die_conn.send({"type": "die"})
+                except ConnectionClosed:
+                    pass  # already dying; death handler finishes the job
+            conn.send({"rid": msg["rid"], "cancelled": cancelled})
         elif t == "free_objects":
             # manual free: drop entries and every host copy, cascading to
             # nested refs (reference: ray._private.internal_api.free)
@@ -2192,9 +2231,18 @@ class GcsServer:
     def _fail_task_objects(self, spec: dict, reason: str):
         """Mark all return objects of a task as errored (caller holds no lock)."""
         import ray_tpu._private.serialization as ser
-        from ray_tpu.exceptions import WorkerCrashedError, ActorDiedError
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            TaskCancelledError,
+            WorkerCrashedError,
+        )
 
-        exc = ActorDiedError(reason) if spec["kind"] == "actor_task" else WorkerCrashedError(reason)
+        if spec.get("_cancelled"):
+            exc = TaskCancelledError(reason)
+        elif spec["kind"] == "actor_task":
+            exc = ActorDiedError(reason)
+        else:
+            exc = WorkerCrashedError(reason)
         blob = ser.dumps(exc)
         with self.lock:
             free_now = self._sys_hold_locked(spec.pop("_holds", ()), -1)
@@ -2315,7 +2363,9 @@ class GcsServer:
         if death_free:
             self._free_objects(death_free)
         for spec in fail:
-            self._fail_task_objects(spec, f"worker {wid} died")
+            self._fail_task_objects(
+                spec, "task was cancelled" if spec.get("_cancelled")
+                else f"worker {wid} died")
         if requeue is not None:
             with self.lock:
                 self.pending_tasks.appendleft(requeue)

@@ -374,6 +374,10 @@ class CoreWorker:
                     self.exec_queue.put(msg["spec"])
                 elif msg.get("type") == "exit":
                     self.exec_queue.put(None)
+                elif msg.get("type") == "die":
+                    # force-cancel: terminate immediately (reference: force-
+                    # cancelled tasks kill their executor process)
+                    os._exit(1)
                 elif msg.get("type") == "kill_actor":
                     if msg["aid"] in self.actors:
                         os._exit(0)
@@ -829,6 +833,16 @@ class CoreWorker:
         for r in ready:
             self._obj_waits.pop(r.hex(), None)
         return ready, not_ready
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> bool:
+        """Cancel the task producing `ref` (reference: ray.cancel —
+        CoreWorker::CancelTask). Queued tasks are dequeued; running ones are
+        interrupted only with force=True (worker SIGKILL + normal
+        death/retry bookkeeping, with retries suppressed)."""
+        tid = ref.hex()[:-5]  # strip the rNNNN return suffix
+        reply = self.rpc({"type": "cancel_task", "task_id": tid,
+                          "force": force})
+        return bool(reply.get("cancelled"))
 
     def free(self, refs: Sequence[ObjectRef]):
         oids = [r.hex() for r in refs]

@@ -31,6 +31,11 @@ class ActorDiedError(RayTpuError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (reference:
+    ray.exceptions.TaskCancelledError)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

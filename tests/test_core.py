@@ -261,3 +261,46 @@ def test_dispatchable_task_behind_infeasible_queue(ray_start_regular):
     ref = cpu_task.remote()
     assert ray_tpu.get(ref, timeout=60) == "ok"
     del refs_infeasible
+
+
+def test_cancel_queued_task(ray_start_regular):
+    """ray_tpu.cancel dequeues a pending task; its output raises
+    (reference: ray.cancel on a queued task)."""
+    import time
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(30)
+
+    @ray_tpu.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    h = hog.remote()
+    time.sleep(0.5)
+    ref = queued.remote()  # can't start: hog holds all CPUs
+    assert ray_tpu.cancel(ref) is True
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    del h
+
+
+def test_force_cancel_running_task(ray_start_regular):
+    """force=True interrupts a running task via worker kill; the task is
+    not retried and its output errors."""
+    import time
+
+    @ray_tpu.remote(max_retries=3)
+    def spin():
+        time.sleep(60)
+        return "done"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # ensure it is running
+    assert ray_tpu.cancel(ref, force=True) is True
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
