@@ -679,6 +679,7 @@ class GcsServer:
             tid = msg["task_id"]
             cancelled = False
             die_conn = None
+            free_args: list[str] = []
             with self.lock:
                 before = len(self.pending_tasks)
                 removed = [s for s in self.pending_tasks if s["task_id"] == tid]
@@ -687,6 +688,21 @@ class GcsServer:
                 cancelled = len(self.pending_tasks) < before
                 for spec in removed:
                     spec["_cancelled"] = True
+                if not cancelled:
+                    # a pending actor METHOD call sits in its actor's queue,
+                    # not pending_tasks — dequeue it there (reference:
+                    # ray.cancel dequeues queued actor tasks)
+                    for a in self.actors.values():
+                        hit = [s for s in a.queue if s["task_id"] == tid]
+                        if hit:
+                            a.queue = collections.deque(
+                                s for s in a.queue if s["task_id"] != tid)
+                            for spec in hit:
+                                spec["_cancelled"] = True
+                                free_args.extend(self._unpin_args_locked(spec))
+                            removed.extend(hit)
+                            cancelled = True
+                            break
                 if not cancelled and msg.get("force"):
                     for w in self.workers.values():
                         spec = w.running_tasks.get(tid)
@@ -700,6 +716,8 @@ class GcsServer:
                             break
             for spec in removed:
                 self._fail_task_objects(spec, "task was cancelled")
+            if free_args:
+                self._free_objects(free_args)
             if die_conn is not None:
                 try:
                     die_conn.send({"type": "die"})
@@ -1207,14 +1225,20 @@ class GcsServer:
                 out.append(oid)
         return out
 
+    def _unpin_args_locked(self, spec: dict) -> list[str]:
+        """Release a spec's pinned args blob (no user ref ever exists for
+        one); returns the oid to free, if any."""
+        args_oid = spec.get("args_oid")
+        if args_oid and args_oid in self.objects:
+            self.objects[args_oid]["pinned"] = False
+            return [args_oid]
+        return []
+
     def _actor_dead_cleanup_locked(self, create_spec: dict) -> list[str]:
         """Permanent actor death: release creation-arg holds and the pinned
         creation-args blob. Returns oids to free."""
         out = self._sys_hold_locked(create_spec.pop("_actor_holds", ()), -1)
-        args_oid = create_spec.get("args_oid")
-        if args_oid and args_oid in self.objects:
-            self.objects[args_oid]["pinned"] = False
-            out.append(args_oid)
+        out.extend(self._unpin_args_locked(create_spec))
         return out
 
     def _drop_lineage_locked(self, tid: str) -> list[str]:
@@ -1223,11 +1247,7 @@ class GcsServer:
         spec = self.lineage.pop(tid, None)
         if spec is None:
             return []
-        args_oid = spec.get("args_oid")
-        if args_oid and args_oid in self.objects:
-            self.objects[args_oid]["pinned"] = False
-            return [args_oid]
-        return []
+        return self._unpin_args_locked(spec)
 
     def _head_store(self):
         if getattr(self, "_head_store_obj", None) is None:
@@ -1878,11 +1898,8 @@ class GcsServer:
 
             # the task is over: release its holds on args/nested refs
             free_now = self._sys_hold_locked(spec.pop("_holds", ()), -1)
-            if kind == "actor_task" and spec.get("args_oid"):
-                ao = spec["args_oid"]
-                if ao in self.objects:
-                    self.objects[ao]["pinned"] = False
-                    free_now.append(ao)
+            if kind == "actor_task":
+                free_now.extend(self._unpin_args_locked(spec))
             if kind == "actor_create" and error is not None:
                 # creation failed permanently: creation-arg holds + args blob
                 free_now.extend(self._actor_dead_cleanup_locked(spec))
@@ -1891,7 +1908,12 @@ class GcsServer:
             # cross-host consumers know where to pull from
             host = w.host_id if w is not None else HEAD_HOST
             contained_map = msg.get("contained") or {}
-            dev_tids = msg.get("device_tensors") or []
+            dev_map = msg.get("device_tensors") or {}
+            if not isinstance(dev_map, dict):
+                # legacy flat-list wire form: attribute to every result
+                dev_map = ({f"{spec['task_id']}r{i:04d}": list(dev_map)
+                            for i in range(spec["num_returns"])}
+                           if isinstance(spec["num_returns"], int) else {})
             any_shm = False
             for res in msg.get("results", ()):
                 oid, where, inline, size = res[:4]
@@ -1916,10 +1938,11 @@ class GcsServer:
                 if refs and "contained" not in (prev or {}):
                     entry["contained"] = list(refs)
                     self._sys_hold_locked(refs, +1)
-                if dev_tids:
-                    # RDT: result carries markers into wid's HBM registry;
-                    # freeing this object must free those entries too
-                    entry["device_tensors"] = (wid, list(dev_tids))
+                if dev_map.get(oid):
+                    # RDT: THIS result carries markers into wid's HBM
+                    # registry; freeing this object frees exactly those
+                    # entries — other results' tensors stay live
+                    entry["device_tensors"] = (wid, list(dev_map[oid]))
                 for conn, rid in self.object_waiters.pop(oid, []):
                     self._reply_object(conn, rid, entry)
                 if self._freeable_locked(oid, entry):

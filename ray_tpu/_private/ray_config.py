@@ -88,6 +88,12 @@ class RayConfig:
     # never reconnect — they exit and the restarted GCS respawns actors.
     gcs_reconnect_timeout_s: float = 10.0
 
+    # --- streaming generators -------------------------------------------
+    # How long a streaming producer waits at the backpressure limit with NO
+    # consumer ack before failing the stream (0 = wait forever while the
+    # GCS connection is alive, matching the reference's blocking behavior).
+    stream_stall_timeout_s: float = 300.0
+
     # --- metrics / tracing ----------------------------------------------
     # Enable task timeline events (reference: ray_config_def.h:615).
     enable_timeline: bool = True

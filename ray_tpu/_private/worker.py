@@ -940,6 +940,9 @@ class CoreWorker:
         _raylet.pyx:299 streaming generators with backpressure)."""
         task_id = spec["task_id"]
         bp = int(spec.get("backpressure") or 16)
+        from ray_tpu._private.ray_config import RayConfig
+
+        stall_budget = RayConfig.instance().stream_stall_timeout_s
         produced = 0
         stalled = False
         try:
@@ -961,6 +964,7 @@ class CoreWorker:
                 self.send_no_reply(msg)
                 produced += 1
                 stalled = False
+                stall_t = 0.0
                 while True:
                     if (task_id in self._stream_cancelled
                             or produced - self._stream_acks.get(task_id, 0) <= bp):
@@ -969,7 +973,15 @@ class CoreWorker:
                     ev.clear()
                     if produced - self._stream_acks.get(task_id, 0) <= bp:
                         break  # ack raced the clear
-                    if not ev.wait(60.0):
+                    # wait in short slices: any ack progress resets the stall
+                    # clock, so only a consumer with NO progress for the whole
+                    # budget fails the stream (budget 0 = wait forever while
+                    # the GCS connection lives — reference blocks indefinitely)
+                    if ev.wait(5.0):
+                        stall_t = 0.0
+                        continue
+                    stall_t += 5.0
+                    if stall_budget and stall_t >= stall_budget:
                         stalled = True  # consumer gone/stalled: stop, don't
                         break           # produce unboundedly past it
                 if stalled:
@@ -981,8 +993,8 @@ class CoreWorker:
                     spec.get("name") or "stream", "",
                     TimeoutError(
                         f"streaming producer stalled: consumer took no item "
-                        f"for 60s with the producer {bp} items ahead "
-                        f"(produced {produced})")))
+                        f"for {stall_budget:.0f}s with the producer {bp} items "
+                        f"ahead (produced {produced})")))
                 self.send_no_reply({"type": "stream_end", "wid": self.wid,
                                     "task_id": task_id, "error": err})
             else:
@@ -998,7 +1010,8 @@ class CoreWorker:
         error_blob = None
         results = []
         contained_map: dict = {}
-        _dev_tids: list = []
+        _extract_dev = False
+        _dev_map: dict = {}  # oid → tensor ids contained in THAT result
         self._task_ctx.task_id = spec["task_id"]
         _t_exec0 = time.time()
         try:
@@ -1036,11 +1049,7 @@ class CoreWorker:
                     out = method(*args, **kwargs)
                 if getattr(getattr(method, "__func__", method),
                            "__ray_tpu_tensor_transport__", None):
-                    # RDT: returned jax.Arrays stay in this process's HBM;
-                    # only small markers cross the control plane
-                    from ray_tpu.experimental import device_objects
-
-                    out, _dev_tids = device_objects.extract(out, self.wid)
+                    _extract_dev = True
             else:
                 raise RayTpuError(f"unknown task kind {kind}")
             n = spec["num_returns"]
@@ -1052,6 +1061,18 @@ class CoreWorker:
                 values = [out] if n == 1 else (list(out) if n > 0 else [])
             if isinstance(n, int) and n > 1 and len(values) != n:
                 raise ValueError(f"task declared num_returns={n} but returned {len(values)} values")
+            if _extract_dev:
+                # RDT: returned jax.Arrays stay in this process's HBM; only
+                # small markers cross the control plane. Extraction is PER
+                # RETURN VALUE so the GCS can free each result's registry
+                # entries independently (freeing return 0 must not drop
+                # tensors still referenced by a live return 1).
+                from ray_tpu.experimental import device_objects
+
+                for i in range(len(values)):
+                    values[i], tids = device_objects.extract(values[i], self.wid)
+                    if tids:
+                        _dev_map[f"{spec['task_id']}r{i:04d}"] = tids
             for i, val in enumerate(values):
                 oid = f"{spec['task_id']}r{i:04d}"
                 (parts, total), refs = _serialize_capturing(ser.dumps_into, val)
@@ -1109,10 +1130,10 @@ class CoreWorker:
         done = {"type": "task_done", "wid": self.wid, "spec": lite,
                 "results": results, "error": error_blob,
                 "contained": contained_map}
-        if _dev_tids:
-            # registry lifetime rides the result object: the GCS tells us to
-            # drop these HBM entries when the enclosing object is freed
-            done["device_tensors"] = _dev_tids
+        if _dev_map:
+            # registry lifetime rides each result object: the GCS tells us to
+            # drop a result's HBM entries when THAT object is freed
+            done["device_tensors"] = _dev_map
         self.send_no_reply(done)
 
     def exec_loop(self):

@@ -34,6 +34,9 @@ _lock = threading.Lock()
 _registry: dict[str, Any] = {}
 # owner-side cache of host-staged exports: tensor_id -> pinned store oid
 _exports: dict[str, str] = {}
+# per-tensor in-flight export guard: two concurrent export requests must not
+# both stage a device→host copy (the loser's pinned oid would leak)
+_export_inflight: dict[str, threading.Lock] = {}
 # unpickle-time detection: constructing a marker during ser.loads flips the
 # active capture, so consumers restore exactly when needed (any nesting
 # depth, registered pytrees included)
@@ -151,22 +154,42 @@ def export_to_store(tensor_id: str, worker) -> str | None:
     from ray_tpu._private.ids import ObjectID
 
     with _lock:
-        arr = _registry.get(tensor_id)
         cached = _exports.get(tensor_id)
-    if cached is not None:
-        return cached  # each tensor is host-staged at most once
-    if arr is None:
-        return None
-    host = np.asarray(arr)  # one device→host copy, only on cross-process use
-    oid = ObjectID.for_put().hex()
-    parts, total = ser.dumps_into(host)
-    tier = worker.store.put_parts(oid, parts, total)
-    worker.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
-                          "size": total, "host": worker.host_id,
-                          "tier": tier, "pin": True})
-    with _lock:
-        prior = _exports.setdefault(tensor_id, oid)
-    return prior
+        if cached is not None:
+            return cached  # each tensor is host-staged at most once
+        if tensor_id not in _registry:
+            return None
+        guard = _export_inflight.setdefault(tensor_id, threading.Lock())
+    with guard:
+        with _lock:  # the race loser re-checks under the guard
+            cached = _exports.get(tensor_id)
+            arr = _registry.get(tensor_id)
+        if cached is not None:
+            return cached
+        if arr is None:
+            return None  # freed while we waited
+        host = np.asarray(arr)  # one device→host copy, on cross-process use
+        oid = ObjectID.for_put().hex()
+        parts, total = ser.dumps_into(host)
+        tier = worker.store.put_parts(oid, parts, total)
+        worker.send_no_reply({"type": "object_put", "oid": oid, "where": "shm",
+                              "size": total, "host": worker.host_id,
+                              "tier": tier, "pin": True})
+        with _lock:
+            if tensor_id not in _registry:
+                freed = True  # freed mid-copy: our staged oid must not leak
+            else:
+                freed = False
+                _exports[tensor_id] = oid
+                _export_inflight.pop(tensor_id, None)
+        if freed:
+            try:
+                worker.send_no_reply({"type": "free_objects_async",
+                                      "oids": [oid]})
+            except Exception:
+                pass
+            return None
+        return oid
 
 
 def free_device_tensors(tensor_ids, worker=None) -> None:
@@ -176,6 +199,7 @@ def free_device_tensors(tensor_ids, worker=None) -> None:
     with _lock:
         for tid in tensor_ids:
             _registry.pop(tid, None)
+            _export_inflight.pop(tid, None)
             oid = _exports.pop(tid, None)
             if oid:
                 stale_oids.append(oid)

@@ -154,6 +154,13 @@ def clear_registry() -> None:
         _registry.clear()
 
 
+def _esc_label(v) -> str:
+    """Escape a label value per the Prometheus exposition format
+    (backslash, double-quote, newline)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def to_prometheus(agg: dict) -> str:
     """Render a GCS-side aggregate ({name: {kind, description, series:
     {source: [(tags, value), ...]}}}) as Prometheus text format."""
@@ -175,6 +182,12 @@ def to_prometheus(agg: dict) -> str:
                     if cur is None:
                         merged[key] = {k: (list(v) if isinstance(v, list) else v)
                                        for k, v in val.items()}
+                    elif list(cur.get("boundaries", ())) != list(
+                            val.get("boundaries", ())):
+                        # sources disagree on bucket layout (e.g. a metric
+                        # was redefined mid-flight): summing would corrupt
+                        # both — keep the first series, skip this one
+                        continue
                     else:
                         cur["sum"] += val["sum"]
                         cur["count"] += val["count"]
@@ -183,7 +196,7 @@ def to_prometheus(agg: dict) -> str:
                 else:
                     merged[key] = merged.get(key, 0.0) + val
         for key, val in merged.items():
-            label = ",".join(f'{k}="{v}"' for k, v in key)
+            label = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
             label = "{" + label + "}" if label else ""
             if kind == "histogram":
                 acc = 0

@@ -304,3 +304,30 @@ def test_force_cancel_running_task(ray_start_regular):
 
     with pytest.raises(TaskCancelledError):
         ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_queued_actor_task(ray_start_regular):
+    """A pending actor METHOD call sitting in the actor's queue is
+    cancellable (reference: ray.cancel dequeues pending actor tasks)."""
+    import time
+
+    @ray_tpu.remote
+    class Slow:
+        def block(self):
+            time.sleep(20)
+            return "blocked"
+
+        def quick(self):
+            return "quick"
+
+    a = Slow.remote()
+    ray_tpu.get(a.quick.remote(), timeout=60)  # actor alive
+    busy = a.block.remote()
+    time.sleep(0.3)
+    queued = a.quick.remote()  # sits in the actor queue behind block()
+    assert ray_tpu.cancel(queued) is True
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    del busy

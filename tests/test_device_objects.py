@@ -141,3 +141,49 @@ def test_registry_freed_with_enclosing_object(session):
             break
         time.sleep(0.2)
     assert ray_tpu.get(p.registry_size.remote(), timeout=60) == 0
+
+
+def test_per_result_registry_partition():
+    """num_returns=2 with tensor transport: freeing return 0 keeps return 1's
+    HBM entry live (regression: flat device_tensors list freed ALL the task's
+    tensors when ANY one return object died). Fresh session: earlier tests'
+    actors pin workers and can exhaust max_workers."""
+    import gc
+    import time
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=1, max_workers=4)
+
+    @ray_tpu.remote
+    class P2:
+        @ray_tpu.method(tensor_transport="device")
+        def make_pair(self):
+            import jax.numpy as jnp
+
+            return jnp.ones((64,)) * 2.0, jnp.ones((64,)) * 5.0
+
+        def consume(self, payload):
+            return float(payload.sum())
+
+        def registry_size(self):
+            from ray_tpu.experimental import device_objects
+
+            return device_objects.registry_size()
+
+    p = P2.remote()
+    r0, r1 = p.make_pair.options(num_returns=2).remote()
+    ray_tpu.wait([r0, r1], num_returns=2, timeout=60)
+    s0 = ray_tpu.get(p.registry_size.remote(), timeout=60)
+    assert s0 >= 2  # the worker may host leftovers from earlier actors
+    del r0
+    gc.collect()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.get(p.registry_size.remote(), timeout=60) < s0:
+            break
+        time.sleep(0.2)
+    # r0's tensor was freed — and r1's MUST survive it (the regression:
+    # a flat per-task list freed both tensors when either object died)
+    assert ray_tpu.get(p.registry_size.remote(), timeout=60) < s0
+    assert ray_tpu.get(p.consume.remote(r1), timeout=60) == 64 * 5.0
+    ray_tpu.shutdown()

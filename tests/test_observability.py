@@ -78,6 +78,25 @@ def test_prometheus_rendering():
     assert "lat_count 3" in text
 
 
+def test_prometheus_label_escaping_and_bucket_mismatch():
+    agg = {
+        "esc": {"kind": "counter", "description": "",
+                "series": {"w1": [[[["path", 'a"b\\c\nd']], 1.0]]}},
+        "mix": {"kind": "histogram", "description": "",
+                "series": {"w1": [[[], {"buckets": [1, 0, 0], "sum": 0.1,
+                                        "count": 1,
+                                        "boundaries": [0.1, 1.0]}]],
+                           "w2": [[[], {"buckets": [0, 1], "sum": 0.5,
+                                        "count": 1,
+                                        "boundaries": [0.5]}]]}},
+    }
+    text = met.to_prometheus(agg)
+    # label values escape backslash, quote, newline per the exposition format
+    assert 'esc{path="a\\"b\\\\c\\nd"} 1.0' in text
+    # mismatched bucket boundaries: first series kept, second skipped
+    assert "mix_count 1" in text
+
+
 class TestClusterObservability:
     def test_metrics_events_dashboard(self, ray_start_regular):
         met.clear_registry()
