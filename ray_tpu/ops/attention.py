@@ -26,9 +26,11 @@ def repeat_kv(k, *, n_rep: int):
 def _flash_ok(q) -> bool:
     if q.shape[1] % 256 != 0:  # seq must tile into flash blocks
         return False
-    # measured on v5e: XLA's fused attention wins at short seq; the Pallas
-    # kernel pays off where the quadratic score tensor stops fitting
-    return jax.default_backend() == "tpu" and q.shape[1] >= 4096
+    # measured on v5e (benchmarks/attn_bench.py, b8 h16 d128): the Pallas
+    # kernel wins from seq 1024 up once fwd AND bwd are kernels — 2.4x at
+    # s2048 (12.96 vs 31.22 ms fwd+bwd) — and is the only path that runs at
+    # s4096+ (XLA's quadratic score tensor OOMs HBM)
+    return jax.default_backend() == "tpu" and q.shape[1] >= 1024
 
 
 def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
@@ -50,7 +52,11 @@ def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
 
     use_flash = impl == "flash" or (impl is None and _flash_ok(q))
     if use_flash:
+        T = q.shape[1]
+        # best measured block size (benchmarks/attn_bench.py), falling back
+        # to 256 for seqs that don't tile into 512
+        blk = 512 if T % 512 == 0 else min(256, T)
         qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-        out = flash_attention(qt, kt, vt, causal, scale)
+        out = flash_attention(qt, kt, vt, causal, scale, blk, blk)
         return out.transpose(0, 2, 1, 3)
     return reference_attention(q, k, v, causal=causal, scale=scale)
