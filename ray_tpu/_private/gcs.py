@@ -24,6 +24,7 @@ responsibilities and state machines:
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import queue as _queue
 import threading
@@ -50,11 +51,12 @@ CHIP_SPAWN_TIMEOUT_S = 300.0
 class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
                  "running_tasks", "node_id", "tpu_chips", "host_id",
-                 "ref_balance", "renv_hash")
+                 "ref_balance", "renv_hash", "direct_addr", "leased_to",
+                 "lease_spec", "lease_token")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
                  tpu_chips: tuple = (), host_id: str = "host-0",
-                 renv_hash: str = ""):
+                 renv_hash: str = "", direct_addr: str | None = None):
         self.host_id = host_id
         self.wid = wid
         self.conn = conn
@@ -75,6 +77,11 @@ class _Worker:
         # runtime-env fingerprint baked into the process at spawn
         # (reference: worker pool keyed by runtime-env hash)
         self.renv_hash = renv_hash
+        # direct-dispatch plane (reference: leased-worker submission)
+        self.direct_addr = direct_addr  # where leased callers connect
+        self.leased_to: str | None = None  # caller wid holding the lease
+        self.lease_spec: dict | None = None  # resources held by the lease
+        self.lease_token: int | None = None  # guards stale release messages
 
 
 class _Actor:
@@ -227,6 +234,15 @@ class GcsServer:
         self.pubsub_conns: dict[tuple[str, str], MsgConnection] = {}
         # in-flight RDT exports: token → (requester conn, rid)
         self._tensor_exports: dict[str, tuple] = {}
+        # direct-dispatch leases (reference: cluster_lease_manager.h:41):
+        # grant tokens guard against stale release messages; the holder index
+        # lets caller death release everything it held
+        self._lease_seq = 0
+        self._leases_by_holder: dict[str, set[str]] = {}
+        # caller-reported local submission backlogs, piggybacked on lease
+        # requests (reference: backlog_size in lease requests feeds the
+        # autoscaler's demand view)
+        self._direct_backlog: dict[tuple, tuple] = {}  # (caller,key)→(res,n,ts)
         # publish() is called from paths holding self.lock — a slow
         # subscriber socket must not stall the control plane, so replies to
         # parked pollers go through this queue to a dedicated sender thread
@@ -520,7 +536,8 @@ class GcsServer:
                     self.workers[wid] = _Worker(
                         wid, conn, msg.get("pid", 0), msg["kind"], node_id,
                         tpu_chips=chips, host_id=msg.get("host") or HEAD_HOST,
-                        renv_hash=renv_hash)
+                        renv_hash=renv_hash,
+                        direct_addr=msg.get("direct_addr"))
             if not accepted:
                 conn.send({"rid": msg["rid"], "ok": False,
                            "error": "stale chip binding; exit"})
@@ -659,10 +676,26 @@ class GcsServer:
         elif t == "object_put":
             self._on_object_ready(msg["oid"], where=msg.get("where", "shm"),
                                   inline=msg.get("inline"), size=msg.get("size", 0),
-                                  is_error=False, host=msg.get("host") or HEAD_HOST,
+                                  is_error=msg.get("is_error", False),
+                                  host=msg.get("host") or HEAD_HOST,
                                   pin=msg.get("pin", False),
                                   contained=msg.get("contained"),
                                   tier=msg.get("tier", "shm"))
+        elif t == "lease_workers":
+            self._lease_workers(conn, msg, wid)
+        elif t == "return_lease":
+            for lw, tok in (msg.get("tokens") or {}).items():
+                self._release_lease(lw, tok)
+        elif t == "lease_released":
+            # a worker reporting its caller's connection closed
+            self._release_lease(msg["wid"], msg.get("token"))
+        elif t == "direct_lineage":
+            # a direct task produced evictable (shm) outputs: retain its spec
+            # for reconstruction, same budget as GCS-path tasks
+            with self.lock:
+                evicted = self._retain_lineage_locked(msg["spec"])
+            if evicted:
+                self._free_objects(evicted)
         elif t == "wait_object":
             self._wait_object(conn, msg)
         elif t == "free_objects_async":
@@ -837,6 +870,16 @@ class GcsServer:
                     demands.append(dict(spec.get("resources") or {}))
                 for spec in self.pending_actor_creations:
                     demands.append(dict(spec.get("resources") or {}))
+                # direct-dispatch backlogs queued at callers (stale entries
+                # age out; dead callers' entries are dropped)
+                now_m = time.monotonic()
+                for (caller, _rk, _rh), (res, n, ts) in list(
+                        self._direct_backlog.items()):
+                    w = self.workers.get(caller)
+                    if now_m - ts > 5.0 or w is None or w.dead:
+                        self._direct_backlog.pop((caller, _rk, _rh), None)
+                        continue
+                    demands.extend([dict(res)] * min(n, 100))
                 pg_demands = []
                 for pgid in self.pending_pgs:
                     pg = self.pgs.get(pgid)
@@ -1219,6 +1262,13 @@ class GcsServer:
         for oid in oids:
             e = self.objects.get(oid)
             if e is None:
+                if n > 0:
+                    # dep/nested ref the GCS hasn't seen yet — typically an
+                    # unpublished direct-task result whose owner will
+                    # object_put it (publish_on_done): park the hold in a
+                    # stub entry the publish merges into
+                    self.objects[oid] = {"status": "pending", "where": None,
+                                         "inline": None, "size": 0, "sys": n}
                 continue
             e["sys"] = e.get("sys", 0) + n
             if n < 0 and self._freeable_locked(oid, e):
@@ -1518,6 +1568,158 @@ class GcsServer:
             for k, v in res.items():
                 node.available[k] = node.available.get(k, 0.0) + v
 
+    # ------------------------------------------------------- direct leases
+    # (reference: src/ray/raylet/scheduling/cluster_lease_manager.h:41 lease
+    # grant/release; normal_task_submitter.h:81 caller-side lease use)
+
+    def _lease_workers(self, conn: MsgConnection, msg: dict, caller: str | None):
+        res = msg.get("resources") or {"CPU": 1.0}
+        rh = msg.get("renv_hash", "")
+        need = accelerators.chips_required(res)
+        prefer = msg.get("prefer_host")
+        count = max(1, int(msg.get("count", 1)))
+        grants: list[dict] = []
+        with self.lock:
+            # record the caller's local backlog for the autoscaler demand view
+            bkey = (caller, tuple(sorted(res.items())), rh)
+            backlog = int(msg.get("backlog", 0))
+            if backlog > 0:
+                self._direct_backlog[bkey] = (dict(res), backlog, time.monotonic())
+            else:
+                self._direct_backlog.pop(bkey, None)
+            if not self.stopped and caller is not None:
+                cands = [w for w in self.workers.values()
+                         if w.kind == "worker" and not w.dead and w.idle
+                         and w.actor_id is None and w.leased_to is None
+                         and len(w.tpu_chips) == need and w.renv_hash == rh
+                         and w.direct_addr]
+                if prefer:
+                    cands.sort(key=lambda w: w.host_id != prefer)
+                for w in cands:
+                    if len(grants) >= count:
+                        break
+                    node = self.nodes.get(w.node_id)
+                    if node is None or not node.alive:
+                        continue
+                    if not pg_policy._fits(node.available, res):
+                        continue
+                    lspec = {"resources": dict(res)}
+                    self._acquire_for(lspec, w.node_id)
+                    self._lease_seq += 1
+                    w.idle = False
+                    w.leased_to = caller
+                    w.lease_spec = lspec
+                    w.lease_token = self._lease_seq
+                    self._leases_by_holder.setdefault(caller, set()).add(w.wid)
+                    grants.append({"wid": w.wid, "addr": w.direct_addr,
+                                   "host": w.host_id, "node": w.node_id,
+                                   "token": self._lease_seq})
+        unmet = count - len(grants)
+        if unmet > 0:
+            self._spawn_for_lease_demand(res, rh, need, unmet)
+        try:
+            conn.send({"rid": msg["rid"], "leases": grants})
+        except ConnectionClosed:
+            for g in grants:
+                self._release_lease(g["wid"], g["token"])
+
+    def _spawn_for_lease_demand(self, res: dict, rh: str, need: int, n: int):
+        """Unmet lease demand scales the pool up, same as queued GCS tasks
+        do — the caller's next lease attempt then finds idle workers."""
+        spawn_plan: list[tuple[str, list]] = []
+        now = time.monotonic()
+        with self.lock:
+            n_workers = sum(1 for w in self.workers.values()
+                            if w.kind == "worker" and not w.dead)
+            spawning = sum(len(dq) for dq in self._spawn_pending.values())
+            headroom = self.max_workers - n_workers - spawning
+            n = min(n, headroom)
+            if n <= 0:
+                return
+            node_id = pg_policy.pick_node_hybrid(
+                list(self.nodes.values()), res, self.local_node_id)
+            if node_id is None:
+                return
+            node = self.nodes.get(node_id)
+            assignments: list = []
+            for _ in range(n):
+                if need == 0:
+                    assignments.append(None)
+                    continue
+                if node is None or not node.alive or len(node.chip_pool) < need:
+                    break
+                chips = tuple(node.chip_pool[:need])
+                del node.chip_pool[:need]
+                assignments.append(chips)
+            if not assignments:
+                return
+            self._spawn_pending[node_id].extend(
+                (now, c, rh) for c in assignments)
+            host = self.node_hosts.get(node_id, HEAD_HOST)
+            agent_conn = self.hosts.get(host, {}).get("conn")
+            renv = self.runtime_envs.get(rh) if rh else None
+            spawn_plan.append((node_id, assignments, agent_conn, renv))
+        for node_id, assignments, agent_conn, renv in spawn_plan:
+            if agent_conn is not None:
+                try:
+                    agent_conn.send({"type": "spawn_workers",
+                                     "node_id": node_id,
+                                     "assignments": assignments,
+                                     "runtime_env": renv})
+                except ConnectionClosed:
+                    pass
+            else:
+                self.spawn_worker_cb(len(assignments), node_id, assignments,
+                                     renv)
+
+    def _release_lease(self, target: str, token=None, make_idle: bool = True):
+        with self.lock:
+            w = self.workers.get(target)
+            if w is None or w.leased_to is None:
+                return
+            if token is not None and token != w.lease_token:
+                return  # stale release for an already-recycled lease
+            holder = w.leased_to
+            w.leased_to = None
+            w.lease_token = None
+            hs = self._leases_by_holder.get(holder)
+            if hs is not None:
+                hs.discard(target)
+            spec, w.lease_spec = w.lease_spec, None
+            if spec is not None:
+                self._release_for(spec)
+            if not w.dead and make_idle:
+                w.idle = True
+        self._schedule()
+
+    def _retain_lineage_locked(self, spec: dict) -> list[str]:
+        """Retain a task spec for lineage reconstruction of its outputs,
+        under the bounded budget (reference: lineage eviction). A
+        reconstruction resubmit keeps its spent budget. Returns oids freed
+        by eviction; caller holds the lock."""
+        prev_lin = self.lineage.get(spec["task_id"])
+        lin = {k: v for k, v in spec.items()
+               if k not in ("_paid", "_holds", "retries_used")}
+        if prev_lin is not None:
+            lin["recons_used"] = prev_lin.get("recons_used", 0)
+        self.lineage[spec["task_id"]] = lin
+        evicted: list[str] = []
+        if len(self.lineage) > MAX_LINEAGE:
+            # evict oldest-first, but never a task that is still
+            # queued/running — dropping one would free its pinned
+            # args blob under it and hang the dispatch
+            active = {s["task_id"] for s in self.pending_tasks}
+            for w_ in self.workers.values():
+                active.update(w_.running_tasks.keys())
+            active.add(spec["task_id"])
+            for tid in list(self.lineage):
+                if len(self.lineage) <= MAX_LINEAGE:
+                    break
+                if tid in active:
+                    continue
+                evicted.extend(self._drop_lineage_locked(tid))
+        return evicted
+
     # ----------------------------------------------------------------- tasks
 
     def _invalid_strategy_reason(self, strat: dict | None) -> str | None:
@@ -1557,29 +1759,7 @@ class GcsServer:
                 self._sys_hold_locked(holds, +1)
                 evicted: list[str] = []
                 if spec["kind"] == "task" and isinstance(spec["num_returns"], int):
-                    # retain the spec for lineage reconstruction of outputs,
-                    # under a bounded budget (reference: lineage eviction).
-                    # A reconstruction resubmit must keep its spent budget.
-                    prev_lin = self.lineage.get(spec["task_id"])
-                    lin = {k: v for k, v in spec.items()
-                           if k not in ("_paid", "_holds", "retries_used")}
-                    if prev_lin is not None:
-                        lin["recons_used"] = prev_lin.get("recons_used", 0)
-                    self.lineage[spec["task_id"]] = lin
-                    if len(self.lineage) > MAX_LINEAGE:
-                        # evict oldest-first, but never a task that is still
-                        # queued/running — dropping one would free its pinned
-                        # args blob under it and hang the dispatch
-                        active = {s["task_id"] for s in self.pending_tasks}
-                        for w_ in self.workers.values():
-                            active.update(w_.running_tasks.keys())
-                        active.add(spec["task_id"])
-                        for tid in list(self.lineage):
-                            if len(self.lineage) <= MAX_LINEAGE:
-                                break
-                            if tid in active:
-                                continue
-                            evicted.extend(self._drop_lineage_locked(tid))
+                    evicted = self._retain_lineage_locked(spec)
                 self.pending_tasks.append(spec)
             self.task_counter["submitted"] += 1
         if reason is not None:
@@ -1600,6 +1780,7 @@ class GcsServer:
         """Dispatch whatever can run; request worker scale-up for the rest."""
         to_send: list[tuple[MsgConnection, dict]] = []
         want_spawn: collections.Counter = collections.Counter()  # (node, n_chips) → demand
+        revokes: list[tuple[MsgConnection, str]] = []
         with self.lock:
             if self.stopped:
                 return
@@ -1637,7 +1818,10 @@ class GcsServer:
             can_place = (any(idle_by_node.values())
                          or self.max_workers - n_alive - spawning_now > 0)
 
+            dispatched_any = False
+
             def dispatch(spec) -> bool:
+                nonlocal dispatched_any
                 node_id = self._fits_for(spec)
                 if node_id is None or not self._deps_ready(spec):
                     return False
@@ -1661,6 +1845,7 @@ class GcsServer:
                     actor = self.actors[spec["actor_id"]]
                     actor.worker = w.wid
                 to_send.append((w.conn, {"type": "exec", "spec": spec}))
+                dispatched_any = True
                 return True
 
             if can_place:
@@ -1714,6 +1899,19 @@ class GcsServer:
                 scan(self.pending_actor_creations, skip=_dead_actor)
                 scan(self.pending_tasks)
 
+            # pending work that couldn't dispatch while leases hold the
+            # resources it needs: revoke exactly those leases (reference:
+            # leases are returned under cluster pressure / spillback)
+            if ((self.pending_tasks or self.pending_actor_creations)
+                    and not dispatched_any):
+                for lw in self.workers.values():
+                    if (lw.kind == "worker" and not lw.dead
+                            and lw.leased_to is not None
+                            and self._lease_would_help_locked(lw)):
+                        holder = self.workers.get(lw.leased_to)
+                        if holder is not None and not holder.dead:
+                            revokes.append((holder.conn, lw.wid))
+
             # actor method calls (up to max_concurrency in flight per actor)
             for actor in self.actors.values():
                 while (actor.state == "alive" and actor.queue
@@ -1754,6 +1952,22 @@ class GcsServer:
                     headroom += len(got)
                     reclaim.extend(got)
                 n = max(0, min(want, headroom))
+                if n < want:
+                    # demand this pass can't spawn for: ask lease holders to
+                    # hand matching leased workers back (reference: leases are
+                    # revoked/spilled back under cluster pressure)
+                    needed = want - n
+                    for lw in self.workers.values():
+                        if needed <= 0:
+                            break
+                        if (lw.kind == "worker" and not lw.dead
+                                and lw.leased_to is not None
+                                and len(lw.tpu_chips) == need
+                                and lw.renv_hash == rh):
+                            holder = self.workers.get(lw.leased_to)
+                            if holder is not None and not holder.dead:
+                                revokes.append((holder.conn, lw.wid))
+                                needed -= 1
                 if n <= 0:
                     continue
                 assignments: list = []
@@ -1792,6 +2006,11 @@ class GcsServer:
                 w.conn.send({"type": "exit"})
             except ConnectionClosed:
                 pass
+        for hconn, lw in revokes:
+            try:
+                hconn.send({"type": "lease_revoke", "wid": lw})
+            except ConnectionClosed:
+                pass
         for agent_conn, node_id, assignments, renv in agent_sends:
             try:
                 agent_conn.send({"type": "spawn_workers", "node_id": node_id,
@@ -1802,6 +2021,30 @@ class GcsServer:
         for node_id, assignments, rh in spawn_plan:
             self.spawn_worker_cb(len(assignments), node_id, assignments,
                                  self.runtime_envs.get(rh) if rh else None)
+
+    def _lease_would_help_locked(self, lw: _Worker) -> bool:
+        """Would returning this worker's lease make any head-of-queue
+        pending spec resource-feasible on its node? Only specs that are
+        dep-ready AND actually resource-blocked count — revoking for work
+        that is waiting on something else would just churn the lease pool."""
+        node = self.nodes.get(lw.node_id)
+        if node is None or not node.alive:
+            return False
+        avail0 = node.available
+        avail = dict(avail0)
+        for k, v in (lw.lease_spec or {}).get("resources", {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+        for spec in itertools.islice(
+                itertools.chain(self.pending_actor_creations,
+                                self.pending_tasks), 32):
+            res = spec.get("resources", {})
+            if not self._deps_ready(spec):
+                continue
+            if all(avail0.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                continue  # resources already free: blocked on workers, not us
+            if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                return True
+        return False
 
     def _reclaim_mismatched_idle_locked(self, node_id: str, need: int,
                                         max_count: int,
@@ -2328,11 +2571,36 @@ class GcsServer:
                             "error": "owner process died during export"})
             except ConnectionClosed:
                 pass
+        # leases HELD by the dying process: its workers may still be mid-task
+        # on the direct plane, so don't hand them to the scheduler — retire
+        # them (the reference kills workers leaked by dead drivers too)
+        with self.lock:
+            held = list(self._leases_by_holder.pop(wid, ()))
+        for lw in held:
+            self._release_lease(lw, None, make_idle=False)
+            with self.lock:
+                lw_w = self.workers.get(lw)
+                exit_conn = lw_w.conn if lw_w is not None and not lw_w.dead else None
+            if exit_conn is not None:
+                try:
+                    exit_conn.send({"type": "exit"})
+                except ConnectionClosed:
+                    pass
         if driver_death:
             if death_free:
                 self._free_objects(death_free)
             return
         with self.lock:
+            # a lease ON the dying worker: give its resources back
+            if w.leased_to is not None:
+                hs = self._leases_by_holder.get(w.leased_to)
+                if hs is not None:
+                    hs.discard(wid)
+                w.leased_to = None
+                w.lease_token = None
+                if w.lease_spec is not None:
+                    self._release_for(w.lease_spec)
+                    w.lease_spec = None
             if w.tpu_chips:
                 node = self.nodes.get(w.node_id)
                 if node is not None and node.alive:

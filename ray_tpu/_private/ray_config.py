@@ -59,6 +59,12 @@ class RayConfig:
     # Seconds between batched refcount-delta flushes to the GCS.
     ref_flush_interval_s: float = 0.2
 
+    # Direct dispatch: callers lease idle workers from the GCS and push
+    # plain tasks to them over a dedicated connection, keeping the central
+    # scheduler off the per-task hot path (reference: leased-worker
+    # submission, normal_task_submitter.h:81).
+    direct_dispatch: bool = True
+
     # --- scheduling -----------------------------------------------------
     # Utilization threshold past which the hybrid policy spreads instead of
     # packing (reference: scheduling_policy.h:66 ~50%).

@@ -28,6 +28,8 @@ from ray_tpu.exceptions import (
     ObjectLostError,
     RayTaskError,
     RayTpuError,
+    TaskCancelledError,
+    WorkerCrashedError,
 )
 
 from ray_tpu._private.ray_config import RayConfig as _RayConfig
@@ -228,11 +230,34 @@ class CoreWorker:
         self._renv_cache: dict[str, tuple[dict, str]] = {}
         self.default_runtime_env: dict | None = None  # job-level default
         from ray_tpu._private.accelerators import current_worker_chips
+        from ray_tpu._private.ray_config import RayConfig as _RC
+
+        # direct-dispatch plane (reference: leased-worker task submission,
+        # normal_task_submitter.h:81): workers serve leased callers on a
+        # dedicated socket; every process can hold leases as a caller
+        self._direct_enabled = _RC.get("direct_dispatch")
+        self.direct_server = None
+        if kind == "worker" and self._direct_enabled:
+            from ray_tpu._private.direct import DirectServer
+
+            self.direct_server = DirectServer(self)
+        # owner-side records for direct-task results: oid → entry; results
+        # that never leave this process never touch the GCS at all
+        self._owned: dict[str, dict] = {}
+        self._owned_lock = threading.RLock()
+        self._loc_cache: dict[str, tuple] = {}  # oid → (host, size) once ready
+        self._flight_holds: dict[str, list[str]] = {}  # direct tid → held oids
+        self._direct = None  # DirectDispatcher, created lazily on first use
+        # deserialized task functions keyed by their pickled blob (reference:
+        # the worker's function table caches imported functions per process)
+        self._func_cache: dict[bytes, Any] = {}
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
                           "pid": os.getpid(), "node_id": self.node_id,
                           "host": self.host_id, "renv_hash": self.renv_hash,
-                          "tpu_chips": current_worker_chips()})
+                          "tpu_chips": current_worker_chips(),
+                          **({"direct_addr": self.direct_server.address}
+                             if self.direct_server else {})})
         if reply.get("ok") is False:
             raise RayTpuError(f"registration rejected: {reply.get('error')}")
         # reference counting: per-process local counts, process-level
@@ -256,13 +281,22 @@ class CoreWorker:
 
     # -------------------------------------------------------------- refcounts
 
+    def _gcs_invisible(self, oid: str) -> bool:
+        """True for direct-task results that never left this process: the
+        GCS has no entry for them, so ref transitions would be dropped there
+        anyway — skipping them keeps the hot path free of GCS traffic."""
+        ent = self._owned.get(oid)
+        return (ent is not None and not ent.get("published")
+                and ent.get("status") != "redirect")
+
     def incref(self, oid: str) -> bool:
         if not self._gc_enabled:
             return False
         with self._ref_lock:
             n = self._local_refs.get(oid, 0) + 1
             self._local_refs[oid] = n
-            if n == 1:  # first local ref in this process
+            if n == 1 and not self._gcs_invisible(oid):
+                # first local ref in this process
                 self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
         return True
 
@@ -272,7 +306,8 @@ class CoreWorker:
             n = self._local_refs.get(oid, 0) - 1
             if n <= 0:
                 self._local_refs.pop(oid, None)
-                self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) - 1
+                if not self._gcs_invisible(oid):
+                    self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) - 1
                 drop_cache = True
             else:
                 self._local_refs[oid] = n
@@ -280,6 +315,12 @@ class CoreWorker:
             self._memory.pop(oid, None)
             self._plasma_refs.pop(oid, None)
             self._obj_waits.pop(oid, None)
+            with self._owned_lock:
+                ent = self._owned.get(oid)
+                # in-flight entries stay: the reply handler needs them (they
+                # die with the flight if the user already dropped the ref)
+                if ent is not None and ent.get("status") != "pending":
+                    self._owned.pop(oid, None)
 
     def _ref_flush_loop(self):
         from ray_tpu._private.ray_config import RayConfig
@@ -289,6 +330,11 @@ class CoreWorker:
         while self._alive:
             time.sleep(cfg.ref_flush_interval_s)
             self._flush_ref_deltas()
+            if self._direct is not None:
+                try:
+                    self._direct.reap_idle()
+                except Exception:
+                    pass
             now = time.time()
             if now - last_metrics >= cfg.metrics_report_interval_s:
                 last_metrics = now
@@ -444,6 +490,13 @@ class CoreWorker:
                     ev = self._stream_events.get(tid)
                     if ev is not None:
                         ev.set()
+                elif msg.get("type") == "lease_revoke":
+                    # GCS has pending demand this leased worker could serve
+                    if self._direct is not None:
+                        try:
+                            self._direct.revoke(msg["wid"])
+                        except Exception:
+                            pass
         except ConnectionClosed:
             if self.kind == "driver" and not self._disconnecting:
                 # drivers outlive a GCS restart: retry connect + re-register
@@ -581,6 +634,9 @@ class CoreWorker:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
         renv, rhash = self._prepare_runtime_env(runtime_env)
+        # refs nested in args may be this process's unpublished direct-task
+        # results: the GCS (and any borrower) must be able to resolve them
+        self._publish_owned(spec_part.get("ref_holds", ()))
         # submitter's refs must be counted at the GCS before the task can
         # possibly complete: otherwise a borrower's death could free an
         # object whose only counted ref was the borrower's (the submitter's
@@ -600,10 +656,290 @@ class CoreWorker:
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
+        if (self._direct_enabled and strategy is None
+                and isinstance(num_returns, int)
+                and self._try_submit_direct(spec)):
+            return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
+        self._prepare_gcs_deps(deps)
         self.rpc({"type": "submit_task", "spec": spec})
         if num_returns == "streaming":
             return ObjectRefGenerator(task_id, self)
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
+
+    # -------------------------------------------------------- direct path
+    # Lease-based caller→worker submission (reference: leased-worker task
+    # pushes, normal_task_submitter.h:81; locality via lease_policy.h).
+
+    def _dispatcher(self):
+        if self._direct is None:
+            from ray_tpu._private.direct import DirectDispatcher
+
+            self._direct = DirectDispatcher(self)
+        return self._direct
+
+    def _classify_deps(self, deps):
+        """Decide direct-eligibility from dependency state. Returns None
+        (→ GCS path) or (inline_deps, required_lease, prefer_host)."""
+        inline_deps: dict[str, bytes] = {}
+        required_lease = None
+        prefer_host = None
+        best = -1
+        disp = self._direct
+        for d in deps:
+            with self._owned_lock:
+                ent = self._owned.get(d)
+                if ent is not None:
+                    st = ent.get("status")
+                    if st == "pending":
+                        # chain: runnable only on the dep's own lease (the
+                        # worker computes the dep first, in order)
+                        lease = disp.by_wid.get(ent.get("lease") or "") if disp else None
+                        if lease is None or lease.dead or (
+                                required_lease is not None
+                                and lease is not required_lease):
+                            return None
+                        required_lease = lease
+                        if not ent.get("publish_on_done"):
+                            # safety net: if anything else ends up waiting on
+                            # this oid at the GCS, the publish will come
+                            ent["publish_on_done"] = True
+                            self.incref(d)
+                        continue
+                    if st == "redirect":
+                        return None  # GCS owns this task now
+                    if st == "error":
+                        return None  # error propagation is the GCS path's job
+                    if ent.get("where") == "inline":
+                        if not ent.get("published"):
+                            inline_deps[d] = ent["inline"]
+                        continue
+                    if ent.get("size", 0) > best:
+                        best, prefer_host = ent["size"], ent.get("host")
+                    continue
+            if d in self._memory or d in self._plasma_refs:
+                continue  # materialized locally → ready cluster-wide
+            lc = self._loc_cache.get(d)
+            if lc is None:
+                return None  # unknown readiness → let the GCS queue it
+            host, size = lc
+            if host is not None and size > best:
+                best, prefer_host = size, host
+        return inline_deps, required_lease, prefer_host
+
+    def _prepare_gcs_deps(self, deps):
+        """Before a GCS-path submit: make every dep resolvable there."""
+        self._publish_owned(deps)
+
+    def _publish_owned(self, oids):
+        """Ensure this process's direct-task results are visible at the GCS
+        (called whenever such a ref escapes this process)."""
+        for oid in oids:
+            msg = None
+            with self._owned_lock:
+                ent = self._owned.get(oid)
+                if ent is None or ent.get("published"):
+                    continue
+                if ent.get("status") == "pending":
+                    if not ent.get("publish_on_done"):
+                        ent["publish_on_done"] = True
+                        self.incref(oid)
+                    continue
+                if ent.get("status") == "redirect":
+                    continue
+                ent["published"] = True
+                # its earlier incref was suppressed as GCS-invisible: emit it
+                # now so the GCS count matches this process's live refs
+                with self._ref_lock:
+                    if self._local_refs.get(oid, 0) > 0:
+                        self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
+                if ent.get("where") == "inline":
+                    msg = {"type": "object_put", "oid": oid, "where": "inline",
+                           "inline": ent["inline"], "size": ent.get("size", 0),
+                           "is_error": ent.get("status") == "error",
+                           "contained": ent.get("contained") or None}
+            if msg is not None:
+                self.send_no_reply(msg)
+
+    def _try_submit_direct(self, spec: dict) -> bool:
+        disp = self._dispatcher()
+        cls = self._classify_deps(spec.get("deps", ()))
+        if cls is None:
+            return False
+        inline_deps, required_lease, prefer_host = cls
+        from ray_tpu._private.direct import shape_key
+
+        key = shape_key(spec["resources"], spec.get("renv_hash", ""))
+        if inline_deps:
+            spec["inline_deps"] = inline_deps
+        tid = spec["task_id"]
+        holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
+        for d in holds:
+            self.incref(d)
+        self._flight_holds[tid] = holds
+        with self._owned_lock:
+            for i in range(spec["num_returns"]):
+                self._owned[f"{tid}r{i:04d}"] = {
+                    "status": "pending", "fut": _Future(), "lease": None,
+                    "task_id": tid, "published": False}
+        spec.pop("strategy", None)
+        if not disp.submit_or_queue(key, spec, spec["resources"],
+                                    spec.get("renv_hash", ""), prefer_host,
+                                    required_lease):
+            # no pool for this shape: roll back, the GCS path runs it
+            for d in self._flight_holds.pop(tid, ()):
+                self.decref(d)
+            with self._owned_lock:
+                for i in range(spec["num_returns"]):
+                    self._owned.pop(f"{tid}r{i:04d}", None)
+            spec.pop("inline_deps", None)
+            return False
+        return True
+
+    def _note_direct_lease(self, spec: dict, wid: str) -> None:
+        """Record which lease a direct spec was pushed to (dep-chaining)."""
+        tid = spec["task_id"]
+        with self._owned_lock:
+            for i in range(spec["num_returns"]):
+                ent = self._owned.get(f"{tid}r{i:04d}")
+                if ent is not None:
+                    ent["lease"] = wid
+
+    def _direct_cancelled_local(self, spec: dict) -> None:
+        """A spec cancelled straight out of the caller's local queue."""
+        for d in self._flight_holds.pop(spec["task_id"], ()):
+            self.decref(d)
+        publish_later: list[str] = []
+        with self._owned_lock:
+            self._owned_fail_locked(
+                spec, TaskCancelledError("task was cancelled"), publish_later)
+        self._publish_owned(publish_later)
+        for oid in publish_later:
+            self.decref(oid)
+
+    def _redirect_to_gcs(self, spec: dict) -> None:
+        """Hand a direct spec over to the GCS path (lease pool collapsed or
+        worker-death retry): its return objects become GCS-owned."""
+        tid = spec["task_id"]
+        publish_later: list[str] = []
+        # deps whose blobs ride in inline_deps were never published; the GCS
+        # gates dispatch on their readiness, so publish them now
+        self._publish_owned(spec.get("deps", ()))
+        with self._owned_lock:
+            for i in range(spec["num_returns"]):
+                oid = f"{tid}r{i:04d}"
+                ent = self._owned.get(oid)
+                if ent is None:
+                    continue
+                if ent.pop("publish_on_done", False):
+                    self.decref(oid)
+                # flip to GCS-visible atomically with re-emitting the
+                # suppressed +1 (decref takes _ref_lock before consulting
+                # _gcs_invisible, so holding it here closes the race)
+                with self._ref_lock:
+                    ent["status"] = "redirect"
+                    if self._local_refs.get(oid, 0) > 0:
+                        self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
+                ent["fut"].set({"ready": False, "redirect": True})
+        spec["strategy"] = None
+        spec.pop("_cancelled", None)
+        try:
+            self.rpc({"type": "submit_task", "spec": spec})
+        except Exception:
+            with self._owned_lock:
+                # entries are "redirect" now; recreate minimal error records
+                for i in range(spec["num_returns"]):
+                    oid = f"{tid}r{i:04d}"
+                    if oid in self._owned:
+                        self._owned.pop(oid)
+            # the GCS is gone: getters will fail on their own RPCs
+        for d in self._flight_holds.pop(tid, ()):
+            self.decref(d)
+
+    def _on_direct_done(self, lease, spec: dict, done: dict):
+        tid = spec["task_id"]
+        err = done.get("error")
+        contained = done.get("contained") or {}
+        published = set(done.get("published") or ())
+        publish_later: list[str] = []
+        with self._owned_lock:
+            if done.get("cancelled"):
+                self._owned_fail_locked(
+                    spec, TaskCancelledError("task was cancelled"),
+                    publish_later)
+            else:
+                for res in done.get("results") or ():
+                    oid, where, inline, size = res[:4]
+                    ent = self._owned.get(oid)
+                    if ent is None:
+                        continue  # every ref already dropped
+                    was_published = oid in published
+                    ent.update(
+                        status="error" if err is not None else "ready",
+                        where=where, inline=inline, size=size,
+                        host=lease.host,
+                        contained=list(contained.get(oid) or ()),
+                        published=was_published)
+                    if was_published:
+                        # worker registered it at the GCS (shm/contained):
+                        # surface this process's suppressed refs there
+                        with self._ref_lock:
+                            if self._local_refs.get(oid, 0) > 0:
+                                self._ref_deltas[oid] = \
+                                    self._ref_deltas.get(oid, 0) + 1
+                    if ent.pop("publish_on_done", False):
+                        publish_later.append(oid)
+                    ent["fut"].set({"ready": True})
+        for d in self._flight_holds.pop(tid, ()):
+            self.decref(d)
+        self._publish_owned(publish_later)
+        for oid in publish_later:
+            self.decref(oid)  # the publish_on_done guard ref
+
+    def _owned_fail_locked(self, spec: dict, exc, publish_later: list):
+        """Mark a direct task's return objects errored (owned-side analogue
+        of the GCS's _fail_task_objects). Caller holds _owned_lock."""
+        blob = ser.dumps(exc)
+        tid = spec["task_id"]
+        for i in range(spec["num_returns"]):
+            oid = f"{tid}r{i:04d}"
+            ent = self._owned.get(oid)
+            if ent is None:
+                continue
+            ent.update(status="error", where="inline", inline=blob,
+                       size=len(blob), contained=[], published=False)
+            if ent.pop("publish_on_done", False):
+                publish_later.append(oid)
+            ent["fut"].set({"ready": True})
+
+    def _direct_task_failed(self, spec: dict, lease):
+        """The leased worker died with this spec in flight."""
+        tid = spec["task_id"]
+        publish_later: list[str] = []
+        if spec.pop("_cancelled", False):
+            for d in self._flight_holds.pop(tid, ()):
+                self.decref(d)
+            with self._owned_lock:
+                self._owned_fail_locked(
+                    spec, TaskCancelledError("task was cancelled"),
+                    publish_later)
+        elif (spec.get("retries_used", 0) < spec.get("max_retries", 0)
+              and self._alive):
+            # hand the retry to the GCS: it owns queuing, spawn, and any
+            # further retries (reference: task resubmission on worker death)
+            spec["retries_used"] = spec.get("retries_used", 0) + 1
+            self._redirect_to_gcs(spec)
+            return
+        else:
+            for d in self._flight_holds.pop(tid, ()):
+                self.decref(d)
+            with self._owned_lock:
+                self._owned_fail_locked(
+                    spec,
+                    WorkerCrashedError(f"worker {lease.wid} died"),
+                    publish_later)
+        self._publish_owned(publish_later)
+        for oid in publish_later:
+            self.decref(oid)
 
     def create_actor(
         self,
@@ -623,6 +959,8 @@ class CoreWorker:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
         renv, rhash = self._prepare_runtime_env(runtime_env)
+        self._publish_owned(spec_part.get("ref_holds", ()))
+        self._prepare_gcs_deps(deps)
         self._flush_ref_deltas()  # see submit_task: count refs before submit
         spec = {
             "kind": "actor_create",
@@ -660,6 +998,8 @@ class CoreWorker:
     ) -> list[ObjectRef]:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
+        self._publish_owned(spec_part.get("ref_holds", ()))
+        self._prepare_gcs_deps(deps)
         self._flush_ref_deltas()  # see submit_task: count refs before submit
         spec = {
             "kind": "actor_task",
@@ -693,6 +1033,7 @@ class CoreWorker:
         infrastructure objects handed around by raw id, e.g. channels)."""
         oid = ObjectID.for_put().hex()
         (parts, total), contained = _serialize_capturing(ser.dumps_into, value)
+        self._publish_owned(contained)  # nested direct-result refs escape
         if total <= INLINE_LIMIT:
             blob = b"".join(bytes(p) if not isinstance(p, bytes) else p for p in parts)
             self.send_no_reply({"type": "object_put", "oid": oid, "where": "inline",
@@ -781,9 +1122,47 @@ class CoreWorker:
     def get_object(self, oid: str, timeout: float | None = None) -> Any:
         if oid in self._memory:
             return self._memory[oid]
+        ent = self._owned.get(oid)
+        if ent is not None and ent.get("status") != "redirect":
+            # a direct-task result this process owns: no GCS round-trip
+            if not ent["fut"].event.is_set() and self._direct is not None:
+                self._direct.flush()  # it may still be in the local queue
+            ent["fut"].wait(timeout if timeout is not None else 86400.0)
+            with self._owned_lock:
+                ent = self._owned.get(oid, ent)
+                st = ent.get("status")
+                where, inline = ent.get("where"), ent.get("inline")
+            if st in ("ready", "error") and where == "inline":
+                value = self._loads_restoring(inline)
+                if st == "error":
+                    raise value
+                self._memory[oid] = value
+                return value
+            if st == "ready" and where == "shm" and self.store.contains(oid):
+                plasma = self.store.get(oid)
+                self._plasma_refs[oid] = plasma
+                value = self._loads_restoring(plasma.buf)
+                self._memory[oid] = value
+                return value
+            # redirected to the GCS (retry) or a remote shm copy: fall through
         reply = self.rpc({"type": "wait_object", "oid": oid},
                          timeout=timeout if timeout is not None else 86400.0)
+        self._note_locations(oid, reply)
         return self._materialize(oid, reply)
+
+    def _note_locations(self, oid: str, reply: dict) -> None:
+        """Cache readiness + primary host of a GCS-known object; direct
+        submission uses this for locality-aware lease targeting."""
+        if not reply.get("ready") or reply.get("status") == "pending":
+            return
+        host = None
+        locs = reply.get("locations") or ()
+        if locs:
+            host = locs[0][0]
+        self._loc_cache[oid] = (host, reply.get("size", 0))
+        if len(self._loc_cache) > 4096:
+            for k in list(self._loc_cache)[:1024]:
+                self._loc_cache.pop(k, None)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -799,11 +1178,17 @@ class CoreWorker:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: float | None = None):
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
+        if self._direct is not None:
+            self._direct.flush()  # some refs may still sit in the local queue
         futures: list[tuple[ObjectRef, _Future | None]] = []
         for r in refs:
             oid = r.hex()
             if oid in self._memory:
                 futures.append((r, None))
+                continue
+            ent = self._owned.get(oid)
+            if ent is not None and ent.get("status") != "redirect":
+                futures.append((r, ent["fut"]))
                 continue
             # one outstanding GCS waiter per object, however often wait() polls
             fut = self._obj_waits.get(oid)
@@ -818,6 +1203,18 @@ class CoreWorker:
             return f is None or (f.event.is_set() and bool(f.value.get("ready")))
 
         while True:
+            # an owned fut can resolve to a redirect (direct task handed to
+            # the GCS on retry): swap in a GCS waiter for it
+            for idx, (r, f) in enumerate(futures):
+                if (f is not None and f.event.is_set()
+                        and isinstance(f.value, dict)
+                        and f.value.get("redirect")):
+                    oid = r.hex()
+                    nf = self._obj_waits.get(oid)
+                    if nf is None:
+                        nf = self.rpc_async({"type": "wait_object", "oid": oid})
+                        self._obj_waits[oid] = nf
+                    futures[idx] = (r, nf)
             ready = [r for r, f in futures if is_ready(f)]
             if len(ready) >= num_returns or (deadline is not None and time.monotonic() >= deadline):
                 break
@@ -831,7 +1228,9 @@ class CoreWorker:
         ready = [r for r in refs if r.hex() in ready_set]
         not_ready = [r for r in refs if r.hex() not in ready_set]
         for r in ready:
-            self._obj_waits.pop(r.hex(), None)
+            fut = self._obj_waits.pop(r.hex(), None)
+            if fut is not None and fut.event.is_set():
+                self._note_locations(r.hex(), fut.value)
         return ready, not_ready
 
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> bool:
@@ -840,6 +1239,10 @@ class CoreWorker:
         interrupted only with force=True (worker SIGKILL + normal
         death/retry bookkeeping, with retries suppressed)."""
         tid = ref.hex()[:-5]  # strip the rNNNN return suffix
+        if self._direct is not None:
+            r = self._direct.cancel(tid, force)
+            if r is not None:
+                return r
         reply = self.rpc({"type": "cancel_task", "task_id": tid,
                           "force": force})
         return bool(reply.get("cancelled"))
@@ -850,6 +1253,8 @@ class CoreWorker:
             self._memory.pop(oid, None)
             self._plasma_refs.pop(oid, None)
             self._obj_waits.pop(oid, None)
+            with self._owned_lock:
+                self._owned.pop(oid, None)
             self.store.delete(oid)
         self.rpc({"type": "free_objects", "oids": oids})
 
@@ -925,8 +1330,41 @@ class CoreWorker:
             args, kwargs = self._loads_restoring(plasma.buf)
         else:
             args, kwargs = self._loads_restoring(spec["args"])
-        args = tuple(self.get_object(a.hex) if isinstance(a, _RefMarker) else a for a in args)
-        kwargs = {k: self.get_object(v.hex) if isinstance(v, _RefMarker) else v for k, v in kwargs.items()}
+        inline_deps = spec.get("inline_deps") or {}
+
+        def resolve(oid: str):
+            if oid in self._memory:
+                return self._memory[oid]
+            # direct-path blobs: the caller attached its unpublished results
+            blob = inline_deps.get(oid)
+            if blob is not None:
+                value = self._loads_restoring(blob)
+                self._memory[oid] = value
+                return value
+            # chained direct task: the predecessor ran in THIS process
+            ds = self.direct_server
+            if ds is not None:
+                rec = ds.recent.get(oid)
+                if rec is not None:
+                    where, inline, is_err = rec
+                    if where == "inline" and inline is not None:
+                        value = self._loads_restoring(inline)
+                        if is_err:
+                            raise value
+                        self._memory[oid] = value
+                        return value
+                    if self.store.contains(oid):
+                        plasma = self.store.get(oid)
+                        self._plasma_refs[oid] = plasma
+                        value = self._loads_restoring(plasma.buf)
+                        if is_err:
+                            raise value
+                        self._memory[oid] = value
+                        return value
+            return self.get_object(oid)
+
+        args = tuple(resolve(a.hex) if isinstance(a, _RefMarker) else a for a in args)
+        kwargs = {k: resolve(v.hex) if isinstance(v, _RefMarker) else v for k, v in kwargs.items()}
         return args, kwargs
 
     @property
@@ -1005,7 +1443,11 @@ class CoreWorker:
             self._stream_events.pop(task_id, None)
             self._stream_cancelled.discard(task_id)
 
-    def execute_task(self, spec: dict) -> None:
+    def execute_spec(self, spec: dict) -> dict:
+        """Run a task spec to completion and return the task_done-shaped
+        report (results, error, contained, device_tensors) WITHOUT sending
+        it anywhere — the GCS exec path and the direct-dispatch path differ
+        only in where the report goes."""
         kind = spec["kind"]
         error_blob = None
         results = []
@@ -1017,7 +1459,12 @@ class CoreWorker:
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
-                func = ser.loads(spec["func"])
+                func = self._func_cache.get(spec["func"])
+                if func is None:
+                    func = ser.loads(spec["func"])
+                    if len(self._func_cache) > 256:
+                        self._func_cache.clear()
+                    self._func_cache[spec["func"]] = func
                 out = func(*args, **kwargs)
             elif kind == "actor_create":
                 cls = ser.loads(spec["func"])
@@ -1128,12 +1575,55 @@ class CoreWorker:
         # under us (reference: borrower protocol, reference_counter.h:43)
         self._flush_ref_deltas()
         done = {"type": "task_done", "wid": self.wid, "spec": lite,
+                "task_id": spec["task_id"],
                 "results": results, "error": error_blob,
                 "contained": contained_map}
         if _dev_map:
             # registry lifetime rides each result object: the GCS tells us to
             # drop a result's HBM entries when THAT object is freed
             done["device_tensors"] = _dev_map
+        return done
+
+    def register_direct_results(self, spec: dict, done: dict, server) -> None:
+        """After a direct task: make the outputs that need cluster-level
+        bookkeeping visible at the GCS — shm results (locations, spilling,
+        lineage for reconstruction) and inline results carrying nested refs
+        (the GCS must hold those for future borrowers). Pure-inline results
+        stay caller-local: zero GCS traffic on the hot path."""
+        results = done.get("results") or ()
+        contained = done.get("contained") or {}
+        is_err = done.get("error") is not None
+        published: list[str] = []
+        any_shm = False
+        for res in results:
+            oid, where, inline, size = res[:4]
+            server.note_recent(oid, where, inline, is_err)
+            tier = res[4] if len(res) > 4 else "shm"
+            if where == "shm":
+                any_shm = True
+                self.send_no_reply({
+                    "type": "object_put", "oid": oid, "where": "shm",
+                    "size": size, "host": self.host_id, "tier": tier,
+                    "is_error": is_err,
+                    "contained": contained.get(oid) or None})
+                published.append(oid)
+            elif contained.get(oid):
+                self.send_no_reply({
+                    "type": "object_put", "oid": oid, "where": "inline",
+                    "inline": inline, "size": size, "is_error": is_err,
+                    "contained": contained.get(oid)})
+                published.append(oid)
+        if (any_shm and spec.get("kind") == "task"
+                and isinstance(spec.get("num_returns"), int)):
+            # shm outputs are evictable/losable: retain lineage so the GCS
+            # can reconstruct them (inline results die with their owner)
+            lin = {k: v for k, v in spec.items() if k != "_cancelled"}
+            self.send_no_reply({"type": "direct_lineage", "spec": lin})
+        if published:
+            done["published"] = published
+
+    def execute_task(self, spec: dict) -> None:
+        done = self.execute_spec(spec)
         self.send_no_reply(done)
 
     def exec_loop(self):
@@ -1155,6 +1645,16 @@ class CoreWorker:
             _ref_tracker = None
         self._disconnecting = True
         self._alive = False
+        if self._direct is not None:
+            try:
+                self._direct.shutdown()
+            except Exception:
+                pass
+        if self.direct_server is not None:
+            try:
+                self.direct_server.stop()
+            except Exception:
+                pass
         try:
             self._flush_ref_deltas()
         except Exception:

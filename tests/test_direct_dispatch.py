@@ -1,0 +1,164 @@
+"""Direct (leased-worker) task dispatch: fast path, chaining, failure
+handling, cancel semantics, locality-aware lease targeting.
+
+(reference capability: src/ray/core_worker/task_submission/
+normal_task_submitter.h:81 direct task pushes to leased workers;
+lease_policy.h locality-aware leasing — VERDICT round-2 item 2.)
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+
+
+def _core():
+    return _api._get_worker()
+
+
+@ray_tpu.remote
+def add_one(x):
+    return x + 1
+
+
+def test_fast_path_engages(ray_start_regular):
+    assert ray_tpu.get(add_one.remote(1), timeout=60) == 2  # warm the pool
+    core = _core()
+    before = core._direct.submitted if core._direct else 0
+    out = ray_tpu.get([add_one.remote(i) for i in range(60)], timeout=60)
+    assert out == list(range(1, 61))
+    assert core._direct.submitted - before >= 50  # most rode the fast path
+
+
+def test_chained_direct_tasks(ray_start_regular):
+    r = add_one.remote(0)
+    for _ in range(40):
+        r = add_one.remote(r)
+    assert ray_tpu.get(r, timeout=60) == 41
+
+
+def test_direct_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("direct boom")
+
+    ref = boom.remote()
+    with pytest.raises(Exception, match="direct boom"):
+        ray_tpu.get(ref, timeout=60)
+    # an errored direct dep fails the dependent task too (GCS fallback path)
+    dep = boom.remote()
+    ref2 = add_one.remote(dep)
+    with pytest.raises(Exception, match="direct boom"):
+        ray_tpu.get(ref2, timeout=60)
+
+
+def test_direct_result_ref_escapes_to_actor(ray_start_regular):
+    """An unpublished direct result gets published when its ref leaves the
+    caller, so other processes can resolve it."""
+
+    @ray_tpu.remote
+    class Reader:
+        def read(self, ref):
+            return ray_tpu.get(ref)
+
+    val = add_one.remote(10)  # direct result, caller-local
+    assert ray_tpu.get(val, timeout=60) == 11
+    reader = Reader.remote()
+    assert ray_tpu.get(reader.read.remote([val]), timeout=60) == [11]
+
+
+def test_direct_worker_death_retries_via_gcs(ray_start_regular, tmp_path):
+    flag = str(tmp_path / "died-once")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        if not os.path.exists(flag):
+            open(flag, "w").write("x")
+            os._exit(1)  # kills the leased worker mid-task
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(), timeout=90) == "recovered"
+
+
+def test_direct_cancel_queued_behind_running(ray_start_regular):
+    """A direct task queued behind a long-running one on the same leased
+    worker is cancellable out of the worker's queue."""
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(20)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def quick():
+        return "quick"
+
+    h = hog.remote()
+    time.sleep(0.6)  # hog is running on the only 4-CPU lease
+    q = quick.remote()
+    time.sleep(0.2)
+    assert ray_tpu.cancel(q) is True
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    del h
+
+
+def test_wait_mixes_direct_and_gcs(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    fast_ref = add_one.remote(1)
+    slow_ref = slow.remote()
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=4)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+@ray_tpu.remote
+def whereami():
+    return os.environ.get("RAY_TPU_HOST_ID", "host-0")
+
+
+@ray_tpu.remote
+def consume(arr):
+    return (os.environ.get("RAY_TPU_HOST_ID", "host-0"), float(arr.sum()))
+
+
+def test_locality_large_arg_no_cross_host_bytes():
+    """A task whose big argument lives on a follower host is leased there:
+    the bytes never cross hosts (reference: lease_policy.h locality)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(num_cpus=2, num_workers=1,
+                                          max_workers=8))
+    try:
+        host = cluster.add_host(num_cpus=2)
+
+        @ray_tpu.remote
+        def make_big(n):
+            return np.full((n,), 2, dtype=np.float32)
+
+        big = make_big.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=host)).remote(400_000)  # ~1.6 MB, shm on follower
+        ray_tpu.wait([big], timeout=60)  # caches readiness + location
+
+        host_ran, total = ray_tpu.get(consume.remote(big), timeout=60)
+        assert total == 2.0 * 400_000
+        assert host_ran == host  # ran next to its argument
+        core = _core()
+        # the big argument's bytes never landed in the driver's store
+        assert not core.store.contains(big.hex())
+    finally:
+        cluster.shutdown()
