@@ -283,9 +283,24 @@ class DirectDispatcher:
         self.local_queue: dict[tuple, collections.deque] = {}
         self._next_try: dict[tuple, float] = {}
         self._backoff: dict[tuple, float] = {}
-        self._rid = 0
+        import itertools
+
+        self._rid = itertools.count(1)  # next() is atomic under the GIL
         self._pending: dict[int, object] = {}  # rid → _Future for cancels
         self.submitted = 0  # stats (tests assert the fast path engaged)
+        self._maint = threading.Thread(target=self._maintenance_loop,
+                                       daemon=True, name="direct-maint")
+        self._maint.start()
+
+    def _maintenance_loop(self):
+        # lease upkeep runs on its OWN thread: _grow blocks on a GCS RPC and
+        # must never stall the caller's refcount-flush cadence
+        while getattr(self.core, "_alive", True):
+            time.sleep(0.2)
+            try:
+                self.reap_idle()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ leasing
 
@@ -342,21 +357,23 @@ class DirectDispatcher:
         if grants:
             self.pump(key)
 
+    def _candidates(self, key: tuple, host: str | None = None) -> list[_Lease]:
+        """Live leases for `key` with pipeline headroom (optionally on one
+        host). Takes and releases self.lock."""
+        now = time.monotonic()
+        with self.lock:
+            return [l for l in self.leases.get(key, ())
+                    if not l.dead and not l.draining
+                    and (host is None or l.host == host)
+                    and len(l.inflight) < l.cap(now)]
+
     def pick(self, key: tuple, resources: dict, renv_hash: str,
              prefer_host: str | None) -> _Lease | None:
         """A lease with pipeline headroom, preferring `prefer_host`."""
-        now = time.monotonic()
-        with self.lock:
-            cands = [l for l in self.leases.get(key, ())
-                     if not l.dead and not l.draining
-                     and len(l.inflight) < l.cap(now)]
+        cands = self._candidates(key)
         if not cands:
             self._grow(key, resources, renv_hash, prefer_host)
-            now = time.monotonic()
-            with self.lock:
-                cands = [l for l in self.leases.get(key, ())
-                         if not l.dead and not l.draining
-                         and len(l.inflight) < l.cap(now)]
+            cands = self._candidates(key)
             if not cands:
                 return None
         if prefer_host is not None:
@@ -366,11 +383,7 @@ class DirectDispatcher:
             else:
                 # no lease on the preferred host yet: try to get one there
                 self._grow(key, resources, renv_hash, prefer_host)
-                with self.lock:
-                    fresh = [l for l in self.leases.get(key, ())
-                             if not l.dead and not l.draining
-                             and l.host == prefer_host
-                             and len(l.inflight) < l.cap(now)]
+                fresh = self._candidates(key, host=prefer_host)
                 if fresh:
                     cands = fresh
         return min(cands, key=lambda l: len(l.inflight))
@@ -428,8 +441,7 @@ class DirectDispatcher:
         return True
 
     def _send(self, lease: _Lease, spec: dict) -> bool:
-        self._rid += 1
-        rid = self._rid
+        rid = next(self._rid)
         with lease.lock:
             if lease.dead:
                 return False
@@ -453,8 +465,7 @@ class DirectDispatcher:
             if lease.dead:
                 return False
             for spec in specs:
-                self._rid += 1
-                items.append((self._rid, spec))
+                items.append((next(self._rid), spec))
                 lease.inflight[spec["task_id"]] = spec
             lease.last_used = time.monotonic()
         for spec in specs:
@@ -498,7 +509,7 @@ class DirectDispatcher:
                 else:
                     cands = [l for l in self.leases.get(key, ())
                              if not l.dead and not l.draining
-                             and len(l.inflight) < l.cap(now)]
+                             and len(l.inflight) < l.cap(now)]  # under lock
                     if not cands:
                         return
                     lease = min(cands, key=lambda l: len(l.inflight))
@@ -542,8 +553,7 @@ class DirectDispatcher:
         spec = lease.inflight.get(task_id)
         if spec is not None:
             spec["_cancelled"] = True
-        self._rid += 1
-        rid = self._rid
+        rid = next(self._rid)
         from ray_tpu._private.worker import _Future
 
         fut = _Future()

@@ -330,11 +330,6 @@ class CoreWorker:
         while self._alive:
             time.sleep(cfg.ref_flush_interval_s)
             self._flush_ref_deltas()
-            if self._direct is not None:
-                try:
-                    self._direct.reap_idle()
-                except Exception:
-                    pass
             now = time.time()
             if now - last_metrics >= cfg.metrics_report_interval_s:
                 last_metrics = now

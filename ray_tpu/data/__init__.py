@@ -5,10 +5,12 @@ physical stages, a pull-based streaming executor over the task runtime, and
 device-prefetching iterators feeding jax device_puts.)
 """
 
+from ray_tpu.data import aggregate
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import (
     DataIterator,
     Dataset,
+    GroupedData,
     MaterializedDataset,
     from_arrow,
     from_items,
@@ -31,7 +33,9 @@ __all__ = [
     "DataIterator",
     "Dataset",
     "Datasource",
+    "GroupedData",
     "MaterializedDataset",
+    "aggregate",
     "ReadTask",
     "from_arrow",
     "from_items",

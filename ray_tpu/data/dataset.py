@@ -91,6 +91,32 @@ class Dataset:
             refs.append(ray_tpu.put(list(o._materialize_blocks())))
         return Dataset(L.InputBlocks(refs=refs))
 
+    def groupby(self, key) -> "GroupedData":
+        """Group by one column (or a list of columns); aggregate with the
+        returned handle (reference: Dataset.groupby, data/grouped_data.py:23)."""
+        return GroupedData(self, [key] if isinstance(key, str) else list(key))
+
+    def join(self, other: "Dataset", on, *, right_on=None, how: str = "inner",
+             suffixes: tuple = ("", "_r"),
+             num_partitions: int | None = None) -> "Dataset":
+        """Distributed hash join (reference: Dataset.join,
+        data/_internal/execution/operators/join.py:54).
+
+        how: "inner" | "left" | "right" | "outer"."""
+        on = [on] if isinstance(on, str) else list(on)
+        right_on = on if right_on is None else (
+            [right_on] if isinstance(right_on, str) else list(right_on))
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        return self._append(L.Join(
+            right_last=other._op, on=on, right_on=right_on, how=how,
+            suffixes=tuple(suffixes), num_partitions=num_partitions))
+
+    def unique(self, column: str) -> list:
+        """Distinct values of one column."""
+        out = self.groupby(column).count().take_all()
+        return [r[column] for r in out]
+
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def add(batch):
             batch[name] = fn(batch)
@@ -233,6 +259,62 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset({' -> '.join(type(o).__name__ for o in self._op.chain())})"
+
+
+class GroupedData:
+    """Handle returned by Dataset.groupby: terminal aggregation methods
+    append a GroupByAgg (or MapGroups) op to the plan.
+
+    (reference: python/ray/data/grouped_data.py:23 — aggregate, count, sum,
+    min, max, mean, std, map_groups.)"""
+
+    def __init__(self, ds: Dataset, keys: list):
+        self._ds = ds
+        self._keys = keys
+
+    def aggregate(self, *aggs) -> Dataset:
+        from ray_tpu.data.aggregate import AggregateFn
+
+        for a in aggs:
+            if not isinstance(a, AggregateFn):
+                raise TypeError(f"expected AggregateFn, got {type(a)}")
+        return self._ds._append(L.GroupByAgg(keys=self._keys, aggs=list(aggs)))
+
+    def count(self) -> Dataset:
+        from ray_tpu.data.aggregate import Count
+
+        return self.aggregate(Count(alias_name="count()"))
+
+    def sum(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on, ddof=ddof))
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy") -> Dataset:
+        """Apply fn to each whole group; fn receives the group's rows as one
+        batch and returns a batch (dict of columns) or list of rows."""
+        return self._ds._append(L.MapGroups(keys=self._keys, fn=fn,
+                                            batch_format=batch_format))
 
 
 class MaterializedDataset(Dataset):

@@ -189,6 +189,24 @@ def build_stages(ops: list[L.LogicalOp], default_parallelism: int) -> list[Stage
         elif isinstance(op, L.Sort):
             flush()
             stages.append(Stage(name="Sort", a2a_refs=_dist_sort_refs(op.key, op.descending)))
+        elif isinstance(op, L.GroupByAgg):
+            from ray_tpu._private import serialization as ser
+
+            flush()
+            stages.append(Stage(
+                name="GroupByAgg",
+                a2a_refs=_dist_groupby_refs(op.keys, ser.dumps(op.aggs))))
+        elif isinstance(op, L.MapGroups):
+            from ray_tpu._private import serialization as ser
+
+            flush()
+            stages.append(Stage(
+                name="MapGroups",
+                a2a_refs=_dist_groupby_refs(op.keys, ser.dumps(op.fn),
+                                            map_groups=True)))
+        elif isinstance(op, L.Join):
+            flush()
+            stages.append(Stage(name="Join", a2a_refs=_dist_join_refs(op)))
         elif isinstance(op, L.Union):
             pass  # handled at Dataset level by ref concatenation
         else:
@@ -355,6 +373,234 @@ def _merge_sorted(key: str, descending: bool, *parts):
 def _normalize_parts(handle, w: int):
     """options(num_returns=w) returns a single ref for w==1."""
     return handle if isinstance(handle, list) else [handle]
+
+
+# ------------------------------------------------- groupby / join (hashed)
+# (reference: data/grouped_data.py:23 groupby/aggregate over a hash shuffle,
+# _internal/execution/operators/hash_shuffle.py + join.py:54)
+
+
+def _row_hashes(cols, n: int) -> np.ndarray:
+    """Stable per-row hash of the key columns (same value → same partition)."""
+    import zlib
+
+    h = np.zeros(n, dtype=np.uint64)
+    for c in cols:
+        a = np.asarray(c)
+        if a.dtype.kind in "iubf":
+            # ALL numerics hash through float64 so equal values co-locate
+            # across dtypes (int64 5 must meet float64 5.0 in a join);
+            # precision collisions just share a partition, which is fine
+            az = a.astype(np.float64)
+            az = np.where(az == 0.0, 0.0, az)  # -0.0 and 0.0 must co-locate
+            v = az.view(np.uint64)
+        else:
+            v = np.fromiter((zlib.crc32(str(x).encode()) for x in a),
+                            dtype=np.uint64, count=n)
+        h = h * np.uint64(1099511628211) + v
+    return h
+
+
+@ray_tpu.remote
+def _split_hash(payload, w: int, keys: list):
+    merged = concat_blocks(_as_blocks(payload))
+    if not merged:
+        return tuple([{}] for _ in range(w)) if w > 1 else [{}]
+    n = BlockAccessor(merged).num_rows()
+    cols = [merged[k] for k in keys]
+    assign = (_row_hashes(cols, n) % np.uint64(w)).astype(np.int64)
+    return _split_by_assignment(merged, assign, w)
+
+
+def _group_sorted(merged: Block, keys: list):
+    """Sort rows into group order; return (sorted block, group starts,
+    group counts)."""
+    n = BlockAccessor(merged).num_rows()
+    cols = [np.asarray(merged[k]) for k in keys]
+    order = np.lexsort(tuple(reversed(cols)))
+    srt = _take_rows(merged, order)
+    scols = [np.asarray(srt[k]) for k in keys]
+    if n == 0:
+        return srt, np.asarray([], dtype=np.int64), np.asarray([], dtype=np.int64)
+    newgrp = np.zeros(n, dtype=bool)
+    newgrp[0] = True
+    for c in scols:
+        newgrp[1:] |= c[1:] != c[:-1]
+    starts = np.nonzero(newgrp)[0]
+    counts = np.diff(np.concatenate([starts, [n]]))
+    return srt, starts, counts
+
+
+@ray_tpu.remote
+def _agg_partition(keys: list, aggs_blob: bytes, *parts):
+    from ray_tpu._private import serialization as ser
+
+    aggs = ser.loads(aggs_blob)
+    blocks = [b for p in parts for b in _as_blocks(p) if BlockAccessor(b).num_rows()]
+    if not blocks:
+        return [{}]
+    srt, starts, counts = _group_sorted(concat_blocks(blocks), keys)
+    out: Block = {k: np.asarray(srt[k])[starts] for k in keys}
+    for agg in aggs:
+        col = np.asarray(srt[agg.on]) if agg.on else None
+        vals = agg.compute(col, starts, counts)
+        out[agg.alias] = vals if isinstance(vals, list) else np.asarray(vals)
+    return [out]
+
+
+@ray_tpu.remote
+def _map_groups_partition(keys: list, fn_blob: bytes, *parts):
+    from ray_tpu._private import serialization as ser
+    from ray_tpu.data.block import rows_to_block
+
+    fn = ser.loads(fn_blob)
+    blocks = [b for p in parts for b in _as_blocks(p) if BlockAccessor(b).num_rows()]
+    if not blocks:
+        return [{}]
+    srt, starts, counts = _group_sorted(concat_blocks(blocks), keys)
+    n = BlockAccessor(srt).num_rows()
+    ends = np.concatenate([starts[1:], [n]])
+    outs = []
+    for s, e in zip(starts, ends):
+        group = {k: (np.asarray(v)[s:e] if isinstance(v, np.ndarray)
+                     else v[s:e]) for k, v in srt.items()}
+        res = fn(group)
+        if isinstance(res, dict):
+            outs.append(res)
+        else:  # list of rows
+            outs.append(rows_to_block(list(res)))
+    return [concat_blocks(outs)] if outs else [{}]
+
+
+@ray_tpu.remote
+def _join_partition(on: list, right_on: list, how: str, suffixes: tuple,
+                    n_left: int, *parts):
+    lparts, rparts = parts[:n_left], parts[n_left:]
+    lb = [b for p in lparts for b in _as_blocks(p) if BlockAccessor(b).num_rows()]
+    rb = [b for p in rparts for b in _as_blocks(p) if BlockAccessor(b).num_rows()]
+    left = concat_blocks(lb) if lb else {}
+    right = concat_blocks(rb) if rb else {}
+    ln = BlockAccessor(left).num_rows() if left else 0
+    rn = BlockAccessor(right).num_rows() if right else 0
+
+    lkeys = list(zip(*[np.asarray(left[k]) for k in on])) if ln else []
+    rkeys = list(zip(*[np.asarray(right[k]) for k in right_on])) if rn else []
+    rindex: dict = {}
+    for i, k in enumerate(rkeys):
+        rindex.setdefault(k, []).append(i)
+
+    li_out: list[int] = []
+    ri_out: list[int] = []   # -1 = no right match
+    r_matched = np.zeros(rn, dtype=bool)
+    for i, k in enumerate(lkeys):
+        hits = rindex.get(k)
+        if hits:
+            for j in hits:
+                li_out.append(i)
+                ri_out.append(j)
+                r_matched[j] = True
+        elif how in ("left", "outer"):
+            li_out.append(i)
+            ri_out.append(-1)
+    if how in ("right", "outer"):
+        for j in np.nonzero(~r_matched)[0]:
+            li_out.append(-1)
+            ri_out.append(int(j))
+    if not li_out:
+        return [{}]
+    li = np.asarray(li_out)
+    ri = np.asarray(ri_out)
+
+    ls, rs = suffixes
+    lcols = list(left.keys()) if ln else []
+    rcols = [c for c in (right.keys() if rn else []) if c not in right_on]
+    out: Block = {}
+
+    def gather(col_vals, idx, n_src):
+        arr = np.asarray(col_vals)
+        missing = idx < 0
+        if not missing.any():
+            return arr[idx]
+        if arr.dtype.kind in "fiub":
+            res = np.full(len(idx), np.nan, dtype=np.float64)
+            res[~missing] = arr[idx[~missing]].astype(np.float64)
+            return res
+        res = np.empty(len(idx), dtype=object)
+        res[~missing] = arr[idx[~missing]]
+        return res
+
+    # join keys: from the left side, falling back to the right for
+    # right/outer rows with no left match
+    for kl, kr in zip(on, right_on):
+        kv = gather(left[kl], li, ln) if ln else None
+        if how in ("right", "outer") and rn:
+            rv = gather(right[kr], ri, rn)
+            if kv is None:
+                kv = rv
+            else:
+                miss = li < 0
+                if miss.any():
+                    kv = np.asarray(kv, dtype=object)
+                    kv[miss] = np.asarray(rv, dtype=object)[miss]
+        out[kl] = kv
+    for c in lcols:
+        if c in on:
+            continue
+        name = c + (ls if c in rcols else "")
+        out[name] = gather(left[c], li, ln)
+    for c in rcols:
+        # suffix on ANY collision with an already-emitted left column —
+        # including the join keys, which a right non-key column may shadow
+        name = c + (rs if (c in lcols or c in on) else "")
+        out[name] = gather(right[c], ri, rn)
+    return [out]
+
+
+def _dist_groupby_refs(keys: list, aggs_blob: bytes, map_groups: bool = False):
+    def run(inputs: list) -> list:
+        if not inputs:
+            return []
+        w = len(inputs)
+        parts = [_normalize_parts(
+            _split_hash.options(num_returns=w).remote(it, w, keys), w)
+            for it in inputs]
+        task = _map_groups_partition if map_groups else _agg_partition
+        return [task.remote(keys, aggs_blob, *[p[j] for p in parts])
+                for j in range(w)]
+
+    return run
+
+
+def _dist_join_refs(op):
+    """op: logical.Join — the right plan executes to refs inside the stage
+    (a barrier anyway), then both sides hash-shuffle into w partitions and
+    one join task merges each."""
+
+    def run(inputs: list) -> list:
+        from ray_tpu.data import logical as L
+
+        right_stages = build_stages(L.optimize(op.right_last.chain()), 8)
+        ex = StreamingExecutor(right_stages)
+        right_refs = []
+        for item in ex.execute():
+            if not hasattr(item, "hex"):
+                item = ray_tpu.put(item if isinstance(item, list) else [item])
+            else:
+                ex.owned.discard(item.hex())  # ownership moves to this stage
+            right_refs.append(item)
+        w = op.num_partitions or max(len(inputs), len(right_refs), 1)
+        lparts = [_normalize_parts(
+            _split_hash.options(num_returns=w).remote(it, w, op.on), w)
+            for it in inputs]
+        rparts = [_normalize_parts(
+            _split_hash.options(num_returns=w).remote(it, w, op.right_on), w)
+            for it in right_refs]
+        return [_join_partition.remote(
+            op.on, op.right_on, op.how, op.suffixes, len(lparts),
+            *[p[j] for p in lparts], *[p[j] for p in rparts])
+            for j in range(w)]
+
+    return run
 
 
 def _dist_shuffle_refs(seed):

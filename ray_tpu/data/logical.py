@@ -92,6 +92,40 @@ class Union(LogicalOp):
     input: LogicalOp | None = None
 
 
+@dataclass
+class GroupByAgg(LogicalOp):
+    """Hash-shuffle by key columns, then aggregate each partition.
+    (reference: data/grouped_data.py:23 + hash_shuffle.py)"""
+
+    keys: list = field(default_factory=list)   # list[str]
+    aggs: list = field(default_factory=list)   # list[AggregateFn]
+    input: LogicalOp | None = None
+
+
+@dataclass
+class MapGroups(LogicalOp):
+    """Hash-shuffle by key columns, then apply fn per group."""
+
+    keys: list = field(default_factory=list)
+    fn: Callable = None
+    input: LogicalOp | None = None
+    batch_format: str = "numpy"
+
+
+@dataclass
+class Join(LogicalOp):
+    """Distributed hash join against another dataset's plan.
+    (reference: data/_internal/execution/operators/join.py:54)"""
+
+    right_last: LogicalOp = None               # other dataset's plan tail
+    on: list = field(default_factory=list)     # left key columns
+    right_on: list = field(default_factory=list)
+    how: str = "inner"                         # inner | left | right | outer
+    suffixes: tuple = ("", "_r")
+    num_partitions: int | None = None
+    input: LogicalOp | None = None
+
+
 # ----------------------------------------------------------------- optimizer
 
 

@@ -1,0 +1,178 @@
+"""Dataset.groupby/aggregate + Dataset.join: distributed hash shuffle into
+per-partition aggregate/join tasks.
+
+(reference: python/ray/data/grouped_data.py:23, data/aggregate.py,
+_internal/execution/operators/hash_shuffle.py + join.py:54 — VERDICT
+round-2 item 3.)
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Quantile, Std, Sum
+
+
+@pytest.fixture
+def rows():
+    # 3 groups spread over multiple blocks
+    return [{"k": ["a", "b", "c"][i % 3], "v": float(i), "w": i * 2}
+            for i in range(60)]
+
+
+def _by_key(out, key="k"):
+    return {r[key]: r for r in out}
+
+
+def test_groupby_count_sum(ray_start_regular, rows):
+    ds = rd.from_items(rows)
+    out = ds.groupby("k").aggregate(Count(), Sum("v")).take_all()
+    assert len(out) == 3
+    got = _by_key(out)
+    for g in "abc":
+        expect = [r["v"] for r in rows if r["k"] == g]
+        assert got[g]["count()"] == len(expect)
+        assert got[g]["sum(v)"] == pytest.approx(sum(expect))
+
+
+def test_groupby_min_max_mean_std_quantile(ray_start_regular, rows):
+    ds = rd.from_items(rows)
+    out = ds.groupby("k").aggregate(
+        Min("v"), Max("v"), Mean("v"), Std("v"), Quantile("v", q=0.5)).take_all()
+    got = _by_key(out)
+    for g in "abc":
+        vs = [r["v"] for r in rows if r["k"] == g]
+        assert got[g]["min(v)"] == min(vs)
+        assert got[g]["max(v)"] == max(vs)
+        assert got[g]["mean(v)"] == pytest.approx(sum(vs) / len(vs))
+        assert got[g]["std(v)"] == pytest.approx(np.std(vs, ddof=1))
+        assert got[g]["quantile(v)"] == pytest.approx(np.quantile(vs, 0.5))
+
+
+def test_groupby_multi_key_and_numeric_keys(ray_start_regular):
+    rows = [{"a": i % 2, "b": i % 3, "v": i} for i in range(36)]
+    out = rd.from_items(rows).groupby(["a", "b"]).sum("v").take_all()
+    assert len(out) == 6
+    for r in out:
+        expect = sum(x["v"] for x in rows
+                     if x["a"] == r["a"] and x["b"] == r["b"])
+        assert r["sum(v)"] == expect
+
+
+def test_groupby_after_map(ray_start_regular, rows):
+    ds = rd.from_items(rows).map(lambda r: {**r, "v": r["v"] * 10})
+    out = ds.groupby("k").mean("v").take_all()
+    got = _by_key(out)
+    vs = [r["v"] * 10 for r in rows if r["k"] == "a"]
+    assert got["a"]["mean(v)"] == pytest.approx(sum(vs) / len(vs))
+
+
+def test_map_groups(ray_start_regular, rows):
+    ds = rd.from_items(rows)
+
+    def top1(group):
+        i = int(np.argmax(np.asarray(group["v"])))
+        return {"k": np.asarray(group["k"])[i:i + 1],
+                "v": np.asarray(group["v"])[i:i + 1]}
+
+    out = ds.groupby("k").map_groups(top1).take_all()
+    got = _by_key(out)
+    assert len(out) == 3
+    for g in "abc":
+        assert got[g]["v"] == max(r["v"] for r in rows if r["k"] == g)
+
+
+def test_unique(ray_start_regular, rows):
+    vals = rd.from_items(rows).unique("k")
+    assert sorted(vals) == ["a", "b", "c"]
+
+
+def test_join_inner(ray_start_regular):
+    left = rd.from_items([{"id": i, "x": i * 1.0} for i in range(20)])
+    right = rd.from_items([{"id": i, "y": i * 10} for i in range(10, 30)])
+    out = left.join(right, on="id").take_all()
+    assert len(out) == 10  # ids 10..19
+    for r in out:
+        assert 10 <= r["id"] < 20
+        assert r["x"] == float(r["id"])
+        assert r["y"] == r["id"] * 10
+
+
+def test_join_left_right_outer(ray_start_regular):
+    left = rd.from_items([{"id": i, "x": float(i)} for i in range(6)])
+    right = rd.from_items([{"id": i, "y": i * 10} for i in range(3, 9)])
+
+    lo = left.join(right, on="id", how="left").take_all()
+    assert len(lo) == 6
+    miss = [r for r in lo if r["id"] < 3]
+    assert all(math.isnan(r["y"]) for r in miss)
+
+    ro = left.join(right, on="id", how="right").take_all()
+    assert len(ro) == 6
+    assert sorted(r["id"] for r in ro) == [3, 4, 5, 6, 7, 8]
+
+    oo = left.join(right, on="id", how="outer").take_all()
+    assert sorted(r["id"] for r in oo) == list(range(9))
+
+
+def test_join_duplicate_keys_and_suffixes(ray_start_regular):
+    left = rd.from_items([{"id": 1, "v": 1.0}, {"id": 1, "v": 2.0}])
+    right = rd.from_items([{"id": 1, "v": 10.0}, {"id": 1, "v": 20.0}])
+    out = left.join(right, on="id", suffixes=("_l", "_r")).take_all()
+    assert len(out) == 4  # 2x2 cross within the key group
+    assert {(r["v_l"], r["v_r"]) for r in out} == {
+        (1.0, 10.0), (1.0, 20.0), (2.0, 10.0), (2.0, 20.0)}
+
+
+def test_join_mixed_key_dtypes(ray_start_regular):
+    """int64 keys on one side, float64 on the other must still co-locate."""
+    left = rd.from_items([{"id": i, "x": i} for i in range(8)])  # int keys
+    right = rd.from_items([{"id": float(i), "y": i * 3} for i in range(8)])
+    out = left.join(right, on="id").take_all()
+    assert len(out) == 8
+    for r in out:
+        assert r["y"] == int(r["id"]) * 3
+
+
+def test_join_right_column_shadows_key(ray_start_regular):
+    """A right non-key column named like the left join key gets suffixed
+    instead of overwriting the key output."""
+    left = rd.from_items([{"id": i, "x": i} for i in range(4)])
+    right = rd.from_items([{"rid": i, "id": i * 100} for i in range(4)])
+    out = left.join(right, on="id", right_on="rid",
+                    suffixes=("", "_r")).take_all()
+    assert len(out) == 4
+    for r in out:
+        assert r["id"] < 4          # the join key survived
+        assert r["id_r"] == r["id"] * 100
+
+
+def test_join_different_key_names(ray_start_regular):
+    left = rd.from_items([{"lid": i, "x": i} for i in range(5)])
+    right = rd.from_items([{"rid": i, "y": i * 2} for i in range(5)])
+    out = left.join(right, on="lid", right_on="rid").take_all()
+    assert len(out) == 5
+    for r in out:
+        assert r["y"] == r["lid"] * 2
+
+
+@pytest.mark.slow
+def test_groupby_multihost():
+    """Hash partitions + aggregate tasks run across follower hosts."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(num_cpus=2, num_workers=1,
+                                          max_workers=8))
+    try:
+        cluster.add_host(num_cpus=2)
+        rows = [{"k": i % 5, "v": float(i)} for i in range(500)]
+        out = rd.from_items(rows).groupby("k").sum("v").take_all()
+        assert len(out) == 5
+        for r in out:
+            assert r["sum(v)"] == sum(x["v"] for x in rows if x["k"] == r["k"])
+    finally:
+        cluster.shutdown()
