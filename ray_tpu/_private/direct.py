@@ -238,7 +238,8 @@ class DirectServer:
 
 class _Lease:
     __slots__ = ("wid", "addr", "host", "node", "token", "conn", "inflight",
-                 "last_used", "last_done", "dead", "draining", "key", "lock")
+                 "last_used", "last_done", "dead", "draining", "key", "lock",
+                 "death_reason")
 
     def __init__(self, wid, addr, host, node, token, conn, key):
         self.wid = wid
@@ -253,6 +254,7 @@ class _Lease:
         self.last_done = 0.0
         self.dead = False
         self.draining = False
+        self.death_reason: str | None = None
         self.lock = threading.Lock()
 
     def cap(self, now: float) -> int:
@@ -626,6 +628,14 @@ class DirectDispatcher:
             lease.conn.close()
         except Exception:
             pass
+        if pending:
+            # one reason lookup covers every spec this lease was running
+            try:
+                lease.death_reason = self.core.rpc(
+                    {"type": "worker_death_reason", "wid": lease.wid},
+                    timeout=5.0).get("reason")
+            except Exception:
+                lease.death_reason = None
         for spec in pending:
             self.core._direct_task_failed(spec, lease)
         self.pump(lease.key)

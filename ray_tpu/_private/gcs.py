@@ -52,7 +52,7 @@ class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
                  "running_tasks", "node_id", "tpu_chips", "host_id",
                  "ref_balance", "renv_hash", "direct_addr", "leased_to",
-                 "lease_spec", "lease_token")
+                 "lease_spec", "lease_token", "oom_why")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
                  tpu_chips: tuple = (), host_id: str = "host-0",
@@ -82,6 +82,7 @@ class _Worker:
         self.leased_to: str | None = None  # caller wid holding the lease
         self.lease_spec: dict | None = None  # resources held by the lease
         self.lease_token: int | None = None  # guards stale release messages
+        self.oom_why: str | None = None  # set by the memory monitor pre-kill
 
 
 class _Actor:
@@ -358,6 +359,63 @@ class GcsServer:
             target=self._accept_loop, args=(self._tcp_listener,), daemon=True,
             name="gcs-accept-tcp")
         self._tcp_accept_thread.start()
+        # OOM defense for the head host (reference: memory_monitor.h:52 +
+        # worker_killing_policy_group_by_owner.h:87); node agents run their
+        # own for follower hosts
+        self._mem_monitor = None
+        refresh_ms = RayConfig.get("memory_monitor_refresh_ms")
+        if refresh_ms > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self._mem_monitor = MemoryMonitor(
+                threshold=RayConfig.get("memory_usage_threshold"),
+                period_s=refresh_ms / 1000.0,
+                pick_victim=self._pick_oom_victim,
+                on_kill=self._note_oom_kill).start()
+
+    def _pick_oom_victim(self):
+        """Newest retriable running plain task's worker on the head host,
+        then any running plain task's worker, then the newest-leased direct
+        worker — never actors or infrastructure (reference:
+        worker_killing_policy_group_by_owner.h:87)."""
+        with self.lock:
+            best = None  # ((retriable, newest_ts), worker)
+            for w in self.workers.values():
+                if (w.kind != "worker" or w.dead or w.host_id != HEAD_HOST
+                        or w.actor_id is not None or not w.pid):
+                    continue
+                plain = [s for s in w.running_tasks.values()
+                         if s.get("kind") == "task"]
+                if not plain:
+                    continue
+                ts = max(s.get("_ts", 0.0) for s in plain)
+                retriable = any(s.get("retries_used", 0) < s.get("max_retries", 0)
+                                for s in plain)
+                key = (1 if retriable else 0, ts)
+                if best is None or key > best[0]:
+                    best = (key, w)
+            if best is not None:
+                w = best[1]
+                names = [s.get("name") or s.get("task_id", "")[:8]
+                         for s in w.running_tasks.values()]
+                return w.pid, f"worker {w.wid[:8]} running {names}"
+            leased = [w for w in self.workers.values()
+                      if w.kind == "worker" and not w.dead and w.pid
+                      and w.host_id == HEAD_HOST and w.leased_to is not None]
+            if leased:
+                w = max(leased, key=lambda x: x.lease_token or 0)
+                return w.pid, f"leased worker {w.wid[:8]}"
+        return None
+
+    def _note_oom_kill(self, pid: int, why: str | None) -> None:
+        with self.lock:
+            for w in self.workers.values():
+                if w.pid == pid and not w.dead:
+                    w.oom_why = why
+                    break
+        if why is not None:
+            self.publish("errors", {"kind": "oom_kill", "error": why,
+                                    "ts": time.time()})
 
     def crash_for_testing(self):
         """Abruptly drop every connection and listener WITHOUT the graceful
@@ -400,6 +458,8 @@ class GcsServer:
                 pass
 
     def stop(self):
+        if getattr(self, "_mem_monitor", None) is not None:
+            self._mem_monitor.stop()
         if self.storage is not None:
             self.storage.close()
         self._pub_sendq.put(None)
@@ -689,6 +749,13 @@ class GcsServer:
         elif t == "lease_released":
             # a worker reporting its caller's connection closed
             self._release_lease(msg["wid"], msg.get("token"))
+        elif t == "worker_death_reason":
+            # direct-dispatch callers ask why their leased worker vanished
+            # (e.g. the memory monitor killed it) to build a useful error
+            with self.lock:
+                w2 = self.workers.get(msg["wid"])
+                why = w2.oom_why if w2 is not None else None
+            conn.send({"rid": msg["rid"], "reason": why})
         elif t == "direct_lineage":
             # a direct task produced evictable (shm) outputs: retain its spec
             # for reconstruction, same budget as GCS-path tasks
@@ -1839,6 +1906,7 @@ class GcsServer:
                 pool.remove(w)
                 self._acquire_for(spec, node_id)
                 w.idle = False
+                spec["_ts"] = time.monotonic()
                 w.running_tasks[spec["task_id"]] = spec
                 if spec["kind"] == "actor_create":
                     w.actor_id = spec["actor_id"]
@@ -2653,10 +2721,11 @@ class GcsServer:
                             self._actor_dead_cleanup_locked(actor.create_spec))
         if death_free:
             self._free_objects(death_free)
+        death_reason = w.oom_why or f"worker {wid} died"
         for spec in fail:
             self._fail_task_objects(
                 spec, "task was cancelled" if spec.get("_cancelled")
-                else f"worker {wid} died")
+                else death_reason)
         if requeue is not None:
             with self.lock:
                 self.pending_tasks.appendleft(requeue)

@@ -68,6 +68,26 @@ class NodeAgent:
         })
         self.log_monitor = LogMonitor(
             os.path.join(self.session_dir, "logs"), sink=self._forward_log).start()
+        # OOM defense for THIS host: no task metadata here, so the victim is
+        # the fattest live worker child — the GCS death path retries its
+        # tasks (reference: per-raylet memory monitor, memory_monitor.h:52)
+        self.mem_monitor = None
+        from ray_tpu._private.ray_config import RayConfig
+        refresh_ms = RayConfig.get("memory_monitor_refresh_ms")
+        if refresh_ms > 0:
+            from ray_tpu._private.memory_monitor import (MemoryMonitor,
+                                                         proc_rss_bytes)
+
+            def pick():
+                live = [p for p in self._procs if p.poll() is None]
+                if not live:
+                    return None
+                fat = max(live, key=lambda p: proc_rss_bytes(p.pid))
+                return fat.pid, f"worker pid {fat.pid} on host {self.host_id}"
+
+            self.mem_monitor = MemoryMonitor(
+                threshold=RayConfig.get("memory_usage_threshold"),
+                period_s=refresh_ms / 1000.0, pick_victim=pick).start()
 
     def _rpc(self, msg: dict) -> dict:
         msg["rid"] = self._rid
@@ -158,6 +178,8 @@ class NodeAgent:
             self._procs.append(p)
 
     def shutdown(self):
+        if self.mem_monitor is not None:
+            self.mem_monitor.stop()
         self.log_monitor.stop()
         self.obj_server.stop()
         deadline = time.monotonic() + 3.0

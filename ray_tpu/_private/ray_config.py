@@ -85,6 +85,16 @@ class RayConfig:
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
 
+    # --- memory / OOM defense -------------------------------------------
+    # Host memory-monitor poll period in ms; 0 disables (reference:
+    # memory_monitor.h:52 polls at memory_monitor_refresh_ms). Off by
+    # default here so test runs on loaded hosts stay deterministic; node
+    # deployments enable it (ray_tpu start / node_agent pass it through).
+    memory_monitor_refresh_ms: int = 0
+    # Usage fraction past which a victim worker is killed (reference:
+    # memory_usage_threshold 0.95).
+    memory_usage_threshold: float = 0.95
+
     # --- GCS persistence ------------------------------------------------
     # Path for the GCS write-ahead table store; empty = in-memory only
     # (reference: redis_store_client.h — Redis mode = fault tolerance).

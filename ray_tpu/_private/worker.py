@@ -927,11 +927,13 @@ class CoreWorker:
         else:
             for d in self._flight_holds.pop(tid, ()):
                 self.decref(d)
+            # the GCS may know more (e.g. the memory monitor killed it) —
+            # fetched once per dead lease in _fail_lease
+            why = (getattr(lease, "death_reason", None)
+                   or f"worker {lease.wid} died")
             with self._owned_lock:
                 self._owned_fail_locked(
-                    spec,
-                    WorkerCrashedError(f"worker {lease.wid} died"),
-                    publish_later)
+                    spec, WorkerCrashedError(why), publish_later)
         self._publish_owned(publish_later)
         for oid in publish_later:
             self.decref(oid)

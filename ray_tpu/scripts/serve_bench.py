@@ -1,0 +1,153 @@
+"""Serve data-plane microbenchmark — `python -m ray_tpu.scripts.serve_bench`.
+
+Measures noop HTTP latency (sequential + concurrent), handle-path latency,
+and concurrent SSE streaming; writes SERVE_BENCH.json at the repo root so
+numbers are committed round-over-round.
+
+(reference: the serve microbenchmarks under release/serve_tests — noop
+latency / throughput over the proxy; VERDICT round-2 weak item 5.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=10)
+    results = []
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=32)
+    def noop(req):
+        return {"ok": True}
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=32)
+    class Streamer:
+        def stream_request(self, req):
+            for i in range(((req.get("body") or {}).get("n") or 16)):
+                yield {"i": i}
+
+        def __call__(self, req):
+            return {"ok": True}
+
+    serve.run(noop.bind(), name="noop", route_prefix="/noop")
+    serve.run(Streamer.bind(), name="stream", route_prefix="/stream")
+    serve.start(http_port=0)
+    host, port = serve.http_address()
+    url = f"http://{host}:{port}/noop"
+    _post(url, {})  # warm
+
+    # sequential noop latency over one keep-alive connection
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    N = 300
+    t0 = time.perf_counter()
+    for _ in range(N):
+        conn.request("POST", "/noop", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+    dt = (time.perf_counter() - t0) / N
+    conn.close()
+    results.append({"name": "http_noop_sequential",
+                    "ops_per_s": round(1 / dt, 1),
+                    "us_per_op": round(dt * 1e6, 1)})
+    print(f"http_noop_sequential: {1/dt:,.0f} req/s  ({dt*1e3:.2f} ms)")
+
+    # concurrent noop throughput (16 client threads, keep-alive each)
+    CT, PER = 16, 60
+    done = []
+
+    def worker():
+        c = http.client.HTTPConnection(host, port, timeout=30)
+        n = 0
+        for _ in range(PER):
+            c.request("POST", "/noop", body=b"{}",
+                      headers={"Content-Type": "application/json"})
+            r = c.getresponse()
+            r.read()
+            if r.status == 200:
+                n += 1
+        c.close()
+        done.append(n)
+
+    threads = [threading.Thread(target=worker) for _ in range(CT)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    ok = sum(done)
+    results.append({"name": "http_noop_concurrent16",
+                    "ops_per_s": round(ok / wall, 1),
+                    "us_per_op": round(wall / max(ok, 1) * 1e6, 1)})
+    print(f"http_noop_concurrent16: {ok/wall:,.0f} req/s ({ok} ok)")
+
+    # handle path (no HTTP)
+    handle = serve.get_deployment_handle("noop", app_name="noop")
+    t0 = time.perf_counter()
+    for _ in range(N):
+        handle.remote({}).result(timeout_s=30)
+    dt = (time.perf_counter() - t0) / N
+    results.append({"name": "handle_noop_sequential",
+                    "ops_per_s": round(1 / dt, 1),
+                    "us_per_op": round(dt * 1e6, 1)})
+    print(f"handle_noop_sequential: {1/dt:,.0f} req/s  ({dt*1e3:.2f} ms)")
+
+    # concurrent SSE streams: 8 clients x 32 events
+    SC, EVENTS = 8, 32
+    stream_ok = []
+
+    def stream_worker():
+        req = urllib.request.Request(
+            f"http://{host}:{port}/stream",
+            data=json.dumps({"n": EVENTS, "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Accept": "text/event-stream"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            n = sum(1 for ln in resp if ln.startswith(b"data:")) - 1  # [DONE]
+        stream_ok.append(n)
+
+    sthreads = [threading.Thread(target=stream_worker) for _ in range(SC)]
+    t0 = time.perf_counter()
+    for t in sthreads:
+        t.start()
+    for t in sthreads:
+        t.join()
+    wall = time.perf_counter() - t0
+    events = sum(stream_ok)
+    assert all(n == EVENTS for n in stream_ok), stream_ok
+    results.append({"name": "sse_stream_concurrent8_events_per_s",
+                    "ops_per_s": round(events / wall, 1),
+                    "us_per_op": round(wall / max(events, 1) * 1e6, 1)})
+    print(f"sse_concurrent8: {events/wall:,.0f} events/s ({len(stream_ok)} streams complete)")
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    out = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "SERVE_BENCH.json")
+    with open(out, "w") as f:
+        json.dump({"ts": time.strftime("%Y-%m-%d %H:%M"),
+                   "results": results}, f, indent=1)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

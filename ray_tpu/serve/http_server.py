@@ -1,0 +1,259 @@
+"""Asyncio HTTP/1.1 server for the Serve data plane.
+
+Replaces the thread-per-request stdlib server: one event loop handles all
+connections (keep-alive, pipelined clients, slow readers) with a bounded
+connection semaphore; blocking deployment-handle calls run on a bounded
+executor so the loop never stalls; streaming responses bridge a blocking
+generator into chunked transfer frames through an asyncio queue; shutdown
+is graceful — stop accepting, drain in-flight requests up to a deadline,
+then close.
+
+(reference: python/ray/serve/_private/proxy.py:706 — uvicorn-based proxy
+with graceful draining; uvicorn isn't in the image, so this is a minimal
+native-asyncio equivalent.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+
+class _BadRequest(Exception):
+    pass
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 512 * 1024 * 1024
+
+
+class AsyncHTTPServer:
+    """`handler(method, path, headers, body)` returns
+    (status, content_type, payload_bytes) for plain responses or
+    (status, content_type, iterator) where an iterator streams chunks
+    (SSE-style, sent with chunked transfer encoding). The handler runs on
+    the executor — it may block."""
+
+    def __init__(self, handler: Callable, host: str = "127.0.0.1",
+                 port: int = 0, *, max_connections: int = 1024,
+                 executor_workers: int = 32, drain_grace_s: float = 10.0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self.drain_grace_s = drain_grace_s
+        self._max_connections = max_connections
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_workers, thread_name_prefix="serve-http")
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.base_events.Server | None = None
+        self._inflight = 0
+        self._inflight_zero = threading.Event()
+        self._inflight_zero.set()
+        self._stopping = False
+        self._start_error: BaseException | None = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-http-loop")
+
+    # ---------------------------------------------------------------- start
+
+    def start(self) -> "AsyncHTTPServer":
+        self._thread.start()
+        if not self._started.wait(30.0):
+            raise RuntimeError("HTTP server failed to start")
+        if self._start_error is not None:
+            raise self._start_error
+        return self
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+
+    async def _serve(self):
+        self._conn_sem = asyncio.Semaphore(self._max_connections)
+        try:
+            self._server = await asyncio.start_server(
+                self._on_connection, self.host, self.port)
+        except OSError as e:  # bind failure surfaces to start() immediately
+            self._start_error = e
+            self._started.set()
+            return
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with self._server:
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+    # ------------------------------------------------------------ connection
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter):
+        async with self._conn_sem:
+            try:
+                while not self._stopping:
+                    req = await self._read_request(reader)
+                    if req is None:
+                        break
+                    method, path, headers, body = req
+                    self._inflight += 1
+                    self._inflight_zero.clear()
+                    try:
+                        keep = await self._respond(writer, method, path,
+                                                   headers, body)
+                    finally:
+                        self._inflight -= 1
+                        if self._inflight == 0:
+                            self._inflight_zero.set()
+                    if not keep:
+                        break
+            except _BadRequest:
+                try:
+                    body = b'{"error": "bad request"}'
+                    writer.write(
+                        b"HTTP/1.1 400 X\r\nContent-Type: application/json\r\n"
+                        + f"Content-Length: {len(body)}\r\n".encode()
+                        + b"Connection: close\r\n\r\n" + body)
+                    await writer.drain()
+                except Exception:
+                    pass
+            except (asyncio.IncompleteReadError, ConnectionResetError,
+                    asyncio.LimitOverrunError, BrokenPipeError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                    await writer.wait_closed()
+                except Exception:
+                    pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            return None
+        if len(head) > MAX_HEADER_BYTES:
+            return None
+        lines = head.decode("latin1").split("\r\n")
+        parts = lines[0].split()
+        if len(parts) < 3:
+            return None
+        method, path = parts[0], parts[1]
+        headers: dict[str, str] = {}
+        for ln in lines[1:]:
+            if ":" in ln:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+        try:
+            n = int(headers.get("content-length") or 0)
+        except ValueError as e:
+            raise _BadRequest from e
+        if n < 0 or n > MAX_BODY_BYTES:
+            raise _BadRequest
+        body = await reader.readexactly(n) if n else b""
+        return method, path, headers, body
+
+    async def _respond(self, writer: asyncio.StreamWriter, method: str,
+                       path: str, headers: dict, body: bytes) -> bool:
+        loop = asyncio.get_running_loop()
+        try:
+            status, ctype, payload = await loop.run_in_executor(
+                self._executor, self.handler, method, path, headers, body)
+        except Exception as e:  # noqa: BLE001 — the server must answer
+            payload = json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode()
+            status, ctype = 500, "application/json"
+        keep = (headers.get("connection", "").lower() != "close"
+                and not self._stopping)
+        if isinstance(payload, (bytes, bytearray)):
+            writer.write(
+                f"HTTP/1.1 {status} X\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: {'keep-alive' if keep else 'close'}\r\n"
+                f"\r\n".encode() + payload)
+            await writer.drain()
+            return keep
+        # streaming: a blocking iterator bridged through an asyncio queue
+        writer.write(
+            f"HTTP/1.1 {status} X\r\nContent-Type: {ctype}\r\n"
+            "Cache-Control: no-cache\r\nTransfer-Encoding: chunked\r\n"
+            "Connection: close\r\n\r\n".encode())
+        await writer.drain()
+        q: asyncio.Queue = asyncio.Queue(maxsize=16)
+        DONE = object()
+        aborted = threading.Event()  # consumer gone: pump must not block
+
+        def put_blocking(item) -> bool:
+            while not aborted.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
+                try:
+                    fut.result(timeout=0.5)
+                    return True
+                except concurrent.futures.TimeoutError:
+                    fut.cancel()  # slow/dead consumer: re-check aborted
+                except Exception:
+                    return False  # loop closed
+            return False
+
+        def pump():
+            try:
+                try:
+                    for item in payload:
+                        if not put_blocking(item):
+                            return
+                except Exception as e:  # noqa: BLE001 — surfaced as a chunk
+                    put_blocking(e)
+                put_blocking(DONE)
+            finally:
+                close = getattr(payload, "close", None)
+                if close is not None:
+                    try:
+                        close()  # release the deployment generator
+                    except Exception:
+                        pass
+
+        self._executor.submit(pump)
+        try:
+            while True:
+                item = await q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, Exception):
+                    chunk = (b"data: " + json.dumps(
+                        {"error": f"{type(item).__name__}: {item}"}).encode()
+                        + b"\n\n")
+                else:
+                    chunk = item if isinstance(item, (bytes, bytearray)) else str(item).encode()
+                writer.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        finally:
+            aborted.set()
+        return False
+
+    # ----------------------------------------------------------------- stop
+
+    def stop(self, graceful: bool = True) -> None:
+        """Stop accepting; drain in-flight up to drain_grace_s; close."""
+        self._stopping = True
+        loop = self._loop
+        if loop is None:
+            return
+        if self._server is not None:
+            loop.call_soon_threadsafe(self._server.close)
+        if graceful:
+            self._inflight_zero.wait(self.drain_grace_s)
+
+        def _cancel_all():
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+
+        loop.call_soon_threadsafe(_cancel_all)
+        self._executor.shutdown(wait=False)
+        self._thread.join(timeout=5.0)

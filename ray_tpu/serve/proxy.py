@@ -2,18 +2,20 @@
 
 (reference: python/ray/serve/_private/proxy.py — ProxyActor per node runs a
 uvicorn HTTP server (:706) and a gRPC server (:530), routes by longest
-matching route prefix, and forwards to DeploymentHandles. Here: a stdlib
-ThreadingHTTPServer inside the proxy actor (no uvicorn in the image), JSON
-in/out, same longest-prefix routing.)
+matching route prefix, and forwards to DeploymentHandles. Here: an
+asyncio HTTP/1.1 server (serve/http_server.py — keep-alive, bounded
+connections, chunked SSE streaming, graceful drain on shutdown) inside the
+proxy actor, JSON in/out, same longest-prefix routing. The binary RPC
+ingress (serve/rpc_ingress.py) is the low-latency alternative path.)
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import ray_tpu
+from ray_tpu.serve.http_server import AsyncHTTPServer
 
 PROXY_NAME = "SERVE_PROXY"
 
@@ -28,84 +30,45 @@ class ProxyActor:
         self._version = -1
         self._handles: dict[str, object] = {}
         self._lock = threading.Lock()
-        proxy = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):  # no stderr spam in workers
-                pass
-
-            def _wants_stream(self, body: bytes) -> bool:
-                if "text/event-stream" in (self.headers.get("Accept") or ""):
-                    return True
-                try:
-                    return bool(body and json.loads(body).get("stream"))
-                except Exception:
-                    return False
-
-            def _run(self):
-                n = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(n) if n else b""
-                if self._wants_stream(body):
-                    self._run_stream(body)
-                    return
-                try:
-                    status, payload = proxy._dispatch(self.path, self.command, body)
-                except Exception as e:  # noqa: BLE001 — proxy must answer
-                    status, payload = 500, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-            def _run_stream(self, body: bytes):
-                """SSE: one `data:` event per yielded chunk, chunked framing
-                (reference: streaming responses through the proxy,
-                serve/_private/proxy.py:706)."""
-                try:
-                    gen = proxy._dispatch_stream(self.path, self.command, body)
-                except Exception as e:  # noqa: BLE001
-                    payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
-                def chunk(data: bytes):
-                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
-                    self.wfile.flush()
-
-                try:
-                    for item in gen:
-                        chunk(b"data: " + json.dumps(item, default=str).encode()
-                              + b"\n\n")
-                    chunk(b"data: [DONE]\n\n")
-                except Exception as e:  # noqa: BLE001 — mid-stream failure
-                    chunk(b"data: " + json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode() + b"\n\n")
-                finally:
-                    self.wfile.write(b"0\r\n\r\n")
-                    self.wfile.flush()
-
-            do_GET = do_POST = do_PUT = do_DELETE = _run
-
-        self.server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self.server.server_address[1]
-        self._thread = threading.Thread(target=self.server.serve_forever,
-                                        daemon=True, name="serve-http")
-        self._thread.start()
+        self.server = AsyncHTTPServer(self._handle_request, host, port).start()
+        self.port = self.server.port
 
     def address(self) -> tuple[str, int]:
-        return self.server.server_address[0], self.port
+        return self.server.host, self.port
+
+    # ------------------------------------------------------------- data plane
+
+    def _handle_request(self, method: str, path: str, headers: dict,
+                        body: bytes):
+        """Runs on the HTTP server's executor (may block on the handle)."""
+        if self._wants_stream(headers, body):
+            try:
+                gen = self._dispatch_stream(path, method, body)
+            except Exception as e:  # noqa: BLE001 — the proxy must answer
+                return 500, "application/json", json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+
+            def sse():
+                for item in gen:
+                    yield b"data: " + json.dumps(item, default=str).encode() + b"\n\n"
+                yield b"data: [DONE]\n\n"
+
+            return 200, "text/event-stream", sse()
+        try:
+            status, payload = self._dispatch(path, method, body)
+        except Exception as e:  # noqa: BLE001
+            status, payload = 500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode()
+        return status, "application/json", payload
+
+    @staticmethod
+    def _wants_stream(headers: dict, body: bytes) -> bool:
+        if "text/event-stream" in (headers.get("accept") or ""):
+            return True
+        try:
+            return bool(body and json.loads(body).get("stream"))
+        except Exception:
+            return False
 
     def _refresh_routes(self):
         table = ray_tpu.get(
@@ -168,4 +131,4 @@ class ProxyActor:
             request, _routing_hint=self._routing_hint(request))
 
     def shutdown(self):
-        self.server.shutdown()
+        self.server.stop(graceful=True)
