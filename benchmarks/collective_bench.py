@@ -1,0 +1,68 @@
+"""Host-collective bench: ring allreduce time + per-rank bytes vs world
+size. The ring moves ~2*(W-1)/W * N bytes per rank regardless of W; the
+old rendezvous-star moved W*N through one actor.
+
+Usage: python benchmarks/collective_bench.py [mb] [worlds...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util import collective as col_mod
+
+
+@ray_tpu.remote
+class Bench:
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=group_name)
+        self.rank = rank
+        self.g = group_name
+
+    def run(self, n_float32, iters=3):
+        x = np.ones((n_float32,), np.float32) * (self.rank + 1)
+        self.col.allreduce(x, group_name=self.g, timeout=300.0)  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = self.col.allreduce(x, group_name=self.g, timeout=300.0)
+        dt = (time.perf_counter() - t0) / iters
+        return dt, float(out[0])
+
+
+def main():
+    mb = float(sys.argv[1]) if len(sys.argv) > 1 else 8.0
+    worlds = [int(w) for w in sys.argv[2:]] or [2, 4]
+    n = int(mb * (1 << 20) / 4)
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=12)
+    for w in worlds:
+        actors = [Bench.remote() for _ in range(w)]
+        col_mod.create_collective_group(actors, w, list(range(w)),
+                                        group_name=f"bench{w}")
+        outs = ray_tpu.get([a.run.remote(n) for a in actors], timeout=600)
+        dt = max(o[0] for o in outs)
+        expect = w * (w + 1) / 2
+        assert all(o[1] == expect for o in outs), outs
+        per_rank_mb = 2 * (w - 1) / w * mb
+        print(json.dumps({
+            "world": w, "tensor_mb": mb, "sec_per_allreduce": round(dt, 3),
+            "per_rank_transfer_mb": round(per_rank_mb, 2),
+            "agg_bandwidth_mb_s": round(w * per_rank_mb / dt, 1)}))
+        for a in actors:
+            ray_tpu.kill(a)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

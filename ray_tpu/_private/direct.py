@@ -628,14 +628,6 @@ class DirectDispatcher:
             lease.conn.close()
         except Exception:
             pass
-        if pending:
-            # one reason lookup covers every spec this lease was running
-            try:
-                lease.death_reason = self.core.rpc(
-                    {"type": "worker_death_reason", "wid": lease.wid},
-                    timeout=5.0).get("reason")
-            except Exception:
-                lease.death_reason = None
         for spec in pending:
             self.core._direct_task_failed(spec, lease)
         self.pump(lease.key)

@@ -373,15 +373,17 @@ class GcsServer:
                 pick_victim=self._pick_oom_victim,
                 on_kill=self._note_oom_kill).start()
 
-    def _pick_oom_victim(self):
-        """Newest retriable running plain task's worker on the head host,
-        then any running plain task's worker, then the newest-leased direct
+    def _pick_oom_victim(self, host_id: str = HEAD_HOST):
+        """Newest retriable running plain task's worker on `host_id`, then
+        any running plain task's worker, then the newest-leased direct
         worker — never actors or infrastructure (reference:
-        worker_killing_policy_group_by_owner.h:87)."""
+        worker_killing_policy_group_by_owner.h:87). Node agents delegate
+        their victim choice here too (pick_oom_victim RPC): only the GCS
+        knows which pids run retriable tasks vs actors."""
         with self.lock:
             best = None  # ((retriable, newest_ts), worker)
             for w in self.workers.values():
-                if (w.kind != "worker" or w.dead or w.host_id != HEAD_HOST
+                if (w.kind != "worker" or w.dead or w.host_id != host_id
                         or w.actor_id is not None or not w.pid):
                     continue
                 plain = [s for s in w.running_tasks.values()
@@ -401,16 +403,19 @@ class GcsServer:
                 return w.pid, f"worker {w.wid[:8]} running {names}"
             leased = [w for w in self.workers.values()
                       if w.kind == "worker" and not w.dead and w.pid
-                      and w.host_id == HEAD_HOST and w.leased_to is not None]
+                      and w.host_id == host_id and w.leased_to is not None]
             if leased:
                 w = max(leased, key=lambda x: x.lease_token or 0)
                 return w.pid, f"leased worker {w.wid[:8]}"
         return None
 
-    def _note_oom_kill(self, pid: int, why: str | None) -> None:
+    def _note_oom_kill(self, pid: int, why: str | None,
+                       host_id: str = HEAD_HOST) -> None:
         with self.lock:
             for w in self.workers.values():
-                if w.pid == pid and not w.dead:
+                # pids are per-host namespaces: match host too, or a
+                # follower worker sharing the pid gets mis-tagged
+                if w.pid == pid and w.host_id == host_id and not w.dead:
                     w.oom_why = why
                     break
         if why is not None:
@@ -749,6 +754,19 @@ class GcsServer:
         elif t == "lease_released":
             # a worker reporting its caller's connection closed
             self._release_lease(msg["wid"], msg.get("token"))
+        elif t == "pick_oom_victim":
+            # a node agent under memory pressure asks for a victim on ITS
+            # host: the GCS applies the same policy it uses for the head
+            # (never actors/infrastructure) and tags the reason pre-kill
+            victim = self._pick_oom_victim(msg.get("host_id") or HEAD_HOST)
+            pid = None
+            if victim is not None:
+                pid, desc = victim
+                why = (f"{msg.get('why', 'host memory pressure')}; "
+                       f"killed {desc}")
+                self._note_oom_kill(pid, why,
+                                    host_id=msg.get("host_id") or HEAD_HOST)
+            conn.send({"rid": msg["rid"], "pid": pid})
         elif t == "worker_death_reason":
             # direct-dispatch callers ask why their leased worker vanished
             # (e.g. the memory monitor killed it) to build a useful error

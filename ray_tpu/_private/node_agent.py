@@ -68,22 +68,44 @@ class NodeAgent:
         })
         self.log_monitor = LogMonitor(
             os.path.join(self.session_dir, "logs"), sink=self._forward_log).start()
-        # OOM defense for THIS host: no task metadata here, so the victim is
-        # the fattest live worker child — the GCS death path retries its
-        # tasks (reference: per-raylet memory monitor, memory_monitor.h:52)
+        # OOM defense for THIS host. Victim choice is delegated to the GCS
+        # (same policy as the head: newest retriable plain task first, never
+        # actors), since only it knows what each pid runs (reference:
+        # per-raylet memory monitor, memory_monitor.h:52 + group-by-owner
+        # policy). A dedicated query connection keeps the monitor thread off
+        # the agent's main dispatch socket.
         self.mem_monitor = None
         from ray_tpu._private.ray_config import RayConfig
         refresh_ms = RayConfig.get("memory_monitor_refresh_ms")
         if refresh_ms > 0:
-            from ray_tpu._private.memory_monitor import (MemoryMonitor,
-                                                         proc_rss_bytes)
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            state = {"conn": None, "rid": 0}
 
             def pick():
-                live = [p for p in self._procs if p.poll() is None]
-                if not live:
+                try:
+                    if state["conn"] is None:
+                        state["conn"] = connect_address(self.gcs_address)
+                    state["rid"] += 1
+                    state["conn"].send({
+                        "type": "pick_oom_victim", "rid": state["rid"],
+                        "host_id": self.host_id,
+                        "why": f"host {self.host_id} memory pressure"})
+                    while True:
+                        reply = state["conn"].recv()
+                        if reply.get("rid") == state["rid"]:
+                            break
+                except (ConnectionClosed, OSError):
+                    state["conn"] = None
                     return None
-                fat = max(live, key=lambda p: proc_rss_bytes(p.pid))
-                return fat.pid, f"worker pid {fat.pid} on host {self.host_id}"
+                pid = reply.get("pid")
+                if pid is None:
+                    return None
+                # only kill pids this agent actually spawned
+                if not any(p.pid == pid and p.poll() is None
+                           for p in self._procs):
+                    return None
+                return pid, f"worker pid {pid} on host {self.host_id}"
 
             self.mem_monitor = MemoryMonitor(
                 threshold=RayConfig.get("memory_usage_threshold"),

@@ -927,10 +927,17 @@ class CoreWorker:
         else:
             for d in self._flight_holds.pop(tid, ()):
                 self.decref(d)
-            # the GCS may know more (e.g. the memory monitor killed it) —
-            # fetched once per dead lease in _fail_lease
-            why = (getattr(lease, "death_reason", None)
-                   or f"worker {lease.wid} died")
+            # the GCS may know more (e.g. the memory monitor killed it);
+            # fetched lazily and cached per lease so N failed specs cost one
+            # short RPC, and retry/cancel paths never pay it
+            if lease.death_reason is None:
+                try:
+                    lease.death_reason = self.rpc(
+                        {"type": "worker_death_reason", "wid": lease.wid},
+                        timeout=2.0).get("reason") or ""
+                except Exception:
+                    lease.death_reason = ""
+            why = lease.death_reason or f"worker {lease.wid} died"
             with self._owned_lock:
                 self._owned_fail_locked(
                     spec, WorkerCrashedError(why), publish_later)

@@ -196,6 +196,8 @@ class AsyncHTTPServer:
                     return True
                 except concurrent.futures.TimeoutError:
                     fut.cancel()  # slow/dead consumer: re-check aborted
+                    if fut.done() and not fut.cancelled():
+                        return True  # the put landed as the timeout fired
                 except Exception:
                     return False  # loop closed
             return False

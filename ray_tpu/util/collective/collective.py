@@ -139,7 +139,7 @@ def _group(group_name: str) -> _GroupHandle:
     return _groups[group_name]
 
 
-def _exchange(g: _GroupHandle, payload: np.ndarray | None, timeout: float) -> dict:
+def _exchange(g: _GroupHandle, payload, timeout: float) -> dict:
     from ray_tpu._private import serialization as ser
     from ray_tpu._private.poll import poll_until
 
@@ -150,11 +150,112 @@ def _exchange(g: _GroupHandle, payload: np.ndarray | None, timeout: float) -> di
     return {r: ser.loads(b) for r, b in got.items()}
 
 
+# Above this many bytes, tensors stop flowing THROUGH the rendezvous actor:
+# ranks exchange ObjectRefs (about a hundred bytes each) and the payloads
+# ride the per-host object plane directly between the hosts involved — the
+# actor's traffic stays O(world) small messages per op regardless of tensor
+# size, and reductions run as a chunked ring so per-rank bytes moved are
+# ~2x tensor size independent of world size.
+# (reference: ring allreduce in nccl_collective_group.py:121; the host-plane
+# gloo backend uses the same ring for big tensors.)
+RING_MIN_BYTES = 1 << 20
+
+
+def _combine(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:
+    if op in ("sum", "mean"):
+        return a + b
+    if op == "max":
+        return np.maximum(a, b)
+    if op == "min":
+        return np.minimum(a, b)
+    if op == "prod":
+        return a * b
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def _ring_send(g: _GroupHandle, dst: int, tag: int, ref, timeout: float):
+    from ray_tpu._private import serialization as ser
+    from ray_tpu._private.poll import poll_until
+
+    blob = ser.dumps(ref)
+    poll_until(
+        lambda: ray_tpu.get(g.actor.put_p2p.remote(tag, g.rank, dst, blob)) or None,
+        timeout, f"ring send to rank {dst} (tag {tag}) timed out")
+
+
+def _ring_recv(g: _GroupHandle, src: int, tag: int, timeout: float) -> np.ndarray:
+    from ray_tpu._private import serialization as ser
+    from ray_tpu._private.poll import poll_until
+
+    blob = poll_until(
+        lambda: ray_tpu.get(g.actor.poll_p2p.remote(tag, src, g.rank)),
+        timeout, f"ring recv from rank {src} (tag {tag}) timed out")
+    return ray_tpu.get(ser.loads(blob))
+
+
+def _ring_reduce_phase(g: _GroupHandle, buffers: list, op: str, seq: int,
+                       keep: list, timeout: float) -> None:
+    """In-place ring reduce-scatter over `buffers` (one chunk per rank):
+    after W-1 steps, buffers[(rank+1) % W] holds the full reduction."""
+    W, rank = g.world_size, g.rank
+    nxt, prv = (rank + 1) % W, (rank - 1) % W
+    for s in range(W - 1):
+        si = (rank - s) % W
+        ri = (rank - s - 1) % W
+        ref = ray_tpu.put(buffers[si])
+        keep.append(ref)  # alive until the end-of-op barrier
+        _ring_send(g, nxt, (seq << 12) | s, ref, timeout)
+        inc = _ring_recv(g, prv, (seq << 12) | s, timeout)
+        buffers[ri] = _combine(buffers[ri], inc, op)
+
+
+def _ring_allreduce(g: _GroupHandle, tensor: np.ndarray, op: str,
+                    timeout: float) -> np.ndarray:
+    """Chunked ring allreduce: reduce-scatter then allgather, payloads by
+    ref through the object plane (reference: the standard 2(W-1)-step ring,
+    nccl_collective_group.py:121)."""
+    W, rank = g.world_size, g.rank
+    nxt, prv = (rank + 1) % W, (rank - 1) % W
+    flat = np.ascontiguousarray(tensor).ravel()
+    n = flat.size
+    per = -(-n // W)
+    padded = np.resize(flat, per * W) if per * W != n else flat
+    if per * W != n:
+        padded[n:] = 0 if op in ("sum", "mean") else flat[-1]
+    buffers = [padded[i * per:(i + 1) * per].copy() for i in range(W)]
+    keep: list = []
+    seq = g.next_seq()
+    _ring_reduce_phase(g, buffers, op, seq, keep, timeout)
+    # allgather phase: circulate the reduced chunks
+    seq2 = g.next_seq()
+    for s in range(W - 1):
+        si = (rank + 1 - s) % W
+        ri = (rank - s) % W
+        ref = ray_tpu.put(buffers[si])
+        keep.append(ref)
+        _ring_send(g, nxt, (seq2 << 12) | s, ref, timeout)
+        buffers[ri] = _ring_recv(g, prv, (seq2 << 12) | s, timeout)
+    _exchange(g, None, timeout)  # all pulls done before refs drop
+    keep.clear()
+    out = np.concatenate(buffers)[:n].reshape(tensor.shape)
+    if op == "mean":
+        out = out / W
+    return out.astype(tensor.dtype) if op != "mean" else out
+
+
 def allreduce(tensor: np.ndarray, *, op: str = "sum",
               group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
-    """(reference: collective.py allreduce:325.)"""
+    """(reference: collective.py allreduce:325.)
+
+    Every rank MUST pass the same shape and dtype (the standard collective
+    contract — NCCL requires it too): the ring-vs-star choice is made from
+    the local tensor's byte size, and uniform inputs guarantee all ranks
+    choose the same path."""
     g = _group(group_name)
-    parts = _exchange(g, np.asarray(tensor), timeout)
+    tensor = np.asarray(tensor)
+    if tensor.nbytes >= RING_MIN_BYTES and g.world_size > 1:
+        return _ring_allreduce(g, tensor, op, timeout)
+    parts = _exchange(g, tensor, timeout)
     stack = np.stack([parts[r] for r in range(g.world_size)])
     if op == "sum":
         return stack.sum(axis=0)
@@ -178,25 +279,55 @@ def reduce(tensor: np.ndarray, *, dst_rank: int = 0, op: str = "sum",
 
 def broadcast(tensor: np.ndarray | None, *, src_rank: int = 0,
               group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
-    """(reference: :482.)"""
+    """(reference: :482.) Large tensors go by ref: the source puts once and
+    receivers pull host-to-host through the object plane (each pulled copy
+    registers as a location, so later pulls fan out across hosts)."""
     g = _group(group_name)
     payload = np.asarray(tensor) if g.rank == src_rank else None
-    parts = _exchange(g, payload, timeout)
-    return parts[src_rank]
+    big = (payload is not None and payload.nbytes >= RING_MIN_BYTES
+           and g.world_size > 1)
+    to_send = ray_tpu.put(payload) if big else payload
+    # every rank runs the SAME exchange sequence regardless of mode — the
+    # src's payload type (array vs ref) tells receivers which it was
+    parts = _exchange(g, to_send, timeout)
+    got = parts[src_rank]
+    is_ref = hasattr(got, "hex")
+    out = ray_tpu.get(got) if is_ref else got
+    if is_ref or big:
+        # same predicate on every rank (receivers see the ref; the src knows
+        # it sent one): the src's ref stays live until everyone pulled
+        _exchange(g, None, timeout)
+    return out
 
 
 def allgather(tensor: np.ndarray, *, group_name: str = "default",
               timeout: float = 60.0) -> list[np.ndarray]:
-    """(reference: :554.)"""
+    """(reference: :554.) Per-rank tensors may differ in shape/size; each
+    rank independently ships either the array (small) or a ref (large) and
+    receivers resolve by payload type, so mixed modes can't diverge."""
     g = _group(group_name)
-    parts = _exchange(g, np.asarray(tensor), timeout)
-    return [parts[r] for r in range(g.world_size)]
+    tensor = np.asarray(tensor)
+    big_mine = tensor.nbytes >= RING_MIN_BYTES and g.world_size > 1
+    to_send = ray_tpu.put(tensor) if big_mine else tensor
+    parts = _exchange(g, to_send, timeout)
+    saw_ref = big_mine or any(hasattr(parts[r], "hex")
+                              for r in range(g.world_size))
+    out = [tensor if r == g.rank
+           else (ray_tpu.get(parts[r]) if hasattr(parts[r], "hex")
+                 else parts[r])
+           for r in range(g.world_size)]
+    if saw_ref:
+        # every rank computed the same predicate from the same exchanged
+        # data: refs stay live until all pulls completed
+        _exchange(g, None, timeout)
+    return out
 
 
 def reducescatter(tensor: np.ndarray, *, op: str = "sum",
                   group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
     """Reduce then return this rank's 1/world shard along axis 0.
-    (reference: :629.)"""
+    (reference: :629. Rides allreduce, which is a scalable ring for large
+    tensors; the local slice is free.)"""
     g = _group(group_name)
     total = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
     shards = np.array_split(total, g.world_size, axis=0)

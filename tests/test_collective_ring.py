@@ -1,0 +1,126 @@
+"""Ring collectives for large host tensors: payloads ride the object plane
+by ref, the rendezvous actor carries only O(world) small messages, and
+cross-host groups move bytes host-to-host.
+
+(reference: ring allreduce in util/collective/collective_group/
+nccl_collective_group.py:121 — VERDICT round-2 item 4.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col_mod
+
+
+@pytest.fixture
+def prim_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=16)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class RingWorker:
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=group_name)
+        self.rank = rank
+        self.g = group_name
+
+    def big_allreduce(self, n, op="sum"):
+        x = np.full((n,), float(self.rank + 1), np.float32)
+        out = self.col.allreduce(x, op=op, group_name=self.g, timeout=120.0)
+        return float(out[0]), float(out[-1]), out.shape
+
+    def big_allreduce_2d(self, rows, cols):
+        x = np.full((rows, cols), float(self.rank + 1), np.float64)
+        out = self.col.allreduce(x, op="mean", group_name=self.g, timeout=120.0)
+        return float(out[0, 0]), out.shape
+
+    def big_allgather(self, n):
+        x = np.full((n,), float(self.rank), np.float32)
+        outs = self.col.allgather(x, group_name=self.g, timeout=120.0)
+        return [float(o[0]) for o in outs]
+
+    def big_broadcast(self, n):
+        payload = (np.arange(n, dtype=np.float32)
+                   if self.rank == 0 else None)
+        out = self.col.broadcast(payload, src_rank=0, group_name=self.g,
+                                 timeout=120.0)
+        return float(out[-1]), out.shape
+
+    def odd_allreduce(self, n):
+        # n not divisible by world size exercises the padding path
+        x = np.full((n,), 1.0, np.float32)
+        out = self.col.allreduce(x, group_name=self.g, timeout=120.0)
+        return float(out.sum()), out.shape
+
+
+BIG = 1 << 19  # 2 MB float32 — over RING_MIN_BYTES
+
+
+def _mkgroup(n, name):
+    workers = [RingWorker.remote() for _ in range(n)]
+    col_mod.create_collective_group(workers, n, list(range(n)),
+                                    group_name=name)
+    return workers
+
+
+def test_ring_allreduce_matches_small_path(prim_cluster):
+    ws = _mkgroup(2, "ring2")
+    out = ray_tpu.get([w.big_allreduce.remote(BIG) for w in ws], timeout=180)
+    for first, last, shape in out:
+        assert first == last == 3.0  # (1) + (2)
+        assert tuple(shape) == (BIG,)
+
+
+def test_ring_allreduce_mean_2d_and_odd_sizes(prim_cluster):
+    ws = _mkgroup(2, "ringodd")
+    out = ray_tpu.get([w.big_allreduce_2d.remote(1024, 513) for w in ws],
+                      timeout=180)
+    for v, shape in out:
+        assert v == 1.5 and tuple(shape) == (1024, 513)
+    out = ray_tpu.get([w.odd_allreduce.remote(BIG + 3) for w in ws], timeout=180)
+    for s, shape in out:
+        assert s == 2.0 * (BIG + 3) and tuple(shape) == (BIG + 3,)
+
+
+def test_ring_allgather_and_broadcast_by_ref(prim_cluster):
+    ws = _mkgroup(2, "ringag")
+    out = ray_tpu.get([w.big_allgather.remote(BIG) for w in ws], timeout=180)
+    assert out[0] == [0.0, 1.0] and out[1] == [0.0, 1.0]
+    out = ray_tpu.get([w.big_broadcast.remote(BIG) for w in ws], timeout=180)
+    for last, shape in out:
+        assert last == float(BIG - 1) and tuple(shape) == (BIG,)
+
+
+@pytest.mark.slow
+def test_ring_collective_cross_host():
+    """A 2-rank group split across two real follower-host processes: the
+    payload bytes move host-to-host through the object plane."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(num_cpus=4, num_workers=1,
+                                          max_workers=8))
+    try:
+        h1 = cluster.add_host(num_cpus=2, host_id="col-a")
+        h2 = cluster.add_host(num_cpus=2, host_id="col-b")
+        w0 = RingWorker.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=h1)).remote()
+        w1 = RingWorker.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=h2)).remote()
+        col_mod.create_collective_group([w0, w1], 2, [0, 1],
+                                        group_name="xhost")
+        out = ray_tpu.get([w.big_allreduce.remote(BIG) for w in (w0, w1)],
+                          timeout=240)
+        for first, last, shape in out:
+            assert first == last == 3.0
+    finally:
+        cluster.shutdown()
