@@ -767,6 +767,10 @@ class GcsServer:
                 self._note_oom_kill(pid, why,
                                     host_id=msg.get("host_id") or HEAD_HOST)
             conn.send({"rid": msg["rid"], "pid": pid})
+        elif t == "oom_clear":
+            # agent declined the pick or its kill failed: drop the tag
+            self._note_oom_kill(msg["pid"], None,
+                                host_id=msg.get("host_id") or HEAD_HOST)
         elif t == "worker_death_reason":
             # direct-dispatch callers ask why their leased worker vanished
             # (e.g. the memory monitor killed it) to build a useful error

@@ -82,10 +82,24 @@ class NodeAgent:
 
             state = {"conn": None, "rid": 0}
 
+            def _clear(pid):
+                """Un-tag a declined/failed victim so an unrelated later
+                death isn't misattributed to memory pressure."""
+                try:
+                    if state["conn"] is not None:
+                        state["conn"].send({"type": "oom_clear",
+                                            "host_id": self.host_id,
+                                            "pid": pid})
+                except (ConnectionClosed, OSError):
+                    state["conn"] = None
+
             def pick():
                 try:
                     if state["conn"] is None:
                         state["conn"] = connect_address(self.gcs_address)
+                        # a hung GCS must not wedge the monitor forever —
+                        # the kernel OOM killer is what we're racing
+                        state["conn"].sock.settimeout(5.0)
                     state["rid"] += 1
                     state["conn"].send({
                         "type": "pick_oom_victim", "rid": state["rid"],
@@ -104,12 +118,18 @@ class NodeAgent:
                 # only kill pids this agent actually spawned
                 if not any(p.pid == pid and p.poll() is None
                            for p in self._procs):
+                    _clear(pid)
                     return None
                 return pid, f"worker pid {pid} on host {self.host_id}"
 
+            def on_kill(pid, why):
+                if why is None:  # the SIGKILL itself failed
+                    _clear(pid)
+
             self.mem_monitor = MemoryMonitor(
                 threshold=RayConfig.get("memory_usage_threshold"),
-                period_s=refresh_ms / 1000.0, pick_victim=pick).start()
+                period_s=refresh_ms / 1000.0, pick_victim=pick,
+                on_kill=on_kill).start()
 
     def _rpc(self, msg: dict) -> dict:
         msg["rid"] = self._rid

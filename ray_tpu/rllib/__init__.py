@@ -8,6 +8,7 @@ is a jitted XLA program that scales by mesh sharding instead of torch DDP.)
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import ReplayBuffer
 from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_vec_env
@@ -21,6 +22,8 @@ __all__ = [
     "BCConfig",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
     "ReplayBuffer",
     "CartPoleVecEnv",
     "EnvRunner",

@@ -1,3 +1,4 @@
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = ["IMPALA", "IMPALAConfig", "PPO", "PPOConfig"]

@@ -1,0 +1,218 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace off-policy correction.
+
+Decoupled architecture (reference: rllib/algorithms/impala/ — IMPALA's
+aggregated async sampling + learner thread; Espeholt et al. 2018): rollout
+actors STREAM trajectory batches continuously (num_returns="streaming"
+generators with backpressure) using whatever weights they last received;
+the learner consumes batches as they arrive, corrects the off-policy gap
+with V-trace, updates, and pushes fresh weights back asynchronously. No
+synchronous sample→update barrier anywhere — the pattern the synchronous
+PPO/DQN implementations don't exercise.
+
+V-trace targets (vs) and the policy-gradient advantage:
+  rho_t = min(rho_bar, pi(a|s)/mu(a|s)),  c_t = min(c_bar, pi/mu)
+  delta_t = rho_t (r_t + gamma V(x_{t+1}) - V(x_t))
+  vs_t = V_t + delta_t + gamma c_t (vs_{t+1} - V_{t+1})
+  adv_t = rho_t (r_t + gamma vs_{t+1} - V_t)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.env_runner import EnvRunner
+
+
+class IMPALAConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+        self.batches_per_iteration = 8
+
+
+def _vtrace(target_logp, behavior_logp, rewards, values, dones, last_value,
+            *, gamma, rho_bar, c_bar):
+    """All inputs time-major [T, N]; returns (vs [T, N], pg_adv [T, N])."""
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.minimum(rho_bar, jnp.exp(target_logp - behavior_logp))
+    c = jnp.minimum(c_bar, jnp.exp(target_logp - behavior_logp))
+    not_done = 1.0 - dones.astype(jnp.float32)
+    v_next = jnp.concatenate([values[1:], last_value[None]], axis=0) * not_done
+    delta = rho * (rewards + gamma * v_next - values)
+
+    def step(carry, xs):
+        acc = carry  # vs_{t+1} - V_{t+1}
+        d, c_t, nd = xs
+        acc = d + gamma * c_t * nd * acc
+        return acc, acc
+
+    _, adv_stack = jax.lax.scan(step, jnp.zeros_like(delta[0]),
+                                (delta, c, not_done), reverse=True)
+    vs = values + adv_stack
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0) * not_done
+    pg_adv = rho * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    """Jitted V-trace actor-critic update over time-major batches."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+                 hidden=(64, 64), vf_coef: float = 0.5, ent_coef: float = 0.01,
+                 gamma: float = 0.99, rho_bar: float = 1.0, c_bar: float = 1.0,
+                 seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.rllib import rl_module
+
+        self._rl = rl_module
+        self.params = rl_module.init(jax.random.PRNGKey(seed), obs_dim,
+                                     num_actions, hidden=tuple(hidden))
+        self.opt = optax.chain(optax.clip_by_global_norm(40.0),
+                               optax.adam(lr))
+        self.opt_state = self.opt.init(self.params)
+        self.version = 0
+
+        @functools.partial(jax.jit)
+        def update(params, opt_state, batch):
+            import jax.numpy as jnp
+
+            def loss_fn(p):
+                T, N = batch["rewards"].shape
+                obs = batch["obs"].reshape(T * N, -1)
+                logits, values = rl_module.forward(p, obs)
+                logp_all = jax.nn.log_softmax(logits)
+                target_logp = logp_all[
+                    jnp.arange(T * N), batch["actions"].reshape(T * N)]
+                target_logp = target_logp.reshape(T, N)
+                values = values.reshape(T, N)
+                _, last_value = rl_module.forward(p, batch["bootstrap_obs"])
+                vs, pg_adv = _vtrace(
+                    target_logp, batch["behavior_logp"], batch["rewards"],
+                    values, batch["dones"], last_value,
+                    gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+                pg_loss = -jnp.mean(target_logp * pg_adv)
+                vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+                ent = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+                loss = pg_loss + vf_coef * vf_loss - ent_coef * ent
+                return loss, (pg_loss, vf_loss, ent)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = update
+
+    def update(self, batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k in ("obs", "actions", "behavior_logp", "rewards", "dones",
+                       "bootstrap_obs")}
+        self.params, self.opt_state, loss, (pg, vf, ent) = self._update(
+            self.params, self.opt_state, jb)
+        self.version += 1
+        return {"loss": float(loss), "pg_loss": float(pg),
+                "vf_loss": float(vf), "entropy": float(ent),
+                "weights_version": self.version}
+
+    def get_weights_blob(self) -> bytes:
+        from ray_tpu._private import serialization as ser
+
+        return ser.dumps(self.params)
+
+
+class IMPALA(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        probe = make_vec_env(cfg.env_id, 1, cfg.seed)
+        self.learner = ImpalaLearner(
+            probe.obs_dim, probe.num_actions, lr=cfg.lr,
+            hidden=cfg.model_hidden, vf_coef=cfg.vf_loss_coeff,
+            ent_coef=cfg.entropy_coeff, gamma=cfg.gamma,
+            rho_bar=getattr(cfg, "rho_bar", 1.0),
+            c_bar=getattr(cfg, "c_bar", 1.0), seed=cfg.seed)
+        self._streams: list = []
+        self._runners: list = []
+        for i in range(cfg.num_env_runners):
+            self._start_runner(i)
+
+    def _start_runner(self, seed_offset: int):
+        cfg = self.config
+        runner = EnvRunner.options(max_concurrency=2).remote(
+            cfg.env_id, cfg.num_envs_per_runner,
+            cfg.seed + 1000 * (seed_offset + 1))
+        runner.set_weights.remote(self.learner.get_weights_blob())
+        stream = runner.stream_rollouts.options(
+            num_returns="streaming").remote(cfg.rollout_fragment_length)
+        self._runners.append(runner)
+        self._streams.append(stream)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        out: dict = {}
+        consumed = 0
+        idx = 0
+        budget = cfg.batches_per_iteration
+        while consumed < budget and self._streams:
+            i = idx % len(self._streams)
+            idx += 1
+            try:
+                ref = next(self._streams[i])
+                batch = ray_tpu.get(ref, timeout=120.0)
+            except StopIteration:
+                # stream exhausted (bounded runs): restart it
+                self._restart(i)
+                continue
+            except Exception:
+                # runner died mid-iteration (reference: FaultAwareApply
+                # restarts failed env runners) — replace it and keep going
+                self._restart(i)
+                continue
+            self._episode_returns.extend(batch.pop("episode_returns", ()))
+            out = self.learner.update(batch)
+            consumed += 1
+            # async weight push: the runner picks it up for its NEXT batch;
+            # no barrier — staleness is what V-trace corrects
+            try:
+                self._runners[i].set_weights.remote(
+                    self.learner.get_weights_blob())
+            except Exception:
+                self._restart(i)
+        out["batches_consumed"] = consumed
+        out["num_healthy_runners"] = len(self._runners)
+        return out
+
+    def _restart(self, i: int):
+        try:
+            ray_tpu.kill(self._runners[i])
+        except Exception:
+            pass
+        self._runners.pop(i)
+        self._streams.pop(i)
+        self._start_runner(len(self._runners) + np.random.randint(100, 10_000))
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners.clear()
+        self._streams.clear()
+
+
+IMPALAConfig.algo_class = IMPALA

@@ -24,6 +24,7 @@ class EnvRunner:
         self.obs = self.env.reset(seed)
         self.key = jax.random.PRNGKey(seed)
         self.num_envs = num_envs
+        self._params_blob = None  # pushed by set_weights (IMPALA streaming)
 
     def sample(self, params_blob: bytes, num_steps: int) -> dict:
         """Roll `num_steps` per sub-env; returns time-major arrays
@@ -94,6 +95,51 @@ class EnvRunner:
             "next_obs": next_buf, "dones": done_buf,
             "episode_returns": self.env.drain_episode_returns(),
         }
+
+    def set_weights(self, params_blob: bytes) -> None:
+        """Async weight push from the learner (IMPALA): picked up by the
+        streaming rollout loop at its next batch boundary. Runs on a second
+        concurrency slot while stream_rollouts occupies the first."""
+        self._params_blob = params_blob
+
+    def stream_rollouts(self, num_steps: int, max_batches: int = 1_000_000):
+        """Continuous trajectory stream (IMPALA's decoupled sampling):
+        yields time-major batches produced with the most recently pushed
+        weights, tagging each with the behavior policy's logp so the
+        learner can V-trace-correct the off-policy gap. Producer-side
+        backpressure bounds how far ahead of the learner this runs."""
+        import jax
+
+        from ray_tpu._private import serialization as ser
+        from ray_tpu.rllib import rl_module
+
+        import time as _time
+
+        while self._params_blob is None:  # first weight push may race us in
+            _time.sleep(0.01)
+        for _ in range(max_batches):
+            params = ser.loads(self._params_blob)
+            T, N = num_steps, self.num_envs
+            obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+            act_buf = np.zeros((T, N), np.int32)
+            logp_buf = np.zeros((T, N), np.float32)
+            rew_buf = np.zeros((T, N), np.float32)
+            done_buf = np.zeros((T, N), np.bool_)
+            for t in range(T):
+                self.key, sub = jax.random.split(self.key)
+                action, logp, _value = rl_module.forward_exploration(
+                    params, self.obs, sub)
+                action = np.asarray(action)
+                obs_buf[t] = self.obs
+                act_buf[t] = action
+                logp_buf[t] = np.asarray(logp)
+                self.obs, rew_buf[t], done_buf[t], _ = self.env.step(action)
+            yield {
+                "obs": obs_buf, "actions": act_buf, "behavior_logp": logp_buf,
+                "rewards": rew_buf, "dones": done_buf,
+                "bootstrap_obs": np.asarray(self.obs, np.float32),
+                "episode_returns": self.env.drain_episode_returns(),
+            }
 
     def ping(self) -> bool:
         return True

@@ -173,7 +173,9 @@ def _combine(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:
     raise ValueError(f"unknown reduce op {op!r}")
 
 
-def _ring_send(g: _GroupHandle, dst: int, tag: int, ref, timeout: float):
+def _ring_send(g: _GroupHandle, dst: int, tag, ref, timeout: float):
+    # ring tags are tuples — a namespace user send()/recv() int tags can't
+    # collide with in the shared p2p mailbox
     from ray_tpu._private import serialization as ser
     from ray_tpu._private.poll import poll_until
 
@@ -183,7 +185,7 @@ def _ring_send(g: _GroupHandle, dst: int, tag: int, ref, timeout: float):
         timeout, f"ring send to rank {dst} (tag {tag}) timed out")
 
 
-def _ring_recv(g: _GroupHandle, src: int, tag: int, timeout: float) -> np.ndarray:
+def _ring_recv(g: _GroupHandle, src: int, tag, timeout: float) -> np.ndarray:
     from ray_tpu._private import serialization as ser
     from ray_tpu._private.poll import poll_until
 
@@ -204,8 +206,8 @@ def _ring_reduce_phase(g: _GroupHandle, buffers: list, op: str, seq: int,
         ri = (rank - s - 1) % W
         ref = ray_tpu.put(buffers[si])
         keep.append(ref)  # alive until the end-of-op barrier
-        _ring_send(g, nxt, (seq << 12) | s, ref, timeout)
-        inc = _ring_recv(g, prv, (seq << 12) | s, timeout)
+        _ring_send(g, nxt, ("__ring__", seq, s), ref, timeout)
+        inc = _ring_recv(g, prv, ("__ring__", seq, s), timeout)
         buffers[ri] = _combine(buffers[ri], inc, op)
 
 
@@ -233,8 +235,8 @@ def _ring_allreduce(g: _GroupHandle, tensor: np.ndarray, op: str,
         ri = (rank - s) % W
         ref = ray_tpu.put(buffers[si])
         keep.append(ref)
-        _ring_send(g, nxt, (seq2 << 12) | s, ref, timeout)
-        buffers[ri] = _ring_recv(g, prv, (seq2 << 12) | s, timeout)
+        _ring_send(g, nxt, ("__ring__", seq2, s), ref, timeout)
+        buffers[ri] = _ring_recv(g, prv, ("__ring__", seq2, s), timeout)
     _exchange(g, None, timeout)  # all pulls done before refs drop
     keep.clear()
     out = np.concatenate(buffers)[:n].reshape(tensor.shape)
@@ -292,7 +294,10 @@ def broadcast(tensor: np.ndarray | None, *, src_rank: int = 0,
     parts = _exchange(g, to_send, timeout)
     got = parts[src_rank]
     is_ref = hasattr(got, "hex")
-    out = ray_tpu.get(got) if is_ref else got
+    if g.rank == src_rank:
+        out = payload  # no reason to re-fetch our own payload
+    else:
+        out = ray_tpu.get(got) if is_ref else got
     if is_ref or big:
         # same predicate on every rank (receivers see the ref; the src knows
         # it sent one): the src's ref stays live until everyone pulled
@@ -312,7 +317,7 @@ def allgather(tensor: np.ndarray, *, group_name: str = "default",
     parts = _exchange(g, to_send, timeout)
     saw_ref = big_mine or any(hasattr(parts[r], "hex")
                               for r in range(g.world_size))
-    out = [tensor if r == g.rank
+    out = [tensor.copy() if r == g.rank
            else (ray_tpu.get(parts[r]) if hasattr(parts[r], "hex")
                  else parts[r])
            for r in range(g.world_size)]

@@ -1,0 +1,91 @@
+"""IMPALA: async actor-learner with V-trace.
+
+(reference: rllib/algorithms/impala/ — VERDICT round-2 item 7: decoupled
+rollout actors streaming trajectories to a learner with V-trace; must beat
+random on CartPole and survive an env-runner death mid-iteration.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import IMPALAConfig
+
+
+@pytest.fixture
+def rl_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=10)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_vtrace_on_policy_reduces_to_gae_targets():
+    """With target == behavior policy and c_bar=rho_bar=1, vs matches the
+    lambda=1 discounted-return recursion."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import _vtrace
+
+    T, N = 6, 3
+    rng = np.random.default_rng(0)
+    logp = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    dones = jnp.zeros((T, N), bool)
+    last_v = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    vs, adv = _vtrace(logp, logp, rewards, values, dones, last_v,
+                      gamma=0.9, rho_bar=1.0, c_bar=1.0)
+    # on-policy, no truncation: vs_t = r_t + gamma vs_{t+1}; vs_T-1 uses V(x_T)
+    expect = np.zeros((T, N), np.float32)
+    nxt = np.asarray(last_v)
+    for t in reversed(range(T)):
+        expect[t] = np.asarray(rewards[t]) + 0.9 * nxt
+        nxt = expect[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_impala_learns_cartpole(rl_cluster):
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=48)
+        .training(lr=3e-3)
+        .debugging(seed=0)
+        .build()
+    )
+    rets = []
+    for _ in range(16):
+        result = algo.train()
+        r = result["env_runners"]["episode_return_mean"]
+        if not np.isnan(r):
+            rets.append(r)
+    algo.stop()
+    assert rets, "no episodes completed"
+    # random CartPole averages ~20-25; learning must beat it clearly
+    assert max(rets[-4:]) > 40.0, rets
+
+
+@pytest.mark.slow
+def test_impala_survives_runner_death(rl_cluster):
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .debugging(seed=1)
+        .build()
+    )
+    r1 = algo.train()
+    assert r1["learners"]["batches_consumed"] > 0
+    # kill one rollout actor mid-run
+    ray_tpu.kill(algo._runners[0])
+    r2 = algo.train()
+    r3 = algo.train()
+    algo.stop()
+    # the iteration after the kill still consumed batches and the pool healed
+    assert (r2["learners"]["batches_consumed"]
+            + r3["learners"]["batches_consumed"]) > 0
+    assert r3["learners"]["num_healthy_runners"] == 2
