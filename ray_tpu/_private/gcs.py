@@ -52,7 +52,7 @@ class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
                  "running_tasks", "node_id", "tpu_chips", "host_id",
                  "ref_balance", "renv_hash", "direct_addr", "leased_to",
-                 "lease_spec", "lease_token", "oom_why")
+                 "lease_spec", "lease_token", "oom_why", "oom_ts")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
                  tpu_chips: tuple = (), host_id: str = "host-0",
@@ -83,6 +83,7 @@ class _Worker:
         self.lease_spec: dict | None = None  # resources held by the lease
         self.lease_token: int | None = None  # guards stale release messages
         self.oom_why: str | None = None  # set by the memory monitor pre-kill
+        self.oom_ts: float = 0.0  # when; stale tags are ignored on death
 
 
 class _Actor:
@@ -240,6 +241,12 @@ class GcsServer:
         # lets caller death release everything it held
         self._lease_seq = 0
         self._leases_by_holder: dict[str, set[str]] = {}
+        # attached autoscalers can GROW the cluster: infeasible-now
+        # placement groups then stay pending instead of failing fast
+        # (reference: infeasibility is judged against the autoscaler's max
+        # cluster shape, which only the autoscaler knows). Tracked per
+        # connection so autoscaler death restores fail-fast.
+        self._autoscaler_conns: set = set()
         # caller-reported local submission backlogs, piggybacked on lease
         # requests (reference: backlog_size in lease requests feeds the
         # autoscaler's demand view)
@@ -417,6 +424,7 @@ class GcsServer:
                 # follower worker sharing the pid gets mis-tagged
                 if w.pid == pid and w.host_id == host_id and not w.dead:
                     w.oom_why = why
+                    w.oom_ts = time.monotonic()
                     break
         if why is not None:
             self.publish("errors", {"kind": "oom_kill", "error": why,
@@ -555,6 +563,7 @@ class GcsServer:
             # drop pubsub subscriber state owned by this connection — a
             # crashed subscriber must not leave queues accumulating forever
             with self.lock:
+                self._autoscaler_conns.discard(id(conn))
                 dead_keys = [k for k, c in self.pubsub_conns.items() if c is conn]
                 for k in dead_keys:
                     self.pubsub_conns.pop(k, None)
@@ -767,6 +776,10 @@ class GcsServer:
                 self._note_oom_kill(pid, why,
                                     host_id=msg.get("host_id") or HEAD_HOST)
             conn.send({"rid": msg["rid"], "pid": pid})
+        elif t == "autoscaler_attach":
+            with self.lock:
+                self._autoscaler_conns.add(id(conn))
+            conn.send({"rid": msg["rid"], "ok": True})
         elif t == "oom_clear":
             # agent declined the pick or its kill failed: drop the tag
             self._note_oom_kill(msg["pid"], None,
@@ -776,7 +789,10 @@ class GcsServer:
             # (e.g. the memory monitor killed it) to build a useful error
             with self.lock:
                 w2 = self.workers.get(msg["wid"])
-                why = w2.oom_why if w2 is not None else None
+                why = None
+                if (w2 is not None and w2.oom_why is not None
+                        and time.monotonic() - w2.oom_ts < 30.0):
+                    why = w2.oom_why
             conn.send({"rid": msg["rid"], "reason": why})
         elif t == "direct_lineage":
             # a direct task produced evictable (shm) outputs: retain its spec
@@ -982,6 +998,8 @@ class GcsServer:
                     "total_resources": self.total,
                     "available_resources": self.available,
                     "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+                    "node_ids": [n.node_id for n in self.nodes.values()
+                                 if n.alive],
                 }
             conn.send({"rid": msg["rid"], "demand": state})
         elif t == "worker_stacks":
@@ -1888,8 +1906,13 @@ class GcsServer:
             now = time.monotonic()
             for node_id_, dq in self._spawn_pending.items():
                 while dq:
-                    ts_, chips_, _rh_ = dq[0]
-                    limit_ = CHIP_SPAWN_TIMEOUT_S if chips_ else SPAWN_TIMEOUT_S
+                    ts_, chips_, rh_ = dq[0]
+                    # pip runtime envs build a venv inside the worker boot:
+                    # give them the long budget too
+                    slow_env = bool(rh_ and (self.runtime_envs.get(rh_)
+                                             or {}).get("pip"))
+                    limit_ = (CHIP_SPAWN_TIMEOUT_S if chips_ or slow_env
+                              else SPAWN_TIMEOUT_S)
                     if now - ts_ <= limit_:
                         break
                     dq.popleft()  # spawn presumed failed; allow retry
@@ -2420,6 +2443,7 @@ class GcsServer:
                         n.node_id, n.total, dict(n.total), n.labels, True)
                     tot_nodes.append(t)
             if (_persist  # restore path: nodes re-register after start
+                    and not self._autoscaler_conns  # growth may make it fit
                     and pg_policy.place_bundles(
                         tot_nodes, [b.total for b in pg.bundles], pg.strategy) is None):
                 return ("placement group is infeasible: no node set satisfies "
@@ -2743,7 +2767,12 @@ class GcsServer:
                             self._actor_dead_cleanup_locked(actor.create_spec))
         if death_free:
             self._free_objects(death_free)
-        death_reason = w.oom_why or f"worker {wid} died"
+        # a pre-kill OOM tag explains this death only if it is fresh — a
+        # pick whose reply was lost (agent never killed) must not blame a
+        # much later unrelated death on memory pressure
+        oom_fresh = (w.oom_why is not None
+                     and time.monotonic() - w.oom_ts < 30.0)
+        death_reason = (w.oom_why if oom_fresh else None) or f"worker {wid} died"
         for spec in fail:
             self._fail_task_objects(
                 spec, "task was cancelled" if spec.get("_cancelled")

@@ -127,10 +127,16 @@ class Node:
                         # device-pool relay, and a worker blocking on (or
                         # wedging) the single-chip grant takes the pool down.
                         env.pop("PALLAS_AXON_POOL_IPS", None)
+                # pip runtime envs boot through a shim that builds the venv
+                # IN the worker process, then re-execs under its interpreter
+                # — the scheduler thread never waits on pip
+                entry = ("ray_tpu._private.worker_boot"
+                         if runtime_env and runtime_env.get("pip")
+                         else "ray_tpu._private.worker_main")
                 log = open(os.path.join(self.session_dir, "logs", f"worker-{len(self._procs)}.log"), "ab")
                 try:
                     p = subprocess.Popen(
-                        [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                        [sys.executable, "-m", entry],
                         env=env,
                         stdout=log,
                         stderr=subprocess.STDOUT,

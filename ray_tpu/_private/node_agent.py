@@ -208,11 +208,14 @@ class NodeAgent:
                 env["JAX_PLATFORMS"] = platform
                 if platform == "cpu":
                     env.pop("PALLAS_AXON_POOL_IPS", None)
+            entry = ("ray_tpu._private.worker_boot"
+                     if runtime_env and runtime_env.get("pip")
+                     else "ray_tpu._private.worker_main")
             log = open(os.path.join(self.session_dir, "logs",
                                     f"worker-{len(self._procs)}.log"), "ab")
             try:
                 p = subprocess.Popen(
-                    [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                    [sys.executable, "-m", entry],
                     env=env, stdout=log, stderr=subprocess.STDOUT,
                     cwd=os.getcwd())
             finally:

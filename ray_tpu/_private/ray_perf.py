@@ -62,6 +62,27 @@ def main():
         results.append(timeit(
             "get_numpy_2MiB_zero_copy", lambda: ray_tpu.get(ref_big)))
 
+        # honest store-path get: every object fetched exactly once (the
+        # _zero_copy number above re-reads one cached mmap — real, but not
+        # comparable to the reference's fresh-object methodology)
+        fresh = np.zeros(1 << 15, dtype=np.float64)  # 256 KiB → shm path
+        pool = [ray_tpu.put(fresh) for _ in range(400)]
+        it = iter(pool)
+        t0 = time.perf_counter()
+        n_got = 0
+        for ref in it:
+            ray_tpu.get(ref)
+            n_got += 1
+            if time.perf_counter() - t0 > 2.0:
+                break
+        dt = (time.perf_counter() - t0) / max(n_got, 1)
+        rec = {"name": "get_numpy_256KiB_fresh",
+               "ops_per_s": round(1 / dt, 1), "us_per_op": round(dt * 1e6, 1)}
+        print(f"{'get_numpy_256KiB_fresh':48s} {1 / dt:12.1f} ops/s   "
+              f"{dt * 1e6:10.1f} us/op")
+        results.append(rec)
+        del pool  # auto-GC frees the shm copies
+
         # ---- tasks --------------------------------------------------------
         @ray_tpu.remote
         def nop():

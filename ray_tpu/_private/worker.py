@@ -124,11 +124,17 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        return self.next_item()
+
+    def next_item(self, timeout: float = 86400.0) -> ObjectRef:
+        """next() with an explicit timeout: raises GetTimeoutError if the
+        producer yields nothing in time (a hung — not dead — producer
+        blocks plain next() indefinitely, like the reference's generators)."""
         if self._done:
             raise StopIteration
         reply = self._worker.rpc(
             {"type": "stream_next", "task_id": self._task_id,
-             "index": self._index}, timeout=86400.0)
+             "index": self._index}, timeout=timeout)
         if reply.get("done"):
             self._done = True
             err = reply.get("error")

@@ -1,0 +1,41 @@
+"""Worker bootstrap shim: build the pip runtime env, then exec worker_main.
+
+Spawned instead of worker_main when the runtime env carries a "pip" field:
+the (possibly slow) venv creation happens HERE, in the worker process, so
+the scheduler thread never blocks on pip; the process then re-execs under
+the venv interpreter with ray_tpu's location pinned on PYTHONPATH.
+
+(reference: the runtime-env agent materializes envs before worker start,
+_private/runtime_env/agent/runtime_env_agent.py:165.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main():
+    renv = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV") or "{}")
+    pip_spec = renv.get("pip")
+    if pip_spec:
+        from ray_tpu._private.runtime_env_pip import ensure_venv
+
+        python = ensure_venv(pip_spec)
+        # ray_tpu itself isn't installed into the venv: pin its parent dir
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pp = os.environ.get("PYTHONPATH", "")
+        parts = [p for p in pp.split(os.pathsep) if p]
+        if pkg_parent not in parts:
+            parts.insert(0, pkg_parent)
+        os.environ["PYTHONPATH"] = os.pathsep.join(parts)
+        os.execv(python, [python, "-m", "ray_tpu._private.worker_main"])
+    from ray_tpu._private import worker_main
+
+    sys.exit(worker_main.main())
+
+
+if __name__ == "__main__":
+    main()

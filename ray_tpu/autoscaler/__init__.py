@@ -1,4 +1,8 @@
 from .autoscaler import Autoscaler, NodeType
+from .gce_tpu import (FakeGceTpuApi, GceTpuApi, GceTpuNodeProvider,
+                      tpu_slice_node_type)
 from .node_provider import LocalNodeProvider, NodeProvider
 
-__all__ = ["Autoscaler", "NodeType", "NodeProvider", "LocalNodeProvider"]
+__all__ = ["Autoscaler", "NodeType", "NodeProvider", "LocalNodeProvider",
+           "GceTpuApi", "FakeGceTpuApi", "GceTpuNodeProvider",
+           "tpu_slice_node_type"]

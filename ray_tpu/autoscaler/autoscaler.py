@@ -50,13 +50,20 @@ class Autoscaler:
 
     def __init__(self, gcs_address: str, provider: NodeProvider,
                  node_types: List[NodeType], *, interval_s: float = 2.0,
-                 idle_timeout_s: float = 60.0):
+                 idle_timeout_s: float = 60.0,
+                 node_startup_grace_s: float = 60.0):
         self.provider = provider
         self.node_types = {nt.name: nt for nt in node_types}
         self.interval_s = interval_s
         self.idle_timeout_s = idle_timeout_s
+        # launched nodes get this long to join before their capacity stops
+        # counting as pending (reference: the resource demand scheduler
+        # subtracts launching nodes from unmet demand so each reconcile
+        # doesn't relaunch for the same backlog)
+        self.node_startup_grace_s = node_startup_grace_s
         self._conn = connect_address(gcs_address)
         self._rid = itertools.count(1)
+        self._rpc({"type": "autoscaler_attach"})  # infeasible PGs now pend
         self._nodes: Dict[str, str] = {}  # provider node id → type name
         self._launch_times: Dict[str, float] = {}
         self._idle_since: Dict[str, float] = {}
@@ -65,13 +72,16 @@ class Autoscaler:
 
     # -- GCS I/O -----------------------------------------------------------
 
-    def _demand(self) -> dict:
-        msg = {"type": "resource_demand", "rid": next(self._rid)}
+    def _rpc(self, msg: dict) -> dict:
+        msg["rid"] = next(self._rid)
         self._conn.send(msg)
         while True:
             reply = self._conn.recv()
             if reply.get("rid") == msg["rid"]:
-                return reply["demand"]
+                return reply
+
+    def _demand(self) -> dict:
+        return self._rpc({"type": "resource_demand"})["demand"]
 
     # -- reconciliation ----------------------------------------------------
 
@@ -106,26 +116,40 @@ class Autoscaler:
                 counts[nt.name] = counts.get(nt.name, 0) + 1
 
         # 3. bin-pack unmet demand onto new nodes — several demands may share
-        #    one planned node (reference: ResourceDemandScheduler bin-packing)
-        planned: List[tuple] = []  # (NodeType, remaining capacity)
+        #    one planned node (reference: ResourceDemandScheduler bin-packing).
+        #    Recently launched nodes that haven't joined yet are seeded as
+        #    pending capacity so the same backlog doesn't relaunch each pass.
+        now0 = time.monotonic()
+        joined = set(demand.get("node_ids") or ())
+        planned: List[tuple] = []  # (NodeType, remaining capacity, is_new)
+        for nid, tname in self._nodes.items():
+            nt = self.node_types.get(tname)
+            if (nt is not None and nid not in joined  # joined capacity is
+                    # already in available_resources — counting it again
+                    # would absorb real demand into phantom capacity
+                    and now0 - self._launch_times.get(nid, 0.0)
+                    < self.node_startup_grace_s):
+                planned.append((nt, dict(nt.resources), False))
         for d in sorted(unmet, key=lambda d: -sum(d.values())):
-            for _, rem in planned:
+            for _, rem, _new in planned:
                 if _fits(rem, d):
                     _deduct(rem, d)
                     break
             else:
                 for nt in self.node_types.values():
                     count_now = (counts.get(nt.name, 0)
-                                 + sum(1 for p, _ in planned
-                                       if p.name == nt.name))
+                                 + sum(1 for p, _r, new in planned
+                                       if new and p.name == nt.name))
                     if count_now >= nt.max_nodes:
                         continue
                     if _fits(dict(nt.resources), d):
                         rem = dict(nt.resources)
                         _deduct(rem, d)
-                        planned.append((nt, rem))
+                        planned.append((nt, rem, True))
                         break
-        for nt, _ in planned:
+        for nt, _rem, new in planned:
+            if not new:
+                continue
             nid = self._launch(nt)
             actions["launched"].append((nt.name, nid))
 

@@ -171,7 +171,9 @@ class IMPALA(Algorithm):
             i = idx % len(self._streams)
             idx += 1
             try:
-                ref = next(self._streams[i])
+                # bounded wait: a HUNG runner (alive but stuck) must also
+                # trip the restart path, not block for a day
+                ref = self._streams[i].next_item(timeout=120.0)
                 batch = ray_tpu.get(ref, timeout=120.0)
             except StopIteration:
                 # stream exhausted (bounded runs): restart it

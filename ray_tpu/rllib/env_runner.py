@@ -26,15 +26,14 @@ class EnvRunner:
         self.num_envs = num_envs
         self._params_blob = None  # pushed by set_weights (IMPALA streaming)
 
-    def sample(self, params_blob: bytes, num_steps: int) -> dict:
-        """Roll `num_steps` per sub-env; returns time-major arrays
-        [T, N, ...] plus bootstrap values for GAE."""
+    def _rollout(self, params, num_steps: int) -> dict:
+        """Shared on-policy rollout loop: time-major buffers for one
+        fragment (sample() adds values/bootstrap for GAE; stream_rollouts
+        relabels logp as the behavior policy for V-trace)."""
         import jax
 
-        from ray_tpu._private import serialization as ser
         from ray_tpu.rllib import rl_module
 
-        params = ser.loads(params_blob)
         T, N = num_steps, self.num_envs
         obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
         act_buf = np.zeros((T, N), np.int32)
@@ -52,13 +51,21 @@ class EnvRunner:
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             self.obs, rew_buf[t], done_buf[t], _ = self.env.step(action)
+        return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "values": val_buf, "rewards": rew_buf, "dones": done_buf}
+
+    def sample(self, params_blob: bytes, num_steps: int) -> dict:
+        """Roll `num_steps` per sub-env; returns time-major arrays
+        [T, N, ...] plus bootstrap values for GAE."""
+        from ray_tpu._private import serialization as ser
+        from ray_tpu.rllib import rl_module
+
+        params = ser.loads(params_blob)
+        out = self._rollout(params, num_steps)
         _, last_value = rl_module.forward(params, self.obs)
-        return {
-            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
-            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
-            "last_value": np.asarray(last_value),
-            "episode_returns": self.env.drain_episode_returns(),
-        }
+        out["last_value"] = np.asarray(last_value)
+        out["episode_returns"] = self.env.drain_episode_returns()
+        return out
 
     def sample_epsilon_greedy(self, params_blob: bytes, num_steps: int,
                               epsilon: float) -> dict:
@@ -108,35 +115,19 @@ class EnvRunner:
         weights, tagging each with the behavior policy's logp so the
         learner can V-trace-correct the off-policy gap. Producer-side
         backpressure bounds how far ahead of the learner this runs."""
-        import jax
+        import time as _time
 
         from ray_tpu._private import serialization as ser
-        from ray_tpu.rllib import rl_module
-
-        import time as _time
 
         while self._params_blob is None:  # first weight push may race us in
             _time.sleep(0.01)
         for _ in range(max_batches):
             params = ser.loads(self._params_blob)
-            T, N = num_steps, self.num_envs
-            obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
-            act_buf = np.zeros((T, N), np.int32)
-            logp_buf = np.zeros((T, N), np.float32)
-            rew_buf = np.zeros((T, N), np.float32)
-            done_buf = np.zeros((T, N), np.bool_)
-            for t in range(T):
-                self.key, sub = jax.random.split(self.key)
-                action, logp, _value = rl_module.forward_exploration(
-                    params, self.obs, sub)
-                action = np.asarray(action)
-                obs_buf[t] = self.obs
-                act_buf[t] = action
-                logp_buf[t] = np.asarray(logp)
-                self.obs, rew_buf[t], done_buf[t], _ = self.env.step(action)
+            roll = self._rollout(params, num_steps)
             yield {
-                "obs": obs_buf, "actions": act_buf, "behavior_logp": logp_buf,
-                "rewards": rew_buf, "dones": done_buf,
+                "obs": roll["obs"], "actions": roll["actions"],
+                "behavior_logp": roll["logp"], "rewards": roll["rewards"],
+                "dones": roll["dones"],
                 "bootstrap_obs": np.asarray(self.obs, np.float32),
                 "episode_returns": self.env.drain_episode_returns(),
             }

@@ -73,9 +73,17 @@ def package(runtime_env: dict, kv_put, kv_get) -> dict:
     mods = env.pop("py_modules", None)
     if mods:
         out["py_modules"] = [_upload_dir(m, kv_put, kv_get) for m in mods]
+    pip_spec = env.pop("pip", None)
+    if pip_spec is None:
+        pip_spec = env.pop("uv", None)  # uv schema: same requirement lines
+    if pip_spec:
+        from ray_tpu._private.runtime_env_pip import normalize_pip
+
+        out["pip"] = normalize_pip(pip_spec)
     if env:
         raise ValueError(f"unsupported runtime_env keys: {sorted(env)} "
-                         "(supported: env_vars, working_dir, py_modules)")
+                         "(supported: env_vars, working_dir, py_modules, "
+                         "pip, uv)")
     return out
 
 

@@ -295,7 +295,9 @@ def broadcast(tensor: np.ndarray | None, *, src_rank: int = 0,
     got = parts[src_rank]
     is_ref = hasattr(got, "hex")
     if g.rank == src_rank:
-        out = payload  # no reason to re-fetch our own payload
+        # no re-fetch of our own payload — but return an independent copy,
+        # matching what every other rank receives
+        out = payload.copy()
     else:
         out = ray_tpu.get(got) if is_ref else got
     if is_ref or big:
