@@ -46,6 +46,9 @@ MAX_LINEAGE = RayConfig.get("max_lineage")
 # chip spawns can block minutes in TPU plugin init; plain spawns are fast
 SPAWN_TIMEOUT_S = 60.0
 CHIP_SPAWN_TIMEOUT_S = 300.0
+# pip envs build a venv + install inside the worker boot (each phase gets
+# up to PIP_TIMEOUT_S=600s): the presumed-failed budget must exceed that
+PIP_SPAWN_TIMEOUT_S = 1500.0
 
 
 class _Worker:
@@ -1144,6 +1147,19 @@ class GcsServer:
                 for ev in msg.get("events", []):
                     ev.setdefault("worker_id", wid or "")
                     self.task_events.append(ev)
+                    if ev.get("direct") and ev.get("name") != "actor_create":
+                        # direct-dispatch tasks never pass through
+                        # submit/task_done: account them here so cluster
+                        # task counters (and the errors channel) stay truthful
+                        self.task_counter["submitted"] += 1
+                        self.task_counter[
+                            "finished" if ev.get("ok") else "failed"] += 1
+                        if not ev.get("ok"):
+                            self.publish("errors", {
+                                "task_id": ev.get("task_id"), "kind": "task",
+                                "name": ev.get("name"),
+                                "worker": ev.get("worker_id"),
+                                "error": ev.get("error"), "ts": ev.get("end")})
         elif t == "task_events":
             with self.lock:
                 events = list(self.task_events)
@@ -1909,9 +1925,10 @@ class GcsServer:
                     ts_, chips_, rh_ = dq[0]
                     # pip runtime envs build a venv inside the worker boot:
                     # give them the long budget too
-                    slow_env = bool(rh_ and (self.runtime_envs.get(rh_)
-                                             or {}).get("pip"))
-                    limit_ = (CHIP_SPAWN_TIMEOUT_S if chips_ or slow_env
+                    pip_env = bool(rh_ and (self.runtime_envs.get(rh_)
+                                            or {}).get("pip"))
+                    limit_ = (PIP_SPAWN_TIMEOUT_S if pip_env
+                              else CHIP_SPAWN_TIMEOUT_S if chips_
                               else SPAWN_TIMEOUT_S)
                     if now - ts_ <= limit_:
                         break

@@ -26,8 +26,25 @@ import os
 import subprocess
 import sys
 
-VENV_BASE = "/tmp/ray_tpu/venvs"
 PIP_TIMEOUT_S = 600.0
+
+
+def venv_base() -> str:
+    """Per-user 0700 directory (override: RAY_TPU_VENV_BASE). A fixed
+    world-writable path would let another local user pre-plant a venv at a
+    predictable content hash that worker_boot would exec."""
+    import stat
+    import tempfile
+
+    base = os.environ.get("RAY_TPU_VENV_BASE") or os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_venvs_{os.getuid()}")
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    info = os.stat(base)
+    if info.st_uid != os.getuid() or info.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
+        raise RuntimeError(
+            f"refusing venv base {base!r}: not owned by uid {os.getuid()} "
+            "or group/world-writable")
+    return base
 
 
 def pip_hash(entries: list[str]) -> str:
@@ -50,13 +67,13 @@ def normalize_pip(spec) -> list[str]:
 def ensure_venv(entries: list[str]) -> str:
     """Create (or reuse) the venv for `entries`; returns its python path."""
     h = pip_hash(entries)
-    dest = os.path.join(VENV_BASE, h)
+    base = venv_base()
+    dest = os.path.join(base, h)
     python = os.path.join(dest, "bin", "python")
     marker = os.path.join(dest, ".ready")
     if os.path.exists(marker):
         return python
-    os.makedirs(VENV_BASE, exist_ok=True)
-    lock_path = os.path.join(VENV_BASE, f".{h}.lock")
+    lock_path = os.path.join(base, f".{h}.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:

@@ -772,6 +772,7 @@ class CoreWorker:
         key = shape_key(spec["resources"], spec.get("renv_hash", ""))
         if inline_deps:
             spec["inline_deps"] = inline_deps
+        spec["_direct"] = True  # task events carry this so GCS counters see it
         tid = spec["task_id"]
         holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
         for d in holds:
@@ -843,6 +844,7 @@ class CoreWorker:
                 ent["fut"].set({"ready": False, "redirect": True})
         spec["strategy"] = None
         spec.pop("_cancelled", None)
+        spec.pop("_direct", None)  # the GCS path counts it from here on
         try:
             self.rpc({"type": "submit_task", "spec": spec})
         except Exception:
@@ -1577,7 +1579,8 @@ class CoreWorker:
         _te.emit("task:execute", task_id=spec["task_id"],
                  name=spec.get("name") or spec.get("method") or kind,
                  start=_t_exec0, end=time.time(), kind=kind,
-                 ok=error_blob is None)
+                 ok=error_blob is None, direct=spec.get("_direct", False),
+                 **({"error": error_blob} if error_blob else {}))
         lite = {k: spec.get(k) for k in ("task_id", "kind", "actor_id", "resources", "num_returns", "max_retries", "retries_used")}
         # flush ref deltas BEFORE task_done on the same ordered connection:
         # refs this task deserialized/retained must reach the GCS before it

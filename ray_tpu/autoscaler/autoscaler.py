@@ -124,9 +124,11 @@ class Autoscaler:
         planned: List[tuple] = []  # (NodeType, remaining capacity, is_new)
         for nid, tname in self._nodes.items():
             nt = self.node_types.get(tname)
-            if (nt is not None and nid not in joined  # joined capacity is
-                    # already in available_resources — counting it again
-                    # would absorb real demand into phantom capacity
+            if (nt is not None
+                    # joined capacity is already in available_resources —
+                    # counting it again would absorb real demand into
+                    # phantom capacity (providers map ids via node_joined)
+                    and not self.provider.node_joined(nid, joined)
                     and now0 - self._launch_times.get(nid, 0.0)
                     < self.node_startup_grace_s):
                 planned.append((nt, dict(nt.resources), False))

@@ -40,14 +40,17 @@ _SLICE_SHAPES = {
 
 
 def slice_shape(accelerator_type: str) -> tuple[int, int]:
-    """(total chips, hosts) for an accelerator type; falls back to parsing
-    the chip count off the name (4 chips/host)."""
+    """(total chips, hosts) for an accelerator type. Fallback parsing
+    follows the GCE naming convention: v4/v5p suffixes count TensorCores
+    (2 per chip), v5litepod/v6e suffixes count chips; 4 chips per host."""
     if accelerator_type in _SLICE_SHAPES:
         return _SLICE_SHAPES[accelerator_type]
     m = re.search(r"-(\d+)$", accelerator_type)
     if not m:
         raise ValueError(f"unknown accelerator_type {accelerator_type!r}")
-    chips = int(m.group(1))
+    n = int(m.group(1))
+    chips = n // 2 if accelerator_type.startswith(("v4-", "v5p-")) else n
+    chips = max(1, chips)
     return chips, max(1, chips // 4)
 
 
@@ -152,3 +155,9 @@ class GceTpuNodeProvider(NodeProvider):
 
     def is_ready(self, node_id: str) -> bool:
         return self.api.node_state(node_id) == "READY"
+
+    def node_joined(self, node_id: str, gcs_node_ids) -> bool:
+        """Slice VMs register host ids prefixed with the slice name (the
+        startup script passes --host-id <slice-name>-w<k>), so joined-ness
+        is a prefix match rather than id equality."""
+        return any(str(g).startswith(node_id) for g in gcs_node_ids)

@@ -40,6 +40,11 @@ class NodeProvider:
         """Has the node joined the cluster?"""
         return True
 
+    def node_joined(self, node_id: str, gcs_node_ids) -> bool:
+        """Does this provider node correspond to a registered GCS node?
+        Providers whose nodes register under different ids override this."""
+        return node_id in set(gcs_node_ids)
+
     def shutdown(self) -> None:
         for nid in list(self.non_terminated_nodes()):
             self.terminate_node(nid)

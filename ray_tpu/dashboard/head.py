@@ -105,16 +105,11 @@ class _Handler(BaseHTTPRequestHandler):
         q = urllib.parse.parse_qs(parsed.query)
         try:
             if path == "/":
-                st = gcs.rpc({"type": "cluster_state"})["state"]
-                total, avail = st["total_resources"], st["available_resources"]
-                rows = "".join(
-                    f"<tr><td>{k}</td><td>{total[k]-avail.get(k,0):.1f}</td>"
-                    f"<td>{total[k]:.1f}</td></tr>" for k in sorted(total))
-                html = _INDEX.format(
-                    session=os.path.basename(gcs.session_dir),
-                    num_workers=st["num_workers"], num_actors=st["num_actors"],
-                    pending_tasks=st["pending_tasks"], resources=rows)
-                self._send(html.encode(), "text/html")
+                # single-file web UI over the JSON API (reference: the
+                # dashboard React client, python/ray/dashboard/client/)
+                ui = os.path.join(os.path.dirname(__file__), "ui.html")
+                with open(ui, "rb") as f:
+                    self._send(f.read(), "text/html")
             elif path == "/api/cluster":
                 self._json(gcs.rpc({"type": "cluster_state"})["state"])
             elif path == "/api/nodes":

@@ -9,5 +9,8 @@ backpressure contract without the mutable-buffer C++ plane.)
 """
 
 from ray_tpu.experimental.channel.channel import Channel, ChannelClosed, create_channel
+from ray_tpu.experimental.channel.mutable_shm import (MutableShmChannel,
+                                                      create_mutable_channel)
 
-__all__ = ["Channel", "ChannelClosed", "create_channel"]
+__all__ = ["Channel", "ChannelClosed", "create_channel",
+           "MutableShmChannel", "create_mutable_channel"]

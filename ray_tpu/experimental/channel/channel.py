@@ -108,6 +108,16 @@ class Channel:
         return (Channel, (self._broker, self.maxsize))
 
 
-def create_channel(maxsize: int = 2) -> Channel:
+def create_channel(maxsize: int = 2, *, transport: str = "broker",
+                   buffer_bytes: int = 1 << 20):
+    """transport="broker" (default): cross-host-capable ref-passing channel.
+    transport="shm": same-host mutable shared-memory channel — microsecond
+    hops, maxsize fixed at 1 (the mutable-buffer semantics of the
+    reference's shared_memory_channel.py:151)."""
+    if transport == "shm":
+        from ray_tpu.experimental.channel.mutable_shm import (
+            create_mutable_channel)
+
+        return create_mutable_channel(buffer_bytes)
     broker = _Broker.options(num_cpus=0.1).remote(maxsize)
     return Channel(broker, maxsize)

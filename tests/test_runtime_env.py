@@ -158,7 +158,7 @@ def test_pip_runtime_env_isolated_venv(session, tmp_path):
 
     magic, prefix = ray_tpu.get(probe.remote(), timeout=300)
     assert magic == "probe-0.1.0"
-    assert "/ray_tpu/venvs/" in prefix  # ran under the venv interpreter
+    assert "ray_tpu_venvs" in prefix  # ran under the venv interpreter
 
     # cache hit: same spec reuses the venv (fast second task)
     t0 = time.monotonic()
