@@ -311,7 +311,7 @@ class GcsServer:
             # a worker wedged in native code can't hang the requester forever
             with self.lock:
                 expired = [(tok, w) for tok, w in self._tensor_exports.items()
-                           if now - w[3] > 30.0]
+                           if now - w[3] > (w[4] if len(w) > 4 else 30.0)]
                 for tok, _ in expired:
                     self._tensor_exports.pop(tok, None)
             for _, (wconn, wrid, *_rest) in expired:
@@ -1022,6 +1022,35 @@ class GcsServer:
             else:
                 try:
                     target.conn.send({"type": "dump_stacks", "token": token})
+                except ConnectionClosed:
+                    with self.lock:
+                        self._tensor_exports.pop(token, None)
+                    conn.send({"rid": msg["rid"], "ok": False,
+                               "error": "worker connection lost"})
+        elif t == "worker_profile":
+            # on-demand in-process sampling profiler (reference capability:
+            # dashboard/modules/reporter's py-spy integration; here the
+            # worker samples its own frames — no ptrace in the sandbox)
+            with self.lock:
+                target = self.workers.get(msg["wid"])
+                if target is not None and not target.dead:
+                    token = f"pf-{msg['rid']}-{id(conn) & 0xffffff}"
+                    # sampling runs duration_s in the worker: park the
+                    # waiter with a TTL that outlives it
+                    ttl = float(msg.get("duration_s", 5.0)) + 30.0
+                    self._tensor_exports[token] = (conn, msg["rid"], msg["wid"],
+                                                   time.monotonic(), ttl)
+                else:
+                    target = None
+            if target is None:
+                conn.send({"rid": msg["rid"], "ok": False,
+                           "error": "no such live worker"})
+            else:
+                try:
+                    target.conn.send({
+                        "type": "profile", "token": token,
+                        "duration_s": float(msg.get("duration_s", 5.0)),
+                        "hz": float(msg.get("hz", 50.0))})
                 except ConnectionClosed:
                     with self.lock:
                         self._tensor_exports.pop(token, None)

@@ -456,6 +456,47 @@ class CoreWorker:
                                             "text": "\n".join(parts)})
                     except ConnectionClosed:
                         pass
+                elif msg.get("type") == "profile":
+                    # sampling profiler: collect collapsed stacks at `hz`
+                    # for `duration_s`, reply via the stacks relay
+                    def _profile(m=msg):
+                        import collections as _c
+                        import traceback as _tb
+
+                        duration = min(float(m.get("duration_s", 5.0)), 60.0)
+                        period = 1.0 / max(1.0, min(float(m.get("hz", 50.0)), 200.0))
+                        counts: _c.Counter = _c.Counter()
+                        samples = 0
+                        end = time.monotonic() + duration
+                        me = threading.get_ident()
+                        while time.monotonic() < end:
+                            for tid, frame in sys._current_frames().items():
+                                if tid == me:
+                                    continue
+                                stack = []
+                                f = frame
+                                while f is not None:
+                                    co = f.f_code
+                                    stack.append(f"{co.co_name} "
+                                                 f"({co.co_filename.rsplit('/', 1)[-1]}"
+                                                 f":{f.f_lineno})")
+                                    f = f.f_back
+                                counts[";".join(reversed(stack))] += 1
+                            samples += 1
+                            time.sleep(period)
+                        lines = [f"{n:6d}  {st}" for st, n in counts.most_common(40)]
+                        text = (f"# {samples} samples over {duration:.1f}s "
+                                f"(collapsed stacks, hottest first)\n"
+                                + "\n".join(lines))
+                        try:
+                            self.send_no_reply({"type": "stacks_reply",
+                                                "token": m["token"],
+                                                "text": text})
+                        except ConnectionClosed:
+                            pass
+
+                    threading.Thread(target=_profile, daemon=True,
+                                     name="profiler").start()
                 elif msg.get("type") == "free_device_tensors":
                     from ray_tpu.experimental import device_objects
 
@@ -794,6 +835,7 @@ class CoreWorker:
                 for i in range(spec["num_returns"]):
                     self._owned.pop(f"{tid}r{i:04d}", None)
             spec.pop("inline_deps", None)
+            spec.pop("_direct", None)  # GCS path counts it; avoid doubling
             return False
         return True
 

@@ -159,5 +159,6 @@ class GceTpuNodeProvider(NodeProvider):
     def node_joined(self, node_id: str, gcs_node_ids) -> bool:
         """Slice VMs register host ids prefixed with the slice name (the
         startup script passes --host-id <slice-name>-w<k>), so joined-ness
-        is a prefix match rather than id equality."""
-        return any(str(g).startswith(node_id) for g in gcs_node_ids)
+        is a "<name>-w" prefix match — the separator keeps slice "tpu-1"
+        from matching hosts of slice "tpu-10"."""
+        return any(str(g).startswith(node_id + "-w") for g in gcs_node_ids)

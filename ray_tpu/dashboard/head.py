@@ -59,27 +59,6 @@ class _Gcs:
         raise RuntimeError("unreachable")
 
 
-_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
-<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
-td,th{{border:1px solid #999;padding:4px 8px;text-align:left}}</style></head>
-<body><h2>ray_tpu — {session}</h2>
-<p>workers: {num_workers} &nbsp; actors: {num_actors} &nbsp;
-pending tasks: {pending_tasks}</p>
-<h3>resources</h3><table><tr><th>resource</th><th>used</th><th>total</th></tr>
-{resources}</table>
-<h3>endpoints</h3><ul>
-<li><a href="/api/cluster">/api/cluster</a></li>
-<li><a href="/api/nodes">/api/nodes</a></li>
-<li><a href="/api/actors">/api/actors</a></li>
-<li><a href="/api/placement_groups">/api/placement_groups</a></li>
-<li><a href="/api/jobs">/api/jobs</a></li>
-<li><a href="/api/tasks">/api/tasks</a></li>
-<li><a href="/api/logs">/api/logs</a></li>
-<li><a href="/api/timeline">/api/timeline</a></li>
-<li><a href="/metrics">/metrics</a></li>
-</ul></body></html>"""
-
-
 class _Handler(BaseHTTPRequestHandler):
     server_version = "ray_tpu_dashboard/1"
 

@@ -113,6 +113,7 @@ class Stage:
     a2a_refs: Callable | None = None      # distributed barrier: refs -> refs
     resources: dict = field(default_factory=lambda: {"CPU": 1.0})
     max_in_flight: int = 8
+    concurrency: object = None  # int or (min, max) for actor pools
     compute: str = "tasks"  # "tasks" | "actors" (stateful UDF pool)
 
     def run_chain(self, blocks: list[Block]) -> list[Block]:
@@ -166,8 +167,12 @@ def build_stages(ops: list[L.LogicalOp], default_parallelism: int) -> list[Stage
                 cur.transforms.append(t)
             else:
                 flush()
+                conc = op.concurrency or 8
+                # (min, max) tuples configure an autoscaling actor pool
+                # (reference: concurrency=(m, n) on map_batches)
+                mif = max(conc) if isinstance(conc, (tuple, list)) else conc
                 cur = Stage(name="MapBatches", transforms=[t], resources=res,
-                            max_in_flight=op.concurrency or 8,
+                            max_in_flight=mif, concurrency=conc,
                             compute=op.compute or "tasks")
         elif isinstance(op, L.MapRows):
             t = _rows_transform(op.fn, op.kind)
@@ -673,25 +678,74 @@ class _MapPoolActor:
 
 
 class _ActorPool:
-    """Round-robin pool exposing the task-API shape (`.remote(payload)`)
-    so the executor dispatch path is compute-agnostic (reference:
-    execution/operators/actor_pool_map_operator.py:47)."""
+    """Least-loaded autoscaling pool exposing the task-API shape
+    (`.remote(payload)`): dispatch routes to the actor with the fewest
+    outstanding inputs, the pool grows toward max_size while every actor is
+    backed up, and idle actors above min_size are released. The executor
+    reports completions via note_done() (reference:
+    execution/operators/actor_pool_map_operator.py:47 — load-based routing
+    + pool autoscaling, replacing round-1's blind round-robin)."""
 
-    def __init__(self, stage: "Stage", size: int):
+    IDLE_RELEASE_S = 10.0
+
+    def __init__(self, stage: "Stage", size, min_size: int | None = None):
         from ray_tpu._private import serialization as ser
 
+        if isinstance(size, (tuple, list)):
+            min_size, size = int(size[0]), int(size[1])
+        self.min_size = max(1, int(min_size if min_size is not None else size))
+        self.max_size = max(self.min_size, int(size))
         res = stage.resources
         blob = ser.dumps(stage.transforms)
-        cls = _MapPoolActor.options(
+        self._cls = _MapPoolActor.options(
             num_cpus=res.get("CPU", 1.0),
             num_tpus=res.get("TPU", 0.0) or None)
-        self.actors = [cls.remote(blob) for _ in range(max(1, int(size)))]
-        self._i = 0
+        self._blob = blob
+        self.actors = [self._cls.remote(blob) for _ in range(self.min_size)]
+        self._outstanding: dict[str, int] = {}  # ref hex → actor index
+        self._load = [0] * len(self.actors)
+        self._idle_since = [time.monotonic()] * len(self.actors)
 
     def remote(self, payload):
-        actor = self.actors[self._i % len(self.actors)]
-        self._i += 1
-        return actor.run.remote(payload)
+        # grow whenever every live actor is already busy — the executor
+        # caps total outstanding at max_size, so requiring a deeper backlog
+        # would plateau the pool below the requested maximum
+        if (len(self.actors) < self.max_size
+                and self._load and min(self._load) >= 1):
+            self.actors.append(self._cls.remote(self._blob))
+            self._load.append(0)
+            self._idle_since.append(time.monotonic())
+        idx = min(range(len(self.actors)), key=lambda i: self._load[i])
+        self._load[idx] += 1
+        ref = self.actors[idx].run.remote(payload)
+        self._outstanding[ref.hex()] = idx
+        return ref
+
+    def note_done(self, ref_hex: str) -> None:
+        idx = self._outstanding.pop(ref_hex, None)
+        if idx is None or idx >= len(self.actors):
+            return
+        self._load[idx] -= 1
+        now = time.monotonic()
+        if self._load[idx] == 0:
+            self._idle_since[idx] = now
+        # release ONE idle actor above min (newest first) per completion
+        if len(self.actors) > self.min_size:
+            for i in range(len(self.actors) - 1, self.min_size - 1, -1):
+                if (self._load[i] == 0
+                        and now - self._idle_since[i] > self.IDLE_RELEASE_S):
+                    a = self.actors.pop(i)
+                    self._load.pop(i)
+                    self._idle_since.pop(i)
+                    # reindex outstanding entries above i
+                    for k, v in list(self._outstanding.items()):
+                        if v > i:
+                            self._outstanding[k] = v - 1
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+                    break
 
     def shutdown(self):
         for a in self.actors:
@@ -737,7 +791,9 @@ class StreamingExecutor:
                     # stateful UDF pool (reference: ActorPoolMapOperator,
                     # execution/operators/actor_pool_map_operator.py:47):
                     # one actor per concurrency slot, round-robin dispatch
-                    pool = _ActorPool(stage, size=stage.max_in_flight)
+                    pool = _ActorPool(stage,
+                                      size=stage.concurrency
+                                      or stage.max_in_flight)
                     actor_pools.append(pool)
                     remote_cache[i] = pool
                 else:
@@ -841,9 +897,12 @@ class StreamingExecutor:
                 if in_flight[i]:
                     refs = [r for r, _ in in_flight[i].values()]
                     ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+                    pool = remote_cache.get(i)
                     for r in ready:
                         _, consumed = in_flight[i].pop(r.hex())
                         self._free_if_owned(consumed)
+                        if hasattr(pool, "note_done"):
+                            pool.note_done(r.hex())
                         queues[i + 1].append(r)
 
         def _upstream_a2a_done(i):

@@ -123,10 +123,15 @@ class MutableShmChannel:
         return value
 
     def close(self, drain: bool = False) -> None:
+        """Mark closed and unlink the backing file — existing mappings (the
+        peer's included) stay valid per POSIX; the name just can't leak.
+        `drain` is accepted for broker-channel signature parity (a mutable
+        buffer holds at most one unread payload; nothing to drain)."""
         try:
             self._set(closed=1)
         except ValueError:
             pass  # already unmapped
+        self.unlink()
 
     def unlink(self) -> None:
         try:

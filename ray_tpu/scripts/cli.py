@@ -176,7 +176,12 @@ def cmd_stack(args):
         if target is None:
             print(f"no live worker matching {args.worker!r}", file=sys.stderr)
             sys.exit(1)
-        reply = c.rpc({"type": "worker_stacks", "wid": target["wid"]})
+        if getattr(args, "profile", 0):
+            reply = c.rpc({"type": "worker_profile", "wid": target["wid"],
+                           "duration_s": args.profile,
+                           "hz": getattr(args, "hz", 50.0)})
+        else:
+            reply = c.rpc({"type": "worker_stacks", "wid": target["wid"]})
         if not reply.get("ok"):
             print(f"stack dump failed: {reply.get('error')}", file=sys.stderr)
             sys.exit(1)
@@ -320,6 +325,10 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("stack", help="live thread stacks of a worker")
+    sp.add_argument("--profile", type=float, default=0, metavar="SECONDS",
+                    help="sample for SECONDS and print a collapsed-stack "
+                         "profile instead of one snapshot")
+    sp.add_argument("--hz", type=float, default=50.0)
     sp.add_argument("worker", nargs="?", help="wid prefix or pid (omit to list)")
     sp.set_defaults(fn=cmd_stack)
 

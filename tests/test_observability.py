@@ -201,3 +201,34 @@ class TestClusterObservability:
         assert any(s.get("state") == "alive" for s in seen)
         err_sub.close()
         state_sub.close()
+
+
+def test_on_demand_sampling_profiler(ray_start_regular):
+    """worker_profile: the worker samples its own frames for a bounded
+    window and returns a collapsed-stack profile (reference capability:
+    dashboard reporter's on-demand py-spy profiling)."""
+    import time as _time
+
+    from ray_tpu._private import api as _api
+
+    @ray_tpu.remote
+    def spin():
+        t0 = _time.time()
+        x = 0
+        while _time.time() - t0 < 4:
+            x += sum(range(200))
+        return x
+
+    ref = spin.remote()
+    _time.sleep(0.5)
+    w = _api._get_worker()
+    live = [x for x in w.rpc({"type": "list_workers"})["workers"]
+            if not x["dead"] and x["kind"] == "worker"]
+    assert live
+    r = w.rpc({"type": "worker_profile", "wid": live[0]["wid"],
+               "duration_s": 1.5, "hz": 50}, timeout=40)
+    assert r.get("ok"), r
+    text = r["stacks"]
+    assert "samples over" in text and "collapsed stacks" in text
+    assert "spin" in text or "execute_spec" in text  # the busy task shows up
+    ray_tpu.get(ref, timeout=60)
