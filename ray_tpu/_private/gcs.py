@@ -1008,54 +1008,20 @@ class GcsServer:
         elif t == "worker_stacks":
             # live thread stacks of one worker process (reference:
             # dashboard/modules/reporter on-demand profiling)
-            with self.lock:
-                target = self.workers.get(msg["wid"])
-                if target is not None and not target.dead:
-                    token = f"st-{msg['rid']}-{id(conn) & 0xffffff}"
-                    self._tensor_exports[token] = (conn, msg["rid"], msg["wid"],
-                                                   time.monotonic())
-                else:
-                    target = None
-            if target is None:
-                conn.send({"rid": msg["rid"], "ok": False,
-                           "error": "no such live worker"})
-            else:
-                try:
-                    target.conn.send({"type": "dump_stacks", "token": token})
-                except ConnectionClosed:
-                    with self.lock:
-                        self._tensor_exports.pop(token, None)
-                    conn.send({"rid": msg["rid"], "ok": False,
-                               "error": "worker connection lost"})
+            self._park_relay(conn, msg, prefix="st",
+                             payload={"type": "dump_stacks"})
         elif t == "worker_profile":
             # on-demand in-process sampling profiler (reference capability:
             # dashboard/modules/reporter's py-spy integration; here the
-            # worker samples its own frames — no ptrace in the sandbox)
-            with self.lock:
-                target = self.workers.get(msg["wid"])
-                if target is not None and not target.dead:
-                    token = f"pf-{msg['rid']}-{id(conn) & 0xffffff}"
-                    # sampling runs duration_s in the worker: park the
-                    # waiter with a TTL that outlives it
-                    ttl = float(msg.get("duration_s", 5.0)) + 30.0
-                    self._tensor_exports[token] = (conn, msg["rid"], msg["wid"],
-                                                   time.monotonic(), ttl)
-                else:
-                    target = None
-            if target is None:
-                conn.send({"rid": msg["rid"], "ok": False,
-                           "error": "no such live worker"})
-            else:
-                try:
-                    target.conn.send({
-                        "type": "profile", "token": token,
-                        "duration_s": float(msg.get("duration_s", 5.0)),
-                        "hz": float(msg.get("hz", 50.0))})
-                except ConnectionClosed:
-                    with self.lock:
-                        self._tensor_exports.pop(token, None)
-                    conn.send({"rid": msg["rid"], "ok": False,
-                               "error": "worker connection lost"})
+            # worker samples its own frames — no ptrace in the sandbox).
+            # Sampling runs duration_s in the worker, so the parked waiter
+            # gets a TTL that outlives it.
+            self._park_relay(
+                conn, msg, prefix="pf",
+                ttl=float(msg.get("duration_s", 5.0)) + 30.0,
+                payload={"type": "profile",
+                         "duration_s": float(msg.get("duration_s", 5.0)),
+                         "hz": float(msg.get("hz", 50.0))})
         elif t == "stacks_reply":
             with self.lock:
                 waiter = self._tensor_exports.pop(msg["token"], None)
@@ -1645,6 +1611,31 @@ class GcsServer:
                 return
             entry["last_access"] = time.monotonic()  # LRU signal for the spiller
         self._reply_object(conn, msg["rid"], entry)
+
+    def _park_relay(self, conn: MsgConnection, msg: dict, *, prefix: str,
+                    payload: dict, ttl: float = 30.0) -> None:
+        """Forward `payload` (plus a reply token) to msg["wid"] and park the
+        requester until the worker's stacks_reply comes back; waiters are
+        (conn, rid, wid, parked_at, ttl) — expired by the health loop."""
+        with self.lock:
+            target = self.workers.get(msg["wid"])
+            if target is not None and not target.dead:
+                token = f"{prefix}-{msg['rid']}-{id(conn) & 0xffffff}"
+                self._tensor_exports[token] = (conn, msg["rid"], msg["wid"],
+                                               time.monotonic(), ttl)
+            else:
+                target = None
+        if target is None:
+            conn.send({"rid": msg["rid"], "ok": False,
+                       "error": "no such live worker"})
+            return
+        try:
+            target.conn.send({**payload, "token": token})
+        except ConnectionClosed:
+            with self.lock:
+                self._tensor_exports.pop(token, None)
+            conn.send({"rid": msg["rid"], "ok": False,
+                       "error": "worker connection lost"})
 
     # ------------------------------------------------------------- accounting
 
@@ -2725,7 +2716,7 @@ class GcsServer:
                 driver_death = True
             else:
                 driver_death = False
-        for _, (rconn, rrid, _owner, _ts) in stale_exports:
+        for _, (rconn, rrid, *_rest) in stale_exports:
             try:
                 rconn.send({"rid": rrid, "ok": False,
                             "error": "owner process died during export"})

@@ -123,15 +123,16 @@ class MutableShmChannel:
         return value
 
     def close(self, drain: bool = False) -> None:
-        """Mark closed and unlink the backing file — existing mappings (the
-        peer's included) stay valid per POSIX; the name just can't leak.
-        `drain` is accepted for broker-channel signature parity (a mutable
-        buffer holds at most one unread payload; nothing to drain)."""
+        """Mark closed; peers already attached observe ChannelClosed. The
+        NAME stays linked — a consumer that deserializes its channel arg
+        after close must still be able to attach and drain. The creator's
+        GC (or an explicit unlink()) removes the file. `drain` is accepted
+        for broker-channel signature parity (a mutable buffer holds at most
+        one unread payload; nothing to drain)."""
         try:
             self._set(closed=1)
         except ValueError:
             pass  # already unmapped
-        self.unlink()
 
     def unlink(self) -> None:
         try:
@@ -140,6 +141,7 @@ class MutableShmChannel:
             pass
 
     def __reduce__(self):
+        # deserialized copies attach to the existing file (never creators)
         return (MutableShmChannel, (self.path, self.capacity))
 
     def __del__(self):
@@ -147,8 +149,15 @@ class MutableShmChannel:
             self._mm.close()
         except Exception:
             pass
+        if getattr(self, "_creator", False):
+            # the creating handle owns the name: releasing it reclaims the
+            # tmpfs bytes even if close()/unlink() were never called.
+            # Existing mappings stay valid per POSIX.
+            self.unlink()
 
 
 def create_mutable_channel(buffer_bytes: int = 1 << 20) -> MutableShmChannel:
     path = os.path.join(_DIR, f"rtpu_chan_{uuid.uuid4().hex[:12]}")
-    return MutableShmChannel(path, buffer_bytes, _create=True)
+    ch = MutableShmChannel(path, buffer_bytes, _create=True)
+    ch._creator = True  # this handle's GC unlinks the backing file
+    return ch

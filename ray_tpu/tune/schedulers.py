@@ -151,3 +151,100 @@ class PopulationBasedTraining(TrialScheduler):
             elif k in new and isinstance(new[k], (int, float)):
                 new[k] = new[k] * self._rng.choice([0.8, 1.2])
         return new
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT's exploit step, but exploration picks
+    new continuous hyperparameters with a time-varying GP-UCB bandit fit on
+    the population's observed (time, config) → reward-change data instead
+    of random perturbation — far more sample-efficient at small population
+    sizes (reference: tune/schedulers/pb2.py, Parker-Holder et al. 2020).
+
+    `hyperparam_bounds` maps continuous keys to (lower, upper); keys in
+    `hyperparam_mutations` (categoricals) keep PBT-style resampling.
+    """
+
+    def __init__(self, *, hyperparam_bounds: dict,
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25, seed: int | None = None,
+                 ucb_beta: float = 2.0):
+        super().__init__(time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=hyperparam_mutations,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds for its GP")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.ucb_beta = ucb_beta
+        self._data: list = []  # rows: (t, {hp: v}, reward_delta)
+        self._prev_score: dict[str, tuple[float, float]] = {}  # tid → (t, score)
+        self._t_max = 1.0
+
+    def on_result(self, trial, result) -> str:
+        t = float(result.get(self.time_attr, 0))
+        score = self._score(result)
+        prev = self._prev_score.get(trial.trial_id)
+        if prev is not None and t > prev[0]:
+            self._data.append((t, {k: float(trial.config.get(k, 0.0))
+                                   for k in self.bounds}, score - prev[1]))
+            if len(self._data) > 500:
+                del self._data[:100]
+        self._prev_score[trial.trial_id] = (t, score)
+        self._t_max = max(self._t_max, t)
+        decision = super().on_result(trial, result)
+        if trial.exploit_from is not None:
+            # the next report's score includes the donor checkpoint's jump —
+            # attributing that delta to the explored config would poison the
+            # GP (reference pb2.py resets the baseline on exploit)
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    # -- GP machinery ------------------------------------------------------
+
+    def _xy(self):
+        import numpy as np
+
+        X = np.asarray([[t / self._t_max]
+                        + [(cfg[k] - lo) / (hi - lo or 1.0)
+                           for k, (lo, hi) in self.bounds.items()]
+                        for t, cfg, _ in self._data])
+        y = np.asarray([d for _, _, d in self._data], dtype=float)
+        if y.std() > 1e-12:
+            y = (y - y.mean()) / y.std()
+        return X, y
+
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+
+        new = super()._explore(config)  # categoricals via PBT mutations
+        if len(self._data) < 4:
+            # cold start: uniform sample inside the bounds
+            for k, (lo, hi) in self.bounds.items():
+                new[k] = self._rng.uniform(lo, hi)
+            return new
+        X, y = self._xy()
+        n, d = X.shape
+        ell, jitter = 0.3, 1e-4
+        sq = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-sq / (2 * ell * ell)) + jitter * np.eye(n)
+        alpha = np.linalg.solve(K, y)
+        # candidates at the CURRENT (normalized) time
+        m = 256
+        cand = np.empty((m, d))
+        cand[:, 0] = 1.0
+        for j, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            cand[:, 1 + j] = np.asarray(
+                [self._rng.random() for _ in range(m)])
+        sq_c = ((cand[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Ks = np.exp(-sq_c / (2 * ell * ell))
+        mu = Ks @ alpha
+        v = np.linalg.solve(K, Ks.T)
+        var = np.maximum(1.0 - (Ks * v.T).sum(-1), 1e-9)
+        ucb = mu + np.sqrt(self.ucb_beta) * np.sqrt(var)
+        best = cand[int(np.argmax(ucb))]
+        for j, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            new[k] = lo + float(best[1 + j]) * (hi - lo)
+        return new

@@ -244,3 +244,57 @@ def test_tuner_restore_runs_never_created_grid_trials(ray_tune_cluster, tmp_path
     results = tune.Tuner.restore(exp_dir, objective).fit()
     assert len(results) == 3
     assert sorted(r.metrics["score"] for r in results) == [10, 20, 30]
+
+
+def test_pb2_gp_steers_toward_optimum(ray_tune_cluster, tmp_path):
+    """PB2: explores via GP-UCB on observed reward changes — configs it
+    proposes concentrate near the quadratic optimum once data accumulates
+    (reference: tune/schedulers/pb2.py)."""
+    sched = tune.PB2(hyperparam_bounds={"lr": (0.0, 1.0)},
+                     perturbation_interval=2, quantile_fraction=0.5, seed=0)
+    sched.set_search_properties("score", "max")
+    # observed reward-change peaks at lr=0.6 (dy = 1 - |lr - 0.6|)
+    rows = []
+    for t in range(2, 13):
+        for lr in (0.05, 0.2, 0.45, 0.75, 0.95):
+            rows.append((float(t), {"lr": lr}, 1.0 - abs(lr - 0.6)))
+    sched._data = rows
+    sched._t_max = 12.0
+    picks = [sched._explore({"lr": 0.5})["lr"] for _ in range(8)]
+    assert all(0.0 <= p <= 1.0 for p in picks)
+    # the GP must steer proposals toward the optimum's neighborhood
+    assert sum(1 for p in picks if 0.35 <= p <= 0.85) >= 6, picks
+
+
+def test_pb2_end_to_end_exploits(ray_tune_cluster, tmp_path):
+    def objective(config):
+        import tempfile
+        import time as _t
+
+        w = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                w = float(open(os.path.join(d, "rank_0", "w.txt")).read())
+        for i in range(1, 13):
+            _t.sleep(0.05)
+            w += config["lr"]
+            with tempfile.TemporaryDirectory() as d:
+                open(os.path.join(d, "w.txt"), "w").write(str(w))
+                tune.report({"w": w}, checkpoint=Checkpoint.from_directory(d))
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.05, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="w", mode="max",
+            scheduler=tune.PB2(hyperparam_bounds={"lr": (0.05, 2.0)},
+                               perturbation_interval=4,
+                               quantile_fraction=0.5, seed=0),
+            stop={"training_iteration": 30},
+        ),
+        run_config=RunConfig(name="pb2", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    ws = sorted(r.metrics["w"] for r in results)
+    assert ws[0] > 0.1, f"weak trial never exploited under PB2: {ws}"
