@@ -9,6 +9,7 @@ from ray_tpu.data import aggregate
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import (
     DataIterator,
+    from_torch,
     Dataset,
     GroupedData,
     MaterializedDataset,
@@ -24,6 +25,7 @@ from ray_tpu.data.dataset import (
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
 
@@ -49,4 +51,6 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_text",
+    "from_torch",
 ]

@@ -20,6 +20,7 @@ from ray_tpu.data import logical as L
 from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_block
 from ray_tpu.data.datasource import (
     BinaryDatasource,
+    TextDatasource,
     CSVDatasource,
     Datasource,
     ImageDatasource,
@@ -187,6 +188,28 @@ class Dataset:
                 yield pending.popleft()
         while pending:
             yield pending.popleft()
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes: dict | None = None) -> Iterator[dict]:
+        """Batches as torch tensors (reference: data/iterator.py
+        iter_torch_batches:269; CPU tensors — this image's torch has no
+        accelerator)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.asarray(v)
+                if arr.dtype == object:
+                    out[k] = list(arr)  # strings/objects stay python
+                    continue
+                t = torch.from_numpy(np.ascontiguousarray(arr))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
 
     def take(self, n: int = 20) -> list[dict]:
         out: list[dict] = []
@@ -442,6 +465,23 @@ def read_images(paths, *, size=None, parallelism: int = -1) -> Dataset:
 
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(ds, parallelism))
+
+
+def read_text(paths, *, parallelism: int = -1, drop_empty_lines: bool = True,
+              encoding: str = "utf-8") -> Dataset:
+    """One row per line: {"text": ...} (reference: read_api.py read_text)."""
+    return Dataset(L.Read(TextDatasource(paths, drop_empty_lines=drop_empty_lines,
+                                         encoding=encoding), parallelism))
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """Materialize a map-style torch Dataset (reference: read_api.py
+    from_torch). Rows become {"item": sample} (or dict samples verbatim)."""
+    items = []
+    for i in builtins.range(len(torch_dataset)):  # module range() is a reader
+        sample = torch_dataset[i]
+        items.append(sample if isinstance(sample, dict) else {"item": sample})
+    return from_items(items, parallelism=parallelism)
 
 
 def from_numpy(arr) -> Dataset:

@@ -172,6 +172,25 @@ class NumpyDatasource(FileDatasource):
         return [{"data": np.load(path)}]
 
 
+class TextDatasource(FileDatasource):
+    """One row per line: {"text": line} (reference: read_api.py read_text)."""
+
+    suffixes = (".txt", ".text", ".log", ".md")
+
+    def __init__(self, paths, *, drop_empty_lines: bool = True,
+                 encoding: str = "utf-8"):
+        super().__init__(paths)
+        self.drop_empty = drop_empty_lines
+        self.encoding = encoding
+
+    def read_file(self, path: str) -> list:
+        with open(path, encoding=self.encoding, errors="replace") as f:
+            lines = f.read().splitlines()
+        if self.drop_empty:
+            lines = [ln for ln in lines if ln.strip()]
+        return [{"text": lines}] if lines else []
+
+
 class BinaryDatasource(FileDatasource):
     suffixes = ()
 

@@ -252,3 +252,37 @@ def test_map_batches_actor_pool_autoscaling_tuple(ray_session):
     rows = list(ds.iter_rows())
     assert sorted(r["id"] for r in rows) == [i * 10 for i in range(128)]
     assert 1 <= len({r["pid"] for r in rows}) <= 3
+
+
+def test_read_text_and_iter_torch_batches(ray_session, tmp_path):
+    import torch
+
+    import ray_tpu.data as rtd
+
+    (tmp_path / "a.txt").write_text("alpha\n\nbeta\n")
+    (tmp_path / "b.txt").write_text("gamma\n")
+    ds = rtd.read_text(str(tmp_path))
+    rows = sorted(r["text"] for r in ds.take_all())
+    assert rows == ["alpha", "beta", "gamma"]
+
+    nums = rtd.range(10)
+    batches = list(nums.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert int(sum(b["id"].sum() for b in batches)) == sum(range(10))
+
+
+def test_from_torch_dataset(ray_session):
+    import torch.utils.data as tud
+
+    import ray_tpu.data as rtd
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return {"x": i, "sq": i * i}
+
+    ds = rtd.from_torch(Squares())
+    rows = sorted(ds.take_all(), key=lambda r: r["x"])
+    assert len(rows) == 12 and rows[5]["sq"] == 25
