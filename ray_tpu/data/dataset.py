@@ -202,8 +202,8 @@ class Dataset:
             out = {}
             for k, v in batch.items():
                 arr = np.asarray(v)
-                if arr.dtype == object:
-                    out[k] = list(arr)  # strings/objects stay python
+                if arr.dtype.kind in "OUS":
+                    out[k] = list(arr)  # strings/bytes/objects stay python
                     continue
                 t = torch.from_numpy(np.ascontiguousarray(arr))
                 if dtypes and k in dtypes:

@@ -5,6 +5,7 @@ from ray_tpu.parallel.mesh import (
     ShardingRules,
     act_sharding,
     constrain,
+    hybrid_mesh,
     param_shardings,
     sharding_for,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "AXES",
     "DEFAULT_RULES",
     "MeshSpec",
+    "hybrid_mesh",
     "ShardingRules",
     "act_sharding",
     "collectives",
