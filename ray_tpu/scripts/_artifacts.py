@@ -1,0 +1,21 @@
+"""Shared helpers for the scripts in this package."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def write_artifact(name: str, payload: dict) -> str:
+    """Write a timestamped benchmark artifact at the repo root."""
+    path = os.path.join(repo_root(), name)
+    with open(path, "w") as f:
+        json.dump({"ts": time.strftime("%Y-%m-%d %H:%M"), **payload}, f,
+                  indent=1)
+    return path

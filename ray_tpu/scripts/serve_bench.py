@@ -141,12 +141,9 @@ def main():
 
     serve.shutdown()
     ray_tpu.shutdown()
-    out = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "SERVE_BENCH.json")
-    with open(out, "w") as f:
-        json.dump({"ts": time.strftime("%Y-%m-%d %H:%M"),
-                   "results": results}, f, indent=1)
-    print("wrote", out)
+    from ray_tpu.scripts._artifacts import write_artifact
+
+    print("wrote", write_artifact("SERVE_BENCH.json", {"results": results}))
 
 
 if __name__ == "__main__":
