@@ -876,6 +876,14 @@ class GcsServer:
         elif t == "actor_task":
             ok, err = self._submit_actor_task(msg["spec"])
             conn.send({"rid": msg["rid"], "ok": ok, "error": err})
+        elif t == "actor_task_async":
+            # fire-and-forget submission (reference: actor task pushes are
+            # async; a dead target fails the RESULT objects so the error
+            # surfaces at ray.get, not at .remote())
+            spec = msg["spec"]
+            ok, _err = self._submit_actor_task(spec)
+            if not ok and isinstance(spec.get("num_returns"), int):
+                self._fail_task_objects(spec, "actor is dead")
         elif t == "wait_actor_ready":
             self._wait_actor_ready(conn, msg)
         elif t == "get_named_actor":

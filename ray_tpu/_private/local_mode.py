@@ -87,7 +87,14 @@ class LocalWorker:
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, *, num_returns=1):
         if actor_id in self._dead_actors:
-            raise ActorDiedError(f"actor {actor_id[:8]} is dead")
+            # match cluster mode: dead-actor submission yields refs whose
+            # get() raises (the reference errors at get, not .remote())
+            tid = TaskID().hex()
+            err = ActorDiedError(f"actor {actor_id[:8]} is dead")
+            n = num_returns if isinstance(num_returns, int) else 1
+            for i in range(n):
+                self._objects[f"{tid}r{i:04d}"] = (True, err)
+            return [ObjectRef(f"{tid}r{i:04d}") for i in range(n)]
         instance = self.actors[actor_id]
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)
         kwargs = {k: self.get_object(v.hex()) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()}

@@ -1065,11 +1065,15 @@ class CoreWorker:
             "resources": {},
             **spec_part,
         }
-        reply = self.rpc({"type": "actor_task", "spec": spec})
-        if not reply.get("ok"):
-            raise ActorDiedError(f"actor {actor_id[:8]} is dead")
         if num_returns == "streaming":
+            # stream state must exist before the generator polls: stay sync
+            reply = self.rpc({"type": "actor_task", "spec": spec})
+            if not reply.get("ok"):
+                raise ActorDiedError(f"actor {actor_id[:8]} is dead")
             return ObjectRefGenerator(task_id, self)
+        # async push: one-way send — a dead actor fails the result objects
+        # and the error surfaces at get(), same as the reference
+        self.send_no_reply({"type": "actor_task_async", "spec": spec})
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
     def wait_actor_ready(self, actor_id: str, timeout: float | None = None):

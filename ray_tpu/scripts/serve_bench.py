@@ -11,7 +11,6 @@ latency / throughput over the proxy; VERDICT round-2 weak item 5.)
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 import urllib.request
