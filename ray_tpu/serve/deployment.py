@@ -21,7 +21,8 @@ class Deployment:
 
     def options(self, *, name=None, num_replicas=None, max_ongoing_requests=None,
                 ray_actor_options=None, autoscaling_config=None,
-                user_config=None, request_router=None, **_ignored) -> "Deployment":
+                user_config=None, request_router=None,
+                graceful_shutdown_timeout_s=None, **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(self.config.num_replicas if num_replicas is None
                           else (None if num_replicas == "auto" else num_replicas)),
@@ -37,6 +38,10 @@ class Deployment:
             user_config=self.config.user_config if user_config is None else user_config,
             request_router=(self.config.request_router if request_router is None
                             else request_router),
+            graceful_shutdown_timeout_s=(
+                self.config.graceful_shutdown_timeout_s
+                if graceful_shutdown_timeout_s is None
+                else graceful_shutdown_timeout_s),
         )
         if num_replicas == "auto" and cfg.autoscaling_config is None:
             cfg.autoscaling_config = AutoscalingConfig()
