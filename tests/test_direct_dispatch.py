@@ -162,3 +162,26 @@ def test_locality_large_arg_no_cross_host_bytes():
         assert not core.store.contains(big.hex())
     finally:
         cluster.shutdown()
+
+
+def test_lease_revoked_for_pending_gcs_work(ray_start_regular):
+    """A pending actor creation that needs resources held by a direct-
+    dispatch lease triggers a revoke: the lease drains and returns, and the
+    actor gets placed (reference: leases spill back under cluster
+    pressure)."""
+
+    @ray_tpu.remote(num_cpus=4)
+    def hold(sec):
+        time.sleep(sec)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=4)
+    class Big:
+        def ping(self):
+            return "pong"
+
+    ref = hold.remote(2.0)  # direct lease holds all 4 CPUs while running
+    time.sleep(0.5)
+    a = Big.remote()  # queues at the GCS: no resources until the lease goes
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    assert ray_tpu.get(ref, timeout=30) == "done"
