@@ -935,10 +935,24 @@ class GcsServer:
                 ]
             conn.send({"rid": msg["rid"], "nodes": nodes})
         elif t == "kv_put":
+            evicted: list[str] = []
             with self.lock:
                 self.kv[msg["key"]] = msg["value"]
+                if msg["key"].startswith("fn:"):
+                    # function store: bounded LRU-ish (insertion order) so
+                    # dynamic-closure workloads can't grow the GCS without
+                    # bound (reference: the function table is job-scoped)
+                    fn_keys = [k for k in self.kv if k.startswith("fn:")]
+                    for k in fn_keys[:max(0, len(fn_keys) - 2048)]:
+                        self.kv.pop(k, None)
+                        evicted.append(k)
             if self.storage is not None:
                 self.storage.put("kv", msg["key"], msg["value"])
+                for k in evicted:
+                    try:
+                        self.storage.delete("kv", k)
+                    except Exception:
+                        pass
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "kv_get":
             with self.lock:

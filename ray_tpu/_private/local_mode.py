@@ -65,7 +65,7 @@ class LocalWorker:
                 self._objects[f"{task_id}r{i:04d}"] = (True, wrapped)
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
 
-    def submit_task(self, func_blob, args, kwargs, *, num_returns=1, resources=None,
+    def submit_task(self, func_blob, args, kwargs, *, func_sha=None, num_returns=1, resources=None,
                     max_retries=0, name="", strategy=None, runtime_env=None):
         fn = ser.loads(func_blob) if isinstance(func_blob, bytes) else func_blob
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)

@@ -254,9 +254,11 @@ class CoreWorker:
         self._loc_cache: dict[str, tuple] = {}  # oid → (host, size) once ready
         self._flight_holds: dict[str, list[str]] = {}  # direct tid → held oids
         self._direct = None  # DirectDispatcher, created lazily on first use
-        # deserialized task functions keyed by their pickled blob (reference:
-        # the worker's function table caches imported functions per process)
-        self._func_cache: dict[bytes, Any] = {}
+        # deserialized task functions keyed by content sha (or raw blob for
+        # legacy specs); shas this process already uploaded to the cluster
+        # function store (reference: the worker's function table)
+        self._func_cache: dict = {}
+        self._shipped_fns: set[str] = set()
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
                           "pid": os.getpid(), "node_id": self.node_id,
@@ -666,6 +668,7 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         *,
+        func_sha: str | None = None,
         num_returns: int = 1,
         resources: dict | None = None,
         max_retries: int = 0,
@@ -684,10 +687,25 @@ class CoreWorker:
         # object whose only counted ref was the borrower's (the submitter's
         # +1 still in its 0.2s flush window)
         self._flush_ref_deltas()
+        fn_field: dict
+        if func_sha is not None:
+            # content-addressed function store (reference: the GCS function
+            # table with export-once semantics, function_manager.py): the
+            # blob uploads once per cluster; every spec carries 20 bytes
+            if func_sha not in self._shipped_fns:
+                key = "fn:" + func_sha
+                # metadata-only existence probe — kv_get would pull the
+                # whole blob just to discard it
+                if not self.kv_keys(key):
+                    self.kv_put(key, func_blob)
+                self._shipped_fns.add(func_sha)
+            fn_field = {"func_sha": func_sha}
+        else:
+            fn_field = {"func": func_blob}
         spec = {
             "kind": "task",
             "task_id": task_id,
-            "func": func_blob,
+            **fn_field,
             "deps": deps,
             "num_returns": num_returns,
             "resources": resources or {"CPU": 1.0},
@@ -1517,12 +1535,20 @@ class CoreWorker:
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
-                func = self._func_cache.get(spec["func"])
+                key = spec.get("func_sha") or spec["func"]
+                func = self._func_cache.get(key)
                 if func is None:
-                    func = ser.loads(spec["func"])
+                    blob = spec.get("func")
+                    if blob is None:
+                        blob = self.kv_get("fn:" + spec["func_sha"])
+                        if blob is None:
+                            raise RayTpuError(
+                                f"function {spec['func_sha']} missing from "
+                                "the cluster function store")
+                    func = ser.loads(blob)
                     if len(self._func_cache) > 256:
                         self._func_cache.clear()
-                    self._func_cache[spec["func"]] = func
+                    self._func_cache[key] = func
                 out = func(*args, **kwargs)
             elif kind == "actor_create":
                 cls = ser.loads(spec["func"])

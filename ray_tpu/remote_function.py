@@ -44,11 +44,18 @@ class RemoteFunction:
         self._strategy = scheduling_strategy
         self._runtime_env = runtime_env
         self._blob: bytes | None = None
+        self._blob_sha: str | None = None
         functools.update_wrapper(self, func)
 
     def _get_blob(self) -> bytes:
         if self._blob is None:
-            self._blob = ser.dumps(self._func)
+            import hashlib
+
+            blob = ser.dumps(self._func)
+            # sha assigned BEFORE _blob: a racing reader seeing _blob set is
+            # then guaranteed to see the sha too
+            self._blob_sha = hashlib.sha1(blob).hexdigest()[:20]
+            self._blob = blob
         return self._blob
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
@@ -67,6 +74,7 @@ class RemoteFunction:
                          else runtime_env),
         )
         rf._blob = self._blob
+        rf._blob_sha = self._blob_sha
         return rf
 
     def remote(self, *args, **kwargs):
@@ -78,6 +86,7 @@ class RemoteFunction:
             self._get_blob() if worker.kind != "local" else self._func,
             args,
             kwargs,
+            func_sha=self._blob_sha,
             num_returns=self._num_returns,
             resources=self._resources,
             max_retries=self._max_retries,
