@@ -113,6 +113,46 @@ class Dataset:
             right_last=other._op, on=on, right_on=right_on, how=how,
             suffixes=tuple(suffixes), num_partitions=num_partitions))
 
+    def sum(self, on: str):
+        """Global sum of one column (reference: Dataset.sum)."""
+        return self._global_agg(on, "sum")
+
+    def min(self, on: str):
+        return self._global_agg(on, "min")
+
+    def max(self, on: str):
+        return self._global_agg(on, "max")
+
+    def mean(self, on: str):
+        """Global mean of one column (reference: Dataset.mean)."""
+        total, count = 0.0, 0
+        for b in self.iter_blocks():
+            acc = BlockAccessor(b)
+            if acc.num_rows() == 0 or on not in b:
+                continue
+            arr = np.asarray(b[on], dtype=np.float64)
+            total += float(arr.sum())
+            count += arr.size
+        return total / count if count else None
+
+    def _global_agg(self, on: str, op: str):
+        out = None
+        for b in self.iter_blocks():
+            acc = BlockAccessor(b)
+            if acc.num_rows() == 0 or on not in b:
+                continue
+            arr = np.asarray(b[on])
+            v = getattr(arr, op)()
+            if out is None:
+                out = v
+            elif op == "sum":
+                out = out + v
+            elif op == "min":
+                out = min(out, v)
+            else:
+                out = max(out, v)
+        return None if out is None else out.item() if hasattr(out, "item") else out
+
     def unique(self, column: str) -> list:
         """Distinct values of one column."""
         out = self.groupby(column).count().take_all()

@@ -62,7 +62,9 @@ class ItemsDatasource(Datasource):
 
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         n = len(self.items)
-        parallelism = max(1, min(parallelism, n or 1))
+        if n == 0:
+            return []  # empty dataset: no read tasks (step would be 0)
+        parallelism = max(1, min(parallelism, n))
         step = (n + parallelism - 1) // parallelism
         tasks = []
         for start in range(0, n, step):

@@ -286,3 +286,14 @@ def test_from_torch_dataset(ray_session):
     ds = rtd.from_torch(Squares())
     rows = sorted(ds.take_all(), key=lambda r: r["x"])
     assert len(rows) == 12 and rows[5]["sq"] == 25
+
+
+def test_global_aggregates(ray_session):
+    import ray_tpu.data as rtd
+
+    ds = rtd.from_items([{"v": float(i)} for i in range(100)])
+    assert ds.sum("v") == sum(range(100))
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 99.0
+    assert ds.mean("v") == sum(range(100)) / 100
+    assert rtd.from_items([]).sum("v") is None
