@@ -114,7 +114,9 @@ class Dataset:
             suffixes=tuple(suffixes), num_partitions=num_partitions))
 
     def sum(self, on: str):
-        """Global sum of one column (reference: Dataset.sum)."""
+        """Global sum of one column (reference: Dataset.sum). Reduction
+        runs in the read/map tasks; only per-block scalars reach the
+        driver."""
         return self._global_agg(on, "sum")
 
     def min(self, on: str):
@@ -125,24 +127,33 @@ class Dataset:
 
     def mean(self, on: str):
         """Global mean of one column (reference: Dataset.mean)."""
-        total, count = 0.0, 0
-        for b in self.iter_blocks():
-            acc = BlockAccessor(b)
-            if acc.num_rows() == 0 or on not in b:
-                continue
-            arr = np.asarray(b[on], dtype=np.float64)
-            total += float(arr.sum())
-            count += arr.size
-        return total / count if count else None
+        out = self._global_agg(on, "mean")
+        return out
 
     def _global_agg(self, on: str, op: str):
+        # per-block partial aggregation ships ONE scalar row per block to
+        # the driver instead of the block itself
+        def partial(batch):
+            if on not in batch:
+                raise KeyError(
+                    f"column {on!r} not in dataset columns "
+                    f"{sorted(batch)}")
+            arr = np.asarray(batch[on])
+            if op == "mean":
+                return {"_s": np.asarray([arr.astype(np.float64).sum()]),
+                        "_n": np.asarray([arr.size])}
+            return {"_v": np.asarray([getattr(arr, op)()])}
+
+        reduced = self.map_batches(partial, batch_size=None)
+        if op == "mean":
+            total, count = 0.0, 0
+            for row in reduced.iter_rows():
+                total += float(row["_s"])
+                count += int(row["_n"])
+            return total / count if count else None
         out = None
-        for b in self.iter_blocks():
-            acc = BlockAccessor(b)
-            if acc.num_rows() == 0 or on not in b:
-                continue
-            arr = np.asarray(b[on])
-            v = getattr(arr, op)()
+        for row in reduced.iter_rows():
+            v = row["_v"]
             if out is None:
                 out = v
             elif op == "sum":

@@ -42,7 +42,9 @@ class RangeDatasource(Datasource):
         self.column = column
 
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
-        parallelism = max(1, min(parallelism, self.n or 1))
+        if self.n == 0:
+            return []  # empty range: no read tasks (step would be 0)
+        parallelism = max(1, min(parallelism, self.n))
         step = (self.n + parallelism - 1) // parallelism
         tasks = []
         for start in range(0, self.n, step):

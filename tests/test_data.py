@@ -297,3 +297,8 @@ def test_global_aggregates(ray_session):
     assert ds.max("v") == 99.0
     assert ds.mean("v") == sum(range(100)) / 100
     assert rtd.from_items([]).sum("v") is None
+    assert rtd.range(0).take_all() == []  # empty range doesn't crash either
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="not in dataset columns"):
+        ds.sum("nope")
