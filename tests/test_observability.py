@@ -232,3 +232,27 @@ def test_on_demand_sampling_profiler(ray_start_regular):
     assert "samples over" in text and "collapsed stacks" in text
     assert "spin" in text or "execute_spec" in text  # the busy task shows up
     ray_tpu.get(ref, timeout=60)
+
+
+def test_dashboard_ui_and_api_serve(ray_start_regular):
+    """The single-file web UI serves at / and its backing JSON endpoints
+    respond (reference: dashboard client + state API)."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu._private import api as _api
+    from ray_tpu.dashboard.head import start_dashboard
+
+    dash = start_dashboard(_api._node.session_dir, port=0)
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        html = urllib.request.urlopen(base + "/", timeout=30).read().decode()
+        assert "ray_tpu dashboard" in html and "/api/cluster" in html
+        cluster = _json.loads(
+            urllib.request.urlopen(base + "/api/cluster", timeout=30).read())
+        assert "total_resources" in cluster
+        nodes = _json.loads(
+            urllib.request.urlopen(base + "/api/nodes", timeout=30).read())
+        assert any(n.get("alive") for n in nodes)
+    finally:
+        dash.stop()
