@@ -1,0 +1,68 @@
+"""Bounded out-of-process TPU health probe.
+
+Spawns ONE child that attempts jax TPU backend init and exits by itself
+(internal alarm) if the shared device pool is wedged — the child is never
+SIGTERMed/SIGKILLed from outside while it may hold a grant, because killing
+a process mid-grant is exactly what wedges the pool (PERF.md operational
+notes, rounds 1-3).
+
+Exit code 0 = healthy (prints device kind), 1 = unavailable.
+Usage: python benchmarks/tpu_probe.py [timeout_s]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import os, signal, sys
+def _bail(sig, frm):
+    # clean self-exit BEFORE any grant can be half-held; safer than an
+    # external kill which leaves the pool relay stuck
+    os._exit(1)
+signal.signal(signal.SIGALRM, _bail)
+signal.alarm(int(sys.argv[1]))
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+try:
+    devs = jax.devices("tpu")
+except Exception:
+    os._exit(1)
+if not devs:
+    os._exit(1)
+import jax.numpy as jnp
+x = jnp.ones((8, 8))
+(x @ x).block_until_ready()
+signal.alarm(0)  # only after the first real computation completes
+print(devs[0].device_kind, flush=True)
+os._exit(0)
+"""
+
+
+def probe(timeout_s: float = 120.0) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(int(timeout_s))],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env)
+    deadline = timeout_s + 60
+    try:
+        out, _ = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        # NEVER kill the child: it may hold a half-complete grant, and
+        # killing mid-grant is what wedges the pool. Its own SIGALRM exits
+        # it eventually; we just stop waiting and report unhealthy.
+        return False
+    if proc.returncode == 0:
+        print((out or "").strip())
+        return True
+    return False
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    ok = probe(t)
+    print("TPU_HEALTHY" if ok else "TPU_UNAVAILABLE", flush=True)
+    sys.exit(0 if ok else 1)

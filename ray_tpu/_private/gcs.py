@@ -118,7 +118,8 @@ class _VNode:
     the in-process multi-node harness is how the reference tests multi-node,
     SURVEY.md §4.2.)"""
 
-    __slots__ = ("node_id", "total", "available", "labels", "alive", "chip_pool")
+    __slots__ = ("node_id", "total", "available", "labels", "alive",
+                 "chip_pool", "quarantined_chips")
 
     def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
         self.node_id = node_id
@@ -130,6 +131,10 @@ class _VNode:
         # with them visible and return when that worker dies (reference:
         # TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36)
         self.chip_pool: list[int] = list(range(int(self.total.get("TPU", 0.0))))
+        # chips held by a worker that was SIGKILLed mid-grant (OOM defense):
+        # the shared device pool may be wedged, so they are withheld from
+        # re-allocation until an operator re-enables them
+        self.quarantined_chips: list[int] = []
 
 
 class _Bundle:
@@ -190,6 +195,10 @@ class GcsServer:
 
         self.objects: dict[str, dict] = {}
         self.object_waiters: dict[str, list[tuple[MsgConnection, int]]] = {}
+        # wid → oids it promised to publish (will_publish); consulted on its
+        # death so the scan is O(its promises), entries dropped with the wid
+        self._pub_promises: dict[str, set] = {}
+        self._fn_access: dict[str, float] = {}  # fn: key → last touch ts
         self.workers: dict[str, _Worker] = {}
         self.pending_tasks: collections.deque[dict] = collections.deque()
         self.pending_actor_creations: collections.deque[dict] = collections.deque()
@@ -383,6 +392,14 @@ class GcsServer:
                 pick_victim=self._pick_oom_victim,
                 on_kill=self._note_oom_kill).start()
 
+    @staticmethod
+    def _oom_fresh(w) -> bool:
+        """A pre-kill OOM tag explains a death only while fresh — a pick
+        whose reply was lost (agent never killed) must not blame a much
+        later unrelated death on memory pressure."""
+        return (w is not None and w.oom_why is not None
+                and time.monotonic() - w.oom_ts < 30.0)
+
     def _pick_oom_victim(self, host_id: str = HEAD_HOST):
         """Newest retriable running plain task's worker on `host_id`, then
         any running plain task's worker, then the newest-leased direct
@@ -390,11 +407,18 @@ class GcsServer:
         worker_killing_policy_group_by_owner.h:87). Node agents delegate
         their victim choice here too (pick_oom_victim RPC): only the GCS
         knows which pids run retriable tasks vs actors."""
+        # killing a worker mid-TPU-grant can wedge the host's shared device
+        # pool (backend init hangs for every later process), so chip-holding
+        # workers are excluded unless explicitly opted in — and even then
+        # ranked strictly after every chip-free candidate
+        allow_tpu = RayConfig.get("oom_kill_tpu_workers")
         with self.lock:
-            best = None  # ((retriable, newest_ts), worker)
+            best = None  # ((chip_free, retriable, newest_ts), worker)
             for w in self.workers.values():
                 if (w.kind != "worker" or w.dead or w.host_id != host_id
                         or w.actor_id is not None or not w.pid):
+                    continue
+                if w.tpu_chips and not allow_tpu:
                     continue
                 plain = [s for s in w.running_tasks.values()
                          if s.get("kind") == "task"]
@@ -403,7 +427,7 @@ class GcsServer:
                 ts = max(s.get("_ts", 0.0) for s in plain)
                 retriable = any(s.get("retries_used", 0) < s.get("max_retries", 0)
                                 for s in plain)
-                key = (1 if retriable else 0, ts)
+                key = (0 if w.tpu_chips else 1, 1 if retriable else 0, ts)
                 if best is None or key > best[0]:
                     best = (key, w)
             if best is not None:
@@ -413,9 +437,12 @@ class GcsServer:
                 return w.pid, f"worker {w.wid[:8]} running {names}"
             leased = [w for w in self.workers.values()
                       if w.kind == "worker" and not w.dead and w.pid
-                      and w.host_id == host_id and w.leased_to is not None]
+                      and w.host_id == host_id and w.leased_to is not None
+                      and (allow_tpu or not w.tpu_chips)]
             if leased:
-                w = max(leased, key=lambda x: x.lease_token or 0)
+                w = max(leased,
+                        key=lambda x: (0 if x.tpu_chips else 1,
+                                       x.lease_token or 0))
                 return w.pid, f"leased worker {w.wid[:8]}"
         return None
 
@@ -792,10 +819,7 @@ class GcsServer:
             # (e.g. the memory monitor killed it) to build a useful error
             with self.lock:
                 w2 = self.workers.get(msg["wid"])
-                why = None
-                if (w2 is not None and w2.oom_why is not None
-                        and time.monotonic() - w2.oom_ts < 30.0):
-                    why = w2.oom_why
+                why = w2.oom_why if self._oom_fresh(w2) else None
             conn.send({"rid": msg["rid"], "reason": why})
         elif t == "direct_lineage":
             # a direct task produced evictable (shm) outputs: retain its spec
@@ -804,6 +828,46 @@ class GcsServer:
                 evicted = self._retain_lineage_locked(msg["spec"])
             if evicted:
                 self._free_objects(evicted)
+        elif t == "unquarantine_chips":
+            # operator re-enables chips quarantined by an OOM kill, after
+            # confirming the host device pool is healthy again
+            with self.lock:
+                node = self.nodes.get(msg.get("node_id") or self.local_node_id)
+                restored: list[int] = []
+                if node is not None:
+                    want = msg.get("chips")  # None = all
+                    keep: list[int] = []
+                    for c in node.quarantined_chips:
+                        if want is None or c in want:
+                            restored.append(c)
+                        else:
+                            keep.append(c)
+                    node.quarantined_chips = keep
+                    node.chip_pool.extend(restored)
+            conn.send({"rid": msg["rid"], "restored": restored})
+            self._schedule()
+        elif t == "will_publish":
+            # the sender promises a future object_put for this unpublished
+            # direct-task result (publish_on_done). Recording the publisher
+            # lets _on_worker_death fail the stub with OwnerDiedError instead
+            # of letting borrowers block until their wait timeout
+            dead_promise = False
+            with self.lock:
+                pw = self.workers.get(msg["wid"])
+                if pw is None or pw.dead:
+                    # promise arrived after the sender was declared dead (its
+                    # death scan already ran): fail the stub right away
+                    dead_promise = True
+                else:
+                    e = self.objects.setdefault(
+                        msg["oid"], {"status": "pending", "where": None,
+                                     "inline": None, "size": 0})
+                    if e.get("status") == "pending":
+                        e["pub_wid"] = msg["wid"]
+                        self._pub_promises.setdefault(
+                            msg["wid"], set()).add(msg["oid"])
+            if dead_promise:
+                self._fail_orphaned_stubs([msg["oid"]])
         elif t == "wait_object":
             self._wait_object(conn, msg)
         elif t == "free_objects_async":
@@ -930,7 +994,8 @@ class GcsServer:
             with self.lock:
                 nodes = [
                     {"node_id": n.node_id, "alive": n.alive, "labels": dict(n.labels),
-                     "total": dict(n.total), "available": dict(n.available)}
+                     "total": dict(n.total), "available": dict(n.available),
+                     "quarantined_chips": list(n.quarantined_chips)}
                     for n in self.nodes.values()
                 ]
             conn.send({"rid": msg["rid"], "nodes": nodes})
@@ -939,13 +1004,33 @@ class GcsServer:
             with self.lock:
                 self.kv[msg["key"]] = msg["value"]
                 if msg["key"].startswith("fn:"):
+                    self._fn_access[msg["key"]] = time.monotonic()
                     # function store: bounded LRU-ish (insertion order) so
                     # dynamic-closure workloads can't grow the GCS without
-                    # bound (reference: the function table is job-scoped)
+                    # bound (reference: the function table is job-scoped).
+                    # Keys referenced by pending/running specs or retained
+                    # lineage are pinned — evicting them would make those
+                    # tasks permanently unrunnable/unreconstructable. Keys
+                    # touched recently are also spared: direct-plane
+                    # in-flight specs and drivers inside their existence-
+                    # probe memoization window are invisible to the pin
+                    # scan, and both resolve within seconds. The budget is
+                    # soft — overage with nothing evictable is fine.
                     fn_keys = [k for k in self.kv if k.startswith("fn:")]
-                    for k in fn_keys[:max(0, len(fn_keys) - 2048)]:
-                        self.kv.pop(k, None)
-                        evicted.append(k)
+                    excess = len(fn_keys) - 2048
+                    if excess > 0:
+                        pinned = self._pinned_fn_keys_locked()
+                        fresh = time.monotonic() - 300.0
+                        for k in fn_keys:
+                            if excess <= 0:
+                                break
+                            if (k in pinned
+                                    or self._fn_access.get(k, 0.0) > fresh):
+                                continue
+                            self.kv.pop(k, None)
+                            self._fn_access.pop(k, None)
+                            evicted.append(k)
+                            excess -= 1
             if self.storage is not None:
                 self.storage.put("kv", msg["key"], msg["value"])
                 for k in evicted:
@@ -957,14 +1042,24 @@ class GcsServer:
         elif t == "kv_get":
             with self.lock:
                 val = self.kv.get(msg["key"])
+                if msg["key"].startswith("fn:") and val is not None:
+                    self._fn_access[msg["key"]] = time.monotonic()
             conn.send({"rid": msg["rid"], "value": val})
         elif t == "kv_keys":
             with self.lock:
                 keys = [k for k in self.kv if k.startswith(msg.get("prefix", ""))]
+                if msg.get("prefix", "").startswith("fn:"):
+                    # a driver's existence probe: it will skip re-upload and
+                    # submit specs referencing these — keep them evict-safe
+                    # through the memoization window
+                    now = time.monotonic()
+                    for k in keys:
+                        self._fn_access[k] = now
             conn.send({"rid": msg["rid"], "keys": keys})
         elif t == "kv_del":
             with self.lock:
                 self.kv.pop(msg["key"], None)
+                self._fn_access.pop(msg["key"], None)
             if self.storage is not None:
                 self.storage.delete("kv", msg["key"])
             conn.send({"rid": msg["rid"], "ok": True})
@@ -1250,9 +1345,14 @@ class GcsServer:
     def _on_object_ready(self, oid: str, where: str, inline, size: int,
                          is_error: bool, host: str = HEAD_HOST,
                          pin: bool = False, contained=None,
-                         tier: str = "shm"):
+                         tier: str = "shm", only_if_pending: bool = False):
         with self.lock:
             prev = self.objects.get(oid)
+            if (only_if_pending and prev is not None
+                    and prev.get("status") != "pending"):
+                # guarded write (owner-death error path): a concurrently
+                # published real value wins over the OwnerDiedError
+                return
             if (prev is not None and prev["status"] == "ready"
                     and prev["where"] == "shm" and where == "shm"):
                 # an additional shm copy on another host: extend the location
@@ -1273,6 +1373,9 @@ class GcsServer:
             return
         with self.lock:
             prev = self.objects.get(oid)
+            if (only_if_pending and prev is not None
+                    and prev.get("status") != "pending"):
+                return  # re-check: the real publish won the race
             if prev is not None:
                 self._drop_shm_copies_locked(prev)  # stale copies of an overwrite
             entry = self.objects[oid] = {
@@ -1857,6 +1960,44 @@ class GcsServer:
                 w.idle = True
         self._schedule()
 
+    def _fail_orphaned_stubs(self, oids) -> None:
+        """Error pending stubs whose promised publisher is gone (caller
+        holds no lock)."""
+        import ray_tpu._private.serialization as ser
+        from ray_tpu.exceptions import OwnerDiedError
+
+        blob = ser.dumps(OwnerDiedError(
+            "the process owning this object died before publishing it"))
+        for oid in oids:
+            self._on_object_ready(oid, where="inline", inline=blob,
+                                  size=len(blob), is_error=True,
+                                  only_if_pending=True)
+
+    def _pinned_fn_keys_locked(self) -> set:
+        """fn: store keys that MUST survive eviction: referenced by a
+        pending/running spec (the executor fetches the blob at dispatch) or
+        by retained lineage (reconstruction resubmits the spec verbatim).
+        Only called on the rare eviction path (>2048 distinct functions),
+        so the full scan is fine. Caller holds the lock."""
+        pinned: set = set()
+
+        def _note(spec):
+            sha = spec.get("func_sha")
+            if sha:
+                pinned.add("fn:" + sha)
+
+        for s in self.pending_tasks:
+            _note(s)
+        for w in self.workers.values():
+            for s in w.running_tasks.values():
+                _note(s)
+        for a in self.actors.values():
+            for s in a.queue:
+                _note(s)
+        for s in self.lineage.values():
+            _note(s)
+        return pinned
+
     def _retain_lineage_locked(self, spec: dict) -> list[str]:
         """Retain a task spec for lineage reconstruction of its outputs,
         under the bounded budget (reference: lineage eviction). A
@@ -1913,7 +2054,11 @@ class GcsServer:
             else:
                 for i in range(spec["num_returns"]):
                     oid = f"{spec['task_id']}r{i:04d}"
-                    self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+                    e = self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+                    # the GCS path now owns producing this value; a stale
+                    # will_publish promise (direct spec redirected here)
+                    # must not let the old owner's death error the stub
+                    e.pop("pub_wid", None)
             reason = self._invalid_strategy_reason(spec.get("strategy"))
             if reason is None:
                 # hold every object this task needs (args + refs nested in
@@ -2410,7 +2555,11 @@ class GcsServer:
             else:
                 for i in range(spec["num_returns"]):
                     oid = f"{spec['task_id']}r{i:04d}"
-                    self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+                    e = self.objects.setdefault(oid, {"status": "pending", "where": None, "inline": None, "size": 0})
+                    # the GCS path now owns producing this value; a stale
+                    # will_publish promise (direct spec redirected here)
+                    # must not let the old owner's death error the stub
+                    e.pop("pub_wid", None)
             holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
             spec["_holds"] = holds
             self._sys_hold_locked(holds, +1)
@@ -2726,6 +2875,16 @@ class GcsServer:
                 if self._freeable_locked(oid, e):
                     death_free.append(oid)
             w.ref_balance.clear()
+            # pending stubs whose promised publisher is this process: the
+            # object_put will never come, so fail them now instead of letting
+            # borrowers block until their wait timeout (reference:
+            # OwnerDiedError from the ownership directory). The promise index
+            # keeps this O(promises by this wid), not O(all objects)
+            orphaned_stubs = [
+                oid for oid in self._pub_promises.pop(wid, ())
+                if (e := self.objects.get(oid)) is not None
+                and e.get("status") == "pending"
+                and e.get("pub_wid") == wid]
             # fail parked RDT exports that were waiting on this process
             stale_exports = [(tok, waiter) for tok, waiter
                              in self._tensor_exports.items()
@@ -2744,6 +2903,8 @@ class GcsServer:
                             "error": "owner process died during export"})
             except ConnectionClosed:
                 pass
+        if orphaned_stubs:
+            self._fail_orphaned_stubs(orphaned_stubs)
         # leases HELD by the dying process: its workers may still be mid-task
         # on the direct plane, so don't hand them to the scheduler — retire
         # them (the reference kills workers leaked by dead drivers too)
@@ -2777,7 +2938,17 @@ class GcsServer:
             if w.tpu_chips:
                 node = self.nodes.get(w.node_id)
                 if node is not None and node.alive:
-                    node.chip_pool.extend(w.tpu_chips)
+                    # same freshness window the death-reason tagging uses: a
+                    # stale oom_why from a kill that never landed must not
+                    # quarantine chips on an unrelated later death
+                    if self._oom_fresh(w):
+                        # SIGKILLed mid-grant: the physical device pool may
+                        # be wedged — quarantine the chips instead of handing
+                        # them to the next worker (which would hang in
+                        # backend init). Re-enable via unquarantine_chips.
+                        node.quarantined_chips.extend(w.tpu_chips)
+                    else:
+                        node.chip_pool.extend(w.tpu_chips)
             specs = list(w.running_tasks.values())
             w.running_tasks.clear()
             aid = w.actor_id
@@ -2826,12 +2997,7 @@ class GcsServer:
                             self._actor_dead_cleanup_locked(actor.create_spec))
         if death_free:
             self._free_objects(death_free)
-        # a pre-kill OOM tag explains this death only if it is fresh — a
-        # pick whose reply was lost (agent never killed) must not blame a
-        # much later unrelated death on memory pressure
-        oom_fresh = (w.oom_why is not None
-                     and time.monotonic() - w.oom_ts < 30.0)
-        death_reason = (w.oom_why if oom_fresh else None) or f"worker {wid} died"
+        death_reason = (w.oom_why if self._oom_fresh(w) else None) or f"worker {wid} died"
         for spec in fail:
             self._fail_task_objects(
                 spec, "task was cancelled" if spec.get("_cancelled")

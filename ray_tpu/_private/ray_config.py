@@ -94,6 +94,13 @@ class RayConfig:
     # Usage fraction past which a victim worker is killed (reference:
     # memory_usage_threshold 0.95).
     memory_usage_threshold: float = 0.95
+    # Whether the OOM killer may pick workers holding TPU chips. Off by
+    # default: SIGKILLing a process mid-TPU-grant can wedge the shared
+    # device pool for every other worker on the host, converting memory
+    # pressure into an accelerator outage. When a chip worker IS killed
+    # (opt-in), its chips are quarantined rather than returned to the
+    # allocatable pool.
+    oom_kill_tpu_workers: bool = False
 
     # --- GCS persistence ------------------------------------------------
     # Path for the GCS write-ahead table store; empty = in-memory only

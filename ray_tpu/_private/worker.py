@@ -258,7 +258,7 @@ class CoreWorker:
         # legacy specs); shas this process already uploaded to the cluster
         # function store (reference: the worker's function table)
         self._func_cache: dict = {}
-        self._shipped_fns: set[str] = set()
+        self._shipped_fns: dict[str, float] = {}  # sha → last-verified ts
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
                           "pid": os.getpid(), "node_id": self.node_id,
@@ -692,13 +692,17 @@ class CoreWorker:
             # content-addressed function store (reference: the GCS function
             # table with export-once semantics, function_manager.py): the
             # blob uploads once per cluster; every spec carries 20 bytes
-            if func_sha not in self._shipped_fns:
+            now = time.monotonic()
+            # re-probe periodically even when memoized: the GCS function
+            # store evicts past its budget, and a permanently-memoized sha
+            # would then fail every future task using it
+            if now - self._shipped_fns.get(func_sha, -1e9) > 60.0:
                 key = "fn:" + func_sha
                 # metadata-only existence probe — kv_get would pull the
                 # whole blob just to discard it
                 if not self.kv_keys(key):
                     self.kv_put(key, func_blob)
-                self._shipped_fns.add(func_sha)
+                self._shipped_fns[func_sha] = now
             fn_field = {"func_sha": func_sha}
         else:
             fn_field = {"func": func_blob}
@@ -745,46 +749,55 @@ class CoreWorker:
         prefer_host = None
         best = -1
         disp = self._direct
-        for d in deps:
-            with self._owned_lock:
-                ent = self._owned.get(d)
-                if ent is not None:
-                    st = ent.get("status")
-                    if st == "pending":
-                        # chain: runnable only on the dep's own lease (the
-                        # worker computes the dep first, in order)
-                        lease = disp.by_wid.get(ent.get("lease") or "") if disp else None
-                        if lease is None or lease.dead or (
-                                required_lease is not None
-                                and lease is not required_lease):
-                            return None
-                        required_lease = lease
-                        if not ent.get("publish_on_done"):
-                            # safety net: if anything else ends up waiting on
-                            # this oid at the GCS, the publish will come
-                            ent["publish_on_done"] = True
-                            self.incref(d)
+        promised: list[str] = []  # sent after _owned_lock is released
+        try:
+            for d in deps:
+                with self._owned_lock:
+                    ent = self._owned.get(d)
+                    if ent is not None:
+                        st = ent.get("status")
+                        if st == "pending":
+                            # chain: runnable only on the dep's own lease (the
+                            # worker computes the dep first, in order)
+                            lease = disp.by_wid.get(ent.get("lease") or "") if disp else None
+                            if lease is None or lease.dead or (
+                                    required_lease is not None
+                                    and lease is not required_lease):
+                                return None
+                            required_lease = lease
+                            if not ent.get("publish_on_done"):
+                                # safety net: if anything else ends up waiting
+                                # on this oid at the GCS, the publish will come
+                                ent["publish_on_done"] = True
+                                self.incref(d)
+                                promised.append(d)
+                            continue
+                        if st == "redirect":
+                            return None  # GCS owns this task now
+                        if st == "error":
+                            return None  # error propagation is the GCS path's job
+                        if ent.get("where") == "inline":
+                            if not ent.get("published"):
+                                inline_deps[d] = ent["inline"]
+                            continue
+                        if ent.get("size", 0) > best:
+                            best, prefer_host = ent["size"], ent.get("host")
                         continue
-                    if st == "redirect":
-                        return None  # GCS owns this task now
-                    if st == "error":
-                        return None  # error propagation is the GCS path's job
-                    if ent.get("where") == "inline":
-                        if not ent.get("published"):
-                            inline_deps[d] = ent["inline"]
-                        continue
-                    if ent.get("size", 0) > best:
-                        best, prefer_host = ent["size"], ent.get("host")
-                    continue
-            if d in self._memory or d in self._plasma_refs:
-                continue  # materialized locally → ready cluster-wide
-            lc = self._loc_cache.get(d)
-            if lc is None:
-                return None  # unknown readiness → let the GCS queue it
-            host, size = lc
-            if host is not None and size > best:
-                best, prefer_host = size, host
-        return inline_deps, required_lease, prefer_host
+                if d in self._memory or d in self._plasma_refs:
+                    continue  # materialized locally → ready cluster-wide
+                lc = self._loc_cache.get(d)
+                if lc is None:
+                    return None  # unknown readiness → let the GCS queue it
+                host, size = lc
+                if host is not None and size > best:
+                    best, prefer_host = size, host
+            return inline_deps, required_lease, prefer_host
+        finally:
+            # let the GCS fail the stub if this process dies before
+            # delivering the promised publish
+            for d in promised:
+                self.send_no_reply({"type": "will_publish",
+                                    "oid": d, "wid": self.wid})
 
     def _prepare_gcs_deps(self, deps):
         """Before a GCS-path submit: make every dep resolvable there."""
@@ -799,24 +812,32 @@ class CoreWorker:
                 ent = self._owned.get(oid)
                 if ent is None or ent.get("published"):
                     continue
-                if ent.get("status") == "pending":
+                st = ent.get("status")
+                if st == "pending":
                     if not ent.get("publish_on_done"):
                         ent["publish_on_done"] = True
                         self.incref(oid)
+                        # let the GCS fail the stub if this process dies
+                        # before delivering the promised publish (sent
+                        # outside the lock, below)
+                        msg = {"type": "will_publish", "oid": oid,
+                               "wid": self.wid}
+                elif st == "redirect":
                     continue
-                if ent.get("status") == "redirect":
-                    continue
-                ent["published"] = True
-                # its earlier incref was suppressed as GCS-invisible: emit it
-                # now so the GCS count matches this process's live refs
-                with self._ref_lock:
-                    if self._local_refs.get(oid, 0) > 0:
-                        self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
-                if ent.get("where") == "inline":
-                    msg = {"type": "object_put", "oid": oid, "where": "inline",
-                           "inline": ent["inline"], "size": ent.get("size", 0),
-                           "is_error": ent.get("status") == "error",
-                           "contained": ent.get("contained") or None}
+                else:
+                    # flip to GCS-visible atomically with re-emitting the
+                    # suppressed +1 (incref/decref consult _gcs_invisible
+                    # under _ref_lock, so holding it here closes the race —
+                    # same pattern as _redirect_to_gcs)
+                    with self._ref_lock:
+                        ent["published"] = True
+                        if self._local_refs.get(oid, 0) > 0:
+                            self._ref_deltas[oid] = self._ref_deltas.get(oid, 0) + 1
+                    if ent.get("where") == "inline":
+                        msg = {"type": "object_put", "oid": oid, "where": "inline",
+                               "inline": ent["inline"], "size": ent.get("size", 0),
+                               "is_error": st == "error",
+                               "contained": ent.get("contained") or None}
             if msg is not None:
                 self.send_no_reply(msg)
 
@@ -940,15 +961,18 @@ class CoreWorker:
                         status="error" if err is not None else "ready",
                         where=where, inline=inline, size=size,
                         host=lease.host,
-                        contained=list(contained.get(oid) or ()),
-                        published=was_published)
+                        contained=list(contained.get(oid) or ()))
                     if was_published:
                         # worker registered it at the GCS (shm/contained):
-                        # surface this process's suppressed refs there
+                        # flip visibility and surface this process's
+                        # suppressed refs atomically (see _redirect_to_gcs)
                         with self._ref_lock:
+                            ent["published"] = True
                             if self._local_refs.get(oid, 0) > 0:
                                 self._ref_deltas[oid] = \
                                     self._ref_deltas.get(oid, 0) + 1
+                    else:
+                        ent["published"] = False
                     if ent.pop("publish_on_done", False):
                         publish_later.append(oid)
                     ent["fut"].set({"ready": True})

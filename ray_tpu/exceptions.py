@@ -44,5 +44,10 @@ class ObjectLostError(RayTpuError):
     pass
 
 
+class OwnerDiedError(ObjectLostError):
+    """The process that owned an object died before publishing/recovering it
+    (reference: ray.exceptions.OwnerDiedError)."""
+
+
 class PlacementGroupUnschedulableError(RayTpuError):
     pass
