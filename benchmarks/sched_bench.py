@@ -1,0 +1,88 @@
+"""Scheduler scalability microbench: deep-queue submission + shallow drain.
+
+Reference envelope: 1M queued tasks on a single node
+(/root/reference/release/benchmarks/README.md:29 "single_node
+... 10k queued tasks" and distributed 1M queued). The deep-queue case
+measures submit throughput while every worker is blocked and the pending
+queue is already deep — the round-2 fix got 93→296/s; round 4 shards the
+pending queue by resource shape so per-event feasibility is a dict probe.
+
+Usage: python benchmarks/sched_bench.py [--deep N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def bench_deep_queue(n_deep: int = 20000) -> dict:
+    # measure the GCS scheduler path, not the caller-local direct queue
+    os.environ.setdefault("RAY_TPU_DIRECT_DISPATCH", "0")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_workers=2, max_workers=2)
+    release = threading.Event()
+
+    @ray_tpu.remote
+    def blocker(path):
+        import time as _t
+        open(path, "w").close()
+        while not os.path.exists(path + ".go"):
+            _t.sleep(0.05)
+        return "unblocked"
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    import tempfile
+    d = tempfile.mkdtemp(prefix="schedbench")
+    marks = [os.path.join(d, f"b{i}") for i in range(2)]
+    blockers = [blocker.remote(m) for m in marks]
+    deadline = time.time() + 30
+    while not all(os.path.exists(m) for m in marks):
+        if time.time() > deadline:
+            raise RuntimeError("blockers never started")
+        time.sleep(0.05)
+
+    # deep-queue submission: every submit lands behind blocked workers
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n_deep)]
+    t_submit = time.perf_counter() - t0
+    submit_rate = n_deep / t_submit
+
+    # drain: unblock and wait for everything
+    t1 = time.perf_counter()
+    for m in marks:
+        open(m + ".go", "w").close()
+    ray_tpu.get(blockers)
+    ray_tpu.get(refs)
+    t_drain = time.perf_counter() - t1
+    drain_rate = n_deep / t_drain
+
+    ray_tpu.shutdown()
+    return {
+        "deep_queue_n": n_deep,
+        "deep_queue_submit_per_s": round(submit_rate, 1),
+        "deep_queue_drain_per_s": round(drain_rate, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deep", type=int, default=20000)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    out = bench_deep_queue(args.deep)
+    print(json.dumps(out) if args.json else out)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,12 +18,12 @@ import sys
 
 _CHILD = r"""
 import os, signal, sys
-def _bail(sig, frm):
-    # clean self-exit BEFORE any grant can be half-held; safer than an
-    # external kill which leaves the pool relay stuck
-    os._exit(1)
-signal.signal(signal.SIGALRM, _bail)
-signal.alarm(int(sys.argv[1]))
+# default SIGALRM disposition terminates at the C level — a Python handler
+# could never run while the process is blocked inside jax's native backend
+# init (the exact wedged-pool case this probe detects). Self-termination by
+# alarm is indistinguishable from the wedge's own state for the pool (init
+# never completed a grant), and it guarantees no stuck probe accumulates.
+signal.alarm(max(1, int(float(sys.argv[1]))))
 os.environ.pop("JAX_PLATFORMS", None)
 import jax
 try:

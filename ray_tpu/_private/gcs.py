@@ -137,6 +137,98 @@ class _VNode:
         self.quarantined_chips: list[int] = []
 
 
+class _PendingShards:
+    """Pending plain-task queue sharded by (resource shape, renv_hash).
+
+    Deep queues are the reference's scalability envelope (1M queued tasks on
+    a node, release/benchmarks/README.md:29): per-event scheduler work must
+    not scan the whole queue. Specs in one shard are uniform in everything
+    placement-relevant except deps, so ONE feasibility probe (is there an
+    idle worker of this shape / could one be spawned?) covers the entire
+    shard — feasibility becomes a dict walk over shards instead of a spec
+    scan. Specs with a scheduling strategy (PG / node affinity / labels)
+    differ per-spec and live in the `misc` shard, scanned the old way.
+    """
+
+    __slots__ = ("shards", "misc", "ids")
+
+    def __init__(self, specs=()):
+        self.shards: dict[tuple, collections.deque] = {}
+        self.misc: collections.deque = collections.deque()
+        # task_id multiset for O(1) "is this tid queued?" probes (lineage
+        # eviction asks per submit; a set build would be O(queue))
+        self.ids: collections.Counter = collections.Counter()
+        for s in specs:
+            self.append(s)
+
+    @staticmethod
+    def key_of(spec: dict):
+        if spec.get("strategy"):
+            return None
+        res = spec.get("resources") or {}
+        return (tuple(sorted((k, float(v)) for k, v in res.items())),
+                spec.get("renv_hash", ""))
+
+    def _dq(self, spec: dict) -> collections.deque:
+        k = self.key_of(spec)
+        if k is None:
+            return self.misc
+        dq = self.shards.get(k)
+        if dq is None:
+            dq = self.shards[k] = collections.deque()
+        return dq
+
+    def append(self, spec: dict) -> None:
+        self._dq(spec).append(spec)
+        self.ids[spec["task_id"]] += 1
+
+    def appendleft(self, spec: dict) -> None:
+        self._dq(spec).appendleft(spec)
+        self.ids[spec["task_id"]] += 1
+
+    def note_consumed(self, tid: str) -> None:
+        """A spec left the queue by direct deque manipulation (dispatch)."""
+        n = self.ids.get(tid, 0) - 1
+        if n <= 0:
+            self.ids.pop(tid, None)
+        else:
+            self.ids[tid] = n
+
+    def is_queued(self, tid: str) -> bool:
+        return self.ids.get(tid, 0) > 0
+
+    def __len__(self) -> int:
+        return len(self.misc) + sum(len(d) for d in self.shards.values())
+
+    def __bool__(self) -> bool:
+        return bool(self.misc) or any(self.shards.values())
+
+    def __iter__(self):
+        yield from self.misc
+        for dq in self.shards.values():
+            yield from dq
+
+    def remove_task_id(self, tid: str) -> list[dict]:
+        """Remove (and return) every spec with this task id. O(total) —
+        cancellation only."""
+        removed: list[dict] = []
+
+        def _filter(dq: collections.deque) -> collections.deque:
+            kept: collections.deque = collections.deque()
+            for s in dq:
+                (removed if s["task_id"] == tid else kept).append(s)
+            return kept
+
+        self.misc = _filter(self.misc)
+        for k in list(self.shards):
+            self.shards[k] = _filter(self.shards[k])
+            if not self.shards[k]:
+                del self.shards[k]
+        for _ in removed:
+            self.note_consumed(tid)
+        return removed
+
+
 class _Bundle:
     __slots__ = ("total", "available", "node_id")
 
@@ -199,8 +291,9 @@ class GcsServer:
         # death so the scan is O(its promises), entries dropped with the wid
         self._pub_promises: dict[str, set] = {}
         self._fn_access: dict[str, float] = {}  # fn: key → last touch ts
+        self._pinned_fn_cache: tuple[float, set] | None = None
         self.workers: dict[str, _Worker] = {}
-        self.pending_tasks: collections.deque[dict] = collections.deque()
+        self.pending_tasks = _PendingShards()
         self.pending_actor_creations: collections.deque[dict] = collections.deque()
         self.actors: dict[str, _Actor] = {}
         self.named_actors: dict[str, str] = {}
@@ -774,7 +867,11 @@ class GcsServer:
             return wid
         if t == "submit_task":
             self._submit_task(msg["spec"])
-            conn.send({"rid": msg["rid"], "ok": True})
+            # submission is async (reference: .remote() never waits on the
+            # GCS); callers send rid-less fire-and-forget submits with a
+            # periodic synchronous one as backpressure
+            if "rid" in msg:
+                conn.send({"rid": msg["rid"], "ok": True})
         elif t == "task_done":
             self._on_task_done(msg)
         elif t == "object_put":
@@ -886,11 +983,8 @@ class GcsServer:
             die_conn = None
             free_args: list[str] = []
             with self.lock:
-                before = len(self.pending_tasks)
-                removed = [s for s in self.pending_tasks if s["task_id"] == tid]
-                self.pending_tasks = collections.deque(
-                    s for s in self.pending_tasks if s["task_id"] != tid)
-                cancelled = len(self.pending_tasks) < before
+                removed = self.pending_tasks.remove_task_id(tid)
+                cancelled = bool(removed)
                 for spec in removed:
                     spec["_cancelled"] = True
                 if not cancelled:
@@ -1378,6 +1472,15 @@ class GcsServer:
                 return  # re-check: the real publish won the race
             if prev is not None:
                 self._drop_shm_copies_locked(prev)  # stale copies of an overwrite
+                pw = prev.pop("pub_wid", None)
+                if pw is not None:
+                    # promise fulfilled (or superseded): drop the index entry
+                    # so long-lived drivers don't accumulate dead promises
+                    s = self._pub_promises.get(pw)
+                    if s is not None:
+                        s.discard(oid)
+                        if not s:
+                            self._pub_promises.pop(pw, None)
             entry = self.objects[oid] = {
                 **(prev or {}),  # keep refcount state accumulated while pending
                 "status": "error" if is_error else "ready",
@@ -1977,8 +2080,19 @@ class GcsServer:
         """fn: store keys that MUST survive eviction: referenced by a
         pending/running spec (the executor fetches the blob at dispatch) or
         by retained lineage (reconstruction resubmits the spec verbatim).
-        Only called on the rare eviction path (>2048 distinct functions),
-        so the full scan is fine. Caller holds the lock."""
+        Caller holds the lock.
+
+        The scan is O(pending + running + lineage), so the result is cached
+        for a few seconds: dynamic-closure floods hit the eviction path on
+        EVERY overflowing put, and an uncached scan there would undo the
+        sharded-queue submit scaling. Staleness is safe because every key
+        referenced in the cache window is also recency-protected — uploads
+        and existence probes stamp _fn_access, and eviction spares keys
+        touched within the (much longer) 300s freshness window."""
+        now = time.monotonic()
+        cached = self._pinned_fn_cache
+        if cached is not None and now - cached[0] < 5.0:
+            return cached[1]
         pinned: set = set()
 
         def _note(spec):
@@ -1996,6 +2110,7 @@ class GcsServer:
                 _note(s)
         for s in self.lineage.values():
             _note(s)
+        self._pinned_fn_cache = (now, pinned)
         return pinned
 
     def _retain_lineage_locked(self, spec: dict) -> list[str]:
@@ -2013,15 +2128,20 @@ class GcsServer:
         if len(self.lineage) > MAX_LINEAGE:
             # evict oldest-first, but never a task that is still
             # queued/running — dropping one would free its pinned
-            # args blob under it and hang the dispatch
-            active = {s["task_id"] for s in self.pending_tasks}
+            # args blob under it and hang the dispatch. Queued-ness is an
+            # O(1) multiset probe; the candidate walk is BOUNDED so a deep
+            # queue (every lineage entry still pending) costs O(K) per
+            # submit, not O(lineage) — the budget is soft and the excess
+            # drains as soon as tasks start completing
+            running: set = set()
             for w_ in self.workers.values():
-                active.update(w_.running_tasks.keys())
-            active.add(spec["task_id"])
-            for tid in list(self.lineage):
+                running.update(w_.running_tasks.keys())
+            candidates = list(itertools.islice(self.lineage, 64))
+            for tid in candidates:
                 if len(self.lineage) <= MAX_LINEAGE:
                     break
-                if tid in active:
+                if (tid == spec["task_id"] or tid in running
+                        or self.pending_tasks.is_queued(tid)):
                     continue
                 evicted.extend(self._drop_lineage_locked(tid))
         return evicted
@@ -2058,7 +2178,13 @@ class GcsServer:
                     # the GCS path now owns producing this value; a stale
                     # will_publish promise (direct spec redirected here)
                     # must not let the old owner's death error the stub
-                    e.pop("pub_wid", None)
+                    pw = e.pop("pub_wid", None)
+                    if pw is not None:
+                        s = self._pub_promises.get(pw)
+                        if s is not None:
+                            s.discard(oid)
+                            if not s:
+                                self._pub_promises.pop(pw, None)
             reason = self._invalid_strategy_reason(spec.get("strategy"))
             if reason is None:
                 # hold every object this task needs (args + refs nested in
@@ -2162,6 +2288,7 @@ class GcsServer:
                     actor = self.actors[spec["actor_id"]]
                     actor.worker = w.wid
                 to_send.append((w.conn, {"type": "exec", "spec": spec}))
+                self.pending_tasks.note_consumed(spec["task_id"])
                 dispatched_any = True
                 return True
 
@@ -2214,7 +2341,32 @@ class GcsServer:
                     return actor is None or actor.state == "dead"
 
                 scan(self.pending_actor_creations, skip=_dead_actor)
-                scan(self.pending_tasks)
+                # strategy specs: placement varies per spec, scan them all
+                scan(self.pending_tasks.misc)
+                # uniform shards: one feasibility probe covers the shard
+                for key, dq in list(self.pending_tasks.shards.items()):
+                    if not dq:
+                        del self.pending_tasks.shards[key]
+                        continue
+                    res = dq[0].get("resources") or {}
+                    rh = key[1]
+                    need = accelerators.chips_required(res)
+                    if any(len(x.tpu_chips) == need and x.renv_hash == rh
+                           for pool in idle_by_node.values() for x in pool):
+                        scan(dq)
+                        continue
+                    # no matching idle worker anywhere: nothing in this
+                    # shard can dispatch this pass. Register spawn demand
+                    # for the RUNNABLE prefix only (a dep-blocked shard must
+                    # not trigger spawns/reclaims/revocations for tasks that
+                    # couldn't run anyway) — bounded probe, O(K) per shard
+                    node_id = pg_policy.pick_node_hybrid(
+                        list(self.nodes.values()), res, self.local_node_id)
+                    if node_id is not None:
+                        runnable = sum(1 for s in itertools.islice(dq, 64)
+                                       if self._deps_ready(s))
+                        if runnable:
+                            want_spawn[(node_id, need, rh)] += runnable
 
             # pending work that couldn't dispatch while leases hold the
             # resources it needs: revoke exactly those leases (reference:
@@ -2559,7 +2711,13 @@ class GcsServer:
                     # the GCS path now owns producing this value; a stale
                     # will_publish promise (direct spec redirected here)
                     # must not let the old owner's death error the stub
-                    e.pop("pub_wid", None)
+                    pw = e.pop("pub_wid", None)
+                    if pw is not None:
+                        s = self._pub_promises.get(pw)
+                        if s is not None:
+                            s.discard(oid)
+                            if not s:
+                                self._pub_promises.pop(pw, None)
             holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
             spec["_holds"] = holds
             self._sys_hold_locked(holds, +1)

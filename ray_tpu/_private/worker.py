@@ -259,6 +259,7 @@ class CoreWorker:
         # function store (reference: the worker's function table)
         self._func_cache: dict = {}
         self._shipped_fns: dict[str, float] = {}  # sha → last-verified ts
+        self._submit_seq = 0  # every Nth GCS submit is synchronous
 
         reply = self.rpc({"type": "register", "wid": self.wid, "kind": kind,
                           "pid": os.getpid(), "node_id": self.node_id,
@@ -725,7 +726,14 @@ class CoreWorker:
                 and self._try_submit_direct(spec)):
             return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
         self._prepare_gcs_deps(deps)
-        self.rpc({"type": "submit_task", "spec": spec})
+        # fire-and-forget (reference: .remote() never blocks on the control
+        # plane); every Nth submit is synchronous so a flood of submissions
+        # stays bounded by what the GCS has actually admitted
+        self._submit_seq += 1
+        if self._submit_seq % 512 == 0:
+            self.rpc({"type": "submit_task", "spec": spec})
+        else:
+            self.send_no_reply({"type": "submit_task", "spec": spec})
         if num_returns == "streaming":
             return ObjectRefGenerator(task_id, self)
         return [ObjectRef(f"{task_id}r{i:04d}") for i in range(num_returns)]
