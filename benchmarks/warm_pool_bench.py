@@ -1,0 +1,63 @@
+"""Cold first-task latency: on-demand spawn vs warm worker pool.
+
+Reference behavior: prestarted pool (src/ray/raylet/worker_pool.h:280).
+Prints one JSON object with both latencies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def measure(warm: int) -> float:
+    import importlib
+
+    if warm:
+        os.environ["RAY_TPU_WARM_POOL_SIZE"] = str(warm)
+    else:
+        os.environ.pop("RAY_TPU_WARM_POOL_SIZE", None)
+    from ray_tpu._private.ray_config import RayConfig
+
+    RayConfig.reset()
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_workers=0, max_workers=4)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    if warm:
+        # let the floor fill before the cold-task measurement
+        from ray_tpu._private.api import _get_worker
+
+        w = _get_worker()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rows = w.rpc({"type": "list_workers"}).get("workers", [])
+            if sum(1 for x in rows if x.get("idle")
+                   and not x.get("tpu_chips")) >= warm:
+                break
+            time.sleep(0.1)
+    t0 = time.perf_counter()
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    dt = time.perf_counter() - t0
+    ray_tpu.shutdown()
+    return dt
+
+
+def main():
+    cold_spawn = measure(0)
+    warm = measure(2)
+    print(json.dumps({
+        "first_task_latency_spawn_ms": round(cold_spawn * 1e3, 1),
+        "first_task_latency_warm_pool_ms": round(warm * 1e3, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

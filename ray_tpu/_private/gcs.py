@@ -275,6 +275,9 @@ class GcsServer:
         self.lock = threading.RLock()
         self.spawn_worker_cb = spawn_worker_cb
         self.max_workers = max_workers
+        # read once: _schedule is a hot path and the floor can't change
+        # after server start
+        self.warm_pool_size = int(RayConfig.get("warm_pool_size"))
 
         self.nodes: dict[str, _VNode] = {
             DEFAULT_NODE: _VNode(DEFAULT_NODE, total_resources, node_labels)
@@ -2368,6 +2371,24 @@ class GcsServer:
                         if runnable:
                             want_spawn[(node_id, need, rh)] += runnable
 
+            # warm-pool floor: replenish idle no-env CPU workers consumed
+            # by dispatch/leases so the next cold task is a dispatch, not a
+            # process fork + imports (reference: worker_pool.h:280
+            # prestarted pool). Deficits are NOT merged into want_spawn:
+            # real demand may retire mismatched workers and revoke leases
+            # to make room, but background replenishment must only ever use
+            # LEFTOVER headroom (see the post-scale-up block below).
+            warm_needs: dict[str, int] = {}
+            if self.warm_pool_size > 0:
+                for node_id_w, node_w in self.nodes.items():
+                    if not node_w.alive:
+                        continue
+                    idle_plain = sum(
+                        1 for x in idle_by_node.get(node_id_w, ())
+                        if not x.tpu_chips and x.renv_hash == "")
+                    if self.warm_pool_size > idle_plain:
+                        warm_needs[node_id_w] = self.warm_pool_size - idle_plain
+
             # pending work that couldn't dispatch while leases hold the
             # resources it needs: revoke exactly those leases (reference:
             # leases are returned under cluster pressure / spillback)
@@ -2454,6 +2475,21 @@ class GcsServer:
                     self._spawn_pending[node_id].extend(
                         (now, c, rh) for c in assignments)
                     spawn_plan.append((node_id, assignments, rh))
+            # warm-pool replenishment: strictly leftover headroom, shared
+            # across nodes, never reclaims or revokes anything
+            for node_id_w, deficit in warm_needs.items():
+                if headroom <= 0:
+                    break
+                spawning_plain = sum(
+                    1 for _, c_, rh_ in self._spawn_pending[node_id_w]
+                    if not c_ and rh_ == "")
+                n = min(deficit - spawning_plain, headroom)
+                if n <= 0:
+                    continue
+                headroom -= n
+                self._spawn_pending[node_id_w].extend(
+                    (now, None, "") for _ in range(n))
+                spawn_plan.append((node_id_w, [None] * n, ""))
             agent_sends = []
             for node_id, assignments, rh in spawn_plan:
                 host = self.node_hosts.get(node_id, HEAD_HOST)

@@ -85,6 +85,15 @@ class RayConfig:
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
 
+    # --- worker pool ----------------------------------------------------
+    # Warm-pool floor: keep this many idle no-runtime-env CPU workers per
+    # node, replenished asynchronously as they are consumed by dispatch or
+    # leases (reference: raylet worker_pool.h:280 prestarted/cached pool —
+    # first-task latency becomes a dispatch, not a process fork + imports).
+    # 0 disables (init(num_workers=N) still prespawns N once; the floor
+    # additionally REPLENISHES as workers are consumed).
+    warm_pool_size: int = 0
+
     # --- memory / OOM defense -------------------------------------------
     # Host memory-monitor poll period in ms; 0 disables (reference:
     # memory_monitor.h:52 polls at memory_monitor_refresh_ms). Off by
