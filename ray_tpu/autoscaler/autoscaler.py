@@ -67,6 +67,13 @@ class Autoscaler:
         self._nodes: Dict[str, str] = {}  # provider node id → type name
         self._launch_times: Dict[str, float] = {}
         self._idle_since: Dict[str, float] = {}
+        # type name → monotonic ts until which launches are suppressed
+        # (provider create failed with quota/stockout: hot-retrying cannot
+        # succeed, so the failure maps into reconciler state instead of
+        # crashing the loop — reference: v2 instance_manager tracks launch
+        # failures per instance type)
+        self._type_cooldown: Dict[str, float] = {}
+        self._launch_errors: Dict[str, str] = {}  # type → last error text
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -110,8 +117,11 @@ class Autoscaler:
         for nid, tname in self._nodes.items():
             counts[tname] = counts.get(tname, 0) + 1
         for nt in self.node_types.values():
-            while counts.get(nt.name, 0) < nt.min_nodes:
+            while (counts.get(nt.name, 0) < nt.min_nodes
+                   and not self._cooling_down(nt.name)):
                 nid = self._launch(nt)
+                if nid is None:
+                    break  # cooldown just started; next pass retries
                 actions["launched"].append((nt.name, nid))
                 counts[nt.name] = counts.get(nt.name, 0) + 1
 
@@ -139,6 +149,8 @@ class Autoscaler:
                     break
             else:
                 for nt in self.node_types.values():
+                    if self._cooling_down(nt.name):
+                        continue  # launches of this type just failed
                     count_now = (counts.get(nt.name, 0)
                                  + sum(1 for p, _r, new in planned
                                        if new and p.name == nt.name))
@@ -152,8 +164,12 @@ class Autoscaler:
         for nt, _rem, new in planned:
             if not new:
                 continue
+            if self._cooling_down(nt.name):
+                # an earlier launch in THIS pass failed: don't hot-retry
+                continue
             nid = self._launch(nt)
-            actions["launched"].append((nt.name, nid))
+            if nid is not None:
+                actions["launched"].append((nt.name, nid))
 
         # 4. terminate idle above-min nodes (no demand and nothing running
         #    on them — approximated by zero unmet demand + full availability)
@@ -174,16 +190,38 @@ class Autoscaler:
         else:
             self._idle_since.clear()
 
-        # reap externally-died nodes
+        # reap externally-died nodes (incl. preempted slices the provider
+        # filters out of non_terminated_nodes — relaunched next pass)
         live = set(self.provider.non_terminated_nodes())
         for nid in list(self._nodes):
             if nid not in live:
                 self._nodes.pop(nid, None)
                 self._idle_since.pop(nid, None)
+                self._launch_times.pop(nid, None)
+        # expired cooldowns drop their stale error from the summary too
+        for tname in list(self._launch_errors):
+            if not self._cooling_down(tname):
+                self._launch_errors.pop(tname, None)
+        actions["launch_failures"] = dict(self._launch_errors)
         return actions
 
-    def _launch(self, nt: NodeType) -> str:
-        nid = self.provider.create_node(nt.name, nt.resources, nt.labels)
+    def _cooling_down(self, tname: str) -> bool:
+        return time.monotonic() < self._type_cooldown.get(tname, 0.0)
+
+    def _launch(self, nt: NodeType) -> Optional[str]:
+        """Create a node; on provider failure, back off the node type for
+        the error's suggested cooldown and return None instead of raising —
+        a quota/stockout must degrade the reconciler, not crash it."""
+        try:
+            nid = self.provider.create_node(nt.name, nt.resources, nt.labels)
+        except Exception as e:
+            cooldown = float(getattr(e, "cooldown_s", 10.0))
+            self._type_cooldown[nt.name] = time.monotonic() + cooldown
+            self._launch_errors[nt.name] = str(e)
+            logger.warning("autoscaler: launch of %s failed (%s); cooling "
+                           "down %.0fs", nt.name, e, cooldown)
+            return None
+        self._launch_errors.pop(nt.name, None)
         self._nodes[nid] = nt.name
         self._launch_times[nid] = time.monotonic()
         logger.info("autoscaler: launched %s node %s", nt.name, nid)
@@ -193,6 +231,7 @@ class Autoscaler:
         self.provider.terminate_node(nid)
         tname = self._nodes.pop(nid, "?")
         self._idle_since.pop(nid, None)
+        self._launch_times.pop(nid, None)
         logger.info("autoscaler: terminated %s node %s", tname, nid)
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,0 +1,292 @@
+"""REST client for the GCE Cloud TPU API (tpu.googleapis.com v2).
+
+Implements the `GceTpuApi` surface the slice-atomic provider consumes
+(reference: python/ray/autoscaler/_private/gcp/node.py GCPTPUNode wraps the
+same API via googleapiclient; tpu_command_runner.py drives the created pod).
+Built on urllib with an injectable transport so every path — retries,
+backoff, quota/stockout/preemption mapping — is testable offline against
+canned responses; production uses the default transport + the GCE metadata
+server for tokens.
+
+Error model (surfaced to the autoscaler reconciler):
+- `QuotaExceededError`  — 403/429 with quota/rate messages: backoff the
+  node type; retrying immediately cannot succeed.
+- `StockoutError`       — RESOURCE_EXHAUSTED / "no available capacity" in
+  zone: backoff the node type, ideally try another zone.
+- `TpuApiError`         — anything else non-retryable (4xx).
+Transient 5xx/429 responses and transport failures are retried here with
+exponential backoff before any error escapes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.gce_tpu import GceTpuApi
+
+_BASE = "https://tpu.googleapis.com/v2"
+_METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                       "instance/service-accounts/default/token")
+
+# node states the API reports that mean "this slice is gone or dying":
+# preempted/terminated slices must drop out of non_terminated_nodes so the
+# reconciler reaps and relaunches them
+_TERMINAL_STATES = {"PREEMPTED", "TERMINATED", "HIDING", "HIDDEN", "DELETING"}
+
+# google.rpc.Code numeric → name (the subset operation errors carry)
+_RPC_CODES = {3: "INVALID_ARGUMENT", 5: "NOT_FOUND", 7: "PERMISSION_DENIED",
+              8: "RESOURCE_EXHAUSTED", 13: "INTERNAL", 14: "UNAVAILABLE"}
+
+
+class TpuApiError(Exception):
+    """Non-retryable TPU API failure (final status + parsed message)."""
+
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+        super().__init__(f"TPU API error {status}: {message}")
+
+
+class QuotaExceededError(TpuApiError):
+    """Project quota exhausted — backoff, don't hot-retry."""
+
+    cooldown_s = 120.0  # the reconciler backs off this node type
+
+
+class StockoutError(TpuApiError):
+    """Zone has no capacity for this accelerator right now."""
+
+    cooldown_s = 30.0  # stockouts churn; re-probe sooner than quota
+
+
+def _default_transport(method: str, url: str, headers: Dict[str, str],
+                       body: Optional[bytes], timeout: float):
+    """(status_code, body_bytes) via urllib; HTTP errors return their
+    status instead of raising so the retry loop can classify them."""
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def metadata_token_provider() -> str:
+    """Access token from the GCE metadata server (VMs with a service
+    account). Off-GCP deployments inject their own provider."""
+    status, body = _default_transport(
+        "GET", _METADATA_TOKEN_URL, {"Metadata-Flavor": "Google"}, None, 5.0)
+    if status != 200:
+        raise TpuApiError(status, "metadata server token fetch failed")
+    return json.loads(body)["access_token"]
+
+
+def _error_message(body: bytes) -> tuple[str, str]:
+    """(message, rpc_status) from a google.rpc error envelope."""
+    try:
+        err = json.loads(body or b"{}").get("error") or {}
+        return str(err.get("message") or ""), str(err.get("status") or "")
+    except Exception:
+        return (body or b"")[:200].decode("utf-8", "replace"), ""
+
+
+def classify_error(status: int, body: bytes) -> TpuApiError:
+    """Map a final (post-retry) HTTP failure to the typed error the
+    reconciler keys its backoff decisions on."""
+    msg, rpc = _error_message(body)
+    low = msg.lower()
+    if rpc == "RESOURCE_EXHAUSTED" or "no available capacity" in low \
+            or "stockout" in low or "resources are insufficient" in low:
+        # quota wording wins: quota problems persist, stockouts churn
+        if "quota" not in low:
+            return StockoutError(status, msg or "zone stockout")
+    if status in (403, 429) and ("quota" in low or "rate limit" in low
+                                 or rpc == "RESOURCE_EXHAUSTED"):
+        return QuotaExceededError(status, msg or "quota exceeded")
+    return TpuApiError(status, msg or f"http {status}")
+
+
+class RestGceTpuApi(GceTpuApi):
+    """GceTpuApi over tpu.googleapis.com v2 nodes.{create,delete,list,get}.
+
+    `transport(method, url, headers, body, timeout) -> (status, bytes)` and
+    `token_provider() -> str` are injectable; tests drive canned responses
+    through exactly the code paths production takes.
+    """
+
+    RETRYABLE = {429, 500, 502, 503, 504}
+
+    def __init__(self, project: str, zone: str, *,
+                 token_provider: Callable[[], str] = metadata_token_provider,
+                 transport=_default_transport,
+                 gcs_address: str = "",
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 network: str = "", preemptible: bool = False,
+                 max_retries: int = 4, timeout_s: float = 30.0,
+                 backoff_s: float = 0.5, op_polls: int = 3,
+                 op_poll_s: float = 2.0):
+        self.project = project
+        self.zone = zone
+        self.token_provider = token_provider
+        self.transport = transport
+        self.gcs_address = gcs_address
+        self.runtime_version = runtime_version
+        self.network = network
+        self.preemptible = preemptible
+        self.max_retries = max_retries
+        self.timeout_s = timeout_s
+        self.backoff_s = backoff_s
+        self.op_polls = op_polls
+        self.op_poll_s = op_poll_s
+        self._token: Optional[str] = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _headers(self) -> Dict[str, str]:
+        if self._token is None:
+            self._token = self.token_provider()
+        return {"Authorization": f"Bearer {self._token}",
+                "Content-Type": "application/json"}
+
+    def _call(self, method: str, path: str, *, query: str = "",
+              body: Optional[dict] = None) -> dict:
+        url = f"{_BASE}/{path}" + (f"?{query}" if query else "")
+        payload = json.dumps(body).encode() if body is not None else None
+        delay = self.backoff_s
+        last: tuple[int, bytes] = (0, b"")
+        refreshed = False
+        for attempt in range(self.max_retries + 1):
+            try:
+                status, data = self.transport(
+                    method, url, self._headers(), payload, self.timeout_s)
+            except Exception:
+                # transport-level failure (DNS, reset): retryable
+                status, data = (0, b"")
+            if 200 <= status < 300:
+                return json.loads(data or b"{}")
+            last = (status, data)
+            if status == 401 and not refreshed:
+                # expired token: refresh once per call and retry immediately
+                self._token = None
+                refreshed = True
+                continue
+            if status in self.RETRYABLE or status == 0:
+                err = classify_error(status, data)
+                if isinstance(err, (QuotaExceededError, StockoutError)):
+                    # a hard no — retrying (and sleeping) cannot help; the
+                    # reconciler's type cooldown takes it from here
+                    raise err
+                if attempt < self.max_retries:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+                    continue
+            break
+        raise classify_error(*last)
+
+    # -- GceTpuApi surface -------------------------------------------------
+
+    def create_node(self, name: str, accelerator_type: str,
+                    labels: Dict[str, str]) -> None:
+        # GCE label values: lowercase alnum + dash/underscore only
+        clean = {str(k).lower().replace("/", "-").replace(".", "-"):
+                 str(v).lower().replace("/", "-").replace(".", "-")
+                 for k, v in labels.items()}
+        body = {
+            "acceleratorType": accelerator_type,
+            "runtimeVersion": self.runtime_version,
+            "labels": clean,
+            "schedulingConfig": {"preemptible": self.preemptible},
+            "metadata": {
+                # every host of the slice self-joins the cluster on boot
+                # (reference: tpu_command_runner.py runs setup on all pod
+                # workers); -w$(worker-id) keys node_joined's prefix match
+                "startup-script": (
+                    "#! /bin/bash\n"
+                    f"python -m ray_tpu.scripts.cli start "
+                    f"--address {self.gcs_address} "
+                    f"--host-id {name}-w$(curl -sH 'Metadata-Flavor: Google' "
+                    "http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/attributes/agent-worker-number)\n"
+                ) if self.gcs_address else "",
+            },
+        }
+        if self.network:
+            body["networkConfig"] = {"network": self.network}
+        op = self._call("POST", f"{self._parent}/nodes",
+                        query=f"nodeId={name}", body=body)
+        self._check_operation(op)
+
+    def _check_operation(self, op: dict) -> None:
+        """nodes.create returns a long-running Operation; async failures
+        (the common stockout mode: HTTP 200, then the op fails with
+        RESOURCE_EXHAUSTED) must surface through the same quota/stockout
+        classification as synchronous errors, or the reconciler relaunches
+        every pass with no cooldown. Polls briefly; an op still running
+        after the budget is treated as success — the node shows up as
+        CREATING and state polling takes over."""
+        name = op.get("name")
+        for i in range(self.op_polls + 1):
+            if op.get("done"):
+                err = op.get("error") or {}
+                if err:
+                    status = {"RESOURCE_EXHAUSTED": 429,
+                              "PERMISSION_DENIED": 403,
+                              "NOT_FOUND": 404}.get(
+                                  _RPC_CODES.get(err.get("code")), 400)
+                    raise classify_error(status, json.dumps(
+                        {"error": {"message": err.get("message", ""),
+                                   "status": _RPC_CODES.get(err.get("code"),
+                                                            "")}}).encode())
+                return
+            if not name or i == self.op_polls:
+                return  # budget spent while still running: let state polling decide
+            time.sleep(self.op_poll_s)
+            op = self._call("GET", str(name).lstrip("/"))
+
+    def delete_node(self, name: str) -> None:
+        try:
+            self._call("DELETE", f"{self._parent}/nodes/{name}")
+        except TpuApiError as e:
+            if e.status == 404:
+                return  # already gone — deletion is idempotent
+            raise
+
+    def list_nodes(self) -> List[str]:
+        names: List[str] = []
+        page = ""
+        while True:
+            q = "pageSize=100" + (f"&pageToken={page}" if page else "")
+            resp = self._call("GET", f"{self._parent}/nodes", query=q)
+            for node in resp.get("nodes") or ():
+                if node.get("state") in _TERMINAL_STATES:
+                    continue  # preempted/terminated: reconciler must relaunch
+                # API returns fully-qualified names
+                names.append(str(node.get("name", "")).rsplit("/", 1)[-1])
+            page = resp.get("nextPageToken") or ""
+            if not page:
+                return names
+
+    def node_state(self, name: str) -> str:
+        try:
+            resp = self._call("GET", f"{self._parent}/nodes/{name}")
+        except TpuApiError as e:
+            if e.status == 404:
+                return "ABSENT"
+            raise
+        state = str(resp.get("state") or "")
+        if state in ("PREEMPTED", "TERMINATED"):
+            return "ABSENT"
+        if state in ("CREATING", "READY", "DELETING"):
+            return state
+        if state in ("REPAIRING", "RESTARTING", "STARTING"):
+            return "CREATING"
+        return "CREATING" if state else "ABSENT"

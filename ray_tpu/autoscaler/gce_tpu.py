@@ -7,8 +7,9 @@ whole, never host-by-host — the slice-head resource (`TPU-<type>-head`)
 drives demand so one pending multi-host TPU job launches exactly one slice.
 
 The API surface is injected (`GceTpuApi`): production uses the REST client
-(out of scope in this offline build), tests use `FakeGceTpuApi`, which
-simulates async provisioning (CREATING → READY) and records calls — the
+(`gce_rest.RestGceTpuApi` — tpu.googleapis.com v2 with retry/backoff and
+quota/stockout/preemption mapping); `FakeGceTpuApi` simulates async
+provisioning (CREATING → READY) and records calls for fast tests — the
 same env-simulation strategy the TPU detection layer uses.
 
 (reference: python/ray/autoscaler/_private/gcp/ — node.py's GCPTPUNode +

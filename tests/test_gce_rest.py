@@ -1,0 +1,280 @@
+"""GCE TPU REST client against canned HTTP responses (round-4, VERDICT 4).
+
+Every test drives the real request-building/retry/classification code in
+`ray_tpu.autoscaler.gce_rest.RestGceTpuApi` through an injected transport —
+the same paths production takes against tpu.googleapis.com v2 (reference:
+python/ray/autoscaler/_private/gcp/node.py + tpu_command_runner.py).
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeType
+from ray_tpu.autoscaler.gce_rest import (QuotaExceededError, RestGceTpuApi,
+                                         StockoutError, TpuApiError,
+                                         classify_error)
+from ray_tpu.autoscaler.gce_tpu import GceTpuNodeProvider
+
+
+class CannedTransport:
+    """Scripted (status, body) responses; records every request."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.requests = []
+
+    def __call__(self, method, url, headers, body, timeout):
+        self.requests.append((method, url, headers,
+                              json.loads(body) if body else None))
+        if not self.responses:
+            raise AssertionError("transport exhausted")
+        r = self.responses.pop(0)
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+
+def _api(responses, **kw):
+    t = CannedTransport(responses)
+    kw.setdefault("token_provider", lambda: "tok")
+    kw.setdefault("backoff_s", 0.0)
+    api = RestGceTpuApi("proj", "us-central2-b", transport=t, **kw)
+    return api, t
+
+
+def _ok(obj=None):
+    return (200, json.dumps(obj or {}).encode())
+
+
+def _err(status, message, rpc=""):
+    return (status, json.dumps(
+        {"error": {"message": message, "status": rpc}}).encode())
+
+
+def test_create_node_request_shape():
+    api, t = _api([_ok()], gcs_address="10.0.0.1:6379", preemptible=True)
+    api.create_node("ray-tpu-1", "v5litepod-16", {"ray.io/node-group": "tpu"})
+    (method, url, headers, body), = t.requests
+    assert method == "POST"
+    assert url == ("https://tpu.googleapis.com/v2/projects/proj/locations/"
+                   "us-central2-b/nodes?nodeId=ray-tpu-1")
+    assert headers["Authorization"] == "Bearer tok"
+    assert body["acceleratorType"] == "v5litepod-16"
+    assert body["schedulingConfig"] == {"preemptible": True}
+    assert body["labels"] == {"ray-io-node-group": "tpu"}  # GCE label rules
+    assert "ray_tpu" in body["metadata"]["startup-script"]
+    assert "10.0.0.1:6379" in body["metadata"]["startup-script"]
+
+
+def test_retry_on_transient_then_success():
+    api, t = _api([_err(503, "unavailable"), (0, b""), _ok()])
+    api.create_node("n", "v4-8", {})
+    assert len(t.requests) == 3  # 503, transport error, then success
+
+
+def test_retries_exhausted_raises_classified():
+    api, t = _api([_err(503, "unavailable")] * 3, max_retries=2)
+    with pytest.raises(TpuApiError) as ei:
+        api.create_node("n", "v4-8", {})
+    assert ei.value.status == 503
+    assert len(t.requests) == 3
+
+
+def test_token_refresh_on_401():
+    tokens = iter(["stale", "fresh"])
+    api, t = _api([_err(401, "unauthorized"), _ok({"state": "READY"})],
+                  token_provider=lambda: next(tokens))
+    assert api.node_state("n") == "READY"
+    assert t.requests[0][2]["Authorization"] == "Bearer stale"
+    assert t.requests[1][2]["Authorization"] == "Bearer fresh"
+
+
+def test_quota_error_mapped_without_burning_retries():
+    api, t = _api([_err(429, "Quota exceeded for TPUS-per-project",
+                        rpc="RESOURCE_EXHAUSTED")])
+    with pytest.raises(QuotaExceededError):
+        api.create_node("n", "v4-8", {})
+    assert len(t.requests) == 1  # a hard no is not retried/slept on
+
+
+def test_stockout_error_mapped_without_burning_retries():
+    api, t = _api([_err(429, "There is no available capacity in zone "
+                        "us-central2-b", rpc="RESOURCE_EXHAUSTED")])
+    with pytest.raises(StockoutError):
+        api.create_node("n", "v4-8", {})
+    assert len(t.requests) == 1
+
+
+def test_persistent_401_reports_401():
+    api, _ = _api([_err(401, "unauthorized")] * 10, max_retries=2)
+    with pytest.raises(TpuApiError) as ei:
+        api.node_state("n")
+    assert ei.value.status == 401
+
+
+def test_async_create_operation_failure_classified():
+    """HTTP 200 create whose long-running operation fails with
+    RESOURCE_EXHAUSTED — the common async stockout mode — must raise the
+    typed error, not report success."""
+    op_running = _ok({"name": "projects/p/locations/z/operations/op1"})
+    op_failed = _ok({"name": "projects/p/locations/z/operations/op1",
+                     "done": True,
+                     "error": {"code": 8, "message": "no capacity"}})
+    api, t = _api([op_running, op_failed], op_poll_s=0.0)
+    with pytest.raises(StockoutError):
+        api.create_node("n", "v4-8", {})
+    assert t.requests[1][0] == "GET"
+    assert "operations/op1" in t.requests[1][1]
+
+
+def test_async_create_operation_success():
+    op_done = _ok({"name": "projects/p/locations/z/operations/op2",
+                   "done": True, "response": {}})
+    api, t = _api([op_done])
+    api.create_node("n", "v4-8", {})  # no raise
+    assert len(t.requests) == 1
+
+
+def test_async_create_still_running_after_budget_is_ok():
+    op_running = _ok({"name": "projects/p/locations/z/operations/op3"})
+    api, t = _api([op_running] * 4, op_polls=2, op_poll_s=0.0)
+    api.create_node("n", "v4-8", {})  # state polling takes over
+    assert len(t.requests) == 3  # create + 2 op polls
+
+
+def test_classify_non_retryable_400():
+    e = classify_error(400, json.dumps(
+        {"error": {"message": "bad acceleratorType"}}).encode())
+    assert type(e) is TpuApiError and e.status == 400
+
+
+def test_delete_is_idempotent_on_404():
+    api, t = _api([_err(404, "not found")])
+    api.delete_node("gone")  # no raise
+    assert t.requests[0][0] == "DELETE"
+
+
+def test_node_state_mapping():
+    api, _ = _api([_ok({"state": "READY"}), _ok({"state": "REPAIRING"}),
+                   _ok({"state": "PREEMPTED"}), _err(404, "nope")])
+    assert api.node_state("a") == "READY"
+    assert api.node_state("b") == "CREATING"  # repairing → still coming up
+    assert api.node_state("c") == "ABSENT"  # preempted slices are dead
+    assert api.node_state("d") == "ABSENT"
+
+
+def test_list_nodes_pagination_and_preempted_filter():
+    page1 = _ok({"nodes": [
+        {"name": "projects/p/locations/z/nodes/ray-a", "state": "READY"},
+        {"name": "projects/p/locations/z/nodes/ray-b", "state": "PREEMPTED"},
+    ], "nextPageToken": "t2"})
+    page2 = _ok({"nodes": [
+        {"name": "projects/p/locations/z/nodes/ray-c", "state": "CREATING"},
+    ]})
+    api, t = _api([page1, page2])
+    assert api.list_nodes() == ["ray-a", "ray-c"]
+    assert "pageToken=t2" in t.requests[1][1]
+
+
+# -- reconciler integration: the REST errors drive the same paths the fake
+# -- does, plus the new launch-failure cooldown ---------------------------
+
+
+class _StubGcs:
+    """Stands in for the Autoscaler's GCS connection."""
+
+    def __init__(self, demands):
+        self.demands = demands
+
+    def send(self, msg):
+        self._last = msg
+
+    def recv(self):
+        t = self._last["type"]
+        if t == "autoscaler_attach":
+            return {"rid": self._last["rid"], "ok": True}
+        return {"rid": self._last["rid"],
+                "demand": {"available_resources": {}, "demands": self.demands,
+                           "pg_demands": [], "node_ids": []}}
+
+
+def _autoscaler(api, demands):
+    a = Autoscaler.__new__(Autoscaler)
+    provider = GceTpuNodeProvider(api)
+    a.provider = provider
+    nt = NodeType(name="tpu-v4-8", resources={"TPU": 4.0, "CPU": 96.0},
+                  labels={"accelerator_type": "v4-8"}, max_nodes=2)
+    a.node_types = {nt.name: nt}
+    a.interval_s = 0.1
+    a.idle_timeout_s = 60.0
+    a.node_startup_grace_s = 60.0
+    a._conn = _StubGcs(demands)
+    import itertools
+    a._rid = itertools.count(1)
+    a._nodes = {}
+    a._launch_times = {}
+    a._idle_since = {}
+    a._type_cooldown = {}
+    a._launch_errors = {}
+    return a
+
+
+def test_reconciler_launches_through_rest_client():
+    api, t = _api([_ok(),                  # create (op with no name: accepted)
+                   _ok({"nodes": []})])    # reap-pass list
+    a = _autoscaler(api, demands=[{"TPU": 4.0}])
+    actions = a.reconcile_once()
+    assert len(actions["launched"]) == 1
+    assert t.requests[0][0] == "POST"
+    assert not actions["launch_failures"]
+
+
+def test_reconciler_stockout_cooldown_then_recovery():
+    stockout = _err(429, "no available capacity", rpc="RESOURCE_EXHAUSTED")
+    api, t = _api([stockout,              # create attempt 1 (hard no, no retry)
+                   _ok({"nodes": []}),    # list (reap pass 1)
+                   _ok({"nodes": []}),    # list (reap pass 2, still cooling)
+                   ])
+    a = _autoscaler(api, demands=[{"TPU": 4.0}])
+    actions = a.reconcile_once()
+    assert actions["launched"] == []
+    assert "tpu-v4-8" in actions["launch_failures"]
+    assert a._cooling_down("tpu-v4-8")
+    # while cooling down: no new create call is attempted
+    n_before = len(t.requests)
+    actions2 = a.reconcile_once()
+    assert actions2["launched"] == []
+    assert all(m != "POST" for m, *_ in t.requests[n_before:])
+    # cooldown expires → next pass launches again
+    a._type_cooldown["tpu-v4-8"] = 0.0
+    t.responses.extend([_ok(), _ok({"nodes": [
+        {"name": "p/l/n/ray-z", "state": "READY"}]})])
+    actions3 = a.reconcile_once()
+    assert len(actions3["launched"]) == 1
+    assert not actions3["launch_failures"]
+
+
+def test_reconciler_quota_uses_longer_cooldown():
+    quota = _err(403, "Quota 'TPUS' exceeded")
+    api, _ = _api([quota] + [_ok({"nodes": []})])
+    a = _autoscaler(api, demands=[{"TPU": 4.0}])
+    a.reconcile_once()
+    import time
+    remaining = a._type_cooldown["tpu-v4-8"] - time.monotonic()
+    assert remaining > 60  # QuotaExceededError.cooldown_s = 120
+
+
+def test_preempted_slice_reaped_and_relaunched():
+    api, t = _api([
+        _ok(),                      # pass 1: create
+        _ok({"nodes": []}),         # pass 1: list — slice already preempted
+        _ok(),                      # pass 2: create replacement
+        _ok({"nodes": []}),         # pass 2: list
+    ])
+    a = _autoscaler(api, demands=[{"TPU": 4.0}])
+    a1 = a.reconcile_once()
+    assert len(a1["launched"]) == 1
+    assert a._nodes == {}  # reaped: preempted slices vanish from list
+    a2 = a.reconcile_once()
+    assert len(a2["launched"]) == 1  # demand still unmet → relaunched
