@@ -26,6 +26,8 @@ from ray_tpu.data.dataset import (
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
 
@@ -52,5 +54,7 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
+    "read_webdataset",
     "from_torch",
 ]

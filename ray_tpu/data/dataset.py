@@ -32,6 +32,7 @@ from ray_tpu.data.datasource import (
     write_csv_block,
     write_json_block,
     write_parquet_block,
+    write_parquet_partitioned,
 )
 from ray_tpu.data.execution import (
     StreamingExecutor,
@@ -68,7 +69,19 @@ class Dataset:
     def map(self, fn: Callable) -> "Dataset":
         return self._append(L.MapRows(fn, kind="map"))
 
-    def filter(self, fn: Callable) -> "Dataset":
+    def filter(self, fn: Callable | None = None, *,
+               expr: str | None = None) -> "Dataset":
+        """Row predicate (callable) or expression string. Expressions
+        (`expr="label >= 3 and split == 'train'"`) vectorize over batches
+        and push down into parquet reads as row-group pruning (reference:
+        Dataset.filter(expr=...) pushes into the read)."""
+        if (fn is None) == (expr is None):
+            raise ValueError("filter() takes exactly one of fn or expr")
+        if expr is not None:
+            from ray_tpu.data.expressions import parse_filter
+
+            parse_filter(expr)  # fail fast on bad grammar at plan time
+            return self._append(L.FilterExpr(expr))
         return self._append(L.MapRows(fn, kind="filter"))
 
     def flat_map(self, fn: Callable) -> "Dataset":
@@ -183,10 +196,9 @@ class Dataset:
         return self.map_batches(drop)
 
     def select_columns(self, cols: list[str]) -> "Dataset":
-        def select(batch):
-            return {k: batch[k] for k in cols}
-
-        return self.map_batches(select)
+        # a real logical op (not an opaque map) so the optimizer can push
+        # the projection into columnar reads as IO pruning
+        return self._append(L.Project(list(cols)))
 
     def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
         def rename(batch):
@@ -256,7 +268,12 @@ class Dataset:
                 if arr.dtype.kind in "OUS":
                     out[k] = list(arr)  # strings/bytes/objects stay python
                     continue
-                t = torch.from_numpy(np.ascontiguousarray(arr))
+                arr = np.ascontiguousarray(arr)
+                if not arr.flags.writeable:
+                    # zero-copy views of read-only shm blocks: hand users a
+                    # writable tensor, not silent UB on in-place mutation
+                    arr = arr.copy()
+                t = torch.from_numpy(arr)
                 if dtypes and k in dtypes:
                     t = t.to(dtypes[k])
                 out[k] = t
@@ -309,8 +326,50 @@ class Dataset:
 
     # ---------------------------------------------------------------- writes
 
-    def write_parquet(self, path: str) -> list[str]:
-        return self._write(path, write_parquet_block)
+    def write_parquet(self, path: str,
+                      partition_cols: list[str] | None = None) -> list[str]:
+        """Parquet files under `path`; with `partition_cols`, hive-style
+        `col=value/` subdirectories whose files omit the partition columns
+        (reference: Dataset.write_parquet(partition_cols=...))."""
+        if not partition_cols:
+            return self._write(path, write_parquet_block)
+        files: list[str] = []
+        for i, b in enumerate(self.iter_blocks()):
+            if BlockAccessor(b).num_rows():
+                files.extend(write_parquet_partitioned(
+                    b, path, i, partition_cols))
+        return files
+
+    def write_tfrecords(self, path: str) -> list[str]:
+        """One .tfrecord file per block; rows become tf.train.Example
+        records (see data/archive.py encode_example)."""
+        import os as _os
+
+        from ray_tpu.data.archive import encode_example, write_tfrecord_file
+
+        files = []
+        for i, b in enumerate(self.iter_blocks()):
+            acc = BlockAccessor(b)
+            if not acc.num_rows():
+                continue
+            _os.makedirs(path, exist_ok=True)
+            out = _os.path.join(path, f"part-{i:05d}.tfrecord")
+            write_tfrecord_file(out, (encode_example(r)
+                                      for r in acc.iter_rows()))
+            files.append(out)
+        return files
+
+    def write_webdataset(self, path: str) -> list[str]:
+        """One .tar shard per block (WebDataset layout)."""
+        from ray_tpu.data.archive import write_webdataset_shard
+
+        files = []
+        for i, b in enumerate(self.iter_blocks()):
+            acc = BlockAccessor(b)
+            if acc.num_rows():
+                files.append(write_webdataset_shard(
+                    path, acc.iter_rows(), index=i))
+        return files
 
     def write_csv(self, path: str) -> list[str]:
         return self._write(path, write_csv_block)
@@ -490,8 +549,40 @@ def from_items(items: list, *, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(ItemsDatasource(items), parallelism))
 
 
-def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
-    return Dataset(L.Read(ParquetDatasource(paths, columns), parallelism))
+def read_parquet(paths, *, columns=None, filter: str | list | None = None,
+                 parallelism: int = -1) -> Dataset:
+    """`columns` prunes at IO; `filter` (expression string or pyarrow DNF
+    tuples) prunes row groups by their statistics before decode."""
+    filters = None
+    if isinstance(filter, str):
+        from ray_tpu.data.expressions import parse_filter
+
+        filters = parse_filter(filter)
+    elif filter:
+        filters = list(filter)
+    return Dataset(L.Read(ParquetDatasource(paths, columns, filters),
+                          parallelism))
+
+
+def read_tfrecords(paths, *, decode="example", verify_crc: bool = True,
+                   parallelism: int = -1) -> Dataset:
+    """Sharded .tfrecord archives; `decode="example"` parses tf.train
+    .Example features, None yields raw {"bytes": ...} rows, or pass a
+    callable (reference: read_api.py read_tfrecords)."""
+    from ray_tpu.data.archive import TFRecordDatasource
+
+    return Dataset(L.Read(TFRecordDatasource(
+        paths, decode=decode, verify_crc=verify_crc), parallelism))
+
+
+def read_webdataset(paths, *, decode: bool = True,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset-style .tar shards: files sharing a basename prefix form
+    one sample (reference: read_api.py read_webdataset)."""
+    from ray_tpu.data.archive import WebDatasetDatasource
+
+    return Dataset(L.Read(WebDatasetDatasource(paths, decode=decode),
+                          parallelism))
 
 
 def read_csv(paths, *, parallelism: int = -1) -> Dataset:

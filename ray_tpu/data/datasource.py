@@ -125,16 +125,26 @@ class FileDatasource(Datasource):
 
 
 class ParquetDatasource(FileDatasource):
-    suffixes = (".parquet",)
+    """Columnar reads with projection (column pruning) and predicate
+    pushdown: `columns` prunes at the IO layer, `filters` (pyarrow DNF
+    conjunction, e.g. [("x", ">", 3)]) prunes whole row groups via their
+    min/max statistics before any decode (reference:
+    data/_internal/datasource/parquet_datasource.py)."""
 
-    def __init__(self, paths, columns=None):
+    suffixes = (".parquet",)
+    supports_projection = True
+    supports_predicates = True
+
+    def __init__(self, paths, columns=None, filters=None):
         super().__init__(paths)
-        self.columns = columns
+        self.columns = list(columns) if columns else None
+        self.filters = list(filters) if filters else None
 
     def read_file(self, path: str) -> list:
         import pyarrow.parquet as pq
 
-        table = pq.read_table(path, columns=self.columns)
+        table = pq.read_table(path, columns=self.columns,
+                              filters=self.filters)
         from ray_tpu.data.block import normalize_block
 
         return [normalize_block(table)]
@@ -262,6 +272,44 @@ def write_json_block(block: Block, path: str, index: int) -> str:
         for row in BlockAccessor(block).iter_rows():
             f.write(json.dumps({k: _json_safe(v) for k, v in row.items()}) + "\n")
     return out
+
+
+def write_parquet_partitioned(block: Block, path: str, index: int,
+                              partition_cols: list[str]) -> list[str]:
+    """Hive-style partitioned write: rows fan out to
+    `col1=val1/col2=val2/part-<index>.parquet`, partition columns dropped
+    from the files (they're encoded in the directory names — reference:
+    Dataset.write_parquet(partition_cols=...))."""
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.block import BlockAccessor, rows_to_block
+
+    groups: dict[tuple, list] = {}
+    for row in BlockAccessor(block).iter_rows():
+        key = tuple(row[c] for c in partition_cols)
+        groups.setdefault(key, []).append(
+            {k: v for k, v in row.items() if k not in partition_cols})
+    out: list[str] = []
+    for key, rows in groups.items():
+        sub = os.path.join(path, *(
+            f"{c}={_part_str(v)}" for c, v in zip(partition_cols, key)))
+        os.makedirs(sub, exist_ok=True)
+        f = os.path.join(sub, f"part-{index:05d}.parquet")
+        pq.write_table(BlockAccessor(rows_to_block(rows)).to_arrow(), f)
+        out.append(f)
+    return out
+
+
+def _part_str(v: Any) -> str:
+    if isinstance(v, np.generic):
+        v = v.item()
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"  # hive's null sentinel
+    from urllib.parse import quote
+
+    # url-encode separators so values like "a/b" or "x=y" stay one
+    # directory component a hive-aware reader parses back losslessly
+    return quote(str(v), safe="")
 
 
 def _json_safe(v: Any):

@@ -182,6 +182,33 @@ def build_stages(ops: list[L.LogicalOp], default_parallelism: int) -> list[Stage
             else:
                 flush()
                 cur = Stage(name=op.kind, transforms=[t])
+        elif isinstance(op, L.Project):
+            cols = list(op.cols)
+            t = _batches_transform(
+                lambda batch, _c=cols: {k: batch[k] for k in _c},
+                None, "numpy", {})
+            if cur is not None and cur.all_to_all is None:
+                cur.name += "->Project"
+                cur.transforms.append(t)
+            else:
+                flush()
+                cur = Stage(name="Project", transforms=[t])
+        elif isinstance(op, L.FilterExpr):
+            from ray_tpu.data.expressions import compile_predicate
+
+            pred = compile_predicate(op.expr)
+
+            def fexpr(batch, _p=pred):
+                m = _p(batch)
+                return {k: np.asarray(v)[m] for k, v in batch.items()}
+
+            t = _batches_transform(fexpr, None, "numpy", {})
+            if cur is not None and cur.all_to_all is None:
+                cur.name += "->FilterExpr"
+                cur.transforms.append(t)
+            else:
+                flush()
+                cur = Stage(name="FilterExpr", transforms=[t])
         elif isinstance(op, L.Limit):
             flush()
             stages.append(Stage(name="Limit", all_to_all=_limit_fn(op.n)))

@@ -62,6 +62,24 @@ class MapRows(LogicalOp):
 
 
 @dataclass
+class Project(LogicalOp):
+    """Column selection — pushes into columnar reads as IO pruning.
+    (reference: _internal/logical/rules/projection_pushdown.py)"""
+
+    cols: list = field(default_factory=list)
+    input: LogicalOp | None = None
+
+
+@dataclass
+class FilterExpr(LogicalOp):
+    """Expression filter (see data/expressions.py) — pushes into parquet
+    reads as row-group pruning when directly above the Read."""
+
+    expr: str = ""
+    input: LogicalOp | None = None
+
+
+@dataclass
 class Limit(LogicalOp):
     n: int
     input: LogicalOp | None = None
@@ -150,9 +168,82 @@ def apply_limit_pushdown(ops: list[LogicalOp]) -> list[LogicalOp]:
     return out
 
 
+def apply_projection_pushdown(ops: list[LogicalOp]) -> list[LogicalOp]:
+    """Project directly above a projection-capable Read becomes IO column
+    pruning; the Project op disappears. Consecutive Projects collapse to
+    the outermost (it sees only what earlier ones kept).
+    (reference: _internal/logical/rules/projection_pushdown.py)"""
+    import copy
+
+    out = list(ops)
+    i = 1
+    while i < len(out):
+        op = out[i]
+        prev = out[i - 1]
+        if (isinstance(op, Project) and isinstance(prev, Read)
+                and getattr(prev.datasource, "supports_projection", False)
+                and prev.datasource.columns is None):
+            # plans share datasource objects across sibling datasets:
+            # mutate a copy, not the original
+            ds = copy.copy(prev.datasource)
+            ds.columns = list(op.cols)
+            prev.datasource = ds
+            out.pop(i)
+            continue
+        if (isinstance(op, Project) and isinstance(prev, Project)
+                and set(op.cols) <= set(prev.cols)):
+            # collapse only when the outer projection is a subset — an
+            # outer col the inner already dropped must still KeyError at
+            # runtime, not silently resurrect from the source
+            out.pop(i - 1)
+            continue
+        i += 1
+    return out
+
+
+def apply_predicate_pushdown(ops: list[LogicalOp]) -> list[LogicalOp]:
+    """FilterExpr directly above a predicate-capable Read prunes row
+    groups at the IO layer instead of running as a stage."""
+    import copy
+
+    from ray_tpu.data.expressions import parse_filter
+
+    out = list(ops)
+    i = 1
+    while i < len(out):
+        op = out[i]
+        prev = out[i - 1]
+        if (isinstance(op, FilterExpr) and isinstance(prev, Read)
+                and getattr(prev.datasource, "supports_predicates", False)):
+            conj = parse_filter(op.expr)
+            cols = prev.datasource.columns
+            if cols is not None and not all(c in cols for c in
+                                            (t[0] for t in conj)):
+                # a projection already dropped a filter column: keep the
+                # stage so the user still sees the KeyError they wrote
+                i += 1
+                continue
+            ds = copy.copy(prev.datasource)
+            ds.filters = (list(ds.filters) + conj) if ds.filters else conj
+            prev.datasource = ds
+            out.pop(i)
+            continue
+        i += 1
+    return out
+
+
 def optimize(ops: list[LogicalOp]) -> list[LogicalOp]:
     # operate on copies: plans are shared between sibling datasets derived
     # from the same source, and rules mutate ops (e.g. Read.limit)
     import copy
 
-    return apply_limit_pushdown([copy.copy(op) for op in ops])
+    out = [copy.copy(op) for op in ops]
+    # pushdowns can unlock each other (a pushed filter makes a Project
+    # adjacent to the Read and vice versa): iterate to fixpoint
+    while True:
+        n = len(out)
+        out = apply_projection_pushdown(out)
+        out = apply_predicate_pushdown(out)
+        if len(out) == n:
+            break
+    return apply_limit_pushdown(out)

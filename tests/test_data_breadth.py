@@ -1,0 +1,340 @@
+"""Round-4 data breadth (VERDICT item 5): parquet projection + predicate
+pushdown, sharded-archive readers (TFRecord / WebDataset), partitioned
+writes, and the image pipeline feeding iter_jax_batches.
+
+(reference: data/_internal/datasource/{parquet,tfrecords,webdataset}
+_datasource.py, _internal/logical/rules/projection_pushdown.py)
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data import logical as L
+from ray_tpu.data.expressions import compile_predicate, parse_filter
+
+
+@pytest.fixture(scope="module", autouse=True)
+def session():
+    ray_tpu.init(num_cpus=4, num_workers=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def pq_dir(tmp_path_factory):
+    """Two files, multiple row groups each, columns id/val/tag."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path_factory.mktemp("pq")
+    for f in range(2):
+        ids = np.arange(f * 100, (f + 1) * 100)
+        t = pa.table({"id": ids, "val": ids * 2,
+                      "tag": ["even" if i % 2 == 0 else "odd" for i in ids]})
+        pq.write_table(t, d / f"f{f}.parquet", row_group_size=25)
+    return str(d)
+
+
+# ----------------------------------------------------------- expressions
+
+
+def test_parse_filter_grammar():
+    assert parse_filter("a > 3") == [("a", ">", 3)]
+    assert parse_filter("a >= 3 and b == 'x'") == [("a", ">=", 3),
+                                                   ("b", "==", "x")]
+    assert parse_filter("3 < a") == [("a", ">", 3)]  # flipped
+    assert parse_filter("tag in ('a', 'b')") == [("tag", "in", ("a", "b"))]
+    for bad in ("a > b", "f(x) > 1", "a > 1 or b > 2", "__import__('os')",
+                "a > 1 > 2"):
+        with pytest.raises(ValueError):
+            parse_filter(bad)
+
+
+def test_compile_predicate_mask():
+    m = compile_predicate("x >= 2 and tag != 'skip'")
+    out = m({"x": np.array([1, 2, 3]), "tag": np.array(["a", "skip", "b"])})
+    assert out.tolist() == [False, False, True]
+
+
+# ------------------------------------------------- parquet pushdown rules
+
+
+def test_projection_pushed_into_parquet_read(pq_dir):
+    ds = rd.read_parquet(pq_dir).select_columns(["id"])
+    ops = L.optimize(ds._op.chain())
+    # the Project op disappeared into the read's IO pruning
+    assert [type(o).__name__ for o in ops] == ["Read"]
+    assert ops[0].datasource.columns == ["id"]
+    rows = ds.take_all()
+    assert set(rows[0]) == {"id"}
+    assert len(rows) == 200
+
+
+def test_predicate_pushed_into_parquet_read(pq_dir):
+    ds = rd.read_parquet(pq_dir).filter(expr="id >= 150")
+    ops = L.optimize(ds._op.chain())
+    assert [type(o).__name__ for o in ops] == ["Read"]
+    assert ops[0].datasource.filters == [("id", ">=", 150)]
+    assert ds.count() == 50
+    physical = ds.stats().splitlines()[-1]
+    assert "FilterExpr" not in physical  # no runtime filter stage
+
+
+def test_read_parquet_filter_prunes_row_groups(pq_dir):
+    """The pushed filter reads strictly fewer rows than the files hold —
+    row groups whose stats exclude the predicate never decode."""
+    import pyarrow.parquet as pq
+
+    f = sorted(glob.glob(os.path.join(pq_dir, "*.parquet")))[0]
+    # row_group_size=25 → groups [0,25) [25,50) [50,75) [75,100): id >= 90
+    # statistically excludes the first three groups
+    t = pq.read_table(f, filters=[("id", ">=", 90)])
+    assert t.num_rows == 10  # pruned read, not post-filter of 100
+    ds = rd.read_parquet(pq_dir, filter="id >= 190")
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(190, 200))
+
+
+def test_pushdown_not_applied_when_column_projected_away(pq_dir):
+    ds = (rd.read_parquet(pq_dir).select_columns(["val"])
+          .filter(expr="id > 5"))
+    ops = L.optimize(ds._op.chain())
+    # the filter column was projected away: the stage must stay so the
+    # user still sees their KeyError
+    assert any(isinstance(o, L.FilterExpr) for o in ops)
+    with pytest.raises(Exception):
+        ds.take_all()
+
+
+def test_filter_expr_runs_as_stage_for_non_parquet():
+    ds = rd.from_items([{"x": i} for i in range(10)]).filter(expr="x >= 7")
+    assert sorted(r["x"] for r in ds.take_all()) == [7, 8, 9]
+
+
+def test_filter_validates_args():
+    ds = rd.range(3)
+    with pytest.raises(ValueError):
+        ds.filter()
+    with pytest.raises(ValueError):
+        ds.filter(lambda r: True, expr="x > 1")
+    with pytest.raises(ValueError):
+        ds.filter(expr="__import__('os').system('x') > 1")
+
+
+def test_projection_stage_for_non_columnar_source():
+    ds = rd.from_items([{"a": 1, "b": 2}] * 4).select_columns(["a"])
+    rows = ds.take_all()
+    assert all(set(r) == {"a"} for r in rows)
+
+
+def test_sibling_datasets_not_corrupted_by_pushdown(pq_dir):
+    base = rd.read_parquet(pq_dir)
+    narrow = base.select_columns(["id"])
+    assert set(narrow.take(1)[0]) == {"id"}
+    # the shared datasource must not have been mutated by narrow's plan
+    assert set(base.take(1)[0]) == {"id", "val", "tag"}
+
+
+# ------------------------------------------------------ tfrecord archives
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    rows = [{"label": i, "name": f"s{i}", "score": [0.5, float(i)]}
+            for i in range(20)]
+    files = rd.from_items(rows).write_tfrecords(str(tmp_path / "tfr"))
+    assert files and all(f.endswith(".tfrecord") for f in files)
+    back = rd.read_tfrecords(str(tmp_path / "tfr")).take_all()
+    by_label = {int(r["label"]): r for r in back}
+    assert sorted(by_label) == list(range(20))
+    assert by_label[3]["name"] == b"s3"  # bytes features stay bytes
+    assert by_label[3]["score"] == pytest.approx([0.5, 3.0])
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    from ray_tpu.data.archive import iter_tfrecords, write_tfrecord_file
+
+    p = str(tmp_path / "x.tfrecord")
+    write_tfrecord_file(p, [b"hello world"])
+    blob = bytearray(open(p, "rb").read())
+    blob[14] ^= 0xFF  # flip a payload byte
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="crc"):
+        list(iter_tfrecords(p))
+
+
+def test_tfrecord_raw_and_callable_decode(tmp_path):
+    from ray_tpu.data.archive import write_tfrecord_file
+
+    p = str(tmp_path / "r.tfrecord")
+    write_tfrecord_file(p, [b"a", b"bb"])
+    raw = rd.read_tfrecords(p, decode=None).take_all()
+    assert [r["bytes"] for r in raw] == [b"a", b"bb"]
+    sized = rd.read_tfrecords(p, decode=lambda b: {"n": len(b)}).take_all()
+    assert sorted(r["n"] for r in sized) == [1, 2]
+
+
+# ---------------------------------------------------- webdataset archives
+
+
+def test_webdataset_roundtrip_and_grouping(tmp_path):
+    rows = [{"__key__": f"{i:04d}", "npy": np.full((4, 4), i, np.uint8),
+             "cls": i, "txt": f"caption {i}"} for i in range(12)]
+    files = rd.from_items(rows).write_webdataset(str(tmp_path / "wds"))
+    assert files and all(f.endswith(".tar") for f in files)
+    back = rd.read_webdataset(str(tmp_path / "wds")).take_all()
+    assert len(back) == 12
+    s = {r["__key__"]: r for r in back}["0007"]
+    assert s["cls"] == 7
+    assert s["txt"] == "caption 7"
+    assert np.array_equal(s["npy"], np.full((4, 4), 7, np.uint8))
+
+
+def test_webdataset_undecoded_bytes(tmp_path):
+    rows = [{"__key__": "k0", "txt": "hi"}]
+    rd.from_items(rows).write_webdataset(str(tmp_path / "w2"))
+    back = rd.read_webdataset(str(tmp_path / "w2"), decode=False).take_all()
+    assert back[0]["txt"] == b"hi"
+
+
+# ----------------------------------------------------- partitioned writes
+
+
+def test_write_parquet_partitioned(tmp_path):
+    rows = [{"split": "train" if i % 3 else "test", "id": i}
+            for i in range(30)]
+    out = str(tmp_path / "part")
+    files = rd.from_items(rows).write_parquet(out, partition_cols=["split"])
+    assert files
+    assert os.path.isdir(os.path.join(out, "split=train"))
+    assert os.path.isdir(os.path.join(out, "split=test"))
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(os.path.join(out, "split=test"))
+    assert set(t.column_names) == {"id"}  # partition col lives in the path
+    assert sorted(t.column("id").to_pylist()) == [i for i in range(30)
+                                                  if i % 3 == 0]
+
+
+# ------------------------------------------- image pipeline (north star 3)
+
+
+def test_sharded_archive_image_pipeline_to_jax(tmp_path):
+    """BASELINE config 3 shape: sharded archives → decode/normalize →
+    iter_jax_batches with device prefetch."""
+    rows = [{"__key__": f"{i:05d}",
+             "npy": (np.ones((8, 8, 3), np.uint8) * (i % 255)),
+             "cls": i % 10} for i in range(64)]
+    shards = rd.from_items(rows).write_webdataset(str(tmp_path / "imgs"))
+    assert shards
+
+    def normalize(batch):
+        imgs = np.stack(list(batch["npy"])).astype(np.float32) / 255.0
+        return {"image": imgs, "label": np.asarray(batch["cls"])}
+
+    ds = rd.read_webdataset(str(tmp_path / "imgs")).map_batches(normalize)
+    n = 0
+    for batch in ds.iter_jax_batches(batch_size=16, prefetch=2,
+                                     drop_last=True):
+        assert batch["image"].shape == (16, 8, 8, 3)
+        assert str(batch["image"].dtype) == "float32"
+        n += batch["label"].shape[0]
+    assert n == 64
+
+
+def test_iter_torch_batches_writable(tmp_path):
+    """VERDICT weak-8: tensors handed out must be writable (no silent UB
+    UserWarning on read-only shm-backed arrays)."""
+    import warnings
+
+    ds = rd.range(100)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any torch non-writable warning fails
+        for b in ds.iter_torch_batches(batch_size=50):
+            b["id"] += 1  # in-place mutation must be safe
+
+
+# ------------------------------------------- review-found edge cases (r4)
+
+
+def test_consecutive_projects_keep_error_semantics():
+    ds = (rd.from_items([{"a": 1, "b": 2}] * 3)
+          .select_columns(["a"]).select_columns(["b"]))
+    with pytest.raises(Exception):  # 'b' was already dropped
+        ds.take_all()
+    narrowing = (rd.from_items([{"a": 1, "b": 2}] * 3)
+                 .select_columns(["a", "b"]).select_columns(["a"]))
+    assert all(set(r) == {"a"} for r in narrowing.take_all())
+
+
+def test_webdataset_directory_keys_stay_distinct(tmp_path):
+    import io
+    import tarfile
+
+    p = tmp_path / "dirs.tar"
+    with tarfile.open(p, "w") as tf:
+        for d, v in (("train", 1), ("val", 2)):
+            for ext, data in (("cls", str(v).encode()),):
+                info = tarfile.TarInfo(f"{d}/0001.{ext}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    rows = rd.read_webdataset(str(p)).take_all()
+    assert len(rows) == 2  # train/0001 and val/0001 are different samples
+    by_key = {r["__key__"]: r["cls"] for r in rows}
+    assert by_key == {"train/0001": 1, "val/0001": 2}
+
+
+def test_tfrecord_optional_features_pad_to_none(tmp_path):
+    from ray_tpu.data.archive import encode_example, write_tfrecord_file
+
+    p = str(tmp_path / "opt.tfrecord")
+    write_tfrecord_file(p, [encode_example({"a": 1, "extra": 2.5}),
+                            encode_example({"a": 2})])
+    rows = rd.read_tfrecords(p).take_all()
+    by_a = {int(r["a"]): r for r in rows}
+    assert by_a[1]["extra"] == pytest.approx(2.5)
+    assert by_a[2]["extra"] is None  # optional feature padded, not crashed
+
+
+def test_example_parser_accepts_unpacked_fields():
+    from ray_tpu.data.archive import parse_example
+
+    # hand-build an Example with UNPACKED Int64List (one varint entry per
+    # element, wire type 0) and unpacked FloatList (fixed32 entries)
+    import struct
+
+    def varint(n):
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    def ld(field, payload):
+        return varint(field << 3 | 2) + varint(len(payload)) + payload
+
+    unpacked_ints = varint(1 << 3 | 0) + varint(7) + varint(1 << 3 | 0) + varint(9)
+    int_feature = ld(3, unpacked_ints)
+    unpacked_floats = (varint(1 << 3 | 5) + struct.pack("<f", 0.5)
+                       + varint(1 << 3 | 5) + struct.pack("<f", 1.5))
+    float_feature = ld(2, unpacked_floats)
+    entries = (ld(1, ld(1, b"ints") + ld(2, int_feature))
+               + ld(1, ld(1, b"floats") + ld(2, float_feature)))
+    rec = ld(1, entries)
+    row = parse_example(rec)
+    assert row["ints"] == [7, 9]
+    assert row["floats"] == pytest.approx([0.5, 1.5])
+
+
+def test_partition_values_sanitized(tmp_path):
+    rows = [{"tag": "a/b", "id": 1}, {"tag": None, "id": 2}]
+    out = str(tmp_path / "sane")
+    rd.from_items(rows).write_parquet(out, partition_cols=["tag"])
+    dirs = sorted(os.listdir(out))
+    assert "tag=a%2Fb" in dirs  # '/' encoded, one component
+    assert "tag=__HIVE_DEFAULT_PARTITION__" in dirs
