@@ -146,6 +146,7 @@ class TPUEngine:
                  kv_layout: str = "slot", page_size: int = 64,
                  num_pages: int | None = None,
                  max_prefills_per_step: int = 2,
+                 enable_prefix_cache: bool = False,
                  mesh=None):
         self.cfg = cfg
         self.max_len = max_len or cfg.max_seq_len
@@ -199,7 +200,26 @@ class TPUEngine:
                 cfg, max_slots, self.max_len, self.num_pages, page_size)
             self._free_pages = list(range(1, self.num_pages))  # 0 = scratch
             self._slot_pages: dict[int, list] = {}
+            # hash-block prefix cache over the SAME page pool (reference
+            # capability: vLLM automatic prefix caching): chain-hashed
+            # full prompt blocks map to pages still resident in HBM; a
+            # repeated prefix skips its share of prefill compute entirely.
+            self.enable_prefix_cache = bool(enable_prefix_cache)
+            import collections as _collections
+
+            self._prefix_cache: _collections.OrderedDict = \
+                _collections.OrderedDict()       # block-chain hash → page id
+            self._page_refs: dict[int, int] = {}  # shared page → live users
+            self._page_hash: dict[int, bytes] = {}  # reverse map (eviction)
+            self._slot_shared: dict[int, list] = {}  # slot → shared pages
+            self.prefix_hits = 0       # requests that reused ≥1 block
+            self.prefix_misses = 0
+            self.prefix_tokens_reused = 0
         else:
+            self.enable_prefix_cache = False
+            if enable_prefix_cache:
+                raise ValueError(
+                    "enable_prefix_cache requires kv_layout='paged'")
             self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
         if mesh is not None:
             self.state = _shard_state_tp(self.state, mesh)
@@ -237,6 +257,7 @@ class TPUEngine:
                    page_size=ek.get("page_size", 64),
                    num_pages=ek.get("num_pages"),
                    max_prefills_per_step=ek.get("max_prefills_per_step", 2),
+                   enable_prefix_cache=ek.get("enable_prefix_cache", False),
                    mesh=ek.get("mesh"))
 
     def _check_alive(self):
@@ -344,6 +365,102 @@ class TPUEngine:
         last_pos = min(prompt_len + max_tokens, self.max_len - 1)
         return max(bucket // self.page_size, last_pos // self.page_size + 1)
 
+    # ---------------------------------------------------- prefix cache (paged)
+
+    def _block_hashes(self, tokens: list) -> list:
+        """Chain hashes of the prompt's FULL page_size blocks: h_i commits
+        to every token before the block too, so a hit means the whole
+        prefix through block i is identical."""
+        import hashlib
+
+        out = []
+        h = b""
+        P = self.page_size
+        for i in range(len(tokens) // P):
+            blk = np.asarray(tokens[i * P:(i + 1) * P], np.int32).tobytes()
+            h = hashlib.sha1(h + blk).digest()
+            out.append(h)
+        return out
+
+    def _reclaimable_pages(self) -> int:
+        # called from stats() on arbitrary threads while the scheduler
+        # mutates the cache: snapshot first, tolerate a racing resize
+        for _ in range(4):
+            try:
+                pages = list(self._prefix_cache.values())
+                break
+            except RuntimeError:
+                continue
+        else:
+            return 0
+        refs = self._page_refs
+        return sum(1 for p in pages if refs.get(p, 0) == 0)
+
+    def _available_pages(self) -> int:
+        n = len(self._free_pages)
+        if self.enable_prefix_cache:
+            n += self._reclaimable_pages()
+        return n
+
+    def _alloc_pages(self, need: int) -> list | None:
+        """Take pages from the free list, evicting zero-ref cached blocks
+        (LRU first) when the list runs short. None = infeasible now."""
+        if need > self._available_pages():
+            return None
+        if need > len(self._free_pages):
+            for h in list(self._prefix_cache):
+                if len(self._free_pages) >= need:
+                    break
+                p = self._prefix_cache[h]
+                if self._page_refs.get(p, 0) == 0:
+                    del self._prefix_cache[h]
+                    self._page_refs.pop(p, None)
+                    self._page_hash.pop(p, None)
+                    self._free_pages.append(p)
+        return [self._free_pages.pop() for _ in range(need)]
+
+    def _match_prefix(self, tokens: list, hashes: list) -> int:
+        """Longest run of leading cached blocks usable for reuse. The block
+        holding the LAST prompt token is never reused — at least one real
+        token must go through prefill to produce the sampling logits."""
+        usable = (len(tokens) - 1) // self.page_size
+        n_pre = 0
+        for i in range(min(usable, len(hashes))):
+            p = self._prefix_cache.get(hashes[i])
+            if p is None:
+                break
+            self._prefix_cache.move_to_end(hashes[i])  # LRU touch
+            n_pre += 1
+        return n_pre
+
+    def _register_blocks(self, slot: int, tokens: list, hashes: list,
+                         n_pre: int, priv_pages: list) -> None:
+        """Make this request's freshly-computed full blocks available to
+        future prompts: their pages move from private (freed on release)
+        to shared (ref-counted, cached)."""
+        n = len(tokens)
+        shared = self._slot_shared.setdefault(slot, [])
+        still_private = list(priv_pages)
+        for i in range(n_pre, n // self.page_size):
+            if hashes[i] in self._prefix_cache:
+                continue  # someone registered it first; keep ours private
+            page = priv_pages[i - n_pre]
+            self._prefix_cache[hashes[i]] = page
+            self._page_hash[page] = hashes[i]
+            self._page_refs[page] = self._page_refs.get(page, 0) + 1
+            shared.append(page)
+            still_private.remove(page)
+        self._slot_pages[slot] = still_private
+
+    def _release_shared(self, slot: int) -> None:
+        for p in self._slot_shared.pop(slot, ()):
+            left = self._page_refs.get(p, 0) - 1
+            if left <= 0:
+                self._page_refs[p] = 0  # reclaimable; stays cached until
+                # eviction needs the page (or a new request re-refs it)
+            else:
+                self._page_refs[p] = left
+
     def _set_row_sampling(self, slot: int, params: SamplingParams):
         self._temps = self._temps.at[slot].set(params.temperature)
         self._topks = self._topks.at[slot].set(params.top_k)
@@ -354,9 +471,16 @@ class TPUEngine:
         if self.kv_layout == "paged":
             bucket = kv["k"].shape[1]
             need = self._pages_needed(length, bucket, req.params.max_tokens)
-            if need > len(self._free_pages):
-                return False
-            pages = [self._free_pages.pop() for _ in range(need)]
+            if self.enable_prefix_cache:
+                # may evict zero-ref cached blocks to make room
+                alloc = self._alloc_pages(need)
+                if alloc is None:
+                    return False
+                pages = alloc
+            else:
+                if need > len(self._free_pages):
+                    return False
+                pages = [self._free_pages.pop() for _ in range(need)]
             self._slot_pages[slot] = pages
             padded_pages = np.zeros((self.max_pages_per_seq,), np.int32)
             padded_pages[:need] = pages
@@ -405,6 +529,15 @@ class TPUEngine:
                     return  # page pressure: stop admitting this round
                 admitted += 1
                 continue
+            if self.kv_layout == "paged" and self.enable_prefix_cache:
+                first_id = self._admit_cached(req, slot)
+                if first_id is None:
+                    self._free.append(slot)
+                    self._backlog.append(req)
+                    return  # page pressure: stop admitting this round
+                admitted += 1
+                self._emit(req, first_id)
+                continue
             n = len(req.tokens)
             bucket = self._bucket(n)
             if self.kv_layout == "paged":
@@ -429,6 +562,77 @@ class TPUEngine:
             admitted += 1
             self._emit(req, first_id)
 
+    def _admit_cached(self, req: _Request, slot: int):
+        """Paged admission with hash-block prefix reuse. Returns the first
+        sampled token id, or None when the page pool can't host the
+        sequence right now (caller backlogs)."""
+        tokens = req.tokens
+        n = len(tokens)
+        P = self.page_size
+        hashes = self._block_hashes(tokens)
+        n_pre = self._match_prefix(tokens, hashes)
+        # shrink the reused prefix if suffix-bucket roundup would overflow
+        # the static block table
+        while n_pre > 0 and (n_pre + self._bucket(n - n_pre * P) // P
+                             > self.max_pages_per_seq):
+            n_pre -= 1
+        pre_len = n_pre * P
+        suffix = tokens[pre_len:]
+        suf_bucket = self._bucket(len(suffix))
+        last_pos = min(n + req.params.max_tokens, self.max_len - 1)
+        total_pages = max(n_pre + suf_bucket // P, last_pos // P + 1)
+        # pin the matched pages BEFORE allocating: _alloc_pages evicts
+        # zero-ref cached blocks, and the ones we just matched must not be
+        # among them
+        pre_pages = [self._prefix_cache[hashes[i]] for i in range(n_pre)]
+        for p in pre_pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        priv = self._alloc_pages(total_pages - n_pre)
+        if priv is None:
+            for p in pre_pages:  # unpin; the request is backlogged
+                self._page_refs[p] = self._page_refs.get(p, 1) - 1
+            return None
+        self._slot_shared[slot] = list(pre_pages)
+        if n_pre:
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += pre_len
+        else:
+            self.prefix_misses += 1
+        padded = np.zeros((1, suf_bucket), np.int32)
+        padded[0, :len(suffix)] = suffix
+        if n_pre:
+            # pad the shared-page id list to a power of two so compile
+            # count stays O(log(max_pages) × buckets); tail ids point at
+            # scratch page 0, masked out by prefix_len
+            npad = 1
+            while npad < n_pre:
+                npad *= 2
+            padded_ids = np.zeros((npad,), np.int32)
+            padded_ids[:n_pre] = pre_pages
+            k_pre, v_pre = self._dp.gather_prefix_pages(
+                self.state["kp"], self.state["vp"], jnp.asarray(padded_ids))
+            logits, kv = self._dp.prefill_with_prefix(
+                self.params, jnp.asarray(padded), k_pre, v_pre,
+                jnp.int32(pre_len), jnp.int32(len(suffix)), self.cfg)
+        else:
+            logits, kv = decoding.prefill(
+                self.params, jnp.asarray(padded), jnp.int32(len(suffix)),
+                self.cfg)
+        self.key, sub = jax.random.split(self.key)
+        first = decoding.sample(logits[None, :], sub,
+                                req.params.temperature, req.params.top_k)
+        block_row = np.zeros((self.max_pages_per_seq,), np.int32)
+        block_row[:n_pre] = pre_pages
+        block_row[n_pre:n_pre + len(priv)] = priv
+        suf_pages = np.asarray(priv[:suf_bucket // P], np.int32)
+        self.state = self._dp.insert_sequence_paged_prefix(
+            self.state, slot, kv, jnp.asarray(suf_pages),
+            jnp.asarray(block_row), jnp.int32(n), first[0], self.cfg)
+        self._set_row_sampling(slot, req.params)
+        self._by_slot[slot] = req
+        self._register_blocks(slot, tokens, hashes, n_pre, priv)
+        return int(first[0])
+
     def _emit(self, req: _Request, token_id: int):
         req.generated += 1
         stops = set(req.params.stop_token_ids)
@@ -439,6 +643,8 @@ class TPUEngine:
             if self.kv_layout == "paged":
                 self.state = self._dp.release_slot_paged(self.state, req.slot)
                 self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
+                if self.enable_prefix_cache:
+                    self._release_shared(req.slot)
             else:
                 self.state = decoding.release_slot(self.state, req.slot)
             self._free.append(req.slot)
@@ -488,4 +694,13 @@ class TPUEngine:
             out["free_pages"] = len(self._free_pages)
             out["num_pages"] = self.num_pages
             out["page_size"] = self.page_size
+            if self.enable_prefix_cache:
+                hits, misses = self.prefix_hits, self.prefix_misses
+                out["prefix_cache"] = {
+                    "hits": hits, "misses": misses,
+                    "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+                    "tokens_reused": self.prefix_tokens_reused,
+                    "cached_blocks": len(self._prefix_cache),
+                    "reclaimable_pages": self._reclaimable_pages(),
+                }
         return out

@@ -137,3 +137,112 @@ def release_slot_paged(state, slot):
     state["active"] = state["active"].at[slot].set(False)
     state["length"] = state["length"].at[slot].set(0)
     return state
+
+
+# --------------------------------------------------- prefix-cache support
+# (reference capability: vLLM automatic prefix caching / hash-block reuse;
+# TPU design: cached blocks stay IN the page pool and are gathered into a
+# dense bucketed array for the continuation prefill — static shapes, no
+# custom kernels.)
+
+
+@jax.jit
+def gather_prefix_pages(kp, vp, page_ids):
+    """Collect cached prefix KV out of the page pool: page_ids [n] →
+    k, v [L, n*P, Hkv, Dh] (n static via the id vector's shape; unused
+    tail ids point at scratch page 0 and are masked by prefix_len)."""
+    L, _, P, Hkv, Dh = kp.shape
+    n = page_ids.shape[0]
+    k = kp[:, page_ids].reshape(L, n * P, Hkv, Dh)
+    v = vp[:, page_ids].reshape(L, n * P, Hkv, Dh)
+    return k, v
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_with_prefix(params, tokens, prefix_k, prefix_v, prefix_len,
+                        length, cfg: TransformerConfig):
+    """Continuation prefill: run ONLY the suffix tokens [1, Ts] (padded
+    bucket; true count `length`) attending over a cached prefix KV
+    [L, Tp, Hkv, Dh] (valid first `prefix_len` positions — cached K is
+    already roped at its absolute positions) plus the causal suffix.
+
+    Returns (logits at the last suffix token [V],
+             suffix kv {k, v: [L, Ts, Hkv, Dh]}).
+    Compilation count is bounded by #prefix_buckets × #suffix_buckets.
+    """
+    dt = cfg.dtype
+    B, Ts = tokens.shape
+    Tp = prefix_k.shape[1]
+    x = params["embed"].astype(dt)[tokens]
+    pos_suffix = prefix_len + jnp.arange(Ts)                     # [Ts]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos_suffix][None]
+    cos, sin = _rope(cfg)
+
+    # [Ts, Tp + Ts]: every suffix query sees the real prefix positions and
+    # its causal suffix slice
+    prefix_mask = jnp.broadcast_to(
+        jnp.arange(Tp)[None, :] < prefix_len, (Ts, Tp))
+    causal = jnp.arange(Ts)[:, None] >= jnp.arange(Ts)[None, :]
+    mask = jnp.concatenate([prefix_mask, causal], axis=1)
+
+    def block(h, layer_in):
+        layer_p, pk, pv = layer_in                    # [Tp, Hkv, Dh] each
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)  # [1, Ts, H, Dh]
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin, positions=pos_suffix)
+            k = ops.apply_rope(k, cos, sin, positions=pos_suffix)
+        k_all = jnp.concatenate([pk[None].astype(dt), k], axis=1)
+        v_all = jnp.concatenate([pv[None].astype(dt), v], axis=1)
+        G = cfg.n_heads // cfg.kv_heads
+        qh = q.reshape(B, Ts, cfg.kv_heads, G, cfg.head_dim)
+        scores = jnp.einsum("btkgd,bskd->btkgs", qh,
+                            k_all.astype(dt)) / (cfg.head_dim ** 0.5)
+        scores = jnp.where(mask[None, :, None, None, :],
+                           scores.astype(jnp.float32), -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("btkgs,bskd->btkgd", w, v_all.astype(dt))
+        out = out.reshape(B, Ts, cfg.n_heads, cfg.head_dim)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return h, (k[0], v[0])
+
+    x, kv = jax.lax.scan(block, x, (params["layers"], prefix_k, prefix_v))
+    x = _norm(x, params["final_norm"], cfg)
+    last = x[0, length - 1]
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"].astype(dt).T
+    else:
+        logits = last @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), {"k": kv[0], "v": kv[1]}
+
+
+@functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
+def insert_sequence_paged_prefix(state, slot, kv, suffix_pages, block_row,
+                                 length, first_token, cfg: TransformerConfig):
+    """Like insert_sequence_paged, but only the SUFFIX KV is written (the
+    prefix already lives in shared cache pages): `suffix_pages` [ns] are
+    the pages receiving the suffix bucket, `block_row`
+    [max_pages_per_seq] is the full table (shared prefix ids + private
+    ids + 0-padding)."""
+    P = state["kp"].shape[2]
+    L, T = kv["k"].shape[0], kv["k"].shape[1]
+    n = T // P  # static: T is the suffix bucket
+    Hkv, Dh = kv["k"].shape[2], kv["k"].shape[3]
+    k_pages = kv["k"].reshape(L, n, P, Hkv, Dh)
+    v_pages = kv["v"].reshape(L, n, P, Hkv, Dh)
+    state = dict(state)
+    state["kp"] = state["kp"].at[:, suffix_pages[:n]].set(
+        k_pages.astype(state["kp"].dtype))
+    state["vp"] = state["vp"].at[:, suffix_pages[:n]].set(
+        v_pages.astype(state["vp"].dtype))
+    state["block"] = jax.lax.dynamic_update_slice_in_dim(
+        state["block"], block_row[None], slot, axis=0)
+    state["length"] = state["length"].at[slot].set(length)
+    state["last_token"] = state["last_token"].at[slot].set(first_token)
+    state["active"] = state["active"].at[slot].set(True)
+    return state
