@@ -114,6 +114,9 @@ def _run_child(platform: str, timeout: float) -> tuple[dict | None, str]:
     env["RAY_TPU_BENCH_CHILD"] = platform
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+        # a wedged device pool blocks even `import jax` while the relay
+        # env var is present — the CPU fallback must not dial it
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            capture_output=True, text=True, timeout=timeout,

@@ -6,18 +6,23 @@ is a jitted XLA program that scales by mesh sharding instead of torch DDP.)
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.replay import ReplayBuffer
-from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_vec_env
+from ray_tpu.rllib.env import (CartPoleVecEnv, PendulumVecEnv, VectorEnv,
+                               make_vec_env)
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.learner import Learner, compute_gae
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
     "BC",
     "BCConfig",
     "DQN",
@@ -26,11 +31,14 @@ __all__ = [
     "IMPALAConfig",
     "ReplayBuffer",
     "CartPoleVecEnv",
+    "PendulumVecEnv",
     "EnvRunner",
     "EnvRunnerGroup",
     "Learner",
     "PPO",
     "PPOConfig",
+    "SAC",
+    "SACConfig",
     "VectorEnv",
     "compute_gae",
     "make_vec_env",

@@ -65,7 +65,11 @@ def _vtrace(target_logp, behavior_logp, rewards, values, dones, last_value,
 
 
 class ImpalaLearner:
-    """Jitted V-trace actor-critic update over time-major batches."""
+    """Jitted V-trace actor-critic update over time-major batches.
+
+    The loss is a pluggable method (`_loss`): APPO reuses ALL of the
+    init/optimizer/jit/update/weights plumbing here and overrides only the
+    surrogate (appo.py)."""
 
     def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
                  hidden=(64, 64), vf_coef: float = 0.5, ent_coef: float = 0.01,
@@ -77,44 +81,62 @@ class ImpalaLearner:
         from ray_tpu.rllib import rl_module
 
         self._rl = rl_module
+        self.gamma, self.rho_bar, self.c_bar = gamma, rho_bar, c_bar
+        self.vf_coef, self.ent_coef = vf_coef, ent_coef
         self.params = rl_module.init(jax.random.PRNGKey(seed), obs_dim,
                                      num_actions, hidden=tuple(hidden))
+        # target/anchor params: unused by IMPALA's loss, refreshed by APPO
+        self.target_params = self.params
         self.opt = optax.chain(optax.clip_by_global_norm(40.0),
                                optax.adam(lr))
         self.opt_state = self.opt.init(self.params)
         self.version = 0
+        loss = self._loss
 
         @functools.partial(jax.jit)
-        def update(params, opt_state, batch):
-            import jax.numpy as jnp
-
-            def loss_fn(p):
-                T, N = batch["rewards"].shape
-                obs = batch["obs"].reshape(T * N, -1)
-                logits, values = rl_module.forward(p, obs)
-                logp_all = jax.nn.log_softmax(logits)
-                target_logp = logp_all[
-                    jnp.arange(T * N), batch["actions"].reshape(T * N)]
-                target_logp = target_logp.reshape(T, N)
-                values = values.reshape(T, N)
-                _, last_value = rl_module.forward(p, batch["bootstrap_obs"])
-                vs, pg_adv = _vtrace(
-                    target_logp, batch["behavior_logp"], batch["rewards"],
-                    values, batch["dones"], last_value,
-                    gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
-                pg_loss = -jnp.mean(target_logp * pg_adv)
-                vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
-                ent = -jnp.mean(
-                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
-                loss = pg_loss + vf_coef * vf_loss - ent_coef * ent
-                return loss, (pg_loss, vf_loss, ent)
-
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        def update(params, target_params, opt_state, batch):
+            (l, aux), grads = jax.value_and_grad(
+                lambda p: loss(p, target_params, batch), has_aux=True)(params)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, aux
+            return params, opt_state, l, aux
 
         self._update = update
+
+    def _policy_terms(self, p, batch):
+        """Shared forward pass + V-trace targets. Returns
+        (target_logp, logp_all, values, vs, pg_adv), all time-major."""
+        import jax
+        import jax.numpy as jnp
+
+        T, N = batch["rewards"].shape
+        obs = batch["obs"].reshape(T * N, -1)
+        logits, values = self._rl.forward(p, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = logp_all[
+            jnp.arange(T * N), batch["actions"].reshape(T * N)]
+        target_logp = target_logp.reshape(T, N)
+        values = values.reshape(T, N)
+        _, last_value = self._rl.forward(p, batch["bootstrap_obs"])
+        vs, pg_adv = _vtrace(
+            target_logp, batch["behavior_logp"], batch["rewards"],
+            values, batch["dones"], last_value,
+            gamma=self.gamma, rho_bar=self.rho_bar, c_bar=self.c_bar)
+        return target_logp, logp_all, values, vs, pg_adv
+
+    def _loss(self, p, target_params, batch):
+        import jax.numpy as jnp
+
+        target_logp, logp_all, values, vs, pg_adv = self._policy_terms(
+            p, batch)
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pg_loss + self.vf_coef * vf_loss - self.ent_coef * ent
+        return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss, "entropy": ent}
+
+    def _post_update(self):
+        """Hook: APPO refreshes its target network here."""
 
     def update(self, batch: dict) -> dict:
         import jax.numpy as jnp
@@ -122,12 +144,13 @@ class ImpalaLearner:
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k in ("obs", "actions", "behavior_logp", "rewards", "dones",
                        "bootstrap_obs")}
-        self.params, self.opt_state, loss, (pg, vf, ent) = self._update(
-            self.params, self.opt_state, jb)
+        self.params, self.opt_state, loss, aux = self._update(
+            self.params, self.target_params, self.opt_state, jb)
         self.version += 1
-        return {"loss": float(loss), "pg_loss": float(pg),
-                "vf_loss": float(vf), "entropy": float(ent),
-                "weights_version": self.version}
+        self._post_update()
+        out = {"loss": float(loss), "weights_version": self.version}
+        out.update({k: float(v) for k, v in aux.items()})
+        return out
 
     def get_weights_blob(self) -> bytes:
         from ray_tpu._private import serialization as ser

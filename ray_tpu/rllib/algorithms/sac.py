@@ -1,0 +1,374 @@
+"""SAC: soft actor-critic for continuous control.
+
+(reference: rllib/algorithms/sac/ — SACConfig/SAC with twin Q networks,
+polyak-averaged targets, tanh-squashed Gaussian policy, and automatic
+entropy-temperature tuning; Haarnoja et al. 2018. Off-policy like DQN:
+remote env runners fill the replay buffer, the learner runs jitted updates
+over uniform samples.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.replay import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.buffer_size = 100_000
+        self.train_batch_size = 128
+        self.tau = 0.005                  # polyak for target critics
+        self.num_updates_per_step = 16
+        self.learning_starts = 1_000
+        self.initial_alpha = 0.1
+        self.autotune_alpha = True
+        self.target_entropy = None        # default: -action_dim
+
+    def training(self, *, buffer_size=None, train_batch_size=None, tau=None,
+                 num_updates_per_step=None, learning_starts=None,
+                 initial_alpha=None, autotune_alpha=None,
+                 target_entropy=None, **kwargs) -> "SACConfig":
+        super().training(**kwargs)
+        for name, val in (("buffer_size", buffer_size),
+                          ("train_batch_size", train_batch_size),
+                          ("tau", tau),
+                          ("num_updates_per_step", num_updates_per_step),
+                          ("learning_starts", learning_starts),
+                          ("initial_alpha", initial_alpha),
+                          ("autotune_alpha", autotune_alpha),
+                          ("target_entropy", target_entropy)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+# ------------------------------------------------------------- sac networks
+
+
+def _mlp_init(key, sizes):
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i in range(len(sizes) - 1):
+        params[str(i)] = {
+            "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * jnp.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],)),
+        }
+    return params
+
+
+def _mlp(params, x, final_linear=True):
+    n = len(params)
+    for i in range(n):
+        layer = params[str(i)]
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1 or not final_linear:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_sac_params(key, obs_dim: int, action_dim: int,
+                    hidden=(64, 64), initial_alpha: float = 0.1) -> dict:
+    ka, k1, k2 = jax.random.split(key, 3)
+    return {
+        "actor": _mlp_init(ka, (obs_dim, *hidden, 2 * action_dim)),
+        "q1": _mlp_init(k1, (obs_dim + action_dim, *hidden, 1)),
+        "q2": _mlp_init(k2, (obs_dim + action_dim, *hidden, 1)),
+        "log_alpha": jnp.asarray(np.log(initial_alpha), jnp.float32),
+    }
+
+
+def actor_sample(actor_params, obs, key, action_scale: float):
+    """Tanh-squashed Gaussian: returns (action, log_prob). The tanh
+    log-det-Jacobian correction uses the numerically-stable softplus
+    form: log(1 - tanh(u)^2) = 2 (log 2 - u - softplus(-2u))."""
+    out = _mlp(actor_params, obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(key, mu.shape)
+    logp_u = jnp.sum(-0.5 * ((u - mu) / std) ** 2 - log_std
+                     - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+    a = jnp.tanh(u)
+    logp = logp_u - jnp.sum(
+        2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+    return a * action_scale, logp
+
+
+def actor_mean(actor_params, obs, action_scale: float):
+    out = _mlp(actor_params, obs)
+    mu, _ = jnp.split(out, 2, axis=-1)
+    return jnp.tanh(mu) * action_scale
+
+
+def q_value(q_params, obs, action):
+    return _mlp(q_params, jnp.concatenate([obs, action], axis=-1))[:, 0]
+
+
+# --------------------------------------------------------------- env runner
+
+
+@ray_tpu.remote
+class _SACRunner:
+    """Remote rollout actor: samples stochastic actions from the current
+    actor network (jax on CPU in the worker) and returns transitions."""
+
+    def __init__(self, env_id, num_envs: int, seed: int = 0,
+                 action_scale: float = 1.0):
+        self.env = make_vec_env(env_id, num_envs, seed)
+        self.obs = self.env.reset(seed)
+        self.key = jax.random.PRNGKey(seed)
+        self.action_scale = action_scale
+        self._sample = jax.jit(functools.partial(
+            actor_sample, action_scale=action_scale))
+
+    def sample(self, actor_blob: bytes, num_steps: int,
+               random_actions: bool = False) -> dict:
+        from ray_tpu._private import serialization as ser
+
+        actor = None if random_actions else ser.loads(actor_blob)
+        N = self.env.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(num_steps):
+            if random_actions:
+                self.key, sub = jax.random.split(self.key)
+                a = np.asarray(jax.random.uniform(
+                    sub, (N, self.env.action_dim), minval=-1.0, maxval=1.0)
+                    * self.action_scale)
+            else:
+                self.key, sub = jax.random.split(self.key)
+                a, _ = self._sample(actor, jnp.asarray(self.obs), sub)
+                a = np.asarray(a)
+            nxt, r, d, info = self.env.step(a)
+            obs_l.append(self.obs)
+            act_l.append(a)
+            rew_l.append(r)
+            # time-limit truncations are NOT terminals: bootstrap through
+            # them from the pre-reset final observation
+            truncated = info.get("truncated")
+            if truncated is not None and truncated.any():
+                stored_next = nxt.copy()
+                stored_next[truncated] = info["final_obs"][truncated]
+                next_l.append(stored_next)
+                done_l.append(d & ~truncated)
+            else:
+                next_l.append(nxt)
+                done_l.append(d)
+            self.obs = nxt
+        return {
+            "obs": np.concatenate(obs_l, 0),
+            "actions": np.concatenate(act_l, 0),
+            "rewards": np.concatenate(rew_l, 0),
+            "next_obs": np.concatenate(next_l, 0),
+            "dones": np.concatenate(done_l, 0),
+            "episode_returns": self.env.drain_episode_returns(),
+        }
+
+
+# ------------------------------------------------------------------ learner
+
+
+def make_sac_update(actor_opt, q_opt, alpha_opt, *, gamma: float, tau: float,
+                    action_scale: float, target_entropy: float,
+                    autotune: bool):
+    @jax.jit
+    def update(params, target_q, opt_states, batch, key):
+        k1, k2 = jax.random.split(key)
+
+        # --- critics: soft Bellman backup against target twins
+        def q_loss_fn(q_params):
+            a_next, logp_next = actor_sample(params["actor"],
+                                             batch["next_obs"], k1,
+                                             action_scale)
+            tq1 = q_value(target_q["q1"], batch["next_obs"], a_next)
+            tq2 = q_value(target_q["q2"], batch["next_obs"], a_next)
+            alpha = jnp.exp(params["log_alpha"])
+            soft_q = jnp.minimum(tq1, tq2) - alpha * logp_next
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterminal * soft_q)
+            q1 = q_value(q_params["q1"], batch["obs"], batch["actions"])
+            q2 = q_value(q_params["q2"], batch["obs"], batch["actions"])
+            loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+            return loss, jnp.mean(q1)
+
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, q_mean), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True)(q_params)
+        q_updates, q_state = q_opt.update(q_grads, opt_states["q"], q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        # --- actor: maximize soft value under the fresh critics
+        def pi_loss_fn(actor_params):
+            a, logp = actor_sample(actor_params, batch["obs"], k2,
+                                   action_scale)
+            q1 = q_value(q_params["q1"], batch["obs"], a)
+            q2 = q_value(q_params["q2"], batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True)(params["actor"])
+        pi_updates, pi_state = actor_opt.update(pi_grads, opt_states["actor"],
+                                                params["actor"])
+        actor_params = optax.apply_updates(params["actor"], pi_updates)
+
+        # --- temperature: match the target entropy
+        def alpha_loss_fn(log_alpha):
+            return -jnp.mean(jnp.exp(log_alpha)
+                             * jax.lax.stop_gradient(logp + target_entropy))
+
+        if autotune:
+            a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(
+                params["log_alpha"])
+            a_updates, a_state = alpha_opt.update(
+                a_grad, opt_states["alpha"], params["log_alpha"])
+            log_alpha = optax.apply_updates(params["log_alpha"], a_updates)
+        else:
+            a_loss = jnp.float32(0)
+            a_state = opt_states["alpha"]
+            log_alpha = params["log_alpha"]
+
+        new_params = {"actor": actor_params, "q1": q_params["q1"],
+                      "q2": q_params["q2"], "log_alpha": log_alpha}
+        new_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  target_q, q_params)
+        metrics = {"q_loss": q_loss, "pi_loss": pi_loss, "alpha_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha), "q_mean": q_mean,
+                   "entropy": -jnp.mean(logp)}
+        return (new_params, new_target,
+                {"q": q_state, "actor": pi_state, "alpha": a_state}, metrics)
+
+    return update
+
+
+class SAC(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        probe = make_vec_env(cfg.env_id, 1, cfg.seed)
+        if getattr(probe, "action_dim", 0) < 1:
+            raise ValueError(
+                f"SAC needs a continuous-action env; {cfg.env_id!r} has no "
+                "action_dim (use DQN/PPO/IMPALA/APPO for discrete actions)")
+        self.obs_dim = probe.obs_dim
+        self.action_dim = probe.action_dim
+        self.action_scale = float(getattr(probe, "action_high", 1.0))
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(self.action_dim))
+        self.params = init_sac_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim,
+            hidden=cfg.model_hidden, initial_alpha=cfg.initial_alpha)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.actor_opt = optax.adam(cfg.lr)
+        self.q_opt = optax.adam(cfg.lr)
+        self.alpha_opt = optax.adam(cfg.lr)
+        self.opt_states = {
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "q": self.q_opt.init({"q1": self.params["q1"],
+                                  "q2": self.params["q2"]}),
+            "alpha": self.alpha_opt.init(self.params["log_alpha"]),
+        }
+        self._update = make_sac_update(
+            self.actor_opt, self.q_opt, self.alpha_opt, gamma=cfg.gamma,
+            tau=cfg.tau, action_scale=self.action_scale,
+            target_entropy=target_entropy, autotune=cfg.autotune_alpha)
+        # replay over continuous actions
+        self.buffer = ReplayBuffer(cfg.buffer_size, self.obs_dim,
+                                   seed=cfg.seed,
+                                   action_dim=self.action_dim)
+        self.runners = [
+            _SACRunner.remote(cfg.env_id, cfg.num_envs_per_runner,
+                              cfg.seed + 1000 * (i + 1),
+                              action_scale=self.action_scale)
+            for i in range(cfg.num_env_runners)]
+        self.key = jax.random.PRNGKey(cfg.seed + 7)
+        self._env_steps = 0
+        self._num_updates = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        from ray_tpu._private import serialization as ser
+
+        blob = ser.dumps(jax.device_get(self.params["actor"]))
+        warmup = self._env_steps < cfg.learning_starts
+        refs = [r.sample.remote(blob, cfg.rollout_fragment_length,
+                                random_actions=warmup)
+                for r in self.runners]
+        for s in ray_tpu.get(refs, timeout=300):
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["dones"])
+            self._env_steps += len(s["rewards"])
+            self._episode_returns.extend(s["episode_returns"])
+        metrics: dict = {"env_steps": self._env_steps,
+                         "buffer_size": len(self.buffer)}
+        if len(self.buffer) < cfg.learning_starts:
+            return metrics
+        m: dict = {}
+        for _ in range(cfg.num_updates_per_step):
+            batch = {k: jnp.asarray(v)
+                     for k, v in self.buffer.sample(cfg.train_batch_size).items()}
+            self.key, sub = jax.random.split(self.key)
+            self.params, self.target_q, self.opt_states, m = self._update(
+                self.params, self.target_q, self.opt_states, batch, sub)
+            self._num_updates += 1
+        metrics.update({k: float(v) for k, v in m.items()})
+        metrics["num_updates"] = self._num_updates
+        return metrics
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        """Deterministic (mean) action for evaluation."""
+        return np.asarray(actor_mean(self.params["actor"],
+                                     jnp.asarray(obs)[None],
+                                     self.action_scale))[0]
+
+    def save(self, path: str) -> str:
+        import os
+
+        from ray_tpu.llm import checkpoint_io
+
+        os.makedirs(path, exist_ok=True)
+        checkpoint_io.save_params(self.params, os.path.join(path, "module"))
+        return path
+
+    def restore(self, path: str) -> None:
+        import os
+
+        from ray_tpu.llm import checkpoint_io
+
+        loaded = checkpoint_io.load_params(os.path.join(path, "module"))
+        self.params = jax.tree.map(
+            lambda old, new: new.astype(old.dtype)
+            if hasattr(old, "dtype") else new,
+            self.params, loaded)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.opt_states = {
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "q": self.q_opt.init({"q1": self.params["q1"],
+                                  "q2": self.params["q2"]}),
+            "alpha": self.alpha_opt.init(self.params["log_alpha"]),
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners.clear()
+
+
+SACConfig.algo_class = SAC

@@ -94,7 +94,81 @@ class CartPoleVecEnv(VectorEnv):
         return out
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPoleVecEnv}
+class PendulumVecEnv(VectorEnv):
+    """N independent Pendulum-v1 dynamics (classic control formulation):
+    continuous torque in [-2, 2], obs = (cos th, sin th, th_dot), reward
+    -(th^2 + 0.1 th_dot^2 + 0.001 a^2), 200-step episodes."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    def __init__(self, num_envs: int = 16, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = 3
+        self.num_actions = 0          # discrete-action API: none
+        self.action_dim = 1           # continuous torque
+        self.action_low = -self.MAX_TORQUE
+        self.action_high = self.MAX_TORQUE
+        self.rng = np.random.default_rng(seed)
+        self.th = np.zeros(num_envs)
+        self.th_dot = np.zeros(num_envs)
+        self.steps = np.zeros(num_envs, np.int64)
+        self.episode_returns = np.zeros(num_envs, np.float64)
+        self.completed_returns: list[float] = []
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self.th), np.sin(self.th), self.th_dot],
+                        axis=1).astype(np.float32)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.th = self.rng.uniform(-np.pi, np.pi, self.num_envs)
+        self.th_dot = self.rng.uniform(-1.0, 1.0, self.num_envs)
+        self.steps[:] = 0
+        self.episode_returns[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        a = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        reward = -(th_norm ** 2 + 0.1 * self.th_dot ** 2 + 0.001 * a ** 2)
+        self.th_dot = np.clip(
+            self.th_dot + (3 * self.G / (2 * self.L) * np.sin(self.th)
+                           + 3.0 / (self.M * self.L ** 2) * a) * self.DT,
+            -self.MAX_SPEED, self.MAX_SPEED)
+        self.th = self.th + self.th_dot * self.DT
+        self.steps += 1
+        self.episode_returns += reward
+        done = self.steps >= self.MAX_STEPS
+        info = {}
+        if done.any():
+            # Pendulum never terminates — done is always a TIME-LIMIT
+            # truncation. Bootstrapping code needs the pre-reset final
+            # observation and the truncation mask, or it would zero the
+            # continuation value at step 200 (a biased Bellman target).
+            info = {"truncated": done.copy(), "final_obs": self._obs()}
+            self.completed_returns.extend(self.episode_returns[done].tolist())
+            rows = done
+            self.th[rows] = self.rng.uniform(-np.pi, np.pi, rows.sum())
+            self.th_dot[rows] = self.rng.uniform(-1.0, 1.0, rows.sum())
+            self.steps[rows] = 0
+            self.episode_returns[rows] = 0
+        return self._obs(), reward.astype(np.float32), done, info
+
+    def drain_episode_returns(self) -> list[float]:
+        out, self.completed_returns = self.completed_returns, []
+        return out
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPoleVecEnv,
+                "Pendulum-v1": PendulumVecEnv}
 
 
 def make_vec_env(env_id, num_envs: int, seed: int = 0) -> VectorEnv:

@@ -13,10 +13,16 @@ import numpy as np
 class ReplayBuffer:
     """Uniform-sampling ring buffer over transitions."""
 
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int = 0):
+        """action_dim=0 → discrete int actions; >0 → continuous
+        [capacity, action_dim] float actions (SAC)."""
         self.capacity = int(capacity)
         self.obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity,), np.int32)
+        if action_dim > 0:
+            self.actions = np.zeros((capacity, action_dim), np.float32)
+        else:
+            self.actions = np.zeros((capacity,), np.int32)
         self.rewards = np.zeros((capacity,), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
         self.dones = np.zeros((capacity,), np.bool_)

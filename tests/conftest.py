@@ -11,6 +11,11 @@ import os
 # and sitecustomize may pre-import jax, so env vars alone are too late —
 # jax.config.update wins as long as no backend has been initialized yet.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the TPU device-pool relay env var triggers a per-process session
+# registration inside `import jax` (sitecustomize); when the shared pool is
+# wedged that registration BLOCKS the import forever — CPU test processes
+# must never dial it
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
