@@ -93,6 +93,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(gcs.rpc({"type": "cluster_state"})["state"])
             elif path == "/api/nodes":
                 self._json(gcs.rpc({"type": "list_nodes"})["nodes"])
+            elif path == "/api/workers":
+                self._json(gcs.rpc({"type": "list_workers"})["workers"])
+            elif path == "/api/objects":
+                resp = gcs.rpc({"type": "list_objects"})
+                self._json({"objects": resp.get("objects", []),
+                            "total": resp.get("total", 0)})
             elif path == "/api/actors":
                 st = gcs.rpc({"type": "cluster_state"})["state"]
                 self._json(st.get("actors", {}))
