@@ -1,0 +1,145 @@
+"""Control-plane ceilings: what the single GCS process sustains.
+
+VERDICT round-3 item 9: publish measured ceilings (actors, concurrent
+placement groups, virtual nodes) so the next scaling fix is data-driven.
+Reference envelope (release/benchmarks/README.md): many_actors 10k,
+many_pgs 1k, many_nodes 250 (multi-node); single_node 10k queued tasks.
+
+Method on the 1-core box: batched creation, recording the per-step rate
+SERIES (first/min/last) so a mid-run knee is visible in the artifact, plus
+an end-to-end liveness probe at peak scale. Results land in
+MICROBENCH.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def bench_actors(max_actors: int = 2000, step: int = 250) -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    # actors beyond worker capacity queue as pending — the ceiling here is
+    # GCS bookkeeping (registration + state machine), matching the
+    # reference's many_actors envelope semantics
+    handles = []
+    rates = []
+    out: dict = {}
+    try:
+        while len(handles) < max_actors:
+            t0 = time.perf_counter()
+            handles.extend(A.remote() for _ in range(step))
+            dt = time.perf_counter() - t0
+            rates.append(step / dt)
+        # liveness under load: one round trip through the first actors
+        t0 = time.perf_counter()
+        assert ray_tpu.get(handles[0].ping.remote(), timeout=120) == 1
+        ping_ms = (time.perf_counter() - t0) * 1e3
+        out = {
+            "actors_registered": len(handles),
+            "actor_submit_per_s_first": round(rates[0], 1),
+            "actor_submit_per_s_min": round(min(rates), 1),
+            "actor_submit_per_s_last": round(rates[-1], 1),
+            "actor_ping_ms_at_peak": round(ping_ms, 1),
+        }
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_pgs(max_pgs: int = 600, step: int = 100) -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=10_000, num_workers=0, max_workers=1)
+    pgs = []
+    rates = []
+    out: dict = {}
+    try:
+        while len(pgs) < max_pgs:
+            t0 = time.perf_counter()
+            for _ in range(step):
+                pgs.append(ray_tpu.util.placement_group(
+                    [{"CPU": 1.0}], strategy="PACK"))
+            dt = time.perf_counter() - t0
+            rates.append(step / dt)
+        ray_tpu.get(pgs[-1].ready(), timeout=120)
+        t0 = time.perf_counter()
+        for pg in pgs[: step]:
+            ray_tpu.util.remove_placement_group(pg)
+        removal_rate = step / (time.perf_counter() - t0)
+        out = {
+            "pgs_created": len(pgs),
+            "pg_create_per_s_first": round(rates[0], 1),
+            "pg_create_per_s_min": round(min(rates), 1),
+            "pg_create_per_s_last": round(rates[-1], 1),
+            "pg_remove_per_s": round(removal_rate, 1),
+        }
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_nodes(max_nodes: int = 500, step: int = 100) -> dict:
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.init(num_cpus=2, num_workers=0, max_workers=1)
+    cluster = Cluster(initialize_head=False)
+    rates = []
+    out: dict = {}
+    n = 0
+    try:
+        while n < max_nodes:
+            t0 = time.perf_counter()
+            for _ in range(step):
+                cluster.add_node(num_cpus=4.0)
+                n += 1
+            rates.append(step / (time.perf_counter() - t0))
+        from ray_tpu._private.api import _get_worker
+
+        t0 = time.perf_counter()
+        nodes = _get_worker().list_nodes()
+        list_ms = (time.perf_counter() - t0) * 1e3
+        out = {
+            # excludes the head node: virtual nodes this bench added
+            "nodes_added": len(nodes) - 1,
+            "node_add_per_s_first": round(rates[0], 1),
+            "node_add_per_s_min": round(min(rates), 1),
+            "node_add_per_s_last": round(rates[-1], 1),
+            "list_nodes_ms_at_peak": round(list_ms, 1),
+        }
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def main():
+    results = {}
+    results.update(bench_actors())
+    results.update(bench_pgs())
+    results.update(bench_nodes())
+    print(json.dumps(results))
+    path = os.path.join(os.path.dirname(__file__), "..", "MICROBENCH.json")
+    doc = json.load(open(path))
+    keep = [r for r in doc["results"] if not r["name"].startswith("ceiling_")]
+    for k, v in results.items():
+        keep.append({"name": f"ceiling_{k}", "ops_per_s": None, "value": v,
+                     "us_per_op": None})
+    doc["results"] = keep
+    json.dump(doc, open(path, "w"), indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -129,14 +129,25 @@ def main():
     finally:
         ray_tpu.shutdown()
 
+    path = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "MICROBENCH.json"))
+    # merge-preserve rows other benchmarks own (scheduler scale, warm pool,
+    # control-plane ceilings): a core-microbench rerun must not wipe them
+    mine = {r["name"] for r in results}
+    prior = []
+    try:
+        with open(path) as f:
+            prior = [r for r in json.load(f).get("results", [])
+                     if r.get("name") not in mine]
+    except (OSError, ValueError):
+        pass
     out = {
         "recorded_at_round": os.environ.get("RAY_TPU_BENCH_ROUND", ""),
-        "results": results,
+        "results": results + prior,
     }
-    path = os.path.join(os.path.dirname(__file__), "..", "..", "MICROBENCH.json")
-    with open(os.path.abspath(path), "w") as f:
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"\nwrote {os.path.abspath(path)}")
+    print(f"\nwrote {path}")
 
 
 if __name__ == "__main__":
