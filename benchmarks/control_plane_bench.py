@@ -131,14 +131,12 @@ def main():
     results.update(bench_pgs())
     results.update(bench_nodes())
     print(json.dumps(results))
-    path = os.path.join(os.path.dirname(__file__), "..", "MICROBENCH.json")
-    doc = json.load(open(path))
-    keep = [r for r in doc["results"] if not r["name"].startswith("ceiling_")]
-    for k, v in results.items():
-        keep.append({"name": f"ceiling_{k}", "ops_per_s": None, "value": v,
-                     "us_per_op": None})
-    doc["results"] = keep
-    json.dump(doc, open(path, "w"), indent=1)
+    from ray_tpu._private.ray_perf import merge_microbench
+
+    rows = [{"name": f"ceiling_{k}", "ops_per_s": None, "value": v,
+             "us_per_op": None} for k, v in results.items()]
+    merge_microbench(os.path.join(os.path.dirname(__file__), "..",
+                                  "MICROBENCH.json"), rows)
 
 
 if __name__ == "__main__":

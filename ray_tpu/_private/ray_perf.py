@@ -131,8 +131,19 @@ def main():
 
     path = os.path.abspath(os.path.join(
         os.path.dirname(__file__), "..", "..", "MICROBENCH.json"))
-    # merge-preserve rows other benchmarks own (scheduler scale, warm pool,
-    # control-plane ceilings): a core-microbench rerun must not wipe them
+    merge_microbench(path, results)
+    print(f"\nwrote {path}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+
+
+def merge_microbench(path: str, results: list) -> None:
+    """Write benchmark rows into MICROBENCH.json, preserving rows owned by
+    OTHER benchmarks (core microbench, scheduler scale, warm pool,
+    control-plane ceilings all share the artifact — a rerun of one must
+    not wipe the rest)."""
     mine = {r["name"] for r in results}
     prior = []
     try:
@@ -145,10 +156,7 @@ def main():
         "recorded_at_round": os.environ.get("RAY_TPU_BENCH_ROUND", ""),
         "results": results + prior,
     }
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"\nwrote {path}")
-
-
-if __name__ == "__main__":
-    sys.exit(main())
+    os.replace(tmp, path)

@@ -299,21 +299,30 @@ def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
 
 
 def loss_fn(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
-            attn_impl: str | None = None, fused_ce: bool | None = None):
+            attn_impl: str | None = None, fused_ce: bool | None = None,
+            logits_spec=None):
     """Next-token LM loss on tokens [B, T]; positions with label -100 ignored.
 
     fused_ce (default: on for vocab >= 8192) streams the lm_head matmul into
-    a chunked cross-entropy so [B,T,V] logits are never materialized."""
+    a chunked cross-entropy so [B,T,V] logits are never materialized.
+    logits_spec optionally shards the per-chunk head-matmul output over the
+    mesh (vocab dim on tp — see ops.fused_head_cross_entropy)."""
     if fused_ce is None:
         fused_ce = cfg.vocab_size >= 8192
     fused_ce = fused_ce and not cfg.tie_embeddings  # fused path needs lm_head
+    if logits_spec is not None and not fused_ce:
+        raise ValueError(
+            "logits_spec requires the fused-CE path (untied embeddings and "
+            "fused_ce enabled); the unfused path would silently materialize "
+            "replicated [B,T,V] logits")
     labels = tokens[:, 1:]
     if fused_ce:
         hidden, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis,
                               attn_impl=attn_impl, return_hidden=True)
         B, T, E = hidden.shape
         loss, _ = ops.fused_head_cross_entropy(
-            hidden.reshape(B * T, E), params["lm_head"], labels.reshape(B * T))
+            hidden.reshape(B * T, E), params["lm_head"], labels.reshape(B * T),
+            logits_spec=logits_spec)
     else:
         logits, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis, attn_impl=attn_impl)
         loss, _ = ops.softmax_cross_entropy(logits, labels)

@@ -26,13 +26,20 @@ def softmax_cross_entropy(logits, labels, *, ignore_index: int = -100,
 
 
 def fused_head_cross_entropy(hidden, head_w, labels, *, ignore_index: int = -100,
-                             z_loss: float = 0.0, chunk: int = 2048):
+                             z_loss: float = 0.0, chunk: int = 2048,
+                             logits_spec=None):
     """CE( hidden @ head_w, labels ) without materializing full logits.
 
     hidden [N, E] (any float dtype), head_w [E, V], labels [N]. The [N, V]
     logits tensor never exists at once: lax.map runs the head matmul + lse
     per chunk and the VJP replays per chunk too. Saves ~2×N×V×4 bytes of HBM
-    on big-vocab models, which is what caps batch size on one chip."""
+    on big-vocab models, which is what caps batch size on one chip.
+
+    `logits_spec` (a PartitionSpec over [chunk, V]) constrains the per-chunk
+    logits sharding under a mesh: with the vocab dim on the tp axis each
+    chip computes its vocab slice of the head matmul + partial lse and XLA
+    reduces — the vocab-matmul output sharding lever for multi-chip
+    training (scaling-book output-sharded final projection)."""
     N, E = hidden.shape
     pad = (-N) % chunk
     if pad:
@@ -46,6 +53,8 @@ def fused_head_cross_entropy(hidden, head_w, labels, *, ignore_index: int = -100
     def one(args):
         h, lab = args
         logits = (h @ head_w.astype(h.dtype)).astype(jnp.float32)
+        if logits_spec is not None:
+            logits = jax.lax.with_sharding_constraint(logits, logits_spec)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         safe = jnp.where(lab == ignore_index, 0, lab)
         picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]

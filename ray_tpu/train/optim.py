@@ -1,0 +1,130 @@
+"""Memory-efficient optimizers for HBM-bound training.
+
+`adamw_int8` keeps Adam's two moment tensors in int8 with per-block f32
+scales (block-wise absmax quantization — the public 8-bit-Adam recipe,
+Dettmers et al. 2021) instead of f32: 8 bytes/param of optimizer state
+drops to ~2.06 bytes/param. At the 634M bench model that frees ~3.8 GB of
+HBM — the difference between needing rematerialization and running the
+backward pass with activations resident (PERF.md round-2/3: the no-remat
+and d2048-L12 configs exceeded HBM *because of* AdamW state).
+
+Everything is jit-compatible: quantize/dequantize are elementwise + a
+blockwise max, fused by XLA around the update math, which stays in f32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_BLOCK = 256
+
+
+def _pad_len(n: int, block: int) -> int:
+    return (-n) % block
+
+
+def _quantize(x_flat: jnp.ndarray, block: int):
+    """f32 [N] → (int8 [N], f32 scales [N/block]) by per-block absmax."""
+    blocks = x_flat.reshape(-1, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe[:, None]), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale
+
+
+def _dequantize(q: jnp.ndarray, scale: jnp.ndarray, block: int) -> jnp.ndarray:
+    safe = jnp.where(scale > 0, scale, 1.0)
+    return (q.reshape(-1, block).astype(jnp.float32)
+            * safe[:, None]).reshape(-1)
+
+
+class _Int8Moment(NamedTuple):
+    q: jnp.ndarray        # int8 [N_padded]
+    scale: jnp.ndarray    # f32 [N_padded / block]
+
+
+class AdamWInt8State(NamedTuple):
+    count: jnp.ndarray
+    m: object             # pytree of _Int8Moment
+    v: object             # pytree of _Int8Moment
+
+
+def adamw_int8(learning_rate, b1: float = 0.9, b2: float = 0.999,
+               eps: float = 1e-8, weight_decay: float = 0.0,
+               block: int = _BLOCK) -> optax.GradientTransformation:
+    """AdamW whose m/v state lives in block-quantized int8.
+
+    Matches optax.adamw's update math (bias-corrected moments, decoupled
+    weight decay) up to the quantization error of the stored moments.
+    `learning_rate` may be a float or an optax schedule.
+    """
+
+    def _zeros_like_moment(p):
+        n = p.size + _pad_len(p.size, block)
+        return _Int8Moment(jnp.zeros((n,), jnp.int8),
+                           jnp.zeros((n // block,), jnp.float32))
+
+    def init_fn(params):
+        return AdamWInt8State(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree.map(_zeros_like_moment, params),
+            v=jax.tree.map(_zeros_like_moment, params),
+        )
+
+    def _lr(count):
+        if callable(learning_rate):
+            return learning_rate(count)
+        return learning_rate
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("adamw_int8 needs params (weight decay)")
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** c
+        bc2 = 1.0 - b2 ** c
+        # optax evaluates schedules at the PRE-increment count
+        # (scale_by_schedule) while bias correction uses the incremented
+        # one (scale_by_adam) — match both exactly
+        lr = _lr(state.count)
+
+        def one(g, p, m8, v8):
+            n = g.size
+            gf = g.reshape(-1).astype(jnp.float32)
+            pad = _pad_len(n, block)
+            if pad:
+                gf = jnp.concatenate([gf, jnp.zeros((pad,), jnp.float32)])
+            m = _dequantize(m8.q, m8.scale, block)
+            v = _dequantize(v8.q, v8.scale, block)
+            m = b1 * m + (1.0 - b1) * gf
+            v = b2 * v + (1.0 - b2) * gf * gf
+            step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            step = step[:n].reshape(g.shape).astype(jnp.float32)
+            delta = -(lr * (step + weight_decay
+                            * p.astype(jnp.float32))).astype(p.dtype)
+            return delta, _Int8Moment(*_quantize(m, block)), \
+                _Int8Moment(*_quantize(v, block))
+
+        flat_u, treedef = jax.tree.flatten(updates)
+        flat_p = treedef.flatten_up_to(params)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        out = [one(g, p, m8, v8) for g, p, m8, v8
+               in zip(flat_u, flat_p, flat_m, flat_v)]
+        deltas = treedef.unflatten([o[0] for o in out])
+        new_m = treedef.unflatten([o[1] for o in out])
+        new_v = treedef.unflatten([o[2] for o in out])
+        return deltas, AdamWInt8State(count=count, m=new_m, v=new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def optimizer_state_bytes(opt_state) -> int:
+    """Total bytes held by an optimizer state pytree (HBM accounting)."""
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree.leaves(opt_state)
+               if hasattr(x, "dtype"))

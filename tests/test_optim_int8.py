@@ -1,0 +1,146 @@
+"""int8-state AdamW + vocab-sharded fused CE (MFU levers, PERF.md).
+
+(reference capability: training at HBM capacity — the reference leans on
+torch/DeepSpeed-style 8-bit optimizers; here adamw_int8 is the jax-native
+equivalent that frees ~6 bytes/param so the bench config can drop remat.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.train.optim import adamw_int8, optimizer_state_bytes
+
+
+def _toy_params(key, scale=1.0):
+    k1, k2 = jax.random.split(key)
+    return {"w": jax.random.normal(k1, (37, 19)) * scale,
+            "b": jax.random.normal(k2, (19,)) * 0.1}
+
+
+def _quadratic_loss(params, x):
+    y = jnp.tanh(x @ params["w"] + params["b"])
+    return jnp.mean(y ** 2)
+
+
+def test_adamw_int8_tracks_adamw():
+    """Loss trajectory under int8-state AdamW stays close to exact AdamW
+    over many steps (quantization noise, not divergence)."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 37))
+
+    def run(opt, steps=120):
+        params = _toy_params(key)
+        state = opt.init(params)
+        losses = []
+
+        @jax.jit
+        def step(params, state):
+            loss, grads = jax.value_and_grad(_quadratic_loss)(params, x)
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state, loss
+
+        for _ in range(steps):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    exact = run(optax.adamw(1e-2, weight_decay=0.01))
+    quant = run(adamw_int8(1e-2, weight_decay=0.01))
+    assert quant[-1] < quant[0] * 0.75  # actually optimizes
+    # the whole tail stays within a tight band of exact AdamW (measured
+    # ratio ~0.996 — quantization noise, not drift)
+    np.testing.assert_allclose(quant[-10:], exact[-10:], rtol=0.05)
+
+
+def test_adamw_int8_first_step_matches_exactly():
+    """Step 1 from zero moments has no accumulated quantization error in m
+    (one value per block position after (1-b1)*g scaling), so the update
+    direction must match optax to fine tolerance."""
+    params = _toy_params(jax.random.PRNGKey(3))
+    g = jax.tree.map(lambda p: jnp.ones_like(p) * 0.37, params)
+    for opt_fn in (lambda: optax.adamw(1e-3, weight_decay=0.0),
+                   lambda: adamw_int8(1e-3, weight_decay=0.0)):
+        opt = opt_fn()
+        st = opt.init(params)
+        upd, _ = opt.update(g, st, params)
+        uniform = np.unique(np.round(np.asarray(upd["w"]).ravel(), 10))
+        assert len(uniform) == 1  # uniform gradient → uniform step
+    o1 = optax.adamw(1e-3, weight_decay=0.0)
+    o2 = adamw_int8(1e-3, weight_decay=0.0)
+    u1, _ = o1.update(g, o1.init(params), params)
+    u2, _ = o2.update(g, o2.init(params), params)
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]),
+                               rtol=2e-2)
+
+
+def test_state_memory_is_quarter_of_f32():
+    params = {"w": jnp.zeros((1024, 512), jnp.float32)}
+    n = 1024 * 512
+    exact = optax.adamw(1e-3)
+    b_exact = optimizer_state_bytes(exact.init(params))
+    q = adamw_int8(1e-3)
+    b_q = optimizer_state_bytes(q.init(params))
+    assert b_exact >= 8 * n  # two f32 moments
+    assert b_q <= 2.2 * n  # two int8 moments + per-256 block scales
+    assert b_q < b_exact / 3.5
+
+
+def test_lr_schedule_supported():
+    sched = optax.linear_schedule(1e-2, 0.0, 10)
+    opt = adamw_int8(sched)
+    params = _toy_params(jax.random.PRNGKey(4))
+    st = opt.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    u1, st = opt.update(g, st, params)
+    for _ in range(9):
+        u2, st = opt.update(g, st, params)
+    # schedule decayed to ~0 by step 10
+    assert np.abs(np.asarray(u2["w"])).max() < np.abs(np.asarray(u1["w"])).max() / 5
+
+
+def test_jit_train_step_with_int8_state():
+    opt = adamw_int8(1e-2)
+    params = _toy_params(jax.random.PRNGKey(5))
+    state = opt.init(params)
+    x = jax.random.normal(jax.random.PRNGKey(6), (32, 37))
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(_quadratic_loss)(params, x)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    l0 = None
+    for i in range(20):
+        params, state, loss = step(params, state)
+        l0 = l0 if l0 is not None else float(loss)
+    assert float(loss) < l0
+    # moments really are int8 on the wire
+    assert state.m["w"].q.dtype == jnp.int8
+    assert state.v["w"].q.dtype == jnp.int8
+
+
+def test_fused_ce_vocab_sharding_compiles_on_mesh():
+    """The logits_spec constraint compiles and matches the unsharded value
+    on the 8-device virtual mesh (vocab on 'tp')."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from ray_tpu import ops
+
+    key = jax.random.PRNGKey(0)
+    N, E, V = 64, 32, 512
+    hidden = jax.random.normal(key, (N, E), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(1), (E, V), jnp.float32) * 0.1
+    labels = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V)
+    base, _ = ops.fused_head_cross_entropy(hidden, head, labels, chunk=32)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else mesh:
+        sharded_fn = jax.jit(lambda h, w, l: ops.fused_head_cross_entropy(
+            h, w, l, chunk=32, logits_spec=P(None, "tp"))[0])
+        out = sharded_fn(hidden, head, labels)
+    np.testing.assert_allclose(float(out), float(base), rtol=1e-5)
