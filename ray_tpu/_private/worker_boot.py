@@ -16,22 +16,31 @@ import os
 import sys
 
 
+def _reexec_under(python: str) -> None:
+    # ray_tpu itself isn't installed into the env: pin its parent dir
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pp = os.environ.get("PYTHONPATH", "")
+    parts = [p for p in pp.split(os.pathsep) if p]
+    if pkg_parent not in parts:
+        parts.insert(0, pkg_parent)
+    os.environ["PYTHONPATH"] = os.pathsep.join(parts)
+    os.execv(python, [python, "-m", "ray_tpu._private.worker_main"])
+
+
 def main():
     renv = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV") or "{}")
+    conda_spec = renv.get("conda")
+    if conda_spec:
+        from ray_tpu._private.runtime_env_conda import ensure_conda_env
+
+        _reexec_under(ensure_conda_env(conda_spec))
     pip_spec = renv.get("pip")
     if pip_spec:
         from ray_tpu._private.runtime_env_pip import ensure_venv
 
         python = ensure_venv(pip_spec)
-        # ray_tpu itself isn't installed into the venv: pin its parent dir
-        pkg_parent = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        pp = os.environ.get("PYTHONPATH", "")
-        parts = [p for p in pp.split(os.pathsep) if p]
-        if pkg_parent not in parts:
-            parts.insert(0, pkg_parent)
-        os.environ["PYTHONPATH"] = os.pathsep.join(parts)
-        os.execv(python, [python, "-m", "ray_tpu._private.worker_main"])
+        _reexec_under(python)
     from ray_tpu._private import worker_main
 
     sys.exit(worker_main.main())

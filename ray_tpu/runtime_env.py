@@ -80,10 +80,24 @@ def package(runtime_env: dict, kv_put, kv_get) -> dict:
         from ray_tpu._private.runtime_env_pip import normalize_pip
 
         out["pip"] = normalize_pip(pip_spec)
+    conda_spec = env.pop("conda", None)
+    if conda_spec is not None:
+        if pip_spec:
+            raise ValueError(
+                "runtime_env cannot set both 'pip' and 'conda' (the conda "
+                "spec's dependencies list takes pip sub-entries instead)")
+        from ray_tpu._private.runtime_env_conda import normalize_conda
+
+        out["conda"] = normalize_conda(conda_spec)
+    image = env.pop("image_uri", None)
+    if image is not None:
+        from ray_tpu._private.runtime_env_container import normalize_image_uri
+
+        out["image_uri"] = normalize_image_uri(image)
     if env:
         raise ValueError(f"unsupported runtime_env keys: {sorted(env)} "
                          "(supported: env_vars, working_dir, py_modules, "
-                         "pip, uv)")
+                         "pip, uv, conda, image_uri)")
     return out
 
 

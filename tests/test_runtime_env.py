@@ -30,8 +30,11 @@ def test_env_hash_stability_and_normalization(tmp_path):
     n2 = renv.package({"env_vars": {"A": "1", "B": "2"}}, kv.__setitem__, kv.get)
     assert renv.env_hash(n1) == renv.env_hash(n2) != ""
     assert renv.env_hash(None) == renv.env_hash({}) == ""
+    # conda is SUPPORTED as of round 4 (runtime_env_conda.py)
+    assert renv.package({"conda": "env"}, kv.__setitem__,
+                        kv.get)["conda"] == "env"
     with pytest.raises(ValueError):
-        renv.package({"conda": "env"}, kv.__setitem__, kv.get)
+        renv.package({"container": {}}, kv.__setitem__, kv.get)
     with pytest.raises(TypeError):
         renv.package({"env_vars": {"A": 1}}, kv.__setitem__, kv.get)
 
