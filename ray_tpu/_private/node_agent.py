@@ -208,19 +208,11 @@ class NodeAgent:
                 env["JAX_PLATFORMS"] = platform
                 if platform == "cpu":
                     env.pop("PALLAS_AXON_POOL_IPS", None)
-            entry = ("ray_tpu._private.worker_boot"
-                     if runtime_env and (runtime_env.get("pip") or runtime_env.get("conda"))
-                     else "ray_tpu._private.worker_main")
-            argv = [sys.executable, "-m", entry]
-            if runtime_env and runtime_env.get("image_uri"):
-                # containerized worker on a follower host — same wrapper
-                # as the head node's spawner (runtime_env_container)
-                from ray_tpu._private.runtime_env_container import (
-                    container_argv, find_engine)
+            from ray_tpu._private.runtime_env_container import (
+                boot_entry, build_worker_argv)
 
-                argv = container_argv(
-                    runtime_env["image_uri"], argv, env,
-                    session_dir=self.session_dir, engine=find_engine())
+            argv = build_worker_argv(runtime_env, env, self.session_dir,
+                                     boot_entry(runtime_env))
             log = open(os.path.join(self.session_dir, "logs",
                                     f"worker-{len(self._procs)}.log"), "ab")
             try:

@@ -161,6 +161,22 @@ def parse_address(address: str) -> tuple[str, str | tuple[str, int]]:
 
 
 def connect_address(address: str, timeout: float = 30.0) -> MsgConnection:
+    if address.startswith("proxy://"):
+        # Ray-Client-style proxied connection: versioned handshake, then a
+        # per-client relay bridges this socket to the GCS
+        # (util/client/proxier.py)
+        import socket as _socket
+        import uuid as _uuid
+
+        from ray_tpu.util.client.proxier import client_handshake
+
+        host, _, port = address[len("proxy://"):].rpartition(":")
+        sock = _socket.create_connection((host or "127.0.0.1", int(port)),
+                                         timeout=timeout)
+        client_handshake(sock, client_id=_uuid.uuid4().hex[:12])
+        sock.settimeout(None)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return MsgConnection(sock)
     kind, target = parse_address(address)
     if kind == "unix":
         return connect_unix(target, timeout)

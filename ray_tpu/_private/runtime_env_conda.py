@@ -21,23 +21,9 @@ import subprocess
 
 
 def conda_base() -> str:
-    """Per-user 0700 directory (override: RAY_TPU_CONDA_ENV_BASE). A fixed
-    world-writable path would let another local user pre-plant a fake env
-    at a predictable spec hash that worker_boot would exec (same hardening
-    as runtime_env_pip.venv_base)."""
-    import stat
-    import tempfile
+    from ray_tpu._private.runtime_env_pip import secure_user_base
 
-    base = os.environ.get("RAY_TPU_CONDA_ENV_BASE") or os.path.join(
-        tempfile.gettempdir(), f"ray_tpu_conda_{os.getuid()}")
-    os.makedirs(base, mode=0o700, exist_ok=True)
-    info = os.stat(base)
-    if info.st_uid != os.getuid() or info.st_mode & (stat.S_IWGRP
-                                                     | stat.S_IWOTH):
-        raise RuntimeError(
-            f"refusing conda env base {base!r}: not owned by uid "
-            f"{os.getuid()} or group/world-writable")
-    return base
+    return secure_user_base("RAY_TPU_CONDA_ENV_BASE", "ray_tpu_conda")
 
 
 def find_conda(conda_exe: str | None = None) -> str:
@@ -52,16 +38,29 @@ def find_conda(conda_exe: str | None = None) -> str:
 
 
 def normalize_conda(spec) -> str | dict:
-    """Named env → str; inline spec → canonical {dependencies: [...]}."""
+    """Named env → str; inline spec → canonical
+    {dependencies: [...], channels?: [...]}. Unknown keys are rejected —
+    silently dropping e.g. channels would build a DIFFERENT env than the
+    user asked for and collide cache hashes across channel lists."""
     if isinstance(spec, str):
         return spec
     if isinstance(spec, dict):
+        extra = set(spec) - {"dependencies", "channels", "name"}
+        if extra:
+            raise TypeError(
+                f"unsupported conda spec keys {sorted(extra)} (supported: "
+                "dependencies, channels, name)")
         deps = spec.get("dependencies")
         if not isinstance(deps, list) or not deps:
             raise TypeError(
                 "runtime_env['conda'] dict needs a non-empty "
                 "'dependencies' list (conda environment.yml schema)")
         out = {"dependencies": _canon_deps(deps)}
+        channels = spec.get("channels")
+        if channels:
+            if not all(isinstance(c, str) for c in channels):
+                raise TypeError("conda 'channels' must be strings")
+            out["channels"] = list(channels)  # ORDER is priority: keep it
         return out
     raise TypeError("runtime_env['conda'] must be an env name (str) or an "
                     "environment.yml-style dict")
@@ -90,7 +89,11 @@ def conda_hash(normalized) -> str:
 def _env_yaml(normalized: dict) -> str:
     """environment.yml text from the canonical spec (hand-rendered: the
     schema subset here is flat lists, no yaml dependency needed)."""
-    lines = ["dependencies:"]
+    lines = []
+    if normalized.get("channels"):
+        lines.append("channels:")
+        lines.extend(f"  - {c}" for c in normalized["channels"])
+    lines.append("dependencies:")
     for d in normalized["dependencies"]:
         if isinstance(d, str):
             lines.append(f"  - {d}")

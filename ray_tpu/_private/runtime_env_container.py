@@ -61,10 +61,32 @@ def container_argv(image_uri: str, worker_argv: list, env: dict, *,
     worker_argv = list(worker_argv)
     # the HOST interpreter path doesn't exist inside the image: the image
     # provides the python (with the framework's deps); PATH resolves it
-    if worker_argv and worker_argv[0].endswith(("python", "python3"))             and os.path.isabs(worker_argv[0]):
+    if worker_argv and os.path.isabs(worker_argv[0]) \
+            and os.path.basename(worker_argv[0]).startswith("python"):
         worker_argv[0] = "python3"
     argv += worker_argv
     return argv
+
+
+def build_worker_argv(runtime_env: dict | None, env: dict,
+                      session_dir: str, entry: str) -> list:
+    """The spawn argv for one worker given its runtime env — shared by the
+    head-node and follower-agent spawners so entry selection and container
+    wrapping stay in ONE place."""
+    import sys
+
+    argv = [sys.executable, "-m", entry]
+    if runtime_env and runtime_env.get("image_uri"):
+        argv = container_argv(runtime_env["image_uri"], argv, env,
+                              session_dir=session_dir, engine=find_engine())
+    return argv
+
+
+def boot_entry(runtime_env: dict | None) -> str:
+    """worker_boot (env built in the worker) vs worker_main (direct)."""
+    if runtime_env and (runtime_env.get("pip") or runtime_env.get("conda")):
+        return "ray_tpu._private.worker_boot"
+    return "ray_tpu._private.worker_main"
 
 
 def _repo_root() -> str:

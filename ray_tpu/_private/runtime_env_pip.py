@@ -29,22 +29,27 @@ import sys
 PIP_TIMEOUT_S = 600.0
 
 
-def venv_base() -> str:
-    """Per-user 0700 directory (override: RAY_TPU_VENV_BASE). A fixed
-    world-writable path would let another local user pre-plant a venv at a
-    predictable content hash that worker_boot would exec."""
+def secure_user_base(env_var: str, prefix: str) -> str:
+    """Per-user 0700 cache directory (override via `env_var`). A fixed
+    world-writable path would let another local user pre-plant an env at a
+    predictable content hash that worker_boot would exec — shared hardening
+    for every env cache (pip venvs, conda prefixes)."""
     import stat
     import tempfile
 
-    base = os.environ.get("RAY_TPU_VENV_BASE") or os.path.join(
-        tempfile.gettempdir(), f"ray_tpu_venvs_{os.getuid()}")
+    base = os.environ.get(env_var) or os.path.join(
+        tempfile.gettempdir(), f"{prefix}_{os.getuid()}")
     os.makedirs(base, mode=0o700, exist_ok=True)
     info = os.stat(base)
     if info.st_uid != os.getuid() or info.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
         raise RuntimeError(
-            f"refusing venv base {base!r}: not owned by uid {os.getuid()} "
+            f"refusing env base {base!r}: not owned by uid {os.getuid()} "
             "or group/world-writable")
     return base
+
+
+def venv_base() -> str:
+    return secure_user_base("RAY_TPU_VENV_BASE", "ray_tpu_venvs")
 
 
 def pip_hash(entries: list[str]) -> str:

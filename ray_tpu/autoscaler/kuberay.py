@@ -219,8 +219,15 @@ class KubeRayNodeProvider(NodeProvider):
         # or expire (operator wedged / quota: stop waiting after the TTL
         # so the reconciler can retry)
         now = time.monotonic()
-        self._pending = {lid: v for lid, v in self._pending.items()
-                         if now - v[1] < self.launch_ttl_s}
+        expired = [lid for lid, v in self._pending.items()
+                   if now - v[1] >= self.launch_ttl_s]
+        for lid in expired:
+            # roll the replica bump back, or every expiry would leak one
+            # replica the operator eventually materializes as an extra pod
+            try:
+                self.terminate_node(lid)
+            except Exception:
+                self._pending.pop(lid, None)  # give up; retried next pass
         return out + list(self._pending)
 
     def is_ready(self, node_id: str) -> bool:

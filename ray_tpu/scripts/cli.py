@@ -263,6 +263,21 @@ def cmd_dashboard(args):
         head.stop()
 
 
+def cmd_client_proxy(args):
+    """Serve Ray-Client-style proxied connections (util/client/proxier)."""
+    import time as _time
+
+    from ray_tpu.util.client import start_proxy
+
+    proxy = start_proxy(args.address, args.host, args.port)
+    print(f"client proxy on {proxy.address} -> {args.address}")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
 def cmd_microbenchmark(args):
     from ray_tpu._private import ray_perf
 
@@ -351,6 +366,14 @@ def main(argv=None):
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("client-proxy",
+                        help="serve proxied client connections (ray client)")
+    sp.add_argument("--address", required=True,
+                    help="GCS address (host:port) to bridge clients to")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=10001)
+    sp.set_defaults(fn=cmd_client_proxy)
 
     sp = sub.add_parser("submit", help="submit a job (command) to the cluster")
     sp.add_argument("--no-wait", action="store_true")

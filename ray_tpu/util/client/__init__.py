@@ -1,0 +1,6 @@
+"""Ray-Client-style proxied connections (reference: ray.util.client)."""
+
+from ray_tpu.util.client.proxier import (PROTOCOL_VERSION, ClientProxy,
+                                         start_proxy)
+
+__all__ = ["ClientProxy", "PROTOCOL_VERSION", "start_proxy"]

@@ -1,0 +1,219 @@
+"""Client proxy: per-client proxied connections with versioned handshake.
+
+Reference capability: python/ray/util/client/server/proxier.py — the head
+runs ONE proxy endpoint; every connecting client gets its OWN SpecificServer
+process, version skew is rejected at handshake, and a client's disconnect
+tears its server down (which releases everything the client held). This is
+how `ray.init("ray://...")` clients stay isolated from each other.
+
+TPU build: the proxy accepts `proxy://host:port` clients, checks the
+protocol version, spawns a per-client RELAY subprocess bridging the client
+to the GCS, and kills it when the client goes away:
+
+- fault isolation: a client that floods or crashes its relay affects only
+  its own subprocess, never the proxy or other clients;
+- lifecycle: the relay's GCS connection IS the client's driver identity —
+  when the client disconnects the relay exits, the GCS sees the driver die
+  and reclaims its refs/leases/actors through the normal death path
+  (`_on_worker_death` driver handling);
+- streams: log pushes and long-poll replies ride the same relayed framed
+  protocol, so `log_to_driver` and pubsub work unchanged.
+
+The framed protocol itself still executes pickled payloads cluster-side
+(the documented trusted-network assumption, protocol.py); the proxy adds
+the reference's per-client process model and version gate on top.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+from typing import Dict, Optional
+
+# bump the MAJOR half on wire-incompatible changes; clients with a
+# different major are refused at handshake (reference: proxier checks
+# ray version/commit before granting a server)
+PROTOCOL_VERSION = "1.0"
+
+_HELLO_MAGIC = b"RTPUCLNT"
+
+
+def _send_json(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_json(sock: socket.socket) -> dict:
+    head = _recv_exact(sock, 4)
+    (n,) = struct.unpack("<I", head)
+    if n > 1 << 20:
+        raise ValueError("oversized handshake frame")
+    return json.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during handshake")
+        buf += chunk
+    return buf
+
+
+def _compatible(client_version: str) -> bool:
+    return client_version.split(".")[0] == PROTOCOL_VERSION.split(".")[0]
+
+
+class ClientProxy:
+    """Accepts clients, runs the handshake, and hands each one a dedicated
+    relay subprocess (ray_tpu.util.client.relay)."""
+
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gcs_address = gcs_address
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self.host = host
+        self._clients: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"proxy://{self.host}:{self.port}"
+
+    def start(self) -> "ClientProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="client-proxy")
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn, addr),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket, addr) -> None:
+        import uuid as _uuid
+
+        conn_key = _uuid.uuid4().hex  # per-CONNECTION: a duplicate
+        # client-supplied id must not alias another client's relay
+        client_id = "?"
+        try:
+            magic = _recv_exact(conn, len(_HELLO_MAGIC))
+            if magic != _HELLO_MAGIC:
+                conn.close()
+                return
+            hello = _recv_json(conn)
+            client_id = str(hello.get("client_id") or f"{addr[0]}:{addr[1]}")
+            version = str(hello.get("version") or "")
+            if not _compatible(version):
+                _send_json(conn, {
+                    "ok": False,
+                    "error": f"client protocol {version!r} incompatible "
+                             f"with server {PROTOCOL_VERSION!r}"})
+                conn.close()
+                return
+            # dedicated relay: its stdin holds the client socket via fd
+            # passing-free trick — the relay CONNECTS BACK to a per-client
+            # ephemeral listener we hand it
+            hand = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            hand.bind(("127.0.0.1", 0))
+            hand.listen(1)
+            hand_port = hand.getsockname()[1]
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.util.client.relay",
+                 "--gcs", self.gcs_address, "--back", str(hand_port)],
+                env=dict(os.environ))
+            with self._lock:
+                self._clients[conn_key] = proc
+            hand.settimeout(30.0)
+            relay_side, _ = hand.accept()
+            hand.close()
+            _send_json(conn, {"ok": True, "version": PROTOCOL_VERSION,
+                              "client_id": client_id})
+            # splice bytes both ways until either side closes; then kill
+            # the relay so the GCS runs driver-death cleanup
+            t = threading.Thread(target=_pump, args=(relay_side, conn),
+                                 daemon=True)
+            t.start()
+            _pump(conn, relay_side)
+            t.join(timeout=5.0)
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                proc = self._clients.pop(conn_key, None)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    def num_clients(self) -> int:
+        with self._lock:
+            return sum(1 for p in self._clients.values() if p.poll() is None)
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            procs = list(self._clients.values())
+            self._clients.clear()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+def start_proxy(gcs_address: str, host: str = "127.0.0.1",
+                port: int = 0) -> ClientProxy:
+    return ClientProxy(gcs_address, host, port).start()
+
+
+def client_handshake(sock: socket.socket, client_id: str) -> dict:
+    """Client side of the hello exchange; raises on version refusal."""
+    sock.sendall(_HELLO_MAGIC)
+    _send_json(sock, {"client_id": client_id, "version": PROTOCOL_VERSION})
+    reply = _recv_json(sock)
+    if not reply.get("ok"):
+        raise ConnectionError(reply.get("error") or "proxy refused client")
+    return reply

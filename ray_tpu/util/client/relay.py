@@ -1,0 +1,62 @@
+"""Per-client relay process (the proxier's SpecificServer analogue).
+
+One process per connected client: connects back to the proxy's hand-off
+listener on one side and to the GCS on the other, then splices bytes.
+Its GCS TCP connection carries the client's driver registration, so this
+process dying (client disconnect, crash, proxy kill) makes the GCS run
+the normal driver-death cleanup for everything the client held.
+
+(reference: util/client/server/proxier.py SpecificServer — a dedicated
+ray client server process per client, reaped on disconnect.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import threading
+
+from ray_tpu._private.protocol import parse_address
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs", required=True)
+    p.add_argument("--back", type=int, required=True)
+    args = p.parse_args(argv)
+
+    back = socket.create_connection(("127.0.0.1", args.back), timeout=30.0)
+    kind, target = parse_address(args.gcs)
+    if kind == "unix":
+        gcs = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        gcs.connect(target)
+    else:
+        gcs = socket.create_connection(target, timeout=30.0)
+
+    done = threading.Event()
+
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            done.set()
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    threading.Thread(target=pump, args=(back, gcs), daemon=True).start()
+    threading.Thread(target=pump, args=(gcs, back), daemon=True).start()
+    done.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

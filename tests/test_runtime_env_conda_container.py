@@ -171,3 +171,19 @@ os.execv(cmd[0], cmd)
         assert ray_tpu.get(where_am_i.remote(), timeout=120) == "test/img:1"
     finally:
         ray_tpu.shutdown()
+
+
+def test_conda_channels_in_spec_and_yaml():
+    spec = {"dependencies": ["numpy"], "channels": ["conda-forge", "defaults"]}
+    out = normalize_conda(spec)
+    assert out["channels"] == ["conda-forge", "defaults"]  # priority order
+    # channel lists change the cache hash — different channels, different env
+    assert conda_hash(out) != conda_hash(
+        normalize_conda({"dependencies": ["numpy"]}))
+    run = FakeRun()
+    ensure_conda_env(spec, conda_exe="/fake/conda", runner=run)
+    yml_path = [c for c in run.calls if c[1:3] == ["env", "create"]][0]
+    text = open(yml_path[yml_path.index("-f") + 1]).read()
+    assert "channels:" in text and "conda-forge" in text
+    with pytest.raises(TypeError, match="unsupported conda spec keys"):
+        normalize_conda({"dependencies": ["x"], "variables": {"A": "1"}})
