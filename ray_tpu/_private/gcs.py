@@ -765,6 +765,20 @@ class GcsServer:
                        "session_id": self.session_id})
             self._schedule()
             return wid
+        if t == "resource_view":
+            # follower load delta (reference: ray_syncer resource-view
+            # broadcasts) — stored on the host entry, served per node by
+            # list_nodes (and the dashboard's nodes page on top of it)
+            with self.lock:
+                info = self.hosts.get(msg.get("host_id"))
+                if info is not None:
+                    info["view"] = {
+                        "mem_usage": msg.get("mem_usage"),
+                        "load1": msg.get("load1"),
+                        "num_worker_procs": msg.get("num_worker_procs"),
+                        "ts": time.monotonic(),
+                    }
+            return wid
         if t == "pong":
             with self.lock:
                 info = self.hosts.get(msg.get("host_id"))
@@ -1092,7 +1106,8 @@ class GcsServer:
                 nodes = [
                     {"node_id": n.node_id, "alive": n.alive, "labels": dict(n.labels),
                      "total": dict(n.total), "available": dict(n.available),
-                     "quarantined_chips": list(n.quarantined_chips)}
+                     "quarantined_chips": list(n.quarantined_chips),
+                     "host_view": self._host_view_for(n.node_id)}
                     for n in self.nodes.values()
                 ]
             conn.send({"rid": msg["rid"], "nodes": nodes})
@@ -2078,6 +2093,23 @@ class GcsServer:
             self._on_object_ready(oid, where="inline", inline=blob,
                                   size=len(blob), is_error=True,
                                   only_if_pending=True)
+
+    def _host_view_for(self, node_id: str) -> dict | None:
+        """Latest resource-view delta of the host backing a node (caller
+        holds the lock). Views older than 3 intervals are served with a
+        stale flag rather than dropped — a wedged agent's LAST view is
+        still diagnostic."""
+        host = self.node_hosts.get(node_id, HEAD_HOST)
+        view = (self.hosts.get(host) or {}).get("view")
+        if not view:
+            return None
+        out = dict(view)
+        age = time.monotonic() - out.pop("ts")
+        out["age_s"] = round(age, 1)
+        # instance() (not get()) — this runs per node under the GCS lock
+        interval = RayConfig.instance().resource_view_interval_s
+        out["stale"] = age > 3 * max(0.1, interval)
+        return out
 
     def _pinned_fn_keys_locked(self) -> set:
         """fn: store keys that MUST survive eviction: referenced by a

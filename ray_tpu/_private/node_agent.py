@@ -148,13 +148,45 @@ class NodeAgent:
         except ConnectionClosed:
             pass
 
+    def _resource_view(self) -> dict:
+        """One periodic resource-view delta (reference: ray_syncer's
+        RESOURCE_VIEW channel — raylets broadcast their load so the rest
+        of the cluster schedules on fresh state, syncer.h). Here the view
+        feeds the GCS host table, the state API and the dashboard."""
+        from ray_tpu._private.memory_monitor import host_memory_usage
+
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        live = sum(1 for p in self._procs if p.poll() is None)
+        return {"type": "resource_view", "host_id": self.host_id,
+                "mem_usage": round(host_memory_usage(), 4),
+                "load1": round(load1, 2), "num_worker_procs": live}
+
+    def _view_loop(self, period_s: float):
+        while not self._stopping:
+            time.sleep(period_s)
+            try:
+                self.conn.send(self._resource_view())
+            except ConnectionClosed:
+                return
+
     def serve_forever(self):
+        from ray_tpu._private.ray_config import RayConfig
+
+        period = RayConfig.get("resource_view_interval_s")
+        self._stopping = False
+        if period > 0:
+            threading.Thread(target=self._view_loop, args=(period,),
+                             daemon=True, name="agent-view").start()
         try:
             while True:
                 self._dispatch(self.conn.recv())
         except ConnectionClosed:
             pass
         finally:
+            self._stopping = True
             self.shutdown()
 
     def _dispatch(self, msg: dict):

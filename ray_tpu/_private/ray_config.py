@@ -84,6 +84,11 @@ class RayConfig:
     # (reference: gcs_health_check_manager.h:45, ray_config_def.h:877).
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    # Follower agents broadcast a resource-view delta (memory usage, load,
+    # live worker count) this often (reference: ray_syncer RESOURCE_VIEW
+    # messages); 0 disables. Feeds the GCS host table / state API /
+    # dashboard.
+    resource_view_interval_s: float = 2.0
 
     # --- worker pool ----------------------------------------------------
     # Warm-pool floor: keep this many idle no-runtime-env CPU workers per

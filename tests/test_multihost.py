@@ -144,3 +144,25 @@ def test_remote_driver_joins_by_tcp_address(cluster):
                        text=True, timeout=120, env=env, cwd="/root/repo")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "joined-result 42" in r.stdout
+
+
+@pytest.mark.slow
+def test_resource_view_deltas_reach_gcs(cluster):
+    """Follower agents broadcast periodic resource-view deltas (reference:
+    ray_syncer RESOURCE_VIEW) that surface per node in the state API."""
+    import time as _time
+
+    cluster.add_host(num_cpus=2)
+    deadline = _time.time() + 20
+    view = None
+    while _time.time() < deadline:
+        nodes = ray_tpu.nodes()
+        follower = [n for n in nodes if n["node_id"] != "node-0"]
+        if follower and follower[0].get("host_view"):
+            view = follower[0]["host_view"]
+            break
+        _time.sleep(0.3)
+    assert view, "no resource view arrived from the follower agent"
+    assert 0.0 < view["mem_usage"] < 1.0
+    assert view["num_worker_procs"] >= 0
+    assert view["age_s"] < 10 and not view["stale"]
