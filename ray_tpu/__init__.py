@@ -25,6 +25,7 @@ from ray_tpu._private.api import (
 )
 from ray_tpu._private.worker import ObjectRef
 from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.cross_lang import cpp_function, start_cpp_worker
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu import exceptions
 from ray_tpu import util
@@ -40,6 +41,7 @@ __all__ = [
     "cancel",
     "cluster_resources",
     "cluster_state",
+    "cpp_function",
     "exceptions",
     "free",
     "get",
@@ -53,6 +55,7 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "start_cpp_worker",
     "wait",
     "util",
     "__version__",

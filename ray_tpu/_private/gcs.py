@@ -55,11 +55,13 @@ class _Worker:
     __slots__ = ("wid", "conn", "pid", "idle", "actor_id", "dead", "kind",
                  "running_tasks", "node_id", "tpu_chips", "host_id",
                  "ref_balance", "renv_hash", "direct_addr", "leased_to",
-                 "lease_spec", "lease_token", "oom_why", "oom_ts")
+                 "lease_spec", "lease_token", "oom_why", "oom_ts",
+                 "language", "functions")
 
     def __init__(self, wid: str, conn: MsgConnection, pid: int, kind: str, node_id: str,
                  tpu_chips: tuple = (), host_id: str = "host-0",
-                 renv_hash: str = "", direct_addr: str | None = None):
+                 renv_hash: str = "", direct_addr: str | None = None,
+                 language: str = "py", functions: tuple = ()):
         self.host_id = host_id
         self.wid = wid
         self.conn = conn
@@ -87,6 +89,11 @@ class _Worker:
         self.lease_token: int | None = None  # guards stale release messages
         self.oom_why: str | None = None  # set by the memory monitor pre-kill
         self.oom_ts: float = 0.0  # when; stale tags are ignored on death
+        # cross-language workers (reference: C++/Java API workers) execute
+        # REGISTERED named functions; only specs of their language dispatch
+        # to them
+        self.language = language
+        self.functions = tuple(functions)
 
 
 class _Actor:
@@ -167,7 +174,7 @@ class _PendingShards:
             return None
         res = spec.get("resources") or {}
         return (tuple(sorted((k, float(v)) for k, v in res.items())),
-                spec.get("renv_hash", ""))
+                spec.get("renv_hash", ""), spec.get("lang", "py"))
 
     def _dq(self, spec: dict) -> collections.deque:
         k = self.key_of(spec)
@@ -701,6 +708,10 @@ class GcsServer:
     def _handle(self, conn: MsgConnection, msg: dict, wid: str | None) -> str | None:
         t = msg["type"]
         if t == "register":
+            if msg.get("codec") == "json":
+                # language-neutral peer (e.g. the C++ worker): reply frames
+                # must be JSON from the first message on
+                conn.codec = "json"
             with self.lock:
                 wid = msg["wid"]
                 node_id = msg.get("node_id") or DEFAULT_NODE
@@ -737,7 +748,9 @@ class GcsServer:
                         wid, conn, msg.get("pid", 0), msg["kind"], node_id,
                         tpu_chips=chips, host_id=msg.get("host") or HEAD_HOST,
                         renv_hash=renv_hash,
-                        direct_addr=msg.get("direct_addr"))
+                        direct_addr=msg.get("direct_addr"),
+                        language=msg.get("language", "py"),
+                        functions=tuple(msg.get("functions") or ()))
             if not accepted:
                 conn.send({"rid": msg["rid"], "ok": False,
                            "error": "stale chip binding; exit"})
@@ -890,6 +903,8 @@ class GcsServer:
             if "rid" in msg:
                 conn.send({"rid": msg["rid"], "ok": True})
         elif t == "task_done":
+            if conn.codec == "json":
+                self._convert_cross_lang_done(msg)
             self._on_task_done(msg)
         elif t == "object_put":
             self._on_object_ready(msg["oid"], where=msg.get("where", "shm"),
@@ -2081,6 +2096,26 @@ class GcsServer:
                 w.idle = True
         self._schedule()
 
+    def _convert_cross_lang_done(self, msg: dict) -> None:
+        """A JSON-codec (cross-language) worker reports plain JSON result
+        values; Python consumers unpickle inline blobs, so re-encode each
+        value (or the error) here. Mutates msg into the standard
+        task_done shape."""
+        import ray_tpu._private.serialization as ser
+        from ray_tpu.exceptions import RayTpuError
+
+        err = msg.get("error")
+        results = []
+        for res in msg.get("results") or ():
+            oid, where, value = res[0], res[1], res[2]
+            if err is not None:
+                blob = ser.dumps(RayTpuError(
+                    f"cross-language task failed: {err}"))
+            else:
+                blob = ser.dumps(value)
+            results.append([oid, where, blob, len(blob)])
+        msg["results"] = results
+
     def _fail_orphaned_stubs(self, oids) -> None:
         """Error pending stubs whose promised publisher is gone (caller
         holds no lock)."""
@@ -2299,17 +2334,46 @@ class GcsServer:
 
             def dispatch(spec) -> bool:
                 nonlocal dispatched_any
-                node_id = self._fits_for(spec)
-                if node_id is None or not self._deps_ready(spec):
-                    return False
-                # whole-chip TPU specs need a worker spawned with exactly
-                # that many chips visible; CPU specs need a chipless worker
-                # (a chip worker must stay free for TPU demand)
+                lang = spec.get("lang", "py")
                 need = accelerators.chips_required(spec.get("resources", {}))
                 rh = spec.get("renv_hash", "")
-                pool = idle_by_node.get(node_id, [])
-                w = next((x for x in pool if len(x.tpu_chips) == need
-                          and x.renv_hash == rh), None)
+                if lang != "py":
+                    # cross-language workers self-join on whatever node
+                    # their operator chose: place the task WHERE such a
+                    # worker is, not where resources look emptiest (the
+                    # GCS cannot spawn one, so demand registration is
+                    # pointless). Prefer a worker that registered the
+                    # function by name.
+                    if not self._deps_ready(spec):
+                        return False
+                    fname = spec.get("func_name")
+                    cands = [x for pool in idle_by_node.values()
+                             for x in pool
+                             if x.language == lang
+                             and len(x.tpu_chips) == need
+                             and x.renv_hash == rh
+                             and pg_policy._fits(
+                                 self.nodes[x.node_id].available,
+                                 spec.get("resources", {}))]
+                    if not cands:
+                        return False
+                    w = next((x for x in cands
+                              if not x.functions or fname in x.functions),
+                             cands[0])
+                    node_id = w.node_id
+                    pool = idle_by_node.get(node_id, [])
+                else:
+                    node_id = self._fits_for(spec)
+                    if node_id is None or not self._deps_ready(spec):
+                        return False
+                    # whole-chip TPU specs need a worker spawned with
+                    # exactly that many chips visible; CPU specs need a
+                    # chipless worker (a chip worker must stay free for
+                    # TPU demand)
+                    pool = idle_by_node.get(node_id, [])
+                    w = next((x for x in pool if len(x.tpu_chips) == need
+                              and x.renv_hash == rh and x.language == lang),
+                             None)
                 if w is None:
                     want_spawn[(node_id, need, rh)] += 1
                     return False
@@ -2384,12 +2448,15 @@ class GcsServer:
                         del self.pending_tasks.shards[key]
                         continue
                     res = dq[0].get("resources") or {}
-                    rh = key[1]
+                    rh, lang = key[1], key[2]
                     need = accelerators.chips_required(res)
                     if any(len(x.tpu_chips) == need and x.renv_hash == rh
+                           and x.language == lang
                            for pool in idle_by_node.values() for x in pool):
                         scan(dq)
                         continue
+                    if lang != "py":
+                        continue  # cross-language workers self-join: no spawn
                     # no matching idle worker anywhere: nothing in this
                     # shard can dispatch this pass. Register spawn demand
                     # for the RUNNABLE prefix only (a dep-blocked shard must
@@ -2417,7 +2484,8 @@ class GcsServer:
                         continue
                     idle_plain = sum(
                         1 for x in idle_by_node.get(node_id_w, ())
-                        if not x.tpu_chips and x.renv_hash == "")
+                        if not x.tpu_chips and x.renv_hash == ""
+                        and x.language == "py")
                     if self.warm_pool_size > idle_plain:
                         warm_needs[node_id_w] = self.warm_pool_size - idle_plain
 
@@ -2598,6 +2666,8 @@ class GcsServer:
                 break
             if (w.kind == "worker" and not w.dead and w.idle
                     and w.actor_id is None and w.node_id == node_id
+                    and w.language == "py"  # self-joined cpp workers are
+                    # not respawnable: never retire them for headroom
                     and (len(w.tpu_chips) != need
                          or w.renv_hash != renv_hash)):
                 w.dead = True

@@ -70,6 +70,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+# language-neutral frame payload marker: "\0JSN" + UTF-8 JSON. Pickles
+# can never start with a NUL byte (proto>=2 starts \x80; proto 0/1 with a
+# printable opcode), so recv() can auto-detect the codec per frame —
+# that's what lets non-Python workers (cpp/cpp_worker.cc) speak the same
+# control plane.
+_JSON_MAGIC = b"\x00JSN"
+
+
 class MsgConnection:
     """Thread-safe framed connection; one reader, many writers."""
 
@@ -77,11 +85,17 @@ class MsgConnection:
         self.sock = sock
         self._send_lock = threading.Lock()
         self.closed = False
+        self.codec = "pickle"  # "json" for language-neutral peers
 
     def send(self, msg: dict) -> None:
         if _chaos.enabled and _chaos.intercept(msg):
             return  # injected drop
-        data = pickle.dumps(msg, protocol=5)
+        if self.codec == "json":
+            import json as _json
+
+            data = _JSON_MAGIC + _json.dumps(msg).encode()
+        else:
+            data = pickle.dumps(msg, protocol=5)
         if len(data) > MAX_FRAME:
             raise ValueError(f"frame too large: {len(data)}")
         with self._send_lock:
@@ -99,6 +113,10 @@ class MsgConnection:
         except (ConnectionResetError, OSError) as e:
             self.closed = True
             raise ConnectionClosed() from e
+        if data[:4] == _JSON_MAGIC:
+            import json as _json
+
+            return _json.loads(data[4:])
         return pickle.loads(data)
 
     def close(self) -> None:

@@ -25,6 +25,7 @@ from ray_tpu.data.dataset import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
     read_webdataset,
@@ -53,6 +54,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
     "read_webdataset",

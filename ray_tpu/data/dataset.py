@@ -605,6 +605,17 @@ def read_images(paths, *, size=None, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(ImageDatasource(paths, size), parallelism))
 
 
+def read_sql(sql: str, connection_factory, *, params: tuple = (),
+             parallelism: int = 1) -> Dataset:
+    """Query any DBAPI database (reference: read_api.py read_sql). Pass
+    parallelism > 1 only for dialects where `LIMIT ? OFFSET ?` over the
+    query is stable (e.g. an ORDER BY in `sql`)."""
+    from ray_tpu.data.datasource import SQLDatasource
+
+    return Dataset(L.Read(SQLDatasource(sql, connection_factory,
+                                        params=params), parallelism))
+
+
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(ds, parallelism))
 

@@ -318,3 +318,58 @@ def _json_safe(v: Any):
     if isinstance(v, np.ndarray):
         return v.tolist()
     return v
+
+
+class SQLDatasource(Datasource):
+    """Rows from any DBAPI-2.0 connection (reference:
+    data/_internal/datasource/sql_datasource.py — a connection FACTORY plus
+    a query; partitions read disjoint row ranges via OFFSET/LIMIT when a
+    parallelism > 1 is requested and the dialect supports it)."""
+
+    def __init__(self, sql: str, connection_factory: Callable,
+                 *, params: tuple = ()):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.params = tuple(params)
+
+    def _read(self, suffix: str = "", extra: tuple = ()) -> list:
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(self.sql + suffix, self.params + extra)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+        finally:
+            conn.close()
+        return rows
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        if parallelism <= 1:
+            return [ReadTask(lambda: [rows_to_block(r)]
+                             if (r := self._read()) else [])]
+        # count once, then hand each task a disjoint OFFSET/LIMIT window —
+        # the reference's sharded-read strategy for partitionable dialects
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            # the derived-table alias is REQUIRED by postgres/mysql and
+            # harmless on sqlite
+            cur.execute(f"SELECT COUNT(*) FROM ({self.sql}) AS _sub",
+                        self.params)
+            total = int(cur.fetchone()[0])
+        finally:
+            conn.close()
+        if total == 0:
+            return []
+        parallelism = max(1, min(parallelism, total))
+        step = (total + parallelism - 1) // parallelism
+        tasks = []
+        for start in range(0, total, step):
+            limit = min(step, total - start)
+
+            def fn(start=start, limit=limit):
+                rows = self._read(" LIMIT ? OFFSET ?", (limit, start))
+                return [rows_to_block(rows)] if rows else []
+
+            tasks.append(ReadTask(fn, num_rows=limit))
+        return tasks

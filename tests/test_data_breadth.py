@@ -338,3 +338,32 @@ def test_partition_values_sanitized(tmp_path):
     dirs = sorted(os.listdir(out))
     assert "tag=a%2Fb" in dirs  # '/' encoded, one component
     assert "tag=__HIVE_DEFAULT_PARTITION__" in dirs
+
+
+# ------------------------------------------------------------ sql reads
+
+
+def test_read_sql_roundtrip(tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE metrics (id INTEGER, name TEXT, v REAL)")
+    conn.executemany("INSERT INTO metrics VALUES (?, ?, ?)",
+                     [(i, f"m{i}", i * 0.5) for i in range(50)])
+    conn.commit()
+    conn.close()
+
+    factory = lambda: sqlite3.connect(db)  # noqa: E731
+    ds = rd.read_sql("SELECT * FROM metrics WHERE id >= ? ORDER BY id",
+                     factory, params=(10,))
+    rows = ds.take_all()
+    assert len(rows) == 40
+    assert rows[0] == {"id": 10, "name": "m10", "v": 5.0}
+    # partitioned read over OFFSET/LIMIT windows
+    ds4 = rd.read_sql("SELECT * FROM metrics ORDER BY id", factory,
+                      parallelism=4)
+    assert sorted(r["id"] for r in ds4.take_all()) == list(range(50))
+    # empty result: no read tasks, no error
+    assert rd.read_sql("SELECT * FROM metrics WHERE id > 999",
+                       factory, parallelism=4).take_all() == []
