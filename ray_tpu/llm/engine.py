@@ -49,6 +49,10 @@ class _Request:
     slot: int = -1
     generated: int = 0
     kv_pack: dict | None = None  # prefilled elsewhere (PD disaggregation)
+    # chunked-prefill progress (engine._prefill_step)
+    pf_done: int = 0
+    pf_pages: list | None = None
+    pf_hashes: list | None = None
 
 
 _SENTINEL = object()
@@ -147,6 +151,7 @@ class TPUEngine:
                  num_pages: int | None = None,
                  max_prefills_per_step: int = 2,
                  enable_prefix_cache: bool = False,
+                 prefill_chunk: int | None = None,
                  mesh=None):
         self.cfg = cfg
         self.max_len = max_len or cfg.max_seq_len
@@ -172,6 +177,18 @@ class TPUEngine:
                     f"min_bucket {min_bucket} must be a multiple of "
                     f"page_size {page_size} (every prompt bucket reshapes "
                     f"into whole pages)")
+            if prefill_chunk is not None:
+                if (prefill_chunk < min_bucket
+                        or prefill_chunk % page_size
+                        or bucket_for(prefill_chunk, min_bucket,
+                                      max_len or cfg.max_seq_len)
+                        != prefill_chunk):
+                    raise ValueError(
+                        f"prefill_chunk {prefill_chunk} must be one of the "
+                        f"engine's bucket sizes (min_bucket {min_bucket} "
+                        f"doublings) and a multiple of page_size "
+                        f"{page_size} — a non-bucket chunk would pad past "
+                        "its own page span and corrupt neighboring pages")
         self.buckets = []
         b = min_bucket
         while b < self.max_len:
@@ -215,11 +232,22 @@ class TPUEngine:
             self.prefix_hits = 0       # requests that reused ≥1 block
             self.prefix_misses = 0
             self.prefix_tokens_reused = 0
+            # chunked prefill (reference capability: vLLM chunked prefill):
+            # long prompts prefill in fixed chunks interleaved with decode
+            # steps so running requests keep emitting during a long
+            # admission instead of stalling a full prompt-bucket compile
+            self.prefill_chunk = prefill_chunk
+            self._prefilling: list = []  # requests mid-chunked-prefill
+            self.prefill_chunks_run = 0
         else:
             self.enable_prefix_cache = False
+            self.prefill_chunk = None
+            self._prefilling = []
             if enable_prefix_cache:
                 raise ValueError(
                     "enable_prefix_cache requires kv_layout='paged'")
+            if prefill_chunk is not None:
+                raise ValueError("prefill_chunk requires kv_layout='paged'")
             self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
         if mesh is not None:
             self.state = _shard_state_tp(self.state, mesh)
@@ -258,6 +286,7 @@ class TPUEngine:
                    num_pages=ek.get("num_pages"),
                    max_prefills_per_step=ek.get("max_prefills_per_step", 2),
                    enable_prefix_cache=ek.get("enable_prefix_cache", False),
+                   prefill_chunk=ek.get("prefill_chunk"),
                    mesh=ek.get("mesh"))
 
     def _check_alive(self):
@@ -347,6 +376,9 @@ class TPUEngine:
         for req in self._backlog:
             req.out_queue.put(marker)
         self._backlog.clear()
+        for req in self._prefilling:
+            req.out_queue.put(marker)
+        self._prefilling.clear()
         while True:
             try:
                 self._waiting.get_nowait().out_queue.put(marker)
@@ -529,14 +561,16 @@ class TPUEngine:
                     return  # page pressure: stop admitting this round
                 admitted += 1
                 continue
-            if self.kv_layout == "paged" and self.enable_prefix_cache:
+            if self.kv_layout == "paged" and (self.enable_prefix_cache
+                                              or self.prefill_chunk):
                 first_id = self._admit_cached(req, slot)
                 if first_id is None:
                     self._free.append(slot)
                     self._backlog.append(req)
                     return  # page pressure: stop admitting this round
                 admitted += 1
-                self._emit(req, first_id)
+                if first_id != -1:  # -1 = staged for chunked prefill
+                    self._emit(req, first_id)
                 continue
             n = len(req.tokens)
             bucket = self._bucket(n)
@@ -585,6 +619,23 @@ class TPUEngine:
         # zero-ref cached blocks, and the ones we just matched must not be
         # among them
         pre_pages = [self._prefix_cache[hashes[i]] for i in range(n_pre)]
+        chunk = self.prefill_chunk
+        staged = chunk is not None and len(suffix) > chunk
+        if staged:
+            # long admission: stage for chunk-at-a-time prefill interleaved
+            # with decode steps. Page need accounts for per-chunk bucket
+            # spans (the final partial chunk pads to its own bucket). The
+            # inflated count is committed ONLY if staging goes ahead — the
+            # whole-prompt fallback must keep its own (table-fitting) need.
+            rem = len(suffix) % chunk
+            tail_bucket = self._bucket(rem) if rem else 0
+            span = pre_len + (len(suffix) - rem) + tail_bucket
+            staged_pages = max(span // P, total_pages)
+            if staged_pages > self.max_pages_per_seq:
+                staged = False  # bucket roundup overflow: whole-prompt path
+            else:
+                total_pages = staged_pages
+        # pin matched blocks BEFORE allocating (eviction must not take them)
         for p in pre_pages:
             self._page_refs[p] = self._page_refs.get(p, 0) + 1
         priv = self._alloc_pages(total_pages - n_pre)
@@ -593,11 +644,20 @@ class TPUEngine:
                 self._page_refs[p] = self._page_refs.get(p, 1) - 1
             return None
         self._slot_shared[slot] = list(pre_pages)
-        if n_pre:
-            self.prefix_hits += 1
-            self.prefix_tokens_reused += pre_len
-        else:
-            self.prefix_misses += 1
+        if self.enable_prefix_cache:
+            if n_pre:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += pre_len
+            else:
+                self.prefix_misses += 1
+        if staged:
+            req.slot = slot
+            req.pf_done = pre_len
+            req.pf_pages = pre_pages + priv
+            req.pf_hashes = hashes
+            self._slot_pages[slot] = list(priv)
+            self._prefilling.append(req)
+            return -1  # staged: no first token yet
         padded = np.zeros((1, suf_bucket), np.int32)
         padded[0, :len(suffix)] = suffix
         if n_pre:
@@ -630,8 +690,63 @@ class TPUEngine:
             jnp.asarray(block_row), jnp.int32(n), first[0], self.cfg)
         self._set_row_sampling(slot, req.params)
         self._by_slot[slot] = req
-        self._register_blocks(slot, tokens, hashes, n_pre, priv)
+        if self.enable_prefix_cache:
+            self._register_blocks(slot, tokens, hashes, n_pre, priv)
         return int(first[0])
+
+    def _prefill_step(self):
+        """Run ONE chunk of the oldest staged prefill (called between
+        decode steps, so running requests keep emitting during a long
+        admission — reference capability: vLLM chunked prefill)."""
+        req = self._prefilling[0]
+        tokens = req.tokens
+        P = self.page_size
+        done = req.pf_done
+        chunk_toks = tokens[done:done + self.prefill_chunk]
+        is_last = done + len(chunk_toks) >= len(tokens)
+        bucket = self._bucket(len(chunk_toks))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(chunk_toks)] = chunk_toks
+        chunk_pages = np.asarray(
+            req.pf_pages[done // P:(done + bucket) // P], np.int32)
+        if done == 0:
+            logits, kv = decoding.prefill(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(chunk_toks)), self.cfg)
+        else:
+            npad = 1
+            while npad < done // P:
+                npad *= 2
+            padded_ids = np.zeros((npad,), np.int32)
+            padded_ids[:done // P] = req.pf_pages[:done // P]
+            k_pre, v_pre = self._dp.gather_prefix_pages(
+                self.state["kp"], self.state["vp"], jnp.asarray(padded_ids))
+            logits, kv = self._dp.prefill_with_prefix(
+                self.params, jnp.asarray(padded), k_pre, v_pre,
+                jnp.int32(done), jnp.int32(len(chunk_toks)), self.cfg)
+        self.state = self._dp.write_kv_pages(self.state, kv,
+                                             jnp.asarray(chunk_pages))
+        req.pf_done = done + len(chunk_toks)
+        self.prefill_chunks_run += 1
+        if not is_last:
+            return
+        self._prefilling.pop(0)
+        n = len(tokens)
+        self.key, sub = jax.random.split(self.key)
+        first = decoding.sample(logits[None, :], sub,
+                                req.params.temperature, req.params.top_k)
+        block_row = np.zeros((self.max_pages_per_seq,), np.int32)
+        block_row[:len(req.pf_pages)] = req.pf_pages
+        self.state = self._dp.activate_slot(
+            self.state, req.slot, jnp.asarray(block_row), jnp.int32(n),
+            first[0])
+        self._set_row_sampling(req.slot, req.params)
+        self._by_slot[req.slot] = req
+        if self.enable_prefix_cache:
+            n_shared = len(self._slot_shared.get(req.slot, ()))
+            self._register_blocks(req.slot, tokens, req.pf_hashes, n_shared,
+                                  self._slot_pages[req.slot])
+        self._emit(req, int(first[0]))
 
     def _emit(self, req: _Request, token_id: int):
         req.generated += 1
@@ -662,11 +777,15 @@ class TPUEngine:
     def _loop_inner(self):
         while not self._stop:
             if (not self._by_slot and self._waiting.empty()
-                    and not self._backlog):
+                    and not self._backlog and not self._prefilling):
                 self._work.wait(timeout=0.1)
                 self._work.clear()
                 continue
             self._admit()
+            if self._prefilling:
+                # one chunk per iteration: decode below keeps running
+                # requests emitting while a long prompt streams in
+                self._prefill_step()
             if not self._by_slot:
                 continue
             if self.kv_layout == "paged":
@@ -694,6 +813,10 @@ class TPUEngine:
             out["free_pages"] = len(self._free_pages)
             out["num_pages"] = self.num_pages
             out["page_size"] = self.page_size
+            if self.prefill_chunk:
+                out["prefill_chunk"] = self.prefill_chunk
+                out["prefill_chunks_run"] = self.prefill_chunks_run
+                out["prefilling"] = len(self._prefilling)
             if self.enable_prefix_cache:
                 hits, misses = self.prefix_hits, self.prefix_misses
                 out["prefix_cache"] = {

@@ -221,6 +221,37 @@ def prefill_with_prefix(params, tokens, prefix_k, prefix_v, prefix_len,
     return logits.astype(jnp.float32), {"k": kv[0], "v": kv[1]}
 
 
+@functools.partial(jax.jit, donate_argnames=("state",))
+def write_kv_pages(state, kv, pages):
+    """Write a bucketed [L, T, Hkv, Dh] KV into `pages` (T/page_size ids)
+    WITHOUT touching the row bookkeeping — the chunked-prefill building
+    block: chunks accumulate into the pool page by page, and the row only
+    activates once the whole prompt is resident (activate_slot)."""
+    P = state["kp"].shape[2]
+    L, T = kv["k"].shape[0], kv["k"].shape[1]
+    n = T // P
+    Hkv, Dh = kv["k"].shape[2], kv["k"].shape[3]
+    state = dict(state)
+    state["kp"] = state["kp"].at[:, pages[:n]].set(
+        kv["k"].reshape(L, n, P, Hkv, Dh).astype(state["kp"].dtype))
+    state["vp"] = state["vp"].at[:, pages[:n]].set(
+        kv["v"].reshape(L, n, P, Hkv, Dh).astype(state["vp"].dtype))
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def activate_slot(state, slot, block_row, length, first_token):
+    """Turn a fully-prefilled slot live for decode (the bookkeeping half
+    of insert_sequence_paged, after write_kv_pages staged the KV)."""
+    state = dict(state)
+    state["block"] = jax.lax.dynamic_update_slice_in_dim(
+        state["block"], block_row[None], slot, axis=0)
+    state["length"] = state["length"].at[slot].set(length)
+    state["last_token"] = state["last_token"].at[slot].set(first_token)
+    state["active"] = state["active"].at[slot].set(True)
+    return state
+
+
 @functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
 def insert_sequence_paged_prefix(state, slot, kv, suffix_pages, block_row,
                                  length, first_token, cfg: TransformerConfig):
