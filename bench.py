@@ -44,23 +44,25 @@ def _measure(platform: str) -> dict:
     from ray_tpu.models import llama_config, transformer
 
     on_tpu = jax.default_backend() == "tpu"
-    # round-4 lever (PERF.md): int8 optimizer state frees ~6 bytes/param,
-    # which is what lets the backward run WITHOUT remat at this size —
-    # recomputing activations was ~30% of step time. Never measured on
-    # hardware yet (pool wedged all round); try it first, fall back to the
-    # proven round-3 config on any failure (OOM) inside the same child.
+    # round-4 lever, measured on hardware 2026-07-31: no-remat (with or
+    # without int8 optimizer state) either OOMs or crashes this infra's
+    # remote-compile helper, and int8 state alone costs 16% in
+    # quantize/dequantize bandwidth (benchmarks/train_sweep.py int8_*).
+    # The lever that LANDED is batch 16: 27.4k -> 28.1k tok/s. Keep the
+    # no-remat attempt opt-in for when the infra envelope grows.
     attempt_no_remat = on_tpu and os.environ.get(
-        "RAY_TPU_BENCH_NO_REMAT", "1") == "1"
+        "RAY_TPU_BENCH_NO_REMAT", "0") == "1"
     if on_tpu:
-        # config picked by on-hardware sweeps (rounds 2-3,
-        # benchmarks/train_sweep.py): wide beats deep on the MXU, and the
-        # Pallas flash kernels (fwd+bwd) cut the step 31% at s2048
+        # config picked by on-hardware sweeps (rounds 2-4,
+        # benchmarks/train_sweep.py): wide beats deep on the MXU, the
+        # Pallas flash kernels (fwd+bwd) cut the step 31% at s2048, and
+        # batch 16 is the largest that this infra's compile helper accepts
         cfg = llama_config(
             "tiny", vocab_size=32000, max_seq_len=2048, d_model=2048,
             n_layers=8, n_heads=16, n_kv_heads=8, d_ff=8192, dtype=jnp.bfloat16,
             remat=not attempt_no_remat,
         )
-        batch, seq, steps = 8, 2048, 30
+        batch, seq, steps = 16, 2048, 20
     else:  # CPU smoke sizing
         cfg = llama_config("tiny", vocab_size=512, max_seq_len=256, dtype=jnp.float32)
         batch, seq, steps = 2, 128, 3
@@ -100,12 +102,17 @@ def _measure(platform: str) -> dict:
     flops_per_token = 6 * n_params
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = tokens_per_sec * flops_per_token / peak
+    # secondary MFU including causal self-attention matmul FLOPs
+    # (6·L·S·d_attn per token: 2 matmuls × 2·(S/2)·H·Dh fwd, ×3 for train)
+    attn_flops_per_token = 6 * cfg.n_layers * seq * cfg.n_heads * cfg.head_dim
+    mfu_attn = tokens_per_sec * (flops_per_token + attn_flops_per_token) / peak
     return {
         "tokens_per_sec": tokens_per_sec,
         "model_params": n_params,
         "batch": batch, "seq": seq,
         "step_ms": round(dt * 1e3, 2),
         "mfu_6nd": round(mfu, 4),
+        "mfu_incl_attn": round(mfu_attn, 4),
         "final_loss": round(float(loss), 3),
         "backend": jax.default_backend(),
     }
@@ -117,12 +124,13 @@ def _child_main(platform: str) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    fallback_err = None
     try:
         out = _measure(platform)
     except Exception as e:
         retry = False
         if (platform == "tpu"
-                and os.environ.get("RAY_TPU_BENCH_NO_REMAT", "1") == "1"):
+                and os.environ.get("RAY_TPU_BENCH_NO_REMAT", "0") == "1"):
             try:
                 import jax
 
@@ -134,12 +142,24 @@ def _child_main(platform: str) -> int:
                 retry = False
         if not retry:
             raise
-        # the untested no-remat + int8-state config didn't fit/compile:
-        # fall back to the proven round-3 config in the SAME child (a
-        # failed attempt frees its buffers on unwind)
+        fallback_err = f"{type(e).__name__}: {e}"[:200]
+        out = None
+    if out is None:
+        # the no-remat config didn't fit/compile: fall back to the proven
+        # remat config in the SAME child. This runs AFTER the except block
+        # on purpose — while the except clause is live, the interpreter's
+        # exception state still references the traceback whose frames pin
+        # the failed attempt's params/opt_state device buffers, and no
+        # amount of gc inside the clause can free them.
+        import gc
+
+        gc.collect()
+        import jax
+
+        jax.clear_caches()
         os.environ["RAY_TPU_BENCH_NO_REMAT"] = "0"
         out = _measure(platform)
-        out["no_remat_fallback"] = f"{type(e).__name__}: {e}"[:200]
+        out["no_remat_fallback"] = fallback_err
     print("@@RESULT@@" + json.dumps(out))
     return 0
 

@@ -47,6 +47,7 @@ class TransformerConfig:
     moe: MoEConfig | None = None
     remat: bool = True                     # checkpoint each layer (HBM for FLOPs)
     remat_policy: str = "nothing"          # "nothing" | "dots" (save matmul outputs)
+                                           # | "pairs" (checkpoint every other layer)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -282,12 +283,38 @@ def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
             delta = _dense_mlp(normed, layer_p["mlp"], cfg)
         return (h + delta, aux), None
 
-    if cfg.remat:
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if cfg.remat_policy == "dots"
-                  else jax.checkpoint_policies.nothing_saveable)
-        block = jax.checkpoint(block, policy=policy)
-    (x, aux_total), _ = jax.lax.scan(block, (x, aux_total), params["layers"])
+    if cfg.remat and cfg.remat_policy == "pairs" and (cfg.n_layers % 2
+                                                      or cfg.moe):
+        raise ValueError(
+            "remat_policy='pairs' needs an even n_layers and a dense (non-"
+            "MoE) stack; falling back silently would misattribute benchmark "
+            "results to selective remat")
+    if cfg.remat and cfg.remat_policy == "pairs":
+        # selective remat: scan over layer PAIRS, checkpointing only the
+        # first of each pair. Backward recomputes half the layers (full
+        # per-layer remat recomputes all of them — a 4-pass step with an
+        # MFU ceiling of 0.75), at the cost of keeping one layer's
+        # activations per pair live. Picked by on-hardware sweeps.
+        ck = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def pair(carry, pair_p):
+            a = jax.tree.map(lambda t: t[0], pair_p)
+            b = jax.tree.map(lambda t: t[1], pair_p)
+            carry, _ = ck(carry, a)
+            carry, _ = block(carry, b)
+            return carry, None
+
+        stacked = jax.tree.map(
+            lambda t: t.reshape(t.shape[0] // 2, 2, *t.shape[1:]),
+            params["layers"])
+        (x, aux_total), _ = jax.lax.scan(pair, (x, aux_total), stacked)
+    else:
+        if cfg.remat:
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots"
+                      else jax.checkpoint_policies.nothing_saveable)
+            block = jax.checkpoint(block, policy=policy)
+        (x, aux_total), _ = jax.lax.scan(block, (x, aux_total), params["layers"])
     x = _norm(x, params["final_norm"], cfg)
     if return_hidden:
         return x, aux_total
@@ -300,7 +327,7 @@ def forward(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
 
 def loss_fn(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = None,
             attn_impl: str | None = None, fused_ce: bool | None = None,
-            logits_spec=None):
+            logits_spec=None, ce_chunk: int | None = None):
     """Next-token LM loss on tokens [B, T]; positions with label -100 ignored.
 
     fused_ce (default: on for vocab >= 8192) streams the lm_head matmul into
@@ -322,7 +349,7 @@ def loss_fn(params, tokens, cfg: TransformerConfig, *, sp_axis: str | None = Non
         B, T, E = hidden.shape
         loss, _ = ops.fused_head_cross_entropy(
             hidden.reshape(B * T, E), params["lm_head"], labels.reshape(B * T),
-            logits_spec=logits_spec)
+            logits_spec=logits_spec, chunk=ce_chunk or 2048)
     else:
         logits, aux = forward(params, tokens[:, :-1], cfg, sp_axis=sp_axis, attn_impl=attn_impl)
         loss, _ = ops.softmax_cross_entropy(logits, labels)

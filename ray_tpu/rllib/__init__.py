@@ -8,8 +8,11 @@ is a jitted XLA program that scales by mesh sharding instead of torch DDP.)
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.iql import IQL, IQLConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.replay import ReplayBuffer
@@ -25,10 +28,16 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
+    "IQL",
+    "IQLConfig",
+    "MARWIL",
+    "MARWILConfig",
     "ReplayBuffer",
     "CartPoleVecEnv",
     "PendulumVecEnv",

@@ -1,0 +1,245 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+(reference: rllib/algorithms/cql/ — CQLConfig/CQL layers the conservative
+regularizer of Kumar et al. 2020 on top of the SAC losses: in addition to
+the soft Bellman backup, each critic is penalized by
+``logsumexp_a Q(s,a) - Q(s, a_data)`` so Q-values on out-of-distribution
+actions are pushed DOWN, which is what keeps a policy trained purely from
+a static dataset from exploiting Q-function extrapolation errors. The
+logsumexp is estimated from uniform-random and current-policy action
+samples with importance correction, as in the paper's CQL(H) variant.)
+
+Reuses the SAC networks/optimizers (sac.py); there are no env runners —
+the data source is a static dataset of {obs, action, reward, next_obs,
+done} transitions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac import (actor_mean, actor_sample,
+                                          init_sac_params, q_value)
+
+
+class CQLConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data = None
+        self.obs_dim = None
+        self.action_dim = None
+        self.action_scale = 1.0
+        self.train_batch_size = 256
+        self.num_updates_per_step = 200
+        self.tau = 0.005
+        self.initial_alpha = 0.1
+        self.autotune_alpha = True
+        self.target_entropy = None
+        self.cql_alpha = 1.0           # weight of the conservative penalty
+        self.num_cql_actions = 8       # sampled actions per logsumexp term
+
+    def offline(self, *, offline_data=None, obs_dim=None, action_dim=None,
+                action_scale=None, train_batch_size=None,
+                num_updates_per_step=None, cql_alpha=None,
+                num_cql_actions=None, initial_alpha=None, tau=None,
+                **_ignored) -> "CQLConfig":
+        for name, val in (("offline_data", offline_data),
+                          ("obs_dim", obs_dim), ("action_dim", action_dim),
+                          ("action_scale", action_scale),
+                          ("train_batch_size", train_batch_size),
+                          ("num_updates_per_step", num_updates_per_step),
+                          ("cql_alpha", cql_alpha),
+                          ("num_cql_actions", num_cql_actions),
+                          ("initial_alpha", initial_alpha), ("tau", tau)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def make_cql_update(actor_opt, q_opt, alpha_opt, *, gamma: float, tau: float,
+                    action_scale: float, target_entropy: float,
+                    autotune: bool, cql_alpha: float, n_actions: int):
+    def _conservative_penalty(q_params, params, batch, key):
+        """CQL(H): logsumexp over sampled actions minus the data action's Q,
+        per critic. Uniform samples are importance-corrected by the uniform
+        density; policy samples by their log-prob."""
+        B = batch["obs"].shape[0]
+        ku, kp, kn = jax.random.split(key, 3)
+        unif = jax.random.uniform(
+            ku, (n_actions, B, batch["actions"].shape[-1]),
+            minval=-action_scale, maxval=action_scale)
+        log_unif_density = -jnp.log(2.0 * action_scale) * unif.shape[-1]
+
+        def stacked_q(qp, acts, obs):
+            return jax.vmap(lambda a: q_value(qp, obs, a))(acts)  # [n, B]
+
+        pi_cur, logp_cur = jax.vmap(
+            lambda k: actor_sample(params["actor"], batch["obs"], k,
+                                   action_scale))(jax.random.split(kp, n_actions))
+        pi_nxt, logp_nxt = jax.vmap(
+            lambda k: actor_sample(params["actor"], batch["next_obs"], k,
+                                   action_scale))(jax.random.split(kn, n_actions))
+        pi_cur = jax.lax.stop_gradient(pi_cur)
+        pi_nxt = jax.lax.stop_gradient(pi_nxt)
+        logp_cur = jax.lax.stop_gradient(logp_cur)
+        logp_nxt = jax.lax.stop_gradient(logp_nxt)
+
+        pen = 0.0
+        for name in ("q1", "q2"):
+            qp = q_params[name]
+            cat = jnp.concatenate([
+                stacked_q(qp, unif, batch["obs"]) - log_unif_density,
+                stacked_q(qp, pi_cur, batch["obs"]) - logp_cur,
+                stacked_q(qp, pi_nxt, batch["obs"]) - logp_nxt,
+            ], axis=0)                                         # [3n, B]
+            lse = jax.scipy.special.logsumexp(cat, axis=0) - jnp.log(3 * n_actions)
+            q_data = q_value(qp, batch["obs"], batch["actions"])
+            pen = pen + jnp.mean(lse - q_data)
+        return pen
+
+    @jax.jit
+    def update(params, target_q, opt_states, batch, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+
+        def q_loss_fn(q_params):
+            a_next, logp_next = actor_sample(params["actor"],
+                                             batch["next_obs"], k1,
+                                             action_scale)
+            tq1 = q_value(target_q["q1"], batch["next_obs"], a_next)
+            tq2 = q_value(target_q["q2"], batch["next_obs"], a_next)
+            alpha = jnp.exp(params["log_alpha"])
+            soft_q = jnp.minimum(tq1, tq2) - alpha * logp_next
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterminal * soft_q)
+            q1 = q_value(q_params["q1"], batch["obs"], batch["actions"])
+            q2 = q_value(q_params["q2"], batch["obs"], batch["actions"])
+            bellman = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+            penalty = _conservative_penalty(q_params, params, batch, k3)
+            return bellman + cql_alpha * penalty, (jnp.mean(q1), penalty)
+
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, (q_mean, penalty)), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True)(q_params)
+        q_updates, q_state = q_opt.update(q_grads, opt_states["q"], q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        def pi_loss_fn(actor_params):
+            a, logp = actor_sample(actor_params, batch["obs"], k2,
+                                   action_scale)
+            q1 = q_value(q_params["q1"], batch["obs"], a)
+            q2 = q_value(q_params["q2"], batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        (pi_loss, logp), pi_grads = jax.value_and_grad(
+            pi_loss_fn, has_aux=True)(params["actor"])
+        pi_updates, pi_state = actor_opt.update(pi_grads, opt_states["actor"],
+                                                params["actor"])
+        actor_params = optax.apply_updates(params["actor"], pi_updates)
+
+        def alpha_loss_fn(log_alpha):
+            return -jnp.mean(jnp.exp(log_alpha)
+                             * jax.lax.stop_gradient(logp + target_entropy))
+
+        if autotune:
+            a_loss, a_grad = jax.value_and_grad(alpha_loss_fn)(
+                params["log_alpha"])
+            a_updates, a_state = alpha_opt.update(
+                a_grad, opt_states["alpha"], params["log_alpha"])
+            log_alpha = optax.apply_updates(params["log_alpha"], a_updates)
+        else:
+            a_loss = jnp.float32(0)
+            a_state = opt_states["alpha"]
+            log_alpha = params["log_alpha"]
+
+        new_params = {"actor": actor_params, "q1": q_params["q1"],
+                      "q2": q_params["q2"], "log_alpha": log_alpha}
+        new_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  target_q, q_params)
+        metrics = {"q_loss": q_loss, "pi_loss": pi_loss, "alpha_loss": a_loss,
+                   "cql_penalty": penalty, "alpha": jnp.exp(log_alpha),
+                   "q_mean": q_mean, "entropy": -jnp.mean(logp)}
+        return (new_params, new_target,
+                {"q": q_state, "actor": pi_state, "alpha": a_state}, metrics)
+
+    return update
+
+
+def load_transitions(offline_data) -> dict:
+    """Materialize a transition dataset ({obs, action, reward, next_obs,
+    done} rows) into stacked float32 numpy arrays."""
+    rows_iter = (offline_data.iter_rows()
+                 if hasattr(offline_data, "iter_rows") else iter(offline_data))
+    obs, acts, rews, nxt, dones = [], [], [], [], []
+    for row in rows_iter:
+        obs.append(np.asarray(row["obs"], np.float32))
+        acts.append(np.asarray(row["action"], np.float32).reshape(-1))
+        rews.append(float(row["reward"]))
+        nxt.append(np.asarray(row["next_obs"], np.float32))
+        dones.append(bool(row.get("done", False)))
+    return {"obs": np.stack(obs), "actions": np.stack(acts),
+            "rewards": np.asarray(rews, np.float32),
+            "next_obs": np.stack(nxt), "dones": np.asarray(dones, bool)}
+
+
+class CQL(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        if cfg.offline_data is None or cfg.obs_dim is None or cfg.action_dim is None:
+            raise ValueError(
+                "CQL needs .offline(offline_data=..., obs_dim=..., "
+                "action_dim=...)")
+        self._data = load_transitions(cfg.offline_data)
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(cfg.action_dim))
+        self.params = init_sac_params(
+            jax.random.PRNGKey(cfg.seed), cfg.obs_dim, cfg.action_dim,
+            hidden=cfg.model_hidden, initial_alpha=cfg.initial_alpha)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.actor_opt = optax.adam(cfg.lr)
+        self.q_opt = optax.adam(cfg.lr)
+        self.alpha_opt = optax.adam(cfg.lr)
+        self.opt_states = {
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "q": self.q_opt.init({"q1": self.params["q1"],
+                                  "q2": self.params["q2"]}),
+            "alpha": self.alpha_opt.init(self.params["log_alpha"]),
+        }
+        self._update = make_cql_update(
+            self.actor_opt, self.q_opt, self.alpha_opt, gamma=cfg.gamma,
+            tau=cfg.tau, action_scale=cfg.action_scale,
+            target_entropy=target_entropy, autotune=cfg.autotune_alpha,
+            cql_alpha=cfg.cql_alpha, n_actions=cfg.num_cql_actions)
+        self.key = jax.random.PRNGKey(cfg.seed + 7)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._num_updates = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._data["rewards"])
+        m: dict = {}
+        for _ in range(cfg.num_updates_per_step):
+            sel = self._rng.integers(0, n, cfg.train_batch_size)
+            batch = {k: jnp.asarray(v[sel]) for k, v in self._data.items()}
+            self.key, sub = jax.random.split(self.key)
+            self.params, self.target_q, self.opt_states, m = self._update(
+                self.params, self.target_q, self.opt_states, batch, sub)
+            self._num_updates += 1
+        out = {k: float(v) for k, v in m.items()}
+        out["num_updates"] = self._num_updates
+        return out
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        return np.asarray(actor_mean(self.params["actor"],
+                                     jnp.asarray(obs)[None],
+                                     self.config.action_scale))[0]
+
+
+CQLConfig.algo_class = CQL

@@ -1,0 +1,207 @@
+"""IQL — implicit Q-learning for offline continuous control.
+
+(reference: rllib/algorithms/iql/ — IQLConfig/IQL per Kostrikov et al.
+2021: never queries Q on out-of-distribution actions. Three pieces:
+  1. a state-value net V trained by EXPECTILE regression toward the
+     target critics' value of the DATA action (tau > 0.5 biases V toward
+     the upper envelope of behavior-supported returns),
+  2. twin critics trained by MSE toward r + gamma * V(s') — no actor in
+     the backup at all,
+  3. the policy extracted by advantage-weighted regression:
+     max E[exp(beta * (Q - V)) * log pi(a_data | s)].
+Reuses the SAC actor/critic networks (sac.py) and the transition loader
+from cql.py.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.cql import load_transitions
+from ray_tpu.rllib.algorithms.sac import (_mlp, _mlp_init, init_sac_params,
+                                          q_value)
+
+
+class IQLConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data = None
+        self.obs_dim = None
+        self.action_dim = None
+        self.action_scale = 1.0
+        self.train_batch_size = 256
+        self.num_updates_per_step = 200
+        self.tau = 0.005               # polyak for target critics
+        self.expectile = 0.7           # V regression expectile (paper: 0.7)
+        self.beta = 3.0                # AWR inverse temperature
+        self.max_weight = 100.0        # AWR weight clip
+
+    def offline(self, *, offline_data=None, obs_dim=None, action_dim=None,
+                action_scale=None, train_batch_size=None,
+                num_updates_per_step=None, expectile=None, beta=None,
+                max_weight=None, tau=None, **_ignored) -> "IQLConfig":
+        for name, val in (("offline_data", offline_data),
+                          ("obs_dim", obs_dim), ("action_dim", action_dim),
+                          ("action_scale", action_scale),
+                          ("train_batch_size", train_batch_size),
+                          ("num_updates_per_step", num_updates_per_step),
+                          ("expectile", expectile), ("beta", beta),
+                          ("max_weight", max_weight), ("tau", tau)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def _gaussian_logp_of(actor_params, obs, actions):
+    """log pi(a|s) of DATA actions under a plain Gaussian actor: an MLP
+    mean plus a state-INDEPENDENT learnable log-std (the original IQL
+    implementation's policy class). Unlike SAC's tanh-squashed Gaussian,
+    weighted regression toward data actions stays well-conditioned — no
+    atanh blow-up near the action boundary, and the shared std cannot
+    collapse per-state around a wrong mean early in training. Actions are
+    clipped to the valid range only at evaluation time."""
+    mu = _mlp(actor_params["net"], obs)
+    log_std = jnp.clip(actor_params["log_std"], -5.0, 2.0)
+    return jnp.sum(-0.5 * ((actions - mu) / jnp.exp(log_std)) ** 2 - log_std
+                   - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+
+def _gaussian_mean(actor_params, obs, action_scale: float):
+    return jnp.clip(_mlp(actor_params["net"], obs), -action_scale,
+                    action_scale)
+
+
+def make_iql_update(actor_opt, q_opt, v_opt, *, gamma: float, tau: float,
+                    action_scale: float, expectile: float, beta: float,
+                    max_weight: float):
+    @jax.jit
+    def update(params, target_q, opt_states, batch):
+        # --- V: expectile regression toward min target-Q of data actions
+        tq = jnp.minimum(
+            q_value(target_q["q1"], batch["obs"], batch["actions"]),
+            q_value(target_q["q2"], batch["obs"], batch["actions"]))
+
+        def v_loss_fn(v_params):
+            v = _mlp(v_params, batch["obs"])[:, 0]
+            diff = tq - v
+            w = jnp.where(diff > 0, expectile, 1.0 - expectile)
+            return jnp.mean(w * diff ** 2), v
+
+        (v_loss, v_now), v_grads = jax.value_and_grad(
+            v_loss_fn, has_aux=True)(params["v"])
+        v_updates, v_state = v_opt.update(v_grads, opt_states["v"], params["v"])
+        v_params = optax.apply_updates(params["v"], v_updates)
+
+        # --- critics: MSE toward r + gamma * V(s'); V (not the actor)
+        # carries the policy-improvement signal
+        v_next = _mlp(v_params, batch["next_obs"])[:, 0]
+        nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(
+            batch["rewards"] + gamma * nonterminal * v_next)
+
+        def q_loss_fn(q_params):
+            q1 = q_value(q_params["q1"], batch["obs"], batch["actions"])
+            q2 = q_value(q_params["q2"], batch["obs"], batch["actions"])
+            return (jnp.mean((q1 - target) ** 2)
+                    + jnp.mean((q2 - target) ** 2)), jnp.mean(q1)
+
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        (q_loss, q_mean), q_grads = jax.value_and_grad(
+            q_loss_fn, has_aux=True)(q_params)
+        q_updates, q_state = q_opt.update(q_grads, opt_states["q"], q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        # --- policy: advantage-weighted regression on data actions
+        adv = jax.lax.stop_gradient(tq - v_now)
+        weights = jnp.minimum(jnp.exp(beta * adv), max_weight)
+
+        def pi_loss_fn(actor_params):
+            logp = _gaussian_logp_of(actor_params, batch["obs"],
+                                     batch["actions"])
+            return -jnp.mean(weights * logp)
+
+        pi_loss, pi_grads = jax.value_and_grad(pi_loss_fn)(params["actor"])
+        pi_updates, pi_state = actor_opt.update(pi_grads, opt_states["actor"],
+                                                params["actor"])
+        actor_params = optax.apply_updates(params["actor"], pi_updates)
+
+        new_params = {"actor": actor_params, "q1": q_params["q1"],
+                      "q2": q_params["q2"], "v": v_params,
+                      "log_alpha": params["log_alpha"]}
+        new_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  target_q, q_params)
+        metrics = {"v_loss": v_loss, "q_loss": q_loss, "pi_loss": pi_loss,
+                   "q_mean": q_mean, "v_mean": jnp.mean(v_now),
+                   "adv_mean": jnp.mean(adv),
+                   "mean_weight": jnp.mean(weights)}
+        return new_params, new_target, \
+            {"q": q_state, "actor": pi_state, "v": v_state}, metrics
+
+    return update
+
+
+class IQL(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        if cfg.offline_data is None or cfg.obs_dim is None or cfg.action_dim is None:
+            raise ValueError(
+                "IQL needs .offline(offline_data=..., obs_dim=..., "
+                "action_dim=...)")
+        self._data = load_transitions(cfg.offline_data)
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = init_sac_params(key, cfg.obs_dim, cfg.action_dim,
+                                      hidden=cfg.model_hidden)
+        # plain-Gaussian actor (see _gaussian_logp_of) replaces the SAC
+        # tanh-Gaussian head that init_sac_params builds
+        self.params["actor"] = {
+            "net": _mlp_init(jax.random.fold_in(key, 7),
+                             (cfg.obs_dim, *cfg.model_hidden, cfg.action_dim)),
+            "log_std": jnp.zeros((cfg.action_dim,), jnp.float32),
+        }
+        self.params["v"] = _mlp_init(jax.random.fold_in(key, 99),
+                                     (cfg.obs_dim, *cfg.model_hidden, 1))
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.actor_opt = optax.adam(cfg.lr)
+        self.q_opt = optax.adam(cfg.lr)
+        self.v_opt = optax.adam(cfg.lr)
+        self.opt_states = {
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "q": self.q_opt.init({"q1": self.params["q1"],
+                                  "q2": self.params["q2"]}),
+            "v": self.v_opt.init(self.params["v"]),
+        }
+        self._update = make_iql_update(
+            self.actor_opt, self.q_opt, self.v_opt, gamma=cfg.gamma,
+            tau=cfg.tau, action_scale=cfg.action_scale,
+            expectile=cfg.expectile, beta=cfg.beta,
+            max_weight=cfg.max_weight)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._num_updates = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._data["rewards"])
+        m: dict = {}
+        for _ in range(cfg.num_updates_per_step):
+            sel = self._rng.integers(0, n, cfg.train_batch_size)
+            batch = {k: jnp.asarray(v[sel]) for k, v in self._data.items()}
+            self.params, self.target_q, self.opt_states, m = self._update(
+                self.params, self.target_q, self.opt_states, batch)
+            self._num_updates += 1
+        out = {k: float(v) for k, v in m.items()}
+        out["num_updates"] = self._num_updates
+        return out
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        return np.asarray(_gaussian_mean(self.params["actor"],
+                                         jnp.asarray(obs)[None],
+                                         self.config.action_scale))[0]
+
+
+IQLConfig.algo_class = IQL

@@ -1,0 +1,156 @@
+"""MARWIL — monotonic advantage re-weighted imitation learning.
+
+(reference: rllib/algorithms/marwil/ — MARWILConfig/MARWIL trains from
+logged episodes by exponentially advantage-weighted behavior cloning plus
+a value-function baseline; Wang et al. 2018. beta=0 degenerates to plain
+BC. Offline like BC: the data source is a ray_tpu.data Dataset or an
+in-memory list of {obs, action, reward, done} rows in episode order.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class MARWILConfig(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data = None       # Dataset | list of rows, episode order
+        self.obs_dim = None
+        self.num_actions = None
+        self.train_batch_size = 256
+        self.beta = 1.0                # 0 => plain BC
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+
+    def offline(self, *, offline_data=None, obs_dim=None, num_actions=None,
+                train_batch_size=None, beta=None, vf_coeff=None,
+                **_ignored) -> "MARWILConfig":
+        for name, val in (("offline_data", offline_data),
+                          ("obs_dim", obs_dim),
+                          ("num_actions", num_actions),
+                          ("train_batch_size", train_batch_size),
+                          ("beta", beta), ("vf_coeff", vf_coeff)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+def make_marwil_update(optimizer, *, beta: float, vf_coeff: float,
+                       ma_rate: float):
+    @jax.jit
+    def update(params, opt_state, ma_sqd_adv, batch):
+        def loss_fn(p):
+            logits, value = rl_module.forward(p, batch["obs"])
+            adv = batch["returns"] - value
+            vf_loss = jnp.mean(adv ** 2)
+            # advantage scale tracked as a moving average OUTSIDE the
+            # gradient (paper's c normalizer), so exp() stays bounded
+            scale = jnp.sqrt(jax.lax.stop_gradient(ma_sqd_adv)) + 1e-8
+            # cap the exp weight (paper's numerical guard; RLlib clips the
+            # exponent) so a few large advantages can't dominate the batch
+            weights = (jnp.minimum(jnp.exp(jnp.clip(
+                beta * jax.lax.stop_gradient(adv) / scale, -20.0, 20.0)),
+                20.0)
+                if beta else jnp.ones_like(adv))
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, batch["actions"][:, None],
+                                       axis=1)[:, 0]
+            pi_loss = jnp.mean(weights * nll)
+            loss = pi_loss + vf_coeff * vf_loss
+            acc = jnp.mean((jnp.argmax(logits, axis=-1)
+                            == batch["actions"]).astype(jnp.float32))
+            return loss, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                          "imitation_accuracy": acc,
+                          "mean_weight": jnp.mean(weights),
+                          "sqd_adv": jnp.mean(jax.lax.stop_gradient(adv) ** 2)}
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ma_sqd_adv = (1 - ma_rate) * ma_sqd_adv + ma_rate * metrics.pop("sqd_adv")
+        metrics["total_loss"] = loss
+        return params, opt_state, ma_sqd_adv, metrics
+
+    return update
+
+
+def _returns_to_go(rewards: np.ndarray, dones: np.ndarray,
+                   gamma: float) -> np.ndarray:
+    """Discounted return-to-go per timestep, resetting at episode ends."""
+    out = np.zeros_like(rewards, dtype=np.float64)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        out[i] = acc
+    return out.astype(np.float32)
+
+
+class MARWIL(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        if cfg.offline_data is None or cfg.obs_dim is None or cfg.num_actions is None:
+            raise ValueError(
+                "MARWIL needs .offline(offline_data=..., obs_dim=..., "
+                "num_actions=...)")
+        rows_iter = (cfg.offline_data.iter_rows()
+                     if hasattr(cfg.offline_data, "iter_rows")
+                     else iter(cfg.offline_data))
+        obs, acts, rews, dones = [], [], [], []
+        for row in rows_iter:
+            obs.append(np.asarray(row["obs"], np.float32))
+            acts.append(int(row["action"]))
+            rews.append(float(row.get("reward", 0.0)))
+            dones.append(bool(row.get("done", False)))
+        self._obs = np.stack(obs)
+        self._actions = np.asarray(acts, np.int32)
+        self._returns = _returns_to_go(
+            np.asarray(rews, np.float32), np.asarray(dones, bool), cfg.gamma)
+        self.params = rl_module.init(jax.random.PRNGKey(cfg.seed),
+                                     cfg.obs_dim, cfg.num_actions,
+                                     cfg.model_hidden)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # start the advantage normalizer at the data's return scale (V≈0 at
+        # init, so adv≈returns): starting at 1.0 makes the first hundreds of
+        # exp-weights astronomically hot and destabilizes the policy before
+        # the moving average can catch up
+        self.ma_sqd_adv = jnp.float32(max(float(np.mean(self._returns ** 2)),
+                                          1e-6))
+        self._update = make_marwil_update(
+            self.optimizer, beta=cfg.beta, vf_coeff=cfg.vf_coeff,
+            ma_rate=cfg.moving_average_sqd_adv_norm_update_rate)
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self._actions)
+        order = self._rng.permutation(n)
+        metrics: dict = {}
+        for lo in range(0, n - cfg.train_batch_size + 1, cfg.train_batch_size):
+            sel = order[lo:lo + cfg.train_batch_size]
+            batch = {"obs": jnp.asarray(self._obs[sel]),
+                     "actions": jnp.asarray(self._actions[sel]),
+                     "returns": jnp.asarray(self._returns[sel])}
+            self.params, self.opt_state, self.ma_sqd_adv, m = self._update(
+                self.params, self.opt_state, self.ma_sqd_adv, batch)
+            metrics = {k: float(v) for k, v in m.items()}
+        metrics["num_samples_trained"] = n
+        return metrics
+
+    def predict(self, obs) -> np.ndarray:
+        return np.asarray(rl_module.forward_inference(
+            self.params, jnp.asarray(obs, jnp.float32)))
+
+
+MARWILConfig.algo_class = MARWIL

@@ -1,0 +1,172 @@
+"""Offline RL: MARWIL (discrete, advantage-weighted imitation) and
+CQL / IQL (continuous, conservative / implicit Q-learning) trained purely
+from logged transitions — no env interaction during learning.
+
+(reference test strategy: rllib/algorithms/{marwil,cql,iql}/tests/ train
+on recorded datasets and assert the policy clears a return threshold.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env import CartPoleVecEnv, PendulumVecEnv
+
+
+def _cartpole_mixed_dataset(steps: int = 8000, eps: float = 0.3,
+                            seed: int = 0) -> list[dict]:
+    """Episode-ordered {obs, action, reward, done} rows from a mediocre
+    behavior policy: a stabilizing heuristic with eps-random actions."""
+    env = CartPoleVecEnv(num_envs=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    obs = env.reset(seed)
+    rows = []
+    for _ in range(steps):
+        th, th_dot = obs[0, 2], obs[0, 3]
+        a = int(th + 0.5 * th_dot > 0)
+        if rng.random() < eps:
+            a = int(rng.integers(0, 2))
+        nxt, r, d, _ = env.step(np.asarray([a]))
+        rows.append({"obs": obs[0].tolist(), "action": a,
+                     "reward": float(r[0]), "done": bool(d[0])})
+        obs = nxt
+    return rows
+
+
+def _pendulum_dataset(episodes: int = 40, noise: float = 0.3,
+                      seed: int = 0) -> list[dict]:
+    """Transitions from a scripted energy-shaping swing-up controller with
+    exploration noise — a medium-quality behavior policy (clearly better
+    than random ~-1200, clearly worse than an optimal ~-150)."""
+    env = PendulumVecEnv(num_envs=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    obs = env.reset(seed)
+    rows = []
+    for _ in range(episodes * env.MAX_STEPS):
+        cos_th, sin_th, th_dot = obs[0]
+        th_norm = float(np.arctan2(sin_th, cos_th))
+        # rod energy (I = ml^2/3): E_top = m g l/2 = 5 for m=l=1, g=10
+        E = 0.5 * (1.0 / 3.0) * th_dot ** 2 + 5.0 * cos_th
+        if cos_th > 0.85 and abs(th_dot) < 3.0:  # catch basin: PD hold
+            u = -10.0 * th_norm - 2.0 * th_dot
+        else:  # pump energy toward E_top in the direction of motion
+            s = np.sign(th_dot) if abs(th_dot) > 0.05 else 1.0
+            u = float(np.clip(2.0 * (5.0 - E), -1.5, 1.5)) * s
+        # keep expert torques INTERIOR (|u| <= 1.5 < 2): boundary-saturated
+        # bang-bang data is unfittable by smooth policy classes
+        u = float(np.clip(np.clip(u, -1.5, 1.5) + rng.normal() * noise,
+                          -2.0, 2.0))
+        nxt, r, d, _ = env.step(np.asarray([u]))
+        rows.append({"obs": obs[0].tolist(), "action": [u],
+                     "reward": float(r[0]), "next_obs": nxt[0].tolist(),
+                     "done": False})  # pendulum never terminates (time limit)
+        obs = nxt
+    return rows
+
+
+def _eval_discrete(algo, num_steps: int = 1200, seed: int = 123) -> float:
+    env = CartPoleVecEnv(num_envs=4, seed=seed)
+    obs = env.reset(seed)
+    for _ in range(num_steps // 4):
+        obs, _, _, _ = env.step(algo.predict(obs))
+    rets = env.drain_episode_returns()
+    return float(np.mean(rets)) if rets else float(np.mean(env.episode_returns))
+
+
+def _eval_continuous(algo, episodes: int = 4, seed: int = 123) -> float:
+    env = PendulumVecEnv(num_envs=episodes, seed=seed)
+    obs = env.reset(seed)
+    for _ in range(env.MAX_STEPS):
+        acts = np.stack([algo.compute_single_action(o) for o in obs])
+        obs, _, _, _ = env.step(acts[:, 0])
+    return float(np.mean(env.drain_episode_returns()))
+
+
+@pytest.mark.slow
+def test_marwil_learns_from_mixed_cartpole():
+    from ray_tpu.rllib import MARWILConfig
+
+    rows = _cartpole_mixed_dataset()
+    algo = (MARWILConfig()
+            .offline(offline_data=rows, obs_dim=4, num_actions=2,
+                     train_batch_size=256, beta=1.0)
+            .training(lr=3e-3)
+            .debugging(seed=0)
+            .build())
+    for _ in range(12):
+        result = algo.train()
+    ret = _eval_discrete(algo)
+    # behavior data averages well under 200 per episode (30% random
+    # actions); advantage re-weighting must recover a clearly better policy
+    assert ret > 150.0, f"MARWIL eval return {ret}"
+    assert result["learners"]["num_samples_trained"] == len(rows)
+
+
+@pytest.mark.slow
+def test_marwil_beta_zero_is_plain_bc():
+    """beta=0 must reduce to uniform-weight imitation (weights all 1)."""
+    from ray_tpu.rllib import MARWILConfig
+
+    rows = _cartpole_mixed_dataset(steps=2000)
+    algo = (MARWILConfig()
+            .offline(offline_data=rows, obs_dim=4, num_actions=2, beta=0.0)
+            .training(lr=3e-3)
+            .debugging(seed=0)
+            .build())
+    result = algo.train()
+    assert result["learners"]["mean_weight"] == pytest.approx(1.0)
+
+
+@pytest.mark.slow
+def test_cql_learns_pendulum_offline():
+    from ray_tpu.rllib import CQLConfig
+
+    rows = _pendulum_dataset()
+    algo = (CQLConfig()
+            .offline(offline_data=rows, obs_dim=3, action_dim=1,
+                     action_scale=2.0, train_batch_size=256,
+                     num_updates_per_step=1000, cql_alpha=0.5, tau=0.01)
+            .training(lr=3e-3, gamma=0.95)
+            .debugging(seed=0)
+            .build())
+    for _ in range(10):
+        result = algo.train()
+    ret = _eval_continuous(algo)
+    # random sits near -1200, the behavior policy near -170; clearing -600
+    # requires real value learning from the static data
+    assert ret > -600.0, f"CQL eval return {ret}"
+    # the conservative penalty must actually be active and finite
+    assert np.isfinite(result["learners"]["cql_penalty"])
+
+
+@pytest.mark.slow
+def test_iql_learns_pendulum_offline():
+    from ray_tpu.rllib import IQLConfig
+
+    rows = _pendulum_dataset()
+    algo = (IQLConfig()
+            .offline(offline_data=rows, obs_dim=3, action_dim=1,
+                     action_scale=2.0, train_batch_size=256,
+                     num_updates_per_step=1000, expectile=0.7, beta=3.0,
+                     tau=0.01)
+            .training(lr=3e-3, gamma=0.95)
+            .debugging(seed=0)
+            .build())
+    for _ in range(10):
+        result = algo.train()
+    ret = _eval_continuous(algo)
+    assert ret > -600.0, f"IQL eval return {ret}"
+    # expectile-regressed V should sit below the Q of data actions on
+    # average advantage terms staying finite
+    assert np.isfinite(result["learners"]["v_mean"])
+
+
+def test_offline_config_validation():
+    from ray_tpu.rllib import CQLConfig, IQLConfig, MARWILConfig
+
+    for cfg_cls, msg in ((MARWILConfig, "MARWIL needs"),
+                         (CQLConfig, "CQL needs"),
+                         (IQLConfig, "IQL needs")):
+        with pytest.raises(ValueError, match=msg):
+            cfg_cls().build()
