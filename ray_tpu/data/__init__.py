@@ -18,9 +18,13 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range,
+    read_arrow,
+    read_avro,
     read_binary_files,
     read_csv,
     read_datasource,
+    read_delta,
+    read_iceberg,
     read_images,
     read_json,
     read_numpy,
@@ -47,9 +51,13 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_arrow",
+    "read_avro",
     "read_binary_files",
     "read_csv",
     "read_datasource",
+    "read_delta",
+    "read_iceberg",
     "read_images",
     "read_json",
     "read_numpy",

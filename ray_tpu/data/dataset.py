@@ -29,6 +29,8 @@ from ray_tpu.data.datasource import (
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    write_arrow_block,
+    write_avro_block,
     write_csv_block,
     write_json_block,
     write_parquet_block,
@@ -377,6 +379,24 @@ class Dataset:
     def write_json(self, path: str) -> list[str]:
         return self._write(path, write_json_block)
 
+    def write_avro(self, path: str) -> list[str]:
+        """One Avro object container file per block (data/avro.py codec)."""
+        return self._write(path, write_avro_block)
+
+    def write_arrow(self, path: str) -> list[str]:
+        """One Arrow IPC file per block."""
+        return self._write(path, write_arrow_block)
+
+    def write_delta(self, table: str, *, mode: str = "append",
+                    partition_cols: list[str] | None = None) -> list[str]:
+        """Commit to a Delta Lake table: parquet data files plus a
+        `_delta_log` JSON commit (create/append/overwrite — see
+        data/lakehouse.py)."""
+        from ray_tpu.data.lakehouse import write_delta
+
+        return write_delta(self, table, mode=mode,
+                           partition_cols=partition_cols)
+
     def _write(self, path: str, writer) -> list[str]:
         files = []
         for i, b in enumerate(self.iter_blocks()):
@@ -614,6 +634,53 @@ def read_sql(sql: str, connection_factory, *, params: tuple = (),
 
     return Dataset(L.Read(SQLDatasource(sql, connection_factory,
                                         params=params), parallelism))
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    """Avro object container files (reference: read_api.py read_avro)."""
+    from ray_tpu.data.datasource import AvroDatasource
+
+    return Dataset(L.Read(AvroDatasource(paths), parallelism))
+
+
+def read_arrow(paths, *, parallelism: int = -1) -> Dataset:
+    """Arrow IPC / Feather V2 files."""
+    from ray_tpu.data.datasource import ArrowDatasource
+
+    return Dataset(L.Read(ArrowDatasource(paths), parallelism))
+
+
+def _parse_filter_arg(filter):
+    if isinstance(filter, str):
+        from ray_tpu.data.expressions import parse_filter
+
+        return parse_filter(filter)
+    return list(filter) if filter else None
+
+
+def read_delta(table: str, *, columns=None, filter=None,
+               parallelism: int = -1) -> Dataset:
+    """Delta Lake table: replays `_delta_log` (JSON commits + parquet
+    checkpoint) into the active file set; `columns`/`filter` push down
+    into the parquet scans and partition values (reference: read_api.py
+    read_delta)."""
+    from ray_tpu.data.lakehouse import DeltaDatasource
+
+    return Dataset(L.Read(DeltaDatasource(
+        table, columns, _parse_filter_arg(filter)), parallelism))
+
+
+def read_iceberg(table: str, *, columns=None, filter=None,
+                 snapshot_id: int | None = None,
+                 parallelism: int = -1) -> Dataset:
+    """Apache Iceberg table: metadata.json → snapshot → avro manifest list
+    → avro manifests → parquet data files (reference: read_api.py
+    read_iceberg). Local/file:// warehouses."""
+    from ray_tpu.data.lakehouse import IcebergDatasource
+
+    return Dataset(L.Read(IcebergDatasource(
+        table, columns, _parse_filter_arg(filter), snapshot_id),
+        parallelism))
 
 
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:

@@ -236,6 +236,41 @@ class ImageDatasource(FileDatasource):
         return [{"image": arr[None, ...], "path": [path]}]
 
 
+class AvroDatasource(FileDatasource):
+    """Avro object container files → rows, via the dependency-free codec in
+    data/avro.py (reference: read_api.py read_avro — fastavro-backed there)."""
+
+    suffixes = (".avro",)
+
+    def read_file(self, path: str) -> list:
+        from ray_tpu.data.avro import read_avro_file
+        from ray_tpu.data.block import rows_to_block
+
+        records, _ = read_avro_file(path)
+        return [rows_to_block(records)] if records else []
+
+
+class ArrowDatasource(FileDatasource):
+    """Arrow IPC / Feather V2 files (reference capability: Dataset
+    round-trips through Arrow; file-level IPC reads are the natural TPU
+    interchange for zero-copy numpy columns)."""
+
+    suffixes = (".arrow", ".feather", ".ipc")
+
+    def read_file(self, path: str) -> list:
+        import pyarrow as pa
+
+        from ray_tpu.data.block import normalize_block
+
+        with pa.memory_map(path) as src:
+            try:
+                table = pa.ipc.open_file(src).read_all()
+            except pa.ArrowInvalid:
+                src.seek(0)
+                table = pa.ipc.open_stream(src).read_all()
+        return [normalize_block(table)]
+
+
 # --------------------------------------------------------------------- writes
 
 
@@ -271,6 +306,30 @@ def write_json_block(block: Block, path: str, index: int) -> str:
     with open(out, "w") as f:
         for row in BlockAccessor(block).iter_rows():
             f.write(json.dumps({k: _json_safe(v) for k, v in row.items()}) + "\n")
+    return out
+
+
+def write_avro_block(block: Block, path: str, index: int) -> str:
+    from ray_tpu.data.avro import write_avro_file
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.avro")
+    write_avro_file(out, list(BlockAccessor(block).iter_rows()))
+    return out
+
+
+def write_arrow_block(block: Block, path: str, index: int) -> str:
+    import pyarrow as pa
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.arrow")
+    table = BlockAccessor(block).to_arrow()
+    with pa.OSFile(out, "wb") as sink:
+        with pa.ipc.new_file(sink, table.schema) as writer:
+            writer.write_table(table)
     return out
 
 

@@ -85,10 +85,16 @@ def make_cql_update(actor_opt, q_opt, alpha_opt, *, gamma: float, tau: float,
         pi_nxt, logp_nxt = jax.vmap(
             lambda k: actor_sample(params["actor"], batch["next_obs"], k,
                                    action_scale))(jax.random.split(kn, n_actions))
+        # actor_sample's logp is the density of tanh(u) on [-1,1]^d; the
+        # action it returns lives on [-scale, scale]^d — add the
+        # change-of-variables term so policy rows are commensurate with the
+        # uniform rows in the logsumexp
+        d = batch["actions"].shape[-1]
+        scale_corr = d * jnp.log(action_scale)
         pi_cur = jax.lax.stop_gradient(pi_cur)
         pi_nxt = jax.lax.stop_gradient(pi_nxt)
-        logp_cur = jax.lax.stop_gradient(logp_cur)
-        logp_nxt = jax.lax.stop_gradient(logp_nxt)
+        logp_cur = jax.lax.stop_gradient(logp_cur) - scale_corr
+        logp_nxt = jax.lax.stop_gradient(logp_nxt) - scale_corr
 
         pen = 0.0
         for name in ("q1", "q2"):

@@ -22,8 +22,7 @@ import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.cql import load_transitions
-from ray_tpu.rllib.algorithms.sac import (_mlp, _mlp_init, init_sac_params,
-                                          q_value)
+from ray_tpu.rllib.algorithms.sac import _mlp, _mlp_init, q_value
 
 
 class IQLConfig(AlgorithmConfig):
@@ -132,8 +131,7 @@ def make_iql_update(actor_opt, q_opt, v_opt, *, gamma: float, tau: float,
         actor_params = optax.apply_updates(params["actor"], pi_updates)
 
         new_params = {"actor": actor_params, "q1": q_params["q1"],
-                      "q2": q_params["q2"], "v": v_params,
-                      "log_alpha": params["log_alpha"]}
+                      "q2": q_params["q2"], "v": v_params}
         new_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
                                   target_q, q_params)
         metrics = {"v_loss": v_loss, "q_loss": q_loss, "pi_loss": pi_loss,
@@ -155,17 +153,21 @@ class IQL(Algorithm):
                 "action_dim=...)")
         self._data = load_transitions(cfg.offline_data)
         key = jax.random.PRNGKey(cfg.seed)
-        self.params = init_sac_params(key, cfg.obs_dim, cfg.action_dim,
-                                      hidden=cfg.model_hidden)
-        # plain-Gaussian actor (see _gaussian_logp_of) replaces the SAC
-        # tanh-Gaussian head that init_sac_params builds
-        self.params["actor"] = {
-            "net": _mlp_init(jax.random.fold_in(key, 7),
-                             (cfg.obs_dim, *cfg.model_hidden, cfg.action_dim)),
-            "log_std": jnp.zeros((cfg.action_dim,), jnp.float32),
+        k1, k2, ka, kv = jax.random.split(key, 4)
+        # twin critics + a plain-Gaussian actor (see _gaussian_logp_of) and
+        # an expectile V net; IQL has no temperature, so no log_alpha leaf
+        self.params = {
+            "q1": _mlp_init(k1, (cfg.obs_dim + cfg.action_dim,
+                                 *cfg.model_hidden, 1)),
+            "q2": _mlp_init(k2, (cfg.obs_dim + cfg.action_dim,
+                                 *cfg.model_hidden, 1)),
+            "actor": {
+                "net": _mlp_init(ka, (cfg.obs_dim, *cfg.model_hidden,
+                                      cfg.action_dim)),
+                "log_std": jnp.zeros((cfg.action_dim,), jnp.float32),
+            },
+            "v": _mlp_init(kv, (cfg.obs_dim, *cfg.model_hidden, 1)),
         }
-        self.params["v"] = _mlp_init(jax.random.fold_in(key, 99),
-                                     (cfg.obs_dim, *cfg.model_hidden, 1))
         self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
         self.actor_opt = optax.adam(cfg.lr)
         self.q_opt = optax.adam(cfg.lr)

@@ -136,16 +136,20 @@ class MARWIL(Algorithm):
         cfg = self.config
         n = len(self._actions)
         order = self._rng.permutation(n)
-        metrics: dict = {}
-        for lo in range(0, n - cfg.train_batch_size + 1, cfg.train_batch_size):
+        last = None
+        trained = 0
+        for lo in range(0, n, cfg.train_batch_size):
             sel = order[lo:lo + cfg.train_batch_size]
             batch = {"obs": jnp.asarray(self._obs[sel]),
                      "actions": jnp.asarray(self._actions[sel]),
                      "returns": jnp.asarray(self._returns[sel])}
-            self.params, self.opt_state, self.ma_sqd_adv, m = self._update(
+            self.params, self.opt_state, self.ma_sqd_adv, last = self._update(
                 self.params, self.opt_state, self.ma_sqd_adv, batch)
-            metrics = {k: float(v) for k, v in m.items()}
-        metrics["num_samples_trained"] = n
+            trained += len(sel)
+        # convert once, after the loop: float() inside it would block the
+        # dispatch pipeline on every minibatch
+        metrics = ({k: float(v) for k, v in last.items()} if last else {})
+        metrics["num_samples_trained"] = trained
         return metrics
 
     def predict(self, obs) -> np.ndarray:
