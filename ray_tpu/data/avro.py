@@ -186,14 +186,20 @@ class _Encoder:
                 "string")
         for i, branch in enumerate(union):
             b = branch if isinstance(branch, str) else branch.get("type")
-            if b == kind or (kind == "long" and b == "int") or (
+            if b == kind or (kind == "long" and b in ("int", "float",
+                                                      "double")) or (
                     kind == "double" and b == "float"):
                 return i
-        # fall back to the first non-null branch for complex types
-        for i, branch in enumerate(union):
-            if branch != "null":
-                return i
-        raise ValueError(f"no union branch for {type(value)}")
+        # complex (non-primitive) values route to the first structured
+        # branch; a primitive with no matching branch must NOT fall back
+        # (e.g. a float into a long branch would silently truncate)
+        if isinstance(value, (list, tuple, dict)) or not isinstance(
+                value, (bool, int, float, bytes, str)):
+            for i, branch in enumerate(union):
+                if branch != "null":
+                    return i
+        raise TypeError(
+            f"no union branch in {union} for value of type {type(value)}")
 
     def _write_primitive(self, out: io.BytesIO, v: Any, t: str) -> None:
         if t == "null":
@@ -300,14 +306,30 @@ def infer_schema(rows: list[dict], name: str = "row") -> dict:
             return "null"
         raise TypeError(f"cannot map {type(v)} to an avro type")
 
+    sample = rows[:100]
+    keys: list = []
+    for r in sample:  # union of keys, first-seen order
+        for k in r:
+            if k not in keys:
+                keys.append(k)
     fields = []
-    sample = rows[0]
-    for k in sample:
-        t = None
-        for r in rows[:100]:
-            if r.get(k) is not None:
-                t = of(r[k])
-                break
+    for k in keys:
+        t: Any = None
+        for r in sample:
+            if r.get(k) is None:
+                continue
+            cand = of(r[k])
+            if t is None or t == cand:
+                t = cand
+            elif {t, cand} <= {"long", "double"}:
+                t = "double"  # widen mixed int/float columns
+            elif (isinstance(t, dict) and isinstance(cand, dict)
+                  and t.get("type") == cand.get("type") == "array"
+                  and {t["items"], cand["items"]} <= {"long", "double"}):
+                t = {"type": "array", "items": "double"}
+            else:
+                raise TypeError(
+                    f"column {k!r} mixes incompatible types {t} and {cand}")
         fields.append({"name": str(k),
                        "type": ["null", t] if t else "null"})
     return {"type": "record", "name": name, "fields": fields}

@@ -847,6 +847,31 @@ class StreamingExecutor:
         queues: list[collections.deque] = [collections.deque() for _ in range(len(rest) + 1)]
         src_in_flight: dict = {}
 
+        # Submission-order sequence tags. Completions enter queues in
+        # COMPLETION order (nondeterministic under load); map stages don't
+        # care, but barrier stages salt their partition tasks by positional
+        # index, so a reordered input list would silently change e.g. a
+        # seeded random_shuffle's permutation. Tags flow through map stages
+        # (the output ref inherits the input's tag) and barriers sort by
+        # them before fanning out.
+        import itertools as _it
+
+        seq_counter = _it.count()
+        seq_of: dict[str, int] = {}
+
+        def _skey(item) -> str:
+            return item.hex() if hasattr(item, "hex") else str(id(item))
+
+        def _tag(item) -> None:
+            seq_of[_skey(item)] = next(seq_counter)
+
+        def _inherit(new_item, old_item) -> None:
+            seq_of[_skey(new_item)] = seq_of.pop(_skey(old_item),
+                                                 next(seq_counter))
+
+        def _ordered(items):
+            return sorted(items, key=lambda it: seq_of.get(_skey(it), 1 << 60))
+
         def is_barrier(s: Stage) -> bool:
             return s.all_to_all is not None or s.a2a_refs is not None
 
@@ -858,10 +883,12 @@ class StreamingExecutor:
                    and len(queues[0]) < self.max_queued):
                 payload = source_payloads.popleft()
                 if source_is_refs and not first.transforms:
+                    _tag(payload)
                     queues[0].append(payload)
                     continue
                 fn = stage_remote(-1, first)
                 ref = fn.remote(payload)
+                _tag(ref)
                 self.owned.add(ref.hex())
                 src_in_flight[ref.hex()] = ref
 
@@ -882,7 +909,7 @@ class StreamingExecutor:
                                      and all(not queues[j] or j == i for j in range(i + 1)))
                     if a2a_done[i] or not upstream_done or not _upstream_a2a_done(i):
                         continue
-                    inputs = list(queues[i])
+                    inputs = _ordered(queues[i])
                     queues[i].clear()
                     if stage.a2a_refs is not None:
                         # distributed: hand refs to the partition/merge task
@@ -897,6 +924,7 @@ class StreamingExecutor:
                                 in_refs.append(r)
                         for r in stage.a2a_refs(in_refs):
                             self.owned.add(r.hex())
+                            _tag(r)
                             queues[i + 1].append(r)
                         # inputs: drop our handles only — the partition tasks
                         # hold them as deps; manual free here would race arg
@@ -910,6 +938,7 @@ class StreamingExecutor:
                             blocks.extend(got if isinstance(got, list) else [got])
                             self._free_if_owned(item)
                         for out_blocks in stage.all_to_all(blocks):
+                            _tag(out_blocks)
                             queues[i + 1].append(out_blocks)  # plain lists, not refs
                     a2a_done[i] = True
                     continue
@@ -919,6 +948,7 @@ class StreamingExecutor:
                     item = queues[i].popleft()
                     fn = stage_remote(i, stage)
                     ref = fn.remote(item)
+                    _inherit(ref, item)
                     self.owned.add(ref.hex())
                     in_flight[i][ref.hex()] = (ref, item)
                 if in_flight[i]:

@@ -152,10 +152,17 @@ class DeltaDatasource(Datasource):
                             if col not in part:
                                 continue
                             cv = cast(col, part[col])
-                            keep = {"=": cv == val, "==": cv == val,
-                                    "!=": cv != val,
-                                    ">": cv > val, ">=": cv >= val,
-                                    "<": cv < val, "<=": cv <= val}[op]
+                            # lazy dispatch: a dict literal would evaluate
+                            # every branch (e.g. `cv in val` with scalar val)
+                            keep = {"=": lambda: cv == val,
+                                    "==": lambda: cv == val,
+                                    "!=": lambda: cv != val,
+                                    ">": lambda: cv > val,
+                                    ">=": lambda: cv >= val,
+                                    "<": lambda: cv < val,
+                                    "<=": lambda: cv <= val,
+                                    "in": lambda: cv in val,
+                                    "not in": lambda: cv not in val}[op]()
                             if not keep:
                                 blk = {k: v[:0] for k, v in blk.items()}
                                 break
@@ -204,14 +211,22 @@ def write_delta(ds, table: str, *, mode: str = "append",
         if partition_cols:
             written = write_parquet_partitioned(b, table, i, partition_cols)
             for w in written:
-                rel = os.path.relpath(w, table)
+                # commit-unique rename: partitioned filenames are only
+                # block-indexed, so a later commit writing the same
+                # partition would overwrite this commit's physical file
+                unique = os.path.join(
+                    os.path.dirname(w),
+                    f"part-{version:05d}-{uuid.uuid4().hex[:12]}-"
+                    f"{os.path.basename(w)[len('part-'):]}")
+                os.replace(w, unique)
+                rel = os.path.relpath(unique, table)
                 pv = {}
                 for seg in rel.split(os.sep)[:-1]:
                     if "=" in seg:
                         k, _, v = seg.partition("=")
                         pv[k] = v
                 parts[rel] = pv
-            files.extend(written)
+                files.append(unique)
         else:
             w = write_parquet_block(b, table, i)
             # unique names: delta file sets are immutable across commits

@@ -10,6 +10,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.iql import IQL, IQLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
@@ -32,6 +33,8 @@ __all__ = [
     "CQLConfig",
     "DQN",
     "DQNConfig",
+    "DreamerV3",
+    "DreamerV3Config",
     "IMPALA",
     "IMPALAConfig",
     "IQL",

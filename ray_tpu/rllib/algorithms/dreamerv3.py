@@ -1,0 +1,624 @@
+"""DreamerV3 — model-based RL: learn a latent world model, act in dreams.
+
+(reference: rllib/algorithms/dreamerv3/ — DreamerV3Config/DreamerV3 per
+Hafner et al. 2023. Three jointly-trained pieces:
+  1. WORLD MODEL: an RSSM with a deterministic GRU path h_t and a
+     categorical stochastic state z_t (straight-through gradients),
+     trained on replayed sequences by reconstruction + reward + continue
+     prediction and the two KL terms (dynamics vs representation) with
+     free bits,
+  2. CRITIC: regresses symlog lambda-returns computed over imagined
+     rollouts, with a slow EMA target for bootstrapping,
+  3. ACTOR: REINFORCE on imagined trajectories with advantages normalized
+     by an EMA of the return percentile range, plus an entropy bonus.
+The reference implementation is TF2; this one is a jitted JAX program —
+the world-model update and the imagination phase are each a single XLA
+program built from lax.scan over time, which is the TPU-native shape for
+recurrent models.)
+
+Scaled to the built-in vector envs (MLP encoder/decoder, small RSSM); the
+architecture, loss structure, and training loop match the paper.
+
+Alignment convention: the RSSM consumes the PREVIOUS action at every step
+(training and acting identically; is_first masks it at episode starts).
+rewards[t]/dones[t] are the outcome of the action taken at t, and the
+lambda-return indexing matches that; the reward/continue heads therefore
+predict outcome-at-t marginalized over the current action (exact for
+state-determined rewards, a small bias otherwise — the auto-resetting
+vector envs drop the terminal observation, which rules out the paper's
+arrival-indexed storage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class DreamerV3Config(AlgorithmConfig):
+    algo_class = None  # set below
+
+    def __init__(self):
+        super().__init__()
+        self.model_hidden = (128,)
+        self.deter_dim = 128           # GRU (deterministic) state
+        self.stoch_classes = 8         # categorical classes per latent
+        self.stoch_dims = 8            # number of categorical latents
+        self.embed_dim = 64
+        self.batch_size_B = 16         # sequences per world-model batch
+        self.batch_length_T = 32       # timesteps per sequence
+        self.horizon_H = 15            # imagination horizon
+        self.buffer_size = 50_000
+        self.num_updates_per_step = 8
+        self.learning_starts = 1_000
+        self.gae_lambda = 0.95
+        self.entropy_scale = 3e-3
+        self.critic_ema_decay = 0.98
+        self.free_bits = 1.0
+        self.kl_dyn_scale = 0.5
+        self.kl_rep_scale = 0.1
+        self.world_lr = 6e-4
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+
+    def training(self, *, batch_size_B=None, batch_length_T=None,
+                 horizon_H=None, num_updates_per_step=None,
+                 learning_starts=None, entropy_scale=None, world_lr=None,
+                 actor_lr=None, critic_lr=None, **kwargs) -> "DreamerV3Config":
+        super().training(**kwargs)
+        for name, val in (("batch_size_B", batch_size_B),
+                          ("batch_length_T", batch_length_T),
+                          ("horizon_H", horizon_H),
+                          ("num_updates_per_step", num_updates_per_step),
+                          ("learning_starts", learning_starts),
+                          ("entropy_scale", entropy_scale),
+                          ("world_lr", world_lr), ("actor_lr", actor_lr),
+                          ("critic_lr", critic_lr)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+# ------------------------------------------------------------------ modules
+
+
+def _dense_init(key, sizes):
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i in range(len(sizes) - 1):
+        params[str(i)] = {
+            "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * jnp.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],)),
+        }
+    return params
+
+
+def _dense(params, x, act=jax.nn.silu, final_linear=True):
+    n = len(params)
+    for i in range(n):
+        layer = params[str(i)]
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1 or not final_linear:
+            x = act(x)
+    return x
+
+
+def _gru_init(key, in_dim: int, hidden: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    scale = jnp.sqrt(1.0 / (in_dim + hidden))
+    return {"wi": jax.random.normal(k1, (in_dim, 3 * hidden)) * scale,
+            "wh": jax.random.normal(k2, (hidden, 3 * hidden)) * scale,
+            "b": jnp.zeros((3 * hidden,))}
+
+
+def _gru(params, x, h):
+    gates = x @ params["wi"] + h @ params["wh"] + params["b"]
+    r, u, c = jnp.split(gates, 3, axis=-1)
+    r, u = jax.nn.sigmoid(r), jax.nn.sigmoid(u)
+    cand = jnp.tanh(r * c)
+    return u * h + (1.0 - u) * cand
+
+
+def init_dreamer_params(key, obs_dim: int, num_actions: int,
+                        cfg: DreamerV3Config) -> dict:
+    S, C = cfg.stoch_dims, cfg.stoch_classes
+    z_dim = S * C
+    feat = cfg.deter_dim + z_dim
+    ks = jax.random.split(key, 9)
+    hid = cfg.model_hidden
+    return {
+        "encoder": _dense_init(ks[0], (obs_dim, *hid, cfg.embed_dim)),
+        "gru": _gru_init(ks[1], z_dim + num_actions, cfg.deter_dim),
+        "prior": _dense_init(ks[2], (cfg.deter_dim, *hid, z_dim)),
+        "posterior": _dense_init(ks[3], (cfg.deter_dim + cfg.embed_dim,
+                                         *hid, z_dim)),
+        "decoder": _dense_init(ks[4], (feat, *hid, obs_dim)),
+        "reward": _dense_init(ks[5], (feat, *hid, 1)),
+        "continue": _dense_init(ks[6], (feat, *hid, 1)),
+        "actor": _dense_init(ks[7], (feat, *hid, num_actions)),
+        "critic": _dense_init(ks[8], (feat, *hid, 1)),
+    }
+
+
+def _sample_z(logits, key, S: int, C: int):
+    """Straight-through categorical sample: one-hot forward, probs grad."""
+    lg = logits.reshape(*logits.shape[:-1], S, C)
+    # unimix (paper): 1% uniform smoothing keeps log-probs finite
+    probs = 0.99 * jax.nn.softmax(lg) + 0.01 / C
+    lg = jnp.log(probs)
+    idx = jax.random.categorical(key, lg)
+    onehot = jax.nn.one_hot(idx, C, dtype=lg.dtype)
+    st = onehot + probs - jax.lax.stop_gradient(probs)
+    return st.reshape(*logits.shape[:-1], S * C), lg
+
+
+def _kl_cat(lg_p, lg_q):
+    """KL(p || q) for stacked categorical logits [.., S, C], summed over S."""
+    p = jnp.exp(lg_p)
+    return jnp.sum(p * (lg_p - lg_q), axis=(-2, -1))
+
+
+# ------------------------------------------------------------- world model
+
+
+def make_world_model_update(opt, cfg: DreamerV3Config, num_actions: int):
+    S, C = cfg.stoch_dims, cfg.stoch_classes
+
+    def rssm_observe(params, obs_seq, act_seq, is_first, key):
+        """Teacher-forced posterior roll: obs/act [T, B, .] → features,
+        prior/posterior logits. is_first resets the recurrent state."""
+        T, B = obs_seq.shape[:2]
+        embed = _dense(params["encoder"], obs_seq)
+        keys = jax.random.split(key, T)
+
+        def step(carry, inp):
+            h, z = carry
+            e_t, a_t, first_t, k_t = inp
+            mask = (1.0 - first_t)[:, None]
+            h, z = h * mask, z * mask
+            a_t = a_t * mask
+            h = _gru(params["gru"], jnp.concatenate([z, a_t], -1), h)
+            prior_lg = _dense(params["prior"], h)
+            post_lg = _dense(params["posterior"],
+                             jnp.concatenate([h, e_t], -1))
+            z, post_lgn = _sample_z(post_lg, k_t, S, C)
+            _, prior_lgn = _sample_z(prior_lg, k_t, S, C)
+            return (h, z), (h, z, prior_lgn, post_lgn)
+
+        h0 = jnp.zeros((B, cfg.deter_dim))
+        z0 = jnp.zeros((B, S * C))
+        (_, _), (hs, zs, prior_lg, post_lg) = jax.lax.scan(
+            step, (h0, z0), (embed, act_seq, is_first, keys))
+        feats = jnp.concatenate([hs, zs], -1)
+        return feats, prior_lg, post_lg
+
+    @jax.jit
+    def update(wm_params, opt_state, batch, key):
+        """wm_params: ONLY the world-model subtree (encoder/gru/prior/
+        posterior/decoder/reward/continue) — the optimizer state is built
+        over exactly this tree, and the loss touches nothing else."""
+
+        def loss_fn(p):
+            feats, prior_lg, post_lg = rssm_observe(
+                p, batch["obs"], batch["actions_onehot"],
+                batch["is_first"], key)
+            recon = _dense(p["decoder"], feats)
+            recon_loss = jnp.mean(jnp.sum(
+                (recon - symlog(batch["obs"])) ** 2, -1))
+            rew_pred = _dense(p["reward"], feats)[..., 0]
+            rew_loss = jnp.mean((rew_pred - symlog(batch["rewards"])) ** 2)
+            cont_logit = _dense(p["continue"], feats)[..., 0]
+            cont = 1.0 - batch["dones"].astype(jnp.float32)
+            cont_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(
+                cont_logit, cont))
+            dyn_kl = _kl_cat(jax.lax.stop_gradient(post_lg), prior_lg)
+            rep_kl = _kl_cat(post_lg, jax.lax.stop_gradient(prior_lg))
+            kl_loss = (cfg.kl_dyn_scale
+                       * jnp.mean(jnp.maximum(cfg.free_bits, dyn_kl))
+                       + cfg.kl_rep_scale
+                       * jnp.mean(jnp.maximum(cfg.free_bits, rep_kl)))
+            loss = recon_loss + rew_loss + cont_loss + kl_loss
+            metrics = {"wm_recon": recon_loss, "wm_reward": rew_loss,
+                       "wm_continue": cont_loss,
+                       "wm_kl": jnp.mean(dyn_kl)}
+            return loss, (feats, metrics)
+
+        (loss, (feats, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(wm_params)
+        grads = jax.tree.map(lambda g: jnp.clip(g, -100.0, 100.0), grads)
+        updates, opt_state = opt.update(grads, opt_state, wm_params)
+        wm_params = optax.apply_updates(wm_params, updates)
+        metrics["wm_loss"] = loss
+        return wm_params, opt_state, jax.lax.stop_gradient(feats), metrics
+
+    return update
+
+
+# ------------------------------------------------------- imagination phase
+
+
+def make_dream_update(actor_opt, critic_opt, cfg: DreamerV3Config,
+                      num_actions: int):
+    S, C = cfg.stoch_dims, cfg.stoch_classes
+
+    def imagine(params, feats0, key):
+        """Roll the dynamics forward H steps from real posterior states,
+        actions sampled from the actor. feats0 [N, feat]."""
+        N = feats0.shape[0]
+        h0 = feats0[:, :cfg.deter_dim]
+        z0 = feats0[:, cfg.deter_dim:]
+        keys = jax.random.split(key, cfg.horizon_H)
+
+        def step(carry, k_t):
+            h, z = carry
+            feat = jnp.concatenate([h, z], -1)
+            a_lg = jax.nn.log_softmax(_dense(params["actor"], feat))
+            ka, kz = jax.random.split(k_t)
+            a = jax.random.categorical(ka, a_lg)
+            a_1h = jax.nn.one_hot(a, num_actions)
+            h = _gru(params["gru"], jnp.concatenate([z, a_1h], -1), h)
+            prior_lg = _dense(params["prior"], h)
+            z, _ = _sample_z(prior_lg, kz, S, C)
+            logp = jnp.take_along_axis(a_lg, a[:, None], 1)[:, 0]
+            ent = -jnp.sum(jnp.exp(a_lg) * a_lg, -1)
+            return (h, z), (feat, logp, ent)
+
+        (_, _), (feats, logps, ents) = jax.lax.scan(
+            step, (h0, z0), keys)
+        return feats, logps, ents  # [H, N, .]
+
+    @jax.jit
+    def update(params, slow_critic, opt_states, ret_ema, feats0, key):
+        # ---- imagine with gradients flowing ONLY into the actor (the
+        # world model is frozen in this phase, per the paper)
+        frozen = jax.lax.stop_gradient(
+            {k: params[k] for k in ("gru", "prior")})
+
+        def actor_loss_fn(actor_params):
+            p = {**params, **frozen, "actor": actor_params}
+            feats, logps, ents = imagine(p, feats0, key)
+            rew = symexp(_dense(params["reward"], feats)[..., 0])
+            cont = jax.nn.sigmoid(_dense(params["continue"], feats)[..., 0])
+            vals = symexp(_dense(slow_critic, feats)[..., 0])
+            disc = cont * cfg.gamma
+
+            # lambda-returns, backward scan
+            def lam_step(nxt, inp):
+                r_t, d_t, v_next = inp
+                ret = r_t + d_t * ((1 - cfg.gae_lambda) * v_next
+                                   + cfg.gae_lambda * nxt)
+                return ret, ret
+
+            last_v = vals[-1]
+            _, rets = jax.lax.scan(
+                lam_step, last_v,
+                (rew[:-1], disc[:-1], vals[1:]), reverse=True)
+            # normalize advantages by an EMA of the return spread (paper:
+            # 95th-5th percentile, floored at 1)
+            lo = jnp.percentile(rets, 5.0)
+            hi = jnp.percentile(rets, 95.0)
+            spread = jnp.maximum(1.0, hi - lo)
+            adv = jax.lax.stop_gradient(rets - vals[:-1]) / \
+                jax.lax.stop_gradient(jnp.maximum(1.0, ret_ema))
+            # discount-weight trajectories by survival probability
+            weight = jax.lax.stop_gradient(
+                jnp.cumprod(jnp.concatenate(
+                    [jnp.ones((1,) + disc.shape[1:]), disc[:-1]], 0), 0))[:-1]
+            pg = -jnp.mean(weight * adv * logps[:-1])
+            ent_bonus = -cfg.entropy_scale * jnp.mean(weight * ents[:-1])
+            return pg + ent_bonus, (rets, feats, weight,
+                                    jnp.mean(ents), spread)
+
+        (a_loss, (rets, feats, weight, ent_mean, spread)), a_grads = \
+            jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        a_updates, a_state = actor_opt.update(
+            a_grads, opt_states["actor"], params["actor"])
+        actor_params = optax.apply_updates(params["actor"], a_updates)
+
+        # ---- critic: symlog regression toward the lambda-returns
+        feats_sg = jax.lax.stop_gradient(feats[:-1])
+        target = jax.lax.stop_gradient(symlog(rets))
+
+        def critic_loss_fn(critic_params):
+            v = _dense(critic_params, feats_sg)[..., 0]
+            return jnp.mean(weight * (v - target) ** 2)
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        c_updates, c_state = critic_opt.update(
+            c_grads, opt_states["critic"], params["critic"])
+        critic_params = optax.apply_updates(params["critic"], c_updates)
+
+        new_slow = jax.tree.map(
+            lambda s, o: cfg.critic_ema_decay * s
+            + (1 - cfg.critic_ema_decay) * o,
+            slow_critic, critic_params)
+        new_params = {**params, "actor": actor_params,
+                      "critic": critic_params}
+        new_ema = 0.99 * ret_ema + 0.01 * spread
+        metrics = {"actor_loss": a_loss, "critic_loss": c_loss,
+                   "dream_return": jnp.mean(rets),
+                   "actor_entropy": ent_mean}
+        return (new_params, new_slow,
+                {"actor": a_state, "critic": c_state}, new_ema, metrics)
+
+    return update
+
+
+# --------------------------------------------------------------- env runner
+
+
+@ray_tpu.remote
+class _DreamerRunner:
+    """Remote rollout actor carrying the recurrent (h, z) policy state
+    across sample() calls; the world-model + actor params are shipped
+    per call like the other off-policy runners."""
+
+    def __init__(self, env_id, num_envs: int, cfg_blob: bytes,
+                 seed: int = 0):
+        from ray_tpu._private import serialization as ser
+
+        self.cfg = ser.loads(cfg_blob)
+        self.env = make_vec_env(env_id, num_envs, seed)
+        self.obs = self.env.reset(seed)
+        self.num_actions = self.env.num_actions
+        self.key = jax.random.PRNGKey(seed)
+        cfg = self.cfg
+        N = num_envs
+        self.h = np.zeros((N, cfg.deter_dim), np.float32)
+        self.z = np.zeros((N, cfg.stoch_dims * cfg.stoch_classes),
+                          np.float32)
+        self.prev_action = np.zeros((N,), np.int64)
+        self.first = np.ones((N,), np.float32)
+
+        S, C = cfg.stoch_dims, cfg.stoch_classes
+
+        @jax.jit
+        def policy(params, h, z, obs, prev_a, first, key):
+            kz, ka = jax.random.split(key)
+            mask = (1.0 - first)[:, None]
+            h, z = h * mask, z * mask
+            a_1h = jax.nn.one_hot(prev_a, self.num_actions) * mask
+            e = _dense(params["encoder"], obs)
+            h = _gru(params["gru"], jnp.concatenate([z, a_1h], -1), h)
+            post_lg = _dense(params["posterior"],
+                             jnp.concatenate([h, e], -1))
+            z, _ = _sample_z(post_lg, kz, S, C)
+            feat = jnp.concatenate([h, z], -1)
+            logits = _dense(params["actor"], feat)
+            a = jax.random.categorical(ka, logits)
+            return h, z, a
+
+        self._policy = policy
+
+    def sample(self, params_blob: bytes, num_steps: int,
+               random_actions: bool = False) -> dict:
+        from ray_tpu._private import serialization as ser
+
+        params = None if random_actions else ser.loads(params_blob)
+        N = self.env.num_envs
+        obs_l, act_l, prev_l, rew_l, done_l, first_l = [], [], [], [], [], []
+        for _ in range(num_steps):
+            self.key, sub = jax.random.split(self.key)
+            # prev_actions[t] = action taken BEFORE observing obs_t — the
+            # exact input the acting policy's GRU consumed, so training
+            # sequences reproduce the same action alignment (is_first
+            # masks it at episode starts)
+            prev_l.append(self.prev_action.copy())
+            if random_actions:
+                a = np.asarray(jax.random.randint(
+                    sub, (N,), 0, self.num_actions))
+            else:
+                h, z, a = self._policy(
+                    params, jnp.asarray(self.h), jnp.asarray(self.z),
+                    jnp.asarray(self.obs), jnp.asarray(self.prev_action),
+                    jnp.asarray(self.first), sub)
+                self.h, self.z = np.asarray(h), np.asarray(z)
+                a = np.asarray(a)
+            obs_l.append(self.obs.copy())
+            first_l.append(self.first.copy())
+            nxt, r, d, _ = self.env.step(a)
+            act_l.append(a)
+            rew_l.append(r)
+            done_l.append(d)
+            self.obs = nxt
+            self.prev_action = a
+            self.first = d.astype(np.float32)
+        return {
+            "obs": np.stack(obs_l, 1),        # [N, T, obs]
+            "actions": np.stack(act_l, 1),
+            "prev_actions": np.stack(prev_l, 1),
+            "rewards": np.stack(rew_l, 1),
+            "dones": np.stack(done_l, 1),
+            "is_first": np.stack(first_l, 1),
+            "episode_returns": self.env.drain_episode_returns(),
+        }
+
+
+class _SequenceBuffer:
+    """Stores per-env streams; samples [B, T] windows uniformly."""
+
+    def __init__(self, capacity_steps: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity_steps
+        self.obs_dim = obs_dim
+        self.streams: list[dict] = []
+        self.rng = np.random.default_rng(seed)
+        self.size = 0
+
+    def add_rollout(self, batch: dict):
+        N = batch["obs"].shape[0]
+        for i in range(N):
+            self.streams.append({
+                "obs": batch["obs"][i], "actions": batch["actions"][i],
+                "prev_actions": batch["prev_actions"][i],
+                "rewards": batch["rewards"][i], "dones": batch["dones"][i],
+                "is_first": batch["is_first"][i]})
+            self.size += batch["obs"].shape[1]
+        while self.size > self.capacity and len(self.streams) > 1:
+            dead = self.streams.pop(0)
+            self.size -= len(dead["rewards"])
+
+    def sample(self, B: int, T: int) -> dict | None:
+        eligible = [s for s in self.streams if len(s["rewards"]) >= T]
+        if not eligible:
+            return None
+        out = {k: [] for k in ("obs", "actions", "prev_actions", "rewards",
+                               "dones", "is_first")}
+        for _ in range(B):
+            s = eligible[self.rng.integers(0, len(eligible))]
+            lo = self.rng.integers(0, len(s["rewards"]) - T + 1)
+            for k in out:
+                out[k].append(s[k][lo:lo + T])
+        return {k: np.stack(v) for k, v in out.items()}  # [B, T, ...]
+
+
+class DreamerV3(Algorithm):
+    def _setup(self):
+        cfg = self.config
+        probe = make_vec_env(cfg.env_id, 1, cfg.seed)
+        if probe.num_actions < 1:
+            raise ValueError("DreamerV3 here supports discrete-action envs")
+        self.obs_dim = probe.obs_dim
+        self.num_actions = probe.num_actions
+        self.params = init_dreamer_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.num_actions, cfg)
+        self.slow_critic = self.params["critic"]
+        wm_keys = ("encoder", "gru", "prior", "posterior", "decoder",
+                   "reward", "continue")
+        self.world_opt = optax.adam(cfg.world_lr)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self._wm_keys = wm_keys
+        self.opt_states = {
+            "world": self.world_opt.init(
+                {k: self.params[k] for k in wm_keys}),
+            "actor": self.actor_opt.init(self.params["actor"]),
+            "critic": self.critic_opt.init(self.params["critic"]),
+        }
+        self.ret_ema = jnp.float32(1.0)
+        self._wm_update = self._make_wm_wrapper()
+        self._dream_update = make_dream_update(
+            self.actor_opt, self.critic_opt, cfg, self.num_actions)
+        from ray_tpu._private import serialization as ser
+
+        cfg_blob = ser.dumps(cfg)
+        self.runners = [
+            _DreamerRunner.remote(cfg.env_id, cfg.num_envs_per_runner,
+                                  cfg_blob, cfg.seed + 1000 * (i + 1))
+            for i in range(cfg.num_env_runners)]
+        self.buffer = _SequenceBuffer(cfg.buffer_size, self.obs_dim,
+                                      seed=cfg.seed)
+        self.key = jax.random.PRNGKey(cfg.seed + 13)
+        self._env_steps = 0
+        self._num_updates = 0
+
+    def _make_wm_wrapper(self):
+        cfg = self.config
+        wm_keys = self._wm_keys
+        raw = make_world_model_update(self.world_opt, cfg, self.num_actions)
+
+        def update(params, opt_state, batch, key):
+            wm_params = {k: params[k] for k in wm_keys}
+            new_wm, opt_state, feats, metrics = raw(
+                wm_params, opt_state, batch, key)
+            return {**params, **new_wm}, opt_state, feats, metrics
+
+        return update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        from ray_tpu._private import serialization as ser
+
+        warmup = self._env_steps < cfg.learning_starts
+        blob = ser.dumps(jax.device_get(
+            {k: self.params[k] for k in
+             ("encoder", "gru", "posterior", "actor")}))
+        refs = [r.sample.remote(blob, cfg.rollout_fragment_length,
+                                random_actions=warmup)
+                for r in self.runners]
+        for s in ray_tpu.get(refs, timeout=300):
+            self.buffer.add_rollout(s)
+            self._env_steps += int(s["rewards"].size)
+            self._episode_returns.extend(s["episode_returns"])
+        metrics: dict = {"env_steps": self._env_steps}
+        if warmup:
+            return metrics
+        m: dict = {}
+        for _ in range(cfg.num_updates_per_step):
+            batch = self.buffer.sample(cfg.batch_size_B, cfg.batch_length_T)
+            if batch is None:
+                break
+            jb = {
+                # time-major for the scans; the RSSM consumes the PREVIOUS
+                # action at each step, matching the acting policy
+                "obs": jnp.asarray(np.swapaxes(batch["obs"], 0, 1)),
+                "actions_onehot": jax.nn.one_hot(
+                    jnp.asarray(np.swapaxes(batch["prev_actions"], 0, 1)),
+                    self.num_actions),
+                "rewards": jnp.asarray(np.swapaxes(batch["rewards"], 0, 1)),
+                "dones": jnp.asarray(np.swapaxes(batch["dones"], 0, 1)),
+                "is_first": jnp.asarray(
+                    np.swapaxes(batch["is_first"], 0, 1).astype(np.float32)),
+            }
+            self.key, k1, k2 = jax.random.split(self.key, 3)
+            wm_opt = self.opt_states["world"]
+            self.params, wm_opt, feats, m = self._wm_update(
+                self.params, wm_opt, jb, k1)
+            self.opt_states["world"] = wm_opt
+            feats0 = feats.reshape(-1, feats.shape[-1])
+            (self.params, self.slow_critic, ac_states, self.ret_ema,
+             dm) = self._dream_update(
+                self.params, self.slow_critic,
+                {"actor": self.opt_states["actor"],
+                 "critic": self.opt_states["critic"]},
+                self.ret_ema, feats0, k2)
+            self.opt_states["actor"] = ac_states["actor"]
+            self.opt_states["critic"] = ac_states["critic"]
+            m.update(dm)
+            self._num_updates += 1
+        metrics.update({k: float(v) for k, v in m.items()})
+        metrics["num_updates"] = self._num_updates
+        return metrics
+
+    def compute_single_action(self, obs) -> int:
+        """Greedy action through the posterior-free prior path is not
+        meaningful without history; evaluation uses the actor on a
+        fresh posterior step with empty recurrent state."""
+        cfg = self.config
+        e = _dense(self.params["encoder"], jnp.asarray(obs)[None])
+        h = jnp.zeros((1, cfg.deter_dim))
+        z = jnp.zeros((1, cfg.stoch_dims * cfg.stoch_classes))
+        h = _gru(self.params["gru"],
+                 jnp.concatenate([z, jnp.zeros((1, self.num_actions))], -1),
+                 h)
+        post_lg = _dense(self.params["posterior"],
+                         jnp.concatenate([h, e], -1))
+        z, _ = _sample_z(post_lg, jax.random.PRNGKey(0),
+                         cfg.stoch_dims, cfg.stoch_classes)
+        logits = _dense(self.params["actor"], jnp.concatenate([h, z], -1))
+        return int(jnp.argmax(logits[0]))
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners.clear()
+
+
+DreamerV3Config.algo_class = DreamerV3

@@ -94,6 +94,45 @@ def test_delta_partitioned_write_and_partition_filter(tmp_path):
     assert "k" not in got_v[0] and len(got_v) == 30
 
 
+def test_delta_partitioned_append_preserves_data(tmp_path):
+    """Physical filenames must be commit-unique: a second partitioned
+    commit into the same partitions must not overwrite the first's files."""
+    table = str(tmp_path / "pa")
+    rd.from_items([{"k": i % 2, "v": i} for i in range(10)]) \
+        .write_delta(table, partition_cols=["k"])
+    rd.from_items([{"k": i % 2, "v": 100 + i} for i in range(4)]) \
+        .write_delta(table, mode="append", partition_cols=["k"])
+    got = sorted(r["v"] for r in rd.read_delta(table).take_all())
+    assert got == sorted(list(range(10)) + [100, 101, 102, 103])
+
+
+def test_delta_partition_in_filter(tmp_path):
+    table = str(tmp_path / "pin")
+    rd.from_items([{"k": i % 3, "v": i} for i in range(12)]) \
+        .write_delta(table, partition_cols=["k"])
+    got = rd.read_delta(table, filter=[("k", "in", [0, 2])]).take_all()
+    assert sorted({r["k"] for r in got}) == [0, 2] and len(got) == 8
+
+
+def test_avro_mixed_and_ragged_rows(tmp_path):
+    from ray_tpu.data.avro import read_avro_file, write_avro_file
+
+    # int/float mix widens to double instead of truncating
+    p = str(tmp_path / "mix.avro")
+    write_avro_file(p, [{"a": 1}, {"a": 2.5}])
+    got, _ = read_avro_file(p)
+    assert got == [{"a": 1.0}, {"a": 2.5}]
+    # keys absent from the first row still make it into the schema
+    p2 = str(tmp_path / "ragged.avro")
+    write_avro_file(p2, [{"a": 1}, {"a": 2, "b": 9}])
+    got2, _ = read_avro_file(p2)
+    assert got2 == [{"a": 1, "b": None}, {"a": 2, "b": 9}]
+    # incompatible mixes raise instead of corrupting
+    with pytest.raises(TypeError, match="incompatible"):
+        write_avro_file(str(tmp_path / "bad.avro"),
+                        [{"a": 1}, {"a": "text"}])
+
+
 def test_delta_checkpoint_replay(tmp_path):
     """A parquet checkpoint + later JSON commits replay correctly."""
     import pyarrow as pa

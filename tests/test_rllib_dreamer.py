@@ -1,0 +1,79 @@
+"""DreamerV3: world-model learning + imagination-trained actor-critic.
+
+(reference test strategy: rllib/algorithms/dreamerv3/tests/ — unit checks
+on the model parts plus a learning run that must clear a return bar.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rl_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=10)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_symlog_symexp_inverse():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamerv3 import symexp, symlog
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 30.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-5)
+
+
+def test_rssm_shapes_and_straight_through():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamerv3 import (DreamerV3Config,
+                                                    _sample_z,
+                                                    init_dreamer_params)
+
+    cfg = DreamerV3Config()
+    params = init_dreamer_params(jax.random.PRNGKey(0), 4, 2, cfg)
+    z_dim = cfg.stoch_dims * cfg.stoch_classes
+    logits = jax.random.normal(jax.random.PRNGKey(1), (3, z_dim))
+    z, lg = _sample_z(logits, jax.random.PRNGKey(2), cfg.stoch_dims,
+                      cfg.stoch_classes)
+    assert z.shape == (3, z_dim)
+    # forward value is one-hot per latent
+    zr = np.asarray(z).reshape(3, cfg.stoch_dims, cfg.stoch_classes)
+    np.testing.assert_allclose(zr.sum(-1), 1.0, atol=1e-5)
+    # straight-through: gradients flow to the logits despite sampling
+    grad = jax.grad(lambda lgt: jnp.sum(_sample_z(
+        lgt, jax.random.PRNGKey(2), cfg.stoch_dims, cfg.stoch_classes)[0]
+        ** 2))(logits)
+    assert float(jnp.abs(grad).sum()) > 0.0
+
+
+@pytest.mark.slow
+def test_dreamerv3_learns_cartpole(rl_cluster):
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(learning_starts=512, num_updates_per_step=8)
+            .debugging(seed=0)
+            .build())
+    rets = []
+    for _ in range(40):
+        result = algo.train()
+        r = result["env_runners"]["episode_return_mean"]
+        if not np.isnan(r):
+            rets.append(r)
+    algo.stop()
+    assert rets, "no episodes completed"
+    # random CartPole averages ~20-25; the dreamed policy must clearly beat
+    # it (the reference curve here reaches ~120 by 20k env steps)
+    assert max(rets[-10:]) > 60.0, rets[-10:]
+    # the world model must actually be fitting
+    assert result["learners"]["wm_loss"] < 2.0
