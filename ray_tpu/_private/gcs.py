@@ -31,7 +31,7 @@ import threading
 import time
 from typing import Callable
 
-from ray_tpu._private import accelerators, pg_policy
+from ray_tpu._private import accelerators, fixed_point as fp, pg_policy
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
 from ray_tpu._private.ray_config import RayConfig
 
@@ -130,14 +130,17 @@ class _VNode:
 
     def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
         self.node_id = node_id
-        self.total = {k: float(v) for k, v in resources.items()}
+        # fixed-point integer units internally (fixed_point.py): exact
+        # acquire/release round-trips, no epsilon compares
+        self.total = fp.fp_dict(resources)
         self.available = dict(self.total)
         self.labels = dict(labels or {})
         self.alive = True
         # unbound TPU chip ids; chips leave the pool when a worker is spawned
         # with them visible and return when that worker dies (reference:
         # TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36)
-        self.chip_pool: list[int] = list(range(int(self.total.get("TPU", 0.0))))
+        self.chip_pool: list[int] = list(
+            range(int(fp.from_fp(self.total.get("TPU", 0)))))
         # chips held by a worker that was SIGKILLed mid-grant (OOM defense):
         # the shared device pool may be wedged, so they are withheld from
         # re-allocation until an operator re-enables them
@@ -240,7 +243,7 @@ class _Bundle:
     __slots__ = ("total", "available", "node_id")
 
     def __init__(self, resources: dict):
-        self.total = {k: float(v) for k, v in resources.items()}
+        self.total = fp.fp_dict(resources)  # fixed-point units, like _VNode
         self.available = dict(self.total)
         self.node_id: str | None = None
 
@@ -372,24 +375,24 @@ class GcsServer:
         self._pub_sendq: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._pub_thread: threading.Thread | None = None
 
-    # aggregate views (cluster_state compatibility)
+    # aggregate views (cluster_state compatibility; floats at the surface)
     @property
     def total(self) -> dict:
-        out: dict[str, float] = {}
+        out: dict[str, int] = {}
         for n in self.nodes.values():
             if n.alive:
                 for k, v in n.total.items():
-                    out[k] = out.get(k, 0.0) + v
-        return out
+                    out[k] = out.get(k, 0) + v
+        return fp.float_dict(out)
 
     @property
     def available(self) -> dict:
-        out: dict[str, float] = {}
+        out: dict[str, int] = {}
         for n in self.nodes.values():
             if n.alive:
                 for k, v in n.available.items():
-                    out[k] = out.get(k, 0.0) + v
-        return out
+                    out[k] = out.get(k, 0) + v
+        return fp.float_dict(out)
 
     # ------------------------------------------------------------------ server
 
@@ -1097,7 +1100,7 @@ class GcsServer:
                 table = {
                     pg.pg_id: {
                         "name": pg.name, "state": pg.state, "strategy": pg.strategy,
-                        "bundles": [dict(b.total) for b in pg.bundles],
+                        "bundles": [fp.float_dict(b.total) for b in pg.bundles],
                         "bundle_nodes": [b.node_id for b in pg.bundles],
                     }
                     for pg in self.pgs.values()
@@ -1120,7 +1123,8 @@ class GcsServer:
             with self.lock:
                 nodes = [
                     {"node_id": n.node_id, "alive": n.alive, "labels": dict(n.labels),
-                     "total": dict(n.total), "available": dict(n.available),
+                     "total": fp.float_dict(n.total),
+                     "available": fp.float_dict(n.available),
                      "quarantined_chips": list(n.quarantined_chips),
                      "host_view": self._host_view_for(n.node_id)}
                     for n in self.nodes.values()
@@ -1207,7 +1211,8 @@ class GcsServer:
                     },
                     "nodes": {
                         n.node_id: {"alive": n.alive, "labels": dict(n.labels),
-                                    "total": dict(n.total), "available": dict(n.available)}
+                                    "total": fp.float_dict(n.total),
+                                    "available": fp.float_dict(n.available)}
                         for n in self.nodes.values()
                     },
                 }
@@ -1237,7 +1242,7 @@ class GcsServer:
                     pg = self.pgs.get(pgid)
                     if pg is not None and pg.state == "pending":
                         pg_demands.append({"strategy": pg.strategy,
-                                           "bundles": [dict(b.total)
+                                           "bundles": [fp.float_dict(b.total)
                                                        for b in pg.bundles]})
                 state = {
                     "demands": demands,
@@ -1900,10 +1905,23 @@ class GcsServer:
 
     # ------------------------------------------------------------- accounting
 
+
+    @staticmethod
+    def _spec_fp(spec: dict) -> dict:
+        """Fixed-point view of spec["resources"], cached on the spec —
+        schedulers probe the same pending spec many times per pass, and a
+        forgotten fp.fp_dict wrapper at a new call site would compare raw
+        floats against integer availability (never fits)."""
+        r = spec.get("_fp_res")
+        if r is None:
+            r = fp.fp_dict(spec.get("resources") or {})
+            spec["_fp_res"] = r
+        return r
+
     def _fits_for(self, spec: dict) -> str | None:
         """Pick a node for this spec honoring its scheduling strategy.
         Returns node_id or None if nothing fits right now."""
-        res = spec.get("resources", {})
+        res = self._spec_fp(spec)
         strat = spec.get("strategy")
         if strat and strat.get("kind") == "pg":
             pg = self.pgs.get(strat["pg_id"])
@@ -1914,7 +1932,7 @@ class GcsServer:
                 return None  # invalid index: rejected at submit time
             cand = pg.bundles if idx == -1 else [pg.bundles[idx]]
             for b in cand:
-                if all(b.available.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                if all(b.available.get(k, 0) >= v for k, v in res.items()):
                     return b.node_id
             return None
         if strat and strat.get("kind") == "node_label":
@@ -1932,27 +1950,27 @@ class GcsServer:
         return pg_policy.pick_node_hybrid(list(self.nodes.values()), res, self.local_node_id)
 
     def _acquire_for(self, spec: dict, node_id: str):
-        res = spec.get("resources", {})
+        res = self._spec_fp(spec)
         strat = spec.get("strategy")
         if strat and strat.get("kind") == "pg":
             pg = self.pgs[strat["pg_id"]]
             idx = strat.get("bundle", -1)
             cands = list(enumerate(pg.bundles)) if idx == -1 else [(idx, pg.bundles[idx])]
             for i, b in cands:
-                if b.node_id == node_id and all(b.available.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                if b.node_id == node_id and all(b.available.get(k, 0) >= v for k, v in res.items()):
                     for k, v in res.items():
-                        b.available[k] = b.available.get(k, 0.0) - v
+                        b.available[k] = b.available.get(k, 0) - v
                     spec["_paid"] = {"kind": "bundle", "pg_id": pg.pg_id, "bundle": i,
                                      "node": node_id, "epoch": pg.epoch}
                     return
             raise RuntimeError("bundle vanished between fit-check and acquire")
         node = self.nodes[node_id]
         for k, v in res.items():
-            node.available[k] = node.available.get(k, 0.0) - v
+            node.available[k] = node.available.get(k, 0) - v
         spec["_paid"] = {"kind": "node", "node": node_id}
 
     def _release_for(self, spec: dict):
-        res = spec.get("resources", {})
+        res = self._spec_fp(spec)
         paid = spec.pop("_paid", None)
         if not res or paid is None:
             return
@@ -1962,7 +1980,7 @@ class GcsServer:
                     and paid.get("epoch") == pg.epoch):
                 b = pg.bundles[paid["bundle"]]
                 for k, v in res.items():
-                    b.available[k] = b.available.get(k, 0.0) + v
+                    b.available[k] = b.available.get(k, 0) + v
                 return
             # PG removed (or unplaced+re-placed under a new epoch) while the
             # task ran: the in-use share was withheld from the original node
@@ -1970,7 +1988,7 @@ class GcsServer:
         node = self.nodes.get(paid["node"])
         if node is not None and node.alive:
             for k, v in res.items():
-                node.available[k] = node.available.get(k, 0.0) + v
+                node.available[k] = node.available.get(k, 0) + v
 
     # ------------------------------------------------------- direct leases
     # (reference: src/ray/raylet/scheduling/cluster_lease_manager.h:41 lease
@@ -2005,7 +2023,7 @@ class GcsServer:
                     node = self.nodes.get(w.node_id)
                     if node is None or not node.alive:
                         continue
-                    if not pg_policy._fits(node.available, res):
+                    if not pg_policy._fits(node.available, fp.fp_dict(res)):
                         continue
                     lspec = {"resources": dict(res)}
                     self._acquire_for(lspec, w.node_id)
@@ -2041,7 +2059,8 @@ class GcsServer:
             if n <= 0:
                 return
             node_id = pg_policy.pick_node_hybrid(
-                list(self.nodes.values()), res, self.local_node_id)
+                list(self.nodes.values()), fp.fp_dict(res),
+                self.local_node_id)
             if node_id is None:
                 return
             node = self.nodes.get(node_id)
@@ -2354,7 +2373,7 @@ class GcsServer:
                              and x.renv_hash == rh
                              and pg_policy._fits(
                                  self.nodes[x.node_id].available,
-                                 spec.get("resources", {}))]
+                                 self._spec_fp(spec))]
                     if not cands:
                         return False
                     w = next((x for x in cands
@@ -2463,7 +2482,8 @@ class GcsServer:
                     # not trigger spawns/reclaims/revocations for tasks that
                     # couldn't run anyway) — bounded probe, O(K) per shard
                     node_id = pg_policy.pick_node_hybrid(
-                        list(self.nodes.values()), res, self.local_node_id)
+                        list(self.nodes.values()), fp.fp_dict(res),
+                        self.local_node_id)
                     if node_id is not None:
                         runnable = sum(1 for s in itertools.islice(dq, 64)
                                        if self._deps_ready(s))
@@ -2637,17 +2657,18 @@ class GcsServer:
             return False
         avail0 = node.available
         avail = dict(avail0)
-        for k, v in (lw.lease_spec or {}).get("resources", {}).items():
-            avail[k] = avail.get(k, 0.0) + v
+        for k, v in fp.fp_dict(
+                (lw.lease_spec or {}).get("resources", {})).items():
+            avail[k] = avail.get(k, 0) + v
         for spec in itertools.islice(
                 itertools.chain(self.pending_actor_creations,
                                 self.pending_tasks), 32):
-            res = spec.get("resources", {})
+            res = self._spec_fp(spec)
             if not self._deps_ready(spec):
                 continue
-            if all(avail0.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+            if all(avail0.get(k, 0) >= v for k, v in res.items()):
                 continue  # resources already free: blocked on workers, not us
-            if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+            if all(avail.get(k, 0) >= v for k, v in res.items()):
                 return True
         return False
 
@@ -2985,7 +3006,7 @@ class GcsServer:
                 b.node_id = node_id
                 node = self.nodes[node_id]
                 for k, v in b.total.items():
-                    node.available[k] = node.available.get(k, 0.0) - v
+                    node.available[k] = node.available.get(k, 0) - v
             pg.state = "created"
             pg.epoch += 1
             placed.append(pg_id)
@@ -3021,7 +3042,7 @@ class GcsServer:
                     node = self.nodes.get(b.node_id)
                     if node is not None and node.alive:
                         for k, v in b.available.items():
-                            node.available[k] = node.available.get(k, 0.0) + v
+                            node.available[k] = node.available.get(k, 0) + v
             pg.state = "removed"
             waiters, pg.waiters = pg.waiters, []
             if pg.name and self.named_pgs.get(pg.name) == pg_id:
@@ -3095,7 +3116,7 @@ class GcsServer:
                         other = self.nodes.get(b.node_id)
                         if b.node_id != node_id and other is not None and other.alive:
                             for k, v in b.available.items():
-                                other.available[k] = other.available.get(k, 0.0) + v
+                                other.available[k] = other.available.get(k, 0) + v
                         b.available = dict(b.total)
                         b.node_id = None
                     pg.state = "pending"

@@ -20,7 +20,10 @@ STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "SLICE")
 
 
 def _fits(avail: dict, res: dict) -> bool:
-    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items())
+    """Exact comparison — both sides must share one representation (the
+    GCS passes fixed-point integer units on both; see fixed_point.py).
+    The old float epsilon is gone: quantization makes it unnecessary."""
+    return all(avail.get(k, 0) >= v for k, v in res.items())
 
 
 def _deduct(avail: dict, res: dict) -> None:

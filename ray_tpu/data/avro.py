@@ -286,6 +286,21 @@ def infer_schema(rows: list[dict], name: str = "row") -> dict:
     """Infer a nullable record schema from python/numpy row values."""
     import numpy as np
 
+    def widen(t: Any, cand: Any) -> Any:
+        """Least common avro type of two inferred types; raises on
+        incompatible mixes (no silent truncation)."""
+        if t is None or t == cand:
+            return cand
+        if cand is None:
+            return t
+        if isinstance(t, str) and isinstance(cand, str) and \
+                {t, cand} <= {"long", "double"}:
+            return "double"
+        if (isinstance(t, dict) and isinstance(cand, dict)
+                and t.get("type") == cand.get("type") == "array"):
+            return {"type": "array", "items": widen(t["items"], cand["items"])}
+        raise TypeError(f"incompatible avro types {t} and {cand}")
+
     def of(v: Any) -> Any:
         if isinstance(v, bool) or isinstance(v, np.bool_):
             return "boolean"
@@ -298,8 +313,10 @@ def infer_schema(rows: list[dict], name: str = "row") -> dict:
         if isinstance(v, str):
             return "string"
         if isinstance(v, (list, tuple, np.ndarray)):
-            inner = of(v[0]) if len(v) else "double"
-            return {"type": "array", "items": inner}
+            inner: Any = None
+            for el in v[:100]:  # widen over elements, not just element 0
+                inner = widen(inner, of(el))
+            return {"type": "array", "items": inner or "double"}
         if isinstance(v, dict):
             return {"type": "map", "values": "string"}
         if v is None:
@@ -318,18 +335,10 @@ def infer_schema(rows: list[dict], name: str = "row") -> dict:
         for r in sample:
             if r.get(k) is None:
                 continue
-            cand = of(r[k])
-            if t is None or t == cand:
-                t = cand
-            elif {t, cand} <= {"long", "double"}:
-                t = "double"  # widen mixed int/float columns
-            elif (isinstance(t, dict) and isinstance(cand, dict)
-                  and t.get("type") == cand.get("type") == "array"
-                  and {t["items"], cand["items"]} <= {"long", "double"}):
-                t = {"type": "array", "items": "double"}
-            else:
-                raise TypeError(
-                    f"column {k!r} mixes incompatible types {t} and {cand}")
+            try:
+                t = widen(t, of(r[k]))
+            except TypeError as e:
+                raise TypeError(f"column {k!r} mixes incompatible types: {e}")
         fields.append({"name": str(k),
                        "type": ["null", t] if t else "null"})
     return {"type": "record", "name": name, "fields": fields}

@@ -1,0 +1,75 @@
+"""Fixed-point resource accounting.
+
+(reference: src/ray/common/scheduling/fixed_point.h — resource amounts are
+int64 multiples of 1e-4; float accounting drifts over repeated
+acquire/release cycles and either leaks capacity or mis-rejects work.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fixed_point as fp
+
+
+def test_fp_roundtrip_and_quantization():
+    assert fp.to_fp(1.0) == 10_000
+    assert fp.to_fp(0.1) == 1_000          # exact, unlike binary float
+    assert fp.from_fp(fp.to_fp(0.3)) == 0.3
+    assert fp.fp_dict({"CPU": 0.1, "TPU": 4}) == {"CPU": 1_000, "TPU": 40_000}
+    assert fp.float_dict({"CPU": 1_000}) == {"CPU": 0.1}
+
+
+def test_fractional_acquire_release_is_exact():
+    """10 x 0.1 CPU must fill 1 CPU exactly, and releasing them must
+    restore exactly the starting availability — the 0.1+0.2!=0.3 float
+    failure mode this representation exists to kill."""
+    from ray_tpu._private.gcs import _VNode
+
+    node = _VNode("n", {"CPU": 1.0})
+    specs = [{"resources": {"CPU": 0.1}} for _ in range(10)]
+    from ray_tpu._private import pg_policy
+
+    for s in specs:
+        assert pg_policy._fits(node.available, fp.fp_dict(s["resources"]))
+        for k, v in fp.fp_dict(s["resources"]).items():
+            node.available[k] = node.available.get(k, 0) - v
+    assert node.available["CPU"] == 0            # exactly empty
+    # an 11th 0.1-CPU request must NOT fit (float accounting with an
+    # epsilon often lets it through after drift)
+    assert not pg_policy._fits(node.available, fp.fp_dict({"CPU": 0.1}))
+    for s in specs:
+        for k, v in fp.fp_dict(s["resources"]).items():
+            node.available[k] = node.available.get(k, 0) + v
+    assert node.available == node.total          # exact restore
+
+
+@pytest.mark.slow
+def test_fractional_tasks_schedule_exactly(tmp_path):
+    """End-to-end: 10 concurrent 0.1-CPU actors on a 1-CPU budget all
+    become ready; state API reports clean float availability."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_workers=2, max_workers=12)
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class Slot:
+        def ping(self):
+            return 1
+
+    actors = [Slot.remote() for _ in range(10)]
+    assert ray_tpu.get([a.ping.remote() for a in actors],
+                       timeout=120) == [1] * 10
+    avail = ray_tpu.available_resources()
+    # all CPU consumed, no residue like 5.55e-17
+    assert avail.get("CPU", 0.0) == pytest.approx(0.0, abs=1e-12)
+    for a in actors:
+        ray_tpu.kill(a)
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0.0) == 1.0:
+            break
+        time.sleep(0.25)
+    assert ray_tpu.available_resources().get("CPU", 0.0) == 1.0
+    ray_tpu.shutdown()
