@@ -1845,7 +1845,7 @@ class GcsServer:
                 if stale:
                     self._sys_hold_locked(stale, -1)
         newspec = {k: v for k, v in spec.items()
-                   if k not in ("_paid", "_holds", "retries_used", "recons_used")}
+                   if k not in ("_paid", "_holds", "_fp_res", "retries_used", "recons_used")}
         # a hard affinity to a dead node would make reconstruction
         # unschedulable forever; the data matters more than the placement
         strat = newspec.get("strategy")
@@ -2017,13 +2017,14 @@ class GcsServer:
                          and w.direct_addr]
                 if prefer:
                     cands.sort(key=lambda w: w.host_id != prefer)
+                res_fp = fp.fp_dict(res)
                 for w in cands:
                     if len(grants) >= count:
                         break
                     node = self.nodes.get(w.node_id)
                     if node is None or not node.alive:
                         continue
-                    if not pg_policy._fits(node.available, fp.fp_dict(res)):
+                    if not pg_policy._fits(node.available, res_fp):
                         continue
                     lspec = {"resources": dict(res)}
                     self._acquire_for(lspec, w.node_id)
@@ -2209,7 +2210,7 @@ class GcsServer:
         by eviction; caller holds the lock."""
         prev_lin = self.lineage.get(spec["task_id"])
         lin = {k: v for k, v in spec.items()
-               if k not in ("_paid", "_holds", "retries_used")}
+               if k not in ("_paid", "_holds", "_fp_res", "retries_used")}
         if prev_lin is not None:
             lin["recons_used"] = prev_lin.get("recons_used", 0)
         self.lineage[spec["task_id"]] = lin
@@ -2849,7 +2850,7 @@ class GcsServer:
             self.pending_actor_creations.append(spec)
         if _persist and self.storage is not None:
             clean = {k: v for k, v in spec.items()
-                     if k not in ("_actor_holds", "_paid")}
+                     if k not in ("_actor_holds", "_paid", "_fp_res")}
             self.storage.put("actors", aid, clean)
         self._schedule()
         return None

@@ -53,6 +53,8 @@ class _Request:
     pf_done: int = 0
     pf_pages: list | None = None
     pf_hashes: list | None = None
+    # full token history (prompt + emitted) for the n-gram draft proposer
+    history: list = dataclasses.field(default_factory=list)
 
 
 _SENTINEL = object()
@@ -152,6 +154,7 @@ class TPUEngine:
                  max_prefills_per_step: int = 2,
                  enable_prefix_cache: bool = False,
                  prefill_chunk: int | None = None,
+                 speculative_k: int = 0, ngram_size: int = 2,
                  mesh=None):
         self.cfg = cfg
         self.max_len = max_len or cfg.max_seq_len
@@ -251,6 +254,25 @@ class TPUEngine:
             self.state = decoding.init_decode_state(cfg, max_slots, self.max_len)
         if mesh is not None:
             self.state = _shard_state_tp(self.state, mesh)
+        # speculative decoding (reference capability: vLLM prompt-lookup /
+        # [ngram] speculation): propose `speculative_k` draft tokens per
+        # row by matching the trailing n-gram against the request's own
+        # history, verify all of them in ONE multi-token decode step
+        # (models/decoding.py verify_step), emit the accepted prefix + one
+        # corrected token. Model-free drafts; exact sampling semantics.
+        self.speculative_k = int(speculative_k)
+        self.ngram_size = max(1, int(ngram_size))
+        if self.speculative_k:
+            if kv_layout != "slot":
+                raise ValueError(
+                    "speculative_k requires kv_layout='slot' (the paged "
+                    "verify kernel is not implemented)")
+            if self.speculative_k < 1 or self.speculative_k > 16:
+                raise ValueError("speculative_k must be in [1, 16]")
+        self.spec_steps = 0
+        self.spec_slot_steps = 0   # sum of active slots over verify steps
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # device-resident per-row sampling params: updated only on admit,
         # not rebuilt/re-uploaded every decode step
         self._temps = jnp.zeros((max_slots,), jnp.float32)
@@ -287,6 +309,8 @@ class TPUEngine:
                    max_prefills_per_step=ek.get("max_prefills_per_step", 2),
                    enable_prefix_cache=ek.get("enable_prefix_cache", False),
                    prefill_chunk=ek.get("prefill_chunk"),
+                   speculative_k=ek.get("speculative_k", 0),
+                   ngram_size=ek.get("ngram_size", 2),
                    mesh=ek.get("mesh"))
 
     def _check_alive(self):
@@ -314,7 +338,8 @@ class TPUEngine:
                     f"request needs {need} KV pages but the pool only has "
                     f"{self.num_pages - 1}; raise num_pages or shrink "
                     f"prompt/max_tokens")
-        req = _Request(next(self._rid), token_ids, params)
+        req = _Request(next(self._rid), token_ids, params,
+                       history=list(token_ids))
         self._waiting.put(req)
         self._work.set()
         return req
@@ -748,8 +773,65 @@ class TPUEngine:
                                   self._slot_pages[req.slot])
         self._emit(req, int(first[0]))
 
+    def _propose_drafts(self, req: _Request) -> list:
+        """Prompt-lookup drafts: continuation after the most recent earlier
+        occurrence of the trailing n-gram in the request's own history.
+        No match → repeat the last token (a cheap guess; a wrong draft
+        costs nothing beyond the verify FLOPs the step spends anyway)."""
+        k = self.speculative_k
+        h = req.history
+        n = self.ngram_size
+        if len(h) > n:
+            key = h[-n:]
+            for i in range(len(h) - n - 1, -1, -1):
+                if h[i:i + n] == key:
+                    cont = h[i + n:i + n + k]
+                    if cont:
+                        return (cont + [h[-1]] * (k - len(cont)))[:k]
+                    break
+        return [h[-1] if h else 0] * k
+
+    def _speculative_step(self):
+        """One multi-token decode: verify n-gram drafts for every active
+        row, emit the accepted prefix plus one corrected token."""
+        K = self.speculative_k + 1
+        S = self.max_slots
+        draft = np.zeros((S, self.speculative_k), np.int32)
+        for slot, req in self._by_slot.items():
+            draft[slot] = self._propose_drafts(req)
+        self.state, logits = decoding.verify_step(
+            self.params, self.state, jnp.asarray(draft), self.cfg, K)
+        self.key, sub = jax.random.split(self.key)
+        V = logits.shape[-1]
+        toks = decoding.sample_per_row(
+            logits.reshape(S * K, V), sub,
+            jnp.repeat(self._temps, K), jnp.repeat(self._topks, K))
+        toks_host = np.asarray(toks).reshape(S, K)
+        counts = np.zeros((S,), np.int32)
+        last = np.zeros((S,), np.int32)
+        self.spec_steps += 1
+        self.spec_slot_steps += len(self._by_slot)
+        for slot, req in list(self._by_slot.items()):
+            a = 0
+            while (a < self.speculative_k
+                   and toks_host[slot, a] == draft[slot, a]):
+                a += 1
+            self.spec_drafted += self.speculative_k
+            self.spec_accepted += a
+            counts[slot] = a + 1
+            last[slot] = toks_host[slot, a]
+            for j in range(a + 1):
+                self._emit(req, int(toks_host[slot, j]))
+                if slot not in self._by_slot:
+                    break  # finished (EOS/max_tokens) mid-burst
+        # release (inside _emit) precedes this commit: released rows are
+        # inactive, so their length/last_token stay reset
+        self.state = decoding.commit_accepted(
+            self.state, jnp.asarray(last), jnp.asarray(counts))
+
     def _emit(self, req: _Request, token_id: int):
         req.generated += 1
+        req.history.append(token_id)
         stops = set(req.params.stop_token_ids)
         eos = token_id in stops
         if not eos:
@@ -788,6 +870,9 @@ class TPUEngine:
                 self._prefill_step()
             if not self._by_slot:
                 continue
+            if self.speculative_k:
+                self._speculative_step()
+                continue
             if self.kv_layout == "paged":
                 self.state, logits = self._dp.decode_step_paged(
                     self.params, self.state, self.cfg)
@@ -809,6 +894,20 @@ class TPUEngine:
                "waiting": self._waiting.qsize() + len(self._backlog),
                "max_slots": self.max_slots, "buckets": list(self.buckets),
                "kv_layout": self.kv_layout}
+        if self.speculative_k:
+            drafted = self.spec_drafted
+            out["speculative"] = {
+                "k": self.speculative_k, "steps": self.spec_steps,
+                "drafted": drafted, "accepted": self.spec_accepted,
+                "acceptance_rate": (self.spec_accepted / drafted
+                                    if drafted else 0.0),
+                # per-SEQUENCE advance per verify step: each active slot
+                # emits (accepted + 1) tokens per step
+                "tokens_per_step": ((self.spec_accepted
+                                     + self.spec_slot_steps)
+                                    / self.spec_slot_steps
+                                    if self.spec_slot_steps else 0.0),
+            }
         if self.kv_layout == "paged":
             out["free_pages"] = len(self._free_pages)
             out["num_pages"] = self.num_pages

@@ -173,6 +173,91 @@ def decode_step(params, state, cfg: TransformerConfig):
     return state, logits.astype(jnp.float32)
 
 
+@functools.partial(jax.jit, donate_argnames=("state",),
+                   static_argnames=("cfg", "K"))
+def verify_step(params, state, draft, cfg: TransformerConfig, K: int):
+    """Speculative verification: advance every active row K tokens at once.
+
+    Inputs per row are [last_token, draft_0 .. draft_{K-2}] at positions
+    len .. len+K-1; returns (state, logits [slots, K, V]) where logits[:, j]
+    is the next-token distribution AFTER input j. KV is written for all K
+    inputs; `length`/`last_token` are NOT advanced — the host decides how
+    many drafts were accepted and calls commit_accepted. Rejected inputs'
+    KV rows sit beyond the committed length, where the attention mask
+    already ignores them, so no rollback is needed (the memory-bound
+    decode step has idle MXU headroom — verifying K tokens costs barely
+    more than one, which is the whole speculative-decoding bet).
+
+    (reference capability: vLLM speculative decoding / prompt-lookup;
+    rebuilt as one fixed-shape XLA program like decode_step.)
+    """
+    dt = cfg.dtype
+    S = state["k"].shape[2]
+    B = state["length"].shape[0]
+    tokens = jnp.concatenate([state["last_token"][:, None], draft], axis=1)
+    pos = state["length"][:, None] + jnp.arange(K)[None, :]    # [B, K]
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos]
+    cos, sin = _rope(cfg)
+
+    def block(carry, layer_in):
+        h, = carry
+        layer_p, k_cache, v_cache = layer_in                   # [B, S, Hkv, Dh]
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)      # [B, K, H, Dh]
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin, positions=pos)
+            k = ops.apply_rope(k, cos, sin, positions=pos)
+        # scatter the K new K/V rows (positions are distinct per row)
+        oh = jax.nn.one_hot(pos, S, dtype=dt)                  # [B, K, S]
+        any_mask = oh.sum(axis=1)                              # [B, S]
+        k_cache = (k_cache * (1 - any_mask)[..., None, None]
+                   + jnp.einsum("bks,bkhd->bshd", oh, k))
+        v_cache = (v_cache * (1 - any_mask)[..., None, None]
+                   + jnp.einsum("bks,bkhd->bshd", oh, v))
+        G = cfg.n_heads // cfg.kv_heads
+        qh = q.reshape(B, K, cfg.kv_heads, G, cfg.head_dim)
+        scores = jnp.einsum("bkhgd,bshd->bhgks", qh,
+                            k_cache.astype(dt)) / (cfg.head_dim ** 0.5)
+        # causal within the window + full view of the committed cache
+        mask = jnp.arange(S)[None, None, :] <= pos[:, :, None]  # [B, K, S]
+        scores = jnp.where(mask[:, None, None, :, :],
+                           scores.astype(jnp.float32), -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bhgks,bshd->bkhgd", w, v_cache.astype(dt))
+        out = out.reshape(B, K, cfg.n_heads, cfg.head_dim)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return (h,), (k_cache, v_cache)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["layers"], state["k"], state["v"]))
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"].astype(dt)
+    state = dict(state)
+    state["k"], state["v"] = k_new, v_new
+    return state, logits.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def commit_accepted(state, new_last, counts):
+    """Advance each active row by its accepted-token count (1 + accepted
+    drafts) and set the new last (unverified) token."""
+    state = dict(state)
+    act = state["active"]
+    state["length"] = jnp.where(act, state["length"] + counts,
+                                state["length"])
+    state["last_token"] = jnp.where(act, new_last, state["last_token"])
+    return state
+
+
 @functools.partial(jax.jit, donate_argnames=("state",))
 def commit_tokens(state, next_tokens):
     """Record sampled tokens as the next decode inputs (active rows only)."""

@@ -1,0 +1,153 @@
+"""Speculative decoding: n-gram (prompt-lookup) drafts verified in one
+multi-token step.
+
+(reference capability: vLLM speculative decoding with the [ngram] /
+prompt-lookup proposer; here the verifier is a fixed-shape XLA program —
+models/decoding.py verify_step — and acceptance is the exact
+sample-and-match scheme, so outputs are token-identical to the
+non-speculative engine.)
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import transformer
+from ray_tpu.models.transformer import TransformerConfig
+
+TINY = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(**TINY)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _naive_greedy(params, cfg, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits, _ = transformer.forward(params, jnp.asarray([toks]), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_verify_step_matches_decode_step(tiny_model):
+    """K sequential decode_steps and one verify_step over the same tokens
+    must produce identical logits and KV."""
+    from ray_tpu.models import decoding
+
+    cfg, params = tiny_model
+    prompt = [1, 5, 9, 2, 7, 11, 4]
+    bucket = 8
+    toks = jnp.asarray([prompt + [0] * (bucket - len(prompt))])
+    logits_last, kv = decoding.prefill(params, toks, len(prompt), cfg)
+    first = int(jnp.argmax(logits_last))
+
+    # path A: three single-token decode steps (greedy)
+    sa = decoding.init_decode_state(cfg, 2, 64)
+    sa = decoding.insert_sequence(sa, 0, kv, len(prompt), first, cfg)
+    seq_a = [first]
+    logits_a = []
+    for _ in range(3):
+        sa, lg = decoding.decode_step(params, sa, cfg)
+        logits_a.append(np.asarray(lg[0]))
+        nxt = int(jnp.argmax(lg[0]))
+        seq_a.append(nxt)
+        sa = decoding.commit_tokens(sa, jnp.asarray([nxt, 0], jnp.int32))
+
+    # path B: one verify_step whose drafts are exactly the greedy tokens
+    sb = decoding.init_decode_state(cfg, 2, 64)
+    sb = decoding.insert_sequence(sb, 0, kv, len(prompt), first, cfg)
+    draft = jnp.asarray([[seq_a[1], seq_a[2]], [0, 0]], jnp.int32)
+    sb, lg3 = decoding.verify_step(params, sb, draft, cfg, 3)
+    for j in range(3):
+        np.testing.assert_allclose(np.asarray(lg3[0, j]), logits_a[j],
+                                   rtol=1e-4, atol=1e-4)
+    # committing all-accepted advances length by K and the caches agree on
+    # the written region
+    sb = decoding.commit_accepted(
+        sb, jnp.asarray([seq_a[3], 0], jnp.int32),
+        jnp.asarray([3, 0], jnp.int32))
+    assert int(sb["length"][0]) == int(sa["length"][0])
+    L = int(sa["length"][0])
+    np.testing.assert_allclose(np.asarray(sb["k"][:, 0, :L]),
+                               np.asarray(sa["k"][:, 0, :L]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_speculative_engine_token_exact(tiny_model):
+    """Greedy speculative output == greedy non-speculative output."""
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    # a repetitive prompt gives the n-gram proposer real hits
+    prompt = [1, 5, 9, 2, 1, 5, 9, 2, 1, 5, 9, 2]
+    want = _naive_greedy(params, cfg, prompt, 16)
+    eng = TPUEngine(cfg, params, max_slots=4, max_len=96, min_bucket=8,
+                    speculative_k=4)
+    out = eng.generate(prompt, SamplingParams(max_tokens=16, temperature=0.0))
+    stats = eng.stats()["speculative"]
+    eng.shutdown()
+    assert out == want
+    assert stats["steps"] > 0
+    # exactness is the hard requirement; acceptance is the perf signal
+    assert stats["drafted"] == stats["steps"] * 4
+
+
+def test_speculative_accepts_on_repetitive_text(tiny_model):
+    """A forced-repetition workload must actually accept drafts (fewer
+    verify steps than tokens emitted)."""
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=96, min_bucket=8,
+                    speculative_k=4)
+    # the model's own greedy continuation tends to loop on tiny random
+    # weights; long generation gives the proposer history to mine
+    out = eng.generate([3, 3, 3, 3, 3, 3], SamplingParams(max_tokens=40,
+                                                          temperature=0.0))
+    stats = eng.stats()["speculative"]
+    eng.shutdown()
+    assert len(out) == 40
+    assert stats["tokens_per_step"] > 1.0, stats
+    assert stats["steps"] < 40
+
+
+def test_speculative_batched_isolated(tiny_model):
+    """Concurrent speculative sequences stay isolated and exact."""
+    from ray_tpu.llm import SamplingParams, TPUEngine
+
+    cfg, params = tiny_model
+    prompts = [[1, 5, 1, 5, 1, 5], [7, 2, 7, 2, 7, 2], [9, 9, 9, 9]]
+    want = [_naive_greedy(params, cfg, p, 10) for p in prompts]
+    eng = TPUEngine(cfg, params, max_slots=4, max_len=96, min_bucket=8,
+                    speculative_k=3)
+    got = [None] * len(prompts)
+
+    def run(i):
+        got[i] = eng.generate(prompts[i],
+                              SamplingParams(max_tokens=10, temperature=0.0))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    eng.shutdown()
+    assert got == want
+
+
+def test_speculative_rejects_paged_layout(tiny_model):
+    from ray_tpu.llm import TPUEngine
+
+    cfg, params = tiny_model
+    with pytest.raises(ValueError, match="speculative_k requires"):
+        TPUEngine(cfg, params, max_slots=2, max_len=64, min_bucket=64,
+                  kv_layout="paged", page_size=64, speculative_k=2)
