@@ -1,0 +1,136 @@
+"""Standalone monitor process: the autoscaler loop as its own OS process.
+
+(reference: python/ray/autoscaler/_private/monitor.py — the head node runs
+`monitor.py` as a separate process that connects to the GCS, reads demand,
+and drives the NodeProvider; the control plane never blocks on cloud API
+calls. Here the same Autoscaler class the in-process tests use is hosted
+behind a CLI entry; `ray_tpu start --head --autoscaling-config=...`
+launches it, or run `python -m ray_tpu._private.monitor` by hand.)
+
+Config (JSON or YAML):
+    provider:
+      type: local | gce_tpu | kuberay        # fake_gce_tpu for tests
+      ... provider-specific keys ...
+    node_types:
+      worker: {resources: {CPU: 4}, min_nodes: 0, max_nodes: 10}
+    idle_timeout_s: 60
+    interval_s: 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        import yaml
+
+        return yaml.safe_load(text)
+
+
+def build_provider(cfg: dict, gcs_address: str):
+    p = dict(cfg.get("provider") or {"type": "local"})
+    kind = p.pop("type", "local")
+    if kind == "local":
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+
+        return LocalNodeProvider(gcs_address)
+    if kind == "gce_tpu":
+        from ray_tpu.autoscaler.gce_rest import GceRestApi
+        from ray_tpu.autoscaler.gce_tpu import GceTpuNodeProvider
+
+        api = GceRestApi(project=p.pop("project"), zone=p.pop("zone"))
+        return GceTpuNodeProvider(api, **p)
+    if kind == "fake_gce_tpu":
+        from ray_tpu.autoscaler.gce_tpu import (FakeGceTpuApi,
+                                                GceTpuNodeProvider)
+
+        return GceTpuNodeProvider(FakeGceTpuApi(), **p)
+    if kind == "kuberay":
+        from ray_tpu.autoscaler.kuberay import (KubeRayApiClient,
+                                                KubeRayNodeProvider)
+
+        api = KubeRayApiClient(p.pop("namespace"), p.pop("cluster_name"),
+                               **{k: p.pop(k) for k in ("api_server", "token")
+                                  if k in p})
+        return KubeRayNodeProvider(api, **p)
+    raise ValueError(f"unknown provider type {kind!r}")
+
+
+def build_node_types(cfg: dict):
+    from ray_tpu.autoscaler.autoscaler import NodeType
+
+    out = []
+    for name, spec in (cfg.get("node_types") or {}).items():
+        out.append(NodeType(
+            name=name, resources=dict(spec.get("resources") or {}),
+            labels=dict(spec.get("labels") or {}),
+            min_nodes=int(spec.get("min_nodes", 0)),
+            max_nodes=int(spec.get("max_nodes", 10))))
+    if not out:
+        raise ValueError("autoscaling config has no node_types")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ray_tpu monitor")
+    ap.add_argument("--address", required=True,
+                    help="GCS address host:port or unix:<path>")
+    ap.add_argument("--autoscaling-config", required=True)
+    ap.add_argument("--keep-nodes-on-exit", action="store_true",
+                    help="leave provider nodes running when the monitor "
+                         "process is stopped")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s monitor %(levelname)s %(message)s")
+    cfg = load_config(args.autoscaling_config)
+    provider = build_provider(cfg, args.address)
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+
+    scaler = Autoscaler(
+        args.address, provider, build_node_types(cfg),
+        interval_s=float(cfg.get("interval_s", 2.0)),
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 60.0)),
+        node_startup_grace_s=float(cfg.get("node_startup_grace_s", 60.0)))
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    logger.info("monitor up: %s provider, %d node types",
+                type(provider).__name__, len(scaler.node_types))
+    from ray_tpu._private.protocol import ConnectionClosed
+
+    while not stop.is_set():
+        try:
+            scaler.reconcile_once()
+        except ConnectionClosed:
+            # the head/GCS is gone: exit instead of looping forever as an
+            # orphan keeping cloud nodes alive against a dead cluster
+            logger.warning("GCS connection closed; monitor exiting")
+            break
+        except Exception:
+            logger.exception("reconcile failed")
+        stop.wait(scaler.interval_s)
+    scaler.stop(terminate_nodes=not args.keep_nodes_on_exit)
+    logger.info("monitor stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

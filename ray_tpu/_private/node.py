@@ -48,6 +48,12 @@ class Node:
 
         self._procs: list[subprocess.Popen] = []
         self._spawn_lock = threading.Lock()
+        # per-host runtime-env agent process, started on first pip/conda
+        # worker spawn (reference: _private/runtime_env/agent/ — a separate
+        # process builds envs, deduplicating concurrent requests)
+        from ray_tpu._private.runtime_env_agent import AgentHandle
+
+        self._renv_agent = AgentHandle(self.session_dir)
         self.gcs = GcsServer(
             self.socket_path,
             total_resources=total,
@@ -101,6 +107,14 @@ class Node:
         if runtime_env:
             base["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env, sort_keys=True)
             base.update(runtime_env.get("env_vars") or {})
+            if runtime_env.get("pip") or runtime_env.get("conda"):
+                # env-bearing workers resolve their interpreter through the
+                # per-host runtime-env agent (deduped builds, fail-fast);
+                # the boot shim falls back to a local build if it's gone
+                try:
+                    base["RAY_TPU_RENV_AGENT_SOCK"] = self._renv_agent.ensure()
+                except Exception:
+                    pass
         else:
             base.pop("RAY_TPU_RUNTIME_ENV", None)
         with self._spawn_lock:
@@ -168,6 +182,7 @@ class Node:
     def shutdown(self):
         if self.log_monitor is not None:
             self.log_monitor.stop()
+        self._renv_agent.stop()
         self.object_server.stop()
         self.gcs.stop()
         deadline = time.monotonic() + 3.0

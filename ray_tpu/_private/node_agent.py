@@ -57,6 +57,9 @@ class NodeAgent:
         total, labels = accelerators.detect_host_resources(
             num_cpus, num_tpus, resources, labels)
 
+        from ray_tpu._private.runtime_env_agent import AgentHandle
+
+        self._renv_agent = AgentHandle(self.session_dir)
         self._procs: list[subprocess.Popen] = []
         self._rpc({
             "type": "register_host",
@@ -228,6 +231,11 @@ class NodeAgent:
         if runtime_env:
             base["RAY_TPU_RUNTIME_ENV"] = _json.dumps(runtime_env, sort_keys=True)
             base.update(runtime_env.get("env_vars") or {})
+            if runtime_env.get("pip") or runtime_env.get("conda"):
+                try:
+                    base["RAY_TPU_RENV_AGENT_SOCK"] = self._renv_agent.ensure()
+                except Exception:
+                    pass
         else:
             base.pop("RAY_TPU_RUNTIME_ENV", None)
         for chips in assignments:
@@ -259,6 +267,7 @@ class NodeAgent:
     def shutdown(self):
         if self.mem_monitor is not None:
             self.mem_monitor.stop()
+        self._renv_agent.stop()
         self.log_monitor.stop()
         self.obj_server.stop()
         deadline = time.monotonic() + 3.0

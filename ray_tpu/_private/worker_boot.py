@@ -31,11 +31,31 @@ def _reexec_under(python: str) -> None:
 def main():
     renv = json.loads(os.environ.get("RAY_TPU_RUNTIME_ENV") or "{}")
     conda_spec = renv.get("conda")
+    pip_spec = renv.get("pip")
+    if conda_spec and pip_spec:
+        raise SystemExit(
+            "runtime_env cannot combine 'conda' and 'pip' — put pip "
+            "packages under the conda spec's dependencies instead")
+    agent_sock = os.environ.get("RAY_TPU_RENV_AGENT_SOCK")
+    if agent_sock and (conda_spec or pip_spec):
+        # per-host runtime-env agent: concurrent workers needing the same
+        # env share ONE build and a broken env fails fast with the agent's
+        # error; fall back to the local build path if the agent is gone
+        try:
+            from ray_tpu._private import runtime_env_agent
+            from ray_tpu._private.protocol import ConnectionClosed
+
+            reply = runtime_env_agent.get_or_create(agent_sock, renv)
+            _reexec_under(reply["python"])
+        except (OSError, ConnectionError, ConnectionClosed, KeyError):
+            # agent unreachable: local fallback below. An agent-REPORTED
+            # build failure (RuntimeError) propagates — retrying the same
+            # broken build locally would just boot-loop the worker.
+            pass
     if conda_spec:
         from ray_tpu._private.runtime_env_conda import ensure_conda_env
 
         _reexec_under(ensure_conda_env(conda_spec))
-    pip_spec = renv.get("pip")
     if pip_spec:
         from ray_tpu._private.runtime_env_pip import ensure_venv
 

@@ -53,8 +53,12 @@ class _Request:
     pf_done: int = 0
     pf_pages: list | None = None
     pf_hashes: list | None = None
-    # full token history (prompt + emitted) for the n-gram draft proposer
+    # full token history (prompt + emitted) for the n-gram draft proposer,
+    # plus an incremental index: trailing-ngram tuple → (latest, previous)
+    # continuation-start positions, so proposal is O(1) per step instead of
+    # rescanning the history (which is quadratic over a long generation)
     history: list = dataclasses.field(default_factory=list)
+    ngram_index: dict | None = None
 
 
 _SENTINEL = object()
@@ -773,22 +777,39 @@ class TPUEngine:
                                   self._slot_pages[req.slot])
         self._emit(req, int(first[0]))
 
+    def _index_ngram_at(self, req: _Request, end: int):
+        """Record the n-gram ENDING at history position end-1; its
+        continuation starts at `end`."""
+        n = self.ngram_size
+        if end < n:
+            return
+        key = tuple(req.history[end - n:end])
+        latest, _prev = req.ngram_index.get(key, (None, None))
+        req.ngram_index[key] = (end, latest)
+
     def _propose_drafts(self, req: _Request) -> list:
         """Prompt-lookup drafts: continuation after the most recent earlier
         occurrence of the trailing n-gram in the request's own history.
-        No match → repeat the last token (a cheap guess; a wrong draft
-        costs nothing beyond the verify FLOPs the step spends anyway)."""
+        O(1) via the incremental index. No match → repeat the last token
+        (a cheap guess; a wrong draft costs nothing beyond the verify
+        FLOPs the step spends anyway)."""
         k = self.speculative_k
         h = req.history
         n = self.ngram_size
+        if req.ngram_index is None:  # first proposal: index the prompt
+            req.ngram_index = {}
+            for end in range(n, len(h) + 1):
+                self._index_ngram_at(req, end)
         if len(h) > n:
-            key = h[-n:]
-            for i in range(len(h) - n - 1, -1, -1):
-                if h[i:i + n] == key:
-                    cont = h[i + n:i + n + k]
-                    if cont:
-                        return (cont + [h[-1]] * (k - len(cont)))[:k]
-                    break
+            key = tuple(h[-n:])
+            latest, prev = req.ngram_index.get(key, (None, None))
+            # `latest` is the trailing occurrence itself (continuation =
+            # end of history); the draft source is the one before it
+            cs = prev if latest == len(h) else latest
+            if cs is not None:
+                cont = h[cs:cs + k]
+                if cont:
+                    return (cont + [h[-1]] * (k - len(cont)))[:k]
         return [h[-1] if h else 0] * k
 
     def _speculative_step(self):
@@ -832,6 +853,8 @@ class TPUEngine:
     def _emit(self, req: _Request, token_id: int):
         req.generated += 1
         req.history.append(token_id)
+        if self.speculative_k and req.ngram_index is not None:
+            self._index_ngram_at(req, len(req.history))
         stops = set(req.params.stop_token_ids)
         eos = token_id in stops
         if not eos:

@@ -210,10 +210,26 @@ def cmd_start(args):
 
             head = start_dashboard(node.session_dir, port=args.dashboard_port)
             print(f"  dashboard:   http://127.0.0.1:{head.port}")
+        monitor_proc = None
+        if args.autoscaling_config:
+            # the autoscaler runs as its own MONITOR process (reference:
+            # autoscaler/_private/monitor.py spawned by `ray start --head`)
+            import subprocess
+
+            monitor_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.monitor",
+                 "--address", node.address,
+                 "--autoscaling-config", args.autoscaling_config]
+                + (["--keep-nodes-on-exit"] if args.keep_nodes_on_exit
+                   else []))
+            print(f"  monitor:     pid {monitor_proc.pid} "
+                  f"({args.autoscaling_config})")
         try:
             while True:
                 time.sleep(3600)
         except KeyboardInterrupt:
+            if monitor_proc is not None:
+                monitor_proc.terminate()
             node.shutdown()
     elif args.address:
         from ray_tpu._private.node_agent import NodeAgent
@@ -356,7 +372,23 @@ def main(argv=None):
     sp.add_argument("--max-workers", type=int, default=16)
     sp.add_argument("--dashboard", action="store_true")
     sp.add_argument("--dashboard-port", type=int, default=0)
+    sp.add_argument("--autoscaling-config", default=None,
+                    help="JSON/YAML autoscaler config; spawns the monitor "
+                         "process (see ray_tpu/_private/monitor.py)")
+    sp.add_argument("--keep-nodes-on-exit", action="store_true",
+                    help="monitor leaves provider nodes running on exit")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("monitor",
+                        help="run the autoscaler monitor process "
+                             "against a live cluster")
+    sp.add_argument("--address", required=True)
+    sp.add_argument("--autoscaling-config", required=True)
+    sp.add_argument("--keep-nodes-on-exit", action="store_true")
+    sp.set_defaults(fn=lambda a: __import__(
+        "ray_tpu._private.monitor", fromlist=["main"]).main(
+        ["--address", a.address, "--autoscaling-config", a.autoscaling_config]
+        + (["--keep-nodes-on-exit"] if a.keep_nodes_on_exit else [])))
 
     sp = sub.add_parser("timeline", help="export task timeline (chrome trace)")
     sp.add_argument("-o", "--output", help="output path (default timeline.json)")

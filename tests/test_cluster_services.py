@@ -1,0 +1,150 @@
+"""Dedicated cluster-service processes: the per-host runtime-env agent and
+the standalone autoscaler monitor.
+
+(reference: python/ray/_private/runtime_env/agent/ — env creation runs in
+a per-node agent process, deduplicated and observable;
+autoscaler/_private/monitor.py — the autoscaler loop is its own OS
+process spawned by `ray start --head`.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env_agent as rea
+
+
+@pytest.fixture
+def agent(tmp_path):
+    os.makedirs(tmp_path / "logs", exist_ok=True)
+    a = rea.RuntimeEnvAgent(str(tmp_path / "agent.sock"))
+    t = threading.Thread(target=a.serve_forever, daemon=True)
+    t.start()
+    yield a
+    a.stop()
+
+
+def test_agent_dedups_concurrent_builds(agent, monkeypatch):
+    """N concurrent get_or_create calls for the same env run ONE build."""
+    builds = []
+    ev = threading.Event()
+
+    def fake_build(renv):
+        builds.append(renv)
+        ev.wait(timeout=5)  # hold so all callers overlap
+        return {"python": "/fake/python"}
+
+    monkeypatch.setattr(rea, "_build", fake_build)
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(
+        rea.get_or_create(agent.socket_path, {"pip": ["x==1"]})))
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    ev.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 4
+    assert all(r["python"] == "/fake/python" for r in results)
+    assert len(builds) == 1, "concurrent identical envs must share a build"
+
+
+def test_agent_reports_build_failure(agent, monkeypatch):
+    def broken(renv):
+        raise ValueError("no such package: definitely-not-real")
+
+    monkeypatch.setattr(rea, "_build", broken)
+    with pytest.raises(RuntimeError, match="definitely-not-real"):
+        rea.get_or_create(agent.socket_path, {"pip": ["definitely-not-real"]})
+    # status surfaces the failure
+    from ray_tpu._private.protocol import connect_unix
+
+    conn = connect_unix(agent.socket_path)
+    conn.send({"t": "list", "rid": 1})
+    envs = conn.recv()["envs"]
+    conn.close()
+    assert any(e["state"] == "failed" for e in envs.values())
+
+
+def test_agent_failure_does_not_poison_key(agent, monkeypatch):
+    """A transient build failure must not be cached: the next request for
+    the same env retries and can succeed."""
+    calls = []
+
+    def flaky(renv):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("transient network failure")
+        return {"python": "/fixed/python"}
+
+    monkeypatch.setattr(rea, "_build", flaky)
+    with pytest.raises(RuntimeError, match="transient"):
+        rea.get_or_create(agent.socket_path, {"pip": ["flaky==1"]})
+    got = rea.get_or_create(agent.socket_path, {"pip": ["flaky==1"]})
+    assert got["python"] == "/fixed/python"
+    assert len(calls) == 2
+
+
+def test_agent_rejects_conda_plus_pip(agent):
+    with pytest.raises(RuntimeError, match="cannot combine"):
+        rea.get_or_create(agent.socket_path,
+                          {"conda": "base", "pip": ["x"]})
+
+
+def test_agent_subprocess_lifecycle(tmp_path):
+    """AgentHandle starts a real agent subprocess; ping answers; a no-op
+    env resolves to the current interpreter."""
+    os.makedirs(tmp_path / "logs", exist_ok=True)
+    h = rea.AgentHandle(str(tmp_path))
+    sock = h.ensure()
+    assert os.path.exists(sock)
+    reply = rea.get_or_create(sock, {})  # no pip/conda -> base interpreter
+    assert reply["python"] == sys.executable
+    pid_before = h.proc.pid
+    assert h.ensure() == sock            # idempotent, same process
+    assert h.proc.pid == pid_before
+    h.stop()
+    assert h.proc is None
+
+
+@pytest.mark.slow
+def test_monitor_process_scales_cluster(tmp_path):
+    """The standalone monitor process (fake provider) observes queued
+    demand from a live head and adds provider nodes."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_workers=1, max_workers=4)
+    from ray_tpu._private import api as _api
+
+    address = _api._node.address
+    cfg = {"provider": {"type": "local"},
+           "node_types": {"cpu": {"resources": {"CPU": 4},
+                                  "max_nodes": 3}},
+           "interval_s": 0.2, "idle_timeout_s": 3600}
+    cfg_path = tmp_path / "scaling.json"
+    cfg_path.write_text(json.dumps(cfg))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.monitor",
+         "--address", address, "--autoscaling-config", str(cfg_path),
+         "--keep-nodes-on-exit"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        # 4-CPU demand cannot fit the 1-CPU head: the monitor must launch
+        # a virtual 4-CPU node and the task must then run on it
+        ref = big.remote()
+        node_id = ray_tpu.get(ref, timeout=90)
+        assert node_id is not None and node_id != "node-0"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        ray_tpu.shutdown()
