@@ -205,6 +205,13 @@ class AgentHandle:
                     except (OSError, ConnectionClosed):
                         pass
                 time.sleep(0.05)
+            # reset: a half-started process left in self.proc would make
+            # every later ensure() return an unconnectable socket path
+            proc, self.proc = self.proc, None
+            try:
+                proc.kill()
+            except OSError:
+                pass
             raise RuntimeError("runtime-env agent failed to come up "
                                f"(see {self._log_path})")
 

@@ -721,6 +721,11 @@ class CoreWorker:
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
+        # typed-spec validation at the submission boundary (reference:
+        # TaskSpecification — malformed options fail HERE, at the caller)
+        from ray_tpu._private.task_spec import validate_task
+
+        validate_task(spec)
         if (self._direct_enabled and strategy is None
                 and isinstance(num_returns, int)
                 and self._try_submit_direct(spec)):
@@ -1112,6 +1117,9 @@ class CoreWorker:
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **spec_part,
         }
+        from ray_tpu._private.task_spec import validate_actor
+
+        validate_actor(spec)
         reply = self.rpc({"type": "create_actor", "spec": spec})
         if not reply.get("ok"):
             raise ValueError(reply.get("error") or "actor creation rejected")

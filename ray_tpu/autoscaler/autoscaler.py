@@ -257,7 +257,12 @@ class Autoscaler:
             self._thread.join(timeout=5)
         if terminate_nodes:
             for nid in list(self._nodes):
-                self._terminate(nid)
+                try:
+                    self._terminate(nid)
+                except Exception:
+                    # one failed cloud call must not abort teardown and
+                    # leak every REMAINING node
+                    logger.exception("failed to terminate node %s", nid)
         try:
             self._conn.close()
         except Exception:

@@ -232,6 +232,10 @@ def cmd_start(args):
                 monitor_proc.terminate()
             node.shutdown()
     elif args.address:
+        if args.autoscaling_config:
+            print("warning: --autoscaling-config only applies to --head "
+                  "(the monitor runs next to the GCS); ignoring",
+                  file=sys.stderr)
         from ray_tpu._private.node_agent import NodeAgent
 
         agent = NodeAgent(address=args.address,
@@ -241,6 +245,16 @@ def cmd_start(args):
     else:
         print("specify --head or --address", file=sys.stderr)
         sys.exit(2)
+
+
+def cmd_monitor(args):
+    from ray_tpu._private import monitor
+
+    argv = ["--address", args.address,
+            "--autoscaling-config", args.autoscaling_config]
+    if args.keep_nodes_on_exit:
+        argv.append("--keep-nodes-on-exit")
+    return monitor.main(argv)
 
 
 def cmd_timeline(args):
@@ -385,10 +399,7 @@ def main(argv=None):
     sp.add_argument("--address", required=True)
     sp.add_argument("--autoscaling-config", required=True)
     sp.add_argument("--keep-nodes-on-exit", action="store_true")
-    sp.set_defaults(fn=lambda a: __import__(
-        "ray_tpu._private.monitor", fromlist=["main"]).main(
-        ["--address", a.address, "--autoscaling-config", a.autoscaling_config]
-        + (["--keep-nodes-on-exit"] if a.keep_nodes_on_exit else [])))
+    sp.set_defaults(fn=cmd_monitor)
 
     sp = sub.add_parser("timeline", help="export task timeline (chrome trace)")
     sp.add_argument("-o", "--output", help="output path (default timeline.json)")

@@ -76,12 +76,11 @@ def placement_group(
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown placement strategy {strategy!r}; expected one of {STRATEGIES}")
     bundles = [dict(b) for b in bundles]
-    if not bundles:
-        raise ValueError("placement group requires at least one bundle")
-    for b in bundles:
-        if not b or any(v < 0 for v in b.values()):
-            raise ValueError(f"invalid bundle {b!r}")
     pg_id = PlacementGroupID().hex()
+    from ray_tpu._private.task_spec import validate_pg
+
+    validate_pg({"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+                 "name": name})
     _get_worker().create_pg(pg_id, bundles, strategy, name)
     return PlacementGroup(pg_id, bundles)
 

@@ -1,0 +1,88 @@
+"""Typed task/actor/PG specifications: validation at the submission
+boundary (reference: src/ray/common/task/task_spec.h TaskSpecification —
+malformed submissions fail at the caller with a clear error, not as a
+scheduler crash later)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import task_spec as ts
+
+
+def _task(**over):
+    spec = {"kind": "task", "task_id": "t1", "deps": [], "num_returns": 1,
+            "resources": {"CPU": 1.0}, "max_retries": 0, "name": "f",
+            "strategy": None}
+    spec.update(over)
+    return spec
+
+
+def test_valid_task_roundtrip():
+    spec = _task()
+    view = ts.TaskSpec.from_wire(spec)
+    assert view.task_id == "t1" and view.resources == {"CPU": 1.0}
+    assert view.language == "py"
+
+
+@pytest.mark.parametrize("bad,match", [
+    (dict(task_id=""), "missing task_id"),
+    (dict(resources={"CPU": -1}), "negative"),
+    (dict(resources={"": 1}), "non-empty"),
+    (dict(resources={"CPU": "lots"}), "numeric"),
+    (dict(resources="CPU"), "must be a dict"),
+    (dict(num_returns=-2), "num_returns"),
+    (dict(num_returns=1.5), "num_returns"),
+    (dict(max_retries=-5), "max_retries"),
+    (dict(strategy={"pg_id": "p"}), "kind"),
+    (dict(strategy={"kind": "teleport"}), "unknown strategy"),
+    (dict(strategy={"kind": "pg"}), "needs pg_id"),
+    (dict(strategy={"kind": "pg", "pg_id": "p", "bundle": -3}), "bundle"),
+    (dict(strategy={"kind": "node_affinity"}), "needs node_id"),
+    (dict(name="x" * 600), "under"),
+    (dict(deps="notalist"), "deps"),
+])
+def test_invalid_tasks_rejected(bad, match):
+    with pytest.raises(ts.SpecError, match=match):
+        ts.validate_task(_task(**bad))
+
+
+def test_actor_validation():
+    good = {"kind": "actor_create", "task_id": "t", "actor_id": "a1",
+            "resources": {"CPU": 1.0}, "max_restarts": 0,
+            "max_concurrency": 1, "strategy": None}
+    assert ts.ActorSpec.from_wire(good).actor_id == "a1"
+    with pytest.raises(ts.SpecError, match="max_concurrency"):
+        ts.validate_actor({**good, "max_concurrency": 0})
+    with pytest.raises(ts.SpecError, match="max_restarts"):
+        ts.validate_actor({**good, "max_restarts": -2})
+
+
+def test_pg_validation():
+    good = {"pg_id": "p1", "bundles": [{"CPU": 1.0}], "strategy": "PACK"}
+    assert ts.validate_pg(dict(good)) == good
+    with pytest.raises(ts.SpecError, match="non-empty"):
+        ts.validate_pg({**good, "bundles": []})
+    with pytest.raises(ts.SpecError, match="is empty"):
+        ts.validate_pg({**good, "bundles": [{}]})
+    with pytest.raises(ts.SpecError, match="unknown PG strategy"):
+        ts.validate_pg({**good, "strategy": "SCATTER"})
+
+
+@pytest.mark.slow
+def test_bad_submissions_fail_at_caller():
+    """End-to-end: malformed options raise AT .remote()/creation time."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_workers=1)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with pytest.raises(ts.SpecError, match="negative"):
+            f.options(resources={"custom": -3}).remote()
+        with pytest.raises(ts.SpecError, match="num_returns"):
+            f.options(num_returns=-1).remote()
+        # a good submission still works after the rejected ones
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
