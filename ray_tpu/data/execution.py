@@ -790,9 +790,24 @@ class StreamingExecutor:
     downstream queue is full.
     """
 
-    def __init__(self, stages: list[Stage], *, max_queued: int = 16):
+    def __init__(self, stages: list[Stage], *, max_queued: int = 16,
+                 max_queued_bytes: int | None = None):
         self.stages = stages
         self.max_queued = max_queued
+        # reservation-style memory backpressure (reference:
+        # data/_internal/execution/resource_manager.py — operator output
+        # budgets in BYTES, not just counts): dispatch into a queue stalls
+        # while its object-store-resident bytes exceed the budget, so one
+        # stage producing huge blocks cannot OOM the store no matter how
+        # small max_queued is. Sizes come from the local store's metadata
+        # (free for refs this driver produced); unknown sizes count 0, so
+        # the byte gate degrades to the count gate, never deadlocks.
+        import os as _os
+
+        self.max_queued_bytes = (
+            max_queued_bytes if max_queued_bytes is not None
+            else int(_os.environ.get("RAY_TPU_DATA_MAX_QUEUED_BYTES",
+                                     256 << 20)))
         # refs produced by THIS execution (not caller-owned input refs); safe
         # to free once consumed — keeps streaming memory bounded instead of
         # pinning every block in the driver for the run's lifetime
@@ -872,6 +887,54 @@ class StreamingExecutor:
         def _ordered(items):
             return sorted(items, key=lambda it: seq_of.get(_skey(it), 1 << 60))
 
+        # byte accounting for the reservation-style backpressure: size
+        # looked up ONCE at enqueue (local-store metadata for refs, block
+        # sizes for materialized lists), remembered until dequeue
+        qbytes = [0] * (len(rest) + 1)
+        size_of: dict[str, int] = {}
+
+        def _nbytes(item) -> int:
+            if hasattr(item, "hex"):
+                try:
+                    from ray_tpu._private.api import _get_worker
+
+                    return _get_worker().store.size(item.hex())
+                except Exception:  # remote/inline/unknown: count 0
+                    return 0
+            blocks = item if isinstance(item, list) else [item]
+            try:
+                return sum(BlockAccessor(b).size_bytes() for b in blocks)
+            except Exception:
+                return 0
+
+        def _q_add(j: int, item) -> None:
+            n = _nbytes(item)
+            size_of[_skey(item)] = n
+            qbytes[j] += n
+            queues[j].append(item)
+
+        def _q_pop(j: int):
+            item = queues[j].popleft()
+            qbytes[j] -= size_of.pop(_skey(item), 0)
+            return item
+
+        def _q_clear(j: int) -> None:
+            for item in queues[j]:
+                size_of.pop(_skey(item), None)
+            queues[j].clear()
+            qbytes[j] = 0
+
+        def _q_room(j: int) -> bool:
+            # a queue feeding a BARRIER stage is exempt from both gates:
+            # the barrier consumes only after upstream fully drains, so
+            # capping its input (by count or bytes) deadlocks the pipeline
+            # the moment the dataset outgrows the cap. Barrier inputs are
+            # store-resident refs; accumulation is the design.
+            if j < len(rest) and is_barrier(rest[j]):
+                return True
+            return (len(queues[j]) < self.max_queued
+                    and qbytes[j] < self.max_queued_bytes)
+
         def is_barrier(s: Stage) -> bool:
             return s.all_to_all is not None or s.a2a_refs is not None
 
@@ -880,11 +943,11 @@ class StreamingExecutor:
         def pump() -> None:
             # source dispatch
             while (source_payloads and len(src_in_flight) < first.max_in_flight
-                   and len(queues[0]) < self.max_queued):
+                   and _q_room(0)):
                 payload = source_payloads.popleft()
                 if source_is_refs and not first.transforms:
                     _tag(payload)
-                    queues[0].append(payload)
+                    _q_add(0, payload)
                     continue
                 fn = stage_remote(-1, first)
                 ref = fn.remote(payload)
@@ -898,7 +961,7 @@ class StreamingExecutor:
                 ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
                 for r in ready:
                     src_in_flight.pop(r.hex(), None)
-                    queues[0].append(r)
+                    _q_add(0, r)
 
             # downstream stages
             for i, stage in enumerate(rest):
@@ -910,7 +973,7 @@ class StreamingExecutor:
                     if a2a_done[i] or not upstream_done or not _upstream_a2a_done(i):
                         continue
                     inputs = _ordered(queues[i])
-                    queues[i].clear()
+                    _q_clear(i)
                     if stage.a2a_refs is not None:
                         # distributed: hand refs to the partition/merge task
                         # graph; blocks never touch the driver
@@ -925,7 +988,7 @@ class StreamingExecutor:
                         for r in stage.a2a_refs(in_refs):
                             self.owned.add(r.hex())
                             _tag(r)
-                            queues[i + 1].append(r)
+                            _q_add(i + 1, r)
                         # inputs: drop our handles only — the partition tasks
                         # hold them as deps; manual free here would race arg
                         # resolution. Auto-GC reclaims after the tasks finish.
@@ -939,13 +1002,13 @@ class StreamingExecutor:
                             self._free_if_owned(item)
                         for out_blocks in stage.all_to_all(blocks):
                             _tag(out_blocks)
-                            queues[i + 1].append(out_blocks)  # plain lists, not refs
+                            _q_add(i + 1, out_blocks)  # plain lists, not refs
                     a2a_done[i] = True
                     continue
                 # map stage
                 while (queues[i] and len(in_flight[i]) < stage.max_in_flight
-                       and len(queues[i + 1]) < self.max_queued):
-                    item = queues[i].popleft()
+                       and _q_room(i + 1)):
+                    item = _q_pop(i)
                     fn = stage_remote(i, stage)
                     ref = fn.remote(item)
                     _inherit(ref, item)
@@ -960,7 +1023,7 @@ class StreamingExecutor:
                         self._free_if_owned(consumed)
                         if hasattr(pool, "note_done"):
                             pool.note_done(r.hex())
-                        queues[i + 1].append(r)
+                        _q_add(i + 1, r)
 
         def _upstream_a2a_done(i):
             return all(a2a_done[j] for j, s in enumerate(rest[:i]) if is_barrier(s))
@@ -977,7 +1040,7 @@ class StreamingExecutor:
                 pump()
                 if queues[-1]:
                     while queues[-1]:
-                        yield queues[-1].popleft()
+                        yield _q_pop(len(queues) - 1)
                     idle_spin = 0.0
                     continue
                 if all_done():
