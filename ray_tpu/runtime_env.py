@@ -60,13 +60,6 @@ def package(runtime_env: dict, kv_put, kv_get) -> dict:
     content-addressed zips (skipping uploads the KV already has — the URI
     cache) and replace paths with pkg URIs. Returns the normalized env."""
     env = dict(runtime_env or {})
-    if env.get("conda") and env.get("pip"):
-        # reject at the SUBMISSION boundary (reference restriction): the
-        # boot shim would otherwise kill every spawned worker with no
-        # caller-side error
-        raise ValueError(
-            "runtime_env cannot combine 'conda' and 'pip' — put pip "
-            "packages under the conda spec's dependencies instead")
     out: dict[str, Any] = {}
     ev = env.pop("env_vars", None)
     if ev:
