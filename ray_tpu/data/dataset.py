@@ -292,6 +292,28 @@ class Dataset:
     def take_all(self) -> list[dict]:
         return list(self.iter_rows())
 
+    def to_pandas(self):
+        """Materialize the whole dataset as one pandas DataFrame
+        (reference: Dataset.to_pandas)."""
+        import pandas as pd
+
+        frames = [BlockAccessor(b).to_pandas() for b in self.iter_blocks()
+                  if BlockAccessor(b).num_rows()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_arrow(self):
+        """Materialize as a single pyarrow Table (reference:
+        Dataset.to_arrow_refs, driver-side variant)."""
+        import pyarrow as pa
+
+        tables = [BlockAccessor(b).to_arrow() for b in self.iter_blocks()
+                  if BlockAccessor(b).num_rows()]
+        if not tables:
+            return pa.table({})
+        return pa.concat_tables(tables)
+
     def count(self) -> int:
         return sum(BlockAccessor(b).num_rows() for b in self.iter_blocks())
 

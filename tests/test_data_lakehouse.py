@@ -50,6 +50,16 @@ def test_avro_codecs_and_schema(tmp_path):
         assert meta["avro.codec"].decode() == codec
 
 
+def test_to_pandas_to_arrow():
+    ds = rd.range(10).map(lambda r: {"id": r["id"],
+                                     "x": float(r["id"]) * 2})
+    df = ds.to_pandas()
+    assert len(df) == 10 and sorted(df["x"]) == [i * 2.0 for i in range(10)]
+    t = rd.range(5).to_arrow()
+    assert t.num_rows == 5
+    assert rd.from_items([]).to_pandas().empty
+
+
 def test_arrow_ipc_roundtrip(tmp_path):
     ds = rd.range(100).map(lambda r: {"id": r["id"], "sq": int(r["id"]) ** 2})
     files = ds.write_arrow(str(tmp_path / "a"))
