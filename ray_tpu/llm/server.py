@@ -107,6 +107,10 @@ class LLMServer:
         body = request.get("body") or {}
         if path.endswith("/chat/completions"):
             return self.chat(body)
+        if path.endswith("/stats"):
+            # engine observability: slots/pages plus the prefix-cache and
+            # speculative sections when those features are enabled
+            return self.engine_stats()
         return self.completions(body)
 
 

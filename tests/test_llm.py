@@ -152,6 +152,9 @@ def test_llm_server_openai_surface(llm_cluster):
          "max_tokens": 4}).result(timeout_s=120)
     assert chat["object"] == "chat.completion"
     assert "message" in chat["choices"][0]
+    # /v1/stats surfaces engine observability over the same HTTP entry
+    st = handle.remote({"path": "/v1/stats"}).result(timeout_s=60)
+    assert st["max_slots"] >= 1 and "kv_layout" in st
     serve.delete("llm")
 
 
