@@ -107,6 +107,41 @@ class Dataset:
             refs.append(ray_tpu.put(list(o._materialize_blocks())))
         return Dataset(L.InputBlocks(refs=refs))
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Positionally combine columns of two equal-length datasets
+        (reference: Dataset.zip). Duplicate column names from `other` get
+        a `_1` suffix. Row order follows each dataset's block order; both
+        sides stream through the driver for alignment (like the
+        reference, zip is a materializing operation)."""
+        import itertools as _it
+
+        out_blocks: list[Block] = []
+        rows_l = self.iter_rows()
+        rows_r = other.iter_rows()
+        batch: list[dict] = []
+        for left, right in _it.zip_longest(rows_l, rows_r):
+            if left is None or right is None:
+                raise ValueError(
+                    "Dataset.zip requires equal-length datasets")
+            row = dict(left)
+            for k, v in right.items():
+                name = k
+                suffix = 0
+                while name in row:  # never clobber an existing column
+                    suffix += 1
+                    name = f"{k}_{suffix}"
+                row[name] = v
+            batch.append(row)
+            if len(batch) >= 1024:
+                out_blocks.append(rows_to_block(batch))
+                batch = []
+        if batch:
+            out_blocks.append(rows_to_block(batch))
+        # one ref per block: a single ref would collapse every downstream
+        # stage to one task regardless of dataset size
+        return Dataset(L.InputBlocks(
+            refs=[ray_tpu.put([b]) for b in out_blocks]))
+
     def groupby(self, key) -> "GroupedData":
         """Group by one column (or a list of columns); aggregate with the
         returned handle (reference: Dataset.groupby, data/grouped_data.py:23)."""

@@ -914,13 +914,21 @@ class StreamingExecutor:
             queues[j].append(item)
 
         def _q_pop(j: int):
-            item = queues[j].popleft()
+            # min-tag-first: dispatching the oldest pending work bounds how
+            # far ahead out-of-order completions can run (smaller ordered-
+            # emission buffer, stragglers never starve behind newer items)
+            item = min(queues[j],
+                       key=lambda it: seq_of.get(_skey(it), 1 << 60))
+            queues[j].remove(item)
             qbytes[j] -= size_of.pop(_skey(item), 0)
             return item
 
         def _q_clear(j: int) -> None:
             for item in queues[j]:
-                size_of.pop(_skey(item), None)
+                key = _skey(item)
+                size_of.pop(key, None)
+                seq_of.pop(key, None)  # a leaked tag would stall ordered
+                # emission at the consumer (min-live-tag gate) forever
             queues[j].clear()
             qbytes[j] = 0
 
@@ -931,6 +939,14 @@ class StreamingExecutor:
             # the moment the dataset outgrows the cap. Barrier inputs are
             # store-resident refs; accumulation is the design.
             if j < len(rest) and is_barrier(rest[j]):
+                return True
+            # the FINAL queue is also exempt: ordered emission holds items
+            # until every smaller tag lands, so capping it deadlocks when
+            # >= max_queued out-of-order results pile up ahead of one
+            # straggler (the gate blocks the straggler's dispatch, the
+            # ordering gate blocks emission). Min-tag-first dispatch below
+            # keeps the out-of-order horizon small in practice.
+            if j == len(queues) - 1:
                 return True
             return (len(queues[j]) < self.max_queued
                     and qbytes[j] < self.max_queued_bytes)
@@ -1034,16 +1050,47 @@ class StreamingExecutor:
                     and all(not q for q in queues[:-1])
                     and all(a2a_done[i] for i, s in enumerate(rest) if is_barrier(s)))
 
+        def _pop_in_order():
+            """Yieldable final items, SUBMISSION order (reference: Ray Data
+            preserves block order end to end). An item may leave only when
+            no smaller sequence tag is live anywhere upstream — tags are
+            monotonic, future dispatches always tag higher, so the minimum
+            live tag being ours proves nothing earlier can still arrive."""
+            last = len(queues) - 1
+            while queues[last]:
+                min_live = min(seq_of.values(), default=None)
+                head = min(queues[last],
+                           key=lambda it: seq_of.get(_skey(it), 1 << 60))
+                if (min_live is not None
+                        and seq_of.get(_skey(head), 1 << 60) > min_live):
+                    return  # something earlier is still in flight upstream
+                queues[last].remove(head)
+                qbytes[last] -= size_of.pop(_skey(head), 0)
+                seq_of.pop(_skey(head), None)
+                yield head
+
         idle_spin = 0.0
         try:
             while True:
                 pump()
                 if queues[-1]:
-                    while queues[-1]:
-                        yield _q_pop(len(queues) - 1)
-                    idle_spin = 0.0
-                    continue
+                    emitted = False
+                    for item in _pop_in_order():
+                        emitted = True
+                        yield item
+                    if emitted:
+                        idle_spin = 0.0
+                        continue
                 if all_done():
+                    # defensive: flush any remaining final items in tag
+                    # order — nothing upstream can produce anymore, so the
+                    # min-live gate no longer applies
+                    last = len(queues) - 1
+                    for item in sorted(queues[last],
+                                       key=lambda it: seq_of.get(
+                                           _skey(it), 1 << 60)):
+                        yield item
+                    queues[last].clear()
                     return
                 time.sleep(min(0.05, 0.001 + idle_spin))
                 idle_spin = min(0.05, idle_spin + 0.002)

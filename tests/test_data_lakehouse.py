@@ -50,6 +50,15 @@ def test_avro_codecs_and_schema(tmp_path):
         assert meta["avro.codec"].decode() == codec
 
 
+def test_zip_positional_columns():
+    a = rd.range(6)
+    b = rd.range(6).map(lambda r: {"sq": int(r["id"]) ** 2, "id": -1})
+    z = sorted(a.zip(b).take_all(), key=lambda r: r["id"])
+    assert z[3]["id"] == 3 and z[3]["sq"] == 9 and z[3]["id_1"] == -1
+    with pytest.raises(ValueError, match="equal-length"):
+        rd.range(3).zip(rd.range(5)).take_all()
+
+
 def test_to_pandas_to_arrow():
     ds = rd.range(10).map(lambda r: {"id": r["id"],
                                      "x": float(r["id"]) * 2})

@@ -36,6 +36,21 @@ def test_sort_descending(session):
     assert ids == list(range(999, -1, -1))
 
 
+def test_streaming_preserves_dataset_order(session):
+    """Row order out of the executor equals dataset order (reference: Ray
+    Data preserves block order through the streaming executor) — final
+    outputs are emitted by submission-order tags, not completion order,
+    which is what makes Dataset.zip's positional alignment sound."""
+    # parallelism > max_queued (16) with the FIRST task a hard straggler:
+    # more out-of-order completions pile up than the old count gate
+    # allowed, which used to deadlock ordered emission (regression)
+    ds = rdata.range(200, parallelism=24).map(
+        lambda r: __import__("time").sleep(
+            0.4 if int(r["id"]) == 0 else 0.001) or r)
+    got = [int(r["id"]) for r in ds.iter_rows()]
+    assert got == list(range(200))  # unsorted comparison: order itself
+
+
 def test_byte_budget_backpressure_completes(session):
     """Reservation-style byte backpressure: with a budget far smaller than
     the dataset (1MB vs ~16MB of 1MB blocks), the pipeline must still
