@@ -167,6 +167,37 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
+    def call_sync(self, *args, timeout_s: float = 60.0,
+                  _routing_hint=None, **kwargs):
+        """Submit AND wait, retrying replica-death failures on surviving
+        replicas (reference: Serve's proxy retries requests whose replica
+        died — the client was never answered, so a retry is safe). Unlike
+        remote().result(), a death observed at RESULT time also drops the
+        replica from the router before re-picking; without that, retries
+        keep landing on the same dead replica until the table refreshes."""
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+        last: Exception | None = None
+        for _ in range(4):
+            replica_id = self._router.pick(_routing_hint)
+            replica = ActorHandle(replica_id)
+            try:
+                ref = replica.handle_request.remote(
+                    self._method, args, kwargs, self._model_id)
+            except Exception as e:  # submission failed: replica gone
+                last = e
+                self._router.done(replica_id)
+                self._router.drop(replica_id)
+                continue
+            try:
+                return ray_tpu.get(ref, timeout=timeout_s)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                last = e
+                self._router.drop(replica_id)
+            finally:
+                self._router.done(replica_id)
+        raise last
+
     def remote(self, *args, **kwargs):
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a
                      for a in args)

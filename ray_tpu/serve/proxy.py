@@ -86,8 +86,11 @@ class ProxyActor:
             "path": path, "method": method,
             "body": json.loads(body) if body else None,
         }
-        result = handle.remote(
-            request, _routing_hint=self._routing_hint(request)).result(timeout_s=60.0)
+        # replica-death failures retry on survivors, dropping the dead
+        # replica from the router between attempts (see handle.call_sync)
+        result = handle.call_sync(
+            request, timeout_s=60.0,
+            _routing_hint=self._routing_hint(request))
         return 200, json.dumps(result, default=str).encode()
 
     @staticmethod
