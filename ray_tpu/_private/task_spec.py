@@ -12,6 +12,7 @@ tooling a stable schema for introspection.)
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 VALID_STRATEGY_KINDS = ("pg", "node_affinity", "node_label")
@@ -35,6 +36,11 @@ def _check_resources(res: Any, where: str) -> None:
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             raise SpecError(f"{where}: resource {k!r} must be numeric, got "
                             f"{type(v).__name__}")
+        if not math.isfinite(v):
+            # NaN/inf would survive `v < 0` (False for NaN) and then blow
+            # up inside the GCS fixed-point quantization under the
+            # scheduler lock — the exact crash this boundary exists to stop.
+            raise SpecError(f"{where}: resource {k!r} must be finite, got {v}")
         if v < 0:
             raise SpecError(f"{where}: resource {k!r} is negative ({v})")
         if k in ("TPU", "GPU") and float(v) != int(v) and v > 1:

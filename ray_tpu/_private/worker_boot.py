@@ -41,17 +41,23 @@ def main():
         # per-host runtime-env agent: concurrent workers needing the same
         # env share ONE build and a broken env fails fast with the agent's
         # error; fall back to the local build path if the agent is gone
+        reply = None
         try:
             from ray_tpu._private import runtime_env_agent
             from ray_tpu._private.protocol import ConnectionClosed
 
             reply = runtime_env_agent.get_or_create(agent_sock, renv)
-            _reexec_under(reply["python"])
-        except (OSError, ConnectionError, ConnectionClosed, KeyError):
+        except (OSError, ConnectionError, ConnectionClosed):
             # agent unreachable: local fallback below. An agent-REPORTED
             # build failure (RuntimeError) propagates — retrying the same
             # broken build locally would just boot-loop the worker.
             pass
+        # _reexec_under runs OUTSIDE the try: a KeyError/OSError raised
+        # from inside the exec path must surface, not be misread as
+        # "agent unreachable" and silently fall through to a second
+        # build under the wrong interpreter assumption
+        if reply is not None and reply.get("python"):
+            _reexec_under(reply["python"])
     if conda_spec:
         from ray_tpu._private.runtime_env_conda import ensure_conda_env
 

@@ -323,22 +323,26 @@ def infer_schema(rows: list[dict], name: str = "row") -> dict:
             return "null"
         raise TypeError(f"cannot map {type(v)} to an avro type")
 
-    sample = rows[:100]
-    keys: list = []
-    for r in sample:  # union of keys, first-seen order
-        for k in r:
-            if k not in keys:
-                keys.append(k)
-    fields = []
-    for k in keys:
-        t: Any = None
-        for r in sample:
-            if r.get(k) is None:
+    # ONE pass over ALL rows: the key union must see every row (a column
+    # first appearing after row 100 must not be silently dropped from
+    # every written row), while type widening stops after the first 100
+    # non-null values per key. dict preserves first-seen order with O(1)
+    # membership.
+    inferred: dict = {}  # key -> [widened type or None, non-null count]
+    for r in rows:
+        for k, v in r.items():
+            ent = inferred.get(k)
+            if ent is None:
+                ent = inferred[k] = [None, 0]
+            if v is None or ent[1] >= 100:
                 continue
             try:
-                t = widen(t, of(r[k]))
+                ent[0] = widen(ent[0], of(v))
             except TypeError as e:
                 raise TypeError(f"column {k!r} mixes incompatible types: {e}")
+            ent[1] += 1
+    fields = []
+    for k, (t, _) in inferred.items():
         fields.append({"name": str(k),
                        "type": ["null", t] if t else "null"})
     return {"type": "record", "name": name, "fields": fields}

@@ -112,10 +112,16 @@ def rows_to_block(rows: Iterable[Any]) -> Block:
     if not rows:
         return {}
     if isinstance(rows[0], dict):
-        keys = rows[0].keys()
+        # key UNION over all rows (first-seen order): a column appearing
+        # only in later rows must not be silently dropped, and a row
+        # missing a column fills with None instead of raising KeyError
+        keys: dict = {}
+        for r in rows:
+            for k in r:
+                keys.setdefault(k)
         out = {}
         for k in keys:
-            vals = [r[k] for r in rows]
+            vals = [r.get(k) for r in rows]
             try:
                 out[k] = np.asarray(vals)
             except (ValueError, TypeError):
@@ -133,10 +139,14 @@ def concat_blocks(blocks: list[Block]) -> Block:
         return {}
     if len(blocks) == 1:
         return blocks[0]
-    keys = list(blocks[0].keys())
+    keys: dict = {}  # union across blocks, first-seen order
+    for b in blocks:
+        for k in b:
+            keys.setdefault(k)
     out: Block = {}
     for k in keys:
-        vals = [b[k] for b in blocks]
+        vals = [b[k] if k in b
+                else [None] * BlockAccessor(b).num_rows() for b in blocks]
         if all(isinstance(v, np.ndarray) for v in vals):
             out[k] = np.concatenate(vals)
         else:

@@ -917,9 +917,12 @@ class StreamingExecutor:
             # min-tag-first: dispatching the oldest pending work bounds how
             # far ahead out-of-order completions can run (smaller ordered-
             # emission buffer, stragglers never starve behind newer items)
-            item = min(queues[j],
-                       key=lambda it: seq_of.get(_skey(it), 1 << 60))
-            queues[j].remove(item)
+            # removal is by INDEX: deque.remove would compare payloads
+            # with == (ambiguous for block lists holding numpy arrays).
+            # Single O(n) enumerate pass — indexing a deque is O(n) itself.
+            idx, item = min(enumerate(queues[j]),
+                            key=lambda p: seq_of.get(_skey(p[1]), 1 << 60))
+            del queues[j][idx]
             qbytes[j] -= size_of.pop(_skey(item), 0)
             return item
 
@@ -1059,12 +1062,15 @@ class StreamingExecutor:
             last = len(queues) - 1
             while queues[last]:
                 min_live = min(seq_of.values(), default=None)
-                head = min(queues[last],
-                           key=lambda it: seq_of.get(_skey(it), 1 << 60))
+                # index-based removal: == on block payloads is unsafe;
+                # single enumerate pass (deque indexing is O(n))
+                idx, head = min(enumerate(queues[last]),
+                                key=lambda p: seq_of.get(
+                                    _skey(p[1]), 1 << 60))
                 if (min_live is not None
                         and seq_of.get(_skey(head), 1 << 60) > min_live):
                     return  # something earlier is still in flight upstream
-                queues[last].remove(head)
+                del queues[last][idx]
                 qbytes[last] -= size_of.pop(_skey(head), 0)
                 seq_of.pop(_skey(head), None)
                 yield head

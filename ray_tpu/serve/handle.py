@@ -171,14 +171,29 @@ class DeploymentHandle:
                   _routing_hint=None, **kwargs):
         """Submit AND wait, retrying replica-death failures on surviving
         replicas (reference: Serve's proxy retries requests whose replica
-        died — the client was never answered, so a retry is safe). Unlike
+        died). Semantics are AT-LEAST-ONCE: a replica may have executed the
+        request's side effects before dying, so only the client's answer is
+        known lost — non-idempotent deployments should dedup by request id.
+        All attempts share ONE deadline (timeout_s total, not per attempt),
+        so a caller's budget can't silently stretch 4x. Unlike
         remote().result(), a death observed at RESULT time also drops the
         replica from the router before re-picking; without that, retries
         keep landing on the same dead replica until the table refreshes."""
-        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+        import time as _time
 
+        from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
+                                        WorkerCrashedError)
+
+        deadline = _time.monotonic() + timeout_s
         last: Exception | None = None
         for _ in range(4):
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                if last is None:
+                    last = GetTimeoutError(
+                        f"call_sync to {self._name} timed out after "
+                        f"{timeout_s}s before any attempt completed")
+                break
             replica_id = self._router.pick(_routing_hint)
             replica = ActorHandle(replica_id)
             try:
@@ -190,7 +205,7 @@ class DeploymentHandle:
                 self._router.drop(replica_id)
                 continue
             try:
-                return ray_tpu.get(ref, timeout=timeout_s)
+                return ray_tpu.get(ref, timeout=remaining)
             except (ActorDiedError, WorkerCrashedError) as e:
                 last = e
                 self._router.drop(replica_id)
