@@ -21,6 +21,14 @@ from ray_tpu.rllib.env import (CartPoleVecEnv, PendulumVecEnv, VectorEnv,
                                make_vec_env)
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.learner import Learner, compute_gae
+from ray_tpu.rllib.multi_agent_env import (CoordinationGameVecEnv,
+                                           MultiAgentCartPoleVecEnv,
+                                           MultiAgentVecEnv,
+                                           make_multi_agent_env)
+from ray_tpu.rllib.multi_agent_runner import (MultiAgentEnvRunner,
+                                              MultiAgentEnvRunnerGroup)
+from ray_tpu.rllib.multi_rl_module import (MultiRLModuleSpec, RLModuleSpec,
+                                           init_multi)
 
 __all__ = [
     "Algorithm",
@@ -52,6 +60,15 @@ __all__ = [
     "SAC",
     "SACConfig",
     "VectorEnv",
+    "CoordinationGameVecEnv",
+    "MultiAgentCartPoleVecEnv",
+    "MultiAgentVecEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
+    "MultiRLModuleSpec",
+    "RLModuleSpec",
     "compute_gae",
+    "init_multi",
+    "make_multi_agent_env",
     "make_vec_env",
 ]

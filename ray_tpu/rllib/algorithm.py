@@ -29,10 +29,34 @@ class AlgorithmConfig:
         self.entropy_coeff = 0.01
         self.model_hidden = (64, 64)
         self.seed = 0
+        self.env_config: dict = {}
+        # multi-agent (reference: algorithm_config.py multi_agent() —
+        # policies + policy_mapping_fn switch the whole stack to the
+        # MultiAgentEnvRunner / per-policy learner path)
+        self.policies: dict | None = None
+        self.policy_mapping_fn = None
 
-    def environment(self, env=None, **_ignored) -> "AlgorithmConfig":
+    def environment(self, env=None, *, env_config=None,
+                    **_ignored) -> "AlgorithmConfig":
         if env is not None:
             self.env_id = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None,
+                    **_ignored) -> "AlgorithmConfig":
+        """(reference: algorithm_config.py:multi_agent — `policies` names
+        the module ids (dict id -> RLModuleSpec-or-None, or an iterable of
+        ids with specs inferred from the env), `policy_mapping_fn`
+        (agent_id) -> policy_id decides which module serves which agent.)"""
+        if policies is not None:
+            if isinstance(policies, dict):
+                self.policies = dict(policies)
+            else:
+                self.policies = {p: None for p in policies}
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
@@ -89,7 +113,7 @@ class Algorithm:
         self.iteration += 1
         metrics = self.training_step()
         recent = self._episode_returns[-100:]
-        return {
+        out = {
             "training_iteration": self.iteration,
             "env_runners": {
                 "episode_return_mean": float(np.mean(recent)) if recent else float("nan"),
@@ -97,13 +121,28 @@ class Algorithm:
             },
             "learners": metrics,
         }
+        # multi-agent: per-agent return means alongside the aggregate
+        # (reference: result dicts carry env_runners/module_... subtrees)
+        agent_returns = getattr(self, "_agent_episode_returns", None)
+        if agent_returns:
+            out["env_runners"]["agent_episode_returns"] = {
+                a: float(np.mean(v[-100:])) if v else float("nan")
+                for a, v in agent_returns.items()
+            }
+        return out
 
     def save(self, path: str) -> str:
         from ray_tpu.llm import checkpoint_io
 
         os.makedirs(path, exist_ok=True)
-        checkpoint_io.save_params(self.learner.params,
-                                  os.path.join(path, "module"))
+        learners = getattr(self, "learners", None)
+        if learners is not None:  # multi-agent: one subdir per module id
+            for mid, lrn in learners.items():
+                checkpoint_io.save_params(lrn.params,
+                                          os.path.join(path, "module", mid))
+        else:
+            checkpoint_io.save_params(self.learner.params,
+                                      os.path.join(path, "module"))
         return path
 
     def restore(self, path: str) -> None:
@@ -111,10 +150,20 @@ class Algorithm:
 
         from ray_tpu.llm import checkpoint_io
 
-        loaded = checkpoint_io.load_params(os.path.join(path, "module"))
-        self.learner.params = jax.tree.map(
-            lambda old, new: new.astype(old.dtype) if hasattr(old, "dtype") else new,
-            self.learner.params, loaded)
+        def _merge(old, new):
+            return jax.tree.map(
+                lambda o, n: n.astype(o.dtype) if hasattr(o, "dtype") else n,
+                old, new)
+
+        learners = getattr(self, "learners", None)
+        if learners is not None:
+            for mid, lrn in learners.items():
+                loaded = checkpoint_io.load_params(
+                    os.path.join(path, "module", mid))
+                lrn.params = _merge(lrn.params, loaded)
+        else:
+            loaded = checkpoint_io.load_params(os.path.join(path, "module"))
+            self.learner.params = _merge(self.learner.params, loaded)
 
     def stop(self):
         if hasattr(self, "runner_group"):

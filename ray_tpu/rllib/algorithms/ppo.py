@@ -23,6 +23,9 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     def _setup(self):
         cfg = self.config
+        if cfg.policies is not None:
+            self._setup_multi_agent()
+            return
         probe = make_vec_env(cfg.env_id, 1, cfg.seed)
         self.learner = learner_mod.Learner(
             probe.obs_dim, probe.num_actions, lr=cfg.lr,
@@ -33,8 +36,118 @@ class PPO(Algorithm):
             cfg.env_id, num_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_runner, seed=cfg.seed)
 
+    def _setup_multi_agent(self):
+        """Per-policy Learners + MultiAgentEnvRunnerGroup (reference:
+        PPO handles multi-agent through the same Algorithm class once
+        config.multi_agent() is set; learners are per-module —
+        core/learner + multi_rl_module.py)."""
+        cfg = self.config
+        from ray_tpu.rllib.multi_agent_env import make_multi_agent_env
+        from ray_tpu.rllib.multi_agent_runner import MultiAgentEnvRunnerGroup
+        from ray_tpu.rllib.multi_rl_module import RLModuleSpec
+
+        mapping = cfg.policy_mapping_fn or (lambda agent_id: "default_policy")
+        probe = make_multi_agent_env(cfg.env_id, 1, cfg.seed,
+                                     **cfg.env_config)
+        # infer unspecified policy specs from the first agent mapped there
+        specs: dict[str, RLModuleSpec] = {}
+        for pid, spec in cfg.policies.items():
+            served = [a for a in probe.agent_ids if mapping(a) == pid]
+            if not served:
+                raise ValueError(
+                    f"policy {pid!r} has no agents under policy_mapping_fn")
+            # every agent a policy serves must share one interface — a
+            # mismatch would otherwise only surface as a shape error
+            # inside the remote runner, where the fault-tolerant group
+            # swallows it into a silent kill/respawn loop
+            dims = {(probe.obs_dims[a], probe.num_actions[a])
+                    for a in served}
+            if len(dims) > 1:
+                raise ValueError(
+                    f"policy {pid!r} serves agents with mismatched "
+                    f"(obs_dim, num_actions): "
+                    f"{ {a: (probe.obs_dims[a], probe.num_actions[a]) for a in served} }")
+            obs_dim, n_act = next(iter(dims))
+            if spec is not None:
+                if (spec.obs_dim, spec.num_actions) != (obs_dim, n_act):
+                    raise ValueError(
+                        f"policy {pid!r} spec ({spec.obs_dim}, "
+                        f"{spec.num_actions}) does not match its agents' "
+                        f"env interface ({obs_dim}, {n_act})")
+                specs[pid] = spec
+                continue
+            specs[pid] = RLModuleSpec(obs_dim, n_act, cfg.model_hidden)
+        unmapped = [a for a in probe.agent_ids
+                    if mapping(a) not in cfg.policies]
+        if unmapped:
+            raise ValueError(
+                f"agents {unmapped} map outside configured policies "
+                f"{sorted(cfg.policies)}")
+        self.learners = {
+            pid: learner_mod.Learner(
+                s.obs_dim, s.num_actions, lr=cfg.lr, hidden=s.hidden,
+                clip=cfg.clip_param, vf_coef=cfg.vf_loss_coeff,
+                ent_coef=cfg.entropy_coeff, seed=cfg.seed + 31 * i)
+            for i, (pid, s) in enumerate(sorted(specs.items()))
+        }
+        self.runner_group = MultiAgentEnvRunnerGroup(
+            cfg.env_id, num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_runner,
+            mapping_fn=mapping, seed=cfg.seed, env_config=cfg.env_config)
+        self._agent_episode_returns = {a: [] for a in probe.agent_ids}
+
+    def _multi_agent_step(self) -> dict:
+        import jax.numpy as jnp
+
+        from ray_tpu._private import serialization as ser
+        import jax
+
+        cfg = self.config
+        blob = ser.dumps({pid: jax.device_get(lrn.params)
+                          for pid, lrn in self.learners.items()})
+        samples = self.runner_group.sample(blob, cfg.rollout_fragment_length)
+        if not samples:
+            return {}
+        metrics: dict = {}
+        for pid, lrn in self.learners.items():
+            batches = []
+            for s in samples:
+                if pid not in s:
+                    continue
+                b = s[pid]
+                advs, rets = learner_mod.compute_gae(
+                    jnp.asarray(b["rewards"]), jnp.asarray(b["values"]),
+                    jnp.asarray(b["dones"]), jnp.asarray(b["last_value"]),
+                    gamma=cfg.gamma, lam=cfg.lam)
+                T, M = b["rewards"].shape
+                batches.append({
+                    "obs": b["obs"].reshape(T * M, -1),
+                    "actions": b["actions"].reshape(T * M),
+                    "logp_old": b["logp"].reshape(T * M),
+                    "advantages": np.asarray(advs).reshape(T * M),
+                    "returns": np.asarray(rets).reshape(T * M),
+                })
+            if not batches:
+                continue
+            batch = {k: np.concatenate([x[k] for x in batches])
+                     for k in batches[0]}
+            mb = min(cfg.minibatch_size, batch["obs"].shape[0])
+            metrics[pid] = lrn.update(batch, minibatch_size=mb,
+                                      num_epochs=cfg.num_epochs,
+                                      rng=self.rng)
+        for s in samples:
+            per_agent = s.get("__episode_returns__", {})
+            step_all: list[float] = []
+            for a, vals in per_agent.items():
+                self._agent_episode_returns.setdefault(a, []).extend(vals)
+                step_all.extend(vals)
+            self._episode_returns.extend(step_all)
+        return metrics
+
     def training_step(self) -> dict:
         cfg = self.config
+        if cfg.policies is not None:
+            return self._multi_agent_step()
         blob = self.learner.get_weights_blob()
         samples = self.runner_group.sample(blob, cfg.rollout_fragment_length)
         if not samples:

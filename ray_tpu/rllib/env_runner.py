@@ -145,10 +145,13 @@ class EnvRunnerGroup:
         self.env_id = env_id
         self.num_envs_per_runner = num_envs_per_runner
         self.seed = seed
-        self.runners = [
-            EnvRunner.remote(env_id, num_envs_per_runner, seed + 1000 * i)
-            for i in range(num_runners)
-        ]
+        self.runners = [self._make_runner(seed + 1000 * i)
+                        for i in range(num_runners)]
+
+    def _make_runner(self, seed: int):
+        """Runner factory — subclasses (MultiAgentEnvRunnerGroup) override
+        this so __init__ and the fault-tolerant replace path share it."""
+        return EnvRunner.remote(self.env_id, self.num_envs_per_runner, seed)
 
     def _collect(self, refs) -> list[dict]:
         out = []
@@ -163,8 +166,7 @@ class EnvRunnerGroup:
                     ray_tpu.kill(self.runners[i])
                 except Exception:
                     pass
-                self.runners[i] = EnvRunner.remote(
-                    self.env_id, self.num_envs_per_runner, self.seed + 7777 + i)
+                self.runners[i] = self._make_runner(self.seed + 7777 + i)
         return out
 
     def sample(self, params_blob: bytes, num_steps: int) -> list[dict]:

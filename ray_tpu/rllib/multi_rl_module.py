@@ -1,0 +1,51 @@
+"""MultiRLModule: a ModuleID -> policy-params mapping.
+
+(reference: rllib/core/rl_module/multi_rl_module.py:48 — MultiRLModule
+holds n sub-RLModules keyed by ModuleID; which module serves which agent
+is the CALLER's policy-mapping decision, not the module's. Here each
+sub-module is the same pure-functional (init, forward) pair as
+rl_module.py, so the whole thing stays a jax pytree: per-policy updates
+jit independently, and a shared policy is literally the same params leaf
+referenced by every mapped agent.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from ray_tpu.rllib import rl_module
+
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    """Per-policy network spec (reference: core/rl_module/rl_module.py
+    RLModuleSpec — obs/action spaces + model config)."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: tuple = (64, 64)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiRLModuleSpec:
+    """(reference: multi_rl_module.py MultiRLModuleSpec — dict of
+    ModuleID -> RLModuleSpec.)"""
+
+    module_specs: dict  # ModuleID -> RLModuleSpec
+
+    def keys(self):
+        return self.module_specs.keys()
+
+    def __getitem__(self, module_id: str) -> RLModuleSpec:
+        return self.module_specs[module_id]
+
+
+def init_multi(key, spec: MultiRLModuleSpec) -> dict:
+    """-> {module_id: params pytree}; independent init per policy."""
+    keys = jax.random.split(key, max(1, len(spec.module_specs)))
+    return {
+        mid: rl_module.init(k, s.obs_dim, s.num_actions, s.hidden)
+        for k, (mid, s) in zip(keys, sorted(spec.module_specs.items()))
+    }
