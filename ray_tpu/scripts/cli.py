@@ -331,6 +331,48 @@ def cmd_submit(args):
     sys.exit(0 if status == "SUCCEEDED" else 1)
 
 
+def cmd_serve(args):
+    """Declarative serve workflow (reference: serve/scripts.py —
+    `serve deploy config.yaml`, `serve build import_path`, `serve status`)."""
+    import yaml
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import schema as serve_schema
+
+    if args.action == "build":
+        if not args.target:
+            raise SystemExit("serve build needs an import_path "
+                             "(module:attribute)")
+        app_schema = serve_schema.ServeApplicationSchema(
+            import_path=args.target)
+        target = app_schema.resolve_target()
+        cfg = serve_schema.build(target, import_path=args.target)
+        text = yaml.safe_dump(cfg, sort_keys=False)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            print(f"wrote {args.output}")
+        else:
+            print(text, end="")
+        return
+    addr = args.address or os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        ray_tpu.init(address=addr)
+    else:  # attach to the newest live session on this host
+        sd = _pick_session(args)
+        os.environ["RAY_TPU_ADDRESS"] = f"unix:{os.path.join(sd, 'gcs.sock')}"
+        os.environ["RAY_TPU_SESSION"] = os.path.basename(sd)[len("session_"):]
+        ray_tpu.init()
+    if args.action == "deploy":
+        if not args.target:
+            raise SystemExit("serve deploy needs a config YAML path")
+        serve.deploy(args.target)
+        print(f"deployed applications from {args.target}")
+    elif args.action == "status":
+        print(json.dumps(serve.status(), indent=1, default=str))
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -417,6 +459,19 @@ def main(argv=None):
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=10001)
     sp.set_defaults(fn=cmd_client_proxy)
+
+    sp = sub.add_parser("serve",
+                        help="declarative serve: deploy/build/status "
+                             "(reference: `serve deploy` / `serve build`)")
+    sp.add_argument("action", choices=["deploy", "build", "status"])
+    sp.add_argument("target", nargs="?",
+                    help="deploy: config YAML path; build: import_path "
+                         "(module:attribute) of a bound Application")
+    sp.add_argument("-o", "--output", help="build: write YAML here "
+                                           "(default stdout)")
+    sp.add_argument("--address", help="GCS address of a running cluster "
+                                      "(deploy/status attach to it)")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("submit", help="submit a job (command) to the cluster")
     sp.add_argument("--no-wait", action="store_true")

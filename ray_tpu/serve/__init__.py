@@ -22,6 +22,8 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.replica import get_multiplexed_model_id
 from ray_tpu.serve.rpc_ingress import RPCClient, start_rpc_ingress
+from ray_tpu.serve.schema import (SchemaError, ServeDeploySchema, build,
+                                  deploy, load_config)
 
 __all__ = [
     "Application",
@@ -43,5 +45,10 @@ __all__ = [
     "start",
     "start_rpc_ingress",
     "RPCClient",
+    "SchemaError",
+    "ServeDeploySchema",
+    "build",
+    "deploy",
+    "load_config",
     "status",
 ]

@@ -30,6 +30,8 @@ class _DeploymentState:
         self.init_args_blob = init_args_blob
         self.config = config          # dict form of DeploymentConfig
         self.replicas: dict[str, object] = {}  # tag → ActorHandle
+        self.addrs: dict[str, tuple] = {}      # tag → fast-RPC (host, port)
+        self.pushed: dict[str, tuple] = {}     # tag → (ongoing, mono_ts)
         self.draining: dict[str, tuple[object, float]] = {}  # tag → (handle, deadline)
         self.target = config["initial_replicas"]
         self.next_idx = 0
@@ -105,7 +107,11 @@ class ServeController:
                 "deployments": {
                     full: {"replicas": [h.actor_id for h in st.replicas.values()],
                            "max_ongoing": st.config["max_ongoing_requests"],
-                           "request_router": st.config.get("request_router", "pow2")}
+                           "request_router": st.config.get("request_router", "pow2"),
+                           "replica_addrs": {
+                               h.actor_id: st.addrs[tag]
+                               for tag, h in st.replicas.items()
+                               if tag in st.addrs}}
                     for full, st in self.deployments.items()
                 },
             }
@@ -164,6 +170,8 @@ class ServeController:
                             if lookup.get(h.actor_id, {}).get("state") == "dead"]
                     for tag in dead:
                         st.replicas.pop(tag)
+                        st.addrs.pop(tag, None)
+                        st.pushed.pop(tag, None)
                         self.version += 1
                 # drain completion: kill once idle or past the grace deadline
                 for tag, (h, deadline) in list(st.draining.items()):
@@ -196,9 +204,32 @@ class ServeController:
             num_tpus=opts.get("num_tpus"),
             resources=opts.get("resources"),
             max_concurrency=st.config["max_ongoing_requests"],
-        ).remote(st.name, tag, st.callable_blob, st.init_args_blob,
-                 st.config.get("user_config"))
+        ).remote(f"{st.app_name}_{st.name}", tag, st.callable_blob,
+                 st.init_args_blob, st.config.get("user_config"),
+                 st.config["max_ongoing_requests"])
         st.replicas[tag] = handle
+
+    def note_replica_addr(self, full_name: str, tag: str, addr) -> None:
+        """Replica pushes its fast-RPC (host, port) once listening; routers
+        pick it up on the next versioned table pull (replica.py fast data
+        plane)."""
+        with self._lock:
+            st = self.deployments.get(full_name)
+            if st is None or tag not in st.replicas:
+                return  # already dropped (or never known): ignore
+            st.addrs[tag] = tuple(addr)
+            self.version += 1
+
+    def note_replica_stats(self, full_name: str, tag: str,
+                           ongoing: int) -> None:
+        """Replica's out-of-band ongoing+queued count: the autoscaling
+        signal for fast-plane traffic, which never shows up in GCS actor
+        task stats (replica.py _stats_push_loop)."""
+        with self._lock:
+            st = self.deployments.get(full_name)
+            if st is None or tag not in st.replicas:
+                return
+            st.pushed[tag] = (int(ongoing), time.monotonic())
 
     def _drop_replicas(self, st: _DeploymentState, tags: list[str]):
         """Remove replicas from routing and drain: they keep serving queued
@@ -209,6 +240,8 @@ class ServeController:
         deadline = time.monotonic() + grace
         for tag in tags:
             h = st.replicas.pop(tag, None)
+            st.addrs.pop(tag, None)
+            st.pushed.pop(tag, None)
             if h is not None:
                 st.draining[tag] = (h, deadline)
 
@@ -236,10 +269,19 @@ class ServeController:
         for st in states:
             cfg = st.config["autoscaling_config"]
             with self._lock:
-                aids = [h.actor_id for h in st.replicas.values()]
-            total = sum(actor_stats.get(a, {}).get("queued", 0)
-                        + actor_stats.get(a, {}).get("in_flight", 0)
-                        for a in aids)
+                rows = [(tag, h.actor_id) for tag, h in st.replicas.items()]
+                pushed = dict(st.pushed)
+            # per replica: max of the GCS actor-task view (actor plane)
+            # and the freshly pushed counter (covers the fast plane; an
+            # actor-plane request appears in both, so max avoids double
+            # counting)
+            now_m = time.monotonic()
+            total = 0
+            for tag, aid in rows:
+                gcs = (actor_stats.get(aid, {}).get("queued", 0)
+                       + actor_stats.get(aid, {}).get("in_flight", 0))
+                pv, pts = pushed.get(tag, (0, 0.0))
+                total += max(gcs, pv if now_m - pts < 2.0 else 0)
             desired = max(cfg["min_replicas"],
                           min(cfg["max_replicas"],
                               math.ceil(total / cfg["target_ongoing_requests"])))

@@ -20,6 +20,130 @@ from ray_tpu.actor import ActorHandle
 ROUTING_REFRESH_S = 1.0
 
 
+def _channel_dead_error():
+    """The fast-RPC connection to a replica broke (replica death or
+    network): surfaced as ActorDiedError so every retry/drop path treats
+    it exactly like an actor-plane replica death."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    return ActorDiedError("serve fast-rpc channel to replica closed")
+
+
+class _Pending:
+    """A rid's in-flight slot on a _FastChannel."""
+
+    __slots__ = ("event", "reply", "chan", "rid")
+
+    def __init__(self, chan=None, rid=None):
+        self.event = threading.Event()
+        self.reply = None
+        self.chan = chan
+        self.rid = rid
+
+    def wait(self, timeout_s: float | None):
+        if not self.event.wait(timeout_s):
+            # unregister: a long-lived channel must not accumulate
+            # abandoned waiters (and their eventual replies) forever
+            if self.chan is not None:
+                with self.chan._lock:
+                    self.chan._waiters.pop(self.rid, None)
+            raise TimeoutError(f"fast-rpc call timed out after {timeout_s}s")
+        if self.reply is None:  # woken by channel death
+            raise _channel_dead_error()
+        if "result_ser" in self.reply or "error_ser" in self.reply:
+            # cloudpickle fallback lane (payload the frame codec refused)
+            from ray_tpu._private import serialization as ser
+
+            if self.reply.get("ok"):
+                return ser.loads(self.reply["result_ser"])
+            raise ser.loads(self.reply["error_ser"])
+        if self.reply.get("ok"):
+            return self.reply.get("result")
+        raise self.reply.get("error")
+
+
+class _FastChannel:
+    """One persistent framed connection to a replica's RPC listener;
+    rid-tagged requests pipeline, a single recv thread resolves waiters.
+    (reference: the Serve proxy holds persistent gRPC streams into
+    replicas — serve/_private/replica.py — instead of paying a scheduler
+    round-trip per request.)"""
+
+    def __init__(self, addr: tuple):
+        from ray_tpu._private.protocol import connect_tcp
+
+        self._conn = connect_tcp(addr[0], addr[1], timeout=5.0)
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._waiters: dict[int, _Pending] = {}
+        self.dead = False
+        threading.Thread(target=self._recv_loop, daemon=True,
+                         name="serve-fast-recv").start()
+
+    def _recv_loop(self):
+        try:
+            while True:
+                msg = self._conn.recv()
+                with self._lock:
+                    w = self._waiters.pop(msg.get("rid"), None)
+                if w is not None:
+                    w.reply = msg
+                    w.event.set()
+        except Exception:  # noqa: BLE001 — any break means channel death
+            self.dead = True
+            with self._lock:
+                waiters, self._waiters = list(self._waiters.values()), {}
+            for w in waiters:  # wake: their replies will never arrive
+                w.event.set()
+
+    def submit(self, method: str, args: tuple, kwargs: dict,
+               model_id: str | None) -> _Pending:
+        if self.dead:
+            raise _channel_dead_error()
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            w = _Pending(self, rid)
+            self._waiters[rid] = w
+        try:
+            self._conn.send({"rid": rid, "method": method, "args": args,
+                             "kwargs": kwargs, "model_id": model_id})
+        except Exception as e:
+            with self._lock:
+                self._waiters.pop(rid, None)
+            self.dead = True
+            raise _channel_dead_error() from e
+        if self.dead:
+            # the recv loop may have died (and drained waiters) between our
+            # registration and now — make sure this waiter can't hang
+            with self._lock:
+                self._waiters.pop(rid, None)
+            w.event.set()
+        return w
+
+    def call(self, method: str, args: tuple, kwargs: dict,
+             model_id: str | None, timeout_s: float):
+        return self.submit(method, args, kwargs, model_id).wait(timeout_s)
+
+
+_channels: dict[tuple, _FastChannel] = {}
+_channels_lock = threading.Lock()
+
+
+def _get_channel(addr: tuple) -> _FastChannel:
+    addr = tuple(addr)
+    with _channels_lock:
+        ch = _channels.get(addr)
+    if ch is not None and not ch.dead:
+        return ch
+    # connect OUTSIDE the lock — a slow/unreachable replica must not stall
+    # every other channel lookup. A racing duplicate connect is benign.
+    ch = _FastChannel(addr)  # raises OSError if unreachable
+    with _channels_lock:
+        _channels[addr] = ch
+    return ch
+
+
 class DeploymentResponse:
     """(reference: serve/handle.py DeploymentResponse — resolvable future;
     passing it to another .remote() call chains without blocking.)"""
@@ -36,6 +160,25 @@ class DeploymentResponse:
 
     def _to_object_ref(self):
         return self._ref
+
+
+class _FastResponse:
+    """DeploymentResponse equivalent for the fast-RPC plane: resolves a
+    rid-tagged reply instead of an object ref. Chaining into another
+    .remote() materializes through the object store on demand."""
+
+    def __init__(self, pending: "_Pending", on_done):
+        self._pending = pending
+        self._finalizer = weakref.finalize(self, on_done)
+
+    def result(self, timeout_s: float | None = None):
+        try:
+            return self._pending.wait(timeout_s)
+        finally:
+            self._finalizer()
+
+    def _to_object_ref(self):
+        return ray_tpu.put(self.result())
 
 
 class DeploymentResponseGenerator:
@@ -70,6 +213,7 @@ class _Router:
         self.controller = controller
         self.version = -1
         self.replicas: list[str] = []
+        self.addrs: dict[str, tuple] = {}  # replica actor_id -> fast-RPC addr
         self.inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
@@ -88,6 +232,7 @@ class _Router:
             self.version = table["version"]
             dep = table["deployments"].get(self.name)
             self.replicas = dep["replicas"] if dep else []
+            self.addrs = dict(dep.get("replica_addrs") or {}) if dep else {}
             self.inflight = {r: self.inflight.get(r, 0) for r in self.replicas}
             if dep and dep.get("request_router") == "prefix_aware" \
                     and self._prefix_policy is None:
@@ -132,6 +277,7 @@ class _Router:
         """Replica died: force a table refresh next pick."""
         with self._lock:
             self.replicas = [r for r in self.replicas if r != replica]
+            self.addrs.pop(replica, None)
             if self._prefix_policy is not None:
                 self._prefix_policy.on_replica_dead(replica)
         self._last_refresh = 0.0
@@ -195,6 +341,30 @@ class DeploymentHandle:
                         f"{timeout_s}s before any attempt completed")
                 break
             replica_id = self._router.pick(_routing_hint)
+            ch = None
+            addr = self._router.addrs.get(replica_id)
+            if addr is not None:
+                try:
+                    ch = _get_channel(addr)
+                except OSError:
+                    # unroutable from THIS host (not replica death): the
+                    # actor plane below still works — don't drop it
+                    ch = None
+            if ch is not None:
+                # fast data plane: one framed round-trip on a persistent
+                # socket, no per-request task submission
+                try:
+                    return ch.call(self._method, args, kwargs,
+                                   self._model_id, remaining)
+                except TimeoutError as e:
+                    last = e
+                    continue  # deadline loop exits when budget is spent
+                except (ActorDiedError, OSError) as e:
+                    last = e
+                    self._router.drop(replica_id)
+                    continue
+                finally:
+                    self._router.done(replica_id)
             replica = ActorHandle(replica_id)
             try:
                 ref = replica.handle_request.remote(
@@ -214,14 +384,43 @@ class DeploymentHandle:
         raise last
 
     def remote(self, *args, **kwargs):
-        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse) else a
-                     for a in args)
-        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+        from ray_tpu._private.worker import ObjectRef
+
+        args = tuple(a._to_object_ref()
+                     if isinstance(a, (DeploymentResponse, _FastResponse))
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref()
+                      if isinstance(v, (DeploymentResponse, _FastResponse))
+                      else v)
                   for k, v in kwargs.items()}
         hint = kwargs.pop("_routing_hint", None)
+        # object-ref arguments need the task plane's ref resolution — the
+        # fast channel ships plain values only
+        has_refs = (any(isinstance(a, ObjectRef) for a in args)
+                    or any(isinstance(v, ObjectRef) for v in kwargs.values()))
         last_err = None
         for _ in range(3):  # retry on replica death with a fresh table
             replica_id = self._router.pick(hint)
+            if not self._stream and not has_refs:
+                addr = self._router.addrs.get(replica_id)
+                ch = None
+                if addr is not None:
+                    try:
+                        ch = _get_channel(addr)
+                    except OSError:
+                        ch = None  # unroutable from here: actor plane below
+                if ch is not None:
+                    try:
+                        pending = ch.submit(
+                            self._method, args, kwargs, self._model_id)
+                        return _FastResponse(
+                            pending,
+                            lambda r=replica_id: self._router.done(r))
+                    except Exception as e:  # channel down: drop + retry
+                        last_err = e
+                        self._router.done(replica_id)
+                        self._router.drop(replica_id)
+                        continue
             replica = ActorHandle(replica_id)
             try:
                 if self._stream:

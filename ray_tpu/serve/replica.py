@@ -4,17 +4,43 @@
 the user method; replicas track ongoing requests for the router and the
 autoscaler. Concurrency: the reference replica is an asyncio event loop with
 max_ongoing_requests admission; here the actor runs with
-max_concurrency=max_ongoing_requests threads.)
+max_concurrency=max_ongoing_requests threads.
+
+Fast data plane: each replica also listens on a framed-RPC socket
+(reference: the proxy speaks gRPC/HTTP directly into the replica's event
+loop — serve/_private/replica.py handle_request over gRPC — NOT through a
+per-request scheduler hop). DeploymentHandles connect once per replica and
+pipeline rid-tagged request frames, bypassing task-submission machinery;
+the actor-task path remains for streaming and as the fallback when no
+address is known.)
 """
 
 from __future__ import annotations
 
 import inspect
+import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 
 import ray_tpu
+from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_tcp
 
 _replica_ctx = threading.local()
+
+
+def _node_ip() -> str:
+    """This node's routable IP for fast-RPC advertisement. Env override
+    first (multi-host agents set it), then hostname lookup, then loopback
+    (single-host sessions)."""
+    import socket
+
+    ip = os.environ.get("RAY_TPU_NODE_IP")
+    if ip:
+        return ip
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
 
 
 def get_multiplexed_model_id() -> str | None:
@@ -27,7 +53,8 @@ def get_multiplexed_model_id() -> str | None:
 class ReplicaActor:
     def __init__(self, deployment_name: str, replica_tag: str,
                  callable_blob: bytes, init_args_blob: bytes,
-                 user_config: dict | None = None):
+                 user_config: dict | None = None,
+                 max_ongoing_requests: int = 8):
         from ray_tpu._private import serialization as ser
 
         self.deployment_name = deployment_name
@@ -39,14 +66,154 @@ class ReplicaActor:
         else:
             self.user = target  # function deployment: called directly
         self._ongoing = 0
+        self._pending = 0  # admission-queued (either plane), not yet running
         self._total = 0
         self._lock = threading.Lock()
         if user_config is not None:
             self.reconfigure(user_config)
+        # fast data plane: framed-RPC listener + bounded execution pool.
+        # ONE admission semaphore bounds user-code concurrency across BOTH
+        # planes — without it, actor-plane max_concurrency threads plus the
+        # RPC pool would double the configured max_ongoing_requests.
+        self._admission = threading.BoundedSemaphore(
+            max(1, max_ongoing_requests))
+        self._rpc_addr: tuple | None = None
+        self._rpc_stop = False
+        try:
+            self._rpc_sock = listen_tcp("0.0.0.0", 0)
+            # advertise a ROUTABLE address: cross-node handles must not
+            # connect to their own localhost (reference: replicas register
+            # node_ip-based addresses, serve/_private/replica.py)
+            self._rpc_addr = (_node_ip(),
+                              self._rpc_sock.getsockname()[1])
+            self._rpc_pool = ThreadPoolExecutor(
+                max_workers=max(1, max_ongoing_requests),
+                thread_name_prefix=f"replica-rpc-{replica_tag}")
+            threading.Thread(target=self._rpc_accept, daemon=True,
+                             name=f"replica-rpc-accept-{replica_tag}").start()
+        except OSError:
+            self._rpc_sock = None  # handles fall back to the actor plane
+        self._push_addr()
+        # out-of-band ongoing-count push: fast-plane requests never appear
+        # in GCS actor task stats, so the autoscaler needs the replica's
+        # own counters (reference: replicas push autoscaling metrics out of
+        # band — serve/_private/replica.py metrics pusher)
+        threading.Thread(target=self._stats_push_loop, daemon=True,
+                         name=f"replica-stats-{replica_tag}").start()
+
+    def _stats_push_loop(self):
+        import time
+
+        controller = None
+        while not self._rpc_stop:
+            time.sleep(0.2)
+            with self._lock:
+                val = self._ongoing + self._pending
+            try:
+                if controller is None:
+                    from ray_tpu.serve.api import _get_controller
+
+                    controller = _get_controller()
+                controller.note_replica_stats.remote(
+                    self.deployment_name, self.replica_tag, val)
+            except Exception:
+                controller = None  # controller restart: re-resolve
+
+    # ------------------------------------------------------- fast data plane
+
+    def _push_addr(self):
+        """Register the RPC address with the controller so routing tables
+        carry it (fire-and-forget; the actor plane works without it)."""
+        if self._rpc_addr is None:
+            return
+        try:
+            from ray_tpu.serve.api import _get_controller
+
+            _get_controller().note_replica_addr.remote(
+                self.deployment_name, self.replica_tag, self._rpc_addr)
+        except Exception:
+            pass
+
+    def rpc_address(self) -> tuple | None:
+        return self._rpc_addr
+
+    def _rpc_accept(self):
+        while not self._rpc_stop:
+            try:
+                raw, _ = self._rpc_sock.accept()
+            except OSError:
+                return
+            raw.setsockopt(__import__("socket").IPPROTO_TCP,
+                           __import__("socket").TCP_NODELAY, 1)
+            conn = MsgConnection(raw)
+            threading.Thread(target=self._rpc_conn_loop, args=(conn,),
+                             daemon=True, name="replica-rpc-conn").start()
+
+    def _rpc_conn_loop(self, conn: MsgConnection):
+        """One recv loop per client connection; execution fans out to the
+        bounded pool so rid-tagged requests pipeline."""
+        try:
+            while not self._rpc_stop:
+                msg = conn.recv()
+                self._rpc_pool.submit(self._rpc_execute, conn, msg)
+        except (ConnectionClosed, OSError):
+            pass
+
+    def _rpc_execute(self, conn: MsgConnection, msg: dict):
+        rid = msg.get("rid")
+        try:
+            result = self.handle_request(
+                msg["method"], tuple(msg.get("args") or ()),
+                msg.get("kwargs") or {}, msg.get("model_id"))
+            reply = {"rid": rid, "ok": True, "error_text": None,
+                     "result": result}
+        except BaseException as e:  # noqa: BLE001 — shipped to the caller
+            reply = {"rid": rid, "ok": False, "error": e,
+                     "error_text": f"{type(e).__name__}: {e}"}
+        try:
+            conn.send(reply)
+            return
+        except (ConnectionClosed, OSError):
+            return  # client gone: nothing to reply to
+        except Exception:  # noqa: BLE001 — frame pickle rejected the payload
+            pass
+        # parity with the actor plane: stdlib pickle (the frame codec)
+        # can't take lambdas/closures that cloudpickle can — retry the
+        # payload through the runtime's serializer before giving up
+        try:
+            from ray_tpu._private import serialization as ser
+
+            if reply.get("ok"):
+                conn.send({"rid": rid, "ok": True,
+                           "result_ser": ser.dumps(reply["result"])})
+            else:
+                conn.send({"rid": rid, "ok": False,
+                           "error_ser": ser.dumps(reply["error"])})
+            return
+        except (ConnectionClosed, OSError):
+            return
+        except Exception:  # noqa: BLE001 — truly unserializable
+            pass
+        # the rid MUST get a reply or the caller waits forever: ship a
+        # plain-string stand-in for whatever refused to serialize
+        try:
+            conn.send({"rid": rid, "ok": False,
+                       "error": TypeError(
+                           "reply not serializable over fast-rpc: "
+                           + (reply.get("error_text")
+                              or type(reply.get("result")).__name__))})
+        except Exception:
+            pass
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        model_id: str | None = None):
+        # cross-plane admission: fast-RPC pool threads and actor-plane
+        # threads share one max_ongoing_requests budget
         with self._lock:
+            self._pending += 1
+        self._admission.acquire()
+        with self._lock:
+            self._pending -= 1
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
@@ -60,13 +227,19 @@ class ReplicaActor:
             _replica_ctx.model_id = None
             with self._lock:
                 self._ongoing -= 1
+            self._admission.release()
 
     def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
                               model_id: str | None = None):
         """Streaming variant: the user method is a generator; each yielded
         item ships incrementally via the runtime's streaming-generator task
-        (reference: serve replicas stream generator chunks back — replica.py)."""
+        (reference: serve replicas stream generator chunks back — replica.py).
+        The admission slot is held for the stream's whole lifetime."""
         with self._lock:
+            self._pending += 1
+        self._admission.acquire()
+        with self._lock:
+            self._pending -= 1
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
@@ -80,6 +253,7 @@ class ReplicaActor:
             _replica_ctx.model_id = None
             with self._lock:
                 self._ongoing -= 1
+            self._admission.release()
 
     def ongoing(self) -> int:
         return self._ongoing
@@ -102,6 +276,12 @@ class ReplicaActor:
         return True
 
     def shutdown(self) -> None:
+        self._rpc_stop = True
+        if getattr(self, "_rpc_sock", None) is not None:
+            try:
+                self._rpc_sock.close()
+            except Exception:
+                pass
         fn = getattr(self.user, "__del__", None)
         if fn is not None:
             try:

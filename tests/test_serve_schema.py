@@ -1,0 +1,172 @@
+"""Declarative Serve config: schema validation, build/deploy round-trip.
+
+(reference test model: serve/tests/test_schema.py + test_cli — schema
+rejection messages and `serve deploy` applying a YAML config.)
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.schema import (SchemaError, ServeDeploySchema, build,
+                                  deploy, load_config)
+
+# a module-level app graph the import_path can name
+noop_dep = serve.deployment(lambda req: {"ok": True})
+noop_app = noop_dep.options(name="noop", num_replicas=2).bind()
+
+
+def echo_builder(args: dict):
+    """App-builder form: callable(args) -> Application."""
+    prefix = args.get("prefix", "")
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            return {"echo": prefix + str((req.get("body") or {}).get("x"))}
+
+    return Echo.bind()
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+GOOD = textwrap.dedent("""
+    applications:
+      - name: app1
+        route_prefix: /app1
+        import_path: tests.test_serve_schema:noop_app
+        deployments:
+          - name: noop
+            num_replicas: 1
+            max_ongoing_requests: 4
+    http_options:
+      host: 127.0.0.1
+      port: 0
+""")
+
+
+def test_load_config_valid():
+    cfg = load_config(GOOD)
+    assert isinstance(cfg, ServeDeploySchema)
+    app = cfg.applications[0]
+    assert app.name == "app1" and app.route_prefix == "/app1"
+    assert app.deployments[0].num_replicas == 1
+
+
+@pytest.mark.parametrize("mutation, match", [
+    ("applications: []", "non-empty 'applications'"),
+    ("applications:\n  - name: a\n    import_path: x", "import_path"),
+    (GOOD.replace("route_prefix: /app1", "route_prefix: app1"),
+     "must start with"),
+    (GOOD.replace("num_replicas: 1", "num_replicas: -2"), "must be >= 0"),
+    (GOOD.replace("num_replicas: 1", "bogus_field: 1"), "unknown field"),
+    (GOOD.replace("port: 0", "port: 0\n  tls: true"), "unknown field"),
+    (GOOD + "    extra: 1", "not valid YAML|unknown field"),
+])
+def test_load_config_rejects(mutation, match):
+    with pytest.raises(SchemaError, match=match):
+        load_config(mutation)
+
+
+def test_autoscaling_and_num_replicas_exclusive():
+    bad = textwrap.dedent("""
+        applications:
+          - name: a
+            import_path: tests.test_serve_schema:noop_app
+            deployments:
+              - name: noop
+                num_replicas: 2
+                autoscaling_config:
+                  min_replicas: 1
+                  max_replicas: 3
+    """)
+    with pytest.raises(SchemaError, match="mutually exclusive"):
+        load_config(bad)
+
+
+def test_duplicate_routes_rejected():
+    bad = textwrap.dedent("""
+        applications:
+          - name: a
+            route_prefix: /x
+            import_path: tests.test_serve_schema:noop_app
+          - name: b
+            route_prefix: /x
+            import_path: tests.test_serve_schema:noop_app
+    """)
+    with pytest.raises(SchemaError, match="duplicate route_prefix"):
+        load_config(bad)
+
+
+def test_override_unknown_deployment_rejected(cluster):
+    bad = GOOD.replace("name: noop", "name: nonexistent")
+    with pytest.raises(SchemaError, match="do not name deployments"):
+        deploy(bad)
+
+
+def test_deploy_applies_config_and_serves(cluster):
+    handles = deploy(GOOD)
+    assert set(handles) == {"app1"}
+    assert handles["app1"].call_sync({}) == {"ok": True}
+    # the deployments override took: 1 replica, not the decorator's 2
+    st = serve.status()
+    assert st["app1_noop"]["target"] == 1, st
+
+
+def test_deploy_app_builder_with_args(cluster):
+    cfg = textwrap.dedent("""
+        applications:
+          - name: echo
+            route_prefix: /echo
+            import_path: tests.test_serve_schema:echo_builder
+            args:
+              prefix: "v:"
+    """)
+    handles = deploy(cfg)
+    out = handles["echo"].call_sync({"body": {"x": 7}})
+    assert out == {"echo": "v:7"}
+
+
+def test_build_round_trips(cluster):
+    cfg_dict = build(noop_app, app_name="rt", route_prefix="/rt",
+                     import_path="tests.test_serve_schema:noop_app")
+    import yaml
+
+    text = yaml.safe_dump(cfg_dict, sort_keys=False)
+    parsed = load_config(text)
+    assert parsed.applications[0].import_path.endswith("noop_app")
+    # built config is directly deployable
+    handles = deploy(text)
+    assert handles["rt"].call_sync({}) == {"ok": True}
+
+
+def test_fast_channel_replica_death_retries(cluster):
+    """Fast-plane fault tolerance: SIGKILL one replica's worker; the next
+    call_sync retries on the survivor instead of failing."""
+    import os
+    import signal
+    import time
+
+    @serve.deployment(num_replicas=2)
+    class P:
+        def __call__(self, req):
+            return os.getpid()
+
+    h = serve.run(P.bind(), name="pids", route_prefix="/pids")
+    pids = {h.call_sync({}) for _ in range(20)}
+    assert len(pids) == 2  # both replicas serving over the fast plane
+    victim = pids.pop()
+    os.kill(victim, signal.SIGKILL)
+    time.sleep(0.3)
+    survivors = {h.call_sync({}, timeout_s=30.0) for _ in range(10)}
+    assert victim not in survivors and survivors
