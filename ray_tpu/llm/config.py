@@ -20,6 +20,17 @@ class ModelLoadingConfig:
 
 
 @dataclass
+class LoraConfig:
+    """(reference: llm/_internal/serve/core/configs/llm_config.py
+    LoraConfig — dynamic_lora_loading_path + max_num_adapters_per_replica;
+    adapters load on demand when a request's `model` names one.)"""
+
+    dynamic_lora_loading_path: str = ""  # dir of <adapter_id>.npz files
+    max_num_adapters_per_replica: int = 4
+    lora_rank: int = 8
+
+
+@dataclass
 class LLMConfig:
     model_loading_config: ModelLoadingConfig = field(default_factory=ModelLoadingConfig)
     # TransformerConfig kwargs for the built-in families (gpt2/llama/mixtral)
@@ -29,6 +40,7 @@ class LLMConfig:
                                                        # tensor_parallel_size, seed
     deployment_config: dict = field(default_factory=dict)  # serve options
     accelerator_type: str | None = "TPU"
+    lora_config: LoraConfig | None = None
 
     def build_model(self):
         """Returns (TransformerConfig, params). Cited families live in

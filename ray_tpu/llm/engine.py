@@ -59,6 +59,9 @@ class _Request:
     # rescanning the history (which is quadratic over a long generation)
     history: list = dataclasses.field(default_factory=list)
     ngram_index: dict | None = None
+    # multi-LoRA: bank index this request decodes with (0 = base model)
+    lora_idx: int = 0
+    lora_released: bool = False
 
 
 _SENTINEL = object()
@@ -159,7 +162,7 @@ class TPUEngine:
                  enable_prefix_cache: bool = False,
                  prefill_chunk: int | None = None,
                  speculative_k: int = 0, ngram_size: int = 2,
-                 mesh=None):
+                 mesh=None, max_loras: int = 0, lora_rank: int = 8):
         self.cfg = cfg
         self.max_len = max_len or cfg.max_seq_len
         if self.max_len > cfg.max_seq_len:
@@ -273,6 +276,32 @@ class TPUEngine:
                     "verify kernel is not implemented)")
             if self.speculative_k < 1 or self.speculative_k > 16:
                 raise ValueError("speculative_k must be in [1, 16]")
+        # multi-LoRA serving (reference capability: LoRA adapters with
+        # dynamic loading on serve multiplexing —
+        # python/ray/llm/_internal/serve/utils/lora_serve_utils.py; here
+        # adapters live in a device bank gathered per row inside the SAME
+        # batched decode step — decoding.init_lora_bank)
+        self.max_loras = int(max_loras)
+        self.lora_rank = int(lora_rank)
+        self.lora_bank = None
+        if self.max_loras:
+            if kv_layout != "slot":
+                raise ValueError(
+                    "max_loras requires kv_layout='slot' (the paged decode "
+                    "kernel has no LoRA gather yet)")
+            if self.speculative_k:
+                raise ValueError(
+                    "max_loras and speculative_k cannot be combined (the "
+                    "verify kernel has no LoRA gather)")
+            self.lora_bank = decoding.init_lora_bank(cfg, self.max_loras,
+                                                     self.lora_rank)
+            self._lora_free = list(range(1, self.max_loras + 1))
+            self._lora_ids: dict[str, int] = {}   # name -> bank index
+            self._lora_refs: dict[int, int] = {}  # index -> live requests
+            self._slot_lora = jnp.zeros((max_slots,), jnp.int32)
+            # serializes bank read-modify-write: concurrent loads from
+            # replica threads must not lose each other's writes
+            self._lora_lock = threading.Lock()
         self.spec_steps = 0
         self.spec_slot_steps = 0   # sum of active slots over verify steps
         self.spec_drafted = 0
@@ -302,6 +331,7 @@ class TPUEngine:
         """Single construction point for server/PD/batch paths."""
         cfg, params = llm_config.build_model()
         ek = dict(llm_config.engine_kwargs)
+        lora_cfg = getattr(llm_config, "lora_config", None)
         return cls(cfg, params,
                    max_slots=ek.get("max_slots", 8),
                    max_len=ek.get("max_len", cfg.max_seq_len),
@@ -315,7 +345,14 @@ class TPUEngine:
                    prefill_chunk=ek.get("prefill_chunk"),
                    speculative_k=ek.get("speculative_k", 0),
                    ngram_size=ek.get("ngram_size", 2),
-                   mesh=ek.get("mesh"))
+                   mesh=ek.get("mesh"),
+                   max_loras=ek.get(
+                       "max_loras",
+                       lora_cfg.max_num_adapters_per_replica
+                       if lora_cfg else 0),
+                   lora_rank=ek.get(
+                       "lora_rank",
+                       lora_cfg.lora_rank if lora_cfg else 8))
 
     def _check_alive(self):
         if self._error is not None:
@@ -323,7 +360,81 @@ class TPUEngine:
         if self._stop:
             raise RuntimeError("engine is shut down")
 
-    def submit(self, token_ids: list, params: SamplingParams | None = None) -> _Request:
+    def load_lora(self, name: str, weights: dict, *,
+                  alpha: float | None = None) -> None:
+        """Load adapter `name` into a free bank slot. `weights` are
+        layer-stacked host arrays {"A_q": [L, E, r], "B_q": [L, r, H, Dh],
+        "A_v": [L, E, r], "B_v": [L, r, Hkv, Dh]} (missing targets stay
+        zero). Scale defaults to alpha/r with alpha=r (i.e. 1.0)."""
+        import numpy as _np
+
+        if self.lora_bank is None:
+            raise ValueError("engine built without max_loras")
+        with self._lora_lock:
+            if name in self._lora_ids:
+                raise ValueError(f"lora {name!r} already loaded")
+            if not self._lora_free:
+                raise RuntimeError(
+                    f"no free lora slots (max_loras={self.max_loras}); "
+                    f"unload one of {sorted(self._lora_ids)}")
+            idx = self._lora_free.pop()
+            bank = self.lora_bank
+            # validate EVERY shape before writing any — a partial write
+            # followed by a raise would leave stale weights in a slot the
+            # free list hands to the next adapter
+            for key in ("A_q", "B_q", "A_v", "B_v"):
+                if key in weights:
+                    want = bank[key].shape[0:1] + bank[key].shape[2:]
+                    if _np.asarray(weights[key]).shape != want:
+                        self._lora_free.append(idx)
+                        raise ValueError(
+                            f"lora {name!r} {key} shape "
+                            f"{_np.asarray(weights[key]).shape} != {want} "
+                            f"(rank {self.lora_rank}, layer-stacked)")
+            for key in ("A_q", "B_q", "A_v", "B_v"):
+                if key in weights:
+                    bank[key] = bank[key].at[:, idx].set(
+                        jnp.asarray(_np.asarray(weights[key]),
+                                    bank[key].dtype))
+            scale = 1.0 if alpha is None else float(alpha) / self.lora_rank
+            bank["scale"] = bank["scale"].at[idx].set(scale)
+            self.lora_bank = bank
+            self._lora_ids[name] = idx
+            self._lora_refs[idx] = 0
+
+    def unload_lora(self, name: str) -> None:
+        """Free `name`'s bank slot. Refuses while requests using it are
+        live (submitted and not yet finished)."""
+        if self.lora_bank is None:
+            raise KeyError(f"lora {name!r} not loaded")
+        with self._lora_lock:
+            if name not in self._lora_ids:
+                raise KeyError(f"lora {name!r} not loaded")
+            idx = self._lora_ids[name]
+            if self._lora_refs.get(idx, 0) > 0:
+                raise RuntimeError(
+                    f"lora {name!r} has {self._lora_refs[idx]} live requests")
+            del self._lora_ids[name]
+            self._lora_refs.pop(idx, None)
+            bank = self.lora_bank
+            for key in ("A_q", "B_q", "A_v", "B_v"):
+                bank[key] = bank[key].at[:, idx].set(0.0)
+            bank["scale"] = bank["scale"].at[idx].set(0.0)
+            self.lora_bank = bank
+            self._lora_free.append(idx)
+
+    def list_loras(self) -> list:
+        return sorted(self._lora_ids) if self.lora_bank is not None else []
+
+    def _lora_release(self, req: _Request) -> None:
+        if req.lora_idx and not req.lora_released:
+            req.lora_released = True
+            with self._lora_lock:
+                self._lora_refs[req.lora_idx] = max(
+                    0, self._lora_refs.get(req.lora_idx, 1) - 1)
+
+    def submit(self, token_ids: list, params: SamplingParams | None = None,
+               *, lora: str | None = None) -> _Request:
         self._check_alive()
         params = params or SamplingParams()
         token_ids = list(token_ids)
@@ -342,8 +453,21 @@ class TPUEngine:
                     f"request needs {need} KV pages but the pool only has "
                     f"{self.num_pages - 1}; raise num_pages or shrink "
                     f"prompt/max_tokens")
+        lora_idx = 0
+        if lora is not None:
+            if self.lora_bank is None:
+                raise ValueError("engine built without max_loras")
+            # resolve + take the reference atomically w.r.t. load/unload —
+            # otherwise an eviction between the check and the increment
+            # could reuse the bank index for a different adapter
+            with self._lora_lock:
+                if lora not in self._lora_ids:
+                    raise KeyError(f"lora {lora!r} not loaded "
+                                   f"(loaded: {sorted(self._lora_ids)})")
+                lora_idx = self._lora_ids[lora]
+                self._lora_refs[lora_idx] += 1
         req = _Request(next(self._rid), token_ids, params,
-                       history=list(token_ids))
+                       history=list(token_ids), lora_idx=lora_idx)
         self._waiting.put(req)
         self._work.set()
         return req
@@ -382,13 +506,15 @@ class TPUEngine:
         self._work.set()
         return req
 
-    def generate(self, token_ids: list, params: SamplingParams | None = None) -> list:
+    def generate(self, token_ids: list, params: SamplingParams | None = None,
+                 *, lora: str | None = None) -> list:
         """Blocking: returns the generated token ids."""
-        return list(self.stream(token_ids, params))
+        return list(self.stream(token_ids, params, lora=lora))
 
-    def stream(self, token_ids: list, params: SamplingParams | None = None):
+    def stream(self, token_ids: list, params: SamplingParams | None = None,
+               *, lora: str | None = None):
         """Yields token ids as they are produced."""
-        req = self.submit(token_ids, params)
+        req = self.submit(token_ids, params, lora=lora)
         yield from _iter_request(req)
 
     def shutdown(self):
@@ -401,16 +527,21 @@ class TPUEngine:
         """Unblock every waiting caller: end-of-stream, or the failure."""
         marker = _EngineError(error) if error is not None else _SENTINEL
         for req in list(self._by_slot.values()):
+            self._lora_release(req)
             req.out_queue.put(marker)
         for req in self._backlog:
+            self._lora_release(req)
             req.out_queue.put(marker)
         self._backlog.clear()
         for req in self._prefilling:
+            self._lora_release(req)
             req.out_queue.put(marker)
         self._prefilling.clear()
         while True:
             try:
-                self._waiting.get_nowait().out_queue.put(marker)
+                r = self._waiting.get_nowait()
+                self._lora_release(r)
+                r.out_queue.put(marker)
             except queue.Empty:
                 break
 
@@ -554,6 +685,8 @@ class TPUEngine:
                 self.state, slot, kv, jnp.int32(length),
                 jnp.asarray(first_token, jnp.int32), self.cfg)
         self._set_row_sampling(slot, req.params)
+        if self.lora_bank is not None:
+            self._slot_lora = self._slot_lora.at[slot].set(req.lora_idx)
         self._by_slot[slot] = req
         return True
 
@@ -577,6 +710,7 @@ class TPUEngine:
                 if req.generated >= req.params.max_tokens:
                     # budget already spent by the transferred first token
                     self._free.append(slot)
+                    self._lora_release(req)
                     req.out_queue.put(_SENTINEL)
                     continue
                 # PD path: KV arrived from a prefill server over the host plane
@@ -612,8 +746,13 @@ class TPUEngine:
                     return
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = req.tokens
-            logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
-                                          jnp.int32(n), self.cfg)
+            if self.lora_bank is not None:
+                logits, kv = decoding.prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(n), self.cfg,
+                    self.lora_bank, jnp.int32(req.lora_idx))
+            else:
+                logits, kv = decoding.prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(n), self.cfg)
             self.key, sub = jax.random.split(self.key)
             first = decoding.sample(logits[None, :], sub,
                                     req.params.temperature, req.params.top_k)
@@ -867,6 +1006,9 @@ class TPUEngine:
                     self._release_shared(req.slot)
             else:
                 self.state = decoding.release_slot(self.state, req.slot)
+            if self.lora_bank is not None:
+                self._slot_lora = self._slot_lora.at[req.slot].set(0)
+            self._lora_release(req)
             self._free.append(req.slot)
             del self._by_slot[req.slot]
             req.out_queue.put(_SENTINEL)
@@ -899,6 +1041,10 @@ class TPUEngine:
             if self.kv_layout == "paged":
                 self.state, logits = self._dp.decode_step_paged(
                     self.params, self.state, self.cfg)
+            elif self.lora_bank is not None:
+                self.state, logits = decoding.decode_step(
+                    self.params, self.state, self.cfg,
+                    self.lora_bank, self._slot_lora)
             else:
                 self.state, logits = decoding.decode_step(
                     self.params, self.state, self.cfg)

@@ -8,12 +8,88 @@ them. Same layering here over the TPU engine.)
 
 from __future__ import annotations
 
+import os
 import time
 
 from ray_tpu import serve
 from ray_tpu.llm.config import LLMConfig
 from ray_tpu.llm.engine import SamplingParams, TPUEngine
 from ray_tpu.llm.tokenizer import load_tokenizer
+
+
+class _AdapterHandle:
+    """The multiplex cache entry for a loaded adapter: eviction from the
+    LRU calls __del__, which frees the engine's bank slot (unless requests
+    are mid-flight — then the slot frees on the next load's eviction pass).
+    ensure() re-loads the adapter if the engine-side eviction pass freed
+    its bank slot while this cache entry stayed live."""
+
+    def __init__(self, engine: TPUEngine, loading_path: str,
+                 adapter_id: str):
+        self.engine = engine
+        self.loading_path = loading_path
+        self.adapter_id = adapter_id
+        self._evicted = False
+
+    def ensure(self) -> None:
+        if self.adapter_id not in self.engine.list_loras():
+            _load_weights(self.engine, self.loading_path, self.adapter_id)
+
+    def __del__(self):
+        # multiplex eviction calls __del__ explicitly AND the interpreter
+        # calls it again at GC time — without the guard the second call
+        # could unload an adapter that was RELOADED after eviction
+        if self._evicted:
+            return
+        self._evicted = True
+        try:
+            self.engine.unload_lora(self.adapter_id)
+        except Exception:
+            pass  # in use or already gone: next load's eviction retries
+
+
+def _load_weights(engine: TPUEngine, loading_path: str,
+                  adapter_id: str) -> None:
+    """Read <loading_path>/<adapter_id>.npz (A_q/B_q/A_v/B_v layer-stacked,
+    optional scalar alpha) into the engine bank, evicting an idle adapter
+    if the bank is full (reference: lora_serve_utils.py downloads adapter
+    weights by model id and hands them to the engine)."""
+    import numpy as np
+
+    path = os.path.join(loading_path, f"{adapter_id}.npz")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no adapter {adapter_id!r} under {loading_path!r}")
+    z = np.load(path)
+    weights = {k: z[k] for k in ("A_q", "B_q", "A_v", "B_v") if k in z.files}
+    alpha = float(z["alpha"]) if "alpha" in z.files else None
+    try:
+        engine.load_lora(adapter_id, weights, alpha=alpha)
+    except ValueError as e:
+        if "already loaded" in str(e):
+            return  # a concurrent ensure() won the race: done
+        raise
+    except RuntimeError:
+        # bank full: evict an idle adapter (multiplex eviction may have
+        # been unable to free it while requests were live)
+        for name in engine.list_loras():
+            try:
+                engine.unload_lora(name)
+                break
+            except RuntimeError:
+                continue  # live requests: try the next one
+        try:
+            engine.load_lora(adapter_id, weights, alpha=alpha)
+        except ValueError as e:
+            if "already loaded" not in str(e):
+                raise
+
+
+def _load_adapter_into_engine(engine: TPUEngine, loading_path: str,
+                              adapter_id: str) -> _AdapterHandle:
+    if adapter_id not in engine.list_loras():
+        _load_weights(engine, loading_path, adapter_id)
+    return _AdapterHandle(engine, loading_path, adapter_id)
 
 
 @serve.deployment(max_ongoing_requests=16)
@@ -25,6 +101,31 @@ class LLMServer:
         self.config = llm_config
         self.engine = TPUEngine.from_config(llm_config)
         self.tokenizer = load_tokenizer(llm_config.model_loading_config.tokenizer)
+        self._get_adapter = None
+        lc = getattr(llm_config, "lora_config", None)
+        if lc is not None:
+            from ray_tpu.serve.multiplex import multiplexed
+
+            engine, path = self.engine, lc.dynamic_lora_loading_path
+
+            @multiplexed(
+                max_num_models_per_replica=lc.max_num_adapters_per_replica)
+            def _get(adapter_id: str):
+                return _load_adapter_into_engine(engine, path, adapter_id)
+
+            self._get_adapter = _get
+
+    def _maybe_lora(self, body: dict) -> str | None:
+        """A request whose `model` names something other than the base
+        model is a LoRA adapter request (reference: serve LLM treats
+        model_id as the multiplexed adapter id — lora_serve_utils.py)."""
+        model = body.get("model")
+        if (self._get_adapter is None or not model
+                or model == self.config.model_loading_config.model_id):
+            return None
+        handle = self._get_adapter(model)  # load or LRU-refresh (mux cache)
+        handle.ensure()  # heal a cache hit whose bank slot was evicted
+        return model
 
     def _params(self, body: dict) -> SamplingParams:
         eos = getattr(self.tokenizer, "eos_token_id", None)
@@ -38,12 +139,21 @@ class LLMServer:
     def completions(self, body: dict) -> dict:
         prompt = body.get("prompt", "")
         t0 = time.monotonic()
+        lora = self._maybe_lora(body)
         ids = self.tokenizer.encode(prompt)
-        out_ids = self.engine.generate(ids, self._params(body))
+        try:
+            out_ids = self.engine.generate(ids, self._params(body), lora=lora)
+        except KeyError:
+            if lora is None:
+                raise
+            # evicted between ensure() and submit under adapter churn:
+            # reload once and retry
+            self._get_adapter(lora).ensure()
+            out_ids = self.engine.generate(ids, self._params(body), lora=lora)
         dt = time.monotonic() - t0
         return {
             "object": "text_completion",
-            "model": self.config.model_loading_config.model_id,
+            "model": lora or self.config.model_loading_config.model_id,
             "choices": [{"index": 0, "text": self.tokenizer.decode(out_ids),
                          "finish_reason": "stop"}],
             "usage": {"prompt_tokens": len(ids),
@@ -70,9 +180,19 @@ class LLMServer:
         (reference: llm serve streams engine tokens through the replica —
         llm_server.py + proxy streaming)."""
         prompt = body.get("prompt", "")
-        model = self.config.model_loading_config.model_id
+        lora = self._maybe_lora(body)
+        model = lora or self.config.model_loading_config.model_id
         ids = self.tokenizer.encode(prompt)
-        for tok in self.engine.stream(ids, self._params(body)):
+        try:
+            req = self.engine.submit(ids, self._params(body), lora=lora)
+        except KeyError:
+            if lora is None:
+                raise
+            self._get_adapter(lora).ensure()  # evicted mid-churn: reload
+            req = self.engine.submit(ids, self._params(body), lora=lora)
+        from ray_tpu.llm.engine import _iter_request
+
+        for tok in _iter_request(req):
             yield {
                 "object": "text_completion.chunk",
                 "model": model,

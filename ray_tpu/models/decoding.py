@@ -47,11 +47,56 @@ def _rope(cfg):
     return None, None
 
 
-def _attn_qkv(x, p, cfg):
+def init_lora_bank(cfg: TransformerConfig, num_adapters: int,
+                   rank: int) -> dict:
+    """Device-resident multi-LoRA bank for batched per-slot adapters
+    (reference capability: multi-LoRA serving —
+    python/ray/llm/_internal/serve/utils/lora_serve_utils.py loads adapters
+    onto vLLM's punica kernels; here the bank is plain stacked tensors the
+    jitted forward gathers per row — S-LoRA-style, XLA does the batching).
+
+    Adapter slot 0 is the NULL adapter and stays all-zero: a row with
+    index 0 computes base + 0, bit-identical to the base model. Banks are
+    LAYER-major ([L, N+1, ...]) so lax.scan consumes them directly.
+    Targets q and v projections (the standard LoRA target set)."""
+    L, E = cfg.n_layers, cfg.d_model
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    N = num_adapters + 1
+    return {
+        "A_q": jnp.zeros((L, N, E, rank), cfg.dtype),
+        "B_q": jnp.zeros((L, N, rank, H, Dh), cfg.dtype),
+        "A_v": jnp.zeros((L, N, E, rank), cfg.dtype),
+        "B_v": jnp.zeros((L, N, rank, Hkv, Dh), cfg.dtype),
+        "scale": jnp.zeros((N,), jnp.float32),
+    }
+
+
+def _attn_qkv(x, p, cfg, lora_l=None, lora_idx=None, lora_scale=None):
+    """QKV projections; when a LoRA layer-slice is given, adds the per-row
+    low-rank q/v deltas. `lora_idx` is [B] (per decode row) or a scalar
+    (single-sequence prefill); `lora_scale` the matching alpha/r gather."""
     dt = cfg.dtype
     q = jnp.einsum("bte,ehd->bthd", x, p["wq"].astype(dt))
     k = jnp.einsum("bte,ehd->bthd", x, p["wk"].astype(dt))
     v = jnp.einsum("bte,ehd->bthd", x, p["wv"].astype(dt))
+    if lora_l is not None:
+        aq, bq, av, bv = lora_l
+        if lora_idx.ndim == 0:  # one sequence: scalar gather
+            dq = jnp.einsum("bte,er->btr", x, aq[lora_idx].astype(dt))
+            dq = jnp.einsum("btr,rhd->bthd", dq, bq[lora_idx].astype(dt))
+            dv = jnp.einsum("bte,er->btr", x, av[lora_idx].astype(dt))
+            dv = jnp.einsum("btr,rhd->bthd", dv, bv[lora_idx].astype(dt))
+            s = lora_scale.astype(dt)
+            q = q + dq * s
+            v = v + dv * s
+        else:  # per-row adapters: batched gather + matmul
+            dq = jnp.einsum("bte,ber->btr", x, aq[lora_idx].astype(dt))
+            dq = jnp.einsum("btr,brhd->bthd", dq, bq[lora_idx].astype(dt))
+            dv = jnp.einsum("bte,ber->btr", x, av[lora_idx].astype(dt))
+            dv = jnp.einsum("btr,brhd->bthd", dv, bv[lora_idx].astype(dt))
+            s = lora_scale.astype(dt)[:, None, None, None]
+            q = q + dq * s
+            v = v + dv * s
     if cfg.bias:
         q = q + p["bq"].astype(dt)
         k = k + p["bk"].astype(dt)
@@ -67,10 +112,13 @@ def _mlp_block(normed, layer_p, cfg):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def prefill(params, tokens, length, cfg: TransformerConfig):
+def prefill(params, tokens, length, cfg: TransformerConfig,
+            lora_bank=None, lora_idx=None):
     """Run one prompt [1, T] (T = bucket size, padded; true length `length`).
 
     Returns (logits_at_last [V], kv {k,v: [L, T, Hkv, Dh]}).
+    With `lora_bank` + scalar `lora_idx`, applies that adapter's q/v
+    deltas (init_lora_bank; idx 0 = null adapter = exact base model).
     """
     dt = cfg.dtype
     B, T = tokens.shape
@@ -78,10 +126,17 @@ def prefill(params, tokens, length, cfg: TransformerConfig):
     if cfg.pos == "learned":
         x = x + params["pos_embed"][:T].astype(dt)
     cos, sin = _rope(cfg)
+    lscale = None if lora_bank is None else lora_bank["scale"][lora_idx]
 
-    def block(h, layer_p):
+    def block(h, layer_in):
+        if lora_bank is None:
+            layer_p, lora_l = layer_in, None
+        else:
+            layer_p, aq, bq, av, bv = layer_in
+            lora_l = (aq, bq, av, bv)
         normed = _norm(h, layer_p["norm1"], cfg)
-        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg, lora_l, lora_idx,
+                            lscale)
         if cfg.pos == "rope":
             q = ops.apply_rope(q, cos, sin)
             k = ops.apply_rope(k, cos, sin)
@@ -93,7 +148,10 @@ def prefill(params, tokens, length, cfg: TransformerConfig):
         h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
         return h, (k[0], v[0])
 
-    x, kv = jax.lax.scan(block, x, params["layers"])
+    xs = (params["layers"] if lora_bank is None
+          else (params["layers"], lora_bank["A_q"], lora_bank["B_q"],
+                lora_bank["A_v"], lora_bank["B_v"]))
+    x, kv = jax.lax.scan(block, x, xs)
     x = _norm(x, params["final_norm"], cfg)
     last = x[0, length - 1]
     if cfg.tie_embeddings:
@@ -120,8 +178,11 @@ def insert_sequence(state, slot, kv, length, first_token, cfg: TransformerConfig
 
 
 @functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
-def decode_step(params, state, cfg: TransformerConfig):
-    """Advance every active row one token. Returns (state, logits [slots, V])."""
+def decode_step(params, state, cfg: TransformerConfig,
+                lora_bank=None, slot_lora=None):
+    """Advance every active row one token. Returns (state, logits [slots, V]).
+    With `lora_bank` + `slot_lora` [B], each row adds its own adapter's
+    q/v deltas in the SAME batched step (idx 0 = null = base model)."""
     dt = cfg.dtype
     S = state["k"].shape[2]
     B = state["length"].shape[0]
@@ -131,12 +192,19 @@ def decode_step(params, state, cfg: TransformerConfig):
     if cfg.pos == "learned":
         x = x + params["pos_embed"].astype(dt)[pos][:, None]
     cos, sin = _rope(cfg)
+    lscale = None if lora_bank is None else lora_bank["scale"][slot_lora]
 
     def block(carry, layer_in):
         h, = carry
-        layer_p, k_cache, v_cache = layer_in                   # caches [B, S, Hkv, Dh]
+        if lora_bank is None:
+            layer_p, k_cache, v_cache = layer_in               # caches [B, S, Hkv, Dh]
+            lora_l = None
+        else:
+            layer_p, k_cache, v_cache, aq, bq, av, bv = layer_in
+            lora_l = (aq, bq, av, bv)
         normed = _norm(h, layer_p["norm1"], cfg)
-        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)      # [B, 1, H, Dh]
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg, lora_l, slot_lora,
+                            lscale)                            # [B, 1, H, Dh]
         if cfg.pos == "rope":
             q = ops.apply_rope(q, cos, sin, positions=pos[:, None])
             k = ops.apply_rope(k, cos, sin, positions=pos[:, None])
@@ -160,8 +228,11 @@ def decode_step(params, state, cfg: TransformerConfig):
         h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
         return (h,), (k_cache, v_cache)
 
-    (x,), (k_new, v_new) = jax.lax.scan(
-        block, (x,), (params["layers"], state["k"], state["v"]))
+    xs = ((params["layers"], state["k"], state["v"]) if lora_bank is None
+          else (params["layers"], state["k"], state["v"],
+                lora_bank["A_q"], lora_bank["B_q"],
+                lora_bank["A_v"], lora_bank["B_v"]))
+    (x,), (k_new, v_new) = jax.lax.scan(block, (x,), xs)
     x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_embeddings:
         logits = x[:, 0] @ params["embed"].astype(dt).T

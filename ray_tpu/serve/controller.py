@@ -217,7 +217,10 @@ class ServeController:
             st = self.deployments.get(full_name)
             if st is None or tag not in st.replicas:
                 return  # already dropped (or never known): ignore
-            st.addrs[tag] = tuple(addr)
+            addr = tuple(addr)
+            if st.addrs.get(tag) == addr:
+                return  # periodic re-advertisement: no change, no version bump
+            st.addrs[tag] = addr
             self.version += 1
 
     def note_replica_stats(self, full_name: str, tag: str,

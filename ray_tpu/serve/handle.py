@@ -15,6 +15,7 @@ import time
 import weakref
 
 import ray_tpu
+from ray_tpu._private.protocol import ConnectionClosed
 from ray_tpu.actor import ActorHandle
 
 ROUTING_REFRESH_S = 1.0
@@ -108,11 +109,30 @@ class _FastChannel:
         try:
             self._conn.send({"rid": rid, "method": method, "args": args,
                              "kwargs": kwargs, "model_id": model_id})
-        except Exception as e:
+        except (ConnectionClosed, ConnectionError, OSError) as e:
             with self._lock:
                 self._waiters.pop(rid, None)
             self.dead = True
             raise _channel_dead_error() from e
+        except Exception:  # noqa: BLE001 — frame codec rejected the args
+            # serialization failure, NOT transport death: retry through
+            # cloudpickle (parity with the actor plane, which serializes
+            # lambdas/closures fine) without poisoning the channel
+            from ray_tpu._private import serialization as ser
+
+            try:
+                self._conn.send({"rid": rid, "method": method,
+                                 "args_ser": ser.dumps((args, kwargs)),
+                                 "model_id": model_id})
+            except (ConnectionClosed, ConnectionError, OSError) as e:
+                with self._lock:
+                    self._waiters.pop(rid, None)
+                self.dead = True
+                raise _channel_dead_error() from e
+            except Exception:
+                with self._lock:
+                    self._waiters.pop(rid, None)
+                raise  # truly unserializable: surface to the caller as-is
         if self.dead:
             # the recv loop may have died (and drained waiters) between our
             # registration and now — make sure this waiter can't hang
@@ -359,7 +379,11 @@ class DeploymentHandle:
                 except TimeoutError as e:
                     last = e
                     continue  # deadline loop exits when budget is spent
-                except (ActorDiedError, OSError) as e:
+                except ActorDiedError as e:
+                    # transport failures surface ONLY as ActorDiedError
+                    # (submit/recv wrap socket errors) — a user exception
+                    # that happens to subclass OSError must NOT be read
+                    # as replica death and drop a healthy replica
                     last = e
                     self._router.drop(replica_id)
                     continue

@@ -116,6 +116,16 @@ class ReplicaActor:
                     controller = _get_controller()
                 controller.note_replica_stats.remote(
                     self.deployment_name, self.replica_tag, val)
+                # re-advertise the fast-RPC address every tick: the
+                # one-shot __init__ push can be lost (controller restart,
+                # transient failure), which would silently demote this
+                # replica to the slow actor plane forever. The controller
+                # only bumps the table version when the address CHANGES,
+                # so the steady state is free.
+                if self._rpc_addr is not None:
+                    controller.note_replica_addr.remote(
+                        self.deployment_name, self.replica_tag,
+                        self._rpc_addr)
             except Exception:
                 controller = None  # controller restart: re-resolve
 
@@ -162,9 +172,15 @@ class ReplicaActor:
     def _rpc_execute(self, conn: MsgConnection, msg: dict):
         rid = msg.get("rid")
         try:
+            if "args_ser" in msg:  # client's cloudpickle fallback lane
+                from ray_tpu._private import serialization as ser
+
+                args, kwargs = ser.loads(msg["args_ser"])
+            else:
+                args, kwargs = tuple(msg.get("args") or ()), \
+                    msg.get("kwargs") or {}
             result = self.handle_request(
-                msg["method"], tuple(msg.get("args") or ()),
-                msg.get("kwargs") or {}, msg.get("model_id"))
+                msg["method"], args, kwargs, msg.get("model_id"))
             reply = {"rid": rid, "ok": True, "error_text": None,
                      "result": result}
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
