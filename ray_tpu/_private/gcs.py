@@ -2215,6 +2215,13 @@ class GcsServer:
             lin["recons_used"] = prev_lin.get("recons_used", 0)
         self.lineage[spec["task_id"]] = lin
         evicted: list[str] = []
+        # deep-queue fast path: if the last walk found every candidate still
+        # queued/running (a 1M-task queue keeps the oldest lineage pinned),
+        # repeating the walk on each of the next million submits is O(K)
+        # futile probes per submit. The verdict only changes when a task
+        # completes, so stay stalled until _on_task_done clears the flag.
+        if getattr(self, "_lineage_evict_stalled", False):
+            return evicted
         if len(self.lineage) > MAX_LINEAGE:
             # evict oldest-first, but never a task that is still
             # queued/running — dropping one would free its pinned
@@ -2234,6 +2241,8 @@ class GcsServer:
                         or self.pending_tasks.is_queued(tid)):
                     continue
                 evicted.extend(self._drop_lineage_locked(tid))
+            if not evicted and len(self.lineage) > MAX_LINEAGE:
+                self._lineage_evict_stalled = True
         return evicted
 
     # ----------------------------------------------------------------- tasks
@@ -2351,9 +2360,15 @@ class GcsServer:
                          or self.max_workers - n_alive - spawning_now > 0)
 
             dispatched_any = False
+            # why the most recent dispatch() returned False: "deps" (spec-
+            # specific — a later spec in the same shard may still run) vs
+            # "capacity" (no fitting node / no matching idle worker — for a
+            # uniform shard this verdict covers every other spec too)
+            fail_reason = ""
 
             def dispatch(spec) -> bool:
-                nonlocal dispatched_any
+                nonlocal dispatched_any, fail_reason
+                fail_reason = "capacity"
                 lang = spec.get("lang", "py")
                 need = accelerators.chips_required(spec.get("resources", {}))
                 rh = spec.get("renv_hash", "")
@@ -2365,6 +2380,7 @@ class GcsServer:
                     # pointless). Prefer a worker that registered the
                     # function by name.
                     if not self._deps_ready(spec):
+                        fail_reason = "deps"
                         return False
                     fname = spec.get("func_name")
                     cands = [x for pool in idle_by_node.values()
@@ -2384,7 +2400,10 @@ class GcsServer:
                     pool = idle_by_node.get(node_id, [])
                 else:
                     node_id = self._fits_for(spec)
-                    if node_id is None or not self._deps_ready(spec):
+                    if node_id is None:
+                        return False
+                    if not self._deps_ready(spec):
+                        fail_reason = "deps"
                         return False
                     # whole-chip TPU specs need a worker spawned with
                     # exactly that many chips visible; CPU specs need a
@@ -2395,6 +2414,7 @@ class GcsServer:
                               and x.renv_hash == rh and x.language == lang),
                              None)
                 if w is None:
+                    fail_reason = "capacity_demand"  # spawn demand registered
                     want_spawn[(node_id, need, rh)] += 1
                     return False
                 pool.remove(w)
@@ -2435,10 +2455,18 @@ class GcsServer:
                         return True
                     return idle_left > 0 and misses < K_IDLE
 
-                def scan(queue: collections.deque, skip=None) -> None:
+                def scan(queue: collections.deque, skip=None,
+                         uniform: bool = False) -> str:
+                    """Dispatch from `queue`; returns the fail_reason it
+                    stopped on for a UNIFORM queue's capacity miss (every
+                    remaining spec shares the failing spec's resource shape,
+                    so one miss is a verdict for the whole shard — the
+                    caller then registers bulk spawn demand instead of
+                    probing spec by spec), else ""."""
                     nonlocal idle_left
                     still = collections.deque()
                     misses = 0
+                    cap_stop = ""
                     while queue and keep_scanning(misses):
                         spec = queue.popleft()
                         if skip is not None and skip(spec):
@@ -2449,10 +2477,14 @@ class GcsServer:
                         else:
                             still.append(spec)
                             misses += 1
-                    if still and queue and idle_left > 0:
+                            if uniform and fail_reason.startswith("capacity"):
+                                cap_stop = fail_reason
+                                break
+                    if still and queue and idle_left > 0 and not cap_stop:
                         queue.extend(still)  # rotate: different specs next event
                     else:
                         queue.extendleft(reversed(still))
+                    return cap_stop
 
                 # actor creations first (they pin workers)
                 def _dead_actor(spec):
@@ -2470,11 +2502,20 @@ class GcsServer:
                     res = dq[0].get("resources") or {}
                     rh, lang = key[1], key[2]
                     need = accelerators.chips_required(res)
+                    probe_registered = 0
                     if any(len(x.tpu_chips) == need and x.renv_hash == rh
                            and x.language == lang
                            for pool in idle_by_node.values() for x in pool):
-                        scan(dq)
-                        continue
+                        stop = scan(dq, uniform=True)
+                        if not stop:
+                            continue
+                        # capacity-stopped mid-scan: the idle workers are
+                        # consumed/mismatched, so fall through to bulk
+                        # demand registration exactly as if none had matched.
+                        # The probing dispatch may itself have registered +1
+                        # for the spec now back at the queue head — don't
+                        # count it twice below.
+                        probe_registered = 1 if stop == "capacity_demand" else 0
                     if lang != "py":
                         continue  # cross-language workers self-join: no spawn
                     # no matching idle worker anywhere: nothing in this
@@ -2488,7 +2529,8 @@ class GcsServer:
                     if node_id is not None:
                         runnable = sum(1 for s in itertools.islice(dq, 64)
                                        if self._deps_ready(s))
-                        if runnable:
+                        runnable -= probe_registered
+                        if runnable > 0:
                             want_spawn[(node_id, need, rh)] += runnable
 
             # warm-pool floor: replenish idle no-env CPU workers consumed
@@ -2701,6 +2743,9 @@ class GcsServer:
     def _on_task_done(self, msg: dict):
         wid = msg["wid"]
         with self.lock:
+            # a completion can unpin the oldest lineage entries — re-arm the
+            # bounded eviction walk (see _retain_lineage_locked)
+            self._lineage_evict_stalled = False
             w = self.workers.get(wid)
             spec = msg["spec"]
             # prefer the GCS-side spec: it carries the _paid accounting tag the
@@ -3179,6 +3224,10 @@ class GcsServer:
             if w is None or w.dead:
                 return
             w.dead = True
+            # tasks failed here terminate WITHOUT a task_done message, which
+            # can unpin lineage entries just like a completion — re-arm the
+            # bounded eviction walk (see _retain_lineage_locked)
+            self._lineage_evict_stalled = False
             # reclaim the process's outstanding ref contributions: a SIGKILL
             # (or a secondary driver disconnecting) must not pin objects its
             # flushed +1s were holding (reference: reference_counter borrower
