@@ -136,6 +136,11 @@ class RayConfig:
     enable_timeline: bool = True
     # Max buffered task events per process before oldest are dropped.
     task_events_max: int = 10000
+    # Propagate trace context (trace/span ids) inside task/actor specs
+    # across process boundaries and emit spans on the task-event channel
+    # (reference: python/ray/util/tracing/tracing_helper.py:165
+    # _DictPropagator injecting the OTel span context into every spec).
+    enable_tracing: bool = False
     # Metrics report period from workers/agents to the GCS.
     metrics_report_interval_s: float = 2.0
 

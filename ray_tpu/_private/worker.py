@@ -60,6 +60,15 @@ def _serialize_capturing(fn, *args):
         _reduce_capture.refs = prev
 
 
+def _trace_field() -> dict:
+    """``{"trace_ctx": ...}`` for an outgoing spec when a trace is active
+    in this task/thread, else ``{}`` (tracing off or no open trace)."""
+    from ray_tpu.util import tracing
+
+    ctx = tracing.inject()
+    return {"trace_ctx": ctx} if ctx else {}
+
+
 class ObjectRef:
     """Handle to a (possibly pending) remote object. Refcounted: creating one
     registers a local reference, GC drops it; when a process's last local
@@ -719,6 +728,7 @@ class CoreWorker:
             "name": name,
             "strategy": strategy,
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
+            **_trace_field(),
             **spec_part,
         }
         # typed-spec validation at the submission boundary (reference:
@@ -1115,6 +1125,7 @@ class CoreWorker:
                 (concurrency_groups or {}).values()),
             "concurrency_groups": concurrency_groups or {},
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
+            **_trace_field(),
             **spec_part,
         }
         from ray_tpu._private.task_spec import validate_actor
@@ -1147,6 +1158,7 @@ class CoreWorker:
             "deps": deps,
             "num_returns": num_returns,
             "resources": {},
+            **_trace_field(),
             **spec_part,
         }
         if num_returns == "streaming":
@@ -1598,6 +1610,13 @@ class CoreWorker:
         _dev_map: dict = {}  # oid → tensor ids contained in THAT result
         self._task_ctx.task_id = spec["task_id"]
         _t_exec0 = time.time()
+        # trace propagation: the spec's injected context becomes the parent
+        # of this task's span, and the span is current while user code runs
+        # so nested .remote() calls chain under it (reference:
+        # tracing_helper.py:165 _DictPropagator extract-before-execute)
+        from ray_tpu.util import tracing as _tracing
+
+        _tspan = _tracing.begin_task_span(spec.get("trace_ctx"))
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
@@ -1704,6 +1723,9 @@ class CoreWorker:
                 ]
         finally:
             self._task_ctx.task_id = None
+            _tracing.end_task_span(
+                _tspan, name=spec.get("name") or spec.get("method") or kind,
+                task_id=spec["task_id"], kind=kind, ok=error_blob is None)
             # drop arg-value caches this task materialized unless user code
             # in this process also holds refs to them
             for dep in spec.get("deps", ()):
