@@ -63,6 +63,11 @@ class _Request:
     lora_idx: int = 0
     lora_released: bool = False
 
+    def __iter__(self):
+        """Yield generated tokens as they are produced (public surface for
+        callers holding a submit() result — no private imports needed)."""
+        return _iter_request(self)
+
 
 _SENTINEL = object()
 
@@ -378,7 +383,10 @@ class TPUEngine:
                     f"no free lora slots (max_loras={self.max_loras}); "
                     f"unload one of {sorted(self._lora_ids)}")
             idx = self._lora_free.pop()
-            bank = self.lora_bank
+            # shallow copy: writes below bind new arrays to the COPY, so a
+            # mid-write failure (device OOM) leaves self.lora_bank the old,
+            # fully-consistent bank — no partially-written slot
+            bank = dict(self.lora_bank)
             # validate EVERY shape before writing any — a partial write
             # followed by a raise would leave stale weights in a slot the
             # free list hands to the next adapter
@@ -391,13 +399,21 @@ class TPUEngine:
                             f"lora {name!r} {key} shape "
                             f"{_np.asarray(weights[key]).shape} != {want} "
                             f"(rank {self.lora_rank}, layer-stacked)")
-            for key in ("A_q", "B_q", "A_v", "B_v"):
-                if key in weights:
-                    bank[key] = bank[key].at[:, idx].set(
-                        jnp.asarray(_np.asarray(weights[key]),
-                                    bank[key].dtype))
-            scale = 1.0 if alpha is None else float(alpha) / self.lora_rank
-            bank["scale"] = bank["scale"].at[idx].set(scale)
+            try:
+                for key in ("A_q", "B_q", "A_v", "B_v"):
+                    if key in weights:
+                        bank[key] = bank[key].at[:, idx].set(
+                            jnp.asarray(_np.asarray(weights[key]),
+                                        bank[key].dtype))
+                scale = 1.0 if alpha is None else float(alpha) / self.lora_rank
+                bank["scale"] = bank["scale"].at[idx].set(scale)
+            except Exception:
+                # device-side failure mid-write (e.g. HBM OOM): the slot must
+                # go back on the free list or max_loras shrinks by one per
+                # failure. The partial writes only touched the copy, so the
+                # engine keeps decoding with the old consistent bank.
+                self._lora_free.append(idx)
+                raise
             self.lora_bank = bank
             self._lora_ids[name] = idx
             self._lora_refs[idx] = 0
@@ -414,13 +430,15 @@ class TPUEngine:
             if self._lora_refs.get(idx, 0) > 0:
                 raise RuntimeError(
                     f"lora {name!r} has {self._lora_refs[idx]} live requests")
-            del self._lora_ids[name]
-            self._lora_refs.pop(idx, None)
-            bank = self.lora_bank
+            # zero into a copy first: if a device write fails midway the
+            # registry is untouched (same discipline as load_lora)
+            bank = dict(self.lora_bank)
             for key in ("A_q", "B_q", "A_v", "B_v"):
                 bank[key] = bank[key].at[:, idx].set(0.0)
             bank["scale"] = bank["scale"].at[idx].set(0.0)
             self.lora_bank = bank
+            del self._lora_ids[name]
+            self._lora_refs.pop(idx, None)
             self._lora_free.append(idx)
 
     def list_loras(self) -> list:

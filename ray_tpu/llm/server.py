@@ -78,6 +78,10 @@ def _load_weights(engine: TPUEngine, loading_path: str,
                 break
             except RuntimeError:
                 continue  # live requests: try the next one
+            except KeyError:
+                # a concurrent thread unloaded it between list and unload —
+                # that freed a slot, which is all this loop is after
+                break
         try:
             engine.load_lora(adapter_id, weights, alpha=alpha)
         except ValueError as e:
@@ -136,20 +140,25 @@ class LLMServer:
             stop_token_ids=(eos,) if eos is not None else (),
         )
 
+    def _submit_retry(self, ids: list, params, lora: str | None):
+        """Submit with one evicted-adapter reload retry: multiplex churn can
+        evict the adapter between ensure() and submit. One shared path for
+        blocking and streaming completions; returns the engine request
+        (iterable over generated tokens)."""
+        try:
+            return self.engine.submit(ids, params, lora=lora)
+        except KeyError:
+            if lora is None:
+                raise
+            self._get_adapter(lora).ensure()
+            return self.engine.submit(ids, params, lora=lora)
+
     def completions(self, body: dict) -> dict:
         prompt = body.get("prompt", "")
         t0 = time.monotonic()
         lora = self._maybe_lora(body)
         ids = self.tokenizer.encode(prompt)
-        try:
-            out_ids = self.engine.generate(ids, self._params(body), lora=lora)
-        except KeyError:
-            if lora is None:
-                raise
-            # evicted between ensure() and submit under adapter churn:
-            # reload once and retry
-            self._get_adapter(lora).ensure()
-            out_ids = self.engine.generate(ids, self._params(body), lora=lora)
+        out_ids = list(self._submit_retry(ids, self._params(body), lora))
         dt = time.monotonic() - t0
         return {
             "object": "text_completion",
@@ -183,16 +192,8 @@ class LLMServer:
         lora = self._maybe_lora(body)
         model = lora or self.config.model_loading_config.model_id
         ids = self.tokenizer.encode(prompt)
-        try:
-            req = self.engine.submit(ids, self._params(body), lora=lora)
-        except KeyError:
-            if lora is None:
-                raise
-            self._get_adapter(lora).ensure()  # evicted mid-churn: reload
-            req = self.engine.submit(ids, self._params(body), lora=lora)
-        from ray_tpu.llm.engine import _iter_request
-
-        for tok in _iter_request(req):
+        req = self._submit_retry(ids, self._params(body), lora)
+        for tok in req:
             yield {
                 "object": "text_completion.chunk",
                 "model": model,

@@ -105,8 +105,10 @@ class ReplicaActor:
         import time
 
         controller = None
+        tick = 0
         while not self._rpc_stop:
             time.sleep(0.2)
+            tick += 1
             with self._lock:
                 val = self._ongoing + self._pending
             try:
@@ -116,13 +118,15 @@ class ReplicaActor:
                     controller = _get_controller()
                 controller.note_replica_stats.remote(
                     self.deployment_name, self.replica_tag, val)
-                # re-advertise the fast-RPC address every tick: the
-                # one-shot __init__ push can be lost (controller restart,
-                # transient failure), which would silently demote this
-                # replica to the slow actor plane forever. The controller
-                # only bumps the table version when the address CHANGES,
-                # so the steady state is free.
-                if self._rpc_addr is not None:
+                # re-advertise the fast-RPC address periodically (not every
+                # tick — that would double the controller's per-replica
+                # message rate): the one-shot __init__ push can be lost
+                # (controller restart, transient failure), which would
+                # silently demote this replica to the slow actor plane
+                # forever. ~5s of demotion is an acceptable healing window;
+                # the controller only bumps the table version on CHANGE, so
+                # the steady state stays free.
+                if self._rpc_addr is not None and tick % 25 == 1:
                     controller.note_replica_addr.remote(
                         self.deployment_name, self.replica_tag,
                         self._rpc_addr)
