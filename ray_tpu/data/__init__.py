@@ -19,19 +19,23 @@ from ray_tpu.data.dataset import (
     from_pandas,
     range,
     read_arrow,
+    read_audio,
     read_avro,
     read_binary_files,
     read_csv,
     read_datasource,
     read_delta,
+    read_hudi,
     read_iceberg,
     read_images,
     read_json,
+    read_lance,
     read_numpy,
     read_parquet,
     read_sql,
     read_text,
     read_tfrecords,
+    read_videos,
     read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
@@ -52,19 +56,23 @@ __all__ = [
     "from_pandas",
     "range",
     "read_arrow",
+    "read_audio",
     "read_avro",
     "read_binary_files",
     "read_csv",
     "read_datasource",
     "read_delta",
+    "read_hudi",
     "read_iceberg",
     "read_images",
     "read_json",
+    "read_lance",
     "read_numpy",
     "read_parquet",
     "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_videos",
     "read_webdataset",
     "from_torch",
 ]

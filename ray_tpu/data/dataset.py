@@ -707,6 +707,53 @@ def read_arrow(paths, *, parallelism: int = -1) -> Dataset:
     return Dataset(L.Read(ArrowDatasource(paths), parallelism))
 
 
+def read_audio(paths, *, parallelism: int = -1) -> Dataset:
+    """Audio files → {"amplitude": (C, N) float32, "sample_rate", "path"}
+    rows (reference: read_api.py read_audio — soundfile there; WAV/AIFF/AU
+    decode dependency-free here)."""
+    from ray_tpu.data.datasource import AudioDatasource
+
+    return Dataset(L.Read(AudioDatasource(paths), parallelism))
+
+
+def read_videos(paths, *, include_timestamps: bool = False,
+                frame_step: int = 1, parallelism: int = -1) -> Dataset:
+    """Video files → one row per frame: {"frame": HWC uint8 RGB,
+    "frame_index", "path"} (reference: read_api.py read_videos — decord
+    there; OpenCV here)."""
+    from ray_tpu.data.datasource import VideoDatasource
+
+    return Dataset(L.Read(VideoDatasource(
+        paths, include_timestamps=include_timestamps,
+        frame_step=frame_step), parallelism))
+
+
+def read_hudi(table_uri: str, *, columns=None, filter=None,
+              as_of: str | None = None, parallelism: int = -1) -> Dataset:
+    """Apache Hudi copy-on-write snapshot read: `.hoodie` commit timeline
+    → latest base parquet per file group, columns/filter pushed into the
+    parquet scans; `as_of` time-travels to an instant (reference:
+    read_api.py read_hudi)."""
+    from ray_tpu.data.datasource import HudiDatasource
+
+    return Dataset(L.Read(HudiDatasource(
+        table_uri, columns=columns, filters=_parse_filter_arg(filter),
+        as_of=as_of), parallelism))
+
+
+def read_lance(uri: str, *, columns=None, filter: str | None = None,
+               scanner_options: dict | None = None,
+               parallelism: int = -1) -> Dataset:
+    """Lance dataset, one read task per fragment (reference: read_api.py
+    read_lance:4044). Requires the `lance` package (import-gated, absent
+    from this image)."""
+    from ray_tpu.data.datasource import LanceDatasource
+
+    return Dataset(L.Read(LanceDatasource(
+        uri, columns=columns, filter=filter,
+        scanner_options=scanner_options), parallelism))
+
+
 def _parse_filter_arg(filter):
     if isinstance(filter, str):
         from ray_tpu.data.expressions import parse_filter

@@ -28,7 +28,7 @@ from typing import Any
 import numpy as np
 
 from ray_tpu.data.block import Block, BlockAccessor, normalize_block
-from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.datasource import Datasource, ReadTask, round_robin
 
 # ------------------------------------------------------------------- delta
 
@@ -118,14 +118,8 @@ class DeltaDatasource(Datasource):
         if not self.adds:
             return []
         cast = _partition_caster(self.meta)
-        groups: list[list[dict]] = [
-            [] for _ in range(max(1, min(parallelism, len(self.adds))))]
-        for i, a in enumerate(self.adds):
-            groups[i % len(groups)].append(a)
         tasks = []
-        for grp in groups:
-            if not grp:
-                continue
+        for grp in round_robin(self.adds, parallelism):
 
             def fn(grp=grp, table=self.table, columns=self.columns,
                    filters=self.filters, cast=cast):
@@ -361,14 +355,8 @@ class IcebergDatasource(Datasource):
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
         if not self.files:
             return []
-        groups: list[list[dict]] = [
-            [] for _ in range(max(1, min(parallelism, len(self.files))))]
-        for i, f in enumerate(self.files):
-            groups[i % len(groups)].append(f)
         tasks = []
-        for grp in groups:
-            if not grp:
-                continue
+        for grp in round_robin(self.files, parallelism):
 
             def fn(grp=grp, columns=self.columns, filters=self.filters):
                 import pyarrow.parquet as pq

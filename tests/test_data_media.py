@@ -1,0 +1,209 @@
+"""Audio / video / Hudi / Lance datasources.
+
+(reference: data/_internal/datasource/{audio,video,hudi,lance}_datasource.py
+— soundfile/decord/hudi-python/pylance there; this image decodes WAV/AIFF/AU
+via the stdlib, video via OpenCV, and Hudi's copy-on-write protocol
+directly. Row shapes match the reference: audio rows carry
+{"amplitude": (C, N) float32, "sample_rate"}, video rows one frame each
+with {"frame": HWC uint8, "frame_index"}.)
+"""
+
+import json
+import math
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import ray_tpu.data as rdata
+
+
+def _sine(sr, seconds, hz, channels=1):
+    t = np.arange(int(sr * seconds)) / sr
+    x = np.sin(2 * math.pi * hz * t)
+    return np.stack([x * (c + 1) / channels for c in range(channels)])
+
+
+def _write_wav(path, amp, sr, width=2):
+    inter = np.ascontiguousarray(amp.T)
+    if width == 2:
+        pcm = (np.clip(inter, -1, 1) * 32767).astype("<i2").tobytes()
+    elif width == 1:
+        pcm = ((np.clip(inter, -1, 1) * 127) + 128).astype(np.uint8).tobytes()
+    else:
+        raise ValueError(width)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(amp.shape[0])
+        w.setsampwidth(width)
+        w.setframerate(sr)
+        w.writeframes(pcm)
+
+
+def test_read_audio_wav_stereo(tmp_path):
+    sr = 8000
+    amp = _sine(sr, 0.05, 440.0, channels=2)
+    p = str(tmp_path / "tone.wav")
+    _write_wav(p, amp, sr)
+    ds = rdata.read_audio(p)
+    rows = ds.take_all()
+    assert len(rows) == 1
+    got = rows[0]["amplitude"]
+    assert got.shape == (2, amp.shape[1])
+    assert got.dtype == np.float32
+    assert rows[0]["sample_rate"] == sr
+    # int16 quantization: within 1/32767 of the original
+    assert np.abs(got - amp).max() < 2e-4
+
+
+def test_read_audio_8bit_and_aiff(tmp_path):
+    sr = 4000
+    amp = _sine(sr, 0.03, 200.0)
+    w8 = str(tmp_path / "eight.wav")
+    _write_wav(w8, amp, sr, width=1)
+    rows = rdata.read_audio(w8).take_all()
+    assert np.abs(rows[0]["amplitude"] - amp).max() < 2e-2  # 8-bit quant
+
+    import aifc
+
+    pa = str(tmp_path / "tone.aiff")
+    pcm = (np.clip(amp.T, -1, 1) * 32767).astype(">i2").tobytes()
+    with aifc.open(pa, "wb") as a:
+        a.setnchannels(1)
+        a.setsampwidth(2)
+        a.setframerate(sr)
+        a.writeframes(pcm)
+    rows = rdata.read_audio(pa).take_all()
+    assert rows[0]["sample_rate"] == sr
+    assert np.abs(rows[0]["amplitude"] - amp).max() < 2e-4
+
+    # 8-bit AIFF is SIGNED pcm (unlike WAV): silence must decode to ~0,
+    # not a -1.0 DC offset
+    p8 = str(tmp_path / "quiet.aiff")
+    with aifc.open(p8, "wb") as a:
+        a.setnchannels(1)
+        a.setsampwidth(1)
+        a.setframerate(sr)
+        a.writeframes(b"\x00" * 64)
+    rows = rdata.read_audio(p8).take_all()
+    assert np.abs(rows[0]["amplitude"]).max() == 0.0
+
+
+def test_read_videos(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    p = str(tmp_path / "clip.mp4")
+    h, w, n = 32, 48, 12
+    vw = cv2.VideoWriter(p, cv2.VideoWriter_fourcc(*"mp4v"), 10.0, (w, h))
+    assert vw.isOpened()
+    for i in range(n):
+        frame = np.full((h, w, 3), i * 20 % 256, np.uint8)
+        vw.write(frame)
+    vw.release()
+
+    rows = rdata.read_videos(p).take_all()
+    assert len(rows) == n
+    assert rows[0]["frame"].shape == (h, w, 3)
+    assert [r["frame_index"] for r in rows] == list(range(n))
+    # frames are distinguishable and ordered (codec is lossy: wide margin)
+    m0, m5 = rows[0]["frame"].mean(), rows[5]["frame"].mean()
+    assert abs(m0 - 0) < 15 and abs(m5 - 100) < 15
+
+    sampled = rdata.read_videos(p, frame_step=4, include_timestamps=True)
+    srows = sampled.take_all()
+    assert [r["frame_index"] for r in srows] == [0, 4, 8]
+    assert "frame_timestamp" in srows[0]
+
+    # long clips stream out as multiple bounded blocks, not one big stack
+    from ray_tpu.data.datasource import VideoDatasource
+
+    blocks = VideoDatasource([p], frames_per_block=5).read_file(p)
+    assert [len(b["frame_index"]) for b in blocks] == [5, 5, 2]
+    assert list(blocks[2]["frame_index"]) == [10, 11]
+
+
+def _hudi_commit(root, ts, writes):
+    """writes: list of (fileId, relpath)."""
+    stats = [{"fileId": fid, "path": rel} for fid, rel in writes]
+    meta = {"partitionToWriteStats": {"": stats}}
+    os.makedirs(os.path.join(root, ".hoodie"), exist_ok=True)
+    with open(os.path.join(root, ".hoodie", f"{ts}.commit"), "w") as f:
+        json.dump(meta, f)
+
+
+def _write_parquet(path, rows):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(pa.table(rows), path)
+
+
+def test_read_hudi_snapshot_and_time_travel(tmp_path):
+    root = str(tmp_path / "tbl")
+    # commit 1: two file groups
+    _write_parquet(os.path.join(root, "fg1_v1.parquet"),
+                   {"id": [1, 2], "v": [10, 20]})
+    _write_parquet(os.path.join(root, "fg2_v1.parquet"),
+                   {"id": [3, 4], "v": [30, 40]})
+    _hudi_commit(root, "001", [("fg1", "fg1_v1.parquet"),
+                               ("fg2", "fg2_v1.parquet")])
+    # commit 2: rewrites file group 1 (upsert), fg2 untouched
+    _write_parquet(os.path.join(root, "fg1_v2.parquet"),
+                   {"id": [1, 2], "v": [11, 21]})
+    _hudi_commit(root, "002", [("fg1", "fg1_v2.parquet")])
+    # an inflight commit must be ignored
+    open(os.path.join(root, ".hoodie", "003.commit.inflight"), "w").close()
+
+    rows = sorted(rdata.read_hudi(root).take_all(), key=lambda r: r["id"])
+    assert [(r["id"], r["v"]) for r in rows] == [
+        (1, 11), (2, 21), (3, 30), (4, 40)]
+
+    # time travel to instant 001: pre-upsert values
+    old = sorted(rdata.read_hudi(root, as_of="001").take_all(),
+                 key=lambda r: r["id"])
+    assert [(r["id"], r["v"]) for r in old] == [
+        (1, 10), (2, 20), (3, 30), (4, 40)]
+
+    # projection + predicate pushdown reach the parquet layer
+    proj = rdata.read_hudi(root, columns=["v"], filter=[("v", ">", 25)])
+    got = sorted(r["v"] for r in proj.take_all())
+    assert got == [30, 40]
+    assert all(set(r) == {"v"} for r in proj.take_all())
+
+
+def test_read_hudi_replacecommit_drops_replaced_groups(tmp_path):
+    root = str(tmp_path / "tbl")
+    _write_parquet(os.path.join(root, "fg1.parquet"), {"id": [1], "v": [10]})
+    _write_parquet(os.path.join(root, "fg2.parquet"), {"id": [2], "v": [20]})
+    _hudi_commit(root, "001", [("fg1", "fg1.parquet"),
+                               ("fg2", "fg2.parquet")])
+    # clustering: fg1+fg2 rewritten into fg3; replaced groups must leave
+    # the snapshot or every row reads twice
+    _write_parquet(os.path.join(root, "fg3.parquet"),
+                   {"id": [1, 2], "v": [10, 20]})
+    meta = {"partitionToWriteStats": {"": [{"fileId": "fg3",
+                                            "path": "fg3.parquet"}]},
+            "partitionToReplaceFileIds": {"": ["fg1", "fg2"]}}
+    with open(os.path.join(root, ".hoodie", "002.replacecommit"), "w") as f:
+        json.dump(meta, f)
+
+    rows = sorted(rdata.read_hudi(root).take_all(), key=lambda r: r["id"])
+    assert [(r["id"], r["v"]) for r in rows] == [(1, 10), (2, 20)]
+
+
+def test_read_hudi_not_a_table(tmp_path):
+    with pytest.raises(FileNotFoundError, match="hoodie"):
+        rdata.read_hudi(str(tmp_path / "nope")).take_all()
+
+
+def test_read_lance_gated():
+    # pylance is absent from this image: the connector must fail with a
+    # clear import error at construction (reference: _check_import), not
+    # deep inside a read task
+    try:
+        import lance  # noqa: F401
+        pytest.skip("lance installed: gate not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="lance"):
+        rdata.read_lance("/tmp/whatever")
