@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import os
 import queue as _queue
 import threading
 import time
@@ -347,6 +348,15 @@ class GcsServer:
         # series so restarts/re-reports replace instead of double-count
         # (reference: metrics agent aggregation, _private/metrics_agent.py:628)
         self.metrics: dict[str, dict] = {}
+        # retained metric TIME SERIES, head-side (reference: the dashboard's
+        # metrics stack — per-node agents scraped into Prometheus,
+        # dashboard/modules/metrics/metrics_head.py; here the GCS keeps a
+        # bounded in-memory window so the UI graphs history with no
+        # external TSDB): per-node samples appended on each resource_view
+        # delta, cluster samples on each health-loop tick
+        self.node_history: dict[str, collections.deque] = {}
+        self.cluster_history: collections.deque = collections.deque(
+            maxlen=720)
         # general long-poll pubsub: channel → list of (conn, rid) pollers and
         # buffered per-subscriber queues (reference: src/ray/pubsub/publisher.h:159)
         self.pubsub_queues: dict[tuple[str, str], collections.deque] = {}
@@ -422,6 +432,7 @@ class GcsServer:
         while not self.stopped:
             time.sleep(period)
             now = time.monotonic()
+            self._sample_histories()
             # expire parked relay waiters (stack dumps / tensor exports) so
             # a worker wedged in native code can't hang the requester forever
             with self.lock:
@@ -456,6 +467,39 @@ class GcsServer:
                     info["conn"].send({"type": "ping"})
                 except (ConnectionClosed, Exception):
                     self._remove_host(hid)
+
+    def _sample_histories(self):
+        """One retained-history tick: cluster-level gauges plus the head
+        host's own resource view (followers report theirs via ray_syncer
+        deltas; without this the head node would have no series at all)."""
+        from ray_tpu._private.memory_monitor import host_memory_usage
+
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        try:
+            mem = host_memory_usage()
+        except Exception:
+            mem = 0.0
+        ts = time.time()
+        with self.lock:
+            live_workers = sum(1 for w in self.workers.values()
+                               if w.kind == "worker" and not w.dead)
+            self.cluster_history.append({
+                "ts": ts,
+                "pending_tasks": len(self.pending_tasks),
+                "live_actors": sum(1 for a in self.actors.values()
+                                   if a.state == "alive"),
+                "live_workers": live_workers,
+                "placement_groups": len(self.pgs),
+                "objects": len(self.objects),
+            })
+            hist = self.node_history.setdefault(
+                HEAD_HOST, collections.deque(maxlen=720))
+            hist.append({"ts": ts, "mem_usage": round(mem, 4),
+                         "load1": round(load1, 2),
+                         "num_worker_procs": live_workers})
 
     def start(self):
         self._restore_from_storage()
@@ -794,6 +838,14 @@ class GcsServer:
                         "num_worker_procs": msg.get("num_worker_procs"),
                         "ts": time.monotonic(),
                     }
+                    hist = self.node_history.setdefault(
+                        msg.get("host_id"),
+                        collections.deque(maxlen=720))
+                    hist.append({"ts": time.time(),
+                                 "mem_usage": msg.get("mem_usage"),
+                                 "load1": msg.get("load1"),
+                                 "num_worker_procs":
+                                     msg.get("num_worker_procs")})
             return wid
         if t == "pong":
             with self.lock:
@@ -1361,6 +1413,18 @@ class GcsServer:
                                     "description": m.get("description", ""),
                                     "series": {}})
                     rec["series"][source] = m["series"]
+        elif t == "metrics_history":
+            # retained time series for the dashboard's graphs: per-node
+            # resource views + cluster-level gauges (reference capability:
+            # dashboard metrics tab backed by Prometheus range queries)
+            with self.lock:
+                limit = int(msg.get("limit", 0)) or None
+                nodes = {hid: list(dq)[-limit:] if limit else list(dq)
+                         for hid, dq in self.node_history.items()}
+                cluster = (list(self.cluster_history)[-limit:] if limit
+                           else list(self.cluster_history))
+            conn.send({"rid": msg["rid"], "nodes": nodes,
+                       "cluster": cluster})
         elif t == "metrics_snapshot":
             with self.lock:
                 snap = {name: {"kind": r["kind"],
@@ -1379,6 +1443,17 @@ class GcsServer:
                     "kind": "gauge", "description": "live shm bytes per host",
                     "series": {"gcs": [[[["host", h]], float(v)]
                                        for h, v in self.host_shm_bytes.items()]}}
+                snap["ray_tpu_live_workers"] = {
+                    "kind": "gauge", "description": "live worker processes",
+                    "series": {"gcs": [[[], float(sum(
+                        1 for w in self.workers.values()
+                        if w.kind == "worker" and not w.dead))]]}}
+                snap["ray_tpu_node_mem_usage"] = {
+                    "kind": "gauge",
+                    "description": "host memory usage fraction per node",
+                    "series": {"gcs": [
+                        [[["host", hid]], float(s[-1]["mem_usage"] or 0.0)]
+                        for hid, s in self.node_history.items() if s]}}
                 for k, v in self.task_counter.items():
                     snap.setdefault("ray_tpu_tasks_total", {
                         "kind": "counter",
@@ -3135,6 +3210,9 @@ class GcsServer:
             if host_id not in self.hosts or host_id == HEAD_HOST:
                 return
             self.hosts.pop(host_id, None)
+            # a departed host's retained series must go with it, or the
+            # metrics tab / node_mem_usage gauge serves dead nodes forever
+            self.node_history.pop(host_id, None)
             doomed_nodes = [n for n, h in self.node_hosts.items() if h == host_id]
             # drop the host from every object's location set + accounting
             for entry in self.objects.values():

@@ -1,0 +1,205 @@
+"""Grafana dashboard + Prometheus provisioning factory.
+
+Reference capability:
+python/ray/dashboard/modules/metrics/grafana_dashboard_factory.py — panel
+configs rendered into Grafana dashboard JSON, written next to provisioning
+YAML so `docker run grafana` (or an operator) picks everything up with zero
+clicks (metrics_head.py writes the same artifacts on dashboard startup).
+
+Here: panels target the metric names this framework's ``/metrics``
+Prometheus endpoint actually exports (util/metrics.py to_prometheus +
+the GCS's built-in ``ray_tpu_*`` gauges), laid out on Grafana's 24-column
+grid, two panels per row. ``provision(out_dir)`` writes:
+
+    grafana/dashboards/ray_tpu_core.json
+    grafana/dashboards/ray_tpu_serve.json
+    grafana/dashboards/ray_tpu_data.json
+    grafana/provisioning/dashboards/ray_tpu.yml
+    grafana/provisioning/datasources/ray_tpu.yml
+    prometheus/prometheus.yml
+
+CLI: ``ray_tpu grafana --out DIR`` (scripts/cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+PANEL_WIDTH = 12   # 24-column grid, two panels per row
+PANEL_HEIGHT = 8
+
+
+@dataclass
+class Panel:
+    title: str
+    unit: str
+    targets: list  # list of (promql_expr, legend)
+    description: str = ""
+    stack: bool = False
+
+
+@dataclass
+class DashboardConfig:
+    name: str
+    uid: str
+    panels: list = field(default_factory=list)
+
+
+CORE_DASHBOARD = DashboardConfig(
+    name="ray_tpu core",
+    uid="raytpucore",
+    panels=[
+        Panel("Pending tasks", "short",
+              [("ray_tpu_pending_tasks", "queued")],
+              "tasks queued in the GCS scheduler"),
+        Panel("Live actors", "short",
+              [("ray_tpu_live_actors", "alive")]),
+        Panel("Object store bytes", "bytes",
+              [("ray_tpu_object_store_bytes", "{{host}}")],
+              "live shm bytes per host", stack=True),
+        Panel("Worker processes", "short",
+              [("ray_tpu_live_workers", "workers")]),
+        Panel("Task throughput", "ops",
+              [('rate(ray_tpu_tasks_total{state="finished"}[1m])',
+                "finished/s")]),
+        Panel("Node memory usage", "percentunit",
+              [("ray_tpu_node_mem_usage", "{{host}}")]),
+    ])
+
+SERVE_DASHBOARD = DashboardConfig(
+    name="ray_tpu serve",
+    uid="raytpuserve",
+    panels=[
+        Panel("Requests per second", "reqps",
+              [("rate(serve_requests_total[1m])", "{{deployment}}")],
+              stack=True),
+        Panel("Request latency p50/p95", "ms",
+              [("histogram_quantile(0.5, rate(serve_request_latency_ms_bucket[5m]))", "p50"),
+               ("histogram_quantile(0.95, rate(serve_request_latency_ms_bucket[5m]))", "p95")]),
+        Panel("Requests by replica", "reqps",
+              [("rate(serve_requests_total[1m])", "{{replica}}")],
+              stack=True),
+        Panel("Latency mean", "ms",
+              [("rate(serve_request_latency_ms_sum[5m]) / "
+                "rate(serve_request_latency_ms_count[5m])", "mean")]),
+    ])
+
+DATA_DASHBOARD = DashboardConfig(
+    name="ray_tpu data",
+    uid="raytpudata",
+    panels=[
+        Panel("Bytes in flight", "bytes",
+              [("data_bytes_in_flight", "{{pipeline}}")], stack=True),
+        Panel("Items queued", "short",
+              [("data_blocks_queued", "{{pipeline}}")], stack=True),
+        Panel("Backpressure deferrals", "ops",
+              [("rate(data_backpressure_waits[1m])", "{{pipeline}}")]),
+        Panel("Tasks finished (cluster)", "ops",
+              [('rate(ray_tpu_tasks_total{state="finished"}[1m])',
+                "finished/s")]),
+    ])
+
+
+def _panel_json(p: Panel, panel_id: int, x: int, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "title": p.title,
+        "description": p.description,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "raytpuprom"},
+        "gridPos": {"h": PANEL_HEIGHT, "w": PANEL_WIDTH, "x": x, "y": y},
+        "fieldConfig": {
+            "defaults": {
+                "unit": p.unit,
+                "custom": {"stacking": {"mode": "normal" if p.stack
+                                        else "none"}},
+            },
+            "overrides": [],
+        },
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(p.targets)
+        ],
+    }
+
+
+def generate_dashboard(cfg: DashboardConfig) -> str:
+    """One Grafana dashboard JSON document (import-ready: wrapped the way
+    provisioning file providers expect)."""
+    panels = []
+    for i, p in enumerate(cfg.panels):
+        x = (i % 2) * PANEL_WIDTH
+        y = (i // 2) * PANEL_HEIGHT
+        panels.append(_panel_json(p, i + 1, x, y))
+    return json.dumps({
+        "uid": cfg.uid,
+        "title": cfg.name,
+        "tags": ["ray_tpu"],
+        "timezone": "browser",
+        "refresh": "5s",
+        "time": {"from": "now-30m", "to": "now"},
+        "schemaVersion": 39,
+        "panels": panels,
+        "templating": {"list": []},
+    }, indent=2)
+
+
+_DASHBOARD_PROVIDER = """\
+apiVersion: 1
+providers:
+  - name: ray_tpu
+    folder: ray_tpu
+    type: file
+    options:
+      path: /var/lib/grafana/dashboards
+"""
+
+_DATASOURCE = """\
+apiVersion: 1
+datasources:
+  - name: ray_tpu_prometheus
+    uid: raytpuprom
+    type: prometheus
+    access: proxy
+    url: http://{prometheus_host}
+    isDefault: true
+"""
+
+_PROMETHEUS = """\
+global:
+  scrape_interval: 5s
+scrape_configs:
+  - job_name: ray_tpu
+    metrics_path: /metrics
+    static_configs:
+      - targets: ['{dashboard_host}']
+"""
+
+
+def provision(out_dir: str, *, dashboard_host: str = "127.0.0.1:8265",
+              prometheus_host: str = "127.0.0.1:9090") -> list[str]:
+    """Write every provisioning artifact under ``out_dir``; returns the
+    written paths. Idempotent — safe to re-run on upgrade."""
+    written = []
+
+    def w(rel: str, content: str) -> None:
+        path = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+        written.append(path)
+
+    for cfg, fname in ((CORE_DASHBOARD, "ray_tpu_core.json"),
+                       (SERVE_DASHBOARD, "ray_tpu_serve.json"),
+                       (DATA_DASHBOARD, "ray_tpu_data.json")):
+        w(os.path.join("grafana", "dashboards", fname),
+          generate_dashboard(cfg))
+    w(os.path.join("grafana", "provisioning", "dashboards", "ray_tpu.yml"),
+      _DASHBOARD_PROVIDER)
+    w(os.path.join("grafana", "provisioning", "datasources", "ray_tpu.yml"),
+      _DATASOURCE.format(prometheus_host=prometheus_host))
+    w(os.path.join("prometheus", "prometheus.yml"),
+      _PROMETHEUS.format(dashboard_host=dashboard_host))
+    return written

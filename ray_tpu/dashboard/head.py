@@ -139,6 +139,42 @@ class _Handler(BaseHTTPRequestHandler):
                 if tail:
                     data = b"\n".join(data.splitlines()[-tail:])
                 self._send(data, "text/plain")
+            elif path == "/api/metrics/history":
+                limit = int(q.get("limit", [0])[0] or 0)
+                resp = gcs.rpc({"type": "metrics_history", "limit": limit})
+                self._json({"nodes": resp.get("nodes", {}),
+                            "cluster": resp.get("cluster", [])})
+            elif path == "/api/profile":
+                # profile-from-UI: trigger the existing in-worker sampling
+                # profiler and return its flat report (reference capability:
+                # dashboard/modules/reporter — py-spy from the UI)
+                wid = (q.get("wid", [""])[0] or "").strip()
+                if not wid:
+                    self._json({"error": "missing ?wid="}, 400)
+                    return
+                duration = min(float(q.get("duration", [5])[0] or 5), 60.0)
+                # a profile blocks for its whole duration: use a dedicated
+                # connection so the shared _Gcs lock (and with it every
+                # other dashboard endpoint + /metrics scrape) isn't held
+                # hostage for up to 60s
+                own = _Gcs(gcs.session_dir)
+                try:
+                    reply = own.rpc({"type": "worker_profile", "wid": wid,
+                                     "duration_s": duration,
+                                     "hz": float(q.get("hz", [50])[0] or 50)})
+                finally:
+                    try:
+                        if own._conn is not None:
+                            own._conn.close()
+                    except Exception:
+                        pass
+                if not reply.get("ok", False):
+                    self._json({"error": reply.get("error", "profile failed")},
+                               503)
+                    return
+                self._json({"wid": wid, "duration_s": duration,
+                            "profile": reply.get("stacks")
+                            or reply.get("profile", "")})
             elif path == "/metrics":
                 from ray_tpu.util.metrics import to_prometheus
 

@@ -782,6 +782,30 @@ class _ActorPool:
                 pass
 
 
+_pipeline_metric_cache: tuple | None = None
+
+
+def _pipeline_metrics() -> tuple:
+    """Process-wide executor gauges/counters (one registration per process;
+    concurrent executors share them, distinguished by a pipeline tag)."""
+    global _pipeline_metric_cache
+    if _pipeline_metric_cache is None:
+        from ray_tpu.util import metrics as _met
+
+        _pipeline_metric_cache = (
+            _met.Gauge("data_bytes_in_flight",
+                       "queued bytes across executor stages",
+                       tag_keys=("pipeline",)),
+            _met.Gauge("data_blocks_queued",
+                       "queued items across executor stages",
+                       tag_keys=("pipeline",)),
+            _met.Counter("data_backpressure_waits",
+                         "dispatches deferred by queue/byte backpressure",
+                         tag_keys=("pipeline",)),
+        )
+    return _pipeline_metric_cache
+
+
 class StreamingExecutor:
     """Pull-based streaming executor: yields lists of blocks as they finish.
 
@@ -907,11 +931,28 @@ class StreamingExecutor:
             except Exception:
                 return 0
 
+        # pipeline observability on the cluster metrics plane (reference:
+        # Data's dashboard metrics tab — operator bytes/queue gauges);
+        # process-wide gauges tagged per pipeline, updated at the same
+        # sites that maintain the byte accounting
+        m_bytes, m_blocks, m_bp = _pipeline_metrics()
+        pipeline_tag = {"pipeline": f"exec-{id(self) & 0xffff:04x}"}
+        bp_blocked = [False] * (len(rest) + 1)  # per-queue deferral state
+
+        def _note_queues() -> None:
+            try:
+                m_bytes.set(float(sum(qbytes)), pipeline_tag)
+                m_blocks.set(float(sum(len(dq) for dq in queues)),
+                             pipeline_tag)
+            except Exception:
+                pass
+
         def _q_add(j: int, item) -> None:
             n = _nbytes(item)
             size_of[_skey(item)] = n
             qbytes[j] += n
             queues[j].append(item)
+            _note_queues()
 
         def _q_pop(j: int):
             # min-tag-first: dispatching the oldest pending work bounds how
@@ -924,6 +965,7 @@ class StreamingExecutor:
                             key=lambda p: seq_of.get(_skey(p[1]), 1 << 60))
             del queues[j][idx]
             qbytes[j] -= size_of.pop(_skey(item), 0)
+            _note_queues()
             return item
 
         def _q_clear(j: int) -> None:
@@ -951,8 +993,20 @@ class StreamingExecutor:
             # keeps the out-of-order horizon small in practice.
             if j == len(queues) - 1:
                 return True
-            return (len(queues[j]) < self.max_queued
+            room = (len(queues[j]) < self.max_queued
                     and qbytes[j] < self.max_queued_bytes)
+            # edge-triggered: count DEFERRAL EPISODES, not poll frequency —
+            # the pump loop re-probes a full queue every tick, which would
+            # otherwise inflate the counter at spin rate
+            if not room and not bp_blocked[j]:
+                bp_blocked[j] = True
+                try:
+                    m_bp.inc(tags=pipeline_tag)
+                except Exception:
+                    pass
+            elif room:
+                bp_blocked[j] = False
+            return room
 
         def is_barrier(s: Stage) -> bool:
             return s.all_to_all is not None or s.a2a_refs is not None
@@ -1073,6 +1127,7 @@ class StreamingExecutor:
                 del queues[last][idx]
                 qbytes[last] -= size_of.pop(_skey(head), 0)
                 seq_of.pop(_skey(head), None)
+                _note_queues()
                 yield head
 
         idle_spin = 0.0
@@ -1101,6 +1156,14 @@ class StreamingExecutor:
                 time.sleep(min(0.05, 0.001 + idle_spin))
                 idle_spin = min(0.05, idle_spin + 0.002)
         finally:
+            # this pipeline's gauges must read 0 once it stops (normal end,
+            # consumer abandonment, or error) — a stale "in flight" value
+            # would outlive the executor on /metrics forever
+            try:
+                m_bytes.set(0.0, pipeline_tag)
+                m_blocks.set(0.0, pipeline_tag)
+            except Exception:
+                pass
             for pool in actor_pools:
                 pool.shutdown()
 

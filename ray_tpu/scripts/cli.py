@@ -293,6 +293,18 @@ def cmd_dashboard(args):
         head.stop()
 
 
+def cmd_grafana(args):
+    """Write Grafana dashboard JSON + provisioning YAML + a Prometheus
+    scrape config (reference capability: the dashboard's
+    grafana_dashboard_factory + metrics_head artifact generation)."""
+    from ray_tpu.dashboard.grafana import provision
+
+    written = provision(args.out, dashboard_host=args.dashboard_host,
+                        prometheus_host=args.prometheus_host)
+    for p in written:
+        print(p)
+
+
 def cmd_client_proxy(args):
     """Serve Ray-Client-style proxied connections (util/client/proxier)."""
     import time as _time
@@ -451,6 +463,16 @@ def main(argv=None):
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("grafana",
+                        help="write Grafana/Prometheus provisioning artifacts")
+    sp.add_argument("--out", default="./ray_tpu_metrics",
+                    help="output directory (default ./ray_tpu_metrics)")
+    sp.add_argument("--dashboard-host", default="127.0.0.1:8265",
+                    help="where Prometheus scrapes /metrics")
+    sp.add_argument("--prometheus-host", default="127.0.0.1:9090",
+                    help="where Grafana reaches Prometheus")
+    sp.set_defaults(fn=cmd_grafana)
 
     sp = sub.add_parser("client-proxy",
                         help="serve proxied client connections (ray client)")

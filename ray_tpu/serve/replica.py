@@ -20,6 +20,7 @@ from __future__ import annotations
 import inspect
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import ray_tpu
@@ -69,6 +70,19 @@ class ReplicaActor:
         self._pending = 0  # admission-queued (either plane), not yet running
         self._total = 0
         self._lock = threading.Lock()
+        # serve metrics on the cluster metrics plane (reference: serve
+        # emits request count/latency per deployment into the metrics
+        # agent; the Grafana serve dashboard targets these names)
+        from ray_tpu.util import metrics as _met
+
+        tags = {"deployment": deployment_name, "replica": replica_tag}
+        self._m_requests = _met.Counter(
+            "serve_requests_total", "serve requests handled",
+            tag_keys=("deployment", "replica")).set_default_tags(tags)
+        self._m_latency = _met.Histogram(
+            "serve_request_latency_ms", "serve request latency (ms)",
+            boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000],
+            tag_keys=("deployment", "replica")).set_default_tags(tags)
         if user_config is not None:
             self.reconfigure(user_config)
         # fast data plane: framed-RPC listener + bounded execution pool.
@@ -237,6 +251,7 @@ class ReplicaActor:
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
+        t0 = time.perf_counter()
         try:
             fn = getattr(self.user, method, None)
             if fn is None:
@@ -248,6 +263,14 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
             self._admission.release()
+            self._record_request(time.perf_counter() - t0)
+
+    def _record_request(self, elapsed_s: float) -> None:
+        try:
+            self._m_requests.inc()
+            self._m_latency.observe(elapsed_s * 1e3)
+        except Exception:
+            pass  # metrics must never fail a request
 
     def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
                               model_id: str | None = None):
@@ -263,6 +286,7 @@ class ReplicaActor:
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
+        t0 = time.perf_counter()
         try:
             fn = getattr(self.user, method, None)
             if fn is None:
@@ -274,6 +298,9 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
             self._admission.release()
+            # latency here is the full stream duration — that IS the
+            # request's occupancy of the replica
+            self._record_request(time.perf_counter() - t0)
 
     def ongoing(self) -> int:
         return self._ongoing

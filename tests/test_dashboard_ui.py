@@ -96,7 +96,7 @@ def test_every_js_fetched_endpoint_serves_live_data(live_dash):
     answer with 200 on the live cluster."""
     port, _ = live_dash
     html = _ui_html()
-    urls = sorted(set(re.findall(r'[j|fetch]\("(/api/[a-z_]+)"?', html)))
+    urls = sorted(set(re.findall(r'[j|fetch]\("(/api/[a-z_/]+)"?', html)))
     assert "/api/cluster" in urls and "/api/objects" in urls, urls
     for u in urls:
         _get_json(port, u)
@@ -167,3 +167,128 @@ def test_cluster_metrics_history_inputs(live_dash):
     for field in ("num_workers", "num_actors", "pending_tasks",
                   "total_resources", "available_resources"):
         assert field in c, field
+
+
+def test_metrics_history_series_has_real_values_under_load(live_dash):
+    """Head-retained time series (VERDICT r4 item 7): the GCS samples
+    cluster gauges every health tick and each node's resource view; under
+    the fixture's live workload the series must carry REAL values, not
+    just render."""
+    port, _ = live_dash
+    deadline = time.time() + 15
+    h = None
+    while time.time() < deadline:
+        h = _get_json(port, "/api/metrics/history")
+        if len(h.get("cluster", [])) >= 2 and h.get("nodes"):
+            break
+        time.sleep(0.5)
+    cl = h["cluster"]
+    assert len(cl) >= 2, h
+    # monotone wall clocks, real worker counts (the fixture spawned 2+)
+    assert all(cl[i]["ts"] <= cl[i + 1]["ts"] for i in range(len(cl) - 1))
+    assert max(s["live_workers"] for s in cl) >= 2
+    assert max(s["live_actors"] for s in cl) >= 1  # dash-counter
+    # the head host samples itself: mem usage is a real fraction, load is
+    # a real loadavg (this box is busy running the suite)
+    head_series = next(iter(h["nodes"].values()))
+    last = head_series[-1]
+    assert 0.0 < last["mem_usage"] < 1.0
+    assert last["load1"] >= 0.0
+    assert last["num_worker_procs"] >= 2
+    # limit param truncates
+    h2 = _get_json(port, "/api/metrics/history?limit=1")
+    assert len(h2["cluster"]) == 1
+
+
+def test_metrics_page_in_ui(live_dash):
+    html = _ui_html()
+    assert '"metrics"' in html.replace("'", '"')
+    assert "/api/metrics/history" in html
+
+
+def test_profile_from_ui(live_dash):
+    """Profile-from-UI wiring: the dashboard endpoint drives the existing
+    in-worker sampling profiler and returns a flat report."""
+    port, _ = live_dash
+    ws = _get_json(port, "/api/workers")
+    live = [w for w in ws if not w["dead"] and w["kind"] == "worker"]
+    assert live
+    prof = _get_json(port,
+                     f"/api/profile?wid={live[0]['wid']}&duration=1&hz=50")
+    assert prof["wid"] == live[0]["wid"]
+    # the report is the profiler's flat text: sampled frames with counts
+    assert isinstance(prof["profile"], str) and len(prof["profile"]) > 0
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/api/profile")
+    assert ei.value.code == 400
+
+    # the workers page renders a profile link per live worker
+    assert "/api/profile?wid=" in _ui_html()
+
+
+def test_grafana_provisioning_artifacts(tmp_path):
+    """Grafana dashboard factory (reference:
+    dashboard/modules/metrics/grafana_dashboard_factory.py): dashboards
+    are valid Grafana JSON whose panel exprs target metrics the /metrics
+    endpoint actually exports."""
+    from ray_tpu.dashboard.grafana import provision
+
+    written = provision(str(tmp_path), dashboard_host="1.2.3.4:8265",
+                        prometheus_host="5.6.7.8:9090")
+    rels = {p[len(str(tmp_path)) + 1:] for p in written}
+    assert "grafana/dashboards/ray_tpu_core.json" in rels
+    assert "grafana/provisioning/datasources/ray_tpu.yml" in rels
+    assert "prometheus/prometheus.yml" in rels
+
+    core = json.load(open(tmp_path / "grafana/dashboards/ray_tpu_core.json"))
+    assert core["uid"] == "raytpucore"
+    assert len(core["panels"]) >= 5
+    exprs = [t["expr"] for p in core["panels"] for t in p["targets"]]
+    # panels target gauges the GCS really exports (metrics_snapshot)
+    for metric in ("ray_tpu_pending_tasks", "ray_tpu_live_actors",
+                   "ray_tpu_object_store_bytes", "ray_tpu_live_workers"):
+        assert any(metric in e for e in exprs), metric
+    # grid layout: two panels per row on the 24-col grid
+    for i, p in enumerate(core["panels"]):
+        assert p["gridPos"]["w"] == 12
+        assert p["gridPos"]["x"] == (i % 2) * 12
+
+    serve = json.load(open(tmp_path / "grafana/dashboards/ray_tpu_serve.json"))
+    sexprs = [t["expr"] for p in serve["panels"] for t in p["targets"]]
+    assert any("serve_requests_total" in e for e in sexprs)
+    assert any("serve_request_latency_ms" in e for e in sexprs)
+
+    prom = (tmp_path / "prometheus/prometheus.yml").read_text()
+    assert "1.2.3.4:8265" in prom and "/metrics" in prom
+    ds = (tmp_path / "grafana/provisioning/datasources/ray_tpu.yml").read_text()
+    assert "5.6.7.8:9090" in ds
+
+
+def test_serve_metrics_reach_prometheus_endpoint(live_dash):
+    """Replica-side request metrics flow worker → GCS → /metrics."""
+    port, _ = live_dash
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), name="mx")
+    try:
+        for i in range(5):
+            assert h.remote(i).result(timeout_s=30) == i
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            _, body = _get(port, "/metrics")
+            text = body.decode()
+            if "serve_requests_total" in text:
+                break
+            time.sleep(0.5)
+        assert "serve_requests_total" in text
+        assert 'deployment="mx_Echo"' in text  # app-prefixed name
+        assert "serve_request_latency_ms" in text
+    finally:
+        serve.shutdown()
