@@ -484,8 +484,13 @@ class GcsServer:
             mem = 0.0
         ts = time.time()
         with self.lock:
-            live_workers = sum(1 for w in self.workers.values()
-                               if w.kind == "worker" and not w.dead)
+            live_workers = 0
+            head_workers = 0
+            for w in self.workers.values():
+                if w.kind == "worker" and not w.dead:
+                    live_workers += 1
+                    if w.host_id == HEAD_HOST:
+                        head_workers += 1
             self.cluster_history.append({
                 "ts": ts,
                 "pending_tasks": len(self.pending_tasks),
@@ -497,9 +502,11 @@ class GcsServer:
             })
             hist = self.node_history.setdefault(
                 HEAD_HOST, collections.deque(maxlen=720))
+            # the head's PER-NODE series counts head-local workers only —
+            # followers report their own via resource_view deltas
             hist.append({"ts": ts, "mem_usage": round(mem, 4),
                          "load1": round(load1, 2),
-                         "num_worker_procs": live_workers})
+                         "num_worker_procs": head_workers})
 
     def start(self):
         self._restore_from_storage()

@@ -152,7 +152,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if not wid:
                     self._json({"error": "missing ?wid="}, 400)
                     return
-                duration = min(float(q.get("duration", [5])[0] or 5), 60.0)
+                import math as _math
+
+                duration = float(q.get("duration", [5])[0] or 5)
+                hz = float(q.get("hz", [50])[0] or 50)
+                # NaN survives min() (comparisons are False) and would make
+                # the GCS relay TTL never expire — reject non-finite input
+                if not (_math.isfinite(duration) and _math.isfinite(hz)):
+                    self._json({"error": "duration/hz must be finite"}, 400)
+                    return
+                duration = min(duration, 60.0)
                 # a profile blocks for its whole duration: use a dedicated
                 # connection so the shared _Gcs lock (and with it every
                 # other dashboard endpoint + /metrics scrape) isn't held
@@ -160,8 +169,7 @@ class _Handler(BaseHTTPRequestHandler):
                 own = _Gcs(gcs.session_dir)
                 try:
                     reply = own.rpc({"type": "worker_profile", "wid": wid,
-                                     "duration_s": duration,
-                                     "hz": float(q.get("hz", [50])[0] or 50)})
+                                     "duration_s": duration, "hz": hz})
                 finally:
                     try:
                         if own._conn is not None:

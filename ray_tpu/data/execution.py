@@ -15,6 +15,7 @@ in-flight caps + output-queue caps give the same streaming property.)
 from __future__ import annotations
 
 import collections
+import itertools
 import random
 import time
 from dataclasses import dataclass, field
@@ -783,6 +784,7 @@ class _ActorPool:
 
 
 _pipeline_metric_cache: tuple | None = None
+_pipeline_seq = itertools.count(1)  # collision-free pipeline tags
 
 
 def _pipeline_metrics() -> tuple:
@@ -936,7 +938,7 @@ class StreamingExecutor:
         # process-wide gauges tagged per pipeline, updated at the same
         # sites that maintain the byte accounting
         m_bytes, m_blocks, m_bp = _pipeline_metrics()
-        pipeline_tag = {"pipeline": f"exec-{id(self) & 0xffff:04x}"}
+        pipeline_tag = {"pipeline": f"exec-{next(_pipeline_seq)}"}
         bp_blocked = [False] * (len(rest) + 1)  # per-queue deferral state
 
         def _note_queues() -> None:
