@@ -124,6 +124,25 @@ def _child_main(platform: str) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif platform == "tpu":
+        # SELF-terminating init deadline (tpu_probe.py pattern): backend
+        # init on a wedged pool hangs indefinitely, and the parent must
+        # NEVER kill this process from outside — SIGKILL mid-grant is what
+        # wedges the pool for everyone (PERF.md post-mortems, rounds 1-4).
+        # The default SIGALRM disposition exits at the C level even while
+        # blocked inside native init; the alarm is cleared the moment the
+        # backend answers, after which measurement time is bounded.
+        import signal
+
+        signal.alarm(int(float(os.environ.get(
+            "RAY_TPU_BENCH_INIT_BUDGET_S", "240"))))
+        import jax
+
+        if jax.default_backend() == "tpu":
+            import jax.numpy as jnp
+
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        signal.alarm(0)
     fallback_err = None
     try:
         out = _measure(platform)
@@ -172,12 +191,33 @@ def _run_child(platform: str, timeout: float) -> tuple[dict | None, str]:
         # a wedged device pool blocks even `import jax` while the relay
         # env var is present — the CPU fallback must not dial it
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        env["RAY_TPU_BENCH_INIT_BUDGET_S"] = str(max(60.0, timeout - 30.0))
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           capture_output=True, text=True, timeout=timeout,
-                           env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+        if platform == "tpu":
+            # the TPU child self-terminates via its init alarm; the parent
+            # only STOPS WAITING on deadline — it must never SIGKILL a
+            # process that may hold a half-complete device-pool grant
+            # (killing mid-grant wedges the pool: rounds 1-4 post-mortems)
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+            try:
+                stdout, stderr = proc.communicate(timeout=timeout + 60.0)
+            except subprocess.TimeoutExpired:
+                return None, (f"{platform} child unresponsive past "
+                              f"{timeout + 60:.0f}s; abandoned un-killed "
+                              "(its init alarm will exit it)")
+            r = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                            stdout, stderr)
+        else:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=timeout,
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
-        return None, f"{platform} child exceeded {timeout:.0f}s (backend init hang / wedged device pool?)"
+        return None, f"{platform} child exceeded {timeout:.0f}s"
     for line in (r.stdout or "").splitlines():
         if line.startswith("@@RESULT@@"):
             res = json.loads(line[len("@@RESULT@@"):])

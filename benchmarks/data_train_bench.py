@@ -178,13 +178,31 @@ def _run_child(platform: str, timeout: float) -> tuple[dict | None, str]:
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        env["RAY_TPU_DATA_BENCH_INIT_BUDGET_S"] = str(
+            max(60.0, timeout - 30.0))
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           capture_output=True, text=True, timeout=timeout,
-                           env=env, cwd=_ROOT)
+        if platform == "tpu":
+            # tpu_probe.py discipline: the child self-terminates via its
+            # init alarm; the parent only stops waiting — never SIGKILL a
+            # process that may hold a half-complete device-pool grant
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=_ROOT)
+            try:
+                stdout, stderr = proc.communicate(timeout=timeout + 60.0)
+            except subprocess.TimeoutExpired:
+                return None, (f"{platform} child unresponsive past "
+                              f"{timeout + 60:.0f}s; abandoned un-killed")
+            r = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                            stdout, stderr)
+        else:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env, cwd=_ROOT)
     except subprocess.TimeoutExpired:
-        return None, (f"{platform} child exceeded {timeout:.0f}s "
-                      "(backend init hang / wedged device pool?)")
+        return None, f"{platform} child exceeded {timeout:.0f}s"
     for line in (r.stdout or "").splitlines():
         if line.startswith("@@RESULT@@"):
             res = json.loads(line[len("@@RESULT@@"):])
@@ -198,6 +216,19 @@ def _run_child(platform: str, timeout: float) -> tuple[dict | None, str]:
 def main():
     child = os.environ.get("RAY_TPU_DATA_BENCH_CHILD")
     if child:
+        if child == "tpu":
+            # self-terminating init deadline (see _run_child / tpu_probe.py)
+            import signal
+
+            signal.alarm(int(float(os.environ.get(
+                "RAY_TPU_DATA_BENCH_INIT_BUDGET_S", "240"))))
+            import jax
+
+            if jax.default_backend() == "tpu":
+                import jax.numpy as jnp
+
+                (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+            signal.alarm(0)
         print("@@RESULT@@" + json.dumps(_measure(child)))
         return 0
 

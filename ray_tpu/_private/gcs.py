@@ -1324,12 +1324,22 @@ class GcsServer:
             # worker samples its own frames — no ptrace in the sandbox).
             # Sampling runs duration_s in the worker, so the parked waiter
             # gets a TTL that outlives it.
+            # sanitize HERE, not just at the dashboard edge: NaN survives
+            # min()/comparisons, so a NaN duration from any client would
+            # make the relay TTL never expire and leak the parked waiter
+            import math as _math
+
+            dur = float(msg.get("duration_s", 5.0))
+            hz = float(msg.get("hz", 50.0))
+            if not _math.isfinite(dur) or dur <= 0:
+                dur = 5.0
+            if not _math.isfinite(hz) or hz <= 0:
+                hz = 50.0
             self._park_relay(
                 conn, msg, prefix="pf",
-                ttl=float(msg.get("duration_s", 5.0)) + 30.0,
-                payload={"type": "profile",
-                         "duration_s": float(msg.get("duration_s", 5.0)),
-                         "hz": float(msg.get("hz", 50.0))})
+                ttl=min(dur, 120.0) + 30.0,
+                payload={"type": "profile", "duration_s": min(dur, 120.0),
+                         "hz": hz})
         elif t == "stacks_reply":
             with self.lock:
                 waiter = self._tensor_exports.pop(msg["token"], None)

@@ -122,7 +122,7 @@ class RestGceTpuApi(GceTpuApi):
     RETRYABLE = {429, 500, 502, 503, 504}
 
     def __init__(self, project: str, zone: str, *,
-                 token_provider: Callable[[], str] = metadata_token_provider,
+                 token_provider: Callable[[], str] | None = None,
                  transport=_default_transport,
                  gcs_address: str = "",
                  runtime_version: str = "tpu-ubuntu2204-base",
@@ -147,13 +147,31 @@ class RestGceTpuApi(GceTpuApi):
 
     # -- plumbing ----------------------------------------------------------
 
+    def validate(self) -> None:
+        """Startup credential probe: obtain one access token NOW so a
+        misconfigured deployment fails at `ray_tpu start`/monitor launch
+        with an actionable error, not at the first scale-up (reference:
+        providers validate credentials at autoscaler boot)."""
+        try:
+            self._headers()
+        except Exception as e:
+            raise RuntimeError(
+                f"gce_tpu provider cannot obtain an access token for "
+                f"project={self.project!r} zone={self.zone!r}: {e}. On GCE "
+                "the metadata server supplies it; elsewhere pass a "
+                "token_provider (e.g. from service-account credentials)."
+            ) from e
+
     @property
     def _parent(self) -> str:
         return f"projects/{self.project}/locations/{self.zone}"
 
     def _headers(self) -> Dict[str, str]:
         if self._token is None:
-            self._token = self.token_provider()
+            # late-bound default: resolving the module attribute at CALL
+            # time keeps the metadata fallback monkeypatchable/testable
+            provider = self.token_provider or metadata_token_provider
+            self._token = provider()
         return {"Authorization": f"Bearer {self._token}",
                 "Content-Type": "application/json"}
 

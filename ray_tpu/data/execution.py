@@ -1158,12 +1158,14 @@ class StreamingExecutor:
                 time.sleep(min(0.05, 0.001 + idle_spin))
                 idle_spin = min(0.05, idle_spin + 0.002)
         finally:
-            # this pipeline's gauges must read 0 once it stops (normal end,
-            # consumer abandonment, or error) — a stale "in flight" value
-            # would outlive the executor on /metrics forever
+            # retire this pipeline's labelsets once it stops (normal end,
+            # consumer abandonment, or error) — stale series would both
+            # mislead /metrics and accumulate one labelset per lifetime
+            # pipeline in a long-lived driver
             try:
-                m_bytes.set(0.0, pipeline_tag)
-                m_blocks.set(0.0, pipeline_tag)
+                m_bytes.remove(pipeline_tag)
+                m_blocks.remove(pipeline_tag)
+                m_bp.remove(pipeline_tag)
             except Exception:
                 pass
             for pool in actor_pools:

@@ -57,6 +57,15 @@ class Metric:
         self._default_tags = dict(tags)
         return self
 
+    def remove(self, tags: Optional[dict] = None) -> None:
+        """Drop one labelset's series entirely (it stops being exported).
+        For short-lived tag values (e.g. per-pipeline ids) this is the
+        retirement path — setting 0 would leave a dead series in every
+        future scrape and grow the registry without bound."""
+        key = self._merged(tags)
+        with self._series_lock:
+            self._series.pop(key, None)
+
     def _merged(self, tags: Optional[dict]) -> Tuple:
         merged = dict(self._default_tags)
         if tags:

@@ -139,8 +139,14 @@ def test_objects_view_shows_the_put_blob(live_dash):
 
 def test_timeline_has_timed_executions_for_lane_rendering(live_dash):
     port, _ = live_dash
-    events = _get_json(port, "/api/tasks")
-    timed = [e for e in events if e.get("start") and e.get("end")]
+    # workers flush task events on a 2s telemetry interval: poll
+    timed = []
+    deadline = time.time() + 15
+    while not timed and time.time() < deadline:
+        events = _get_json(port, "/api/tasks")
+        timed = [e for e in events if e.get("start") and e.get("end")]
+        if not timed:
+            time.sleep(0.5)
     assert timed, "no timed task events; timeline lanes would be empty"
     assert any(e.get("end") > e.get("start") for e in timed)
     # the chrome-trace export stays consistent with the in-page view
