@@ -1,0 +1,136 @@
+"""Shared TPU-safe capture harness for benchmark scripts.
+
+One implementation of the pool-hygiene rules every bench must follow
+(PERF.md post-mortems, rounds 1-4):
+
+- the TPU measurement runs in a CHILD whose backend init is bounded by a
+  SELF-terminating ``signal.alarm`` — never killed from outside, because
+  SIGKILL/SIGTERM mid-grant is what wedges the shared device pool;
+- the parent only STOPS WAITING on deadline (the child's alarm exits it);
+- CPU children strip ``PALLAS_AXON_POOL_IPS`` so a wedged pool can't
+  block even ``import jax``;
+- a TPU child that lands on another backend exits immediately with a
+  marker instead of burning the budget measuring the wrong platform;
+- last-known-good TPU results are cached across invocations.
+
+bench.py (the driver-run headline bench) keeps its own self-contained
+copy on purpose — it must work standalone at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_MARK = "@@RESULT@@"
+_WRONG_BACKEND = "@@WRONG_BACKEND@@"
+
+
+def child_guard(child_env: str, platform: str) -> None:
+    """Call FIRST in the child: arm the init alarm, confirm the backend
+    with one real device op, then disarm. Exits (rc 3) when a TPU child
+    lands elsewhere so the parent can skip straight to the CPU child."""
+    if platform != "tpu":
+        return
+    import signal
+
+    signal.alarm(int(float(os.environ.get(child_env + "_INIT_BUDGET_S",
+                                          "240"))))
+    import jax
+
+    if jax.default_backend() != "tpu":
+        signal.alarm(0)
+        print(_WRONG_BACKEND + jax.default_backend(), flush=True)
+        os._exit(3)
+    import jax.numpy as jnp
+
+    (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    signal.alarm(0)
+
+
+def emit(result: dict) -> None:
+    print(_MARK + json.dumps(result))
+
+
+def run_child(script_path: str, child_env: str, platform: str,
+              timeout: float, cwd: str) -> tuple[dict | None, str]:
+    env = dict(os.environ)
+    env[child_env] = platform
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        env[child_env + "_INIT_BUDGET_S"] = str(max(60.0, timeout - 30.0))
+    try:
+        if platform == "tpu":
+            proc = subprocess.Popen(
+                [sys.executable, script_path],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=cwd)
+            try:
+                stdout, stderr = proc.communicate(timeout=timeout + 60.0)
+            except subprocess.TimeoutExpired:
+                return None, (f"tpu child unresponsive past "
+                              f"{timeout + 60:.0f}s; abandoned un-killed "
+                              "(its init alarm will exit it)")
+            rc = proc.returncode
+        else:
+            r = subprocess.run([sys.executable, script_path],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env, cwd=cwd)
+            stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} child exceeded {timeout:.0f}s"
+    for line in (stdout or "").splitlines():
+        if line.startswith(_WRONG_BACKEND):
+            return None, (f"tpu backend unavailable (child landed on "
+                          f"{line[len(_WRONG_BACKEND):]!r})")
+        if line.startswith(_MARK):
+            res = json.loads(line[len(_MARK):])
+            if platform == "tpu" and res.get("backend") != "tpu":
+                return None, f"child ran on {res.get('backend')!r}, not tpu"
+            return res, ""
+    tail = "\n".join((stderr or "").strip().splitlines()[-4:])[-600:]
+    return None, f"{platform} child rc={rc}: {tail}"
+
+
+def orchestrate(script_path: str, child_env: str, budget_s: float,
+                lkg_path: str, lkg_fields: list[str],
+                cwd: str) -> dict:
+    """Parent flow: TPU child → LKG cache on success; else CPU child with
+    the cached last-known-good TPU numbers attached for diagnosability."""
+    t0 = time.monotonic()
+    diag: dict = {}
+    result = None
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        result, err = run_child(script_path, child_env, "tpu",
+                                max(60.0, budget_s - 100.0), cwd)
+        if result is None:
+            diag["tpu_unavailable"] = err
+    else:
+        diag["tpu_unavailable"] = "JAX_PLATFORMS=cpu preset"
+
+    if result is not None:
+        try:
+            with open(lkg_path, "w") as f:
+                json.dump({**result, "ts": time.time()}, f)
+        except OSError:
+            pass
+    else:
+        remaining = max(60.0, budget_s - (time.monotonic() - t0) - 10.0)
+        result, err = run_child(script_path, child_env, "cpu",
+                                remaining, cwd)
+        if result is None:
+            diag["cpu_child_failed"] = err
+            result = {"backend": "none"}
+        try:
+            lkg = json.load(open(lkg_path))
+            diag["last_known_good_tpu"] = {
+                **{k: lkg.get(k) for k in lkg_fields},
+                "age_s": round(time.time() - lkg.get("ts", 0.0), 0)}
+        except Exception:
+            pass
+    return {"ts": time.strftime("%Y-%m-%d %H:%M"), **result, **diag}

@@ -1,0 +1,66 @@
+"""One-shot on-chip artifact capture, in judged-priority order.
+
+Runs, sequentially (one process owns the chip at a time, each harness
+already hardened with self-terminating TPU children):
+
+  1. bench.py                    -> BENCH (train tokens/s + MFU) + LKG
+  2. benchmarks/llm_serving_bench.py -> LLM_BENCH.json (TTFT/decode/agg)
+  3. benchmarks/data_train_bench.py  -> DATA_BENCH.json (images/s, wait)
+
+Stops early (still writing whatever was captured) if the first step lands
+on the CPU fallback — the pool is wedged and burning the budget on two
+more wedged inits helps nobody. Usage: python benchmarks/capture_tpu_all.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(script: str, budget_env: tuple[str, str]) -> dict | None:
+    env = dict(os.environ)
+    env[budget_env[0]] = budget_env[1]
+    r = subprocess.run([sys.executable, os.path.join(_ROOT, script)],
+                       capture_output=True, text=True, env=env, cwd=_ROOT)
+    line = (r.stdout or "").strip().splitlines()
+    for ln in reversed(line):
+        try:
+            return json.loads(ln)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    print(f"{script}: no JSON output (rc={r.returncode})", file=sys.stderr)
+    print((r.stderr or "")[-500:], file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    out = run("bench.py", ("RAY_TPU_BENCH_BUDGET_S", "540"))
+    backend = ((out or {}).get("detail") or {}).get("backend")
+    print("bench:", backend, (out or {}).get("value"))
+    if backend != "tpu":
+        print("pool still wedged; skipping the serving/data captures")
+        return 1
+    rc = 0
+    llm = run("benchmarks/llm_serving_bench.py",
+              ("RAY_TPU_LLM_BENCH_BUDGET_S", "540"))
+    print("llm:", (llm or {}).get("backend"),
+          (llm or {}).get("aggregate_tokens_per_s"))
+    if (llm or {}).get("backend") != "tpu":
+        rc = 2  # pool died mid-capture: the artifact is a CPU fallback
+    data = run("benchmarks/data_train_bench.py",
+               ("RAY_TPU_DATA_BENCH_BUDGET_S", "540"))
+    print("data:", (data or {}).get("backend"),
+          (data or {}).get("images_per_sec"),
+          "wait", (data or {}).get("device_wait_frac"))
+    if (data or {}).get("backend") != "tpu":
+        rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import subprocess
 import sys
 import time
 
@@ -172,98 +171,19 @@ def _measure(platform: str) -> dict:
     }
 
 
-def _run_child(platform: str, timeout: float) -> tuple[dict | None, str]:
-    env = dict(os.environ)
-    env["RAY_TPU_DATA_BENCH_CHILD"] = platform
-    if platform == "cpu":
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-    else:
-        env["RAY_TPU_DATA_BENCH_INIT_BUDGET_S"] = str(
-            max(60.0, timeout - 30.0))
-    try:
-        if platform == "tpu":
-            # tpu_probe.py discipline: the child self-terminates via its
-            # init alarm; the parent only stops waiting — never SIGKILL a
-            # process that may hold a half-complete device-pool grant
-            proc = subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                env=env, cwd=_ROOT)
-            try:
-                stdout, stderr = proc.communicate(timeout=timeout + 60.0)
-            except subprocess.TimeoutExpired:
-                return None, (f"{platform} child unresponsive past "
-                              f"{timeout + 60:.0f}s; abandoned un-killed")
-            r = subprocess.CompletedProcess(proc.args, proc.returncode,
-                                            stdout, stderr)
-        else:
-            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                               capture_output=True, text=True,
-                               timeout=timeout, env=env, cwd=_ROOT)
-    except subprocess.TimeoutExpired:
-        return None, f"{platform} child exceeded {timeout:.0f}s"
-    for line in (r.stdout or "").splitlines():
-        if line.startswith("@@RESULT@@"):
-            res = json.loads(line[len("@@RESULT@@"):])
-            if platform == "tpu" and res.get("backend") != "tpu":
-                return None, f"child ran on {res.get('backend')!r}, not tpu"
-            return res, ""
-    tail = "\n".join((r.stderr or "").strip().splitlines()[-4:])[-600:]
-    return None, f"{platform} child rc={r.returncode}: {tail}"
-
-
 def main():
+    sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
+    import _capture
+
     child = os.environ.get("RAY_TPU_DATA_BENCH_CHILD")
     if child:
-        if child == "tpu":
-            # self-terminating init deadline (see _run_child / tpu_probe.py)
-            import signal
-
-            signal.alarm(int(float(os.environ.get(
-                "RAY_TPU_DATA_BENCH_INIT_BUDGET_S", "240"))))
-            import jax
-
-            if jax.default_backend() == "tpu":
-                import jax.numpy as jnp
-
-                (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
-            signal.alarm(0)
-        print("@@RESULT@@" + json.dumps(_measure(child)))
+        _capture.child_guard("RAY_TPU_DATA_BENCH_CHILD", child)
+        _capture.emit(_measure(child))
         return 0
 
-    t0 = time.monotonic()
-    diag: dict = {}
-    result = None
-    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        result, err = _run_child("tpu", timeout=max(60.0, _BUDGET_S - 100.0))
-        if result is None:
-            diag["tpu_unavailable"] = err
-    else:
-        diag["tpu_unavailable"] = "JAX_PLATFORMS=cpu preset"
-
-    if result is not None:
-        try:
-            with open(_LKG_PATH, "w") as f:
-                json.dump({**result, "ts": time.time()}, f)
-        except OSError:
-            pass
-    else:
-        remaining = max(60.0, _BUDGET_S - (time.monotonic() - t0) - 10.0)
-        result, err = _run_child("cpu", timeout=remaining)
-        if result is None:
-            diag["cpu_child_failed"] = err
-            result = {"backend": "none", "images_per_sec": 0.0}
-        try:
-            lkg = json.load(open(_LKG_PATH))
-            diag["last_known_good_tpu"] = {
-                "images_per_sec": lkg.get("images_per_sec"),
-                "device_wait_frac": lkg.get("device_wait_frac"),
-                "age_s": round(time.time() - lkg.get("ts", 0.0), 0)}
-        except Exception:
-            pass
-
-    out = {"ts": time.strftime("%Y-%m-%d %H:%M"), **result, **diag}
+    out = _capture.orchestrate(
+        os.path.abspath(__file__), "RAY_TPU_DATA_BENCH_CHILD", _BUDGET_S,
+        _LKG_PATH, ["images_per_sec", "device_wait_frac"], _ROOT)
     with open(os.path.join(_ROOT, "DATA_BENCH.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

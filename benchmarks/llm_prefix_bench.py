@@ -3,7 +3,8 @@
 Workload: N requests sharing one long prompt prefix with short distinct
 tails (the serve prefix router's steady state). Measures time-to-first-token
 per request after a warmup request populates the cache / compilations.
-Updates LLM_BENCH.json with the prefix-cache rows.
+Updates LLM_MICROBENCH.json with the prefix-cache rows
+(LLM_BENCH.json is owned by llm_serving_bench.py, flat schema).
 """
 
 from __future__ import annotations
@@ -72,7 +73,7 @@ def main():
     print(json.dumps({"prefix_workload": {
         "prefix_len": PREFIX_LEN, "page_size": PAGE,
         "backend": jax.default_backend()}, "results": rows}))
-    path = os.path.join(os.path.dirname(__file__), "..", "LLM_BENCH.json")
+    path = os.path.join(os.path.dirname(__file__), "..", "LLM_MICROBENCH.json")
     try:
         doc = json.load(open(path))
         keep = [r for r in doc.get("results", [])

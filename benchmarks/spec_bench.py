@@ -1,7 +1,8 @@
 """Speculative-decoding microbenchmark: tokens/step and wall-clock speedup
 of n-gram speculation vs plain decode on a repetitive workload.
 
-Appends a `speculative` section to LLM_BENCH.json. CPU numbers are
+Appends a `speculative` section to LLM_MICROBENCH.json
+(LLM_BENCH.json is owned by llm_serving_bench.py, flat schema). CPU numbers are
 relative (the verify-step cost ratio differs on the MXU, in speculation's
 favor — decode is memory-bound there).
 
@@ -76,7 +77,7 @@ def main():
     }
     print(json.dumps(section, indent=1))
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "LLM_BENCH.json")
+        os.path.abspath(__file__))), "LLM_MICROBENCH.json")
     try:
         doc = json.load(open(path))
     except (OSError, ValueError):

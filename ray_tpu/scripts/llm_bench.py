@@ -2,7 +2,7 @@
 
 Measures the continuous-batching engine's TTFT (time to first streamed
 token), per-request decode throughput, and aggregate tokens/s under
-concurrent load; writes LLM_BENCH.json at the repo root so numbers are
+concurrent load; writes LLM_MICROBENCH.json at the repo root so numbers are
 committed round-over-round. On the CPU mesh this characterizes engine
 OVERHEAD (batching, paging, scheduling); the same harness run on the real
 chip gives the serving numbers (reference: vLLM-style serving benchmarks —
@@ -137,7 +137,9 @@ def main():
 
     from ray_tpu.scripts._artifacts import write_artifact
 
-    print("wrote", write_artifact("LLM_BENCH.json", {
+    # LLM_BENCH.json is owned by benchmarks/llm_serving_bench.py
+    # (flat schema); this CLI microbenchmark keeps its own artifact
+    print("wrote", write_artifact("LLM_MICROBENCH.json", {
         "backend": "tpu" if on_tpu else "cpu",
         "config": {"d_model": cfg.d_model, "layers": cfg.n_layers,
                    "slots": slots, "concurrency": conc},
