@@ -155,6 +155,12 @@ def _measure(platform: str) -> dict:
     _spec_base = prompt(32)
     spec_prompt = (_spec_base * ((prompt_len // 32) + 1))[:prompt_len]
 
+    # generation must be LONG enough for greedy decode to settle into a
+    # repetition loop the n-gram drafter can exploit (spec_bench.py's
+    # regime) — a short tail from random weights measures ~0 acceptance
+    # and reads as a speculation regression when it's workload design
+    spec_sp = SamplingParams(max_tokens=max(256, gen_len), temperature=0.0)
+
     def decode_rate(spec_k: int) -> tuple[float, list, dict]:
         _, _, e3 = _build(
             dict(cfg_kw),
@@ -162,8 +168,8 @@ def _measure(platform: str) -> dict:
                  kv_layout="slot", speculative_k=spec_k))
         try:
             p = spec_prompt
-            list(e3.stream(p, sp))
-            req = e3.submit(p, sp)
+            list(e3.stream(p, spec_sp))
+            req = e3.submit(p, spec_sp)
             req.out_queue.get()
             t0 = time.perf_counter()
             toks = [t for t in req]

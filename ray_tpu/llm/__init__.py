@@ -8,12 +8,14 @@ continuous-batching engine (ray_tpu/llm/engine.py) instead of vLLM.)
 from ray_tpu.llm.batch import Processor, build_llm_processor
 from ray_tpu.llm.config import LLMConfig, ModelLoadingConfig
 from ray_tpu.llm.engine import SamplingParams, TPUEngine
+from ray_tpu.llm.guided import GuidedFSM
 from ray_tpu.llm.pd import build_pd_openai_app
 from ray_tpu.llm.server import LLMServer, build_openai_app
 from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
 
 __all__ = [
     "ByteTokenizer",
+    "GuidedFSM",
     "LLMConfig",
     "LLMServer",
     "ModelLoadingConfig",

@@ -38,6 +38,10 @@ class SamplingParams:
     temperature: float = 0.0
     top_k: int = 0
     stop_token_ids: tuple = ()
+    # constrained decoding: a llm.guided.GuidedFSM over token ids
+    # (reference: guided_decoding passthrough to vLLM structured output,
+    # vllm_engine_stage.py:278) — see ray_tpu/llm/guided.py
+    guided: object | None = None
 
 
 @dataclasses.dataclass
@@ -315,6 +319,10 @@ class TPUEngine:
         # not rebuilt/re-uploaded every decode step
         self._temps = jnp.zeros((max_slots,), jnp.float32)
         self._topks = jnp.zeros((max_slots,), jnp.int32)
+        # guided decoding: per-slot host-side FSM + current state; the only
+        # per-step device traffic is the additive bias rows (llm/guided.py)
+        self._guided_fsm: dict[int, object] = {}
+        self._guided_state: dict[int, int] = {}
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         self.key = jax.random.PRNGKey(seed)
         self._free = list(range(max_slots))
@@ -455,6 +463,16 @@ class TPUEngine:
                *, lora: str | None = None) -> _Request:
         self._check_alive()
         params = params or SamplingParams()
+        if params.guided is not None:
+            if self.speculative_k:
+                raise ValueError(
+                    "guided decoding and speculative decoding cannot be "
+                    "combined (drafts would have to be FSM-checked per "
+                    "position; build the engine with speculative_k=0)")
+            if params.guided.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"guided FSM vocab {params.guided.vocab_size} != model "
+                    f"vocab {self.cfg.vocab_size}")
         token_ids = list(token_ids)
         if not token_ids:
             raise ValueError("empty prompt: at least one token is required")
@@ -674,6 +692,22 @@ class TPUEngine:
     def _set_row_sampling(self, slot: int, params: SamplingParams):
         self._temps = self._temps.at[slot].set(params.temperature)
         self._topks = self._topks.at[slot].set(params.top_k)
+        if params.guided is not None:
+            self._guided_fsm[slot] = params.guided
+            # the first token was already sampled under the START state's
+            # mask (prefill path); its state advance happens in _emit
+            self._guided_state[slot] = params.guided.start
+
+    def _sample_first(self, req: _Request, logits, sub):
+        """First-token sampling after a prefill, honoring the request's
+        guided FSM start state (decode steps apply per-slot biases)."""
+        if req.params.guided is not None:
+            from ray_tpu.llm import guided as _g
+
+            logits = logits + jnp.asarray(
+                _g.bias_row(req.params.guided, req.params.guided.start))
+        return decoding.sample(logits[None, :], sub,
+                               req.params.temperature, req.params.top_k)
 
     def _insert(self, req: _Request, slot: int, kv, length: int, first_token):
         """Layout-dispatching sequence insertion. Returns False when the
@@ -772,8 +806,7 @@ class TPUEngine:
                 logits, kv = decoding.prefill(
                     self.params, jnp.asarray(padded), jnp.int32(n), self.cfg)
             self.key, sub = jax.random.split(self.key)
-            first = decoding.sample(logits[None, :], sub,
-                                    req.params.temperature, req.params.top_k)
+            first = self._sample_first(req, logits, sub)
             first_id = int(first[0])
             if not self._insert(req, slot, kv, n, first[0]):
                 self._free.append(slot)
@@ -865,8 +898,7 @@ class TPUEngine:
                 self.params, jnp.asarray(padded), jnp.int32(len(suffix)),
                 self.cfg)
         self.key, sub = jax.random.split(self.key)
-        first = decoding.sample(logits[None, :], sub,
-                                req.params.temperature, req.params.top_k)
+        first = self._sample_first(req, logits, sub)
         block_row = np.zeros((self.max_pages_per_seq,), np.int32)
         block_row[:n_pre] = pre_pages
         block_row[n_pre:n_pre + len(priv)] = priv
@@ -919,8 +951,7 @@ class TPUEngine:
         self._prefilling.pop(0)
         n = len(tokens)
         self.key, sub = jax.random.split(self.key)
-        first = decoding.sample(logits[None, :], sub,
-                                req.params.temperature, req.params.top_k)
+        first = self._sample_first(req, logits, sub)
         block_row = np.zeros((self.max_pages_per_seq,), np.int32)
         block_row[:len(req.pf_pages)] = req.pf_pages
         self.state = self._dp.activate_slot(
@@ -1012,6 +1043,10 @@ class TPUEngine:
         req.history.append(token_id)
         if self.speculative_k and req.ngram_index is not None:
             self._index_ngram_at(req, len(req.history))
+        fsm = self._guided_fsm.get(req.slot)
+        if fsm is not None:
+            self._guided_state[req.slot] = fsm.step(
+                self._guided_state[req.slot], token_id)
         stops = set(req.params.stop_token_ids)
         eos = token_id in stops
         if not eos:
@@ -1027,6 +1062,8 @@ class TPUEngine:
             if self.lora_bank is not None:
                 self._slot_lora = self._slot_lora.at[req.slot].set(0)
             self._lora_release(req)
+            self._guided_fsm.pop(req.slot, None)
+            self._guided_state.pop(req.slot, None)
             self._free.append(req.slot)
             del self._by_slot[req.slot]
             req.out_queue.put(_SENTINEL)
@@ -1067,6 +1104,15 @@ class TPUEngine:
                 self.state, logits = decoding.decode_step(
                     self.params, self.state, self.cfg)
             self.key, sub = jax.random.split(self.key)
+            if self._guided_fsm:
+                # per-slot FSM masks as an additive bias; the sampling math
+                # itself stays in the one jitted sample_per_row program
+                from ray_tpu.llm import guided as _g
+
+                bias = np.zeros(logits.shape, np.float32)
+                for slot, fsm in self._guided_fsm.items():
+                    bias[slot] = _g.bias_row(fsm, self._guided_state[slot])
+                logits = logits + jnp.asarray(bias)
             # sampling params live on device, updated only at admission
             toks = decoding.sample_per_row(logits, sub, self._temps, self._topks)
             self.state = decoding.commit_tokens(self.state, toks)

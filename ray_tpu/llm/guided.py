@@ -1,0 +1,131 @@
+"""Guided (constrained) decoding: finite-state token masks.
+
+Reference capability: ray.llm passes ``guided_decoding`` params
+(choice / regex / json / grammar) through to vLLM's structured-output
+machinery (llm/_internal/batch/stages/vllm_engine_stage.py:278, which
+builds ``vllm.sampling_params.GuidedDecodingParams``). This framework owns
+its engine, so the constraint machinery lives here.
+
+TPU-first design: a guided request carries a finite-state machine over
+TOKEN IDS — ``masks[S, V]`` (allowed tokens per state) and
+``trans[S, V]`` (next state per token). Each decode step the engine adds
+a per-slot ``-inf`` bias for disallowed tokens before sampling; the FSM
+state advance is a host-side table lookup on the token that was emitted
+anyway. The bias tensor is the only extra device traffic (slots × vocab
+per step) and the sampling math stays inside the existing jitted
+``sample_per_row`` — no data-dependent control flow enters the graph.
+
+Builders:
+
+- :meth:`GuidedFSM.from_choices` — output must be exactly one of N token
+  sequences (the ``guided_choice`` feature): a token trie whose terminal
+  state admits only EOS.
+- :meth:`GuidedFSM.from_token_sets` — positional template: step i must
+  draw from ``sets[i]`` (digits-only fields, enum slots, fixed-layout
+  records), then EOS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+NEG = np.float32(-1e9)
+
+
+@dataclasses.dataclass
+class GuidedFSM:
+    """masks[S, V] bool (True = allowed), trans[S, V] int32, start state."""
+
+    masks: np.ndarray
+    trans: np.ndarray
+    start: int = 0
+
+    def __post_init__(self):
+        if self.masks.shape != self.trans.shape:
+            raise ValueError(
+                f"masks {self.masks.shape} / trans {self.trans.shape} "
+                "shape mismatch")
+        if not (0 <= self.start < self.masks.shape[0]):
+            raise ValueError(f"start state {self.start} out of range")
+        # precomputed additive biases [S, V]: the decode hot loop indexes a
+        # row per step instead of running a full-vocab np.where per slot
+        self._biases = np.where(self.masks, np.float32(0.0), NEG)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.masks.shape[1]
+
+    def allowed(self, state: int) -> np.ndarray:
+        return self.masks[state]
+
+    def step(self, state: int, token: int) -> int:
+        return int(self.trans[state, token])
+
+    # ------------------------------------------------------------ builders
+
+    @classmethod
+    def from_choices(cls, choices: list, vocab_size: int,
+                     eos_id: int) -> "GuidedFSM":
+        """Token trie over ``choices`` (lists of token ids); at a complete
+        choice only EOS is admitted (absorbing)."""
+        if not choices:
+            raise ValueError("from_choices needs at least one choice")
+        # state 0 = root; assign states via trie insertion; final = EOS-only
+        children: list[dict] = [{}]
+        terminal: list[bool] = [False]
+        for ch in choices:
+            if not ch:
+                raise ValueError("empty choice")
+            s = 0
+            for tok in ch:
+                if not (0 <= tok < vocab_size):
+                    raise ValueError(f"choice token {tok} outside vocab")
+                nxt = children[s].get(tok)
+                if nxt is None:
+                    nxt = len(children)
+                    children[s][tok] = nxt
+                    children.append({})
+                    terminal.append(False)
+                s = nxt
+            terminal[s] = True
+        n = len(children) + 1  # + absorbing EOS-only state
+        eos_state = n - 1
+        masks = np.zeros((n, vocab_size), bool)
+        trans = np.full((n, vocab_size), eos_state, np.int32)
+        for s, kids in enumerate(children):
+            for tok, nxt in kids.items():
+                masks[s, tok] = True
+                trans[s, tok] = nxt
+            if terminal[s]:
+                masks[s, eos_id] = True
+                trans[s, eos_id] = eos_state
+        masks[eos_state, eos_id] = True
+        return cls(masks=masks, trans=trans, start=0)
+
+    @classmethod
+    def from_token_sets(cls, sets: list, vocab_size: int,
+                        eos_id: int) -> "GuidedFSM":
+        """Positional template: position i draws from ``sets[i]``; after
+        the last position only EOS is admitted."""
+        n = len(sets) + 1
+        eos_state = n - 1
+        masks = np.zeros((n, vocab_size), bool)
+        trans = np.full((n, vocab_size), eos_state, np.int32)
+        for i, allowed in enumerate(sets):
+            if not allowed:
+                raise ValueError(f"position {i}: empty token set")
+            for tok in allowed:
+                if not (0 <= tok < vocab_size):
+                    raise ValueError(f"token {tok} outside vocab")
+                masks[i, tok] = True
+                trans[i, tok] = i + 1
+        masks[eos_state, eos_id] = True
+        return cls(masks=masks, trans=trans, start=0)
+
+
+def bias_row(fsm: GuidedFSM, state: int) -> np.ndarray:
+    """Additive logit bias for one slot: 0 where allowed, -1e9 elsewhere
+    (precomputed at FSM construction; this is a row view)."""
+    return fsm._biases[state]

@@ -133,12 +133,45 @@ class LLMServer:
 
     def _params(self, body: dict) -> SamplingParams:
         eos = getattr(self.tokenizer, "eos_token_id", None)
+        guided = None
+        choices = body.get("guided_choice")
+        if choices:
+            # structured output, choice flavor (reference: guided_decoding
+            # params passed through the OpenAI surface to the engine —
+            # vllm_engine_stage.py:278): output must be exactly one of the
+            # given strings, enforced token-by-token in the decode step
+            from ray_tpu.llm.guided import GuidedFSM
+
+            if eos is None:
+                raise ValueError(
+                    "guided_choice requires a tokenizer with an EOS token")
+            encoded = [self._encode_continuation(c) for c in choices]
+            guided = GuidedFSM.from_choices(
+                encoded, self.engine.cfg.vocab_size, eos)
+            # the guided contract is "exactly one of the choices": never
+            # let max_tokens cut the FSM off mid-choice
+            body = {**body, "max_tokens": max(
+                int(body.get("max_tokens", 64)),
+                max(len(e) for e in encoded) + 1)}
         return SamplingParams(
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             stop_token_ids=(eos,) if eos is not None else (),
+            guided=guided,
         )
+
+    def _encode_continuation(self, text: str) -> list:
+        """Tokenize a guided choice as a CONTINUATION: BOS/special tokens
+        would otherwise be baked into the FSM and forced into the output."""
+        try:
+            return self.tokenizer.encode(text, add_bos=False)
+        except TypeError:
+            pass
+        try:
+            return self.tokenizer.encode(text, add_special_tokens=False)
+        except TypeError:
+            return self.tokenizer.encode(text)
 
     def _submit_retry(self, ids: list, params, lora: str | None):
         """Submit with one evicted-adapter reload retry: multiplex churn can

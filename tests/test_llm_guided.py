@@ -1,0 +1,176 @@
+"""Guided (constrained) decoding: FSM token masks in the batched engine.
+
+(reference: ray.llm guided_decoding passthrough to vLLM structured output
+— vllm_engine_stage.py:278 builds GuidedDecodingParams from
+choice/regex/json specs. This engine owns its decode loop, so the
+constraint is a token-id FSM whose masks bias logits per slot per step;
+see ray_tpu/llm/guided.py. Correctness bar: constrained outputs are
+ALWAYS admitted by the FSM, and an all-permissive FSM is bit-identical
+to unconstrained decoding.)
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.llm.engine import SamplingParams, TPUEngine
+from ray_tpu.llm.guided import GuidedFSM, bias_row
+from ray_tpu.models import llama_config, transformer
+
+VOCAB = 64
+EOS = 1
+
+
+def _engine(**kw):
+    import jax
+    import jax.numpy as jnp
+
+    cfg = llama_config("tiny", vocab_size=VOCAB, max_seq_len=256,
+                       d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                       d_ff=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return TPUEngine(cfg, params, max_slots=4, max_len=256, **kw)
+
+
+PROMPT = [5, 9, 17, 33, 2, 7]
+
+
+def test_choices_constraint_exact():
+    choices = [[10, 11, 12], [10, 20], [30, 31, 32, 33]]
+    fsm = GuidedFSM.from_choices(choices, VOCAB, EOS)
+    eng = _engine()
+    try:
+        for seed_tok in (3, 4, 6, 8):
+            out = eng.generate(
+                PROMPT + [seed_tok],
+                SamplingParams(max_tokens=8, temperature=0.0,
+                               stop_token_ids=(EOS,), guided=fsm))
+            # the emitted sequence (sans eos) must be exactly one choice
+            body = [t for t in out if t != EOS]
+            assert body in choices, (seed_tok, out)
+    finally:
+        eng.shutdown()
+
+
+def test_permissive_fsm_matches_unconstrained():
+    eng = _engine()
+    try:
+        base = eng.generate(PROMPT, SamplingParams(max_tokens=10))
+        allow_all = GuidedFSM(
+            masks=np.ones((1, VOCAB), bool),
+            trans=np.zeros((1, VOCAB), np.int32))
+        guided = eng.generate(PROMPT, SamplingParams(max_tokens=10,
+                                                     guided=allow_all))
+        assert guided == base
+    finally:
+        eng.shutdown()
+
+
+def test_token_sets_template():
+    digits = list(range(40, 50))
+    fsm = GuidedFSM.from_token_sets([digits, digits, [55]], VOCAB, EOS)
+    eng = _engine()
+    try:
+        out = eng.generate(PROMPT, SamplingParams(
+            max_tokens=8, stop_token_ids=(EOS,), guided=fsm))
+        body = [t for t in out if t != EOS]
+        assert len(body) == 3
+        assert body[0] in digits and body[1] in digits and body[2] == 55
+    finally:
+        eng.shutdown()
+
+
+def test_mixed_guided_and_free_batch():
+    fsm = GuidedFSM.from_choices([[10, 11], [20, 21]], VOCAB, EOS)
+    eng = _engine()
+    try:
+        free = eng.submit(PROMPT, SamplingParams(max_tokens=6))
+        g = eng.submit(PROMPT + [8], SamplingParams(
+            max_tokens=6, stop_token_ids=(EOS,), guided=fsm))
+        free_toks = list(free)
+        g_body = [t for t in g if t != EOS]
+        assert g_body in ([10, 11], [20, 21])
+        assert len(free_toks) == 6  # unguided row unaffected by the bias
+    finally:
+        eng.shutdown()
+
+
+def test_guided_with_sampling_temperature():
+    # even at high temperature every sampled token obeys the mask
+    fsm = GuidedFSM.from_choices([[10, 11, 12], [20, 21]], VOCAB, EOS)
+    eng = _engine()
+    try:
+        for _ in range(3):
+            out = eng.generate(PROMPT, SamplingParams(
+                max_tokens=8, temperature=1.5, top_k=0,
+                stop_token_ids=(EOS,), guided=fsm))
+            body = [t for t in out if t != EOS]
+            assert body in ([10, 11, 12], [20, 21]), out
+    finally:
+        eng.shutdown()
+
+
+def test_guided_rejects_bad_configs():
+    fsm = GuidedFSM.from_choices([[10]], VOCAB, EOS)
+    eng = _engine(speculative_k=2)
+    try:
+        with pytest.raises(ValueError, match="speculative"):
+            eng.submit(PROMPT, SamplingParams(guided=fsm))
+    finally:
+        eng.shutdown()
+    eng = _engine()
+    try:
+        small = GuidedFSM.from_choices([[1]], 8, 2)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit(PROMPT, SamplingParams(guided=small))
+    finally:
+        eng.shutdown()
+
+
+def test_fsm_builders():
+    fsm = GuidedFSM.from_choices([[3, 4], [3, 5]], 16, 0)
+    # root allows only 3; after 3, allows 4 or 5; after either, only eos
+    assert set(np.nonzero(fsm.masks[fsm.start])[0]) == {3}
+    s1 = fsm.step(fsm.start, 3)
+    assert set(np.nonzero(fsm.masks[s1])[0]) == {4, 5}
+    s2 = fsm.step(s1, 4)
+    assert set(np.nonzero(fsm.masks[s2])[0]) == {0}
+    # bias row: allowed 0.0, else very negative
+    b = bias_row(fsm, fsm.start)
+    assert b[3] == 0.0 and b[4] < -1e8
+
+    with pytest.raises(ValueError, match="empty"):
+        GuidedFSM.from_choices([[]], 16, 0)
+    with pytest.raises(ValueError, match="vocab"):
+        GuidedFSM.from_choices([[99]], 16, 0)
+
+
+def test_server_guided_choice_end_to_end():
+    """OpenAI-surface guided_choice (reference: guided_decoding params on
+    the serve path): the completion text is exactly one of the choices."""
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, ModelLoadingConfig, build_openai_app
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=8)
+    try:
+        cfg = LLMConfig(
+            model_loading_config=ModelLoadingConfig(model_id="tiny",
+                                                    tokenizer="byte"),
+            model_family="llama",
+            model_kwargs=dict(vocab_size=300, max_seq_len=128, d_model=64,
+                              n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+                              dtype=jnp.float32, remat=False),
+            engine_kwargs={"max_slots": 4, "max_len": 128, "min_bucket": 16},
+        )
+        handle = serve.run(build_openai_app(cfg), name="llmg",
+                           route_prefix="/llmg")
+        out = handle.completions.remote(
+            {"prompt": "pick:", "max_tokens": 16,
+             "guided_choice": ["yes", "no"]}).result(timeout_s=120)
+        assert out["choices"][0]["text"] in ("yes", "no"), out
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
