@@ -798,6 +798,31 @@ def read_text(paths, *, parallelism: int = -1, drop_empty_lines: bool = True,
                                          encoding=encoding), parallelism))
 
 
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """Materialize a Hugging Face ``datasets.Dataset`` (reference:
+    read_api.py from_huggingface — arrow-backed conversion). Batched arrow
+    extraction, not row loops; ``DatasetDict`` callers pick a split first."""
+    if isinstance(hf_dataset, dict):  # DatasetDict subclasses dict
+        raise ValueError(
+            "from_huggingface expects one split (e.g. ds['train']), got a "
+            f"DatasetDict with splits {list(hf_dataset.keys())}")
+    # select/shuffle/train_test_split keep the FULL table in .data and
+    # record the view in a lazy _indices mapping — materialize it or the
+    # handoff would silently return all rows in original order
+    if getattr(hf_dataset, "_indices", None) is not None:
+        hf_dataset = hf_dataset.flatten_indices()
+    try:
+        table = hf_dataset.data.table  # arrow-backed: zero-copy handoff
+    except AttributeError:
+        table = None
+    import pyarrow as pa
+
+    if isinstance(table, pa.Table):
+        return from_arrow(table)
+    return from_items([dict(r) for r in hf_dataset],
+                      parallelism=parallelism)
+
+
 def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
     """Materialize a map-style torch Dataset (reference: read_api.py
     from_torch). Rows become {"item": sample} (or dict samples verbatim)."""

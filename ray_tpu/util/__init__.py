@@ -6,8 +6,10 @@ from ray_tpu.util.placement_group import (
     remove_placement_group,
 )
 from ray_tpu.util import scheduling_strategies
+from ray_tpu.util.actor_pool import ActorPool
 
 __all__ = [
+    "ActorPool",
     "PlacementGroup",
     "get_placement_group",
     "placement_group",

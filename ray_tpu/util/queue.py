@@ -1,0 +1,197 @@
+"""Distributed FIFO queue backed by an actor.
+
+(reference: python/ray/util/queue.py:21 — Queue delegates to a detached
+``_QueueActor`` wrapping asyncio.Queue; producers/consumers in any
+process share it by passing the Queue object around. Same surface here:
+blocking put/get with timeouts, nowait variants, batch ops, and the
+``Empty`` / ``Full`` exceptions subclassing the stdlib ones.)
+"""
+
+from __future__ import annotations
+
+import queue as _stdlib_queue
+import time
+from typing import Any, Iterable, List, Optional
+
+import ray_tpu
+
+
+class Empty(_stdlib_queue.Empty):
+    pass
+
+
+class Full(_stdlib_queue.Full):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self._maxsize = int(maxsize)
+        self._q = collections.deque()  # O(1) popleft on the consumer path
+        self._closed = False
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+    def full(self) -> bool:
+        return 0 < self._maxsize <= len(self._q)
+
+    def close(self) -> None:
+        """Graceful-shutdown step 1: refuse new puts, keep serving gets."""
+        self._closed = True
+
+    def put_nowait(self, item) -> bool:
+        if self._closed or self.full():
+            return False
+        self._q.append(item)
+        return True
+
+    def put_nowait_batch(self, items: list) -> bool:
+        if self._closed or (self._maxsize > 0
+                            and len(self._q) + len(items) > self._maxsize):
+            return False
+        self._q.extend(items)
+        return True
+
+    def get_nowait(self):
+        if not self._q:
+            return False, None
+        return True, self._q.popleft()
+
+    def get_nowait_batch(self, num_items: int):
+        if len(self._q) < num_items:
+            return False, None
+        return True, [self._q.popleft() for _ in range(num_items)]
+
+
+class Queue:
+    """A first-in-first-out queue usable from any worker/driver.
+
+    Example::
+
+        q = Queue(maxsize=100)
+
+        @ray_tpu.remote
+        def consumer(q):
+            return q.get(timeout=5)
+
+        q.put(1)
+        assert ray_tpu.get(consumer.remote(q)) == 1
+    """
+
+    _POLL_S = 0.02
+
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[dict] = None) -> None:
+        self.maxsize = int(maxsize)
+        opts = actor_options or {}
+        self.actor = (_QueueActor.options(**opts).remote(self.maxsize)
+                      if opts else _QueueActor.remote(self.maxsize))
+
+    def __len__(self) -> int:
+        return self.qsize()
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    # ----------------------------------------------------------------- put
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            return self.put_nowait(item)
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # first attempt ships the payload; afterwards poll full() (a
+        # payload-free probe) and only re-ship when room was observed — a
+        # big item must not re-serialize on every 20ms poll of a full
+        # queue. The probe can race another producer; the put itself stays
+        # the authority and the loop just retries.
+        if ray_tpu.get(self.actor.put_nowait.remote(item)):
+            return
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(self._POLL_S)
+            if not ray_tpu.get(self.actor.full.remote()):
+                if ray_tpu.get(self.actor.put_nowait.remote(item)):
+                    return
+
+    def put_nowait(self, item: Any) -> None:
+        if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+            raise Full
+
+    def put_nowait_batch(self, items: Iterable) -> None:
+        items = list(items)
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(items)):
+            raise Full(f"Put batch of {len(items)} items failed: queue full")
+
+    # ----------------------------------------------------------------- get
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            return self.get_nowait()
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if ok:
+                return item
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(self._POLL_S)
+
+    def get_nowait(self) -> Any:
+        ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+        if not ok:
+            raise Empty
+        return item
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        if not isinstance(num_items, int) or num_items < 0:
+            raise ValueError("'num_items' must be a nonnegative integer")
+        ok, items = ray_tpu.get(
+            self.actor.get_nowait_batch.remote(num_items))
+        if not ok:
+            raise Empty(f"Cannot get {num_items} items from queue of size "
+                        f"{self.qsize()}")
+        return items
+
+    # ------------------------------------------------------------ lifetime
+
+    def shutdown(self, force: bool = False,
+                 grace_period_s: int = 5) -> None:
+        """Terminate the backing actor; subsequent operations fail.
+
+        force=False first CLOSES the queue (new puts refused, gets still
+        served) and waits up to grace_period_s for consumers to drain it,
+        then kills; force=True kills immediately, dropping queued items."""
+        if self.actor is None:
+            return
+        if not force:
+            try:
+                ray_tpu.get(self.actor.close.remote())
+                deadline = time.monotonic() + grace_period_s
+                while time.monotonic() < deadline:
+                    if ray_tpu.get(self.actor.qsize.remote()) == 0:
+                        break
+                    time.sleep(self._POLL_S)
+            except Exception:
+                pass  # actor already dead: fall through to kill
+        ray_tpu.kill(self.actor)
+        self.actor = None
