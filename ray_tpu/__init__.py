@@ -18,6 +18,7 @@ from ray_tpu._private.api import (
     is_initialized,
     kill,
     nodes,
+    timeline,
     put,
     remote,
     shutdown,
@@ -52,6 +53,7 @@ __all__ = [
     "kill",
     "method",
     "nodes",
+    "timeline",
     "put",
     "remote",
     "shutdown",

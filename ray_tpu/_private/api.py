@@ -188,13 +188,18 @@ def nodes() -> list:
     return _get_worker().list_nodes()
 
 
-def timeline() -> list:
-    """All task events collected by the GCS (reference: `ray timeline` /
-    GcsTaskManager task-event store)."""
+def timeline(filename: str | None = None) -> list:
+    """All task events collected by the GCS (reference: ray.timeline() —
+    with `filename`, a chrome://tracing JSON is written there too)."""
     w = _get_worker()
-    if not hasattr(w, "rpc"):
-        return []  # local mode keeps no event store
-    return w.rpc({"type": "task_events"}).get("events", [])
+    events = (w.rpc({"type": "task_events"}).get("events", [])
+              if hasattr(w, "rpc") else [])  # local mode keeps no store
+    if filename:
+        # write even when empty: callers open the promised file next
+        from ray_tpu._private.task_events import export_chrome_trace
+
+        export_chrome_trace(events, filename)
+    return events
 
 
 class RuntimeContext:

@@ -73,6 +73,26 @@ def drain() -> list:
     return out
 
 
+def normalize_events(events: list) -> list:
+    """Normalize GCS-side completion records (ts only) into zero-length
+    spans so every export path renders them identically — the chrome-trace
+    renderer drops events without start/end."""
+    for ev in events:
+        if "start" not in ev and "ts" in ev:
+            ev["start"] = ev["ts"]
+            ev["end"] = ev["ts"]
+            ev.setdefault("event", "task:done")
+            ev.setdefault("worker_id", ev.get("worker", ""))
+    return events
+
+
+def export_chrome_trace(events: list, filename: str) -> None:
+    """One exporter for CLI / dashboard / api.timeline: normalize + render
+    + write."""
+    with open(filename, "w") as f:
+        f.write(to_chrome_trace(normalize_events(list(events))))
+
+
 def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
     """Render GCS-collected events as chrome://tracing 'traceEvents' JSON.
 

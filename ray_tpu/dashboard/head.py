@@ -107,10 +107,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/tasks":
                 self._json(gcs.rpc({"type": "task_events"}).get("events", []))
             elif path == "/api/timeline":
-                from ray_tpu._private.task_events import to_chrome_trace
+                from ray_tpu._private.task_events import (normalize_events,
+                                                          to_chrome_trace)
 
                 evs = gcs.rpc({"type": "task_events"}).get("events", [])
-                self._send(to_chrome_trace(evs).encode())
+                self._send(to_chrome_trace(
+                    normalize_events(list(evs))).encode())
             elif path == "/api/jobs":
                 keys = gcs.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
                 jobs = []

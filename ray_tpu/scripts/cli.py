@@ -260,7 +260,7 @@ def cmd_monitor(args):
 def cmd_timeline(args):
     """Export collected task events as a chrome://tracing JSON file
     (reference capability: `ray timeline`, GcsTaskManager + profile events)."""
-    from ray_tpu._private.task_events import to_chrome_trace
+    from ray_tpu._private.task_events import export_chrome_trace
 
     sd = _pick_session(args)
     c = GcsClient(sd)
@@ -268,16 +268,8 @@ def cmd_timeline(args):
         events = c.rpc({"type": "task_events"}).get("events", [])
     finally:
         c.close()
-    # normalize GCS-side completion records (ts only) into spans
-    for ev in events:
-        if "start" not in ev and "ts" in ev:
-            ev["start"] = ev["ts"]
-            ev["end"] = ev["ts"]
-            ev.setdefault("event", "task:done")
-            ev.setdefault("worker_id", ev.get("worker", ""))
     out = args.output or "timeline.json"
-    with open(out, "w") as f:
-        f.write(to_chrome_trace(events))
+    export_chrome_trace(events, out)
     print(f"wrote {len(events)} events to {out} (open in chrome://tracing)")
 
 

@@ -5,7 +5,7 @@ from ray_tpu.util.placement_group import (
     placement_group_table,
     remove_placement_group,
 )
-from ray_tpu.util import scheduling_strategies
+from ray_tpu.util import scheduling_strategies, state
 from ray_tpu.util.actor_pool import ActorPool
 
 __all__ = [
@@ -16,4 +16,5 @@ __all__ = [
     "placement_group_table",
     "remove_placement_group",
     "scheduling_strategies",
+    "state",
 ]

@@ -1,0 +1,136 @@
+"""Programmatic cluster-state API.
+
+(reference: python/ray/util/state — ``list_actors``/``list_nodes``/
+``list_tasks``/``list_objects``/``list_workers``/``list_placement_groups``
+/``list_jobs`` + ``summarize_tasks``, the SDK twin of ``ray list ...``.
+Here each call is one GCS RPC from the CURRENT driver's connection —
+``ray_tpu list`` (scripts/cli.py:86) reads the same tables out-of-process.)
+
+Filters follow the reference's predicate tuples: ``[("state", "=",
+"ALIVE")]`` with ``=``/``!=`` operators against the row dicts.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, List, Optional, Tuple
+
+
+def _worker():
+    from ray_tpu._private.api import _get_worker
+
+    return _get_worker()
+
+
+def _apply(rows: list, filters, limit: int) -> list:
+    for key, op, want in (filters or ()):
+        if op not in ("=", "!="):
+            raise ValueError(f"unsupported filter op {op!r} (use '=' '!=')")
+
+        def keep(r, key=key, op=op, want=want):
+            got = r.get(key)
+            eq = (str(got) == str(want)
+                  or (isinstance(want, str) and "*" in want
+                      and fnmatch.fnmatch(str(got), want)))
+            return eq if op == "=" else not eq
+
+        rows = [r for r in rows if keep(r)]
+    return rows[:limit]
+
+
+def list_nodes(*, filters: Optional[List[Tuple]] = None,
+               limit: int = 1000) -> list:
+    return _apply(_worker().rpc({"type": "list_nodes"})["nodes"],
+                  filters, limit)
+
+
+def list_workers(*, filters: Optional[List[Tuple]] = None,
+                 limit: int = 1000) -> list:
+    return _apply(_worker().rpc({"type": "list_workers"})["workers"],
+                  filters, limit)
+
+
+def list_actors(*, filters: Optional[List[Tuple]] = None,
+                limit: int = 1000) -> list:
+    state = _worker().rpc({"type": "cluster_state"})["state"]
+    rows = [{"actor_id": aid, **info}
+            for aid, info in (state.get("actors") or {}).items()]
+    return _apply(rows, filters, limit)
+
+
+def list_placement_groups(*, filters: Optional[List[Tuple]] = None,
+                          limit: int = 1000) -> list:
+    table = _worker().rpc({"type": "pg_table"})["table"]
+    rows = [{"placement_group_id": k, **v} for k, v in table.items()]
+    return _apply(rows, filters, limit)
+
+
+def list_tasks(*, filters: Optional[List[Tuple]] = None,
+               limit: int = 1000) -> list:
+    rows = _worker().rpc({"type": "task_events"}).get("events", [])
+    return _apply(rows, filters, limit)
+
+
+def list_objects(*, filters: Optional[List[Tuple]] = None,
+                 limit: int = 1000) -> list:
+    rows = _worker().rpc({"type": "list_objects",
+                          "limit": limit}).get("objects", [])
+    return _apply(rows, filters, limit)
+
+
+def list_jobs(*, filters: Optional[List[Tuple]] = None,
+              limit: int = 1000) -> list:
+    import json as _json
+
+    w = _worker()
+    keys = w.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
+    rows = []
+    for k in keys:
+        v = w.rpc({"type": "kv_get", "key": k}).get("value")
+        if not v:
+            continue
+        try:
+            rows.append(_json.loads(v) if isinstance(v, (str, bytes)) else v)
+        except (ValueError, TypeError):
+            pass
+    return _apply(rows, filters, limit)
+
+
+def summarize_tasks() -> dict:
+    """Counts per (name, kind, ok) over the retained task-event window
+    (reference: ``ray summary tasks`` / summarize_tasks)."""
+    events = _worker().rpc({"type": "task_events"}).get("events", [])
+    summary: dict = {}
+    for e in events:
+        if e.get("event") and e["event"] != "task:execute":
+            continue
+        name = e.get("name") or "(unnamed)"
+        rec = summary.setdefault(name, {"count": 0, "failed": 0,
+                                        "total_s": 0.0})
+        rec["count"] += 1
+        if e.get("ok") is False or e.get("error"):
+            rec["failed"] += 1
+        if e.get("start") and e.get("end"):
+            rec["total_s"] += e["end"] - e["start"]
+    for rec in summary.values():
+        rec["total_s"] = round(rec["total_s"], 4)
+    return summary
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    for row in list_actors(filters=[("actor_id", "=", actor_id)], limit=1):
+        return row
+    return None
+
+
+def get_node(node_id: str) -> Optional[dict]:
+    for row in list_nodes(filters=[("node_id", "=", node_id)], limit=1):
+        return row
+    return None
+
+
+__all__ = [
+    "get_actor", "get_node", "list_actors", "list_jobs", "list_nodes",
+    "list_objects", "list_placement_groups", "list_tasks", "list_workers",
+    "summarize_tasks",
+]

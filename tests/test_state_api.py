@@ -1,0 +1,97 @@
+"""Programmatic state API + ray_tpu.timeline(filename).
+
+(reference: python/ray/util/state list_* / summarize_tasks — the SDK twin
+of `ray list ...`; ray.timeline() chrome-trace export.)
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=4)
+
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    @ray_tpu.remote
+    def work(i):
+        return i * 3
+
+    svc = Svc.options(name="state-svc").remote()
+    assert ray_tpu.get(svc.ping.remote()) == "pong"
+    assert ray_tpu.get([work.remote(i) for i in range(6)]) \
+        == [0, 3, 6, 9, 12, 15]
+    pg = ray_tpu.util.placement_group([{"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready(), timeout=30)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_nodes_workers():
+    ns = state.list_nodes()
+    assert ns and all("node_id" in n for n in ns)
+    ws = state.list_workers()
+    assert len(ws) >= 2
+    live = state.list_workers(filters=[("dead", "=", "False")])
+    assert live and all(str(w["dead"]) == "False" for w in live)
+
+
+def test_list_actors_and_filters():
+    rows = state.list_actors()
+    assert any(a.get("name") == "state-svc" for a in rows)
+    alive = state.list_actors(filters=[("state", "=", "alive")])
+    assert alive and all(a["state"] == "alive" for a in alive)
+    none = state.list_actors(filters=[("state", "=", "no-such-state")])
+    assert none == []
+    with pytest.raises(ValueError, match="filter op"):
+        state.list_actors(filters=[("state", ">", "x")])
+
+
+def test_get_actor_by_id():
+    row = state.list_actors(filters=[("name", "=", "state-svc")])[0]
+    got = state.get_actor(row["actor_id"])
+    assert got and got["name"] == "state-svc"
+    assert state.get_actor("nope") is None
+
+
+def test_list_placement_groups():
+    rows = state.list_placement_groups()
+    assert rows and all("placement_group_id" in r for r in rows)
+
+
+def test_tasks_and_summary():
+    deadline = time.time() + 15
+    rows = []
+    while time.time() < deadline:
+        rows = state.list_tasks(filters=[("name", "=", "work")])
+        if len(rows) >= 6:
+            break
+        time.sleep(0.5)
+    assert len(rows) >= 6
+    summary = state.summarize_tasks()
+    assert summary["work"]["count"] >= 6
+    assert summary["work"]["failed"] == 0
+
+
+def test_list_objects_and_limit():
+    blob = ray_tpu.put(b"y" * 150_000)
+    rows = state.list_objects(limit=5)
+    assert len(rows) <= 5
+    del blob
+
+
+def test_timeline_file_export(tmp_path):
+    out = str(tmp_path / "tl.json")
+    events = ray_tpu.timeline(out)
+    assert isinstance(events, list)
+    doc = json.load(open(out))
+    assert "traceEvents" in doc
