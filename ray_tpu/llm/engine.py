@@ -705,7 +705,8 @@ class TPUEngine:
             from ray_tpu.llm import guided as _g
 
             logits = logits + jnp.asarray(
-                _g.bias_row(req.params.guided, req.params.guided.start))
+                _g.bias_row(req.params.guided, req.params.guided.start,
+                            remaining=req.params.max_tokens))
         return decoding.sample(logits[None, :], sub,
                                req.params.temperature, req.params.top_k)
 
@@ -1106,12 +1107,17 @@ class TPUEngine:
             self.key, sub = jax.random.split(self.key)
             if self._guided_fsm:
                 # per-slot FSM masks as an additive bias; the sampling math
-                # itself stays in the one jitted sample_per_row program
+                # itself stays in the one jitted sample_per_row program.
+                # `remaining` triggers the budget-aware closing mask so an
+                # unbounded pattern completes before max_tokens.
                 from ray_tpu.llm import guided as _g
 
                 bias = np.zeros(logits.shape, np.float32)
                 for slot, fsm in self._guided_fsm.items():
-                    bias[slot] = _g.bias_row(fsm, self._guided_state[slot])
+                    r = self._by_slot[slot]
+                    bias[slot] = _g.bias_row(
+                        fsm, self._guided_state[slot],
+                        remaining=r.params.max_tokens - r.generated)
                 logits = logits + jnp.asarray(bias)
             # sampling params live on device, updated only at admission
             toks = decoding.sample_per_row(logits, sub, self._temps, self._topks)

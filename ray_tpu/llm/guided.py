@@ -36,11 +36,18 @@ NEG = np.float32(-1e9)
 
 @dataclasses.dataclass
 class GuidedFSM:
-    """masks[S, V] bool (True = allowed), trans[S, V] int32, start state."""
+    """masks[S, V] bool (True = allowed), trans[S, V] int32, start state.
+
+    ``eos_id`` (when ≥ 0) enables BUDGET-AWARE closing: per-state
+    distance-to-accept is precomputed, and once a request's remaining
+    max_tokens only just covers that distance the engine switches to a
+    closing mask that admits only budget-decreasing tokens — an unbounded
+    ``[a-z]+`` can then never overrun max_tokens mid-pattern."""
 
     masks: np.ndarray
     trans: np.ndarray
     start: int = 0
+    eos_id: int = -1
 
     def __post_init__(self):
         if self.masks.shape != self.trans.shape:
@@ -52,6 +59,60 @@ class GuidedFSM:
         # precomputed additive biases [S, V]: the decode hot loop indexes a
         # row per step instead of running a full-vocab np.where per slot
         self._biases = np.where(self.masks, np.float32(0.0), NEG)
+        # distance-to-accept + closing tables are computed LAZILY: a
+        # guided_choice request builds a fresh FSM per request and (with
+        # max_tokens bumped past the longest choice) never consults them —
+        # paying O(S*V) setup + a second [S,V] table there buys nothing
+        self._dist: np.ndarray | None = None
+        self._closing: np.ndarray | None = None
+
+    @property
+    def dist(self) -> np.ndarray:
+        """Per-state minimum tokens (excl. eos) to reach an accepting
+        state; int32-max where acceptance is unreachable."""
+        self._ensure_closing()
+        return self._dist
+
+    def _ensure_closing(self) -> None:
+        if self._dist is not None:
+            return
+        S, V = self.masks.shape
+        dist = np.full((S,), np.iinfo(np.int32).max, np.int64)
+        closing_bias = self._biases
+        if 0 <= self.eos_id < V:
+            # reverse BFS from accepting states (eos admitted there)
+            dist[self.masks[:, self.eos_id]] = 0
+            frontier = list(np.nonzero(dist == 0)[0])
+            radj: dict = {}
+            for s in range(S):
+                for t in np.nonzero(self.masks[s])[0]:
+                    if t != self.eos_id:
+                        radj.setdefault(int(self.trans[s, t]), []).append(s)
+            d = 0
+            while frontier:
+                d += 1
+                nxt = []
+                for tgt in frontier:
+                    for s in radj.get(int(tgt), ()):
+                        if dist[s] > d:
+                            dist[s] = d
+                            nxt.append(s)
+                frontier = nxt
+            closing = np.zeros((S, V), bool)
+            for s in range(S):
+                if dist[s] == 0:
+                    closing[s, self.eos_id] = True  # stop NOW
+                elif dist[s] < np.iinfo(np.int32).max:
+                    for t in np.nonzero(self.masks[s])[0]:
+                        if (t != self.eos_id
+                                and dist[int(self.trans[s, t])]
+                                == dist[s] - 1):
+                            closing[s, t] = True
+                else:
+                    closing[s] = self.masks[s]  # accept unreachable: free
+            closing_bias = np.where(closing, np.float32(0.0), NEG)
+        self._dist = dist
+        self._closing = closing_bias
 
     @property
     def vocab_size(self) -> int:
@@ -102,7 +163,39 @@ class GuidedFSM:
                 masks[s, eos_id] = True
                 trans[s, eos_id] = eos_state
         masks[eos_state, eos_id] = True
-        return cls(masks=masks, trans=trans, start=0)
+        return cls(masks=masks, trans=trans, start=0, eos_id=eos_id)
+
+    @classmethod
+    def from_regex(cls, pattern: str, vocab_size: int, eos_id: int,
+                   *, token_of: "callable | None" = None) -> "GuidedFSM":
+        """Compile a regex SUBSET (literals, ``[...]`` classes incl.
+        ranges/negation, ``.``, ``* + ?``, ``|``, ``( )``) to a DFA over
+        token ids. ``token_of(char) -> token id`` maps symbols (default:
+        ``ord`` — exact for byte-level tokenizers, where one token is one
+        character; the ``guided_regex`` feature of the reference's
+        structured-output stack). EOS is admitted exactly in accepting
+        states."""
+        nfa_start, nfa_accept = _regex_to_nfa(pattern)
+        dfa = _nfa_to_dfa(nfa_start, nfa_accept)
+        token_of = token_of or ord
+        n = len(dfa.states) + 1
+        eos_state = n - 1
+        masks = np.zeros((n, vocab_size), bool)
+        trans = np.full((n, vocab_size), eos_state, np.int32)
+        for si, (edges, accepting) in enumerate(dfa.states):
+            for ch, ti in edges.items():
+                tok = token_of(ch)
+                if not (0 <= tok < vocab_size):
+                    raise ValueError(
+                        f"regex symbol {ch!r} maps to token {tok} outside "
+                        f"vocab {vocab_size}")
+                masks[si, tok] = True
+                trans[si, tok] = ti
+            if accepting:
+                masks[si, eos_id] = True
+        masks[eos_state, eos_id] = True
+        return cls(masks=masks, trans=trans, start=dfa.start,
+                   eos_id=eos_id)
 
     @classmethod
     def from_token_sets(cls, sets: list, vocab_size: int,
@@ -122,10 +215,180 @@ class GuidedFSM:
                 masks[i, tok] = True
                 trans[i, tok] = i + 1
         masks[eos_state, eos_id] = True
-        return cls(masks=masks, trans=trans, start=0)
+        return cls(masks=masks, trans=trans, start=0, eos_id=eos_id)
 
 
-def bias_row(fsm: GuidedFSM, state: int) -> np.ndarray:
+def bias_row(fsm: GuidedFSM, state: int,
+             remaining: int | None = None) -> np.ndarray:
     """Additive logit bias for one slot: 0 where allowed, -1e9 elsewhere
-    (precomputed at FSM construction; this is a row view)."""
+    (precomputed at FSM construction; this is a row view). With
+    ``remaining`` (tokens of budget left incl. this one) the CLOSING row
+    is used once the budget only just covers the distance to acceptance —
+    the output is then guaranteed to complete before max_tokens."""
+    if remaining is not None and fsm.eos_id >= 0:
+        # S-1 bounds every finite distance: a budget beyond that can never
+        # be tight, so the (lazy, cached) closing tables aren't even built
+        if remaining <= fsm.masks.shape[0]:
+            fsm._ensure_closing()
+            if remaining <= fsm._dist[state] + 1:
+                return fsm._closing[state]
     return fsm._biases[state]
+
+
+# ----------------------------------------------------- regex → NFA → DFA
+# Thompson construction over an explicit character alphabet (printable
+# ASCII by default): enough regex for the structured-output use cases
+# (enums, numbers, identifiers, fixed-layout records) without importing a
+# full engine. ``.`` and negated classes range over _ALPHABET.
+
+_ALPHABET = [chr(c) for c in range(32, 127)]
+
+
+class _NState:
+    __slots__ = ("edges", "eps")
+
+    def __init__(self):
+        self.edges: dict = {}   # char -> _NState
+        self.eps: list = []     # epsilon transitions
+
+
+def _parse_class(pattern: str, i: int) -> tuple:
+    """Parse ``[...]`` starting after '['; returns (chars, next_index)."""
+    neg = i < len(pattern) and pattern[i] == "^"
+    if neg:
+        i += 1
+    chars: set = set()
+    while i < len(pattern) and pattern[i] != "]":
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            i += 1
+            c = pattern[i]
+        if (i + 2 < len(pattern) and pattern[i + 1] == "-"
+                and pattern[i + 2] != "]"):
+            lo, hi = c, pattern[i + 2]
+            chars.update(chr(x) for x in range(ord(lo), ord(hi) + 1))
+            i += 3
+        else:
+            chars.add(c)
+            i += 1
+    if i >= len(pattern):
+        raise ValueError(f"unterminated character class in {pattern!r}")
+    if neg:
+        chars = set(_ALPHABET) - chars
+    return sorted(chars), i + 1  # skip ']'
+
+
+def _regex_to_nfa(pattern: str) -> tuple:
+    """Recursive-descent Thompson construction. Returns (start, accept)."""
+
+    def atom(i: int) -> tuple:
+        """One atom; returns (start, end, next_i)."""
+        if i >= len(pattern):
+            raise ValueError(
+                f"pattern ends where an atom was expected: {pattern!r}")
+        c = pattern[i]
+        if c == "(":
+            s, e, i = alt(i + 1)
+            if i >= len(pattern) or pattern[i] != ")":
+                raise ValueError(f"unbalanced '(' in {pattern!r}")
+            return s, e, i + 1
+        if c == "[":
+            chars, i = _parse_class(pattern, i + 1)
+            s, e = _NState(), _NState()
+            for ch in chars:
+                s.edges.setdefault(ch, []).append(e)
+            return s, e, i
+        if c == ".":
+            s, e = _NState(), _NState()
+            for ch in _ALPHABET:
+                s.edges.setdefault(ch, []).append(e)
+            return s, e, i + 1
+        if c == "\\" and i + 1 < len(pattern):
+            c, i = pattern[i + 1], i + 1
+        elif c in ")|*+?":
+            raise ValueError(f"unexpected {c!r} at {i} in {pattern!r}")
+        s, e = _NState(), _NState()
+        s.edges.setdefault(c, []).append(e)
+        return s, e, i + 1
+
+    def repeat(i: int) -> tuple:
+        s, e, i = atom(i)
+        while i < len(pattern) and pattern[i] in "*+?":
+            op = pattern[i]
+            ns, ne = _NState(), _NState()
+            ns.eps.append(s)
+            e.eps.append(ne)
+            if op in "*?":
+                ns.eps.append(ne)   # skip
+            if op in "*+":
+                e.eps.append(s)     # loop
+            s, e, i = ns, ne, i + 1
+        return s, e, i
+
+    def concat(i: int) -> tuple:
+        s, e, i = repeat(i)
+        while i < len(pattern) and pattern[i] not in ")|":
+            s2, e2, i = repeat(i)
+            e.eps.append(s2)
+            e = e2
+        return s, e, i
+
+    def alt(i: int) -> tuple:
+        s, e, i = concat(i)
+        while i < len(pattern) and pattern[i] == "|":
+            s2, e2, i = concat(i + 1)
+            ns, ne = _NState(), _NState()
+            ns.eps.extend([s, s2])
+            e.eps.append(ne)
+            e2.eps.append(ne)
+            s, e = ns, ne
+        return s, e, i
+
+    if not pattern:
+        raise ValueError("empty regex")
+    s, e, i = alt(0)
+    if i != len(pattern):
+        raise ValueError(f"trailing {pattern[i:]!r} in {pattern!r}")
+    return s, e
+
+
+class _Dfa:
+    __slots__ = ("states", "start")
+
+    def __init__(self, states, start):
+        # states: list of (edges: {char: state_idx}, accepting: bool)
+        self.states = states
+        self.start = start
+
+
+def _nfa_to_dfa(start: "_NState", accept: "_NState") -> _Dfa:
+    def closure(states: frozenset) -> frozenset:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            st = stack.pop()
+            for nxt in st.eps:
+                if nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
+        return frozenset(out)
+
+    start_set = closure(frozenset([start]))
+    index = {start_set: 0}
+    worklist = [start_set]
+    states: list = [({}, accept in start_set)]
+    while worklist:
+        cur = worklist.pop()
+        ci = index[cur]
+        by_char: dict = {}
+        for st in cur:
+            for ch, targets in st.edges.items():
+                by_char.setdefault(ch, set()).update(targets)
+        for ch, targets in by_char.items():
+            nxt = closure(frozenset(targets))
+            if nxt not in index:
+                index[nxt] = len(states)
+                states.append(({}, accept in nxt))
+                worklist.append(nxt)
+            states[ci][0][ch] = index[nxt]
+    return _Dfa(states, 0)

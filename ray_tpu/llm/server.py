@@ -153,6 +153,26 @@ class LLMServer:
             body = {**body, "max_tokens": max(
                 int(body.get("max_tokens", 64)),
                 max(len(e) for e in encoded) + 1)}
+        elif body.get("guided_regex"):
+            # regex flavor: exact for byte-level tokenizers, where one
+            # token is one character (reference: guided_decoding regex)
+            from ray_tpu.llm.guided import GuidedFSM
+
+            if eos is None:
+                raise ValueError(
+                    "guided_regex requires a tokenizer with an EOS token")
+            if not getattr(self.tokenizer, "byte_level", False):
+                raise ValueError(
+                    "guided_regex needs a byte-level tokenizer (one token "
+                    "per character); use guided_choice for subword models")
+            guided = GuidedFSM.from_regex(
+                body["guided_regex"], self.engine.cfg.vocab_size, eos)
+            # a budget below the pattern's minimum length could only ever
+            # return a truncated non-match: bump like guided_choice does
+            min_len = int(guided.dist[guided.start])
+            if min_len < 2 ** 31 - 1:
+                body = {**body, "max_tokens": max(
+                    int(body.get("max_tokens", 64)), min_len + 1)}
         return SamplingParams(
             max_tokens=int(body.get("max_tokens", 64)),
             temperature=float(body.get("temperature", 0.0)),

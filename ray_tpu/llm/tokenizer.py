@@ -17,6 +17,8 @@ class ByteTokenizer:
     BOS = 257
     EOS = 258
     vocab_size = 259
+    # one token per byte: character-level FSMs (guided_regex) are exact
+    byte_level = True
 
     @property
     def eos_token_id(self) -> int:

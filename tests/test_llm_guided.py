@@ -174,3 +174,117 @@ def test_server_guided_choice_end_to_end():
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+def test_regex_fsm_constrains_engine():
+    import re
+
+    # yes|no followed by 1+ digits, over the byte-id alphabet (ord == id)
+    fsm = GuidedFSM.from_regex("(ok|no)[0-9]+", 300, EOS_BYTE := 258)
+    cfg_vocab = 300
+    import jax
+    import jax.numpy as jnp
+
+    cfg = llama_config("tiny", vocab_size=cfg_vocab, max_seq_len=256,
+                       d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                       d_ff=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=256)
+    try:
+        for seed in (3, 5, 11):
+            out = eng.generate([seed, 7, 19], SamplingParams(
+                max_tokens=10, stop_token_ids=(EOS_BYTE,), guided=fsm))
+            text = "".join(chr(t) for t in out if t != EOS_BYTE)
+            assert re.fullmatch(r"(ok|no)[0-9]+", text), (seed, text)
+    finally:
+        eng.shutdown()
+
+
+def test_regex_builder_semantics():
+    f = GuidedFSM.from_regex("a[bc]?d*", 300, 258)
+    s = f.start
+    assert f.masks[s, ord("a")] and not f.masks[s, ord("b")]
+    s1 = f.step(s, ord("a"))
+    # after 'a': accepting (eos), or b/c, or d
+    assert f.masks[s1, 258] and f.masks[s1, ord("b")] and f.masks[s1, ord("d")]
+    s2 = f.step(s1, ord("c"))
+    assert f.masks[s2, 258] and f.masks[s2, ord("d")] and not f.masks[s2, ord("b")]
+    s3 = f.step(s2, ord("d"))
+    assert f.masks[s3, ord("d")] and f.masks[s3, 258]
+
+    # negated class + dot + plus
+    g = GuidedFSM.from_regex("[^x]y+", 300, 258)
+    assert not g.masks[g.start, ord("x")] and g.masks[g.start, ord("q")]
+
+    with pytest.raises(ValueError, match="unbalanced|unexpected"):
+        GuidedFSM.from_regex("(ab", 300, 258)
+    with pytest.raises(ValueError, match="unterminated"):
+        GuidedFSM.from_regex("[ab", 300, 258)
+    with pytest.raises(ValueError, match="empty"):
+        GuidedFSM.from_regex("", 300, 258)
+
+
+def test_server_guided_regex_end_to_end():
+    import re
+
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, ModelLoadingConfig, build_openai_app
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=8)
+    try:
+        cfg = LLMConfig(
+            model_loading_config=ModelLoadingConfig(model_id="tiny",
+                                                    tokenizer="byte"),
+            model_family="llama",
+            model_kwargs=dict(vocab_size=300, max_seq_len=128, d_model=64,
+                              n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+                              dtype=jnp.float32, remat=False),
+            engine_kwargs={"max_slots": 4, "max_len": 128, "min_bucket": 16},
+        )
+        handle = serve.run(build_openai_app(cfg), name="llmr",
+                           route_prefix="/llmr")
+        out = handle.completions.remote(
+            {"prompt": "id:", "max_tokens": 12,
+             "guided_regex": "[A-Z][a-z]+-[0-9][0-9]"}).result(timeout_s=120)
+        text = out["choices"][0]["text"]
+        assert re.fullmatch(r"[A-Z][a-z]+-[0-9][0-9]", text), out
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_budget_aware_closing_completes_unbounded_patterns():
+    """An unbounded `+` must not overrun max_tokens mid-pattern: the FSM's
+    distance-to-accept switches decoding to budget-decreasing tokens."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    fsm = GuidedFSM.from_regex("[a-z]+-[0-9]+", 300, 258)
+    # closing tables: accepting states stop NOW; others step strictly closer
+    assert fsm.dist[fsm.start] >= 3  # needs letter, dash, digit minimum
+    cfg = llama_config("tiny", vocab_size=300, max_seq_len=256,
+                       d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                       d_ff=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=256)
+    try:
+        for budget in (4, 5, 8):
+            out = eng.generate([9, 3, 17], SamplingParams(
+                max_tokens=budget, stop_token_ids=(258,), guided=fsm))
+            text = "".join(chr(t) for t in out if t != 258)
+            assert re.fullmatch(r"[a-z]+-[0-9]+", text), (budget, text)
+            assert len(out) <= budget
+    finally:
+        eng.shutdown()
+
+
+def test_regex_parser_clean_errors():
+    for bad in ("a|", "(", "ab(", "a|*"):
+        with pytest.raises(ValueError):
+            GuidedFSM.from_regex(bad, 300, 258)
