@@ -59,12 +59,11 @@ class GuidedFSM:
         # precomputed additive biases [S, V]: the decode hot loop indexes a
         # row per step instead of running a full-vocab np.where per slot
         self._biases = np.where(self.masks, np.float32(0.0), NEG)
-        # distance-to-accept + closing tables are computed LAZILY: a
-        # guided_choice request builds a fresh FSM per request and (with
-        # max_tokens bumped past the longest choice) never consults them —
-        # paying O(S*V) setup + a second [S,V] table there buys nothing
+        # distance-to-accept is computed LAZILY: a guided_choice request
+        # builds a fresh FSM per request and (with max_tokens bumped past
+        # the longest choice) never consults it — paying O(S*V) setup
+        # there buys nothing
         self._dist: np.ndarray | None = None
-        self._closing: np.ndarray | None = None
 
     @property
     def dist(self) -> np.ndarray:
@@ -78,7 +77,6 @@ class GuidedFSM:
             return
         S, V = self.masks.shape
         dist = np.full((S,), np.iinfo(np.int32).max, np.int64)
-        closing_bias = self._biases
         if 0 <= self.eos_id < V:
             # reverse BFS from accepting states (eos admitted there)
             dist[self.masks[:, self.eos_id]] = 0
@@ -98,21 +96,7 @@ class GuidedFSM:
                             dist[s] = d
                             nxt.append(s)
                 frontier = nxt
-            closing = np.zeros((S, V), bool)
-            for s in range(S):
-                if dist[s] == 0:
-                    closing[s, self.eos_id] = True  # stop NOW
-                elif dist[s] < np.iinfo(np.int32).max:
-                    for t in np.nonzero(self.masks[s])[0]:
-                        if (t != self.eos_id
-                                and dist[int(self.trans[s, t])]
-                                == dist[s] - 1):
-                            closing[s, t] = True
-                else:
-                    closing[s] = self.masks[s]  # accept unreachable: free
-            closing_bias = np.where(closing, np.float32(0.0), NEG)
         self._dist = dist
-        self._closing = closing_bias
 
     @property
     def vocab_size(self) -> int:
@@ -221,17 +205,30 @@ class GuidedFSM:
 def bias_row(fsm: GuidedFSM, state: int,
              remaining: int | None = None) -> np.ndarray:
     """Additive logit bias for one slot: 0 where allowed, -1e9 elsewhere
-    (precomputed at FSM construction; this is a row view). With
-    ``remaining`` (tokens of budget left incl. this one) the CLOSING row
-    is used once the budget only just covers the distance to acceptance —
-    the output is then guaranteed to complete before max_tokens."""
+    (precomputed at FSM construction; this is a row view).
+
+    With ``remaining`` (tokens of budget left incl. the one being sampled)
+    the row is PER-TOKEN budget-feasible: token t stays allowed only if
+    after taking it the leftover budget still covers the successor state's
+    distance-to-accept plus the final EOS. This is inductive — a branch
+    whose completion can't fit is masked BEFORE entering it (a state-level
+    switch would fire too late for distance-INCREASING alternatives like
+    'a|bcdef' at budget 3) — so outputs always complete within
+    max_tokens."""
     if remaining is not None and fsm.eos_id >= 0:
         # S-1 bounds every finite distance: a budget beyond that can never
-        # be tight, so the (lazy, cached) closing tables aren't even built
+        # be tight, so the (lazy, cached) distance table isn't even built
         if remaining <= fsm.masks.shape[0]:
             fsm._ensure_closing()
-            if remaining <= fsm._dist[state] + 1:
-                return fsm._closing[state]
+            dist_next = fsm._dist[fsm.trans[state]]  # [V]
+            feasible = fsm.masks[state] & (dist_next + 2 <= remaining)
+            if fsm.masks[state, fsm.eos_id] and remaining >= 1:
+                feasible = feasible.copy()
+                feasible[fsm.eos_id] = True
+            if feasible.any():
+                return np.where(feasible, np.float32(0.0), NEG)
+            # no feasible completion (caller under-budgeted below the
+            # minimum): fall back to the plain mask — prefix-valid output
     return fsm._biases[state]
 
 
@@ -252,6 +249,32 @@ class _NState:
         self.eps: list = []     # epsilon transitions
 
 
+_SHORTHAND = {
+    "d": set("0123456789"),
+    "w": set("abcdefghijklmnopqrstuvwxyz"
+             "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+    "s": set(" \t\n\r"),
+}
+
+
+def _read_symbol(pattern: str, i: int) -> tuple:
+    """One class symbol at i; returns (char | shorthand-set, next_index).
+    Unknown alphanumeric escapes raise — silently treating ``\\d`` as the
+    letter 'd' would change the constraint without an error."""
+    c = pattern[i]
+    if c != "\\":
+        return c, i + 1
+    if i + 1 >= len(pattern):
+        raise ValueError(f"dangling backslash in {pattern!r}")
+    e = pattern[i + 1]
+    if e in _SHORTHAND:
+        return _SHORTHAND[e], i + 2
+    if e.isalnum():
+        raise ValueError(f"unsupported escape \\{e} in {pattern!r} "
+                         "(supported: \\d \\w \\s and punctuation)")
+    return e, i + 2
+
+
 def _parse_class(pattern: str, i: int) -> tuple:
     """Parse ``[...]`` starting after '['; returns (chars, next_index)."""
     neg = i < len(pattern) and pattern[i] == "^"
@@ -259,22 +282,28 @@ def _parse_class(pattern: str, i: int) -> tuple:
         i += 1
     chars: set = set()
     while i < len(pattern) and pattern[i] != "]":
-        c = pattern[i]
-        if c == "\\" and i + 1 < len(pattern):
-            i += 1
-            c = pattern[i]
-        if (i + 2 < len(pattern) and pattern[i + 1] == "-"
-                and pattern[i + 2] != "]"):
-            lo, hi = c, pattern[i + 2]
-            chars.update(chr(x) for x in range(ord(lo), ord(hi) + 1))
-            i += 3
+        sym, i = _read_symbol(pattern, i)
+        if isinstance(sym, set):
+            chars.update(sym)
+            continue
+        if (i + 1 < len(pattern) and pattern[i] == "-"
+                and pattern[i + 1] != "]"):
+            hi, i = _read_symbol(pattern, i + 1)
+            if isinstance(hi, set):
+                raise ValueError(
+                    f"shorthand cannot end a range in {pattern!r}")
+            if ord(hi) < ord(sym):
+                raise ValueError(f"empty range {sym}-{hi} in {pattern!r}")
+            chars.update(chr(x) for x in range(ord(sym), ord(hi) + 1))
         else:
-            chars.add(c)
-            i += 1
+            chars.add(sym)
     if i >= len(pattern):
         raise ValueError(f"unterminated character class in {pattern!r}")
     if neg:
         chars = set(_ALPHABET) - chars
+    if not chars:
+        raise ValueError(f"empty (or fully-negated) character class in "
+                         f"{pattern!r}: it can never match")
     return sorted(chars), i + 1  # skip ']'
 
 
@@ -303,9 +332,13 @@ def _regex_to_nfa(pattern: str) -> tuple:
             for ch in _ALPHABET:
                 s.edges.setdefault(ch, []).append(e)
             return s, e, i + 1
-        if c == "\\" and i + 1 < len(pattern):
-            c, i = pattern[i + 1], i + 1
-        elif c in ")|*+?":
+        if c == "\\":
+            sym, i2 = _read_symbol(pattern, i)
+            s, e = _NState(), _NState()
+            for ch in (sym if isinstance(sym, set) else (sym,)):
+                s.edges.setdefault(ch, []).append(e)
+            return s, e, i2
+        if c in ")|*+?":
             raise ValueError(f"unexpected {c!r} at {i} in {pattern!r}")
         s, e = _NState(), _NState()
         s.edges.setdefault(c, []).append(e)
@@ -361,6 +394,9 @@ class _Dfa:
         self.start = start
 
 
+_MAX_DFA_STATES = 4096
+
+
 def _nfa_to_dfa(start: "_NState", accept: "_NState") -> _Dfa:
     def closure(states: frozenset) -> frozenset:
         out = set(states)
@@ -387,6 +423,13 @@ def _nfa_to_dfa(start: "_NState", accept: "_NState") -> _Dfa:
         for ch, targets in by_char.items():
             nxt = closure(frozenset(targets))
             if nxt not in index:
+                if len(states) >= _MAX_DFA_STATES:
+                    # subset construction can blow up exponentially
+                    # ((Σ)*aΣ^n forms); user-supplied patterns on the
+                    # serving path must not be a memory/CPU DoS vector
+                    raise ValueError(
+                        f"regex compiles to more than {_MAX_DFA_STATES} "
+                        "DFA states; simplify the pattern")
                 index[nxt] = len(states)
                 states.append(({}, accept in nxt))
                 worklist.append(nxt)

@@ -165,6 +165,8 @@ class LLMServer:
                 raise ValueError(
                     "guided_regex needs a byte-level tokenizer (one token "
                     "per character); use guided_choice for subword models")
+            if len(body["guided_regex"]) > 1024:
+                raise ValueError("guided_regex longer than 1024 chars")
             guided = GuidedFSM.from_regex(
                 body["guided_regex"], self.engine.cfg.vocab_size, eos)
             # a budget below the pattern's minimum length could only ever

@@ -288,3 +288,62 @@ def test_regex_parser_clean_errors():
     for bad in ("a|", "(", "ab(", "a|*"):
         with pytest.raises(ValueError):
             GuidedFSM.from_regex(bad, 300, 258)
+
+
+def test_budget_feasibility_masks_long_branches():
+    """'a|bcdef' at budget 3: entering the 'b' branch is infeasible (needs
+    5 more tokens) and must be masked BEFORE the model steps into it."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    fsm = GuidedFSM.from_regex("a|bcdef", 300, 258)
+    row = bias_row(fsm, fsm.start, remaining=3)
+    assert row[ord("a")] == 0.0
+    assert row[ord("b")] < -1e8  # infeasible branch pre-masked
+    # with enough budget both branches open
+    row = bias_row(fsm, fsm.start, remaining=7)
+    assert row[ord("a")] == 0.0 and row[ord("b")] == 0.0
+
+    cfg = llama_config("tiny", vocab_size=300, max_seq_len=128,
+                       d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                       d_ff=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    eng = TPUEngine(cfg, params, max_slots=2, max_len=128)
+    try:
+        for seed in (2, 9, 30):
+            out = eng.generate([seed, 4], SamplingParams(
+                max_tokens=3, stop_token_ids=(258,), guided=fsm))
+            text = "".join(chr(t) for t in out if t != 258)
+            assert re.fullmatch(r"a|bcdef", text), (seed, text)
+    finally:
+        eng.shutdown()
+
+
+def test_regex_escapes_and_class_edge_cases():
+    # shorthand classes are real classes, not literal letters
+    f = GuidedFSM.from_regex(r"\d+", 300, 258)
+    assert f.masks[f.start, ord("5")] and not f.masks[f.start, ord("d")]
+    f = GuidedFSM.from_regex(r"[\w]", 300, 258)
+    assert f.masks[f.start, ord("_")] and f.masks[f.start, ord("Z")]
+    # unknown alphanumeric escape raises instead of silently matching 'q'
+    with pytest.raises(ValueError, match="unsupported escape"):
+        GuidedFSM.from_regex(r"\q", 300, 258)
+    # escaped punctuation stays literal
+    f = GuidedFSM.from_regex(r"\.\+", 300, 258)
+    assert f.masks[f.start, ord(".")] and not f.masks[f.start, ord("x")]
+    # empty / inverted-to-empty / backwards classes raise
+    with pytest.raises(ValueError, match="empty"):
+        GuidedFSM.from_regex("[]", 300, 258)
+    with pytest.raises(ValueError, match="empty range"):
+        GuidedFSM.from_regex("[z-a]", 300, 258)
+    # escaped range bound applies the escape to the bound itself
+    f = GuidedFSM.from_regex(r"[\--0]", 300, 258)  # '-' .. '0'
+    assert f.masks[f.start, ord("-")] and f.masks[f.start, ord("/")]
+
+
+def test_regex_dfa_state_cap():
+    # (Σ)*aΣ^n subset-construction blowup must be rejected, not compiled
+    with pytest.raises(ValueError, match="DFA states"):
+        GuidedFSM.from_regex(".*a" + "." * 20, 300, 258)
