@@ -102,6 +102,7 @@ class _Actor:
         "aid", "state", "worker", "queue", "in_flight", "max_concurrency",
         "create_spec", "name",
         "restarts_left", "waiters", "kill_requested", "num_restarts",
+        "max_task_retries",
     )
 
     def __init__(self, aid: str, create_spec: dict):
@@ -114,6 +115,11 @@ class _Actor:
         self.create_spec = create_spec
         self.name: str | None = create_spec.get("name")
         self.restarts_left: int = create_spec.get("max_restarts", 0)
+        # in-flight method calls lost to a worker death are retried on the
+        # restarted actor up to this many times each (-1 = unlimited);
+        # 0 = fail with ActorDiedError (reference: actor max_task_retries)
+        self.max_task_retries: int = int(
+            create_spec.get("max_task_retries") or 0)
         self.num_restarts = 0
         self.waiters: list[tuple[MsgConnection, int]] = []  # ready-waiters
         self.kill_requested = False
@@ -3430,11 +3436,37 @@ class GcsServer:
                 actor = self.actors.get(aid)
                 if actor is not None:
                     self._release_for(actor.create_spec)
-                    fail.extend(s for s in specs
-                                if s["kind"] in ("actor_task", "actor_create"))
+                    will_restart = (actor.restarts_left != 0
+                                    and actor.state != "dead")
+                    # in-flight method calls: retried on the restarted
+                    # actor while their per-spec budget lasts (reference:
+                    # max_task_retries), else failed with ActorDiedError.
+                    # Never retried: streams (items already consumed — same
+                    # guard as the plain-task path above) and deaths caused
+                    # by an explicit kill() (reference: ray.kill interrupts
+                    # fail regardless of the retry budget)
+                    can_retry = will_restart and not actor.kill_requested
+                    retry_q = []
+                    for s in specs:
+                        if s["kind"] != "actor_task":
+                            if s["kind"] == "actor_create":
+                                fail.append(s)
+                            continue
+                        mtr = actor.max_task_retries
+                        used = s.get("retries_used", 0)
+                        if (can_retry
+                                and s["num_returns"] != "streaming"
+                                and (mtr == -1 or used < mtr)):
+                            s["retries_used"] = used + 1
+                            retry_q.append(s)
+                        else:
+                            fail.append(s)
+                    # lost calls run FIRST on the restarted actor, ahead of
+                    # the queued backlog that never dispatched
+                    actor.queue.extendleft(reversed(retry_q))
                     actor.in_flight = 0
                     actor.worker = None
-                    if actor.restarts_left != 0 and actor.state != "dead":
+                    if will_restart:
                         if actor.restarts_left > 0:
                             actor.restarts_left -= 1
                         actor.state = "restarting"

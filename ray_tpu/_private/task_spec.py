@@ -107,6 +107,9 @@ def validate_actor(spec: dict) -> dict:
     mr = spec.get("max_restarts", 0)
     if not isinstance(mr, int) or mr < -1:
         raise SpecError(f"{where}: max_restarts must be an int >= -1")
+    mtr = spec.get("max_task_retries", 0)
+    if not isinstance(mtr, int) or mtr < -1:
+        raise SpecError(f"{where}: max_task_retries must be an int >= -1")
     mc = spec.get("max_concurrency", 1)
     if not isinstance(mc, int) or mc < 1:
         raise SpecError(f"{where}: max_concurrency must be an int >= 1")
@@ -169,6 +172,7 @@ class ActorSpec:
     name: str | None
     resources: dict
     max_restarts: int
+    max_task_retries: int
     max_concurrency: int
     strategy: dict | None
 
@@ -178,5 +182,6 @@ class ActorSpec:
         return cls(actor_id=spec["actor_id"], name=spec.get("name"),
                    resources=dict(spec.get("resources") or {}),
                    max_restarts=spec.get("max_restarts", 0),
+                   max_task_retries=spec.get("max_task_retries", 0),
                    max_concurrency=spec.get("max_concurrency", 1),
                    strategy=spec.get("strategy"))

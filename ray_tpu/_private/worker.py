@@ -1094,6 +1094,7 @@ class CoreWorker:
         *,
         resources: dict | None = None,
         max_restarts: int = 0,
+        max_task_retries: int = 0,
         name: str | None = None,
         strategy: dict | None = None,
         max_concurrency: int = 1,
@@ -1116,6 +1117,7 @@ class CoreWorker:
             "num_returns": 0,
             "resources": resources or {"CPU": 1.0},
             "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
             "name": name,
             "strategy": strategy,
             # the GCS gates dispatch on total concurrency: named groups

@@ -92,12 +92,14 @@ _UNSET = object()
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, name=None, lifetime=None, scheduling_strategy=None,
+                 max_restarts=0, max_task_retries=0, name=None, lifetime=None,
+                 scheduling_strategy=None,
                  max_concurrency=1, runtime_env=None, concurrency_groups=None):
         self._cls = cls
         self._opts = {"num_cpus": num_cpus, "num_tpus": num_tpus, "resources": resources}
         self._resources = _build_resources(num_cpus, num_tpus, resources)
         self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
         self._name = name
         self._strategy = scheduling_strategy
         self._max_concurrency = max_concurrency
@@ -113,7 +115,8 @@ class ActorClass:
         return self._blob
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
-                max_restarts=None, name=None, lifetime=None,
+                max_restarts=None, max_task_retries=None, name=None,
+                lifetime=None,
                 scheduling_strategy=_UNSET, max_concurrency=None,
                 runtime_env=_UNSET, concurrency_groups=None,
                 **_ignored) -> "ActorClass":
@@ -123,6 +126,8 @@ class ActorClass:
             num_tpus=self._opts["num_tpus"] if num_tpus is None else num_tpus,
             resources=self._opts["resources"] if resources is None else resources,
             max_restarts=self._max_restarts if max_restarts is None else max_restarts,
+            max_task_retries=(self._max_task_retries if max_task_retries
+                              is None else max_task_retries),
             name=name if name is not None else self._name,
             lifetime=lifetime,
             scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
@@ -149,6 +154,7 @@ class ActorClass:
             kwargs,
             resources=self._resources,
             max_restarts=self._max_restarts,
+            max_task_retries=self._max_task_retries,
             name=self._name,
             strategy=strategy_to_spec(self._strategy),
             max_concurrency=self._max_concurrency,

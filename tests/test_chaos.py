@@ -168,3 +168,48 @@ print("DROP-CHAOS-OK")
                        text=True, timeout=300, env=env, cwd="/root/repo")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "DROP-CHAOS-OK" in r.stdout
+
+
+def test_max_task_retries_inflight_calls_survive_restart(session):
+    """In-flight method calls lost to a SIGKILL are retried on the
+    restarted actor (reference: actor max_task_retries) — the caller's
+    pending get() resolves instead of raising ActorDiedError."""
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+    class Slow:
+        def pid(self):
+            return os.getpid()
+
+        def compute(self, x):
+            time.sleep(1.0)
+            return x * 10
+
+    a = Slow.remote()
+    victim = ray_tpu.get(a.pid.remote(), timeout=60)
+    ref = a.compute.remote(7)          # in flight while we murder the pid
+    time.sleep(0.3)
+    os.kill(victim, signal.SIGKILL)
+    assert ray_tpu.get(ref, timeout=120) == 70
+    assert ray_tpu.get(a.pid.remote(), timeout=60) != victim
+
+
+def test_zero_task_retries_inflight_calls_fail(session):
+    @ray_tpu.remote(max_restarts=-1)  # max_task_retries defaults to 0
+    class Slow:
+        def pid(self):
+            return os.getpid()
+
+        def compute(self, x):
+            time.sleep(1.0)
+            return x * 10
+
+    a = Slow.remote()
+    victim = ray_tpu.get(a.pid.remote(), timeout=60)
+    ref = a.compute.remote(7)
+    time.sleep(0.3)
+    os.kill(victim, signal.SIGKILL)
+    # must FAIL FAST with the actor-death error — a bare timeout would
+    # mean the no-budget path wrongly requeued the call
+    with pytest.raises(Exception, match="[Aa]ctor|died|worker.*died"):
+        ray_tpu.get(ref, timeout=120)
+    # the actor itself restarts and keeps serving
+    assert ray_tpu.get(a.compute.remote(2), timeout=120) == 20
