@@ -23,6 +23,7 @@ _lock = threading.RLock()
 _node: Node | None = None
 _worker = None  # CoreWorker | LocalWorker
 _is_worker_process = False
+_namespace_env_set = False  # init(namespace=...) exported the env var
 
 
 def _get_worker():
@@ -55,6 +56,7 @@ def init(
     max_workers: int = 16,
     ignore_reinit_error: bool = True,
     runtime_env: dict | None = None,
+    namespace: str | None = None,
 ):
     """Start a new session, or join an existing one with `address=` (a GCS
     `host:port` / `unix:<path>`, or env RAY_TPU_ADDRESS — how submitted jobs
@@ -68,12 +70,23 @@ def init(
             raise RayTpuError("ray_tpu already initialized")
         if local_mode or os.environ.get("RAY_TPU_LOCAL_MODE") == "1":
             _worker = LocalWorker()
+            if namespace:
+                _worker.namespace = namespace
             set_global_worker(None)
             return {"session_id": "local"}
+        global _namespace_env_set
+        if namespace:
+            # the driver's namespace scopes its named actors; exported so
+            # worker processes spawned for this session inherit it
+            # (reference: ray.init(namespace=...))
+            os.environ["RAY_TPU_NAMESPACE"] = namespace
+            _namespace_env_set = True
         address = address or os.environ.get("RAY_TPU_ADDRESS")
         if address:
             _worker = CoreWorker(address, os.environ.get("RAY_TPU_SESSION"),
                                  kind="driver")
+            if namespace:
+                _worker.namespace = namespace
             if runtime_env:
                 _worker.default_runtime_env = runtime_env
             atexit.register(shutdown)
@@ -86,6 +99,8 @@ def init(
             max_workers=max_workers,
         )
         _worker = CoreWorker(_node.socket_path, _node.session_id, kind="driver")
+        if namespace:
+            _worker.namespace = namespace
         if runtime_env:
             # job-level default: every task/actor without its own runtime_env
             # inherits it (reference: ray.init(runtime_env=...))
@@ -113,6 +128,13 @@ def shutdown():
             _node.shutdown()
         _node = None
         _worker = None
+        # don't leak this driver's namespace into the next init() in the
+        # same process (test isolation) — but never clobber an env var the
+        # USER exported themselves
+        global _namespace_env_set
+        if _namespace_env_set:
+            os.environ.pop("RAY_TPU_NAMESPACE", None)
+            _namespace_env_set = False
         try:
             atexit.unregister(shutdown)
         except Exception:
@@ -150,10 +172,11 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     _get_worker().kill_actor(actor.actor_id, no_restart=no_restart)
 
 
-def get_actor(name: str) -> ActorHandle:
-    aid = _get_worker().get_named_actor(name)
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    aid = _get_worker().get_named_actor(name, namespace=namespace)
     if aid is None:
-        raise ValueError(f"no actor named {name!r}")
+        ns = namespace or _get_worker().namespace
+        raise ValueError(f"no actor named {name!r} in namespace {ns!r}")
     return ActorHandle(aid)
 
 

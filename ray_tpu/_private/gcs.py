@@ -316,7 +316,10 @@ class GcsServer:
         self.pending_tasks = _PendingShards()
         self.pending_actor_creations: collections.deque[dict] = collections.deque()
         self.actors: dict[str, _Actor] = {}
-        self.named_actors: dict[str, str] = {}
+        # (namespace, name) → actor id: named actors are scoped per
+        # namespace (reference: ray namespaces — jobs in different
+        # namespaces can reuse names without colliding)
+        self.named_actors: dict[tuple, str] = {}
         self.pgs: dict[str, _PG] = {}
         self.named_pgs: dict[str, str] = {}
         self.pending_pgs: collections.deque[str] = collections.deque()
@@ -1146,7 +1149,8 @@ class GcsServer:
             self._wait_actor_ready(conn, msg)
         elif t == "get_named_actor":
             with self.lock:
-                aid = self.named_actors.get(msg["name"])
+                aid = self.named_actors.get(
+                    (msg.get("namespace") or "default", msg["name"]))
                 state = self.actors[aid].state if aid else None
             conn.send({"rid": msg["rid"], "aid": aid, "state": state})
         elif t == "kill_actor":
@@ -2980,10 +2984,13 @@ class GcsServer:
             aid = spec["actor_id"]
             actor = _Actor(aid, spec)
             if actor.name:
-                existing = self.named_actors.get(actor.name)
+                ns = spec.get("namespace") or "default"
+                key = (ns, actor.name)
+                existing = self.named_actors.get(key)
                 if existing is not None and self.actors[existing].state != "dead":
-                    return f"an actor named {actor.name!r} already exists"
-                self.named_actors[actor.name] = aid
+                    return (f"an actor named {actor.name!r} already exists "
+                            f"in namespace {ns!r}")
+                self.named_actors[key] = aid
             self.actors[aid] = actor
             # creation args stay holdable for the actor's whole life (it may
             # be restarted from the same spec)
@@ -3446,6 +3453,11 @@ class GcsServer:
                     # by an explicit kill() (reference: ray.kill interrupts
                     # fail regardless of the retry budget)
                     can_retry = will_restart and not actor.kill_requested
+                    # the kill this flag requested has now happened: clear
+                    # it so a LATER accidental death of the restarted actor
+                    # retries normally (and the alive-handler doesn't
+                    # re-kill every future incarnation)
+                    actor.kill_requested = False
                     retry_q = []
                     for s in specs:
                         if s["kind"] != "actor_task":

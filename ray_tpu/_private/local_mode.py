@@ -73,8 +73,9 @@ class LocalWorker:
         return self._run(fn, args, kwargs, TaskID().hex(), num_returns, name)
 
     # actors
-    def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0,
-                     name=None, strategy=None, max_concurrency=1, runtime_env=None,
+    def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0, max_task_retries=0,
+                     name=None, namespace=None, strategy=None,
+                     max_concurrency=1, runtime_env=None,
                      concurrency_groups=None):
         cls = ser.loads(cls_blob) if isinstance(cls_blob, bytes) else cls_blob
         aid = ActorID().hex()
@@ -82,7 +83,7 @@ class LocalWorker:
         kwargs = {k: self.get_object(v.hex()) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()}
         self.actors[aid] = cls(*args, **kwargs)
         if name:
-            self._named[name] = aid
+            self._named[(namespace or self.namespace, name)] = aid
         return aid
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, *, num_returns=1):
@@ -109,8 +110,15 @@ class LocalWorker:
         self.actors.pop(actor_id, None)
         self._dead_actors.add(actor_id)
 
-    def get_named_actor(self, name):
-        return self._named.get(name)
+    namespace = "default"
+
+    def effective_namespace(self):
+        return self.namespace
+
+    def get_named_actor(self, name, namespace=None):
+        # namespace-scoped exactly like cluster mode: local-mode tests must
+        # not silently resolve across namespaces
+        return self._named.get((namespace or self.namespace, name))
 
     # kv
     def __init_kv(self):

@@ -198,6 +198,12 @@ class CoreWorker:
     def __init__(self, address: str, session_id: str | None, kind: str):
         self.kind = kind
         self.wid = WorkerID().hex()
+        # named actors are scoped by namespace (reference: ray namespaces).
+        # The DRIVER's namespace comes from init(namespace=...); inside a
+        # task/actor call the SUBMITTER's namespace (spec["caller_ns"]) is
+        # active, so nested named-actor creation/lookup lands where the
+        # submitting driver expects.
+        self.namespace = os.environ.get("RAY_TPU_NAMESPACE") or "default"
         if address.startswith("/"):
             address = f"unix:{address}"
         self._address = address
@@ -727,6 +733,7 @@ class CoreWorker:
             "retries_used": 0,
             "name": name,
             "strategy": strategy,
+            "caller_ns": self.effective_namespace(),
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **_trace_field(),
             **spec_part,
@@ -1096,6 +1103,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_task_retries: int = 0,
         name: str | None = None,
+        namespace: str | None = None,
         strategy: dict | None = None,
         max_concurrency: int = 1,
         runtime_env: dict | None = None,
@@ -1119,6 +1127,7 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "max_task_retries": max_task_retries,
             "name": name,
+            "namespace": namespace or self.effective_namespace(),
             "strategy": strategy,
             # the GCS gates dispatch on total concurrency: named groups
             # add their limits on top of the default pool (reference:
@@ -1160,6 +1169,7 @@ class CoreWorker:
             "deps": deps,
             "num_returns": num_returns,
             "resources": {},
+            "caller_ns": self.effective_namespace(),
             **_trace_field(),
             **spec_part,
         }
@@ -1428,8 +1438,14 @@ class CoreWorker:
     def kv_del(self, key: str):
         self.rpc({"type": "kv_del", "key": key})
 
-    def get_named_actor(self, name: str) -> str | None:
-        reply = self.rpc({"type": "get_named_actor", "name": name})
+    def effective_namespace(self) -> str:
+        """The submitter's namespace inside a task, the driver's outside."""
+        return getattr(self._task_ctx, "namespace", None) or self.namespace
+
+    def get_named_actor(self, name: str,
+                        namespace: str | None = None) -> str | None:
+        reply = self.rpc({"type": "get_named_actor", "name": name,
+                          "namespace": namespace or self.effective_namespace()})
         return reply["aid"]
 
     # ------------------------------------------------------- placement groups
@@ -1611,6 +1627,7 @@ class CoreWorker:
         _extract_dev = False
         _dev_map: dict = {}  # oid → tensor ids contained in THAT result
         self._task_ctx.task_id = spec["task_id"]
+        self._task_ctx.namespace = spec.get("caller_ns")
         _t_exec0 = time.time()
         # trace propagation: the spec's injected context becomes the parent
         # of this task's span, and the span is current while user code runs
@@ -1725,6 +1742,7 @@ class CoreWorker:
                 ]
         finally:
             self._task_ctx.task_id = None
+            self._task_ctx.namespace = None
             _tracing.end_task_span(
                 _tspan, name=spec.get("name") or spec.get("method") or kind,
                 task_id=spec["task_id"], kind=kind, ok=error_blob is None)

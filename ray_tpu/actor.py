@@ -92,7 +92,8 @@ _UNSET = object()
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
-                 max_restarts=0, max_task_retries=0, name=None, lifetime=None,
+                 max_restarts=0, max_task_retries=0, name=None,
+                 namespace=None, lifetime=None,
                  scheduling_strategy=None,
                  max_concurrency=1, runtime_env=None, concurrency_groups=None):
         self._cls = cls
@@ -101,6 +102,7 @@ class ActorClass:
         self._max_restarts = max_restarts
         self._max_task_retries = max_task_retries
         self._name = name
+        self._namespace = namespace
         self._strategy = scheduling_strategy
         self._max_concurrency = max_concurrency
         self._runtime_env = runtime_env
@@ -116,7 +118,7 @@ class ActorClass:
 
     def options(self, *, num_cpus=None, num_tpus=None, resources=None,
                 max_restarts=None, max_task_retries=None, name=None,
-                lifetime=None,
+                namespace=None, lifetime=None,
                 scheduling_strategy=_UNSET, max_concurrency=None,
                 runtime_env=_UNSET, concurrency_groups=None,
                 **_ignored) -> "ActorClass":
@@ -129,6 +131,7 @@ class ActorClass:
             max_task_retries=(self._max_task_retries if max_task_retries
                               is None else max_task_retries),
             name=name if name is not None else self._name,
+            namespace=namespace if namespace is not None else self._namespace,
             lifetime=lifetime,
             scheduling_strategy=(self._strategy if scheduling_strategy is _UNSET
                                  else scheduling_strategy),
@@ -156,6 +159,7 @@ class ActorClass:
             max_restarts=self._max_restarts,
             max_task_retries=self._max_task_retries,
             name=self._name,
+            namespace=self._namespace,
             strategy=strategy_to_spec(self._strategy),
             max_concurrency=self._max_concurrency,
             runtime_env=self._runtime_env,

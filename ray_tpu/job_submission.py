@@ -128,14 +128,15 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str, metadata: dict | None = None,
                    submission_id: str | None = None) -> str:
         job_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
-        sup = JobSupervisor.options(name=f"_job_supervisor:{job_id}").remote(
+        sup = JobSupervisor.options(name=f"_job_supervisor:{job_id}",
+                            namespace="_system").remote(
             job_id, entrypoint, metadata or {}, self.session_dir,
             self.socket_path, self.session_id)
         ray_tpu.get(sup.ping.remote())  # surface spawn errors here
         return job_id
 
     def _supervisor(self, job_id: str):
-        return ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+        return ray_tpu.get_actor(f"_job_supervisor:{job_id}", namespace="_system")
 
     def get_job_status(self, job_id: str) -> str:
         try:

@@ -19,12 +19,12 @@ _proxy = None
 
 def _get_controller(create: bool = False):
     try:
-        return ray_tpu.get_actor(CONTROLLER_NAME)
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace="_system")
     except ValueError:
         if not create:
             raise RuntimeError("serve is not running; call serve.run/start first") from None
         return ServeController.options(
-            name=CONTROLLER_NAME, num_cpus=0.5).remote()
+            name=CONTROLLER_NAME, namespace="_system", num_cpus=0.5).remote()
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,

@@ -192,15 +192,15 @@ def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
     (actor_handle, (host, port)). One per cluster, by name."""
     created = False
     try:
-        proxy = ray_tpu.get_actor(_INGRESS_NAME)
+        proxy = ray_tpu.get_actor(_INGRESS_NAME, namespace="_system")
     except ValueError:
         try:
             proxy = RPCProxyActor.options(
-                name=_INGRESS_NAME, num_cpus=0,
+                name=_INGRESS_NAME, namespace="_system", num_cpus=0,
                 max_concurrency=32).remote(host, port)
             created = True
         except ValueError:
-            proxy = ray_tpu.get_actor(_INGRESS_NAME)  # lost the create race
+            proxy = ray_tpu.get_actor(_INGRESS_NAME, namespace="_system")  # lost the create race
     addr = ray_tpu.get(proxy.address.remote())
     if not created and ((host not in ("127.0.0.1", addr[0]))
                         or (port not in (0, addr[1]))):

@@ -92,13 +92,14 @@ def init_collective_group(world_size: int, rank: int, *, backend: str = "host",
         raise ValueError(f"already in collective group {group_name!r}")
     name = _rendezvous_name(group_name)
     if rank == 0:
-        actor = _Rendezvous.options(name=name, num_cpus=0.1).remote(world_size)
+        actor = _Rendezvous.options(name=name, namespace="_system",
+                            num_cpus=0.1).remote(world_size)
         actor.__ray_ready__()
     else:
         deadline = time.monotonic() + 60.0
         while True:
             try:
-                actor = ray_tpu.get_actor(name)
+                actor = ray_tpu.get_actor(name, namespace="_system")
                 break
             except ValueError:
                 if time.monotonic() > deadline:

@@ -15,7 +15,7 @@ from ray_tpu.util import state
 
 @pytest.fixture(scope="module", autouse=True)
 def cluster():
-    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=4)
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=10)
 
     @ray_tpu.remote
     class Svc:
@@ -95,3 +95,64 @@ def test_timeline_file_export(tmp_path):
     assert isinstance(events, list)
     doc = json.load(open(out))
     assert "traceEvents" in doc
+
+
+def test_namespaces_scope_named_actors():
+    """Same name in different namespaces coexists; get_actor resolves in
+    the caller's namespace unless one is given (reference: ray
+    namespaces). Uses a second driver attached over the GCS address."""
+    import subprocess
+    import sys
+
+    import ray_tpu._private.api as _api
+
+    @ray_tpu.remote
+    class Svc:
+        def who(self):
+            return "ns-default"
+
+    # this driver runs in the "default" namespace
+    ray_tpu.get(Svc.options(name="scoped").remote().who.remote())
+    assert ray_tpu.get_actor("scoped") is not None
+    with pytest.raises(ValueError, match="namespace 'other'"):
+        ray_tpu.get_actor("scoped", namespace="other")
+
+    # a second driver in namespace "other" can reuse the name, and can
+    # reach the first driver's actor only by naming its namespace
+    addr = _api._node.address
+    script = f"""
+import ray_tpu
+ray_tpu.init(address={addr!r}, namespace="other")
+
+@ray_tpu.remote
+class Svc:
+    def who(self):
+        return "ns-other"
+
+a = Svc.options(name="scoped").remote()
+assert ray_tpu.get(a.who.remote(), timeout=60) == "ns-other"
+mine = ray_tpu.get_actor("scoped")  # resolves in MY namespace
+assert ray_tpu.get(mine.who.remote(), timeout=60) == "ns-other"
+theirs = ray_tpu.get_actor("scoped", namespace="default")
+assert ray_tpu.get(theirs.who.remote(), timeout=60) == "ns-default"
+
+# nested creation: a TASK submitted by this driver creates a named actor
+# and it must land in THIS driver's namespace (the spec carries caller_ns
+# — cluster workers were spawned with the head's env, not this driver's)
+@ray_tpu.remote
+def make_named():
+    @ray_tpu.remote
+    class Inner:
+        def tag(self):
+            return "inner-other"
+    Inner.options(name="nested").remote().__ray_ready__()
+    return "made"
+
+assert ray_tpu.get(make_named.remote(), timeout=60) == "made"
+inner = ray_tpu.get_actor("nested")  # same namespace as this driver
+assert ray_tpu.get(inner.tag.remote(), timeout=60) == "inner-other"
+print("OK")
+"""
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=180)
+    assert "OK" in r.stdout, r.stderr[-800:]
