@@ -285,6 +285,25 @@ def cmd_dashboard(args):
         head.stop()
 
 
+def cmd_summary(args):
+    """Aggregate task counts/failures/time per task name (reference
+    capability: `ray summary tasks`, util/state summarize)."""
+    from ray_tpu.util.state import summarize_task_events
+
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        events = c.rpc({"type": "task_events"}).get("events", [])
+    finally:
+        c.close()
+    summary = summarize_task_events(events)
+    print(f"{'task':<32} {'count':>7} {'failed':>7} {'total_s':>9}")
+    for name, rec in sorted(summary.items(),
+                            key=lambda kv: -kv[1]["count"]):
+        print(f"{name[:32]:<32} {rec['count']:>7} {rec['failed']:>7} "
+              f"{rec['total_s']:>9.3f}")
+
+
 def cmd_grafana(args):
     """Write Grafana dashboard JSON + provisioning YAML + a Prometheus
     scrape config (reference capability: the dashboard's
@@ -455,6 +474,9 @@ def main(argv=None):
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("summary", help="per-task-name execution summary")
+    sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser("grafana",
                         help="write Grafana/Prometheus provisioning artifacts")

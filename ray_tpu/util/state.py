@@ -96,10 +96,10 @@ def list_jobs(*, filters: Optional[List[Tuple]] = None,
     return _apply(rows, filters, limit)
 
 
-def summarize_tasks() -> dict:
-    """Counts per (name, kind, ok) over the retained task-event window
-    (reference: ``ray summary tasks`` / summarize_tasks)."""
-    events = _worker().rpc({"type": "task_events"}).get("events", [])
+def summarize_task_events(events: list) -> dict:
+    """Aggregate raw task events into per-name counts/failures/time —
+    shared by the in-process API below and the out-of-process
+    ``ray_tpu summary`` CLI."""
     summary: dict = {}
     for e in events:
         if e.get("event") and e["event"] != "task:execute":
@@ -117,6 +117,13 @@ def summarize_tasks() -> dict:
     return summary
 
 
+def summarize_tasks() -> dict:
+    """Counts per (name, kind, ok) over the retained task-event window
+    (reference: ``ray summary tasks`` / summarize_tasks)."""
+    return summarize_task_events(
+        _worker().rpc({"type": "task_events"}).get("events", []))
+
+
 def get_actor(actor_id: str) -> Optional[dict]:
     for row in list_actors(filters=[("actor_id", "=", actor_id)], limit=1):
         return row
@@ -132,5 +139,5 @@ def get_node(node_id: str) -> Optional[dict]:
 __all__ = [
     "get_actor", "get_node", "list_actors", "list_jobs", "list_nodes",
     "list_objects", "list_placement_groups", "list_tasks", "list_workers",
-    "summarize_tasks",
+    "summarize_task_events", "summarize_tasks",
 ]
