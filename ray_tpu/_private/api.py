@@ -226,6 +226,9 @@ def timeline(filename: str | None = None) -> list:
 
 
 class RuntimeContext:
+    """(reference: ray.runtime_context.RuntimeContext — ids, namespace,
+    accelerator assignment for the calling task/actor/driver.)"""
+
     def __init__(self, worker):
         self._w = worker
 
@@ -238,6 +241,36 @@ class RuntimeContext:
 
     def get_task_id(self):
         return getattr(self._w, "current_task_id", None)
+
+    def get_worker_id(self):
+        return getattr(self._w, "wid", None)
+
+    def get_node_id(self):
+        return getattr(self._w, "node_id", "node-0")
+
+    def get_job_id(self):
+        return os.environ.get("RAY_TPU_JOB_ID") or getattr(
+            self._w, "session_id", None)
+
+    @property
+    def namespace(self) -> str:
+        eff = getattr(self._w, "effective_namespace", None)
+        return eff() if callable(eff) else getattr(
+            self._w, "namespace", "default")
+
+    def get_accelerator_ids(self) -> dict:
+        """Chips the scheduler granted THIS process (reference:
+        get_accelerator_ids / get_gpu_ids). Reads the GCS's own binding
+        env, which is set regardless of the TPU_VISIBLE_CHIPS opt-out."""
+        from ray_tpu._private import accelerators
+
+        return {"TPU": [str(c) for c in accelerators.current_worker_chips()]}
+
+    def get_placement_group_id(self):
+        """The PG the CURRENT task was scheduled into, if any (stashed
+        from the executing spec's scheduling strategy)."""
+        ctx = getattr(self._w, "_task_ctx", None)
+        return getattr(ctx, "pg_id", None) if ctx is not None else None
 
 
 def get_runtime_context() -> RuntimeContext:

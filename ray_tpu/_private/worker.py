@@ -1628,6 +1628,9 @@ class CoreWorker:
         _dev_map: dict = {}  # oid → tensor ids contained in THAT result
         self._task_ctx.task_id = spec["task_id"]
         self._task_ctx.namespace = spec.get("caller_ns")
+        strat = spec.get("strategy") or {}
+        self._task_ctx.pg_id = (strat.get("pg_id")
+                                if strat.get("kind") == "pg" else None)
         _t_exec0 = time.time()
         # trace propagation: the spec's injected context becomes the parent
         # of this task's span, and the span is current while user code runs
@@ -1743,6 +1746,7 @@ class CoreWorker:
         finally:
             self._task_ctx.task_id = None
             self._task_ctx.namespace = None
+            self._task_ctx.pg_id = None
             _tracing.end_task_span(
                 _tspan, name=spec.get("name") or spec.get("method") or kind,
                 task_id=spec["task_id"], kind=kind, ok=error_blob is None)

@@ -43,6 +43,9 @@ class JobSupervisor:
         # the job's driver joins THIS session instead of starting its own
         env["RAY_TPU_ADDRESS"] = f"unix:{socket_path}"
         env["RAY_TPU_SESSION"] = session_id
+        # each job's driver (and its nested workloads) reports its own id
+        # (reference: runtime_context.get_job_id)
+        env["RAY_TPU_JOB_ID"] = self.job_id
         self._log_f = open(self.log_path, "ab")
         self._proc = subprocess.Popen(
             entrypoint, shell=True, stdout=self._log_f,

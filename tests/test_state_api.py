@@ -156,3 +156,49 @@ print("OK")
     r = subprocess.run([sys.executable, "-c", script], capture_output=True,
                        text=True, timeout=180)
     assert "OK" in r.stdout, r.stderr[-800:]
+
+
+def test_runtime_context_surface():
+    """(reference: ray.get_runtime_context() — ids/namespace/accelerators
+    available from driver and from inside tasks/actors.)"""
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_worker_id()
+    assert ctx.get_node_id()
+    assert ctx.namespace == "default"
+    assert ctx.get_accelerator_ids() == {"TPU": []}  # driver holds no chips
+
+    @ray_tpu.remote
+    def probe():
+        c = ray_tpu.get_runtime_context()
+        return {"task_id": c.get_task_id(), "worker_id": c.get_worker_id(),
+                "ns": c.namespace, "actor_id": c.get_actor_id()}
+
+    got = ray_tpu.get(probe.remote())
+    assert got["task_id"] and got["worker_id"] and got["actor_id"] is None
+    assert got["ns"] == "default"
+
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            return ray_tpu.get_runtime_context().get_actor_id()
+
+    a = A.remote()
+    assert ray_tpu.get(a.who.remote())
+
+
+def test_runtime_context_pg_id():
+    pg = ray_tpu.util.placement_group([{"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready(), timeout=30)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_placement_group_id()
+
+    inside = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg)).remote())
+    assert inside == pg.id
+    outside = ray_tpu.get(where.remote())
+    assert outside is None
