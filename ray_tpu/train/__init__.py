@@ -9,6 +9,7 @@ orchestration) and the in-worker training loop:
   sharded steps over a jax Mesh (TPU-native replacement for torch DDP/FSDP).
 """
 
+from ray_tpu.train import storage
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.backend import BackendConfig, JaxConfig, TorchConfig
 from ray_tpu.train.config import (
@@ -61,4 +62,5 @@ __all__ = [
     "make_sp_pp_train_step",
     "make_train_step",
     "report",
+    "storage",
 ]

@@ -2,21 +2,21 @@
 
 (reference: train/v2/_internal/execution/checkpoint/checkpoint_manager.py:71
 — tracks (checkpoint, metrics) pairs, keeps the latest plus the top
-`num_to_keep` by `checkpoint_score_attribute`, deletes the rest from storage.)
+`num_to_keep` by `checkpoint_score_attribute`, deletes the rest from storage
+through the checkpoint's storage backend, never a raw rmtree.)
 """
 
 from __future__ import annotations
 
-import os
-import shutil
 from dataclasses import dataclass
 
-# written into a checkpoint dir when the controller registers it; recovery
-# after a crash trusts only marked dirs (or fully-populated multi-rank ones)
-COMPLETE_MARKER = ".complete"
-
+from ray_tpu.train import storage as storage_mod
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.config import CheckpointConfig
+
+# written into a checkpoint dir when the controller registers it; recovery
+# after a crash trusts marked dirs whose per-rank manifests still validate
+COMPLETE_MARKER = storage_mod.COMPLETE_MARKER
 
 
 @dataclass
@@ -36,22 +36,39 @@ class CheckpointManager:
         for t in self._tracked:
             if t.checkpoint.path == checkpoint.path:
                 t.metrics = dict(metrics)  # re-registered (e.g. storage recovery)
+                # a recovered dir may predate its marker (controller died
+                # between persist and registration): (re)write it so the
+                # checkpoint is durable for the NEXT recovery too
+                self._write_complete_marker(t.checkpoint)
                 return
-        try:  # durable completion marker for crash recovery
-            with open(os.path.join(checkpoint.path, COMPLETE_MARKER), "w"):
-                pass
-        except OSError:
-            pass
+        self._write_complete_marker(checkpoint)
         self._tracked.append(_Tracked(checkpoint, dict(metrics), self._counter))
         self._counter += 1
         self._enforce_retention()
 
+    @staticmethod
+    def _write_complete_marker(checkpoint: Checkpoint) -> None:
+        """Durable completion marker for crash recovery, written through the
+        checkpoint's storage backend."""
+        try:
+            marker = storage_mod.join_path(checkpoint.path, COMPLETE_MARKER)
+            if not checkpoint.backend.exists(marker):
+                storage_mod.write_complete_marker(checkpoint.backend,
+                                                  checkpoint.path)
+        except Exception:  # noqa: BLE001 — marker is best-effort metadata
+            pass
+
     def _score(self, t: _Tracked):
         attr = self.config.checkpoint_score_attribute
-        if attr is None or attr not in t.metrics:
-            return t.index  # fall back to recency
+        if attr is None:
+            return (t.index, t.index)  # no attribute configured: recency
+        if attr not in t.metrics:
+            # configured but unreported: fall back to recency among
+            # themselves, but never outrank a real score
+            return (float("-inf"), t.index)
         v = t.metrics[attr]
-        return v if self.config.checkpoint_score_order == "max" else -v
+        score = v if self.config.checkpoint_score_order == "max" else -v
+        return (score, t.index)  # ties break toward the newer checkpoint
 
     def _enforce_retention(self) -> None:
         keep = self.config.num_to_keep
@@ -64,7 +81,10 @@ class CheckpointManager:
         for t in list(self._tracked):
             if id(t) not in keep_set and len(self._tracked) > keep:
                 self._tracked.remove(t)
-                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+                try:  # delete from storage via the backend, not local rmtree
+                    t.checkpoint.delete()
+                except Exception:  # noqa: BLE001 — retention is best-effort
+                    pass
 
     @property
     def latest_checkpoint(self) -> Checkpoint | None:

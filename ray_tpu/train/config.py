@@ -68,17 +68,28 @@ class CheckpointConfig:
 @dataclass
 class RunConfig:
     """(reference: air/config.py RunConfig — name + storage_path root where
-    experiment dirs and checkpoints are persisted via pyarrow.fs; here a
-    local/NFS filesystem path.)"""
+    experiment dirs and checkpoints are persisted via pyarrow.fs; here any
+    URI a `ray_tpu.train.storage` backend is registered for — a bare local/
+    NFS path, `file://...`, or `mock://bucket/prefix?fault-knobs`.)"""
 
     name: str | None = None
     storage_path: str | None = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # persist failures past the retry budget degrade to a logged warning (the
+    # report's metrics still flow; the run keeps training) unless this is set
+    fail_on_persist_error: bool = False
+    # a live StorageBackend instance overriding URI dispatch on storage_path —
+    # how nested runs (Train-in-Tune) inherit the parent's backend (with its
+    # fault knobs), and an escape hatch for backends with unpicklable-into-a-
+    # URI config
+    storage_backend: object | None = None
 
     def experiment_dir(self) -> str:
+        """The experiment prefix (local path or URI, query preserved)."""
+        from ray_tpu.train import storage as storage_mod
+
         root = self.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results")
-        name = self.name or "train_run"
-        return os.path.join(root, name)
+        return storage_mod.join_path(root, self.name or "train_run")

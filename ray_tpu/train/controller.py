@@ -12,6 +12,7 @@ import os
 import time
 
 import ray_tpu
+from ray_tpu.train import storage as storage_mod
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.worker_group import WorkerGroup
@@ -37,14 +38,24 @@ class TrainController:
         self.ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
         self.failures = 0
         self.latest_metrics: dict = {}
+        # sessions reset their cumulative retry counters on restart, so the
+        # run total = sum of completed attempts + the live attempt's high-water
+        self._retries_prev_attempts = 0
+        self._attempt_retries = 0
         self._iter_buffer: dict[int, dict[int, dict]] = {}  # iter → rank → report
+        # the storage backend the run's experiment prefix lives on; fault
+        # knobs from the storage_path URI query stay on this instance (an
+        # explicit run_config.storage_backend — e.g. a nested Train-in-Tune
+        # run inheriting its parent's instance — overrides URI dispatch)
+        self._storage, self._exp_dir = storage_mod.resolve_run_storage(
+            self.run_config)
 
     def get_state(self) -> str:
         return self.state
 
     def run(self) -> dict:
-        exp_dir = self.run_config.experiment_dir()
-        os.makedirs(exp_dir, exist_ok=True)
+        exp_dir = self._exp_dir
+        self._storage.makedirs(exp_dir)
         max_failures = self.run_config.failure_config.max_failures
         error = None
         while True:
@@ -67,6 +78,8 @@ class TrainController:
                 outcome, error = "errored", f"{type(e).__name__}: {e}"
             finally:
                 group.shutdown()
+                self._retries_prev_attempts += self._attempt_retries
+                self._attempt_retries = 0
             if outcome == "finished":
                 self.state = "FINISHED"
                 break
@@ -85,6 +98,7 @@ class TrainController:
             "error": error if self.state == "ERRORED" else None,
             "path": exp_dir,
             "failures": self.failures,
+            "storage_retries": self._retries_prev_attempts + self._attempt_retries,
         }
 
     def _resolve_scaling(self):
@@ -108,30 +122,23 @@ class TrainController:
         return dataclasses.replace(sc, num_workers=n)
 
     def _recover_checkpoints_from_storage(self, exp_dir: str) -> None:
-        """Register complete checkpoints already on storage that the poll loop
-        never saw (worker died with reports undrained). Checkpoints are the
-        durable record; controller memory is not.
+        """Register committed checkpoints already on storage that the poll
+        loop never saw — a worker that died with reports undrained, or a
+        prior controller incarnation on a *different* host. Checkpoints are
+        the durable record; controller memory is not.
         (reference: checkpoints live in StorageContext-managed storage and
-        survive worker loss — v2/_internal/execution/storage.py.)"""
-        tracked = {t.checkpoint.path for t in self.ckpt_manager._tracked}
-        n = self.current_workers
-        for name in sorted(os.listdir(exp_dir)):
-            path = os.path.join(exp_dir, name)
-            if not name.startswith("checkpoint_") or path in tracked:
-                continue
-            # trust the durable completion marker (written at live
-            # registration, which happens only once the iteration completed
-            # on all ranks); fall back to the fully-populated shape so older
-            # checkpoints without markers still recover. A torn dir (crash
-            # mid-save: some rank_* complete, some .tmp) matches neither.
-            from ray_tpu.train.checkpoint_manager import COMPLETE_MARKER
+        survive worker loss — v2/_internal/execution/storage.py.)
 
-            ranks = [r for r in os.listdir(path)
-                     if r.startswith("rank_") and not r.endswith(".tmp")]
-            complete = (os.path.exists(os.path.join(path, COMPLETE_MARKER))
-                        or len(ranks) >= n)
-            if complete and ranks:
-                self.ckpt_manager.register(Checkpoint(path), dict(self.latest_metrics))
+        Trust comes from the two-phase commit: every rank prefix must carry
+        its commit marker AND a validating manifest. A torn dir (crash
+        mid-upload: some files present, no marker / sizes off) is never
+        registered, regardless of its checkpoint_* name."""
+        tracked = {t.checkpoint.path for t in self.ckpt_manager._tracked}
+        for path, meta in storage_mod.list_committed_checkpoints(
+                self._storage, exp_dir, self.current_workers, skip=tracked):
+            metrics = meta.get("metrics") or dict(self.latest_metrics)
+            self.ckpt_manager.register(
+                Checkpoint(path, backend=self._storage), dict(metrics))
 
     def _start_training(self, group: WorkerGroup, exp_dir: str) -> None:
         name = self.run_config.name or os.path.basename(exp_dir)
@@ -158,6 +165,10 @@ class TrainController:
             "start_iteration": start_iteration,
             "local_world_size": self.current_workers,
             "node_rank": 0,
+            # workers persist through the controller's backend instance so
+            # URI fault knobs apply uniformly across the run
+            "storage_backend": self._storage,
+            "fail_on_persist_error": self.run_config.fail_on_persist_error,
         }
         group.start_training(self.train_fn_blob, self.config, ctx,
                              self.backend_blob, shards)
@@ -189,8 +200,25 @@ class TrainController:
                 break  # iteration not complete on all ranks yet
             rank0 = ranks.get(0) or next(iter(ranks.values()))
             self.latest_metrics = rank0["metrics"]
+            self._attempt_retries = max(
+                self._attempt_retries,
+                sum(r.get("storage_retries", 0) for r in ranks.values()))
             ckpt_dir = next((r["checkpoint_dir"] for r in ranks.values()
                              if r["checkpoint_dir"]), None)
-            if ckpt_dir:
-                self.ckpt_manager.register(Checkpoint(ckpt_dir), rank0["metrics"])
+            # a rank whose persist degraded past the retry budget vetoes the
+            # whole checkpoint: registering (and COMPLETE-marking) a prefix
+            # missing that rank's shard would hand recovery a torn resume
+            # point (metrics-only reports don't veto — they never tried)
+            degraded = any(r.get("persist_failed") for r in ranks.values())
+            if ckpt_dir and not degraded:
+                self.ckpt_manager.register(
+                    Checkpoint(ckpt_dir, backend=self._storage),
+                    rank0["metrics"])
+            elif ckpt_dir:
+                try:  # clear the vetoed prefix: a downsized retry may reuse
+                    # this index, and leftover shards from the aborted
+                    # attempt must not mix into (or torn-poison) its commit
+                    self._storage.delete_prefix(ckpt_dir)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
             del self._iter_buffer[idx]

@@ -9,12 +9,15 @@ drain on its next poll.)
 
 from __future__ import annotations
 
+import logging
 import os
-import shutil
 import threading
 from typing import Any
 
+from ray_tpu.train import storage as storage_mod
 from ray_tpu.train._checkpoint import Checkpoint
+
+logger = logging.getLogger(__name__)
 
 _session: "TrainSession | None" = None
 _session_lock = threading.Lock()
@@ -53,7 +56,10 @@ class TrainSession:
                  local_world_size: int, node_rank: int, experiment_dir: str,
                  experiment_name: str, datasets: dict | None = None,
                  checkpoint: Checkpoint | None = None, sync_actor=None,
-                 start_iteration: int = 0):
+                 start_iteration: int = 0,
+                 storage_backend: "storage_mod.StorageBackend | None" = None,
+                 fail_on_persist_error: bool = False,
+                 storage_retry: "storage_mod.RetryConfig | None" = None):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -64,6 +70,13 @@ class TrainSession:
         self.datasets = datasets or {}
         self.starting_checkpoint = checkpoint
         self.sync_actor = sync_actor
+        # storage backend the experiment prefix lives on; checkpoints are
+        # two-phase-committed through it (local backend ≈ the old copytree)
+        self.storage_backend = storage_backend or storage_mod.LocalBackend()
+        self.fail_on_persist_error = fail_on_persist_error
+        self.storage_retry = storage_retry or storage_mod.DEFAULT_RETRY
+        self.persist_retries = 0   # total retry count, bounded per-op by
+        self.persist_failures = 0  # storage_retry.max_attempts
         # restarted attempts continue numbering past the resume checkpoint so
         # checkpoint_NNNNNN dirs are never overwritten across attempts
         self.iteration = start_iteration
@@ -78,25 +91,61 @@ class TrainSession:
         idx = self.iteration
         persisted = None
         if checkpoint is not None:
-            dest = os.path.join(self.experiment_dir,
-                                f"checkpoint_{idx:06d}", f"rank_{self.rank}")
-            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                # stage + atomic rename: a crash mid-copy must never leave a
-                # rank dir that looks complete to controller-side recovery
-                os.makedirs(os.path.dirname(dest), exist_ok=True)
-                tmp = dest + ".tmp"
-                shutil.rmtree(tmp, ignore_errors=True)
-                shutil.copytree(checkpoint.path, tmp)
-                shutil.rmtree(dest, ignore_errors=True)
-                os.rename(tmp, dest)
-            persisted = os.path.dirname(dest)
+            persisted = self._persist(checkpoint, idx, metrics)
         with self._lock:
+            # persist_failed distinguishes "tried and degraded" from
+            # "metrics-only report": one failed rank vetoes registration of
+            # the whole checkpoint on the controller side
             self.reports.append({"iter": idx, "rank": self.rank,
                                  "metrics": dict(metrics),
-                                 "checkpoint_dir": persisted})
+                                 "checkpoint_dir": persisted,
+                                 "persist_failed": (checkpoint is not None
+                                                    and persisted is None),
+                                 "storage_retries": self.persist_retries})
         self.iteration += 1
         if self.stop_requested:
             raise _StopTraining()
+
+    def _persist(self, checkpoint: Checkpoint, idx: int,
+                 metrics: dict) -> str | None:
+        """Two-phase-commit this rank's checkpoint shard to storage. Returns
+        the checkpoint prefix, or None when persisting failed past the retry
+        budget and the run is configured to degrade instead of die."""
+        backend = self.storage_backend
+        ckpt_prefix = storage_mod.join_path(self.experiment_dir,
+                                            f"checkpoint_{idx:06d}")
+        dest = storage_mod.join_path(ckpt_prefix, f"rank_{self.rank}")
+        # world_size rides the manifest so recovery's completeness fallback
+        # compares against the WRITING attempt's size, not a later elastic
+        # downsize that would make a vetoed partial checkpoint look whole
+        meta = {"metrics": dict(metrics), "iteration": idx, "rank": self.rank,
+                "world_size": self.world_size}
+        try:
+            with checkpoint.as_directory() as src:
+                if (backend.is_local and checkpoint.backend.is_local
+                        and os.path.abspath(src) == os.path.abspath(dest)):
+                    # already in place (user wrote straight into storage):
+                    # still write manifest + commit so recovery can trust it
+                    self._commit_in_place(dest, meta)
+                else:
+                    stats = storage_mod.persist_directory(
+                        backend, src, dest, retry=self.storage_retry, meta=meta)
+                    self.persist_retries += stats.retries
+            return ckpt_prefix
+        except storage_mod.StorageError as e:
+            self.persist_failures += 1
+            if self.fail_on_persist_error:
+                raise
+            logger.warning(
+                "rank %d: persisting checkpoint_%06d failed past the retry "
+                "budget, continuing without it (fail_on_persist_error=False): "
+                "%s", self.rank, idx, e)
+            return None
+
+    def _commit_in_place(self, dest: str, meta: dict) -> None:
+        files = storage_mod.scan_local_files(dest)
+        self.persist_retries += storage_mod.write_manifest_and_commit(
+            self.storage_backend, dest, files, meta, retry=self.storage_retry)
 
     def drain_reports(self) -> list[dict]:
         with self._lock:

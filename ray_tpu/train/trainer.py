@@ -27,6 +27,9 @@ class Result:
     path: str
     error: str | None = None
     best_checkpoints: list = field(default_factory=list)
+    # total checkpoint-upload retries observed (bounded per-op by the
+    # storage RetryConfig) — chaos tests assert this stays sane
+    storage_retries: int = 0
 
 
 class TrainingFailedError(RuntimeError):
@@ -70,6 +73,7 @@ class DataParallelTrainer:
             path=out["path"],
             error=out["error"],
             best_checkpoints=out["best_checkpoints"],
+            storage_retries=out.get("storage_retries", 0),
         )
         if out["state"] == "ERRORED":
             raise TrainingFailedError(

@@ -63,6 +63,9 @@ class TrainWorker:
             checkpoint=context.get("checkpoint"),
             sync_actor=context.get("sync_actor"),
             start_iteration=context.get("start_iteration", 0),
+            storage_backend=context.get("storage_backend"),
+            fail_on_persist_error=context.get("fail_on_persist_error", False),
+            storage_retry=context.get("storage_retry"),
         )
         self._status = "running"
         self._error = None

@@ -32,8 +32,12 @@ class Trial:
 
     @property
     def trial_dir(self) -> str:
-        d = os.path.join(self.experiment_dir, self.trial_id)
-        os.makedirs(d, exist_ok=True)
+        """Per-trial storage prefix under the experiment (local path or URI)."""
+        from ray_tpu.train import storage as storage_mod
+
+        d = storage_mod.join_path(self.experiment_dir, self.trial_id)
+        if "://" not in d:
+            os.makedirs(d, exist_ok=True)
         return d
 
     def summary(self) -> dict:

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 import ray_tpu
+from ray_tpu.train import storage as storage_mod
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig
 from ray_tpu.train.worker_group import TrainWorker
@@ -106,24 +107,44 @@ class Tuner:
         """Resume a crashed/interrupted experiment from its snapshot:
         finished trials keep their results without re-running, unfinished
         trials restart from their latest checkpoint, and the remaining
-        sample budget is generated fresh (reference:
-        tune/execution/experiment_state.py + Tuner.restore)."""
-        state_path = os.path.join(path, "experiment_state.json")
-        with open(state_path) as f:
-            summaries = json.load(f)
+        sample budget is generated fresh. `path` may be a storage URI —
+        a fresh Tuner on a different host resumes from the same prefix
+        (reference: tune/execution/experiment_state.py + Tuner.restore)."""
+        backend, base = storage_mod.get_storage_backend(path)
+        state_path = storage_mod.join_path(base, "experiment_state.json")
+        bak_path = storage_mod.join_path(base, "experiment_state.bak.json")
+        if not backend.exists(state_path) and not backend.exists(bak_path):
+            raise FileNotFoundError(  # wrong path fails fast, unretried
+                f"no experiment snapshot at {state_path}")
+        try:
+            summaries = json.loads(storage_mod.with_retry(
+                backend.read_bytes, state_path, op="read experiment state"))
+        except (storage_mod.StorageError, ValueError):
+            # canonical snapshot torn mid-overwrite: the backup slot holds
+            # the previous good generation — but surface the original
+            # corruption when no backup generation was ever written
+            if not backend.exists(bak_path):
+                raise
+            summaries = json.loads(storage_mod.with_retry(
+                backend.read_bytes, bak_path, op="read snapshot backup"))
         # the search space / tune config were pickled at fit() start
         # (reference: tuner.pkl written by Tuner for restore)
-        pkl_path = os.path.join(path, "tuner.pkl")
-        if (param_space is None or tune_config is None) and os.path.exists(pkl_path):
+        pkl_path = storage_mod.join_path(base, "tuner.pkl")
+        if (param_space is None or tune_config is None) and backend.exists(pkl_path):
             import cloudpickle
 
-            with open(pkl_path, "rb") as f:
-                saved = cloudpickle.load(f)
+            saved = cloudpickle.loads(storage_mod.with_retry(
+                backend.read_bytes, pkl_path, op="read tuner.pkl"))
             param_space = param_space or saved.get("param_space")
             tune_config = tune_config or saved.get("tune_config")
         if run_config is None:
-            run_config = RunConfig(name=os.path.basename(path.rstrip("/")),
-                                   storage_path=os.path.dirname(path.rstrip("/")))
+            # keep the original URI (query knobs included) for the root so
+            # the restored run reconstructs the same backend behavior
+            name = storage_mod.basename(path)
+            root = storage_mod.parent(path)
+            _b, _q, query = path.partition("?")
+            run_config = RunConfig(
+                name=name, storage_path=root + (_q + query if query else ""))
         tuner = cls(trainable, param_space=param_space,
                     tune_config=tune_config, run_config=run_config)
         tuner._restore_summaries = summaries
@@ -131,14 +152,17 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
-        exp_dir = self.run_config.experiment_dir()
-        os.makedirs(exp_dir, exist_ok=True)
+        backend, exp_dir = storage_mod.resolve_run_storage(self.run_config)
+        backend.makedirs(exp_dir)
         try:  # durable search space for Tuner.restore (reference: tuner.pkl)
             import cloudpickle
 
-            with open(os.path.join(exp_dir, "tuner.pkl"), "wb") as f:
-                cloudpickle.dump({"param_space": self.param_space,
-                                  "tune_config": self.tune_config}, f)
+            storage_mod.with_retry(
+                backend.write_bytes,
+                storage_mod.join_path(exp_dir, "tuner.pkl"),
+                cloudpickle.dumps({"param_space": self.param_space,
+                                   "tune_config": self.tune_config}),
+                op="write tuner.pkl")
         except Exception:
             pass  # unpicklable user objects: restore needs explicit args
         restored_done: list[Trial] = []
@@ -151,8 +175,8 @@ class Tuner:
                           iteration=s.get("iteration", 0),
                           error=s.get("error"))
                 ckpt_path = s.get("checkpoint_path")
-                if ckpt_path and os.path.isdir(ckpt_path):
-                    t.latest_checkpoint = Checkpoint(ckpt_path)
+                if ckpt_path and backend.exists(ckpt_path):
+                    t.latest_checkpoint = Checkpoint(ckpt_path, backend=backend)
                 if s["status"] == TERMINATED:
                     t.status = TERMINATED
                     restored_done.append(t)
@@ -171,7 +195,9 @@ class Tuner:
         scheduler.set_search_properties(tc.metric or "_none_", tc.mode)
         loop = _TuneLoop(self._as_train_fn(), exp_dir, searcher, scheduler, tc,
                          restored_done=restored_done,
-                         restored_pending=restored_pending)
+                         restored_pending=restored_pending,
+                         storage_backend=backend,
+                         fail_on_persist_error=self.run_config.fail_on_persist_error)
         trials = loop.run()
         results = [
             TuneResult(metrics=t.last_result, config=t.config,
@@ -196,10 +222,15 @@ class Tuner:
 
                 trainer = copy.copy(t)
                 trainer.config = {**t.config, **config.get("train_loop_config", config)}
+                s = sess.get_session()
                 trainer.run_config = RunConfig(
-                    name="nested", storage_path=sess.get_session().experiment_dir,
+                    name="nested", storage_path=s.experiment_dir,
                     failure_config=t.run_config.failure_config,
-                    checkpoint_config=t.run_config.checkpoint_config)
+                    checkpoint_config=t.run_config.checkpoint_config,
+                    # inherit the trial's live backend (fault knobs and all):
+                    # the session's experiment_dir is the query-stripped URI
+                    storage_backend=s.storage_backend,
+                    fail_on_persist_error=s.fail_on_persist_error)
                 result = trainer.fit()
                 sess.report(result.metrics)
 
@@ -210,11 +241,15 @@ class Tuner:
 class _TuneLoop:
     def __init__(self, train_fn, exp_dir, searcher, scheduler, tc: TuneConfig,
                  restored_done: list[Trial] | None = None,
-                 restored_pending: list[Trial] | None = None):
+                 restored_pending: list[Trial] | None = None,
+                 storage_backend: "storage_mod.StorageBackend | None" = None,
+                 fail_on_persist_error: bool = False):
         from ray_tpu._private import serialization as ser
 
         self.fn_blob = ser.dumps(train_fn)
         self.exp_dir = exp_dir
+        self.storage = storage_backend or storage_mod.LocalBackend()
+        self.fail_on_persist_error = fail_on_persist_error
         self.searcher = searcher
         self.scheduler = scheduler
         self.tc = tc
@@ -283,7 +318,10 @@ class _TuneLoop:
                "checkpoint": checkpoint, "local_world_size": 1, "node_rank": 0,
                # continue numbering past prior iterations so a PBT restart
                # never overwrites this trial's earlier checkpoint_* dirs
-               "start_iteration": trial.iteration}
+               "start_iteration": trial.iteration,
+               # per-trial storage prefix rides the experiment's backend
+               "storage_backend": self.storage,
+               "fail_on_persist_error": self.fail_on_persist_error}
         trial.runner.start_train_fn.remote(self.fn_blob, trial.config, ctx, None)
         trial.status = RUNNING
         trial.stopping = False
@@ -335,7 +373,8 @@ class _TuneLoop:
         trial.last_result = result
         self._dirty = True
         if rep["checkpoint_dir"]:
-            trial.latest_checkpoint = Checkpoint(rep["checkpoint_dir"])
+            trial.latest_checkpoint = Checkpoint(rep["checkpoint_dir"],
+                                                 backend=self.storage)
         if self._should_stop(result):
             self._request_stop(trial)
             return
@@ -389,8 +428,22 @@ class _TuneLoop:
         if not self._dirty:
             return
         self._dirty = False
-        path = os.path.join(self.exp_dir, "experiment_state.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump([t.summary() for t in self.trials], f, default=str)
-        os.replace(tmp, path)
+        payload = json.dumps([t.summary() for t in self.trials],
+                             default=str).encode()
+        try:  # snapshots are advisory: retried, and a persistent storage
+            # outage must not kill live trials — the next snapshot catches up
+            storage_mod.with_retry(
+                self.storage.write_bytes,
+                storage_mod.join_path(self.exp_dir, "experiment_state.json"),
+                payload, op="snapshot")
+        except storage_mod.StorageError:
+            self._dirty = True  # rewrite on the next loop tick
+            return
+        try:  # second slot: a torn/interrupted overwrite of the canonical
+            # key must not lose the last good snapshot (restore falls back)
+            storage_mod.with_retry(
+                self.storage.write_bytes,
+                storage_mod.join_path(self.exp_dir, "experiment_state.bak.json"),
+                payload, op="snapshot backup")
+        except storage_mod.StorageError:
+            pass

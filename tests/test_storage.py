@@ -1,0 +1,686 @@
+"""Checkpoint storage layer: backends, two-phase commit, retries, recovery.
+
+(reference: train/v2/_internal/execution/storage.py — StorageContext over an
+arbitrary filesystem; these tests run the same contract against the local
+backend and the fault-injecting mock remote store. Tier-1: everything here
+is in-process or one small cluster; the SIGKILL crash-resume chaos lives in
+test_storage_chaos.py.)
+"""
+
+import json
+import os
+import pickle
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import storage as st
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import COMPLETE_MARKER, CheckpointManager
+from ray_tpu.train.config import CheckpointConfig
+from ray_tpu.train.session import TrainSession
+
+
+@pytest.fixture
+def mock_store(tmp_path, monkeypatch):
+    """Isolated mock object store root for this test."""
+    root = tmp_path / "mock_store"
+    monkeypatch.setenv("RAY_TPU_MOCK_STORE_ROOT", str(root))
+    return str(root)
+
+
+def _make_src(tmp_path, name="src", files=None):
+    src = tmp_path / name
+    src.mkdir(exist_ok=True)
+    for rel, content in (files or {"a.txt": "hello", "sub/b.bin": "b" * 64}).items():
+        p = src / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return str(src)
+
+
+# ----------------------------------------------------------- URI dispatch
+
+
+def test_uri_dispatch_local_and_file_scheme(tmp_path):
+    for uri in [str(tmp_path / "x"), f"file://{tmp_path}/x"]:
+        backend, path = st.get_storage_backend(uri)
+        assert backend.is_local
+        assert path == str(tmp_path / "x")
+
+
+def test_uri_dispatch_mock_parses_fault_knobs(mock_store):
+    backend, path = st.get_storage_backend(
+        "mock://bkt/pfx?fail_rate=0.25&torn_rate=0.1&latency_ms=2&seed=7")
+    assert not backend.is_local
+    assert path == "mock://bkt/pfx"  # query stripped from the clean path
+    assert backend.faults.fail_rate == 0.25
+    assert backend.faults.torn_rate == 0.1
+    assert backend.faults.seed == 7
+
+
+def test_uri_dispatch_unknown_scheme_raises():
+    with pytest.raises(st.StorageError, match="no storage backend"):
+        st.get_storage_backend("s3://nope/bucket")
+
+
+def test_register_custom_scheme(tmp_path):
+    def factory(uri):
+        backend = st.LocalBackend()
+        return backend, backend.normalize(str(tmp_path / "custom"))
+
+    st.register_storage_backend("customfs", factory)
+    try:
+        backend, path = st.get_storage_backend("customfs://whatever")
+        assert backend.is_local and path.endswith("custom")
+    finally:
+        st._SCHEMES.pop("customfs", None)
+
+
+def test_join_path_preserves_query():
+    assert (st.join_path("mock://b/x?fail_rate=0.5", "ckpt", "rank_0")
+            == "mock://b/x/ckpt/rank_0?fail_rate=0.5")
+    assert st.basename("mock://b/x/checkpoint_000003?seed=1") == "checkpoint_000003"
+
+
+# ------------------------------------------------- two-phase commit + restore
+
+
+@pytest.mark.parametrize("uri_fmt", ["{tmp}/local_store", "mock://bkt/exp"])
+def test_persist_restore_roundtrip(tmp_path, mock_store, uri_fmt):
+    backend, base = st.get_storage_backend(uri_fmt.format(tmp=tmp_path))
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "checkpoint_000000", "rank_0")
+    stats = st.persist_directory(backend, src, prefix, meta={"metrics": {"x": 1}})
+    assert stats.files == 2
+    assert st.is_committed(backend, prefix)
+    dest = str(tmp_path / "restored")
+    st.restore_directory(backend, prefix, dest)
+    assert open(os.path.join(dest, "a.txt")).read() == "hello"
+    assert open(os.path.join(dest, "sub", "b.bin")).read() == "b" * 64
+    manifest = st.read_manifest(backend, prefix)
+    assert manifest["meta"]["metrics"] == {"x": 1}
+    assert {f["path"] for f in manifest["files"]} == {"a.txt", "sub/b.bin"}
+
+
+class _FlakyBackend(st.LocalBackend):
+    """Deterministically fails the first `fail_n` data-plane calls."""
+
+    def __init__(self, fail_n):
+        self.remaining = fail_n
+
+    def _maybe_fail(self):
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise st.StorageError("transient flake")
+
+    def upload_file(self, local_path, dest_path):
+        self._maybe_fail()
+        super().upload_file(local_path, dest_path)
+
+    def write_bytes(self, path, data):
+        self._maybe_fail()
+        super().write_bytes(path, data)
+
+    def download_file(self, src_path, local_path):
+        self._maybe_fail()
+        super().download_file(src_path, local_path)
+
+
+def test_persist_retries_with_backoff_and_counts(tmp_path):
+    backend = _FlakyBackend(fail_n=3)
+    src = _make_src(tmp_path)
+    prefix = str(tmp_path / "store" / "ck")
+    stats = st.persist_directory(
+        backend, src, prefix,
+        retry=st.RetryConfig(max_attempts=4, base_delay_s=0.001))
+    assert stats.retries == 3  # exactly the injected flakes, no more
+    assert st.is_committed(backend, prefix)
+
+
+def test_persist_exhausts_retry_budget_raises(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp?fail_rate=1.0&seed=1")
+    src = _make_src(tmp_path)
+    retry = st.RetryConfig(max_attempts=3, base_delay_s=0.001)
+    with pytest.raises(st.StorageError, match="after 3 attempt"):
+        st.persist_directory(backend, src, st.join_path(base, "ck"), retry=retry)
+    assert not st.is_committed(backend, st.join_path(base, "ck"))
+
+
+def test_torn_writes_never_commit(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp?torn_rate=1.0&seed=2")
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "ck")
+    with pytest.raises(st.StorageError):
+        st.persist_directory(
+            backend, src, prefix,
+            retry=st.RetryConfig(max_attempts=2, base_delay_s=0.001))
+    # a torn (partial) object may exist, but the prefix is not committed and
+    # restore refuses it rather than returning corrupt data
+    assert not st.is_committed(backend, prefix)
+    with pytest.raises(st.StorageError):
+        st.restore_directory(backend, prefix, str(tmp_path / "out"))
+
+
+def test_restore_validates_manifest_sizes(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "ck")
+    st.persist_directory(backend, src, prefix)
+    # corrupt the stored object behind the API's back (bit-rot / torn blob)
+    blob = backend._local(st.join_path(prefix, "sub/b.bin"))
+    with open(blob, "wb") as f:
+        f.write(b"short")
+    assert not st.validate_manifest(backend, prefix)
+    assert not st.is_committed(backend, prefix)
+    with pytest.raises(st.StorageError, match="size mismatch|download"):
+        st.restore_directory(
+            backend, prefix, str(tmp_path / "out"),
+            retry=st.RetryConfig(max_attempts=2, base_delay_s=0.001))
+
+
+def test_restore_ignores_stray_uncommitted_objects(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "ck")
+    st.persist_directory(backend, src, prefix)
+    backend.write_bytes(st.join_path(prefix, "stale_garbage.bin"), b"torn junk")
+    dest = str(tmp_path / "out")
+    st.restore_directory(backend, prefix, dest)
+    assert not os.path.exists(os.path.join(dest, "stale_garbage.bin"))
+    assert open(os.path.join(dest, "a.txt")).read() == "hello"
+
+
+def test_restore_fails_loudly_on_unvouched_rank_subtree(tmp_path, mock_store):
+    """A rank shard whose uploader died before writing its manifest must
+    fail the whole-checkpoint restore, not silently vanish from it."""
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    ck = st.join_path(base, "checkpoint_000000")
+    st.persist_directory(backend, src, st.join_path(ck, "rank_0"))
+    backend.write_bytes(st.join_path(ck, "rank_1", "state.txt"), b"partial")
+    with pytest.raises(st.StorageError, match="unvouched"):
+        st.restore_directory(backend, ck, str(tmp_path / "out"))
+
+
+def test_read_failures_are_retried(tmp_path, mock_store):
+    backend, base = st.get_storage_backend(
+        "mock://bkt/exp?read_fail_rate=0.4&seed=3")
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "ck")
+    st.persist_directory(backend, src, prefix)
+    stats = st.restore_directory(
+        backend, prefix, str(tmp_path / "out"),
+        retry=st.RetryConfig(max_attempts=10, base_delay_s=0.001))
+    assert stats.files == 2
+    assert open(str(tmp_path / "out" / "a.txt")).read() == "hello"
+
+
+# -------------------------------------------------------- Checkpoint handle
+
+
+def test_checkpoint_local_zero_copy_behavior(tmp_path):
+    src = _make_src(tmp_path)
+    ck = Checkpoint.from_directory(src)
+    with ck.as_directory() as d:
+        assert d == os.path.abspath(src)  # zero-copy: the stored path itself
+
+
+def test_checkpoint_remote_download_on_demand(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    prefix = st.join_path(base, "checkpoint_000000", "rank_0")
+    st.persist_directory(backend, src, prefix)
+    ck = Checkpoint(prefix, backend=backend)
+    with ck.as_directory() as d:
+        assert d != prefix
+        assert open(os.path.join(d, "a.txt")).read() == "hello"
+    assert not os.path.exists(d)  # temp view cleaned up
+    out = ck.to_directory(str(tmp_path / "mat"))
+    assert open(os.path.join(out, "a.txt")).read() == "hello"
+
+
+def test_checkpoint_reduce_preserves_subclass_and_backend(tmp_path, mock_store):
+    from ray_tpu._private import serialization as ser
+
+    class MyCheckpoint(Checkpoint):
+        pass
+
+    # subclasses survive serialization through the object store
+    local = ser.loads(ser.dumps(MyCheckpoint.from_directory(str(tmp_path))))
+    assert type(local).__name__ == "MyCheckpoint"
+    assert isinstance(local, Checkpoint) and type(local) is not Checkpoint
+    backend, base = st.get_storage_backend("mock://bkt/exp?fail_rate=0.5&seed=9")
+    remote = ser.loads(ser.dumps(MyCheckpoint(base, backend=backend)))
+    assert type(remote).__name__ == "MyCheckpoint"
+    assert remote.backend.faults.fail_rate == 0.5  # fault knobs travel too
+    # plain pickle also round-trips the (backend, path) pair
+    plain = pickle.loads(pickle.dumps(Checkpoint(base, backend=backend)))
+    assert plain.path == base and not plain.backend.is_local
+
+
+def test_checkpoint_subdir_restores_single_rank_shard(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    ck_prefix = st.join_path(base, "checkpoint_000000")
+    for r in range(2):
+        src = _make_src(tmp_path, name=f"r{r}", files={"w.txt": f"rank{r}"})
+        st.persist_directory(backend, src, st.join_path(ck_prefix, f"rank_{r}"))
+    shard = Checkpoint(ck_prefix, backend=backend).subdir("rank_1")
+    with shard.as_directory() as d:
+        # only this rank's bytes moved (commit metadata dotfiles ride along
+        # so the view matches the zero-copy local one)
+        assert [x for x in os.listdir(d) if not x.startswith(".")] == ["w.txt"]
+        assert open(os.path.join(d, "w.txt")).read() == "rank1"
+
+
+def test_checkpoint_from_uri_autoresolves(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    st.persist_directory(backend, src, st.join_path(base, "ck"))
+    ck = Checkpoint.from_uri("mock://bkt/exp/ck")
+    assert not ck.backend.is_local
+    with ck.as_directory() as d:
+        assert open(os.path.join(d, "a.txt")).read() == "hello"
+
+
+# -------------------------------------------- CheckpointManager retention
+
+
+def _register_n(mgr, tmp_path, metrics_list):
+    paths = []
+    for i, m in enumerate(metrics_list):
+        p = tmp_path / f"ckpt_{i}"
+        p.mkdir(exist_ok=True)
+        (p / "w.txt").write_text(str(i))
+        paths.append(str(p))
+        mgr.register(Checkpoint.from_directory(str(p)), m)
+    return paths
+
+
+def test_retention_num_to_keep_zero_keeps_only_latest(tmp_path):
+    mgr = CheckpointManager(CheckpointConfig(num_to_keep=0))
+    paths = _register_n(mgr, tmp_path, [{"acc": 0.9}, {"acc": 0.1}, {"acc": 0.5}])
+    kept = [t.checkpoint.path for t in mgr._tracked]
+    assert kept == [paths[2]]  # resume point survives even num_to_keep=0
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[1])
+
+
+def test_retention_score_ties_prefer_newer(tmp_path):
+    cfg = CheckpointConfig(num_to_keep=1, checkpoint_score_attribute="acc")
+    mgr = CheckpointManager(cfg)
+    paths = _register_n(mgr, tmp_path, [{"acc": 0.5}, {"acc": 0.5}, {"acc": 0.5}])
+    kept = [t.checkpoint.path for t in mgr._tracked]
+    assert kept == [paths[2]]  # deterministic: the tie breaks toward recency
+    assert mgr.best_checkpoint.path == paths[2]
+
+
+def test_retention_missing_score_attribute_falls_back_to_recency(tmp_path):
+    cfg = CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="nope")
+    mgr = CheckpointManager(cfg)
+    paths = _register_n(mgr, tmp_path, [{"a": 1}, {"a": 2}, {"a": 3}, {"a": 4}])
+    kept = [t.checkpoint.path for t in mgr._tracked]
+    assert kept == [paths[2], paths[3]]  # most recent two
+    assert not os.path.exists(paths[0])
+
+
+def test_retention_latest_never_deleted_even_if_worst(tmp_path):
+    cfg = CheckpointConfig(num_to_keep=1, checkpoint_score_attribute="acc")
+    mgr = CheckpointManager(cfg)
+    paths = _register_n(mgr, tmp_path, [{"acc": 0.9}, {"acc": 0.8}, {"acc": 0.1}])
+    kept = [t.checkpoint.path for t in mgr._tracked]
+    assert paths[2] in kept        # latest (worst score) still the resume point
+    assert paths[0] in kept        # best score retained
+    assert mgr.latest_checkpoint.path == paths[2]
+    assert mgr.best_checkpoint.path == paths[0]
+
+
+def test_retention_deletes_via_backend_for_remote(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    mgr = CheckpointManager(CheckpointConfig(num_to_keep=1))
+    prefixes = []
+    for i in range(3):
+        src = _make_src(tmp_path, name=f"s{i}")
+        prefix = st.join_path(base, f"checkpoint_{i:06d}")
+        st.persist_directory(backend, src, st.join_path(prefix, "rank_0"))
+        prefixes.append(prefix)
+        mgr.register(Checkpoint(prefix, backend=backend), {"i": i})
+    assert not backend.exists(prefixes[0])  # deleted from the object store
+    assert backend.exists(prefixes[2])
+
+
+def test_reregistration_rewrites_missing_complete_marker(tmp_path):
+    mgr = CheckpointManager(CheckpointConfig())
+    src = _make_src(tmp_path, name="ck")
+    ck = Checkpoint.from_directory(src)
+    mgr.register(ck, {"a": 1})
+    marker = os.path.join(src, COMPLETE_MARKER)
+    assert os.path.exists(marker)
+    os.remove(marker)  # e.g. storage-recovered dir that predates its marker
+    mgr.register(ck, {"a": 2})  # re-registration path
+    assert os.path.exists(marker)
+    assert mgr._tracked[0].metrics == {"a": 2}
+
+
+# ------------------------------------------------------- recovery scanning
+
+
+def test_recovery_trusts_manifest_not_name_prefix(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    # committed checkpoint
+    good = st.join_path(base, "checkpoint_000001")
+    st.persist_directory(backend, src, st.join_path(good, "rank_0"),
+                         meta={"metrics": {"loss": 0.5}, "iteration": 1})
+    # torn dir: checkpoint_* name, rank files present, but no commit marker
+    torn = st.join_path(base, "checkpoint_000002")
+    backend.write_bytes(st.join_path(torn, "rank_0", "state.txt"), b"par")
+    # committed but wrong sizes (bit-rot after commit): also untrusted
+    rotten = st.join_path(base, "checkpoint_000003")
+    st.persist_directory(backend, src, st.join_path(rotten, "rank_0"))
+    with open(backend._local(st.join_path(rotten, "rank_0", "a.txt")), "wb") as f:
+        f.write(b"x")
+    found = st.list_committed_checkpoints(backend, base, world_size=1)
+    assert [p for p, _ in found] == [good]
+    assert found[0][1]["metrics"] == {"loss": 0.5}  # metrics ride the manifest
+
+
+def test_recovery_requires_all_ranks_unless_marked(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    ck = st.join_path(base, "checkpoint_000000")
+    st.persist_directory(backend, src, st.join_path(ck, "rank_0"))
+    # only 1 of 2 ranks committed → not recoverable at world_size=2
+    assert st.list_committed_checkpoints(backend, base, world_size=2) == []
+    # unless the controller's COMPLETE_MARKER vouches for it
+    backend.write_bytes(st.join_path(ck, st.COMPLETE_MARKER), b"")
+    assert [p for p, _ in
+            st.list_committed_checkpoints(backend, base, world_size=2)] == [ck]
+
+
+def test_recovery_accepts_legacy_marker_only_checkpoints(tmp_path, mock_store):
+    """Pre-manifest-era checkpoints (marker, no manifests anywhere) stay
+    recoverable; a MIXED dir (some manifests) is a torn modern write."""
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    ck = st.join_path(base, "checkpoint_000000")
+    for r in range(2):
+        backend.write_bytes(st.join_path(ck, f"rank_{r}", "state.txt"), b"old")
+    assert st.list_committed_checkpoints(backend, base, 2) == []  # unmarked
+    backend.write_bytes(st.join_path(ck, st.COMPLETE_MARKER), b"")
+    assert [p for p, _ in
+            st.list_committed_checkpoints(backend, base, 2)] == [ck]
+    src = _make_src(tmp_path)
+    ck2 = st.join_path(base, "checkpoint_000001")
+    st.persist_directory(backend, src, st.join_path(ck2, "rank_0"))
+    backend.write_bytes(st.join_path(ck2, "rank_1", "state.txt"), b"partial")
+    backend.write_bytes(st.join_path(ck2, st.COMPLETE_MARKER), b"")
+    assert [p for p, _ in
+            st.list_committed_checkpoints(backend, base, 2)] == [ck]
+
+
+def test_downsized_recovery_respects_writing_world_size(tmp_path, mock_store):
+    """A checkpoint the controller vetoed (one of two ranks failed to
+    persist) must not become recoverable after an elastic downsize to 1:
+    the manifest records the writing attempt's world size."""
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    ck = st.join_path(base, "checkpoint_000000")
+    st.persist_directory(backend, src, st.join_path(ck, "rank_0"),
+                         meta={"world_size": 2})
+    assert st.list_committed_checkpoints(backend, base, world_size=1) == []
+    assert st.list_committed_checkpoints(backend, base, world_size=2) == []
+
+
+def test_tuner_restore_falls_back_to_backup_snapshot(tmp_path, mock_store):
+    """A torn overwrite of experiment_state.json (partial object in place)
+    must not make the experiment unrestorable — the backup slot holds the
+    previous good generation."""
+    from ray_tpu.tune.tuner import Tuner
+
+    backend, base = st.get_storage_backend("mock://bkt/exp/run")
+    good = json.dumps([{"trial_id": "trial_0000", "config": {"x": 1},
+                        "status": "TERMINATED", "last_result": {"score": 1},
+                        "iteration": 1, "error": None,
+                        "checkpoint_path": None}]).encode()
+    backend.write_bytes(st.join_path(base, "experiment_state.bak.json"), good)
+    backend.write_bytes(st.join_path(base, "experiment_state.json"),
+                        good[: len(good) // 2])  # torn canonical
+    tuner = Tuner.restore("mock://bkt/exp/run", lambda config: None,
+                          param_space={"x": [1]})
+    assert tuner._restore_summaries[0]["trial_id"] == "trial_0000"
+
+
+def test_marked_checkpoint_missing_recorded_shard_not_recovered(
+        tmp_path, mock_store):
+    """The COMPLETE marker records its rank set: a retention delete that
+    crashed halfway (one shard gone, marker intact) must not leave a
+    recoverable-looking checkpoint — even after an elastic downsize."""
+    backend, base = st.get_storage_backend("mock://bkt/exp")
+    src = _make_src(tmp_path)
+    ck = st.join_path(base, "checkpoint_000000")
+    for r in range(2):
+        st.persist_directory(backend, src, st.join_path(ck, f"rank_{r}"))
+    st.write_complete_marker(backend, ck)
+    assert [p for p, _ in
+            st.list_committed_checkpoints(backend, base, 2)] == [ck]
+    backend.delete_prefix(st.join_path(ck, "rank_1"))  # crashed half-delete
+    assert st.list_committed_checkpoints(backend, base, 2) == []
+    assert st.list_committed_checkpoints(backend, base, 1) == []
+
+
+# ----------------------------------------------------- session persist path
+
+
+def _session(tmp_path, backend, exp_dir, **kw):
+    return TrainSession(rank=0, world_size=1, local_rank=0, local_world_size=1,
+                        node_rank=0, experiment_dir=exp_dir,
+                        experiment_name="t", storage_backend=backend, **kw)
+
+
+def test_session_report_uploads_two_phase(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/run")
+    s = _session(tmp_path, backend, base)
+    src = _make_src(tmp_path)
+    s.report({"loss": 1.0}, checkpoint=Checkpoint.from_directory(src))
+    reports = s.drain_reports()
+    assert reports[0]["checkpoint_dir"] == st.join_path(base, "checkpoint_000000")
+    assert st.is_committed(
+        backend, st.join_path(base, "checkpoint_000000", "rank_0"))
+    manifest = st.read_manifest(
+        backend, st.join_path(base, "checkpoint_000000", "rank_0"))
+    assert manifest["meta"]["metrics"] == {"loss": 1.0}
+
+
+def test_session_persist_failure_degrades_by_default(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/run?fail_rate=1.0&seed=4")
+    s = _session(tmp_path, backend, base,
+                 storage_retry=st.RetryConfig(max_attempts=2, base_delay_s=0.001))
+    src = _make_src(tmp_path)
+    s.report({"loss": 1.0}, checkpoint=Checkpoint.from_directory(src))
+    rep = s.drain_reports()[0]
+    assert rep["checkpoint_dir"] is None  # degraded: metrics flow, no ckpt
+    assert rep["metrics"] == {"loss": 1.0}
+    assert s.persist_failures == 1
+
+
+def test_session_persist_failure_raises_when_configured(tmp_path, mock_store):
+    backend, base = st.get_storage_backend("mock://bkt/run?fail_rate=1.0&seed=4")
+    s = _session(tmp_path, backend, base, fail_on_persist_error=True,
+                 storage_retry=st.RetryConfig(max_attempts=2, base_delay_s=0.001))
+    src = _make_src(tmp_path)
+    with pytest.raises(st.StorageError):
+        s.report({"loss": 1.0}, checkpoint=Checkpoint.from_directory(src))
+
+
+# ------------------------------------------------- end-to-end on a cluster
+
+
+@pytest.fixture
+def ray_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_workers=2, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def mock_bucket():
+    """A unique bucket in the default shared store root: controller and
+    worker processes don't see the test's env, but they all resolve the same
+    default root, so bucket-uniqueness is the isolation."""
+    import shutil
+    import tempfile
+    import uuid
+
+    bucket = f"t{uuid.uuid4().hex[:12]}"
+    yield bucket
+    root = os.environ.get(
+        "RAY_TPU_MOCK_STORE_ROOT",
+        os.path.join(tempfile.gettempdir(), "ray_tpu_mock_store"))
+    shutil.rmtree(os.path.join(root, bucket), ignore_errors=True)
+    shutil.rmtree(os.path.join(root, ".internal", bucket), ignore_errors=True)
+
+
+def test_trainer_fit_on_mock_storage(ray_cluster, mock_bucket):
+    """Full trainer run against the mock remote store: checkpoints upload
+    through the backend, the result checkpoint downloads on demand."""
+
+    def train_fn(config):
+        import tempfile
+
+        from ray_tpu import train as t
+
+        for i in range(2):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(f"iter={i}")
+                t.report({"iter": i}, checkpoint=Checkpoint.from_directory(d))
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(
+            name="mockrun",
+            storage_path=f"mock://{mock_bucket}/results?latency_ms=1"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["iter"] == 1
+    assert result.checkpoint is not None
+    assert (result.checkpoint.path
+            == f"mock://{mock_bucket}/results/mockrun/checkpoint_000001")
+    with result.checkpoint.as_directory() as d:
+        assert sorted(x for x in os.listdir(d) if not x.startswith(".")) == \
+            ["rank_0", "rank_1"]
+        assert open(os.path.join(d, "rank_0", "state.txt")).read() == "iter=1"
+    assert result.storage_retries == 0
+
+
+def test_controller_vetoes_checkpoint_with_degraded_rank(tmp_path, mock_store):
+    """Unit-level veto: one rank's persist degraded (persist_failed=True) →
+    the controller must not register the checkpoint even though the other
+    rank committed its shard (a marked-but-incomplete prefix would become a
+    torn resume point)."""
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+    from ray_tpu.train.config import CheckpointConfig
+    from ray_tpu.train.controller import TrainController
+
+    backend, base = st.get_storage_backend("mock://bkt/run")
+    ctrl = TrainController._cls.__new__(TrainController._cls)
+    ctrl.ckpt_manager = CheckpointManager(CheckpointConfig())
+    ctrl.latest_metrics = {}
+    ctrl._retries_prev_attempts = 0
+    ctrl._attempt_retries = 0
+    ctrl._storage = backend
+    ctrl._iter_buffer = {0: {
+        0: {"iter": 0, "rank": 0, "metrics": {"loss": 1.0},
+            "checkpoint_dir": None, "persist_failed": True,
+            "storage_retries": 4},
+        1: {"iter": 0, "rank": 1, "metrics": {"loss": 1.0},
+            "checkpoint_dir": st.join_path(base, "checkpoint_000000"),
+            "persist_failed": False, "storage_retries": 0},
+    }}
+    ctrl._consume_complete_iters(2)
+    assert ctrl.ckpt_manager.latest_checkpoint is None  # vetoed
+    assert ctrl.latest_metrics == {"loss": 1.0}         # metrics still flow
+    assert ctrl._iter_buffer == {}
+    # metrics-only reports (never tried to persist) do NOT veto
+    ctrl._iter_buffer = {1: {
+        0: {"iter": 1, "rank": 0, "metrics": {"loss": 0.5},
+            "checkpoint_dir": st.join_path(base, "checkpoint_000001"),
+            "persist_failed": False, "storage_retries": 0},
+        1: {"iter": 1, "rank": 1, "metrics": {"loss": 0.5},
+            "checkpoint_dir": None, "persist_failed": False,
+            "storage_retries": 0},
+    }}
+    ctrl._consume_complete_iters(2)
+    assert ctrl.ckpt_manager.latest_checkpoint is not None
+
+
+@pytest.mark.slow
+def test_degraded_rank_vetoes_checkpoint_registration(ray_cluster, mock_bucket):
+    """fail_on_key pins a permanent outage on rank_0's uploads: rank_1
+    commits its shard but the controller must never register (or
+    COMPLETE-mark) a checkpoint missing a rank — metrics still flow and the
+    run finishes without a resume point rather than with a torn one."""
+
+    def train_fn(config):
+        import tempfile
+
+        from ray_tpu import train as t
+
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "w.txt"), "w") as f:
+                f.write("x")
+            t.report({"step": 1}, checkpoint=Checkpoint.from_directory(d))
+
+    uri = f"mock://{mock_bucket}/runs?fail_on_key=rank_0"
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(name="degraded", storage_path=uri),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics == {"step": 1}   # metrics flow despite the outage
+    assert result.checkpoint is None       # torn checkpoint never registered
+    backend, base = st.get_storage_backend(uri)
+    exp = st.join_path(base, "degraded")
+    assert st.list_committed_checkpoints(backend, exp, world_size=2) == []
+
+
+@pytest.mark.slow
+def test_tuner_on_mock_storage_and_restore_uri(ray_cluster, mock_bucket):
+    """Tune trials persist under per-trial mock:// prefixes; Tuner.restore
+    from the storage URI sees the finished trials without re-running.
+    (slow: tune e2e lives behind -m slow in this repo, see conftest.)"""
+    from ray_tpu.tune import TuneConfig, Tuner, grid_search
+
+    def trainable(config):
+        import tempfile
+
+        from ray_tpu import train as t
+
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "v.txt"), "w") as f:
+                f.write(str(config["x"]))
+            t.report({"score": config["x"] * 10},
+                     checkpoint=Checkpoint.from_directory(d))
+
+    uri = f"mock://{mock_bucket}/tune_exp"
+    tuner = Tuner(trainable, param_space={"x": grid_search([1, 2])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=train.RunConfig(name="grid", storage_path=uri))
+    grid = tuner.fit()
+    assert len(grid) == 2 and not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 20
+    assert best.checkpoint is not None and not best.checkpoint.backend.is_local
+    with best.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "rank_0", "v.txt")).read() == "2"
+    # snapshot + tuner.pkl live in the object store, not on local disk
+    backend, base = st.get_storage_backend(f"{uri}/grid")
+    assert backend.exists(st.join_path(base, "experiment_state.json"))
+    restored = Tuner.restore(f"{uri}/grid", trainable).fit()
+    assert len(restored) == 2 and not restored.errors
+    assert restored.get_best_result().metrics["score"] == 20

@@ -1,0 +1,198 @@
+"""Checkpoint-storage chaos: SIGKILL mid-upload, crash-resume across hosts.
+
+(reference: the Ray paper's fault-tolerance story applied to training —
+checkpoints ride a StorageContext so a run survives losing its host
+(train/v2/_internal/execution/storage.py); the mock:// backend makes the
+preemption-heavy TPU regime testable with networking blocked.)
+
+The headline test kills the training worker process mid-upload (the mock
+store's die_on_key knob SIGKILLs the uploader halfway through an object
+write), then starts a FRESH driver + controller — a different "host", no
+shared memory with the first — pointed at the same storage URI, and asserts
+it resumes from the last *committed* checkpoint, never the torn one, with
+bounded retry counts. The long randomized fault-injection loop stays behind
+`-m slow` so tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import pytest
+
+from ray_tpu.train import storage as st
+
+_PHASE_A = """
+import os, sys
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train._checkpoint import Checkpoint
+
+ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+
+def train_fn(config):
+    import tempfile
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            with open(os.path.join(d, "rank_0", "iter.txt")) as f:
+                start = int(f.read()) + 1
+    for i in range(start, 5):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "iter.txt"), "w") as f:
+                f.write(str(i))
+            with open(os.path.join(d, "payload.bin"), "wb") as f:
+                f.write(os.urandom(4096))
+            train.report({"iter": i, "resumed_from": start},
+                         checkpoint=Checkpoint.from_directory(d))
+
+trainer = train.DataParallelTrainer(
+    train_fn,
+    scaling_config=train.ScalingConfig(num_workers=1),
+    run_config=train.RunConfig(
+        name="chaos", storage_path=os.environ["CHAOS_URI_A"],
+        failure_config=train.FailureConfig(max_failures=0)),
+)
+try:
+    trainer.fit()
+    print("PHASE-A-UNEXPECTED-SUCCESS")
+except train.TrainingFailedError:
+    print("PHASE-A-DIED-AS-EXPECTED")
+ray_tpu.shutdown()
+"""
+
+_PHASE_B = """
+import os, sys
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import storage as st
+from ray_tpu.train._checkpoint import Checkpoint
+
+uri = os.environ["CHAOS_URI_B"]
+backend, exp_root = st.get_storage_backend(uri)
+exp = st.join_path(exp_root, "chaos")
+
+# the durable record before resume: two committed checkpoints; the torn
+# mid-upload prefix from phase A exists on storage but is NOT recoverable
+committed = [st.basename(p) for p, _ in
+             st.list_committed_checkpoints(backend, exp, world_size=1)]
+print("COMMITTED-BEFORE:", ",".join(committed))
+torn = st.join_path(exp, "checkpoint_000002")
+print("TORN-EXISTS:", backend.exists(torn),
+      "TORN-COMMITTED:", st.is_committed(backend, st.join_path(torn, "rank_0")))
+
+ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+
+def train_fn(config):
+    import tempfile
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            with open(os.path.join(d, "rank_0", "iter.txt")) as f:
+                start = int(f.read()) + 1
+    for i in range(start, 5):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "iter.txt"), "w") as f:
+                f.write(str(i))
+            with open(os.path.join(d, "payload.bin"), "wb") as f:
+                f.write(os.urandom(4096))
+            train.report({"iter": i, "resumed_from": start},
+                         checkpoint=Checkpoint.from_directory(d))
+
+trainer = train.DataParallelTrainer(
+    train_fn,
+    scaling_config=train.ScalingConfig(num_workers=1),
+    run_config=train.RunConfig(
+        name="chaos", storage_path=uri,
+        failure_config=train.FailureConfig(max_failures=0)),
+)
+result = trainer.fit()
+print("RESULT-ITER:", result.metrics["iter"])
+print("RESUMED-FROM:", result.metrics["resumed_from"])
+print("RESULT-CKPT:", st.basename(result.checkpoint.path))
+print("STORAGE-RETRIES:", result.storage_retries)
+ray_tpu.shutdown()
+"""
+
+
+@pytest.mark.storage_chaos
+def test_kill_mid_upload_then_resume_on_fresh_host(tmp_path):
+    """SIGKILL the training worker mid-upload; a fresh controller on a
+    'different host' (new driver process, same storage URI) resumes from the
+    last committed checkpoint and never registers the torn one."""
+    env = dict(os.environ)
+    env["RAY_TPU_MOCK_STORE_ROOT"] = str(tmp_path / "store")
+    # die halfway through uploading checkpoint_000002's first object: the
+    # prefix is left genuinely torn (partial file, no manifest, no commit)
+    env["CHAOS_URI_A"] = ("mock://chaosbkt/runs"
+                          "?die_on_key=checkpoint_000002/rank_0&latency_ms=1")
+    # the resumed run reads AND writes under injected faults: uploads/reads
+    # fail 15% of the time and are absorbed by bounded retries
+    env["CHAOS_URI_B"] = ("mock://chaosbkt/runs"
+                          "?fail_rate=0.15&read_fail_rate=0.1&seed=11")
+
+    a = subprocess.run(["python", "-c", _PHASE_A], capture_output=True,
+                       text=True, timeout=300, env=env, cwd="/root/repo")
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert "PHASE-A-DIED-AS-EXPECTED" in a.stdout, a.stdout + a.stderr
+
+    # the worker died mid-upload: committed = 000000, 000001; 000002 torn
+    b = subprocess.run(["python", "-c", _PHASE_B], capture_output=True,
+                       text=True, timeout=300, env=env, cwd="/root/repo")
+    assert b.returncode == 0, b.stdout + b.stderr
+    out = b.stdout
+    assert "COMMITTED-BEFORE: checkpoint_000000,checkpoint_000001" in out, out
+    assert "TORN-EXISTS: True TORN-COMMITTED: False" in out, out
+    assert "RESUMED-FROM: 2" in out, out       # resumed past committed 000001,
+    assert "RESULT-ITER: 4" in out, out        # never from the torn 000002
+    assert "RESULT-CKPT: checkpoint_000004" in out, out
+    retries = int(out.split("STORAGE-RETRIES:")[1].strip().split()[0])
+    # bounded: every op retries at most max_attempts-1 times; the whole run
+    # moves ~18 objects, so anything runaway would blow well past this
+    assert 0 <= retries <= 18 * (st.DEFAULT_RETRY.max_attempts - 1), out
+
+
+@pytest.mark.slow
+@pytest.mark.storage_chaos
+def test_fault_injection_loop_never_silently_corrupts(tmp_path, monkeypatch):
+    """Long randomized loop: under upload failures, torn writes, and read
+    failures, every persist/restore cycle either succeeds with byte-exact
+    content or raises StorageError — never silent corruption, and a failed
+    persist never leaves a committed prefix."""
+    monkeypatch.setenv("RAY_TPU_MOCK_STORE_ROOT", str(tmp_path / "store"))
+    retry = st.RetryConfig(max_attempts=6, base_delay_s=0.001)
+    outcomes = {"ok": 0, "persist_fail": 0}
+    for seed in range(12):
+        backend, base = st.get_storage_backend(
+            f"mock://loop/exp{seed}?fail_rate=0.3&torn_rate=0.15"
+            f"&read_fail_rate=0.2&seed={seed}")
+        src = tmp_path / f"src{seed}"
+        src.mkdir()
+        blobs = {f"f{j}.bin": os.urandom(256 + 64 * j) for j in range(4)}
+        for name, data in blobs.items():
+            (src / name).write_bytes(data)
+        prefix = st.join_path(base, "ck")
+        try:
+            stats = st.persist_directory(backend, str(src), prefix, retry=retry)
+        except st.StorageError:
+            outcomes["persist_fail"] += 1
+            assert not st.is_committed(backend, prefix)  # torn, untrusted
+            continue
+        assert stats.retries <= (stats.files + 2) * (retry.max_attempts - 1)
+        assert st.is_committed(backend, prefix)
+        dest = tmp_path / f"dest{seed}"
+        st.restore_directory(
+            backend, prefix, str(dest),
+            retry=st.RetryConfig(max_attempts=12, base_delay_s=0.001))
+        for name, data in blobs.items():
+            assert (dest / name).read_bytes() == data  # byte-exact or raise
+        outcomes["ok"] += 1
+    assert outcomes["ok"] >= 1          # the retry budget absorbs most faults
+    assert sum(outcomes.values()) == 12
