@@ -384,6 +384,11 @@ class GcsServer:
         # cluster shape, which only the autoscaler knows). Tracked per
         # connection so autoscaler death restores fail-fast.
         self._autoscaler_conns: set = set()
+        # autoscaler instance state machine (reference: v2 instance_manager's
+        # InstanceStorage lives in the GCS so a restarted reconciler rebuilds
+        # from the table): instance_id → record dict, write-through to the
+        # sqlite `instances` table when persistence is on
+        self.autoscaler_instances: dict[str, dict] = {}
         # caller-reported local submission backlogs, piggybacked on lease
         # requests (reference: backlog_size in lease requests feeds the
         # autoscaler's demand view)
@@ -423,6 +428,8 @@ class GcsServer:
         with self.lock:
             for k, v in self.storage.items("kv"):
                 self.kv[k] = v
+            for k, v in self.storage.items("instances"):
+                self.autoscaler_instances[k] = v
         for _, spec in self.storage.items("pgs"):
             self._create_pg(dict(spec), _persist=False)
         for _, spec in self.storage.items("actors"):
@@ -1010,6 +1017,29 @@ class GcsServer:
             with self.lock:
                 self._autoscaler_conns.add(id(conn))
             conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "instance_put":
+            # autoscaler instance state machine write-through (reference: v2
+            # instance_storage) — the reply IS the durability ack: the
+            # reconciler orders provider side-effects after it, so persist
+            # (memory + sqlite) strictly before sending
+            rec = dict(msg["instance"])
+            iid = str(rec["instance_id"])
+            with self.lock:
+                self.autoscaler_instances[iid] = rec
+            if self.storage is not None:
+                self.storage.put("instances", iid, rec)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "instance_delete":
+            iid = str(msg["instance_id"])
+            with self.lock:
+                self.autoscaler_instances.pop(iid, None)
+            if self.storage is not None:
+                self.storage.delete("instances", iid)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "instance_list":
+            with self.lock:
+                recs = [dict(r) for r in self.autoscaler_instances.values()]
+            conn.send({"rid": msg["rid"], "instances": recs})
         elif t == "oom_clear":
             # agent declined the pick or its kill failed: drop the tag
             self._note_oom_kill(msg["pid"], None,

@@ -7,7 +7,8 @@ via gcs_init_data.h). TPU build keeps it dependency-free: sqlite3 (stdlib)
 in WAL mode, one table per GCS manager, write-through on every mutation.
 
 Tables: kv (internal KV incl. jobs), actors (create specs of live actors),
-pgs (placement-group specs), session (session metadata).
+pgs (placement-group specs), session (session metadata), instances
+(autoscaler instance state machine — see autoscaler/instance_manager.py).
 """
 
 from __future__ import annotations
@@ -29,7 +30,7 @@ class GcsStorage:
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
-        for table in ("kv", "actors", "pgs", "session"):
+        for table in ("kv", "actors", "pgs", "session", "instances"):
             self._db.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "
                 "(key TEXT PRIMARY KEY, value BLOB)")

@@ -67,6 +67,15 @@ def build_provider(cfg: dict, gcs_address: str):
                             gcs_address=gcs_address, **api_kw)
         api.validate()
         return GceTpuNodeProvider(api, **p)
+    if kind == "fake_file":
+        # file-backed fake "cloud" with SIGKILL fault injection — the
+        # provider crash-restart chaos tests drive the real monitor process
+        # through it (tests/test_autoscaler_chaos.py)
+        from ray_tpu.autoscaler.node_provider import FakeFileNodeProvider
+
+        return FakeFileNodeProvider(
+            p.pop("path"),
+            die_after_create=int(p.pop("die_after_create", 0)))
     if kind == "fake_gce_tpu":
         from ray_tpu.autoscaler.gce_tpu import (FakeGceTpuApi,
                                                 GceTpuNodeProvider)

@@ -1,8 +1,11 @@
 from .autoscaler import Autoscaler, NodeType
 from .gce_tpu import (FakeGceTpuApi, GceTpuApi, GceTpuNodeProvider,
                       tpu_slice_node_type)
-from .node_provider import LocalNodeProvider, NodeProvider
+from .instance_manager import Instance, InstanceManager
+from .node_provider import (FakeFileNodeProvider, LocalNodeProvider,
+                            NodeProvider)
 
 __all__ = ["Autoscaler", "NodeType", "NodeProvider", "LocalNodeProvider",
+           "FakeFileNodeProvider", "Instance", "InstanceManager",
            "GceTpuApi", "FakeGceTpuApi", "GceTpuNodeProvider",
            "tpu_slice_node_type"]

@@ -6,6 +6,16 @@ instance_manager/reconciler.py) consuming the GCS autoscaler-state API
 (src/ray/gcs/gcs_autoscaler_state_manager.h), and v1's demand bin-packing
 (autoscaler/_private/resource_demand_scheduler.py:100).
 
+Every node the autoscaler touches is an `Instance` record in a persisted
+state machine (instance_manager.py): REQUESTED is persisted before the
+provider create call, ALLOCATED after it, TERMINATING before the terminate
+call — so `reconcile_once` is a pure function of (persisted instance table,
+provider `non_terminated_nodes()`, GCS demand). A reconciler SIGKILLed at
+any single point restarts, rebuilds from the table, adopts still-alive
+provider nodes, reaps records whose node vanished, sweeps provider nodes
+that have no record, and converges to the same target without
+double-launching or leaking (tests/test_autoscaler_chaos.py).
+
 Loop: read pending demand from the GCS → bin-pack unplaceable demand onto
 configured node types (respecting min/max counts) → create/terminate via the
 NodeProvider → repeat. TPU slices scale atomically: a `NodeType` with TPU
@@ -22,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ray_tpu._private.protocol import ConnectionClosed, connect_address
+from ray_tpu.autoscaler import instance_manager as im
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
 logger = logging.getLogger(__name__)
@@ -63,17 +74,16 @@ class Autoscaler:
         self.node_startup_grace_s = node_startup_grace_s
         self._conn = connect_address(gcs_address)
         self._rid = itertools.count(1)
+        # stop() keeps going after a 5s join timeout (the loop thread may be
+        # wedged in a provider backoff) and then issues RPCs of its own:
+        # request/reply pairs on the shared connection must be atomic or
+        # each thread's recv loop silently eats the other's reply
+        self._rpc_lock = threading.Lock()
         self._rpc({"type": "autoscaler_attach"})  # infeasible PGs now pend
-        self._nodes: Dict[str, str] = {}  # provider node id → type name
-        self._launch_times: Dict[str, float] = {}
-        self._idle_since: Dict[str, float] = {}
-        # type name → monotonic ts until which launches are suppressed
-        # (provider create failed with quota/stockout: hot-retrying cannot
-        # succeed, so the failure maps into reconciler state instead of
-        # crashing the loop — reference: v2 instance_manager tracks launch
-        # failures per instance type)
-        self._type_cooldown: Dict[str, float] = {}
-        self._launch_errors: Dict[str, str] = {}  # type → last error text
+        # the persisted instance state machine, write-through to the GCS
+        # `instances` table; the first reconcile pass rebuilds from it
+        self._im = im.InstanceManager(im.GcsInstanceStorage(self._rpc))
+        self._recovered = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -81,11 +91,12 @@ class Autoscaler:
 
     def _rpc(self, msg: dict) -> dict:
         msg["rid"] = next(self._rid)
-        self._conn.send(msg)
-        while True:
-            reply = self._conn.recv()
-            if reply.get("rid") == msg["rid"]:
-                return reply
+        with self._rpc_lock:
+            self._conn.send(msg)
+            while True:
+                reply = self._conn.recv()
+                if reply.get("rid") == msg["rid"]:
+                    return reply
 
     def _demand(self) -> dict:
         return self._rpc({"type": "resource_demand"})["demand"]
@@ -94,8 +105,63 @@ class Autoscaler:
 
     def reconcile_once(self) -> dict:
         """One reconcile pass; returns a summary (for tests/introspection)."""
+        actions = {"launched": [], "terminated": [], "adopted": [],
+                   "reaped": [], "swept": []}
+        if not self._recovered:
+            self._recover(actions)
+            self._recovered = True
+        now = time.time()
+
+        # 0. sync the table against provider ground truth. This is what
+        #    makes a restart just another pass: stale records resolve, and
+        #    provider reality the table doesn't know about gets cleaned up.
+        live = set(self.provider.non_terminated_nodes())
+        for inst in self._im.instances(im.REQUESTED):
+            # only a crashed reconciler leaves REQUESTED behind (within a
+            # pass it resolves synchronously): launch outcome unknown, so
+            # count it failed — any node it DID create has no record and is
+            # swept below, and real demand drives a fresh launch
+            self._im.transition(inst, im.TERMINATED)
+        for inst in self._im.instances(*im.LIVE_STATES):
+            if inst.node_id not in live:
+                # externally-died node (incl. preempted slices the provider
+                # filters out of non_terminated_nodes — relaunched on demand)
+                self._im.transition(inst, im.TERMINATED)
+                actions["reaped"].append((inst.node_type, inst.node_id))
+        for inst in self._im.instances(im.TERMINATING):
+            if inst.node_id in live:
+                # crash landed between the TERMINATING persist and the cloud
+                # call: re-issue the (idempotent) terminate
+                if self._terminate_instance(inst, actions):
+                    live.discard(inst.node_id)  # or the sweep re-terminates
+            else:
+                self._im.transition(inst, im.TERMINATED)
+        for inst in self._im.instances(im.ALLOCATION_FAILED):
+            if now >= inst.cooldown_until:
+                # expired cooldowns drop their stale error from the summary
+                self._im.transition(inst, im.TERMINATED)
+        # leak sweep: provider nodes no record claims. Only nodes the
+        # provider recognizes as autoscaler-created (owns_node) — sweeping a
+        # foreign node would be worse than leaking one.
+        recorded = {i.node_id for i in self._im.instances() if i.node_id}
+        for nid in sorted(live - recorded):
+            if not self.provider.owns_node(nid):
+                continue
+            try:
+                self.provider.terminate_node(nid)
+                actions["swept"].append(nid)
+                live.discard(nid)
+                logger.warning("autoscaler: swept leaked node %s (no "
+                               "instance record)", nid)
+            except Exception:
+                logger.exception("failed to sweep leaked node %s", nid)
+
         demand = self._demand()
-        actions = {"launched": [], "terminated": []}
+        joined = set(demand.get("node_ids") or ())
+        for inst in self._im.instances(im.ALLOCATED):
+            if inst.node_id in live and self.provider.node_joined(
+                    inst.node_id, joined):
+                self._im.transition(inst, im.RUNNING)
 
         # 1. unplaceable demand = demands that don't fit current availability
         avail = dict(demand["available_resources"])
@@ -113,9 +179,7 @@ class Autoscaler:
                     unmet.append(b)
 
         # 2. min_nodes floors
-        counts: Dict[str, int] = {}
-        for nid, tname in self._nodes.items():
-            counts[tname] = counts.get(tname, 0) + 1
+        counts = self._im.counts()
         for nt in self.node_types.values():
             while (counts.get(nt.name, 0) < nt.min_nodes
                    and not self._cooling_down(nt.name)):
@@ -127,20 +191,18 @@ class Autoscaler:
 
         # 3. bin-pack unmet demand onto new nodes — several demands may share
         #    one planned node (reference: ResourceDemandScheduler bin-packing).
-        #    Recently launched nodes that haven't joined yet are seeded as
-        #    pending capacity so the same backlog doesn't relaunch each pass.
-        now0 = time.monotonic()
-        joined = set(demand.get("node_ids") or ())
+        #    ALLOCATED instances that haven't joined yet are seeded as
+        #    pending capacity so the same backlog doesn't relaunch each pass
+        #    (their launch_time is persisted wall-clock: the seeding — and
+        #    therefore double-launch protection — survives a restart).
         planned: List[tuple] = []  # (NodeType, remaining capacity, is_new)
-        for nid, tname in self._nodes.items():
-            nt = self.node_types.get(tname)
+        for inst in self._im.instances(im.ALLOCATED):
+            nt = self.node_types.get(inst.node_type)
             if (nt is not None
                     # joined capacity is already in available_resources —
                     # counting it again would absorb real demand into
-                    # phantom capacity (providers map ids via node_joined)
-                    and not self.provider.node_joined(nid, joined)
-                    and now0 - self._launch_times.get(nid, 0.0)
-                    < self.node_startup_grace_s):
+                    # phantom capacity (ALLOCATED means not yet joined)
+                    and now - inst.launch_time < self.node_startup_grace_s):
                 planned.append((nt, dict(nt.resources), False))
         for d in sorted(unmet, key=lambda d: -sum(d.values())):
             for _, rem, _new in planned:
@@ -174,65 +236,144 @@ class Autoscaler:
         # 4. terminate idle above-min nodes (no demand and nothing running
         #    on them — approximated by zero unmet demand + full availability)
         if not unmet and not demand["pg_demands"]:
-            now = time.monotonic()
-            for nid, tname in list(self._nodes.items()):
-                nt = self.node_types.get(tname)
+            live_insts = self._im.instances(*im.LIVE_STATES)
+            alive_counts = self._im.counts(states=im.LIVE_STATES)
+            for inst in live_insts:
+                nt = self.node_types.get(inst.node_type)
                 if nt is None:
                     continue
-                alive_of_type = sum(1 for t in self._nodes.values() if t == tname)
-                if alive_of_type <= nt.min_nodes:
-                    self._idle_since.pop(nid, None)
+                if alive_counts.get(inst.node_type, 0) <= nt.min_nodes:
+                    if inst.state == im.IDLE_TRACKED:
+                        self._im.transition(inst, im.RUNNING, idle_since=None)
                     continue
-                since = self._idle_since.setdefault(nid, now)
-                if now - since >= self.idle_timeout_s:
-                    self._terminate(nid)
-                    actions["terminated"].append((tname, nid))
+                if (inst.state == im.ALLOCATED
+                        and now - inst.launch_time
+                        < self.node_startup_grace_s):
+                    # a just-launched node that hasn't joined yet must not be
+                    # idle-terminated out from under its own startup: the
+                    # idle clock only starts once it joins (RUNNING) or
+                    # overstays the startup grace
+                    continue
+                if inst.state != im.IDLE_TRACKED:
+                    inst = self._im.transition(inst, im.IDLE_TRACKED,
+                                               idle_since=now)
+                if now - (inst.idle_since or now) >= self.idle_timeout_s:
+                    if self._terminate_instance(inst, actions):
+                        alive_counts[inst.node_type] = (
+                            alive_counts.get(inst.node_type, 1) - 1)
         else:
-            self._idle_since.clear()
+            for inst in self._im.instances(im.IDLE_TRACKED):
+                self._im.transition(inst, im.RUNNING, idle_since=None)
 
-        # reap externally-died nodes (incl. preempted slices the provider
-        # filters out of non_terminated_nodes — relaunched next pass)
-        live = set(self.provider.non_terminated_nodes())
-        for nid in list(self._nodes):
-            if nid not in live:
-                self._nodes.pop(nid, None)
-                self._idle_since.pop(nid, None)
-                self._launch_times.pop(nid, None)
-        # expired cooldowns drop their stale error from the summary too
-        for tname in list(self._launch_errors):
-            if not self._cooling_down(tname):
-                self._launch_errors.pop(tname, None)
-        actions["launch_failures"] = dict(self._launch_errors)
+        actions["launch_failures"] = {
+            f.node_type: f.error
+            for f in self._im.instances(im.ALLOCATION_FAILED)}
         return actions
 
+    def _recover(self, actions: dict) -> None:
+        """Startup rebuild: load the persisted table and let the provider
+        re-attach to each recorded live node (a fresh LocalNodeProvider
+        re-adopts agent pids; cloud providers just confirm existence).
+        Records whose node is truly gone are reaped by the sync step of the
+        same pass — recovery never launches or terminates by itself."""
+        for inst in self._im.load():
+            # TERMINATING is included: a terminate interrupted by the crash
+            # must be re-attachable, or a provider whose visibility depends
+            # on adoption (LocalNodeProvider pids) would "lose" the node and
+            # orphan it instead of re-issuing the terminate
+            if inst.state not in (*im.LIVE_STATES, im.TERMINATING):
+                continue
+            adopted = False
+            try:
+                adopted = self.provider.adopt_node(
+                    inst.node_id, dict(inst.provider_data))
+            except Exception:
+                logger.exception("adopt_node failed for %s", inst.node_id)
+            if adopted:
+                actions["adopted"].append((inst.node_type, inst.node_id))
+                logger.info("autoscaler: adopted %s node %s from persisted "
+                            "state", inst.node_type, inst.node_id)
+
     def _cooling_down(self, tname: str) -> bool:
-        return time.monotonic() < self._type_cooldown.get(tname, 0.0)
+        now = time.time()
+        return any(f.cooldown_until > now
+                   for f in self._im.instances(im.ALLOCATION_FAILED)
+                   if f.node_type == tname)
 
     def _launch(self, nt: NodeType) -> Optional[str]:
         """Create a node; on provider failure, back off the node type for
         the error's suggested cooldown and return None instead of raising —
-        a quota/stockout must degrade the reconciler, not crash it."""
+        a quota/stockout must degrade the reconciler, not crash it.
+
+        Persistence ordering: the REQUESTED record is durable BEFORE the
+        provider call, the ALLOCATED record (with the node id) right after
+        it — a crash at any point leaves a record the recovery sweep can
+        resolve."""
+        if self._stop.is_set():
+            # a wedged reconcile pass resuming AFTER stop() tore the fleet
+            # down must not relaunch nodes nobody will ever terminate
+            return None
+        inst = self._im.create(nt.name)
         try:
             nid = self.provider.create_node(nt.name, nt.resources, nt.labels)
         except Exception as e:
             cooldown = float(getattr(e, "cooldown_s", 10.0))
-            self._type_cooldown[nt.name] = time.monotonic() + cooldown
-            self._launch_errors[nt.name] = str(e)
+            self._im.transition(inst, im.ALLOCATION_FAILED,
+                                cooldown_until=time.time() + cooldown,
+                                error=str(e))
             logger.warning("autoscaler: launch of %s failed (%s); cooling "
                            "down %.0fs", nt.name, e, cooldown)
             return None
-        self._launch_errors.pop(nt.name, None)
-        self._nodes[nid] = nt.name
-        self._launch_times[nid] = time.monotonic()
+        if self._stop.is_set():
+            # stop() tore the fleet down while this create was in flight
+            # (thread wedged inside the provider call past the join
+            # timeout): ALLOCATING now would hand a live node to nobody —
+            # undo it instead
+            logger.warning("autoscaler: launch of %s completed after stop; "
+                           "terminating %s", nt.name, nid)
+            try:
+                self.provider.terminate_node(nid)
+            except Exception:
+                logger.exception("failed to terminate post-stop node %s",
+                                 nid)
+            try:
+                self._im.transition(inst, im.TERMINATED)
+            except Exception:
+                # GCS may already be gone; a stale REQUESTED record is
+                # resolved by the next incarnation's recovery
+                pass
+            return None
+        data: dict = {}
+        try:
+            data = self.provider.describe_node(nid) or {}
+        except Exception:
+            logger.exception("describe_node failed for %s", nid)
+        self._im.transition(inst, im.ALLOCATED, node_id=nid,
+                            launch_time=time.time(), provider_data=data)
+        # a successful launch retires stale failure records of this type
+        for f in self._im.instances(im.ALLOCATION_FAILED):
+            if f.node_type == nt.name:
+                self._im.transition(f, im.TERMINATED)
         logger.info("autoscaler: launched %s node %s", nt.name, nid)
         return nid
 
-    def _terminate(self, nid: str) -> None:
-        self.provider.terminate_node(nid)
-        tname = self._nodes.pop(nid, "?")
-        self._idle_since.pop(nid, None)
-        self._launch_times.pop(nid, None)
-        logger.info("autoscaler: terminated %s node %s", tname, nid)
+    def _terminate_instance(self, inst: im.Instance, actions: dict) -> bool:
+        """TERMINATING is durable before the cloud call: a crash in between
+        re-issues the idempotent terminate on restart instead of leaking.
+        Returns True once the node is actually gone."""
+        if inst.state != im.TERMINATING:
+            inst = self._im.transition(inst, im.TERMINATING)
+        try:
+            self.provider.terminate_node(inst.node_id)
+        except Exception:
+            # record stays TERMINATING; the next pass re-issues
+            logger.exception("failed to terminate node %s", inst.node_id)
+            return False
+        self._im.transition(inst, im.TERMINATED)
+        actions["terminated"].append((inst.node_type, inst.node_id))
+        logger.info("autoscaler: terminated %s node %s", inst.node_type,
+                    inst.node_id)
+        return True
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -255,14 +396,51 @@ class Autoscaler:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # a loop thread still alive after the join timeout may be wedged
+        # inside an RPC holding _rpc_lock — teardown must not touch the
+        # shared connection or it deadlocks
+        wedged = self._thread is not None and self._thread.is_alive()
+        if terminate_nodes and not self._recovered and not wedged:
+            # stopped before the first reconcile ever ran: the in-memory
+            # view is empty but the TABLE may hold a previous incarnation's
+            # live nodes — load (and adopt, so pid-based providers can kill
+            # them) or terminate_nodes would silently leak everything
+            try:
+                self._recover({"adopted": []})
+                self._recovered = True
+            except Exception:
+                logger.warning("could not load persisted instances for "
+                               "teardown (GCS gone?)")
         if terminate_nodes:
-            for nid in list(self._nodes):
+            # teardown is provider-FIRST, persistence best-effort — the
+            # inverse of the reconcile-path ordering. The monitor often
+            # stops BECAUSE the head/GCS died (ConnectionClosed exit), and
+            # a failing persist must not stand between us and releasing
+            # cloud nodes. A record left stale here still resolves: the
+            # next reconciler's sync reaps it once the node is gone.
+            # InstanceManager snapshots are internally locked, so this is
+            # consistent even against a wedged reconcile thread mid-pass
+            for inst in self._im.instances(*im.LIVE_STATES, im.TERMINATING):
                 try:
-                    self._terminate(nid)
+                    self.provider.terminate_node(inst.node_id)
                 except Exception:
                     # one failed cloud call must not abort teardown and
                     # leak every REMAINING node
-                    logger.exception("failed to terminate node %s", nid)
+                    logger.exception("failed to terminate node %s",
+                                     inst.node_id)
+                    continue
+                logger.info("autoscaler: terminated %s node %s",
+                            inst.node_type, inst.node_id)
+                if wedged:
+                    continue
+                try:
+                    if inst.state != im.TERMINATING:
+                        inst = self._im.transition(inst, im.TERMINATING)
+                    self._im.transition(inst, im.TERMINATED)
+                except Exception:
+                    logger.warning("could not persist teardown of %s "
+                                   "(GCS gone?); the recovery sweep will "
+                                   "resolve the stale record", inst.node_id)
         try:
             self._conn.close()
         except Exception:

@@ -21,6 +21,7 @@ exponential backoff before any error escapes.
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.error
 import urllib.request
@@ -129,7 +130,8 @@ class RestGceTpuApi(GceTpuApi):
                  network: str = "", preemptible: bool = False,
                  max_retries: int = 4, timeout_s: float = 30.0,
                  backoff_s: float = 0.5, op_polls: int = 3,
-                 op_poll_s: float = 2.0):
+                 op_poll_s: float = 2.0,
+                 rng: Optional[random.Random] = None):
         self.project = project
         self.zone = zone
         self.token_provider = token_provider
@@ -143,6 +145,8 @@ class RestGceTpuApi(GceTpuApi):
         self.backoff_s = backoff_s
         self.op_polls = op_polls
         self.op_poll_s = op_poll_s
+        # injectable for deterministic jitter tests
+        self._rng = rng if rng is not None else random.Random()
         self._token: Optional[str] = None
 
     # -- plumbing ----------------------------------------------------------
@@ -204,7 +208,11 @@ class RestGceTpuApi(GceTpuApi):
                     # reconciler's type cooldown takes it from here
                     raise err
                 if attempt < self.max_retries:
-                    time.sleep(delay)
+                    # full jitter over the exponential window (the
+                    # retry/backoff+jitter convention from train/storage.py):
+                    # many reconcilers retrying the same quota/5xx must not
+                    # hammer the API in lockstep at deterministic delays
+                    time.sleep(self._rng.uniform(0.0, delay))
                     delay = min(delay * 2, 30.0)
                     continue
             break

@@ -132,17 +132,38 @@ class GceTpuNodeProvider(NodeProvider):
     of a pod). The provider itself only manages slice lifecycle."""
 
     def __init__(self, api: GceTpuApi, *, project: str = "proj",
-                 zone: str = "us-central2-b", gcs_address: str = ""):
+                 zone: str = "us-central2-b", gcs_address: str = "",
+                 cluster_name: str = ""):
         self.api = api
         self.project = project
         self.zone = zone
         self.gcs_address = gcs_address
+        # scopes node NAMES (ray--<cluster>--...) and therefore owns_node /
+        # the reconciler's leak sweep: set it whenever more than one
+        # ray_tpu cluster can share a project+zone, or each reconciler
+        # would sweep the other's unrecorded slices
+        if "--" in cluster_name or cluster_name.strip("-") != cluster_name:
+            # the double hyphen DELIMITS the cluster token in node names;
+            # a name containing '--' (or edged with '-', which recreates a
+            # '--' at the delimiter) would make "a" own "a--b"'s or "a-"'s
+            # slices — the prefix-ambiguity the delimiter exists to prevent
+            raise ValueError(
+                f"cluster_name {cluster_name!r} must not contain '--' or "
+                "begin/end with '-'")
+        self.cluster_name = cluster_name
         self._types: Dict[str, str] = {}  # node name → accelerator_type
+
+    @property
+    def _name_prefix(self) -> str:
+        # '--' delimiters make the scope prefix-unambiguous: 'ray--prod--'
+        # can never prefix 'ray--prod-eu--...' (cluster names cannot
+        # contain '--', enforced above)
+        return f"ray--{self.cluster_name}--" if self.cluster_name else "ray-"
 
     def create_node(self, node_type: str, resources: Dict[str, float],
                     labels: Dict[str, str]) -> str:
         acc = labels.get("accelerator_type") or node_type.removeprefix("tpu-")
-        name = f"ray-{node_type}-{uuid.uuid4().hex[:6]}"
+        name = f"{self._name_prefix}{node_type}-{uuid.uuid4().hex[:6]}"
         self.api.create_node(name, acc, labels)
         self._types[name] = acc
         return name
@@ -156,6 +177,29 @@ class GceTpuNodeProvider(NodeProvider):
 
     def is_ready(self, node_id: str) -> bool:
         return self.api.node_state(node_id) == "READY"
+
+    def describe_node(self, node_id: str) -> dict:
+        return {"accelerator_type": self._types.get(node_id, "")}
+
+    def adopt_node(self, node_id: str, data: dict) -> bool:
+        """A restarted reconciler re-attaches to a slice its predecessor
+        created: confirm the node still exists and restore the name →
+        accelerator_type mapping from the persisted instance record."""
+        if self.api.node_state(node_id) == "ABSENT":
+            return False
+        acc = data.get("accelerator_type")
+        if acc:
+            self._types[node_id] = acc
+        return True
+
+    def owns_node(self, node_id: str) -> bool:
+        """Leak-sweep eligibility requires an explicit cluster_name scope:
+        list_nodes sees the whole project+zone, and an UNSCOPED provider
+        cannot distinguish its own `ray-...` slices from another cluster's
+        `ray-<other>-...` — so it never claims any (leaking a slice is
+        recoverable; sweeping a foreign cluster's live slice is not)."""
+        return bool(self.cluster_name) and node_id.startswith(
+            self._name_prefix)
 
     def node_joined(self, node_id: str, gcs_node_ids) -> bool:
         """Slice VMs register host ids prefixed with the slice name (the

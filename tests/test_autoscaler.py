@@ -86,7 +86,9 @@ def test_min_nodes_floor(session):
 def test_idle_scale_down(session):
     provider = FakeProvider()
     nt = NodeType("burst", {"CPU": 4}, min_nodes=0, max_nodes=3)
-    a = _mk(provider, [nt], idle_timeout_s=0.2)
+    # grace 0: fake nodes never join the GCS, and this test wants the idle
+    # clock running from the first pass
+    a = _mk(provider, [nt], idle_timeout_s=0.2, node_startup_grace_s=0.0)
     # manually launch one (as if demand had spiked earlier)
     a._launch(nt)
     assert len(provider.nodes) == 1
@@ -111,6 +113,381 @@ def test_max_nodes_cap(session):
     assert len(provider.nodes) <= 2
     a.stop()
     del refs
+
+
+class OwnedFakeProvider(FakeProvider):
+    """FakeProvider that recognizes its own nodes, enabling the leak sweep."""
+
+    def owns_node(self, node_id):
+        return node_id.startswith("fake-")
+
+
+def test_restart_adopts_persisted_instances(session):
+    """A fresh Autoscaler over the same GCS + provider (the crash-restart
+    path) rebuilds from the persisted instance table: still-alive nodes are
+    adopted, nothing is relaunched for them."""
+    provider = FakeProvider()
+    types = [NodeType("warm", {"CPU": 2}, min_nodes=2, max_nodes=4)]
+    a1 = _mk(provider, types)
+    actions = a1.reconcile_once()
+    assert len(actions["launched"]) == 2
+    a1.stop(terminate_nodes=False)  # "crash": records stay in the GCS table
+
+    a2 = _mk(provider, types)
+    actions = a2.reconcile_once()
+    assert sorted(n for _, n in actions["adopted"]) == sorted(provider.nodes)
+    assert actions["launched"] == [], "adopted nodes must not be relaunched"
+    assert len(provider.nodes) == 2
+    a2.stop(terminate_nodes=False)
+
+
+def test_reap_vanished_and_sweep_leaked(session):
+    """Records whose node vanished are reaped; provider nodes with no
+    record (a leak from a crash mid-launch) are terminated by the sweep."""
+    provider = OwnedFakeProvider()
+    nt = NodeType("burst", {"CPU": 2}, min_nodes=0, max_nodes=4)
+    a = _mk(provider, [nt])
+    n1 = a._launch(nt)
+    n2 = a._launch(nt)
+    provider.nodes.pop(n1)                 # externally died (e.g. preempted)
+    provider.nodes["fake-leak"] = "burst"  # exists, but no record claims it
+    actions = a.reconcile_once()
+    assert ("burst", n1) in actions["reaped"]
+    assert actions["swept"] == ["fake-leak"]
+    assert set(provider.nodes) == {n2}
+    a.stop()
+
+
+def test_idle_not_racing_node_startup(session):
+    """A just-launched node that hasn't joined the GCS yet must not be
+    idle-terminated out from under its own startup: the idle clock only
+    starts once it joins or overstays node_startup_grace_s."""
+    provider = FakeProvider()
+    nt = NodeType("burst", {"CPU": 4}, min_nodes=0, max_nodes=3)
+    a = _mk(provider, [nt], idle_timeout_s=0.05, node_startup_grace_s=60.0)
+    a._launch(nt)
+    a.reconcile_once()
+    time.sleep(0.15)                       # way past idle_timeout_s
+    actions = a.reconcile_once()
+    assert not actions["terminated"], "idle-killed a node still starting up"
+    assert len(provider.nodes) == 1
+    a.stop()
+
+
+class FlakyProvider(FakeProvider):
+    """First create fails with a cooldown-carrying error, then succeeds."""
+
+    def __init__(self, cooldown_s=0.3):
+        super().__init__()
+        self.cooldown_s = cooldown_s
+        self.failures_left = 1
+        self.create_calls = 0
+
+    def create_node(self, node_type, resources, labels):
+        self.create_calls += 1
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            e = RuntimeError("zone stockout (injected)")
+            e.cooldown_s = self.cooldown_s
+            raise e
+        return super().create_node(node_type, resources, labels)
+
+
+def test_launch_failure_cooldown_lifecycle(session):
+    """Cooldown suppresses launches while active, expires on schedule, the
+    relaunch succeeds, and the stale error drops out of the summary."""
+    provider = FlakyProvider(cooldown_s=0.3)
+    a = _mk(provider, [NodeType("warm", {"CPU": 2}, min_nodes=1, max_nodes=2)])
+    actions = a.reconcile_once()
+    assert actions["launched"] == []
+    assert "stockout" in actions["launch_failures"]["warm"]
+    assert provider.create_calls == 1
+
+    actions = a.reconcile_once()           # still cooling: no hot retry
+    assert provider.create_calls == 1
+    assert "warm" in actions["launch_failures"]
+
+    time.sleep(0.35)                       # cooldown expires
+    actions = a.reconcile_once()
+    assert len(actions["launched"]) == 1
+    assert provider.create_calls == 2
+    assert actions["launch_failures"] == {}, "stale error must be dropped"
+    assert len(provider.nodes) == 1
+    a.stop()
+
+
+def test_interrupted_terminate_reissued_on_restart(session):
+    """A crash between the TERMINATING persist and the cloud call must
+    re-issue the (idempotent) terminate after restart, not leak the node."""
+    from ray_tpu.autoscaler import instance_manager as im
+
+    provider = FakeProvider()
+    nt = NodeType("burst", {"CPU": 2}, min_nodes=0, max_nodes=2)
+    a1 = _mk(provider, [nt])
+    nid = a1._launch(nt)
+    a1._im.transition(a1._im.by_node(nid), im.TERMINATING)
+    a1.stop(terminate_nodes=False)  # "crash" right before terminate_node
+
+    a2 = _mk(provider, [nt])
+    actions = a2.reconcile_once()
+    assert ("burst", nid) in actions["terminated"]
+    assert provider.nodes == {}
+    a2.stop()
+
+
+def test_launch_cooldown_survives_restart(session):
+    """ALLOCATION_FAILED records persist, so a restarted reconciler keeps
+    suppressing hot relaunches of a quota/stockout-limited type."""
+    provider = FlakyProvider(cooldown_s=60.0)
+    types = [NodeType("warm", {"CPU": 2}, min_nodes=1, max_nodes=2)]
+    a1 = _mk(provider, types)
+    a1.reconcile_once()             # launch fails; cooldown persisted
+    assert provider.create_calls == 1
+    a1.stop(terminate_nodes=False)
+
+    a2 = _mk(provider, types)
+    actions = a2.reconcile_once()
+    assert actions["launched"] == []
+    assert "warm" in actions["launch_failures"]
+    assert provider.create_calls == 1, "restart must not forget the cooldown"
+    a2.stop(terminate_nodes=False)
+
+
+class AdoptionRequiredProvider(FakeProvider):
+    """Models LocalNodeProvider's restart blindness: a fresh incarnation
+    cannot see nodes launched pre-crash until adopt_node re-attaches."""
+
+    def __init__(self, cloud):
+        super().__init__()
+        self.cloud = cloud              # shared across "incarnations"
+        self.attached = set()
+
+    def create_node(self, node_type, resources, labels):
+        nid = super().create_node(node_type, resources, labels)
+        self.cloud[nid] = node_type
+        self.attached.add(nid)
+        return nid
+
+    def terminate_node(self, node_id):
+        super().terminate_node(node_id)
+        self.cloud.pop(node_id, None)
+        self.attached.discard(node_id)
+
+    def non_terminated_nodes(self):
+        return [n for n in self.cloud if n in self.attached]
+
+    def adopt_node(self, node_id, data):
+        if node_id in self.cloud:
+            self.attached.add(node_id)
+            return True
+        return False
+
+
+def test_interrupted_terminate_readopted_then_reissued(session):
+    """When the provider needs adoption to even SEE pre-crash nodes (like
+    LocalNodeProvider), a TERMINATING record must still be re-attached on
+    recovery — otherwise the sync step mistakes the invisible node for a
+    vanished one, deletes the record, and orphans the node forever."""
+    cloud = {}
+    p1 = AdoptionRequiredProvider(cloud)
+    nt = NodeType("burst", {"CPU": 2}, min_nodes=0, max_nodes=2)
+    a1 = _mk(p1, [nt])
+    nid = a1._launch(nt)
+    from ray_tpu.autoscaler import instance_manager as im
+
+    a1._im.transition(a1._im.by_node(nid), im.TERMINATING)
+    a1.stop(terminate_nodes=False)  # crash before the cloud call
+
+    a2 = _mk(AdoptionRequiredProvider(cloud), [nt])  # fresh incarnation
+    actions = a2.reconcile_once()
+    assert ("burst", nid) in actions["terminated"]
+    assert cloud == {}, "orphaned the node instead of re-terminating"
+    assert a2._im.instances() == []
+    a2.stop()
+
+
+def test_failing_terminate_blocks_overlaunch(session):
+    """A node stuck TERMINATING (cloud terminate failing every pass) still
+    occupies its max_nodes slot — the reconciler must not launch past the
+    cap while provider reality still holds the node."""
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(30)
+
+    refs = [hog.remote() for _ in range(4)]
+    time.sleep(0.5)
+
+    class OutageProvider(FakeProvider):
+        def terminate_node(self, node_id):
+            raise RuntimeError("cloud API outage")
+
+    provider = OutageProvider()
+    nt = NodeType("cpu4", {"CPU": 4}, max_nodes=1)
+    a = _mk(provider, [nt])
+    nid = a._launch(nt)
+    from ray_tpu.autoscaler import instance_manager as im
+
+    a._im.transition(a._im.by_node(nid), im.TERMINATING)
+    actions = a.reconcile_once()
+    assert actions["launched"] == [], actions
+    assert list(provider.nodes) == [nid]
+    inst, = a._im.instances()
+    assert inst.state == im.TERMINATING  # still retrying next pass
+    a.stop(terminate_nodes=False)
+    del refs
+
+
+def test_reissued_terminate_not_double_swept(session):
+    """A terminate re-issued from the TERMINATING sync must drop the node
+    from the pass's live view — the leak sweep in the same pass must not
+    terminate it a second time or report it as swept."""
+    from ray_tpu.autoscaler import instance_manager as im
+
+    class CountingProvider(OwnedFakeProvider):
+        def __init__(self):
+            super().__init__()
+            self.terminate_calls = []
+
+        def terminate_node(self, node_id):
+            self.terminate_calls.append(node_id)
+            super().terminate_node(node_id)
+
+    provider = CountingProvider()
+    nt = NodeType("burst", {"CPU": 2}, min_nodes=0, max_nodes=2)
+    a = _mk(provider, [nt])
+    nid = a._launch(nt)
+    a._im.transition(a._im.by_node(nid), im.TERMINATING)  # crash pre-cloud
+    actions = a.reconcile_once()
+    assert ("burst", nid) in actions["terminated"]
+    assert actions["swept"] == [], actions
+    assert provider.terminate_calls == [nid], "terminated twice"
+    a.stop()
+
+
+def test_stop_before_first_reconcile_terminates_persisted_nodes(session):
+    """stop(terminate_nodes=True) before any reconcile pass must still
+    tear down a previous incarnation's persisted nodes, not just the empty
+    in-memory view."""
+    provider = FakeProvider()
+    types = [NodeType("warm", {"CPU": 2}, min_nodes=1, max_nodes=2)]
+    a1 = _mk(provider, types)
+    a1.reconcile_once()
+    assert len(provider.nodes) == 1
+    a1.stop(terminate_nodes=False)     # records persist
+
+    a2 = _mk(provider, types)          # SIGTERMed before its first pass
+    a2.stop(terminate_nodes=True)
+    assert provider.nodes == {}, "early stop leaked the predecessor's node"
+
+    a3 = _mk(provider, types)          # table must be clean too
+    actions = a3.reconcile_once()
+    assert actions["adopted"] == []
+    assert len(actions["launched"]) == 1  # floor relaunches fresh
+    a3.stop()
+
+
+def test_stop_terminates_nodes_even_with_dead_gcs(session):
+    """The monitor stops BECAUSE the head died (ConnectionClosed exit):
+    teardown must still release provider nodes even though no transition
+    can be persisted anymore."""
+    provider = FakeProvider()
+    nt = NodeType("burst", {"CPU": 2}, min_nodes=0, max_nodes=2)
+    a = _mk(provider, [nt])
+    a._launch(nt)
+    a._conn.close()  # the GCS is gone
+    a.stop(terminate_nodes=True)
+    assert provider.nodes == {}, "dead GCS must not leak provider nodes"
+
+
+def test_local_provider_orphans_visible_through_pid_registry(tmp_path):
+    """An agent spawned by a provider incarnation that crashed before any
+    record carried its pid must still be visible to a FRESH incarnation
+    (on-disk pid registry) so the reconciler's leak sweep can kill it."""
+    from ray_tpu.autoscaler.node_provider import _pid_alive
+
+    addr = "unix:/tmp/ray-tpu-no-such-gcs-orphan.sock"
+    reg = str(tmp_path / "registry.json")
+    p1 = LocalNodeProvider(addr, registry_path=reg)
+    nid = p1.create_node("w", {"CPU": 1.0}, {})
+    pid = p1._procs[nid].pid
+
+    p2 = LocalNodeProvider(addr, registry_path=reg)  # fresh incarnation
+    assert nid in p2.non_terminated_nodes(), "orphan invisible to sweep"
+    assert p2.owns_node(nid)
+    p2.terminate_node(nid)                           # the sweep's call
+    deadline = time.time() + 10
+    while time.time() < deadline and _pid_alive(pid):
+        time.sleep(0.05)
+    assert not _pid_alive(pid), "orphan agent survived the sweep"
+    assert p2.non_terminated_nodes() == []
+    p1.non_terminated_nodes()  # reap the zombie in THIS (parent) process
+
+
+def test_local_provider_recovers_pid_from_provisional_entry(tmp_path):
+    """A crash BETWEEN Popen and the registry pid write leaves a
+    provisional (pid-less) entry; a fresh incarnation recovers the pid by
+    the agent's unique --host-id in /proc cmdlines, making even that
+    narrowest orphan window sweepable."""
+    import json as _json
+    import subprocess
+    import sys
+
+    addr = "unix:/tmp/ray-tpu-no-such-gcs-prov.sock"
+    reg_path = tmp_path / "registry.json"
+    nid = "as-w-provisional1"
+    reg_path.write_text(_json.dumps(
+        {nid: {"pid": None, "created_at": time.time()}}))
+    # stand-in for the orphan agent: carries the node_agent module token
+    # and host id in its argv (the real agent exits fast on a bad address)
+    orphan = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)",
+         "ray_tpu._private.node_agent", "--host-id", nid],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        p2 = LocalNodeProvider(addr, registry_path=str(reg_path))
+        assert nid in p2.non_terminated_nodes(), "orphan pid not recovered"
+        # the recovered entry is now a full (pid, start-time) identity
+        ent = p2._registry()[nid]
+        assert ent["pid"] == orphan.pid and ent["pid_start"] is not None
+        p2.terminate_node(nid)
+        orphan.wait(timeout=10)  # our child here: reap it
+        assert p2.non_terminated_nodes() == []
+    finally:
+        if orphan.poll() is None:
+            orphan.kill()
+            orphan.wait(timeout=10)
+
+
+def test_local_provider_adopt_rejects_recycled_pid():
+    """(pid, start_time) identifies the process: a pid recycled to an
+    unrelated process while the reconciler was down must NOT be adopted
+    (it would be SIGTERMed on scale-down)."""
+    import os
+
+    from ray_tpu.autoscaler.node_provider import _pid_start_time
+
+    provider = LocalNodeProvider("unix:/tmp/ray-tpu-no-such-gcs.sock")
+    me, start = os.getpid(), _pid_start_time(os.getpid())
+    assert start is not None
+    assert not provider.adopt_node("as-w-x", {"pid": me,
+                                              "pid_start": start - 1})
+    assert provider.adopt_node("as-w-y", {"pid": me, "pid_start": start})
+    provider._adopted.clear()  # never terminate_node our own test process
+
+
+def test_local_provider_reaps_exited_procs():
+    """Exited node-agent subprocesses must be collected and dropped on
+    listing — not accumulated as zombie processes / dead Popen entries."""
+    provider = LocalNodeProvider("unix:/tmp/ray-tpu-no-such-gcs.sock")
+    nid = provider.create_node("w", {"CPU": 1.0}, {})
+    p = provider._procs[nid]
+    p.kill()
+    deadline = time.time() + 10
+    while provider.non_terminated_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    assert provider.non_terminated_nodes() == []
+    assert provider._procs == {}, "dead proc entry never reaped"
+    assert p.returncode is not None, "child never wait()ed (zombie)"
 
 
 def test_local_provider_joins_real_cluster(session):

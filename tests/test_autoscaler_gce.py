@@ -52,13 +52,39 @@ def test_fake_api_provisioning_states():
     assert [c[0] for c in api.calls] == ["create", "delete"]
 
 
+def test_owns_node_scoped_by_cluster_name():
+    """Two clusters sharing a project/zone must not sweep each other's
+    slices: cluster_name scopes both the created names and owns_node."""
+    api = FakeGceTpuApi()
+    prov = GceTpuNodeProvider(api, cluster_name="blue")
+    nid = prov.create_node("tpu-v4-8", {}, {"accelerator_type": "v4-8"})
+    assert nid.startswith("ray--blue--tpu-v4-8-")
+    assert prov.owns_node(nid)
+    assert not prov.owns_node("ray--green--tpu-v4-8-abc123")  # other cluster
+    assert not prov.owns_node("my-manual-tpu")                 # operator's
+    # hyphenated names must not prefix-collide: "blue" vs "blue-eu"
+    assert not prov.owns_node("ray--blue-eu--tpu-v4-8-abc123")
+    with pytest.raises(ValueError, match="--"):
+        GceTpuNodeProvider(FakeGceTpuApi(), cluster_name="bad--name")
+    with pytest.raises(ValueError, match="--"):
+        GceTpuNodeProvider(FakeGceTpuApi(), cluster_name="trailing-")
+    # an UNSCOPED provider can't tell its own ray-* slices from another
+    # cluster's ray-<name>-* — it must never claim sweep rights at all
+    default = GceTpuNodeProvider(FakeGceTpuApi())
+    assert not default.owns_node("ray-tpu-v4-8-abc123")
+    assert not default.owns_node("my-manual-tpu")
+
+
 def test_pg_demand_scales_slice_up_and_down(session):
     """A pending multi-host TPU placement group launches exactly ONE whole
     v5e-16 slice (atomic); draining the demand terminates it."""
     api = FakeGceTpuApi()
     provider = GceTpuNodeProvider(api, gcs_address="unused")
+    # grace 0: the fake slice never joins the GCS, and this test wants the
+    # idle clock running from the first post-drain pass
     a = _mk(provider, [tpu_slice_node_type("v5litepod-16", cpus_per_host=8,
-                                           max_nodes=2)])
+                                           max_nodes=2)],
+            node_startup_grace_s=0.0)
 
     # 4 hosts x 4 chips + the slice-head sentinel: one slice's worth
     pg = placement_group(

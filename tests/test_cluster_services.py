@@ -114,6 +114,52 @@ def test_agent_subprocess_lifecycle(tmp_path):
     assert h.proc is None
 
 
+def test_monitor_config_loading_json(tmp_path):
+    from ray_tpu._private.monitor import build_node_types, load_config
+
+    cfg = {"provider": {"type": "local"},
+           "node_types": {"w": {"resources": {"CPU": 2}, "min_nodes": 1,
+                                "max_nodes": 3, "labels": {"pool": "warm"}}},
+           "interval_s": 0.5}
+    p = tmp_path / "scaling.json"
+    p.write_text(json.dumps(cfg))
+    assert load_config(str(p)) == cfg
+    nts = build_node_types(cfg)
+    assert len(nts) == 1 and nts[0].name == "w"
+    assert (nts[0].min_nodes, nts[0].max_nodes) == (1, 3)
+    assert nts[0].resources == {"CPU": 2}
+    assert nts[0].labels == {"pool": "warm"}
+    with pytest.raises(ValueError, match="no node_types"):
+        build_node_types({"provider": {"type": "local"}})
+
+
+def test_monitor_config_loading_yaml(tmp_path):
+    yaml = pytest.importorskip("yaml")
+    from ray_tpu._private.monitor import build_node_types, load_config
+
+    p = tmp_path / "scaling.yaml"
+    p.write_text(yaml.safe_dump(
+        {"provider": {"type": "local"},
+         "node_types": {"w": {"resources": {"CPU": 2}, "max_nodes": 5}}}))
+    cfg = load_config(str(p))
+    assert cfg["provider"] == {"type": "local"}
+    nts = build_node_types(cfg)
+    assert nts[0].max_nodes == 5 and nts[0].min_nodes == 0
+
+
+def test_monitor_builds_fake_file_provider(tmp_path):
+    from ray_tpu._private.monitor import build_provider
+    from ray_tpu.autoscaler import FakeFileNodeProvider
+
+    p = build_provider(
+        {"provider": {"type": "fake_file",
+                      "path": str(tmp_path / "cloud.json"),
+                      "die_after_create": 2}}, "unix:/unused")
+    assert isinstance(p, FakeFileNodeProvider)
+    assert p.die_after_create == 2
+    assert p.non_terminated_nodes() == []
+
+
 @pytest.mark.slow
 def test_monitor_process_scales_cluster(tmp_path):
     """The standalone monitor process (fake provider) observes queued

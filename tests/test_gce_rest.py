@@ -73,6 +73,29 @@ def test_retry_on_transient_then_success():
     assert len(t.requests) == 3  # 503, transport error, then success
 
 
+def test_retry_backoff_uses_full_jitter(monkeypatch):
+    """Retry sleeps are drawn uniformly from [0, delay) over the doubling
+    exponential window (storage.py's backoff+jitter convention) — not the
+    deterministic delay*2 ladder that retries fleets in lockstep."""
+    import random as _random
+
+    from ray_tpu.autoscaler import gce_rest
+
+    sleeps = []
+    monkeypatch.setattr(gce_rest.time, "sleep", sleeps.append)
+    api, t = _api([_err(503, "unavailable")] * 4, max_retries=3,
+                  backoff_s=8.0, rng=_random.Random(7))
+    with pytest.raises(TpuApiError):
+        api.node_state("n")
+    assert len(sleeps) == 3
+    expect = _random.Random(7)
+    for got, delay in zip(sleeps, [8.0, 16.0, 30.0]):
+        want = expect.uniform(0.0, delay)
+        assert got == want
+        assert 0.0 <= got < delay
+    assert sleeps != [8.0, 16.0, 30.0]  # the ladder itself is never used
+
+
 def test_retries_exhausted_raises_classified():
     api, t = _api([_err(503, "unavailable")] * 3, max_retries=2)
     with pytest.raises(TpuApiError) as ei:
@@ -211,30 +234,32 @@ def _autoscaler(api, demands):
     a.node_startup_grace_s = 60.0
     a._conn = _StubGcs(demands)
     import itertools
+    import threading
     a._rid = itertools.count(1)
-    a._nodes = {}
-    a._launch_times = {}
-    a._idle_since = {}
-    a._type_cooldown = {}
-    a._launch_errors = {}
+    a._rpc_lock = threading.Lock()
+    a._stop = threading.Event()
+    from ray_tpu.autoscaler import instance_manager as im
+
+    a._im = im.InstanceManager(im.MemoryInstanceStorage())
+    a._recovered = True
     return a
 
 
 def test_reconciler_launches_through_rest_client():
-    api, t = _api([_ok(),                  # create (op with no name: accepted)
-                   _ok({"nodes": []})])    # reap-pass list
+    api, t = _api([_ok({"nodes": []}),     # ground-truth sync list
+                   _ok()])                 # create (op with no name: accepted)
     a = _autoscaler(api, demands=[{"TPU": 4.0}])
     actions = a.reconcile_once()
     assert len(actions["launched"]) == 1
-    assert t.requests[0][0] == "POST"
+    assert t.requests[1][0] == "POST"
     assert not actions["launch_failures"]
 
 
 def test_reconciler_stockout_cooldown_then_recovery():
     stockout = _err(429, "no available capacity", rpc="RESOURCE_EXHAUSTED")
-    api, t = _api([stockout,              # create attempt 1 (hard no, no retry)
-                   _ok({"nodes": []}),    # list (reap pass 1)
-                   _ok({"nodes": []}),    # list (reap pass 2, still cooling)
+    api, t = _api([_ok({"nodes": []}),    # list (sync pass 1)
+                   stockout,              # create attempt 1 (hard no, no retry)
+                   _ok({"nodes": []}),    # list (sync pass 2, still cooling)
                    ])
     a = _autoscaler(api, demands=[{"TPU": 4.0}])
     actions = a.reconcile_once()
@@ -246,10 +271,14 @@ def test_reconciler_stockout_cooldown_then_recovery():
     actions2 = a.reconcile_once()
     assert actions2["launched"] == []
     assert all(m != "POST" for m, *_ in t.requests[n_before:])
-    # cooldown expires → next pass launches again
-    a._type_cooldown["tpu-v4-8"] = 0.0
-    t.responses.extend([_ok(), _ok({"nodes": [
-        {"name": "p/l/n/ray-z", "state": "READY"}]})])
+    # cooldown expires (the persisted ALLOCATION_FAILED record ages out)
+    # → next pass drops it and launches again
+    from ray_tpu.autoscaler import instance_manager as im
+
+    for f in a._im.instances(im.ALLOCATION_FAILED):
+        f.cooldown_until = 0.0
+        a._im.storage.put(f.to_dict())
+    t.responses.extend([_ok({"nodes": []}), _ok()])
     actions3 = a.reconcile_once()
     assert len(actions3["launched"]) == 1
     assert not actions3["launch_failures"]
@@ -257,26 +286,29 @@ def test_reconciler_stockout_cooldown_then_recovery():
 
 def test_reconciler_quota_uses_longer_cooldown():
     quota = _err(403, "Quota 'TPUS' exceeded")
-    api, _ = _api([quota] + [_ok({"nodes": []})])
+    api, _ = _api([_ok({"nodes": []}), quota])
     a = _autoscaler(api, demands=[{"TPU": 4.0}])
     a.reconcile_once()
     import time
-    remaining = a._type_cooldown["tpu-v4-8"] - time.monotonic()
-    assert remaining > 60  # QuotaExceededError.cooldown_s = 120
+
+    from ray_tpu.autoscaler import instance_manager as im
+
+    f, = a._im.instances(im.ALLOCATION_FAILED)
+    assert f.cooldown_until - time.time() > 60  # Quota cooldown_s = 120
 
 
 def test_preempted_slice_reaped_and_relaunched():
     api, t = _api([
+        _ok({"nodes": []}),         # pass 1: sync list
         _ok(),                      # pass 1: create
-        _ok({"nodes": []}),         # pass 1: list — slice already preempted
+        _ok({"nodes": []}),         # pass 2: list — slice already preempted
         _ok(),                      # pass 2: create replacement
-        _ok({"nodes": []}),         # pass 2: list
     ])
     a = _autoscaler(api, demands=[{"TPU": 4.0}])
     a1 = a.reconcile_once()
     assert len(a1["launched"]) == 1
-    assert a._nodes == {}  # reaped: preempted slices vanish from list
     a2 = a.reconcile_once()
+    assert len(a2["reaped"]) == 1    # preempted slice vanished from the list
     assert len(a2["launched"]) == 1  # demand still unmet → relaunched
 
 

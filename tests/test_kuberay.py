@@ -191,11 +191,14 @@ def test_reconciler_scales_through_kuberay():
     a.node_startup_grace_s = 60.0
     a._conn = _StubGcs()
     a._rid = itertools.count(1)
-    a._nodes = {}
-    a._launch_times = {}
-    a._idle_since = {}
-    a._type_cooldown = {}
-    a._launch_errors = {}
+    import threading
+
+    a._rpc_lock = threading.Lock()
+    a._stop = threading.Event()
+    from ray_tpu.autoscaler import instance_manager as im
+
+    a._im = im.InstanceManager(im.MemoryInstanceStorage())
+    a._recovered = True
 
     actions = a.reconcile_once()
     assert len(actions["launched"]) == 1
