@@ -157,6 +157,14 @@ def remote(*args, **kwargs):
 
 
 def get(refs, *, timeout: float | None = None):
+    if getattr(refs, "__dag_future__", False):
+        # compiled-DAG futures (channel plane returns no ObjectRefs at all)
+        return refs.result(timeout=timeout)
+    if (isinstance(refs, (list, tuple))
+            and any(getattr(r, "__dag_future__", False) for r in refs)):
+        # lists may mix DAG futures and ObjectRefs; the timeout applies
+        # per element (futures resolve in submission order anyway)
+        return [get(r, timeout=timeout) for r in refs]
     return _get_worker().get(refs, timeout=timeout)
 
 
@@ -165,6 +173,27 @@ def put(value: Any) -> ObjectRef:
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: float | None = None):
+    if any(getattr(r, "__dag_future__", False) for r in refs):
+        # channel-plane DAG futures have no ObjectRefs; poll their done()
+        # (non-blocking) alongside ordinary refs with wait(timeout=0)
+        import time as _time
+
+        worker = _get_worker()
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        want = min(num_returns, len(refs))
+        while True:
+            ready = [r for r in refs
+                     if (r.done() if getattr(r, "__dag_future__", False)
+                         else bool(worker.wait([r], num_returns=1,
+                                               timeout=0)[0]))]
+            if len(ready) >= want or (
+                    deadline is not None
+                    and _time.monotonic() >= deadline):
+                ready = ready[:num_returns]
+                ready_ids = {id(r) for r in ready}
+                return ready, [r for r in refs if id(r) not in ready_ids]
+            _time.sleep(0.005)
     return _get_worker().wait(refs, num_returns=num_returns, timeout=timeout)
 
 

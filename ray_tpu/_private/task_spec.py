@@ -18,6 +18,12 @@ from typing import Any
 VALID_STRATEGY_KINDS = ("pg", "node_affinity", "node_label")
 _MAX_NAME = 512
 
+# actor-task method name the worker routes to the compiled-DAG channel
+# exec loop (ray_tpu/dag/channel_execution.py) on a dedicated thread —
+# defined here so the spec producer and the worker dispatcher share one
+# source of truth
+EXEC_LOOP_METHOD = "__ray_tpu_channel_exec_loop__"
+
 
 class SpecError(ValueError):
     """A malformed submission, reported at the caller."""

@@ -22,6 +22,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import make_object_store
 from ray_tpu._private.protocol import ConnectionClosed, connect_address
+from ray_tpu._private.task_spec import EXEC_LOOP_METHOD
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -1674,20 +1675,33 @@ class CoreWorker:
                 out = None
             elif kind == "actor_task":
                 instance = self.actors[spec["actor_id"]]
-                method = getattr(instance, spec["method"])
-                import inspect as _inspect
+                if spec["method"] == EXEC_LOOP_METHOD:
+                    # compiled-DAG channel plane: the provisioned per-actor
+                    # loop runs as a (long-lived) actor task so teardown
+                    # joins it through the normal result path (reference:
+                    # compiled_dag_node.py do_exec_tasks). The executor is
+                    # passed so async ops run on the actor's own event loop.
+                    from ray_tpu.dag.channel_execution import actor_exec_loop
 
-                if _inspect.iscoroutinefunction(
-                        getattr(method, "__func__", method)):
-                    # async method reached execute_task directly (pool
-                    # routing already ran it on the loop when enabled)
-                    execer = self._actor_pools.get(spec["actor_id"])
-                    out = execer.run_coroutine_sync(method(*args, **kwargs))
+                    out = actor_exec_loop(
+                        instance, *args,
+                        _execer=self._actor_pools.get(spec["actor_id"]),
+                        **kwargs)
                 else:
-                    out = method(*args, **kwargs)
-                if getattr(getattr(method, "__func__", method),
-                           "__ray_tpu_tensor_transport__", None):
-                    _extract_dev = True
+                    method = getattr(instance, spec["method"])
+                    import inspect as _inspect
+
+                    if _inspect.iscoroutinefunction(
+                            getattr(method, "__func__", method)):
+                        # async method reached execute_task directly (pool
+                        # routing already ran it on the loop when enabled)
+                        execer = self._actor_pools.get(spec["actor_id"])
+                        out = execer.run_coroutine_sync(method(*args, **kwargs))
+                    else:
+                        out = method(*args, **kwargs)
+                    if getattr(getattr(method, "__func__", method),
+                               "__ray_tpu_tensor_transport__", None):
+                        _extract_dev = True
             else:
                 raise RayTpuError(f"unknown task kind {kind}")
             n = spec["num_returns"]
@@ -1829,6 +1843,18 @@ class CoreWorker:
             spec = self.exec_queue.get()
             if spec is None:
                 return
+            if (spec["kind"] == "actor_task"
+                    and spec.get("method") == EXEC_LOOP_METHOD):
+                # compiled-DAG exec loop: blocks until teardown, so it gets
+                # a dedicated thread — other actors hosted by this process
+                # must stay schedulable behind it. Actor serialization is
+                # NOT weakened: the GCS dispatches ≤ max_concurrency tasks
+                # per actor, and the loop occupies a slot for its lifetime,
+                # so a plain actor's normal calls queue until teardown
+                # rather than racing the loop.
+                threading.Thread(target=self.execute_task, args=(spec,),
+                                 daemon=True, name="dag-channel-loop").start()
+                continue
             execer = (self._actor_pools.get(spec.get("actor_id"))
                       if spec["kind"] == "actor_task" else None)
             if execer is not None:

@@ -1,0 +1,689 @@
+"""Channel-backed compiled-DAG execution plane.
+
+Steady-state compiled execution with ZERO control-plane hops per step:
+`experimental_compile()` partitions the static schedule into per-actor op
+lists, provisions one long-lived execution-loop task per participating
+actor (submitted ONCE over the ordered actor plane — the same exec-loop
+idiom as `_private/direct.py`), and allocates a seqlock `MutableShmChannel`
+per cross-actor edge plus driver input/output channels. After compile,
+`execute()` is one shared-memory write and `result()` one shared-memory
+read; intermediates flow actor→actor through channels and never touch the
+driver, the GCS, or the object store.
+
+Lifecycle contract:
+- backpressure — depth-1 mutable channels ack per hop; the driver bounds
+  un-drained executions at `max_inflight_executions` by draining the
+  oldest result set before admitting a new step;
+- errors — a step error is serialized into the faulting op's downstream
+  channels as a `_PipelineError` envelope, skips execution of every
+  dependent op, and re-raises at the driver with the faulting node named;
+- teardown — closing every channel (a shared-memory flag) unblocks all
+  loops wherever they are; the driver then joins the loop tasks and
+  unlinks every `/dev/shm` file it created;
+- fallback — graphs the SPSC channel plane can't serve (task nodes,
+  multi-return methods, cross-host actors, local mode) keep the existing
+  per-step submit path; `CompiledDAG` records the reason.
+
+(reference: python/ray/dag/compiled_dag_node.py — do_exec_tasks per-actor
+loops, ExecutableTask channel wiring, CompiledDAGRef results; Ray paper
+arXiv:1712.05889 §4 motivates keeping the control plane off the ms-scale
+hot path.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any
+
+from ray_tpu.dag.dag_node import AwaitableDAGFuture
+from ray_tpu.exceptions import (GetTimeoutError, RayChannelError,
+                                RayTaskError)
+from ray_tpu.experimental.channel.channel import ChannelClosed
+from ray_tpu.experimental.channel.mutable_shm import (MutableShmChannel,
+                                                      create_mutable_channel)
+
+logger = logging.getLogger(__name__)
+
+# actor-task method name the worker routes to actor_exec_loop() on a
+# dedicated thread (never the shared exec thread — a blocked loop must not
+# starve other actors hosted by the same worker process)
+from ray_tpu._private.task_spec import EXEC_LOOP_METHOD  # noqa: E402
+
+# loops re-check liveness at this cadence while blocked on a channel: if the
+# backing file vanished (driver died without teardown), they exit instead of
+# polling shared memory forever
+_LOOP_BLOCK_SLICE_S = 30.0
+# driver-side read/write slice between loop-death / drain checks
+_DRIVER_BLOCK_SLICE_S = 0.05
+
+
+# actors currently occupied by a live compiled DAG's exec loop (this
+# process's driver). A second compile over the same actor would queue its
+# loop task behind the first forever (the GCS caps per-actor dispatch at
+# max_concurrency) and hang silently — reject it at compile time instead
+# (reference: Ray raises "actor is already in a compiled DAG").
+_occupied_actors: set[str] = set()
+_occupied_lock = threading.Lock()
+
+
+def _claim_actors(aids: list) -> None:
+    with _occupied_lock:
+        busy = [a for a in aids if a in _occupied_actors]
+        if busy:
+            raise ValueError(
+                f"actor {busy[0][:8]} already participates in a live "
+                f"compiled DAG; teardown() that DAG first")
+        _occupied_actors.update(aids)
+
+
+def _release_actors(aids: list) -> None:
+    with _occupied_lock:
+        _occupied_actors.difference_update(aids)
+
+
+class _PipelineError:
+    """Error envelope flowing through channels in place of a value.
+
+    Small and always serializable: downstream ops skip execution and
+    forward it; the driver re-raises `.error` (a RayTaskError naming the
+    faulting node) from `result()`."""
+
+    def __init__(self, node_label: str, error: RayTaskError):
+        self.node_label = node_label
+        self.error = error
+
+    def __repr__(self):
+        return f"_PipelineError({self.node_label})"
+
+
+def _task_error(label: str, exc: Exception, tb: str = "") -> _PipelineError:
+    if not tb and exc is not None:
+        tb = f"{type(exc).__name__}: {exc}"
+    err = RayTaskError(label, tb, exc)
+    try:
+        from ray_tpu._private import serialization as ser
+
+        ser.dumps(err)
+    except Exception:
+        # unpicklable cause: keep the traceback, drop the cause (mirrors
+        # the worker's execute_spec fallback)
+        err = RayTaskError(label, tb or repr(exc), None)
+    return _PipelineError(label, err)
+
+
+# --------------------------------------------------------------------------
+# worker side: the per-actor execution loop
+# --------------------------------------------------------------------------
+
+
+def _loop_read(ch: MutableShmChannel):
+    """Blocking read that survives long stalls but notices a vanished
+    driver: the backing /dev/shm file disappearing means nobody will ever
+    close the channel properly."""
+    while True:
+        try:
+            return ch.read(timeout=_LOOP_BLOCK_SLICE_S)
+        except TimeoutError:
+            if not os.path.exists(ch.path):
+                raise ChannelClosed("channel file unlinked (peer gone)")
+
+
+def _loop_write(ch: MutableShmChannel, payload: bytes):
+    while True:
+        try:
+            return ch.write_serialized(payload, timeout=_LOOP_BLOCK_SLICE_S)
+        except TimeoutError:
+            if not os.path.exists(ch.path):
+                raise ChannelClosed("channel file unlinked (peer gone)")
+
+
+def _emit(outs: list, result, label: str):
+    """Serialize once, write to every out-edge. Oversized payloads become a
+    clear in-band error (the channel stays usable for the next step)."""
+    from ray_tpu._private import serialization as ser
+
+    try:
+        blob = ser.dumps(result)
+    except Exception:
+        result = _task_error(label, None, traceback.format_exc())
+        blob = ser.dumps(result)
+    cap = min(ch.capacity for ch in outs)
+    if len(blob) > cap:
+        result = _task_error(label, ValueError(
+            f"DAG intermediate from {label} is {len(blob)}B, exceeding the "
+            f"channel capacity {cap}B (raise channel_buffer_bytes at "
+            f"experimental_compile)"))
+        blob = ser.dumps(result)
+    for ch in outs:
+        _loop_write(ch, blob)
+
+
+def _run_op(instance, op, args, kwargs, execer):
+    """One method invocation; `async def` methods resolve on the actor's
+    own event loop (ActorExecutor) so they share its loop-bound state, or
+    on a private loop when the actor has none."""
+    import inspect
+
+    result = getattr(instance, op["method"])(*args, **kwargs)
+    if inspect.iscoroutine(result):
+        if execer is not None and getattr(execer, "_loop", None) is not None:
+            return execer.run_coroutine_sync(result)
+        import asyncio
+
+        return asyncio.run(result)
+    return result
+
+
+def actor_exec_loop(instance, plan: dict, _execer=None) -> dict:
+    """Run inside the actor process until the driver tears the DAG down.
+
+    `plan` (built by try_build, shipped once at compile time):
+      ops:   [{method, args, kwargs, out, label}] in schedule order; arg
+             encodings are ("const", v) | ("reg", i) | ("chan", ch) |
+             ("input",)
+      input: driver input channel (also the pacing tick for actors whose
+             ops have no channel in-edges), or None
+    """
+    ops = plan["ops"]
+    input_ch = plan.get("input")
+    steps = 0
+    try:
+        while True:
+            inp = _loop_read(input_ch) if input_ch is not None else None
+            regs: list[Any] = []
+            for op in ops:
+                args = [_decode(e, regs, inp) for e in op["args"]]
+                kwargs = {k: _decode(e, regs, inp)
+                          for k, e in op["kwargs"].items()}
+                poisoned = next(
+                    (v for v in (*args, *kwargs.values())
+                     if isinstance(v, _PipelineError)), None)
+                if poisoned is not None:
+                    result = poisoned  # propagate, don't execute
+                else:
+                    try:
+                        result = _run_op(instance, op, args, kwargs, _execer)
+                    except Exception as e:  # noqa: BLE001 — becomes in-band error
+                        result = _task_error(op["label"], e,
+                                             traceback.format_exc())
+                regs.append(result)
+                if op["out"]:
+                    _emit(op["out"], result, op["label"])
+            steps += 1
+    except ChannelClosed:
+        return {"steps": steps, "status": "closed"}
+
+
+def _decode(enc, regs, inp):
+    kind = enc[0]
+    if kind == "const":
+        return enc[1]
+    if kind == "reg":
+        return regs[enc[1]]
+    if kind == "chan":
+        return _loop_read(enc[1])
+    if kind == "input":
+        return inp
+    raise ValueError(f"unknown arg encoding {kind!r}")
+
+
+# --------------------------------------------------------------------------
+# driver side
+# --------------------------------------------------------------------------
+
+
+class ChannelDAGFuture(AwaitableDAGFuture):
+    """Handle to one in-flight channel-plane execution. `result()` blocks,
+    `done()` polls, `await` works inside a running event loop (via
+    AwaitableDAGFuture). Results are delivered in submission order; each
+    future caches its own row so `result()` is repeatable."""
+
+    def __init__(self, executor: "ChannelExecutor", seq: int):
+        self._ex = executor
+        self._seq = seq
+        self._have = False
+        self._row = None
+        self._fetch_lock = threading.Lock()
+
+    def _fetch(self, timeout=None):
+        # serialized: `await fut` (a default-executor thread) racing a
+        # direct result() must not both _take the row — the loser would
+        # see a spurious "already consumed"
+        with self._fetch_lock:
+            if not self._have:
+                self._row = self._ex._take(self._seq, timeout)
+                self._have = True
+            return self._row
+
+    def result(self, timeout: float | None = None):
+        row = self._fetch(timeout)
+        for v in row:
+            if isinstance(v, _PipelineError):
+                raise v.error
+        return list(row) if self._ex._multi else row[0]
+
+    def done(self) -> bool:
+        return self._have or self._ex._done(self._seq)
+
+
+class ChannelExecutor:
+    """Driver endpoint of the channel plane: owns every channel (creator
+    handles → unlink responsibility), the loop-task refs, and the in-order
+    result drain."""
+
+    def __init__(self, worker, plans: dict, order: list, in_chans: list,
+                 out_chans: list, all_chans: list, *, max_inflight: int,
+                 multi: bool):
+        self._worker = worker
+        self._plans = plans
+        self._order = order  # actor ids, schedule order
+        self._in_chans = in_chans
+        self._out_chans = out_chans
+        self._all_chans = all_chans
+        self._max_inflight = max(1, int(max_inflight))
+        self._multi = multi
+        self._loops: dict[str, Any] = {}  # aid → loop-task ObjectRef
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self._drained = 0  # next seq to drain
+        self._row: list = []  # partial output row for seq self._drained
+        self._results: dict[int, list] = {}
+        # fire-and-forget callers (execute() with the future discarded)
+        # must not grow driver memory without bound: beyond this depth,
+        # drained rows whose future was dropped are evicted oldest-first.
+        # Rows with a live future are always kept — the caller can still
+        # result() them.
+        import weakref
+
+        self._retain = max(2 * self._max_inflight, 32)
+        self._live: "weakref.WeakValueDictionary[int, ChannelDAGFuture]" = (
+            weakref.WeakValueDictionary())
+        self._expired_below = 0  # seqs under this were evicted unconsumed
+        # _torn is set OUTSIDE self._lock (own tiny lock for idempotency):
+        # teardown must be able to abort a result()/execute() that is
+        # blocked on a channel while HOLDING self._lock — those loops poll
+        # _torn between read/write slices
+        self._torn = False
+        self._torn_lock = threading.Lock()
+
+    # ------------------------------------------------------------- provision
+
+    def _provision(self):
+        for aid in self._order:
+            ref = self._worker.submit_actor_task(
+                aid, EXEC_LOOP_METHOD, (self._plans[aid],), {},
+                num_returns=1)[0]
+            self._loops[aid] = ref
+
+    @property
+    def stats(self) -> dict:
+        return {"actors": len(self._order),
+                "channels": len(self._all_chans),
+                "executions_submitted": self._submitted}
+
+    # --------------------------------------------------------------- execute
+
+    def execute(self, input_value) -> ChannelDAGFuture:
+        from ray_tpu._private import serialization as ser
+
+        with self._lock:
+            if self._torn:
+                raise RayChannelError("compiled DAG was torn down")
+            payload = ser.dumps(input_value)
+            cap = min(ch.capacity for ch in self._in_chans)
+            if len(payload) > cap:
+                # checked BEFORE any channel write: a partial input fan-out
+                # would desynchronize the actor loops
+                raise ValueError(
+                    f"DAG input is {len(payload)}B, exceeding the channel "
+                    f"capacity {cap}B (raise channel_buffer_bytes at "
+                    f"experimental_compile)")
+            while self._submitted - self._drained >= self._max_inflight:
+                self._drain_one(deadline=None)
+            for ch in self._in_chans:
+                self._write_input(ch, payload)
+            seq = self._submitted
+            self._submitted += 1
+            fut = ChannelDAGFuture(self, seq)
+            self._live[seq] = fut  # registered under the lock: eviction
+            # scans _live, so the row must never look abandoned here
+        return fut
+
+    def _write_input(self, ch, payload: bytes):
+        # caller holds the lock. A full input channel means the pipeline is
+        # backed up to the driver — drain any completed output rows while
+        # waiting, or the driver (sole output consumer) deadlocks the loop
+        # it is trying to feed
+        while True:
+            try:
+                return ch.write_serialized(payload,
+                                           timeout=_DRIVER_BLOCK_SLICE_S)
+            except TimeoutError:
+                while self._drain_one_nonblocking():
+                    pass
+                self._raise_if_loops_dead()
+            except ChannelClosed as e:
+                raise RayChannelError(
+                    f"DAG input channel closed: {e}") from e
+
+    # ----------------------------------------------------------------- drain
+
+    def _take(self, seq: int, timeout: float | None):
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            while seq >= self._drained:
+                self._drain_one(deadline)
+            row = self._results.pop(seq, None)
+        if row is None:
+            if seq < self._expired_below:
+                raise RayChannelError(
+                    f"result for execution #{seq} expired: it stayed "
+                    f"unconsumed beyond the retention window "
+                    f"({self._retain} rows)")
+            raise RayChannelError(
+                f"result for execution #{seq} was already consumed")
+        return row
+
+    def _done(self, seq: int) -> bool:
+        # true poll: never blocks. The lock-free int read answers already-
+        # drained seqs; the opportunistic drain is skipped when a blocked
+        # result()/execute() holds the lock (it would block us unboundedly)
+        if seq < self._drained:
+            return True
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
+            while self._drain_one_nonblocking():
+                pass
+            return seq < self._drained
+        finally:
+            self._lock.release()
+
+    def _drain_one(self, deadline):
+        """Read one full output row (all output channels, fixed order) into
+        the buffer. Caller holds the lock."""
+        while len(self._row) < len(self._out_chans):
+            ch = self._out_chans[len(self._row)]
+            self._row.append(self._read_out(ch, deadline))
+        self._store_row()
+
+    def _drain_one_nonblocking(self) -> bool:
+        while len(self._row) < len(self._out_chans):
+            ch = self._out_chans[len(self._row)]
+            if not ch.poll():
+                return False
+            self._row.append(self._read_out(ch, None))
+        self._store_row()
+        return True
+
+    def _store_row(self):
+        self._results[self._drained] = self._row
+        self._row = []
+        self._drained += 1
+        if len(self._results) <= self._retain:
+            return
+        for seq in list(self._results):  # insertion order = seq order
+            if len(self._results) <= self._retain:
+                break
+            if seq in self._live:
+                continue  # future still held: the caller can result() it
+            self._results.pop(seq)
+            self._expired_below = max(self._expired_below, seq + 1)
+
+    def _read_out(self, ch, deadline):
+        while True:
+            try:
+                return ch.read(timeout=_DRIVER_BLOCK_SLICE_S)
+            except TimeoutError:
+                if self._torn:
+                    raise RayChannelError("compiled DAG was torn down")
+                self._raise_if_loops_dead()
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        "timed out waiting for compiled-DAG output")
+            except ChannelClosed as e:
+                if self._torn:
+                    raise RayChannelError(
+                        "compiled DAG was torn down") from e
+                self._raise_if_loops_dead()
+                raise RayChannelError(
+                    f"DAG output channel closed: {e}") from e
+
+    def _raise_if_loops_dead(self):
+        """A loop task resolving while executions are pending means its
+        actor died (or the loop crashed) — surface that instead of letting
+        the driver block on a channel nobody will ever write."""
+        import ray_tpu
+
+        for aid, ref in self._loops.items():
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                continue
+            try:
+                out = ray_tpu.get(ref)
+            except Exception as e:
+                raise RayChannelError(
+                    f"compiled-DAG execution loop on actor {aid[:8]} died: "
+                    f"{e}") from e
+            raise RayChannelError(
+                f"compiled-DAG execution loop on actor {aid[:8]} exited "
+                f"prematurely: {out!r}")
+
+    # -------------------------------------------------------------- teardown
+
+    def teardown(self, raise_on_error: bool = False) -> list:
+        """Close every channel (unblocking all loops wherever they are),
+        join the loops, and unlink every /dev/shm file. Idempotent."""
+        import ray_tpu
+
+        with self._torn_lock:  # NOT self._lock: a result()/execute()
+            # blocked on a channel holds that and exits via _torn
+            if self._torn:
+                return []
+            self._torn = True
+        for ch in self._all_chans:
+            ch.close()
+        errors: list[tuple[str, Exception]] = []
+        still_running: set[str] = set()
+        for aid, ref in self._loops.items():
+            try:
+                ray_tpu.get(ref, timeout=30.0)
+            except GetTimeoutError as e:
+                # the loop is wedged in a user op: keep the actor claimed,
+                # or a recompile over it would queue behind the stuck loop
+                # and hang silently — the very failure the occupancy
+                # registry exists to surface
+                still_running.add(aid)
+                errors.append((aid, e))
+            except Exception as e:  # noqa: BLE001 — collected, logged below
+                errors.append((aid, e))
+        _release_actors([a for a in self._order if a not in still_running])
+        for ch in self._all_chans:
+            ch.unlink()
+        if errors:
+            logger.warning(
+                "compiled DAG teardown: %d execution-loop error(s); first "
+                "(actor %s): %r", len(errors), errors[0][0][:8],
+                errors[0][1])
+            if raise_on_error:
+                raise errors[0][1]
+        return errors
+
+    def __del__(self):
+        # executor dropped without teardown: still release the actors and
+        # the /dev/shm bytes. No loop joins here — blocking get()s have no
+        # place in GC; the closed flag alone makes the loops exit.
+        try:
+            with self._torn_lock:
+                if self._torn:
+                    return
+                self._torn = True
+            _release_actors(self._order)
+            for ch in self._all_chans:
+                ch.close()
+                ch.unlink()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# compile-time planner
+# --------------------------------------------------------------------------
+
+
+def try_build(root, schedule, *, max_inflight: int,
+              buffer_bytes: int = 1 << 20):
+    """Partition `schedule` into per-actor exec-loop plans and provision
+    the channel plane. Returns (executor, None) on success or
+    (None, fallback_reason) when the graph/topology can't ride SPSC
+    same-host channels."""
+    from ray_tpu._private.api import _get_worker
+    from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
+                                      MultiOutputNode)
+
+    if os.environ.get("RAY_TPU_DAG_CHANNELS", "1") == "0":
+        return None, "disabled via RAY_TPU_DAG_CHANNELS=0"
+    worker = _get_worker()
+    if getattr(worker, "kind", None) != "driver" or not hasattr(worker, "rpc"):
+        return None, "channel plane requires a cluster-mode driver"
+
+    multi = isinstance(root, MultiOutputNode)
+    outputs = list(root._upstream()) if multi else [root]
+    actor_nodes: list = []
+    n_inputs = 0
+    for node in schedule:
+        if node is root and multi:
+            continue
+        if isinstance(node, InputNode):
+            n_inputs += 1
+            continue
+        if isinstance(node, MultiOutputNode):
+            return None, "interior MultiOutputNode requires the submit path"
+        if not isinstance(node, ClassMethodNode):
+            return None, (f"{type(node).__name__} requires the submit path "
+                          "(only actor-method nodes ride channels)")
+        if node._method._num_returns != 1:
+            return None, "num_returns != 1 requires the submit path"
+        actor_nodes.append(node)
+    if n_inputs > 1:
+        return None, "multiple InputNodes require the submit path"
+    if not actor_nodes:
+        return None, "no actor-method nodes in the graph"
+    for out in outputs:
+        if not isinstance(out, ClassMethodNode):
+            return None, "non-actor output requires the submit path"
+
+    # same-host gate: SPSC mutable-shm channels need every loop AND the
+    # driver on one host; cross-host graphs keep the submit path
+    aids: list[str] = []
+    for node in actor_nodes:
+        aid = node._method._actor_id
+        if aid not in aids:
+            aids.append(aid)
+    try:
+        for aid in aids:
+            worker.wait_actor_ready(aid, timeout=60.0)
+        rows = worker.rpc({"type": "list_workers"}).get("workers", [])
+    except Exception as e:  # noqa: BLE001 — compile must not crash; fallback
+        return None, f"actor placement unavailable ({e!r})"
+    host_of = {r["actor_id"]: r["host"] for r in rows if r.get("actor_id")}
+    for aid in aids:
+        host = host_of.get(aid)
+        if host is None:
+            return None, f"actor {aid[:8]} placement unknown"
+        if host != worker.host_id:
+            return None, (f"actor {aid[:8]} is on host {host} (driver on "
+                          f"{worker.host_id}): cross-host edges need the "
+                          "submit path")
+
+    # a second compiled DAG over a busy actor would hang, not degrade —
+    # raising beats both silent queuing and the submit-path fallback
+    # (whose .remote() calls would queue behind the loop just the same)
+    _claim_actors(aids)
+
+    # ---- partition into per-actor op lists + allocate per-edge channels
+    all_chans: list[MutableShmChannel] = []
+
+    def new_chan():
+        ch = create_mutable_channel(buffer_bytes)
+        all_chans.append(ch)
+        return ch
+
+    try:
+        plans: dict[str, dict] = {
+            aid: {"ops": [], "input": None, "needs_input": False}
+            for aid in aids}
+        node_loc: dict[int, tuple[str, int]] = {}  # id(node) → (aid, reg)
+        for node in actor_nodes:
+            aid = node._method._actor_id
+            plan = plans[aid]
+
+            def enc(a, aid=aid, plan=plan):
+                if isinstance(a, InputNode):
+                    plan["needs_input"] = True
+                    return ("input",)
+                if isinstance(a, DAGNode):
+                    p_aid, p_reg = node_loc[id(a)]
+                    if p_aid == aid:
+                        return ("reg", p_reg)
+                    # one channel PER CONSUMING ARG: depth-1 SPSC buffers
+                    # can't be read twice per step
+                    ch = new_chan()
+                    plans[p_aid]["ops"][p_reg]["out"].append(ch)
+                    return ("chan", ch)
+                return ("const", a)
+
+            op = {"method": node._method._method_name,
+                  "args": [enc(a) for a in node._bound_args],
+                  "kwargs": {k: enc(v)
+                             for k, v in node._bound_kwargs.items()},
+                  "out": [],
+                  "label": (f"{node._method._method_name}"
+                            f"@actor:{aid[:8]}")}
+            plan["ops"].append(op)
+            node_loc[id(node)] = (aid, len(plan["ops"]) - 1)
+
+        # driver input channels: actors that consume the InputNode, plus a
+        # pacing tick for any actor with an un-paced op (no transitive
+        # channel/input dependency) — without it a source op would free-run
+        # ahead of execute() calls, advancing actor state speculatively
+        in_chans: list[MutableShmChannel] = []
+        for aid in aids:
+            plan = plans[aid]
+            paced: list[bool] = []
+            for op in plan["ops"]:
+                encs = list(op["args"]) + list(op["kwargs"].values())
+                paced.append(any(
+                    e[0] in ("chan", "input")
+                    or (e[0] == "reg" and paced[e[1]]) for e in encs))
+            if plan.pop("needs_input") or not all(paced):
+                ch = new_chan()
+                plan["input"] = ch
+                in_chans.append(ch)
+
+        # driver output channels, one per output occurrence (root order)
+        out_chans: list[MutableShmChannel] = []
+        for out_node in outputs:
+            aid, reg = node_loc[id(out_node)]
+            ch = new_chan()
+            plans[aid]["ops"][reg]["out"].append(ch)
+            out_chans.append(ch)
+
+        executor = ChannelExecutor(
+            worker, plans, aids, in_chans, out_chans, all_chans,
+            max_inflight=max_inflight, multi=multi)
+        executor._provision()
+        return executor, None
+    except Exception as e:  # noqa: BLE001 — release shm, then fall back
+        _release_actors(aids)
+        for ch in all_chans:
+            ch.close()
+            ch.unlink()
+        logger.warning("channel-plane compile failed; falling back to the "
+                       "submit path: %r", e)
+        return None, f"channel plane provisioning failed ({e!r})"

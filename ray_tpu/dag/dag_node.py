@@ -71,9 +71,19 @@ class DAGNode:
                 values[id(node)] = node._submit(args, kwargs)
         return values[id(self)]
 
-    def experimental_compile(self, *, max_inflight_executions: int = 10) -> "CompiledDAG":
+    def experimental_compile(self, *, max_inflight_executions: int = 10,
+                             enable_channel_execution: bool = True,
+                             channel_buffer_bytes: int = 1 << 20) -> "CompiledDAG":
+        """Compile the graph for repeated steady-state execution. When the
+        topology allows (actor-method nodes only, every actor on the
+        driver's host), per-actor execution loops are provisioned over
+        mutable-shm channels and each step skips the task-submission
+        control plane entirely; otherwise the cached-schedule submit path
+        is used (`CompiledDAG.fallback_reason` says why)."""
         return CompiledDAG(self,
-                           max_inflight_executions=max_inflight_executions)
+                           max_inflight_executions=max_inflight_executions,
+                           enable_channel_execution=enable_channel_execution,
+                           channel_buffer_bytes=channel_buffer_bytes)
 
 
 class InputNode(DAGNode):
@@ -115,7 +125,24 @@ class MultiOutputNode(DAGNode):
         super().__init__(tuple(outputs), {})
 
 
-class DAGFuture:
+class AwaitableDAGFuture:
+    """Shared future protocol for both execution planes: marks the handle
+    for `ray_tpu.get()` resolution and adapts blocking `.result()` to
+    `await` (subclasses provide `result`)."""
+
+    __dag_future__ = True  # ray_tpu.get() resolves these via .result()
+
+    def __await__(self):
+        import asyncio
+
+        # get_event_loop() is deprecated and raises on 3.12 without a
+        # running loop; awaiting implies one is running
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(None, self.result)
+        return fut.__await__()
+
+
+class DAGFuture(AwaitableDAGFuture):
     """Handle to one in-flight compiled-DAG execution: blocking `.result()`
     or `await` (reference: compiled execute_async returns an awaitable,
     compiled_dag_node.py:2627)."""
@@ -140,29 +167,50 @@ class DAGFuture:
     def refs(self):
         return self._output
 
-    def __await__(self):
-        import asyncio
-
-        loop = asyncio.get_event_loop()
-        fut = loop.run_in_executor(None, self.result)
-        return fut.__await__()
-
 
 class CompiledDAG:
     """(reference: dag/compiled_dag_node.py:805 — the compiled form caches
     a static execution schedule; execute()/execute_async() are the
     steady-state entry points (:2546, :2627); in-flight executions overlap
-    up to max_inflight_executions, pipelining the actors.)"""
+    up to max_inflight_executions, pipelining the actors.)
 
-    def __init__(self, root: DAGNode, *, max_inflight_executions: int = 10):
+    Two execution planes:
+    - channel plane (default when eligible): per-actor exec loops over
+      mutable-shm channels, provisioned once at compile time — a step is
+      one channel write + one channel read, no task submission at all;
+    - submit plane (fallback): the cached schedule is replayed through
+      `.remote()` per step. `fallback_reason` records why."""
+
+    def __init__(self, root: DAGNode, *, max_inflight_executions: int = 10,
+                 enable_channel_execution: bool = True,
+                 channel_buffer_bytes: int = 1 << 20):
         self._root = root
         self._max_inflight = max(1, int(max_inflight_executions))
         self._inflight: list[DAGFuture] = []
+        self._torn = False
         # static schedule, computed once: topological, with per-actor op
         # lists so repeated executions skip traversal entirely
         # (reference: _build_execution_schedule, compiled_dag_node.py:2002)
         self._schedule = root._topo()
         self._input_nodes = [n for n in self._schedule if isinstance(n, InputNode)]
+        self._channel = None
+        self._fallback_reason: str | None = None
+        if enable_channel_execution:
+            from ray_tpu.dag.channel_execution import try_build
+
+            self._channel, self._fallback_reason = try_build(
+                root, self._schedule, max_inflight=self._max_inflight,
+                buffer_bytes=channel_buffer_bytes)
+        else:
+            self._fallback_reason = "channel execution disabled by caller"
+
+    @property
+    def uses_channels(self) -> bool:
+        return self._channel is not None
+
+    @property
+    def fallback_reason(self) -> str | None:
+        return self._fallback_reason
 
     def _submit_once(self, input_value):
         values: dict[int, Any] = {}
@@ -186,15 +234,24 @@ class CompiledDAG:
             self._inflight = [f for f in self._inflight if not f.done()]
 
     def execute(self, input_value: Any = None):
-        """Submit one execution; returns the output ObjectRef(s). Submits
-        overlap with previous in-flight executions up to the cap."""
+        """Submit one execution. Channel plane → a ChannelDAGFuture
+        (`.result()` / `await` / `ray_tpu.get()`); submit plane → the
+        output ObjectRef(s). Executions overlap up to the cap."""
+        if self._torn:
+            raise ValueError("compiled DAG was torn down")
+        if self._channel is not None:
+            return self._channel.execute(input_value)
         self._reap_inflight()
         out = self._submit_once(input_value)
         self._inflight.append(DAGFuture(out))
         return out
 
-    def execute_async(self, input_value: Any = None) -> DAGFuture:
-        """Submit one execution; returns a DAGFuture (`.result()`/`await`)."""
+    def execute_async(self, input_value: Any = None):
+        """Submit one execution; returns a future (`.result()`/`await`)."""
+        if self._torn:
+            raise ValueError("compiled DAG was torn down")
+        if self._channel is not None:
+            return self._channel.execute(input_value)
         self._reap_inflight()
         fut = DAGFuture(self._submit_once(input_value))
         self._inflight.append(fut)
@@ -214,16 +271,41 @@ class CompiledDAG:
                 label = (f"{getattr(n._method, '_actor_id', '?')[:8]}."
                          f"{getattr(n._method, '_method_name', '?')}")
             lines.append(f"{i:3d} {kind:16s} {label:24s} deps={deps}")
+        if self._channel is not None:
+            s = self._channel.stats
+            lines.append(f"plane: channels ({s['actors']} exec loops, "
+                         f"{s['channels']} shm channels)")
+        else:
+            lines.append(f"plane: submit ({self._fallback_reason})")
         return "\n".join(lines)
 
-    def teardown(self):
+    def teardown(self, raise_on_error: bool = False):
+        """Stop the channel plane (close channels, join exec loops, unlink
+        /dev/shm files) and settle in-flight submit-plane executions.
+        Errors from in-flight steps are logged once; `raise_on_error=True`
+        re-raises the first one."""
+        if self._torn:
+            return
+        self._torn = True
+        errors: list[Exception] = []
+        if self._channel is not None:
+            errors.extend(e for _aid, e in
+                          self._channel.teardown(raise_on_error=False))
         for f in self._inflight:
             try:
                 f.result(timeout=5)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — collected, logged below
+                errors.append(e)
         self._inflight = []
         self._schedule = []
+        if errors:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "CompiledDAG.teardown: %d in-flight execution error(s); "
+                "first: %r", len(errors), errors[0])
+            if raise_on_error:
+                raise errors[0]
 
 
 def _function_bind(self, *args, **kwargs) -> FunctionNode:

@@ -51,3 +51,9 @@ class OwnerDiedError(ObjectLostError):
 
 class PlacementGroupUnschedulableError(RayTpuError):
     pass
+
+
+class RayChannelError(RayTpuError):
+    """A compiled-DAG channel operation failed: peer loop/actor died, the
+    channel was closed mid-execution, or the DAG was torn down (reference:
+    ray.exceptions.RayChannelError)."""

@@ -68,32 +68,65 @@ class MutableShmChannel:
         for name, val in fields.items():
             self._FIELD.pack_into(self._mm, self._OFF[name], val)
 
-    @staticmethod
-    def _wait(cond, timeout: float | None, what: str):
+    def _wait(self, check, timeout: float | None, what: str):
+        # `check` takes one header tuple — ONE _hdr() unpack per iteration
+        # serves both the condition and the progress snapshot on this
+        # per-message hot path. The deadline is checked BEFORE any sleep
+        # so a timeout=0 poll is a true non-blocking probe (one condition
+        # check, immediate raise). The spin phase is SHORT: with several
+        # channel endpoints parked on one small host, long hot spins
+        # starve the one thread that has real work. After it, sleeps
+        # escalate while the channel is quiet; any header progress (e.g.
+        # the peer published plen but not yet the seq bump) drops the
+        # sleep back to the lowest tier so the follow-on update is caught
+        # at low latency.
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
+        slept_since = None
+        snap = None
         while True:
-            if cond():
+            hdr = self._hdr()
+            if check(hdr):
                 return
-            spins += 1
-            if spins > 1000:  # spin briefly, then yield the core
-                time.sleep(50e-6)
-            if deadline is not None and time.monotonic() > deadline:
+            if hdr != snap:
+                snap = hdr
+                slept_since = None  # progress: reset the sleep escalation
+            if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(what)
+            spins += 1
+            if spins <= 100:  # spin briefly, then yield the core
+                continue
+            now = time.monotonic()
+            if slept_since is None:
+                slept_since = now
+            quiet = now - slept_since
+            time.sleep(50e-6 if quiet < 0.002
+                       else (200e-6 if quiet < 0.02
+                             else (1e-3 if quiet < 0.25 else 5e-3)))
 
     # ---------------------------------------------------------------- api
+
+    def poll(self) -> bool:
+        """Non-blocking: True iff a payload is ready to read."""
+        w, r, _n, _c = self._hdr()
+        return w > r
 
     def write(self, value, timeout: float | None = 60.0) -> None:
         from ray_tpu._private import serialization as ser
 
-        payload = ser.dumps(value)
+        self.write_serialized(ser.dumps(value), timeout)
+
+    def write_serialized(self, payload: bytes,
+                         timeout: float | None = 60.0) -> None:
+        """Write pre-serialized bytes (one serialization for a fan-out of
+        writes, and size-checking before committing to any channel)."""
         if len(payload) > self.capacity:
             raise ValueError(
                 f"payload {len(payload)}B exceeds channel capacity "
                 f"{self.capacity}B (pick buffer_bytes at create_channel)")
 
-        def writable():
-            w, r, _n, c = self._hdr()
+        def writable(hdr):
+            w, r, _n, c = hdr
             if c:
                 raise ChannelClosed("channel closed")
             return w == r  # previous payload consumed
@@ -108,8 +141,8 @@ class MutableShmChannel:
     def read(self, timeout: float | None = 60.0):
         from ray_tpu._private import serialization as ser
 
-        def readable():
-            w, r, _n, c = self._hdr()
+        def readable(hdr):
+            w, r, _n, c = hdr
             if w > r:
                 return True
             if c:
