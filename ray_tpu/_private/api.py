@@ -247,10 +247,14 @@ def timeline(filename: str | None = None) -> list:
     events = (w.rpc({"type": "task_events"}).get("events", [])
               if hasattr(w, "rpc") else [])  # local mode keeps no store
     if filename:
-        # write even when empty: callers open the promised file next
-        from ray_tpu._private.task_events import export_chrome_trace
+        # write even when empty: callers open the promised file next.
+        # Actor rows labeled class/name, like `ray_tpu timeline`.
+        from ray_tpu._private.task_events import (export_chrome_trace,
+                                                  fetch_worker_names)
 
-        export_chrome_trace(events, filename)
+        export_chrome_trace(events, filename,
+                            fetch_worker_names(w.rpc)
+                            if hasattr(w, "rpc") else {})
     return events
 
 

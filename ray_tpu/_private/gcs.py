@@ -357,6 +357,12 @@ class GcsServer:
         # series so restarts/re-reports replace instead of double-count
         # (reference: metrics agent aggregation, _private/metrics_agent.py:628)
         self.metrics: dict[str, dict] = {}
+        # compiled-DAG registry: dag_id → metadata registered at
+        # experimental_compile (nodes, actors, channel topology,
+        # fallback_reason), dropped at teardown or driver death. Session-
+        # scoped like task_events — a DAG cannot outlive its driver, so the
+        # table is in-memory only.
+        self.compiled_dags: dict[str, dict] = {}
         # retained metric TIME SERIES, head-side (reference: the dashboard's
         # metrics stack — per-node agents scraped into Prometheus,
         # dashboard/modules/metrics/metrics_head.py; here the GCS keeps a
@@ -1304,6 +1310,7 @@ class GcsServer:
                     "task_counter": dict(self.task_counter),
                     "actors": {
                         a.aid: {"state": a.state, "name": a.name, "worker": a.worker,
+                                "class": a.create_spec.get("class_name"),
                                 "num_restarts": a.num_restarts,
                                 "queued": len(a.queue), "in_flight": a.in_flight}
                         for a in self.actors.values()
@@ -1391,24 +1398,38 @@ class GcsServer:
                     pass
         elif t == "list_objects":
             # object-directory summary (reference: `ray list objects`,
-            # util/state/state_cli.py backed by GCS/raylet introspection)
-            import itertools as _it
+            # util/state/state_cli.py backed by GCS/raylet introspection).
+            # Filters run BEFORE the limit cut (state.list_objects pushes
+            # its predicates here): limiting first would return fewer than
+            # `limit` matching rows while more matches exist, and shipping
+            # the whole table for client-side filtering would marshal
+            # every row under this lock. limit <= 0 means unbounded.
+            from ray_tpu.util.state import matches_filters
 
             limit = int(msg.get("limit", 1000))
+            filters = msg.get("filters") or ()
+            truncated = False
             with self.lock:
                 total = len(self.objects)
                 rows = []
-                for oid, e in _it.islice(self.objects.items(), limit):
-                    rows.append({
+                for oid, e in self.objects.items():
+                    row = {
                         "object_id": oid, "status": e.get("status"),
                         "where": e.get("where"), "size": e.get("size", 0),
                         "ref_count": e.get("count", 0),
                         "sys_holds": e.get("sys", 0),
                         "pinned": bool(e.get("pinned")),
                         "hosts": sorted(e.get("hosts", ())),
-                    })
+                    }
+                    if filters and not matches_filters(row, filters):
+                        continue
+                    if 0 < limit <= len(rows):
+                        # a further MATCH exists past the cut
+                        truncated = True
+                        break
+                    rows.append(row)
             conn.send({"rid": msg["rid"], "objects": rows, "total": total,
-                       "truncated": total > limit})
+                       "truncated": truncated})
         elif t == "list_workers":
             with self.lock:
                 rows = [{"wid": w.wid, "pid": w.pid, "kind": w.kind,
@@ -1468,8 +1489,13 @@ class GcsServer:
                     rec = self.metrics.setdefault(
                         m["name"], {"kind": m["kind"],
                                     "description": m.get("description", ""),
-                                    "series": {}})
+                                    "series": {}, "ts": {}})
                     rec["series"][source] = m["series"]
+                    # snapshot ts per source: gauge merging picks the
+                    # newest series deterministically (util/metrics.py
+                    # to_prometheus), not whichever source iterates last
+                    rec.setdefault("ts", {})[source] = m.get(
+                        "ts", time.time())
         elif t == "metrics_history":
             # retained time series for the dashboard's graphs: per-node
             # resource views + cluster-level gauges (reference capability:
@@ -1486,7 +1512,8 @@ class GcsServer:
             with self.lock:
                 snap = {name: {"kind": r["kind"],
                                "description": r["description"],
-                               "series": {s: list(v) for s, v in r["series"].items()}}
+                               "series": {s: list(v) for s, v in r["series"].items()},
+                               "ts": dict(r.get("ts") or {})}
                         for name, r in self.metrics.items()}
                 # fold in internal runtime stats as gauges
                 snap["ray_tpu_pending_tasks"] = {
@@ -1540,6 +1567,25 @@ class GcsServer:
             with self.lock:
                 events = list(self.task_events)
             conn.send({"rid": msg["rid"], "events": events})
+        elif t == "dag_register":
+            # compiled-DAG registry (tentpole: observability for the channel
+            # execution plane). The registering connection's wid is recorded
+            # so driver death retires the entry — a DAG cannot outlive the
+            # driver that owns its channels.
+            rec = dict(msg["dag"])
+            rec.setdefault("driver_wid", wid or "")
+            with self.lock:
+                self.compiled_dags[str(rec["dag_id"])] = rec
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "dag_deregister":
+            with self.lock:
+                existed = self.compiled_dags.pop(
+                    str(msg["dag_id"]), None) is not None
+            conn.send({"rid": msg["rid"], "ok": True, "existed": existed})
+        elif t == "dag_list":
+            with self.lock:
+                rows = [dict(r) for r in self.compiled_dags.values()]
+            conn.send({"rid": msg["rid"], "dags": rows})
         elif t == "subscribe":
             key = (msg["channel"], msg["sub_id"])
             with self.lock:
@@ -3415,6 +3461,11 @@ class GcsServer:
         # them (the reference kills workers leaked by dead drivers too)
         with self.lock:
             held = list(self._leases_by_holder.pop(wid, ()))
+            # compiled DAGs registered by this driver die with it (their
+            # channels/loops are gone); the registry must not serve ghosts
+            for did in [d for d, r in self.compiled_dags.items()
+                        if r.get("driver_wid") == wid]:
+                self.compiled_dags.pop(did, None)
         for lw in held:
             self._release_lease(lw, None, make_idle=False)
             with self.lock:

@@ -143,6 +143,15 @@ class RayConfig:
     enable_tracing: bool = False
     # Metrics report period from workers/agents to the GCS.
     metrics_report_interval_s: float = 2.0
+    # Compiled-DAG channel-plane instrumentation: per-step phase histograms
+    # (input-wait / compute / output-write / backpressure-drain). The
+    # always-on cost is two monotonic reads + one pre-bound histogram
+    # observe per phase; 0/false disables entirely (the bench baseline).
+    dag_metrics: bool = True
+    # Emit a full timeline span (task_events, flushed to the GCS by the
+    # CoreWorker flusher) every Nth compiled-DAG step; 0 = off. Sampled at
+    # compile time into the exec-loop plan so workers need no env override.
+    dag_span_sample_every: int = 100
 
     _singleton = None
     _lock = threading.Lock()

@@ -86,19 +86,55 @@ def normalize_events(events: list) -> list:
     return events
 
 
-def export_chrome_trace(events: list, filename: str) -> None:
+def export_chrome_trace(events: list, filename: str,
+                        worker_names: dict | None = None) -> None:
     """One exporter for CLI / dashboard / api.timeline: normalize + render
     + write."""
     with open(filename, "w") as f:
-        f.write(to_chrome_trace(normalize_events(list(events))))
+        f.write(to_chrome_trace(normalize_events(list(events)),
+                                worker_names))
+
+
+def worker_display_names(workers: list, actors: dict) -> dict:
+    """wid → human label for timeline rows: actor workers are labeled with
+    the actor's class/name from the GCS actor table instead of a bare
+    pid/wid, so e.g. compiled-DAG exec-loop rows read as `Stage:my_actor`
+    rather than an opaque id. `workers` is the list_workers RPC rows,
+    `actors` the cluster_state actor map."""
+    names: dict = {}
+    for w in workers or ():
+        aid = w.get("actor_id")
+        if not aid:
+            continue
+        info = (actors or {}).get(aid) or {}
+        cls = info.get("class") or "Actor"
+        label = (f"{cls}:{info['name']}" if info.get("name")
+                 else f"{cls}@{aid[:8]}")
+        names[w["wid"]] = f"{label} (pid {w.get('pid')})"
+    return names
+
+
+def fetch_worker_names(rpc) -> dict:
+    """worker_display_names over any GCS request/reply callable (driver
+    worker, dashboard client, CLI client). Labels are decoration: any RPC
+    failure yields {} rather than failing the export."""
+    try:
+        return worker_display_names(
+            rpc({"type": "list_workers"}).get("workers", []),
+            rpc({"type": "cluster_state"})["state"].get("actors", {}))
+    except Exception:
+        return {}
 
 
 def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
     """Render GCS-collected events as chrome://tracing 'traceEvents' JSON.
 
-    Rows: one per (worker-id, pid). Durations become complete ('X') events
-    with microsecond timestamps, matching what chrome://tracing / Perfetto
-    ingests from the reference's `ray timeline` output.
+    Rows: one per (worker-id, pid) — except compiled-DAG step spans, which
+    carry a `dag_id` and are grouped under one row per DAG (tid = DAG node)
+    so a pipeline's steps line up regardless of which worker ran them.
+    Durations become complete ('X') events with microsecond timestamps,
+    matching what chrome://tracing / Perfetto ingests from the reference's
+    `ray timeline` output.
     """
     worker_names = worker_names or {}
     trace = []
@@ -106,14 +142,20 @@ def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
         if ev.get("start") is None:
             continue
         wid = ev.get("worker_id", "") or str(ev.get("pid", 0))
+        if ev.get("dag_id"):
+            row = f"dag:{ev['dag_id']}"
+            tid = ev.get("node") or ev.get("pid", 0)
+        else:
+            row = worker_names.get(wid, wid)
+            tid = ev.get("pid", 0)
         trace.append({
             "name": ev.get("name") or ev.get("event", ""),
             "cat": ev.get("event", "task"),
             "ph": "X",
             "ts": ev["start"] * 1e6,
             "dur": max(0.0, ((ev.get("end") or ev["start"]) - ev["start"])) * 1e6,
-            "pid": worker_names.get(wid, wid),
-            "tid": ev.get("pid", 0),
+            "pid": row,
+            "tid": tid,
             "args": {k: v for k, v in ev.items()
                      if k not in ("start", "end", "name", "event", "pid")},
         })

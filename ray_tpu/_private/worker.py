@@ -1109,6 +1109,7 @@ class CoreWorker:
         max_concurrency: int = 1,
         runtime_env: dict | None = None,
         concurrency_groups: dict | None = None,
+        class_name: str | None = None,
     ) -> str:
         actor_id = ActorID().hex()
         task_id = TaskID().hex()
@@ -1128,6 +1129,9 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "max_task_retries": max_task_retries,
             "name": name,
+            # human-readable class for state/timeline labels (the GCS only
+            # ever sees the pickled blob otherwise)
+            "class_name": class_name,
             "namespace": namespace or self.effective_namespace(),
             "strategy": strategy,
             # the GCS gates dispatch on total concurrency: named groups

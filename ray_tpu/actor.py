@@ -164,6 +164,7 @@ class ActorClass:
             max_concurrency=self._max_concurrency,
             runtime_env=self._runtime_env,
             concurrency_groups=self._concurrency_groups,
+            class_name=getattr(self._cls, "__name__", None),
         )
         return ActorHandle(actor_id)
 

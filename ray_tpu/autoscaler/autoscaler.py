@@ -101,10 +101,76 @@ class Autoscaler:
     def _demand(self) -> dict:
         return self._rpc({"type": "resource_demand"})["demand"]
 
+    # -- metrics -----------------------------------------------------------
+
+    def _observe_pass(self, duration_s: float) -> None:
+        """Record reconcile duration + per-type pending/running gauges.
+        Gauges are set for EVERY configured node type (zero included) so a
+        scale-down is visible as 0, not as a vanished series."""
+        try:
+            from ray_tpu.util.metrics import (Gauge, Histogram,
+                                              get_or_create)
+
+            get_or_create(
+                Histogram, "ray_tpu_autoscaler_reconcile_seconds",
+                "autoscaler reconcile-pass duration",
+                boundaries=(0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 60.0),
+            ).observe(duration_s)
+            pending = self._im.counts(states=(im.REQUESTED, im.ALLOCATED))
+            running = self._im.counts(states=(im.RUNNING, im.IDLE_TRACKED))
+            g_pend = get_or_create(
+                Gauge, "ray_tpu_autoscaler_pending_nodes",
+                "instances requested/allocated but not yet joined",
+                tag_keys=("node_type",))
+            g_run = get_or_create(
+                Gauge, "ray_tpu_autoscaler_running_nodes",
+                "instances joined to the cluster (incl. idle-tracked)",
+                tag_keys=("node_type",))
+            for tname in self.node_types:
+                g_pend.set(pending.get(tname, 0), tags={"node_type": tname})
+                g_run.set(running.get(tname, 0), tags={"node_type": tname})
+        except Exception:  # noqa: BLE001 — metrics must never fail a pass
+            logger.debug("autoscaler metrics update failed", exc_info=True)
+
+    def _flush_metrics(self) -> None:
+        """Ship this process's metric registry to the GCS. Only when no
+        in-process CoreWorker exists (the monitor process): a driver-hosted
+        autoscaler shares the process registry, which the driver's own
+        flusher already reports — a second source would double-count."""
+        try:
+            from ray_tpu._private import api as _api
+
+            if getattr(_api, "_worker", None) is not None:
+                return
+            from ray_tpu.util import metrics as _met
+
+            snap = _met.snapshot()
+            if not snap:
+                return
+            # source is per-PROCESS (registry is process-wide): a restarted
+            # Autoscaler instance in the same monitor re-reports the same
+            # cumulative registry, and per-source replace must not let the
+            # GCS sum the old and new copies
+            import os as _os
+
+            msg = {"type": "metrics_report",
+                   "source": f"autoscaler:{_os.getpid()}", "metrics": snap}
+            with self._rpc_lock:  # one-way send; metrics_report never replies
+                self._conn.send(msg)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- reconciliation ----------------------------------------------------
 
     def reconcile_once(self) -> dict:
         """One reconcile pass; returns a summary (for tests/introspection)."""
+        t_pass = time.monotonic()
+        try:
+            return self._reconcile_once()
+        finally:
+            self._observe_pass(time.monotonic() - t_pass)
+
+    def _reconcile_once(self) -> dict:
         actions = {"launched": [], "terminated": [], "adopted": [],
                    "reaped": [], "swept": []}
         if not self._recovered:
@@ -387,6 +453,7 @@ class Autoscaler:
         while not self._stop.wait(self.interval_s):
             try:
                 self.reconcile_once()
+                self._flush_metrics()
             except ConnectionClosed:
                 return
             except Exception:

@@ -74,6 +74,27 @@ class InvalidTransition(RuntimeError):
     """A state change the machine does not allow (programming error)."""
 
 
+def _transition_counter():
+    """Instance state-transition counter, fetched registry-aware (the
+    monitor process flushes it to the GCS; in-process autoscalers ride the
+    driver's flusher)."""
+    from ray_tpu.util.metrics import Counter, get_or_create
+
+    return get_or_create(
+        Counter, "ray_tpu_autoscaler_instance_transitions_total",
+        "autoscaler instance state-machine transitions",
+        tag_keys=("node_type", "from_state", "to_state"))
+
+
+def _count_transition(node_type: str, from_state: str, to_state: str) -> None:
+    try:
+        _transition_counter().inc(tags={"node_type": node_type,
+                                        "from_state": from_state,
+                                        "to_state": to_state})
+    except Exception:  # noqa: BLE001 — metrics must never fail a transition
+        pass
+
+
 @dataclass
 class Instance:
     """One autoscaler-managed node, as persisted in the GCS table.
@@ -201,6 +222,7 @@ class InstanceManager:
         self.storage.put(inst.to_dict())
         with self._lock:
             self._instances[inst.instance_id] = inst
+        _count_transition(node_type, "(new)", REQUESTED)
         return inst
 
     def transition(self, inst: Instance, state: str, *,
@@ -225,6 +247,8 @@ class InstanceManager:
             self.storage.put(updated.to_dict())
             with self._lock:
                 self._instances[updated.instance_id] = updated
+        # counted AFTER the persist: the metric reports durable transitions
+        _count_transition(updated.node_type, cur.state, state)
         return updated
 
     # -- queries ----------------------------------------------------------

@@ -115,6 +115,123 @@ def _task_error(label: str, exc: Exception, tb: str = "") -> _PipelineError:
     return _PipelineError(label, err)
 
 
+class _DagInput:
+    """Trace-context envelope for channel payloads. The driver wraps the
+    input value only when it holds an active trace AND span sampling is on;
+    instrumented exec loops re-wrap their sampled intermediates so the
+    context propagates DOWNSTREAM through the data channels too — actors
+    past the first stage have no driver input channel, and without in-band
+    forwarding their sampled steps could never join the caller's trace
+    (the channel plane bypasses the submit path where `tracing.inject`
+    normally rides, _private/worker.py _trace_field)."""
+
+    __slots__ = ("value", "trace_ctx")
+
+    def __init__(self, value, trace_ctx):
+        self.value = value
+        self.trace_ctx = trace_ctx
+
+    def __reduce__(self):
+        return (_DagInput, (self.value, self.trace_ctx))
+
+
+# histogram bucket layout for DAG step phases: channel hops are µs-scale,
+# user compute can be seconds
+_STEP_BUCKETS = (50e-6, 200e-6, 1e-3, 5e-3, 25e-3, 0.1, 0.5, 2.0, 10.0)
+_PHASES = (("input_wait", "input/argument wait"),
+           ("compute", "user-method compute"),
+           ("output_write", "output channel write"))
+
+
+def _phase_histograms():
+    """The three per-step phase histograms, fetched registry-aware (tests
+    clear the registry; a module cache would go stale)."""
+    from ray_tpu.util.metrics import Histogram, get_or_create
+
+    return tuple(
+        get_or_create(Histogram, f"ray_tpu_dag_step_{phase}_seconds",
+                      f"compiled-DAG per-step {desc} (channel plane)",
+                      boundaries=_STEP_BUCKETS, tag_keys=("dag_id", "node"))
+        for phase, desc in _PHASES)
+
+
+class _LoopInstr:
+    """Worker-side exec-loop instrumentation.
+
+    Always-on path (dag_metrics): two `time.monotonic()` reads and one
+    PRE-BOUND histogram observe per phase — tag merge/sort happens once at
+    loop start, never per step. Every `sample`-th step additionally emits a
+    full timeline span into the process task_events buffer, which the
+    CoreWorker flusher already ships to the GCS; with an active trace
+    context the span joins the caller's trace. When both knobs are off,
+    `create` returns None and the loop takes the original untimed path —
+    zero emits, zero extra allocation (the tier-1 zero-emit guard)."""
+
+    __slots__ = ("dag_id", "sample", "_bound", "_series")
+
+    def __init__(self, dag_id: str, sample: int, metrics_on: bool, ops):
+        self.dag_id = dag_id
+        self.sample = sample
+        self._bound = None
+        self._series: list = []  # (hist, tags) for retirement
+        if metrics_on:
+            hists = _phase_histograms()
+            bound = []
+            for op in ops:
+                tags = {"dag_id": dag_id, "node": op["label"]}
+                bound.append(tuple(h.bind(tags) for h in hists))
+                self._series.extend((h, tags) for h in hists)
+            self._bound = bound
+
+    @classmethod
+    def create(cls, plan: dict) -> "_LoopInstr | None":
+        dag_id = plan.get("dag_id")
+        sample = int(plan.get("sample") or 0)
+        metrics_on = bool(plan.get("metrics"))
+        if not dag_id or not (metrics_on or sample):
+            return None
+        return cls(dag_id, sample, metrics_on, plan["ops"])
+
+    def record(self, i: int, op: dict, step: int, wait_s: float,
+               compute_s: float, write_s: float, trace_ctx) -> None:
+        if self._bound is not None:
+            b = self._bound[i]
+            b[0].observe(wait_s)
+            b[1].observe(compute_s)
+            b[2].observe(write_s)
+        if self.sample and step % self.sample == 0:
+            self._emit_span(op, step, wait_s, compute_s, write_s, trace_ctx)
+
+    def retire(self) -> None:
+        """Drop this DAG's labelsets from the registry (loop exit): dag_id
+        is a short-lived tag value — per Metric.remove, leaving it would
+        grow every future scrape with dead series across compiles."""
+        for h, tags in self._series:
+            h.remove(tags)
+
+    def _emit_span(self, op, step, wait_s, compute_s, write_s, trace_ctx):
+        from ray_tpu._private import task_events
+
+        end = time.time()
+        extra = {"dag_id": self.dag_id, "node": op["label"], "seq": step,
+                 "input_wait_s": round(wait_s, 9),
+                 "compute_s": round(compute_s, 9),
+                 "output_write_s": round(write_s, 9)}
+        start = end - (wait_s + compute_s + write_s)
+        if trace_ctx:
+            # event kind "trace:span" so tracing.assemble() attaches the
+            # step under the driver's trace tree
+            task_events.emit(
+                "trace:span", name=op["label"], start=start, end=end,
+                trace_id=trace_ctx["trace_id"],
+                span_id=os.urandom(8).hex(),
+                parent_span_id=trace_ctx.get("parent_span_id", ""),
+                span_kind="dag_step", ok=True, **extra)
+        else:
+            task_events.emit("dag:step", name=op["label"], start=start,
+                             end=end, **extra)
+
+
 # --------------------------------------------------------------------------
 # worker side: the per-actor execution loop
 # --------------------------------------------------------------------------
@@ -152,6 +269,11 @@ def _emit(outs: list, result, label: str):
         result = _task_error(label, None, traceback.format_exc())
         blob = ser.dumps(result)
     cap = min(ch.capacity for ch in outs)
+    if len(blob) > cap and type(result) is _DagInput:
+        # the sampled-step trace envelope must not make a fitting
+        # intermediate fail every Nth step: strip it and retry bare
+        result = result.value
+        blob = ser.dumps(result)
     if len(blob) > cap:
         result = _task_error(label, ValueError(
             f"DAG intermediate from {label} is {len(blob)}B, exceeding the "
@@ -178,41 +300,122 @@ def _run_op(instance, op, args, kwargs, execer):
     return result
 
 
+def _materialize_args(op: dict, regs: list, inp):
+    args = [_decode(e, regs, inp) for e in op["args"]]
+    kwargs = {k: _decode(e, regs, inp) for k, e in op["kwargs"].items()}
+    return args, kwargs
+
+
+def _materialize_args_traced(op: dict, regs: list, inp):
+    """Instrumented-path variant: channel args may arrive wrapped in a
+    _DagInput envelope (an upstream loop forwarding the caller's trace
+    context on a sampled step) — unwrap and surface the context."""
+    ctx = None
+
+    def dec(e):
+        nonlocal ctx
+        v = _decode(e, regs, inp)
+        if type(v) is _DagInput:
+            ctx = v.trace_ctx
+            v = v.value
+        return v
+
+    args = [dec(e) for e in op["args"]]
+    kwargs = {k: dec(e) for k, e in op["kwargs"].items()}
+    return args, kwargs, ctx
+
+
+def _compute_op(instance, op: dict, args, kwargs, execer):
+    poisoned = next(
+        (v for v in (*args, *kwargs.values())
+         if isinstance(v, _PipelineError)), None)
+    if poisoned is not None:
+        return poisoned  # propagate, don't execute
+    try:
+        return _run_op(instance, op, args, kwargs, execer)
+    except Exception as e:  # noqa: BLE001 — becomes in-band error
+        return _task_error(op["label"], e, traceback.format_exc())
+
+
 def actor_exec_loop(instance, plan: dict, _execer=None) -> dict:
     """Run inside the actor process until the driver tears the DAG down.
 
     `plan` (built by try_build, shipped once at compile time):
-      ops:   [{method, args, kwargs, out, label}] in schedule order; arg
-             encodings are ("const", v) | ("reg", i) | ("chan", ch) |
-             ("input",)
-      input: driver input channel (also the pacing tick for actors whose
-             ops have no channel in-edges), or None
+      ops:     [{method, args, kwargs, out, label}] in schedule order; arg
+               encodings are ("const", v) | ("reg", i) | ("chan", ch) |
+               ("input",)
+      input:   driver input channel (also the pacing tick for actors whose
+               ops have no channel in-edges), or None
+      dag_id / metrics / sample: instrumentation identity + knobs, stamped
+               at compile time from the driver's RayConfig so workers need
+               no env propagation
     """
     ops = plan["ops"]
     input_ch = plan.get("input")
+    instr = _LoopInstr.create(plan)
+    try:
+        return _exec_loop_body(instance, ops, input_ch, instr, _execer)
+    finally:
+        if instr is not None:
+            # ANY exit path (ChannelClosed or a crashed loop in a
+            # still-alive actor) must drop this DAG's labelsets, or the
+            # flusher keeps exporting dead per-dag_id series forever
+            instr.retire()
+
+
+def _exec_loop_body(instance, ops, input_ch, instr, _execer) -> dict:
     steps = 0
     try:
         while True:
-            inp = _loop_read(input_ch) if input_ch is not None else None
-            regs: list[Any] = []
-            for op in ops:
-                args = [_decode(e, regs, inp) for e in op["args"]]
-                kwargs = {k: _decode(e, regs, inp)
-                          for k, e in op["kwargs"].items()}
-                poisoned = next(
-                    (v for v in (*args, *kwargs.values())
-                     if isinstance(v, _PipelineError)), None)
-                if poisoned is not None:
-                    result = poisoned  # propagate, don't execute
-                else:
-                    try:
-                        result = _run_op(instance, op, args, kwargs, _execer)
-                    except Exception as e:  # noqa: BLE001 — becomes in-band error
-                        result = _task_error(op["label"], e,
-                                             traceback.format_exc())
-                regs.append(result)
-                if op["out"]:
-                    _emit(op["out"], result, op["label"])
+            if instr is None:
+                # untimed path: metrics + sampling disabled — no clock
+                # reads, no emits, no extra allocation per step
+                inp = _loop_read(input_ch) if input_ch is not None else None
+                if type(inp) is _DagInput:
+                    inp = inp.value
+                regs: list[Any] = []
+                for op in ops:
+                    args, kwargs = _materialize_args(op, regs, inp)
+                    result = _compute_op(instance, op, args, kwargs, _execer)
+                    regs.append(result)
+                    if op["out"]:
+                        _emit(op["out"], result, op["label"])
+            else:
+                t0 = time.monotonic()
+                inp = _loop_read(input_ch) if input_ch is not None else None
+                t1 = time.monotonic()
+                in_wait = t1 - t0
+                trace_ctx = None
+                if type(inp) is _DagInput:
+                    trace_ctx = inp.trace_ctx
+                    inp = inp.value
+                regs = []
+                sampled = instr.sample and steps % instr.sample == 0
+                for i, op in enumerate(ops):
+                    # stamps chain op-to-op: t1 is the previous op's write
+                    # end (3 clock reads per op, not 5)
+                    args, kwargs, chan_ctx = _materialize_args_traced(
+                        op, regs, inp)
+                    op_ctx = chan_ctx or trace_ctx
+                    t2 = time.monotonic()
+                    result = _compute_op(instance, op, args, kwargs, _execer)
+                    t3 = time.monotonic()
+                    regs.append(result)
+                    if op["out"]:
+                        wire = result
+                        if (sampled and op_ctx is not None
+                                and not isinstance(result, _PipelineError)):
+                            # forward the trace context downstream in-band
+                            # so later stages' sampled steps join the trace
+                            wire = _DagInput(result, op_ctx)
+                        _emit(op["out"], wire, op["label"])
+                    t4 = time.monotonic()
+                    # the driver-input wait is attributed to the actor's
+                    # first op (the read happens once per step, loop-level)
+                    instr.record(i, op, steps,
+                                 (t2 - t1) + (in_wait if i == 0 else 0.0),
+                                 t3 - t2, t4 - t3, op_ctx)
+                    t1 = t4
             steps += 1
     except ChannelClosed:
         return {"steps": steps, "status": "closed"}
@@ -277,7 +480,8 @@ class ChannelExecutor:
 
     def __init__(self, worker, plans: dict, order: list, in_chans: list,
                  out_chans: list, all_chans: list, *, max_inflight: int,
-                 multi: bool):
+                 multi: bool, dag_id: str | None = None, sample: int = 0,
+                 metrics_on: bool = False, topology: list | None = None):
         self._worker = worker
         self._plans = plans
         self._order = order  # actor ids, schedule order
@@ -286,6 +490,22 @@ class ChannelExecutor:
         self._all_chans = all_chans
         self._max_inflight = max(1, int(max_inflight))
         self._multi = multi
+        self._dag_id = dag_id
+        self._sample = int(sample or 0)
+        self.topology = list(topology or ())  # channel edges, for registry
+        self._h_bp = None  # driver-side backpressure-drain phase histogram
+        self._h_bp_src = None  # (hist, tags) for series retirement
+        if metrics_on and dag_id:
+            from ray_tpu.util.metrics import Histogram, get_or_create
+
+            hist = get_or_create(
+                Histogram, "ray_tpu_dag_step_backpressure_drain_seconds",
+                "compiled-DAG driver wait draining the oldest result at "
+                "max_inflight (channel plane)",
+                boundaries=_STEP_BUCKETS, tag_keys=("dag_id", "node"))
+            tags = {"dag_id": dag_id, "node": "driver"}
+            self._h_bp = hist.bind(tags)
+            self._h_bp_src = (hist, tags)
         self._loops: dict[str, Any] = {}  # aid → loop-task ObjectRef
         self._lock = threading.Lock()
         self._submitted = 0
@@ -333,8 +553,25 @@ class ChannelExecutor:
         with self._lock:
             if self._torn:
                 raise RayChannelError("compiled DAG was torn down")
+            if self._sample and self._submitted % self._sample == 0:
+                # envelope the driver's trace context only on steps the
+                # loops will actually sample (their step counters advance
+                # in lockstep with the submission seq) and only when a
+                # trace is active; every other step rides the channel as
+                # the raw value
+                from ray_tpu.util import tracing
+
+                ctx = tracing.inject()
+                if ctx is not None:
+                    input_value = _DagInput(input_value, ctx)
             payload = ser.dumps(input_value)
             cap = min(ch.capacity for ch in self._in_chans)
+            if len(payload) > cap and type(input_value) is _DagInput:
+                # the trace envelope must never turn a fitting input into
+                # a 1-in-N failure: drop it (losing this step's trace
+                # join), keep the step
+                input_value = input_value.value
+                payload = ser.dumps(input_value)
             if len(payload) > cap:
                 # checked BEFORE any channel write: a partial input fan-out
                 # would desynchronize the actor loops
@@ -342,8 +579,13 @@ class ChannelExecutor:
                     f"DAG input is {len(payload)}B, exceeding the channel "
                     f"capacity {cap}B (raise channel_buffer_bytes at "
                     f"experimental_compile)")
+            t_bp = None
             while self._submitted - self._drained >= self._max_inflight:
+                if t_bp is None:
+                    t_bp = time.monotonic()
                 self._drain_one(deadline=None)
+            if t_bp is not None and self._h_bp is not None:
+                self._h_bp.observe(time.monotonic() - t_bp)
             for ch in self._in_chans:
                 self._write_input(ch, payload)
             seq = self._submitted
@@ -438,7 +680,12 @@ class ChannelExecutor:
     def _read_out(self, ch, deadline):
         while True:
             try:
-                return ch.read(timeout=_DRIVER_BLOCK_SLICE_S)
+                v = ch.read(timeout=_DRIVER_BLOCK_SLICE_S)
+                if type(v) is _DagInput:
+                    # a sampled step's trace envelope reached a driver
+                    # output channel; the caller wants the bare value
+                    v = v.value
+                return v
             except TimeoutError:
                 if self._torn:
                     raise RayChannelError("compiled DAG was torn down")
@@ -505,6 +752,9 @@ class ChannelExecutor:
         _release_actors([a for a in self._order if a not in still_running])
         for ch in self._all_chans:
             ch.unlink()
+        if self._h_bp_src is not None:
+            # retire this DAG's driver-side series (see _LoopInstr.retire)
+            self._h_bp_src[0].remove(self._h_bp_src[1])
         if errors:
             logger.warning(
                 "compiled DAG teardown: %d execution-loop error(s); first "
@@ -537,12 +787,13 @@ class ChannelExecutor:
 
 
 def try_build(root, schedule, *, max_inflight: int,
-              buffer_bytes: int = 1 << 20):
+              buffer_bytes: int = 1 << 20, dag_id: str | None = None):
     """Partition `schedule` into per-actor exec-loop plans and provision
     the channel plane. Returns (executor, None) on success or
     (None, fallback_reason) when the graph/topology can't ride SPSC
     same-host channels."""
     from ray_tpu._private.api import _get_worker
+    from ray_tpu._private.ray_config import RayConfig
     from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
                                       MultiOutputNode)
 
@@ -608,22 +859,31 @@ def try_build(root, schedule, *, max_inflight: int,
 
     # ---- partition into per-actor op lists + allocate per-edge channels
     all_chans: list[MutableShmChannel] = []
+    topology: list[dict] = []  # channel edges for the DAG registry
 
     def new_chan():
         ch = create_mutable_channel(buffer_bytes)
         all_chans.append(ch)
         return ch
 
+    # instrumentation knobs, stamped into every plan at compile time so
+    # the exec loops inherit the DRIVER's config (no worker env plumbing)
+    cfg = RayConfig.instance()
+    metrics_on = bool(getattr(cfg, "dag_metrics", True))
+    sample = max(0, int(getattr(cfg, "dag_span_sample_every", 0)))
+
     try:
         plans: dict[str, dict] = {
-            aid: {"ops": [], "input": None, "needs_input": False}
+            aid: {"ops": [], "input": None, "needs_input": False,
+                  "dag_id": dag_id, "metrics": metrics_on, "sample": sample}
             for aid in aids}
         node_loc: dict[int, tuple[str, int]] = {}  # id(node) → (aid, reg)
         for node in actor_nodes:
             aid = node._method._actor_id
             plan = plans[aid]
+            label = f"{node._method._method_name}@actor:{aid[:8]}"
 
-            def enc(a, aid=aid, plan=plan):
+            def enc(a, aid=aid, plan=plan, label=label):
                 if isinstance(a, InputNode):
                     plan["needs_input"] = True
                     return ("input",)
@@ -635,6 +895,9 @@ def try_build(root, schedule, *, max_inflight: int,
                     # can't be read twice per step
                     ch = new_chan()
                     plans[p_aid]["ops"][p_reg]["out"].append(ch)
+                    topology.append(
+                        {"from": plans[p_aid]["ops"][p_reg]["label"],
+                         "to": label})
                     return ("chan", ch)
                 return ("const", a)
 
@@ -643,8 +906,7 @@ def try_build(root, schedule, *, max_inflight: int,
                   "kwargs": {k: enc(v)
                              for k, v in node._bound_kwargs.items()},
                   "out": [],
-                  "label": (f"{node._method._method_name}"
-                            f"@actor:{aid[:8]}")}
+                  "label": label}
             plan["ops"].append(op)
             node_loc[id(node)] = (aid, len(plan["ops"]) - 1)
 
@@ -665,6 +927,8 @@ def try_build(root, schedule, *, max_inflight: int,
                 ch = new_chan()
                 plan["input"] = ch
                 in_chans.append(ch)
+                topology.append({"from": "driver",
+                                 "to": f"loop@actor:{aid[:8]}"})
 
         # driver output channels, one per output occurrence (root order)
         out_chans: list[MutableShmChannel] = []
@@ -673,10 +937,13 @@ def try_build(root, schedule, *, max_inflight: int,
             ch = new_chan()
             plans[aid]["ops"][reg]["out"].append(ch)
             out_chans.append(ch)
+            topology.append({"from": plans[aid]["ops"][reg]["label"],
+                             "to": "driver"})
 
         executor = ChannelExecutor(
             worker, plans, aids, in_chans, out_chans, all_chans,
-            max_inflight=max_inflight, multi=multi)
+            max_inflight=max_inflight, multi=multi, dag_id=dag_id,
+            sample=sample, metrics_on=metrics_on, topology=topology)
         executor._provision()
         return executor, None
     except Exception as e:  # noqa: BLE001 — release shm, then fall back

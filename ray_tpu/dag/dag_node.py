@@ -184,10 +184,13 @@ class CompiledDAG:
     def __init__(self, root: DAGNode, *, max_inflight_executions: int = 10,
                  enable_channel_execution: bool = True,
                  channel_buffer_bytes: int = 1 << 20):
+        import uuid
+
         self._root = root
         self._max_inflight = max(1, int(max_inflight_executions))
         self._inflight: list[DAGFuture] = []
         self._torn = False
+        self._dag_id = f"dag-{uuid.uuid4().hex[:12]}"
         # static schedule, computed once: topological, with per-actor op
         # lists so repeated executions skip traversal entirely
         # (reference: _build_execution_schedule, compiled_dag_node.py:2002)
@@ -200,9 +203,18 @@ class CompiledDAG:
 
             self._channel, self._fallback_reason = try_build(
                 root, self._schedule, max_inflight=self._max_inflight,
-                buffer_bytes=channel_buffer_bytes)
+                buffer_bytes=channel_buffer_bytes, dag_id=self._dag_id)
         else:
             self._fallback_reason = "channel execution disabled by caller"
+        # observability: every compile registers its metadata in the GCS
+        # DAG table (state API `list_compiled_dags`, dashboard /api/dags,
+        # `ray_tpu dag` CLI); teardown deregisters, driver death retires
+        self._registered = False
+        self._register()
+
+    @property
+    def dag_id(self) -> str:
+        return self._dag_id
 
     @property
     def uses_channels(self) -> bool:
@@ -211,6 +223,67 @@ class CompiledDAG:
     @property
     def fallback_reason(self) -> str | None:
         return self._fallback_reason
+
+    # ------------------------------------------------------------- registry
+
+    def _registry_record(self) -> dict:
+        import time
+
+        nodes = []
+        for i, n in enumerate(self._schedule):
+            label = ""
+            if isinstance(n, FunctionNode):
+                label = getattr(n._fn, "__name__", "fn")
+            elif isinstance(n, ClassMethodNode):
+                label = (f"{getattr(n._method, '_method_name', '?')}"
+                         f"@actor:{getattr(n._method, '_actor_id', '?')[:8]}")
+            nodes.append({"index": i, "type": type(n).__name__,
+                          "label": label,
+                          "deps": [self._schedule.index(u)
+                                   for u in n._upstream()]})
+        actors: list[str] = []
+        for n in self._schedule:
+            if isinstance(n, ClassMethodNode):
+                aid = getattr(n._method, "_actor_id", None)
+                if aid and aid not in actors:
+                    actors.append(aid)
+        ch = self._channel
+        return {
+            "dag_id": self._dag_id,
+            "plane": "channels" if ch is not None else "submit",
+            "fallback_reason": self._fallback_reason,
+            "nodes": nodes,
+            "actors": actors,
+            "channels": len(ch._all_chans) if ch is not None else 0,
+            "topology": list(ch.topology) if ch is not None else [],
+            "max_inflight": self._max_inflight,
+            "sample_every": getattr(ch, "_sample", 0) if ch is not None else 0,
+            "created_at": time.time(),
+        }
+
+    def _register(self) -> None:
+        try:
+            from ray_tpu._private.api import _get_worker
+
+            w = _get_worker()
+            if getattr(w, "rpc", None) is None:
+                return  # local mode: no GCS to register with
+            w.rpc({"type": "dag_register", "dag": self._registry_record()})
+            self._registered = True
+        except Exception:  # noqa: BLE001 — observability must not break compile
+            pass
+
+    def _deregister(self) -> None:
+        if not self._registered:
+            return
+        self._registered = False
+        try:
+            from ray_tpu._private.api import _get_worker
+
+            _get_worker().rpc({"type": "dag_deregister",
+                               "dag_id": self._dag_id})
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
 
     def _submit_once(self, input_value):
         values: dict[int, Any] = {}
@@ -287,6 +360,7 @@ class CompiledDAG:
         if self._torn:
             return
         self._torn = True
+        self._deregister()
         errors: list[Exception] = []
         if self._channel is not None:
             errors.extend(e for _aid, e in

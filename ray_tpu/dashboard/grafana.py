@@ -73,17 +73,17 @@ SERVE_DASHBOARD = DashboardConfig(
     uid="raytpuserve",
     panels=[
         Panel("Requests per second", "reqps",
-              [("rate(serve_requests_total[1m])", "{{deployment}}")],
+              [("rate(ray_tpu_serve_requests_total[1m])", "{{deployment}}")],
               stack=True),
         Panel("Request latency p50/p95", "ms",
-              [("histogram_quantile(0.5, rate(serve_request_latency_ms_bucket[5m]))", "p50"),
-               ("histogram_quantile(0.95, rate(serve_request_latency_ms_bucket[5m]))", "p95")]),
+              [("histogram_quantile(0.5, rate(ray_tpu_serve_request_latency_ms_bucket[5m]))", "p50"),
+               ("histogram_quantile(0.95, rate(ray_tpu_serve_request_latency_ms_bucket[5m]))", "p95")]),
         Panel("Requests by replica", "reqps",
-              [("rate(serve_requests_total[1m])", "{{replica}}")],
+              [("rate(ray_tpu_serve_requests_total[1m])", "{{replica}}")],
               stack=True),
         Panel("Latency mean", "ms",
-              [("rate(serve_request_latency_ms_sum[5m]) / "
-                "rate(serve_request_latency_ms_count[5m])", "mean")]),
+              [("rate(ray_tpu_serve_request_latency_ms_sum[5m]) / "
+                "rate(ray_tpu_serve_request_latency_ms_count[5m])", "mean")]),
     ])
 
 DATA_DASHBOARD = DashboardConfig(
@@ -91,11 +91,11 @@ DATA_DASHBOARD = DashboardConfig(
     uid="raytpudata",
     panels=[
         Panel("Bytes in flight", "bytes",
-              [("data_bytes_in_flight", "{{pipeline}}")], stack=True),
+              [("ray_tpu_data_bytes_in_flight", "{{pipeline}}")], stack=True),
         Panel("Items queued", "short",
-              [("data_blocks_queued", "{{pipeline}}")], stack=True),
+              [("ray_tpu_data_blocks_queued", "{{pipeline}}")], stack=True),
         Panel("Backpressure deferrals", "ops",
-              [("rate(data_backpressure_waits[1m])", "{{pipeline}}")]),
+              [("rate(ray_tpu_data_backpressure_waits[1m])", "{{pipeline}}")]),
         Panel("Tasks finished (cluster)", "ops",
               [('rate(ray_tpu_tasks_total{state="finished"}[1m])',
                 "finished/s")]),

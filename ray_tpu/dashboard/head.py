@@ -10,6 +10,7 @@ Endpoints:
   GET /                      — HTML overview
   GET /api/cluster           — cluster_state JSON
   GET /api/nodes|actors|placement_groups|jobs|tasks
+  GET /api/dags              — compiled-DAG registry (state API twin)
   GET /api/logs              — list log files; /api/logs/<name>?tail=N
   GET /api/timeline          — chrome://tracing JSON of task events
   GET /metrics               — Prometheus text format
@@ -107,12 +108,18 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/tasks":
                 self._json(gcs.rpc({"type": "task_events"}).get("events", []))
             elif path == "/api/timeline":
-                from ray_tpu._private.task_events import (normalize_events,
-                                                          to_chrome_trace)
+                from ray_tpu._private.task_events import (
+                    fetch_worker_names, normalize_events, to_chrome_trace)
 
                 evs = gcs.rpc({"type": "task_events"}).get("events", [])
+                # actor-worker rows labeled with class/name, not bare pid
                 self._send(to_chrome_trace(
-                    normalize_events(list(evs))).encode())
+                    normalize_events(list(evs)),
+                    fetch_worker_names(gcs.rpc)).encode())
+            elif path == "/api/dags":
+                # compiled-DAG registry (registered at experimental_compile,
+                # dropped at teardown/driver death)
+                self._json(gcs.rpc({"type": "dag_list"}).get("dags", []))
             elif path == "/api/jobs":
                 keys = gcs.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
                 jobs = []

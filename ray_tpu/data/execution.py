@@ -795,13 +795,13 @@ def _pipeline_metrics() -> tuple:
         from ray_tpu.util import metrics as _met
 
         _pipeline_metric_cache = (
-            _met.Gauge("data_bytes_in_flight",
+            _met.Gauge("ray_tpu_data_bytes_in_flight",
                        "queued bytes across executor stages",
                        tag_keys=("pipeline",)),
-            _met.Gauge("data_blocks_queued",
+            _met.Gauge("ray_tpu_data_blocks_queued",
                        "queued items across executor stages",
                        tag_keys=("pipeline",)),
-            _met.Counter("data_backpressure_waits",
+            _met.Counter("ray_tpu_data_backpressure_waits",
                          "dispatches deferred by queue/byte backpressure",
                          tag_keys=("pipeline",)),
         )

@@ -259,18 +259,69 @@ def cmd_monitor(args):
 
 def cmd_timeline(args):
     """Export collected task events as a chrome://tracing JSON file
-    (reference capability: `ray timeline`, GcsTaskManager + profile events)."""
-    from ray_tpu._private.task_events import export_chrome_trace
+    (reference capability: `ray timeline`, GcsTaskManager + profile events).
+    Rows for actor workers are labeled with the actor's class/name from the
+    GCS actor table; compiled-DAG step spans group under their DAG id."""
+    from ray_tpu._private.task_events import (export_chrome_trace,
+                                              fetch_worker_names)
 
     sd = _pick_session(args)
     c = GcsClient(sd)
     try:
         events = c.rpc({"type": "task_events"}).get("events", [])
+        names = fetch_worker_names(c.rpc)
     finally:
         c.close()
     out = args.output or "timeline.json"
-    export_chrome_trace(events, out)
+    export_chrome_trace(events, out, names)
     print(f"wrote {len(events)} events to {out} (open in chrome://tracing)")
+
+
+def cmd_dag(args):
+    """Compiled-DAG registry: `ray_tpu dag list` shows every live compiled
+    DAG (plane, actors, channels, fallback reason); `ray_tpu dag show <id>`
+    prints one DAG's full record plus per-node step-phase timing aggregated
+    from the always-on ray_tpu_dag_step_* histograms."""
+    from ray_tpu.util.state import summarize_dag_metrics
+
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        dags = c.rpc({"type": "dag_list"}).get("dags", [])
+        if args.action == "list":
+            if args.json:
+                print(json.dumps(dags, indent=1, default=str))
+                return
+            print(f"{'dag_id':<18} {'plane':<9} {'actors':>6} "
+                  f"{'channels':>8}  fallback_reason")
+            for d in sorted(dags, key=lambda d: d.get("created_at", 0)):
+                print(f"{d['dag_id']:<18} {d.get('plane', '?'):<9} "
+                      f"{len(d.get('actors', [])):>6} "
+                      f"{d.get('channels', 0):>8}  "
+                      f"{d.get('fallback_reason') or '-'}")
+            return
+        # show: an exact id always wins; a prefix must be unambiguous
+        matches = [d for d in dags if d["dag_id"] == args.dag_id]
+        if not matches and args.dag_id:
+            matches = [d for d in dags
+                       if d["dag_id"].startswith(args.dag_id)]
+        if args.dag_id is None or not matches:
+            print(f"no compiled DAG matching {args.dag_id!r} "
+                  f"(have: {', '.join(d['dag_id'] for d in dags) or 'none'})",
+                  file=sys.stderr)
+            sys.exit(1)
+        if len(matches) > 1:
+            print(f"ambiguous DAG prefix {args.dag_id!r}: "
+                  f"{', '.join(d['dag_id'] for d in matches)}",
+                  file=sys.stderr)
+            sys.exit(1)
+        rec = matches[0]
+        snap = c.rpc({"type": "metrics_snapshot"}).get("metrics", {})
+    finally:
+        c.close()
+    print(json.dumps({"dag": rec,
+                      "steps": summarize_dag_metrics(snap, rec["dag_id"])},
+                     indent=1, default=str))
 
 
 def cmd_dashboard(args):
@@ -469,6 +520,14 @@ def main(argv=None):
     sp = sub.add_parser("timeline", help="export task timeline (chrome trace)")
     sp.add_argument("-o", "--output", help="output path (default timeline.json)")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("dag", help="compiled-DAG registry: list / show")
+    sp.add_argument("action", choices=["list", "show"])
+    sp.add_argument("dag_id", nargs="?",
+                    help="show: dag id (or unique prefix)")
+    sp.add_argument("--json", action="store_true",
+                    help="list: raw JSON instead of the table")
+    sp.set_defaults(fn=cmd_dag)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--host", default="127.0.0.1")

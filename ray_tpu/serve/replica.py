@@ -77,10 +77,10 @@ class ReplicaActor:
 
         tags = {"deployment": deployment_name, "replica": replica_tag}
         self._m_requests = _met.Counter(
-            "serve_requests_total", "serve requests handled",
+            "ray_tpu_serve_requests_total", "serve requests handled",
             tag_keys=("deployment", "replica")).set_default_tags(tags)
         self._m_latency = _met.Histogram(
-            "serve_request_latency_ms", "serve request latency (ms)",
+            "ray_tpu_serve_request_latency_ms", "serve request latency (ms)",
             boundaries=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000],
             tag_keys=("deployment", "replica")).set_default_tags(tags)
         if user_config is not None:

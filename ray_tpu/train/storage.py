@@ -473,6 +473,43 @@ def get_storage_backend(uri: str | None) -> tuple[StorageBackend, str]:
     return factory(uri)
 
 
+# ------------------------------------------------------------------ metrics
+
+
+def _backend_tag(backend: StorageBackend) -> str:
+    return ("local" if backend.is_local
+            else type(backend).__name__.replace("Backend", "").lower())
+
+
+def _observe_transfer(backend: StorageBackend, op: str,
+                      stats: "PersistStats", commit_s: float | None = None):
+    """Record one persist/restore's byte/retry counters (and, for
+    persists, the end-to-end commit latency). Fetched registry-aware and
+    fully fire-and-forget — metrics must never fail a checkpoint."""
+    try:
+        from ray_tpu.util.metrics import Counter, Histogram, get_or_create
+
+        tags = {"backend": _backend_tag(backend)}
+        get_or_create(
+            Counter, f"ray_tpu_storage_{op}_bytes_total",
+            f"checkpoint bytes {op}ed through storage backends",
+            tag_keys=("backend",)).inc(stats.bytes, tags=tags)
+        if stats.retries:
+            get_or_create(
+                Counter, "ray_tpu_storage_retries_total",
+                "extra storage-op attempts beyond the first",
+                tag_keys=("backend", "op")).inc(
+                    stats.retries, tags={**tags, "op": op})
+        if commit_s is not None:
+            get_or_create(
+                Histogram, "ray_tpu_storage_commit_seconds",
+                "two-phase checkpoint commit latency (upload → marker)",
+                boundaries=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0),
+                tag_keys=("backend",)).observe(commit_s, tags=tags)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # --------------------------------------------------- two-phase commit layer
 
 
@@ -529,6 +566,7 @@ def persist_directory(backend: StorageBackend, local_dir: str,
     prefix only once the marker exists and the manifest validates."""
     retry = retry or DEFAULT_RETRY
     stats = PersistStats()
+    t0 = time.monotonic()
     files = scan_local_files(local_dir)
     # phase 0: a crashed prior attempt at this prefix may have left torn
     # objects; the manifest only vouches for what THIS commit uploads
@@ -542,6 +580,8 @@ def persist_directory(backend: StorageBackend, local_dir: str,
         stats.retries += extra
     stats.retries += write_manifest_and_commit(backend, dest_prefix, files,
                                                meta, retry=retry)
+    _observe_transfer(backend, "upload", stats,
+                      commit_s=time.monotonic() - t0)
     return stats
 
 
@@ -646,6 +686,7 @@ def restore_directory(backend: StorageBackend, src_prefix: str, dest_dir: str,
             os.path.join(dest_dir, rel.replace("/", os.sep)),
             retry=retry, op=f"download {rel}")
         stats.retries += extra
+    _observe_transfer(backend, "download", stats)
     return stats
 
 

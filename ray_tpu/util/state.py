@@ -22,20 +22,33 @@ def _worker():
     return _get_worker()
 
 
-def _apply(rows: list, filters, limit: int) -> list:
-    for key, op, want in (filters or ()):
+def validate_filters(filters) -> None:
+    for _key, op, _want in (filters or ()):
         if op not in ("=", "!="):
             raise ValueError(f"unsupported filter op {op!r} (use '=' '!=')")
 
-        def keep(r, key=key, op=op, want=want):
-            got = r.get(key)
-            eq = (str(got) == str(want)
-                  or (isinstance(want, str) and "*" in want
-                      and fnmatch.fnmatch(str(got), want)))
-            return eq if op == "=" else not eq
 
-        rows = [r for r in rows if keep(r)]
-    return rows[:limit]
+def matches_filters(row: dict, filters) -> bool:
+    """One row against the reference-style predicate tuples. Shared by the
+    client-side `_apply` and the GCS's server-side list_objects filter, so
+    the two planes can never disagree on semantics."""
+    for key, op, want in (filters or ()):
+        got = row.get(key)
+        eq = (str(got) == str(want)
+              or (isinstance(want, str) and "*" in want
+                  and fnmatch.fnmatch(str(got), want)))
+        if eq if op == "!=" else not eq:
+            return False
+    return True
+
+
+def _apply(rows: list, filters, limit: int) -> list:
+    validate_filters(filters)
+    if filters:
+        rows = [r for r in rows if matches_filters(r, filters)]
+    # limit <= 0 means unbounded, matching the GCS list handlers — slicing
+    # to [:0] would ship the whole table only to return nothing
+    return rows[:limit] if limit > 0 else rows
 
 
 def list_nodes(*, filters: Optional[List[Tuple]] = None,
@@ -73,8 +86,16 @@ def list_tasks(*, filters: Optional[List[Tuple]] = None,
 
 def list_objects(*, filters: Optional[List[Tuple]] = None,
                  limit: int = 1000) -> list:
-    rows = _worker().rpc({"type": "list_objects",
-                          "limit": limit}).get("objects", [])
+    # filters are pushed SERVER-side (the GCS applies matches_filters
+    # before its limit cut): applying the limit before the filters would
+    # return fewer than `limit` matching rows while more matches exist,
+    # and fetching the whole table instead would marshal every object row
+    # under the GCS lock
+    validate_filters(filters)
+    rows = _worker().rpc({
+        "type": "list_objects", "limit": limit,
+        "filters": [list(f) for f in (filters or ())],
+    }).get("objects", [])
     return _apply(rows, filters, limit)
 
 
@@ -124,6 +145,52 @@ def summarize_tasks() -> dict:
         _worker().rpc({"type": "task_events"}).get("events", []))
 
 
+def list_compiled_dags(*, filters: Optional[List[Tuple]] = None,
+                       limit: int = 1000) -> list:
+    """Compiled DAGs currently registered in the GCS (registered at
+    `experimental_compile`, deregistered at `teardown()` / driver death).
+    Rows carry plane ("channels"/"submit"), fallback_reason, nodes, actors,
+    and channel topology."""
+    rows = _worker().rpc({"type": "dag_list"}).get("dags", [])
+    return _apply(rows, filters, limit)
+
+
+def summarize_dag_metrics(snapshot: dict, dag_id: str) -> dict:
+    """Per-node step-phase stats for one DAG, from a GCS metrics snapshot
+    ({name: {kind, series: {source: [(tags, hist_state)]}}}). Pure — shared
+    by the in-process API below and the out-of-process `ray_tpu dag` CLI."""
+    out: dict = {}
+    for name, rec in snapshot.items():
+        if not name.startswith("ray_tpu_dag_step_") or rec.get(
+                "kind") != "histogram":
+            continue
+        phase = name[len("ray_tpu_dag_step_"):].rsplit("_seconds", 1)[0]
+        for series in (rec.get("series") or {}).values():
+            for tags, st in series:
+                td = {k: v for k, v in (tuple(t) for t in tags)}
+                if td.get("dag_id") != dag_id:
+                    continue
+                node = out.setdefault(td.get("node", "?"), {})
+                agg = node.setdefault(phase, {"count": 0, "total_s": 0.0})
+                agg["count"] += st.get("count", 0)
+                agg["total_s"] += st.get("sum", 0.0)
+    for node in out.values():
+        for agg in node.values():
+            agg["mean_s"] = round(
+                agg["total_s"] / agg["count"], 9) if agg["count"] else 0.0
+            agg["total_s"] = round(agg["total_s"], 6)
+    return out
+
+
+def summarize_dag(dag_id: str) -> Optional[dict]:
+    """One DAG's registry record plus per-node step-phase timing aggregated
+    from the always-on `ray_tpu_dag_step_*` histograms."""
+    for rec in list_compiled_dags(filters=[("dag_id", "=", dag_id)], limit=1):
+        snap = _worker().rpc({"type": "metrics_snapshot"}).get("metrics", {})
+        return {"dag": rec, "steps": summarize_dag_metrics(snap, dag_id)}
+    return None
+
+
 def get_actor(actor_id: str) -> Optional[dict]:
     for row in list_actors(filters=[("actor_id", "=", actor_id)], limit=1):
         return row
@@ -137,7 +204,8 @@ def get_node(node_id: str) -> Optional[dict]:
 
 
 __all__ = [
-    "get_actor", "get_node", "list_actors", "list_jobs", "list_nodes",
-    "list_objects", "list_placement_groups", "list_tasks", "list_workers",
+    "get_actor", "get_node", "list_actors", "list_compiled_dags",
+    "list_jobs", "list_nodes", "list_objects", "list_placement_groups",
+    "list_tasks", "list_workers", "summarize_dag", "summarize_dag_metrics",
     "summarize_task_events", "summarize_tasks",
 ]

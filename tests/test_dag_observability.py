@@ -1,0 +1,476 @@
+"""Observability for the dark planes: compiled-DAG instrumentation + the
+DAG registry, autoscaler metrics, storage metrics, and the satellite fixes
+(deterministic gauge merging, filtered list_objects, timeline labels).
+
+Tentpole contract (ISSUE 4): the channel exec loop's always-on path is two
+monotonic reads + one pre-bound histogram observe per phase; a full
+timeline span rides the existing task_events buffer every Nth step
+(RayConfig.dag_span_sample_every, 0 = off) and joins the caller's trace
+when one is active; `experimental_compile` registers DAG metadata in a GCS
+table surfaced via `list_compiled_dags()`, `/api/dags`, and `ray_tpu dag`.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+from ray_tpu._private import task_events as te
+from ray_tpu.util import metrics as met
+
+N_STEPS = 12
+
+
+def _series(name):
+    for m in met.snapshot():
+        if m["name"] == name:
+            return m["series"]
+    return []
+
+
+# ---------------------------------------------------------------- unit level
+
+
+def _run_loop(plan, in_ch, out_ch, n_steps, inputs=None):
+    """Drive actor_exec_loop in-process: write n inputs, read n outputs,
+    close, join. Returns the outputs."""
+    from ray_tpu.dag.channel_execution import actor_exec_loop
+
+    class Inst:
+        def work(self, x):
+            return x + 1
+
+    done = {}
+    t = threading.Thread(
+        target=lambda: done.update(actor_exec_loop(Inst(), plan)),
+        daemon=True)
+    t.start()
+    outs = []
+    try:
+        for i in range(n_steps):
+            in_ch.write(inputs[i] if inputs else i, timeout=30)
+            outs.append(out_ch.read(timeout=30))
+    finally:
+        for ch in (in_ch, out_ch):
+            ch.close()
+        t.join(timeout=30)
+        assert not t.is_alive(), "exec loop failed to exit on close"
+        for ch in (in_ch, out_ch):
+            ch.unlink()
+    assert done.get("status") == "closed"
+    return outs
+
+
+def _mk_plan(in_ch, out_ch, **instr):
+    plan = {"ops": [{"method": "work", "args": [("input",)], "kwargs": {},
+                     "out": [out_ch], "label": "work@actor:unittest"}],
+            "input": in_ch}
+    plan.update(instr)
+    return plan
+
+
+def _chans():
+    from ray_tpu.experimental.channel.mutable_shm import \
+        create_mutable_channel
+
+    return create_mutable_channel(65536), create_mutable_channel(65536)
+
+
+def test_exec_loop_zero_emit_when_disabled(monkeypatch):
+    """The zero-emit guard: with metrics AND sampling off the hot path
+    makes no task_events.emit call (and records no histogram series)."""
+    emits = []
+    monkeypatch.setattr(te, "emit", lambda *a, **k: emits.append(a))
+    met.clear_registry()
+    in_ch, out_ch = _chans()
+    outs = _run_loop(_mk_plan(in_ch, out_ch, dag_id="dag-unit0",
+                              metrics=False, sample=0), in_ch, out_ch, 5)
+    assert outs == [1, 2, 3, 4, 5]
+    assert emits == [], "disabled instrumentation must emit nothing"
+    assert not _series("ray_tpu_dag_step_compute_seconds")
+    met.clear_registry()
+
+
+def test_exec_loop_histograms_always_on_spans_sampled(monkeypatch):
+    emits = []
+    monkeypatch.setattr(
+        te, "emit", lambda event, **kw: emits.append({"event": event, **kw}))
+    met.clear_registry()
+    in_ch, out_ch = _chans()
+    _run_loop(_mk_plan(in_ch, out_ch, dag_id="dag-unit1", metrics=True,
+                       sample=3), in_ch, out_ch, 7)
+    # sampled steps 0, 3, 6 → 3 spans, each with the phase breakdown
+    assert [e["event"] for e in emits] == ["dag:step"] * 3
+    assert emits[0]["dag_id"] == "dag-unit1"
+    assert emits[0]["node"] == "work@actor:unittest"
+    assert {"input_wait_s", "compute_s", "output_write_s"} <= set(emits[0])
+    assert [e["seq"] for e in emits] == [0, 3, 6]
+    # histograms observed every step while the loop ran, then retired on
+    # exit (dag_id is a short-lived labelset — no dead series after close)
+    assert not _series("ray_tpu_dag_step_compute_seconds")
+    met.clear_registry()
+
+
+def test_exec_loop_sampled_spans_join_caller_trace(monkeypatch):
+    """A _DagInput envelope carrying the driver's trace context turns the
+    sampled span into a trace:span that assembles under the caller's
+    trace."""
+    from ray_tpu.dag.channel_execution import _DagInput
+
+    emits = []
+    monkeypatch.setattr(
+        te, "emit", lambda event, **kw: emits.append({"event": event, **kw}))
+    met.clear_registry()
+    ctx = {"trace_id": "ab" * 16, "parent_span_id": "cd" * 8}
+    in_ch, out_ch = _chans()
+    outs = _run_loop(_mk_plan(in_ch, out_ch, dag_id="dag-unit2",
+                              metrics=False, sample=1),
+                     in_ch, out_ch, 3,
+                     inputs=[_DagInput(i, ctx) for i in range(3)])
+    # envelope unwrapped before user code, and RE-WRAPPED on the out-edge
+    # (sampled step): downstream stages receive the trace context in-band
+    assert all(type(o) is _DagInput for o in outs)
+    assert [o.value for o in outs] == [1, 2, 3]
+    assert all(o.trace_ctx == ctx for o in outs)
+    assert [e["event"] for e in emits] == ["trace:span"] * 3
+    assert all(e["trace_id"] == ctx["trace_id"] for e in emits)
+    assert all(e["parent_span_id"] == ctx["parent_span_id"] for e in emits)
+    assert all(e["span_kind"] == "dag_step" for e in emits)
+    # the tree assembler accepts the spans like any other child span
+    from ray_tpu.util import tracing
+
+    tree = tracing.assemble(
+        [dict(e, name="work") for e in emits], ctx["trace_id"])
+    assert tree and len(tree["root"]["children"]) == 3
+    met.clear_registry()
+
+
+def test_chrome_trace_groups_dag_rows():
+    events = [
+        {"event": "dag:step", "name": "work@actor:aaaa", "start": 1.0,
+         "end": 1.001, "dag_id": "dag-xyz", "node": "work@actor:aaaa",
+         "pid": 41, "worker_id": "w1"},
+        {"event": "task:execute", "name": "other", "start": 1.0, "end": 1.1,
+         "pid": 42, "worker_id": "w2"},
+    ]
+    rows = json.loads(te.to_chrome_trace(events))["traceEvents"]
+    assert rows[0]["pid"] == "dag:dag-xyz"
+    assert rows[0]["tid"] == "work@actor:aaaa"
+    assert rows[1]["pid"] == "w2"
+
+
+def test_prometheus_gauge_merge_newest_ts_wins():
+    """Gauge merging across sources is deterministic: the series with the
+    newest snapshot ts wins regardless of source-dict iteration order."""
+    for order in (("w_old", "w_new"), ("w_new", "w_old")):
+        series = {"w_old": [[[], 1.0]], "w_new": [[[], 2.0]]}
+        agg = {"ray_tpu_g": {
+            "kind": "gauge", "description": "",
+            "series": {s: series[s] for s in order},
+            "ts": {"w_old": 100.0, "w_new": 200.0}}}
+        assert "ray_tpu_g 2.0" in met.to_prometheus(agg)
+    # ts tie → larger source id wins (still deterministic)
+    agg = {"ray_tpu_g": {"kind": "gauge", "description": "",
+                         "series": {"b": [[[], 5.0]], "a": [[[], 4.0]]},
+                         "ts": {"a": 100.0, "b": 100.0}}}
+    assert "ray_tpu_g 5.0" in met.to_prometheus(agg)
+
+
+def test_prometheus_histogram_layout_majority_wins():
+    """Rolling-restart scenario: a histogram's bucket layout changes; the
+    majority layout wins even when one stale source keeps reporting with
+    the newest snapshot ts."""
+    new = {"buckets": [1, 0, 0], "sum": 0.1, "count": 1,
+           "boundaries": [0.1, 1.0]}
+    old = {"buckets": [5, 0], "sum": 2.5, "count": 5, "boundaries": [0.5]}
+    agg = {"ray_tpu_lat": {
+        "kind": "histogram", "description": "",
+        "series": {"w1": [[[], dict(new)]], "w2": [[[], dict(new)]],
+                   "w_stale": [[[], dict(old)]]},
+        # the stale old-layout source reports most recently
+        "ts": {"w1": 100.0, "w2": 110.0, "w_stale": 200.0}}}
+    text = met.to_prometheus(agg)
+    assert "ray_tpu_lat_count 2" in text          # both new-layout sources
+    assert 'le="0.1"' in text and 'le="0.5"' not in text
+
+
+def test_storage_transfer_metrics(tmp_path):
+    from ray_tpu.train import storage as st
+
+    met.clear_registry()
+    src = tmp_path / "ckpt"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"x" * 2048)
+    (src / "meta.json").write_bytes(b"{}")
+    backend, prefix = st.get_storage_backend(
+        f"mock://obsbucket/exp?fail_rate=0.3&seed=3")
+    stats = st.persist_directory(backend, str(src),
+                                 st.join_path(prefix, "checkpoint_0/rank_0"))
+    up = _series("ray_tpu_storage_upload_bytes_total")
+    assert up and up[0][1] == stats.bytes == 2050
+    assert dict(map(tuple, up[0][0]))["backend"] == "mockremote"
+    commit = _series("ray_tpu_storage_commit_seconds")
+    assert commit and commit[0][1]["count"] == 1
+    if stats.retries:  # deterministic under the seeded RNG
+        rt = _series("ray_tpu_storage_retries_total")
+        assert rt and sum(v for _t, v in rt) == stats.retries
+    st.restore_directory(backend, st.join_path(prefix, "checkpoint_0/rank_0"),
+                         str(tmp_path / "restored"))
+    down = _series("ray_tpu_storage_download_bytes_total")
+    assert down and down[0][1] == 2050
+    met.clear_registry()
+
+
+def test_autoscaler_transition_and_reconcile_metrics(tmp_path):
+    """Transition counters + reconcile histogram + pending/running gauges,
+    riding the FakeFileNodeProvider (file-backed cloud, no processes)."""
+    from ray_tpu.autoscaler import (Autoscaler, FakeFileNodeProvider,
+                                    NodeType)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_workers=1, max_workers=4)
+    met.clear_registry()
+    try:
+        provider = FakeFileNodeProvider(str(tmp_path / "cloud.json"))
+        a = Autoscaler(f"unix:{_api._node.socket_path}", provider,
+                       [NodeType("warm", {"CPU": 2}, min_nodes=1,
+                                 max_nodes=2)])
+        try:
+            a.reconcile_once()
+            trans = {tuple(sorted(map(tuple, tags))): v
+                     for tags, v in _series(
+                         "ray_tpu_autoscaler_instance_transitions_total")}
+            key_new = (("from_state", "(new)"), ("node_type", "warm"),
+                       ("to_state", "REQUESTED"))
+            key_alloc = (("from_state", "REQUESTED"), ("node_type", "warm"),
+                         ("to_state", "ALLOCATED"))
+            assert trans.get(key_new) == 1.0, trans
+            assert trans.get(key_alloc) == 1.0, trans
+            rec = _series("ray_tpu_autoscaler_reconcile_seconds")
+            assert rec and rec[0][1]["count"] >= 1
+            pend = {dict(map(tuple, tags))["node_type"]: v
+                    for tags, v in _series(
+                        "ray_tpu_autoscaler_pending_nodes")}
+            assert pend.get("warm") == 1.0  # ALLOCATED, never joins
+        finally:
+            a.stop()
+    finally:
+        met.clear_registry()
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- cluster level
+
+
+@pytest.fixture
+def obs_cluster(monkeypatch):
+    from ray_tpu._private.ray_config import RayConfig
+
+    monkeypatch.setenv("RAY_TPU_DAG_SPAN_SAMPLE_EVERY", "2")
+    monkeypatch.setenv("RAY_TPU_ENABLE_TRACING", "1")
+    RayConfig.reset()
+    met.clear_registry()
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=8)
+    yield
+    ray_tpu.shutdown()
+    met.clear_registry()
+    RayConfig.reset()
+
+
+@ray_tpu.remote
+class ObsStage:
+    def work(self, x):
+        return x + 1
+
+
+def _poll(fn, deadline_s=25.0, every=0.3):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(every)
+    return fn()
+
+
+@pytest.mark.dag
+def test_dag_registry_metrics_timeline_end_to_end(obs_cluster):
+    """Acceptance: a compiled run with sampling on yields per-step spans
+    grouped under the DAG id in the timeline, non-zero ray_tpu_dag_step_*
+    histograms on /metrics, and a registry entry that teardown retires."""
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import state as st
+
+    actors = [ObsStage.remote() for _ in range(2)]
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.work.bind(node)
+    compiled = node.experimental_compile(max_inflight_executions=2)
+    assert compiled.uses_channels, compiled.fallback_reason
+    dag_id = compiled.dag_id
+
+    # registry: listed while live, with plane + topology
+    rows = st.list_compiled_dags(filters=[("dag_id", "=", dag_id)])
+    assert rows and rows[0]["plane"] == "channels"
+    assert rows[0]["actors"] and rows[0]["channels"] >= 3
+    assert any(e["to"] == "driver" for e in rows[0]["topology"])
+
+    for i in range(N_STEPS):
+        assert compiled.execute(i).result(timeout=60) == i + 2
+    # a driver trace spanning some steps: sampled steps must join it (the
+    # trace context rides the input envelope, not the submit path)
+    from ray_tpu.util import tracing
+
+    with tracing.trace("dag-run") as tctx:
+        for i in range(4):
+            compiled.execute(i).result(timeout=60)
+    # saturate max_inflight so the driver-side backpressure phase records
+    for i in range(6):
+        compiled.execute(i)
+
+    # driver-side histogram is local to this process
+    bp = _series("ray_tpu_dag_step_backpressure_drain_seconds")
+    assert bp and bp[0][1]["count"] >= 1
+
+    w = _api._get_worker()
+    # worker-side spans ship on the 2s flusher cadence
+    events = _poll(lambda: [
+        e for e in w.rpc({"type": "task_events"})["events"]
+        if e.get("dag_id") == dag_id and e.get("event") == "dag:step"])
+    assert events, "no sampled dag:step spans reached the GCS"
+    assert all(e.get("node") for e in events)
+
+    # ...and so do the always-on phase histograms
+    def dag_hist():
+        snap = w.rpc({"type": "metrics_snapshot"})["metrics"]
+        rec = snap.get("ray_tpu_dag_step_compute_seconds")
+        if not rec:
+            return None
+        for series in rec["series"].values():
+            for tags, hval in series:
+                if (dict(map(tuple, tags)).get("dag_id") == dag_id
+                        and hval["count"] > 0):
+                    return snap
+        return None
+
+    snap = _poll(dag_hist)
+    assert snap, "ray_tpu_dag_step_* histograms never reached the GCS"
+
+    # sampled steps inside the driver trace joined it as dag_step spans —
+    # from EVERY stage, not just the one fed by the driver input channel
+    # (the context is forwarded downstream in-band through data channels)
+    def traced():
+        tree = tracing.get_trace(tctx["trace_id"])
+        if tree is None:
+            return None
+        nodes = {c.get("node") for c in tree["root"]["children"]
+                 if c.get("span_kind") == "dag_step"}
+        return nodes if len(nodes) >= 2 else None
+
+    traced_nodes = _poll(traced)
+    assert traced_nodes, "downstream stages never joined the caller's trace"
+
+    # summarize_dag aggregates phases per node from the snapshot
+    summary = st.summarize_dag(dag_id)
+    assert summary and summary["dag"]["dag_id"] == dag_id
+    assert any(v.get("compute", {}).get("count", 0) > 0
+               for v in summary["steps"].values()), summary
+
+    # timeline export groups the sampled steps under the DAG id
+    trace = json.loads(te.to_chrome_trace(te.normalize_events(
+        list(w.rpc({"type": "task_events"})["events"]))))
+    dag_rows = [r for r in trace["traceEvents"]
+                if r["pid"] == f"dag:{dag_id}"]
+    assert dag_rows and all(r["tid"] for r in dag_rows)
+
+    # dashboard surfaces: /api/dags + /metrics
+    from ray_tpu.dashboard import start_dashboard
+
+    head = start_dashboard(_api._node.session_dir)
+    try:
+        base = f"http://127.0.0.1:{head.port}"
+        dags = json.loads(urllib.request.urlopen(
+            base + "/api/dags", timeout=30).read())
+        assert any(d["dag_id"] == dag_id for d in dags)
+        prom = urllib.request.urlopen(base + "/metrics", timeout=30).read()
+        assert b"ray_tpu_dag_step_compute_seconds_bucket" in prom
+    finally:
+        head.stop()
+
+    compiled.teardown()
+    assert not st.list_compiled_dags(filters=[("dag_id", "=", dag_id)]), (
+        "teardown must deregister the DAG")
+
+
+def test_list_objects_filter_beyond_server_limit(obs_cluster):
+    """Satellite: a filtered query returns `limit` matching rows even when
+    the match set is larger than any server-side cut."""
+    from ray_tpu.util.state import list_objects
+
+    refs = [ray_tpu.put(i) for i in range(15)]
+    rows = list_objects(filters=[("status", "=", "ready")], limit=10)
+    assert len(rows) == 10
+    rows_all = list_objects(filters=[("status", "=", "ready")], limit=1000)
+    assert len(rows_all) >= 15
+    del refs
+
+
+def test_timeline_rows_labeled_with_actor_class(obs_cluster):
+    """Satellite: timeline rows for actor workers carry the actor's class
+    (from the GCS actor table) instead of a bare pid."""
+
+    @ray_tpu.remote
+    class TimelineTarget:
+        def ping(self):
+            return "pong"
+
+    a = TimelineTarget.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    w = _api._get_worker()
+
+    def labeled():
+        workers = w.rpc({"type": "list_workers"})["workers"]
+        actors = w.rpc({"type": "cluster_state"})["state"]["actors"]
+        names = te.worker_display_names(workers, actors)
+        return names if any("TimelineTarget" in v
+                            for v in names.values()) else None
+
+    names = _poll(labeled)
+    assert names, "actor worker never got a class-labeled row"
+    events = _poll(lambda: [
+        e for e in w.rpc({"type": "task_events"})["events"]
+        if e.get("name") == "ping"])
+    assert events
+    trace = json.loads(te.to_chrome_trace(te.normalize_events(events), names))
+    assert any("TimelineTarget" in str(r["pid"])
+               for r in trace["traceEvents"]), trace["traceEvents"][:3]
+
+
+def test_cli_dag_list_and_show(obs_cluster, capsys):
+    """`ray_tpu dag` reads the registry out-of-process over the session
+    socket, like the other CLI verbs."""
+    from ray_tpu.dag import InputNode
+    from ray_tpu.scripts import cli
+
+    a = ObsStage.remote()
+    with InputNode() as inp:
+        dag = a.work.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.uses_channels, compiled.fallback_reason
+    compiled.execute(1).result(timeout=60)
+    try:
+        cli.main(["--session", _api._node.session_dir, "dag", "list"])
+        out = capsys.readouterr().out
+        assert compiled.dag_id in out and "channels" in out
+        cli.main(["--session", _api._node.session_dir, "dag", "show",
+                  compiled.dag_id])
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["dag"]["dag_id"] == compiled.dag_id
+    finally:
+        compiled.teardown()

@@ -263,8 +263,8 @@ def test_grafana_provisioning_artifacts(tmp_path):
 
     serve = json.load(open(tmp_path / "grafana/dashboards/ray_tpu_serve.json"))
     sexprs = [t["expr"] for p in serve["panels"] for t in p["targets"]]
-    assert any("serve_requests_total" in e for e in sexprs)
-    assert any("serve_request_latency_ms" in e for e in sexprs)
+    assert any("ray_tpu_serve_requests_total" in e for e in sexprs)
+    assert any("ray_tpu_serve_request_latency_ms" in e for e in sexprs)
 
     prom = (tmp_path / "prometheus/prometheus.yml").read_text()
     assert "1.2.3.4:8265" in prom and "/metrics" in prom
@@ -290,11 +290,11 @@ def test_serve_metrics_reach_prometheus_endpoint(live_dash):
         while time.time() < deadline:
             _, body = _get(port, "/metrics")
             text = body.decode()
-            if "serve_requests_total" in text:
+            if "ray_tpu_serve_requests_total" in text:
                 break
             time.sleep(0.5)
-        assert "serve_requests_total" in text
+        assert "ray_tpu_serve_requests_total" in text
         assert 'deployment="mx_Echo"' in text  # app-prefixed name
-        assert "serve_request_latency_ms" in text
+        assert "ray_tpu_serve_request_latency_ms" in text
     finally:
         serve.shutdown()

@@ -1,0 +1,38 @@
+"""Tier-1 tooling check: canonical metric names.
+
+tools/check_metric_names.py statically verifies every Counter/Gauge/
+Histogram literal name in the ray_tpu package matches the one exported
+namespace, ``ray_tpu_[a-z0-9_]+`` (see README "Observability").
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_metric_names  # noqa: E402
+
+
+def test_package_metric_names_are_canonical():
+    bad = check_metric_names.check_tree(os.path.join(REPO, "ray_tpu"))
+    assert not bad, "\n".join(f"{p}:{ln}: {name!r}" for p, ln, name in bad)
+
+
+def test_checker_flags_bad_names(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "from ray_tpu.util.metrics import Counter, Histogram, get_or_create\n"
+        "import collections\n"
+        "c1 = Counter('requests_total')\n"                       # bad: prefix
+        "c2 = Counter('ray_tpu_Bad_Case')\n"                     # bad: case
+        "c3 = Counter('ray_tpu_good_total')\n"                   # ok
+        "h = get_or_create(Histogram, 'lat_seconds')\n"          # bad
+        "cc = collections.Counter('not a metric')\n"             # ignored
+        "f1 = Counter(f'ray_tpu_x_{1}_total')\n"                 # ok head
+        "f2 = Counter(f'serve_{1}_total')\n"                     # bad head
+    )
+    bad = check_metric_names.check_file(str(src))
+    assert [b[2] for b in bad] == ["requests_total", "ray_tpu_Bad_Case",
+                                   "lat_seconds",
+                                   "<f-string head 'serve_'>"]

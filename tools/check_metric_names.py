@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Static check: canonical metric names.
+
+Every `Counter`/`Gauge`/`Histogram` constructed with a literal name inside
+the `ray_tpu` package (including via `metrics.get_or_create(Counter, ...)`)
+must match ``ray_tpu_[a-z0-9_]+`` — snake_case with the `ray_tpu_` prefix —
+so dashboards, Prometheus relabeling, and docs can rely on one namespace.
+
+Run directly (`python tools/check_metric_names.py [package_dir]`) or via the
+tier-1 test (tests/test_metric_names.py). Exit code 1 lists every violation
+as `path:line: name`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+# module objects whose .Counter etc. are NOT metrics
+_NON_METRIC_BASES = {"collections", "typing"}
+
+
+def _ctor_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name) and base.id in _NON_METRIC_BASES:
+            return None
+        return func.attr
+    return None
+
+
+def _literal_name_arg(call: ast.Call) -> ast.expr | None:
+    """The metric-name argument of a constructor call, or of
+    `get_or_create(<Ctor>, name, ...)`."""
+    fn = _ctor_name(call.func)
+    if fn in METRIC_CTORS:
+        if call.args:
+            return call.args[0]
+        return next((k.value for k in call.keywords if k.arg == "name"), None)
+    if fn == "get_or_create" and len(call.args) >= 2:
+        first = _ctor_name(call.args[0]) if isinstance(
+            call.args[0], (ast.Name, ast.Attribute)) else None
+        if first in METRIC_CTORS:
+            return call.args[1]
+    return None
+
+
+def check_file(path: str) -> list[tuple[str, int, str]]:
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), path)
+        except SyntaxError as e:
+            return [(path, e.lineno or 0, f"<syntax error: {e.msg}>")]
+    bad = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        arg = _literal_name_arg(node)
+        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                and not NAME_RE.match(arg.value)):
+            bad.append((path, node.lineno, arg.value))
+        elif isinstance(arg, ast.JoinedStr):
+            # f-string name: the leading LITERAL segment must already
+            # carry the canonical prefix (e.g. f"ray_tpu_dag_step_{p}_s")
+            # — otherwise dynamic names would be a blind spot in the
+            # namespace guarantee
+            head = arg.values[0] if arg.values else None
+            head_str = (head.value if isinstance(head, ast.Constant)
+                        and isinstance(head.value, str) else "")
+            if not re.match(r"^ray_tpu_[a-z0-9_]*$", head_str):
+                bad.append((path, node.lineno,
+                            f"<f-string head {head_str!r}>"))
+    return bad
+
+
+def check_tree(root: str) -> list[tuple[str, int, str]]:
+    bad = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                bad.extend(check_file(os.path.join(dirpath, name)))
+    return bad
+
+
+def main(argv=None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    root = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ray_tpu")
+    bad = check_tree(root)
+    for path, line, name in bad:
+        print(f"{path}:{line}: metric name {name!r} does not match "
+              f"{NAME_RE.pattern}")
+    if bad:
+        print(f"{len(bad)} non-canonical metric name(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
