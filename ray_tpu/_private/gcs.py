@@ -1183,6 +1183,23 @@ class GcsServer:
                 self._fail_task_objects(spec, "actor is dead")
         elif t == "wait_actor_ready":
             self._wait_actor_ready(conn, msg)
+        elif t == "actor_info":
+            # non-blocking liveness/placement probe (compiled-DAG recovery
+            # polls this while waiting out an actor restart): state, the
+            # host of the CURRENT incarnation (None mid-restart), and the
+            # remaining restart budget
+            with self.lock:
+                a = self.actors.get(msg["aid"])
+                if a is None:
+                    conn.send({"rid": msg["rid"], "found": False})
+                else:
+                    w = self.workers.get(a.worker) if a.worker else None
+                    conn.send({
+                        "rid": msg["rid"], "found": True, "state": a.state,
+                        "host": w.host_id if w is not None else None,
+                        "restarts_left": a.restarts_left,
+                        "num_restarts": a.num_restarts,
+                        "max_task_retries": a.max_task_retries})
         elif t == "get_named_actor":
             with self.lock:
                 aid = self.named_actors.get(
@@ -3545,7 +3562,13 @@ class GcsServer:
                             if s["kind"] == "actor_create":
                                 fail.append(s)
                             continue
-                        mtr = actor.max_task_retries
+                        # spec-level override of the actor's budget: the
+                        # compiled-DAG exec loop submits with 0 so a lost
+                        # loop task FAILS (resolving the driver's liveness
+                        # ref) instead of resurrecting a stale loop over
+                        # dead channels on the restarted actor
+                        mtr = s.get("max_task_retries",
+                                    actor.max_task_retries)
                         used = s.get("retries_used", 0)
                         if (can_retry
                                 and s["num_returns"] != "streaming"

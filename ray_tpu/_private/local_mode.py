@@ -86,7 +86,8 @@ class LocalWorker:
             self._named[(namespace or self.namespace, name)] = aid
         return aid
 
-    def submit_actor_task(self, actor_id, method_name, args, kwargs, *, num_returns=1):
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, *,
+                          num_returns=1, max_task_retries=None):
         if actor_id in self._dead_actors:
             # match cluster mode: dead-actor submission yields refs whose
             # get() raises (the reference errors at get, not .remote())

@@ -152,6 +152,11 @@ class RayConfig:
     # CoreWorker flusher) every Nth compiled-DAG step; 0 = off. Sampled at
     # compile time into the exec-loop plan so workers need no env override.
     dag_span_sample_every: int = 100
+    # Compiled-DAG exec-loop recovery budget: total seconds the driver
+    # waits per recovery for the core actor restart + the in-band rewire
+    # barrier + the in-flight replay before degrading the DAG to the
+    # submit-path fallback.
+    dag_recovery_timeout_s: float = 60.0
 
     _singleton = None
     _lock = threading.Lock()

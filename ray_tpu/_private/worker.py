@@ -1160,6 +1160,7 @@ class CoreWorker:
         kwargs: dict,
         *,
         num_returns: int = 1,
+        max_task_retries: int | None = None,
     ) -> list[ObjectRef]:
         task_id = TaskID().hex()
         spec_part, deps = self._serialize_args(args, kwargs)
@@ -1178,6 +1179,11 @@ class CoreWorker:
             **_trace_field(),
             **spec_part,
         }
+        if max_task_retries is not None:
+            # per-spec override of the actor's death-retry budget (the
+            # compiled-DAG exec loop pins 0: a lost loop must fail, not be
+            # replayed on the restarted actor — see gcs worker-death path)
+            spec["max_task_retries"] = int(max_task_retries)
         if num_returns == "streaming":
             # stream state must exist before the generator polls: stay sync
             reply = self.rpc({"type": "actor_task", "spec": spec})

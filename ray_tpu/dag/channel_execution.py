@@ -22,7 +22,20 @@ Lifecycle contract:
   unlinks every `/dev/shm` file it created;
 - fallback — graphs the SPSC channel plane can't serve (task nodes,
   multi-return methods, cross-host actors, local mode) keep the existing
-  per-step submit path; `CompiledDAG` records the reason.
+  per-step submit path; `CompiledDAG` records the reason;
+- recovery — a dead exec loop (actor crash/SIGKILL) no longer bricks the
+  DAG: when the actor has restart budget the driver waits for the core
+  restart, re-provisions that actor's loop over FRESH shm channels, and
+  rewires the surviving loops in band — a `_Reconfigure` sync/done barrier
+  floods the data channels themselves (each loop applies the channel
+  remap, forwards the marker downstream, and drains stale payloads), so
+  no surviving loop is ever restarted. In-flight steps are replayed from
+  the driver's retained input rows when compiled with `enable_retry=True`
+  (mirroring `max_task_retries`: execution is at-least-once on surviving
+  actors, results exactly-once at the driver), otherwise surfaced as
+  per-step errors naming the dead node. Actors out of restart budget
+  degrade the whole DAG to the submit-path fallback
+  (`fallback_reason="actor_death"`) instead of killing it.
 
 (reference: python/ray/dag/compiled_dag_node.py — do_exec_tasks per-actor
 loops, ExecutableTask channel wiring, CompiledDAGRef results; Ray paper
@@ -40,8 +53,8 @@ import traceback
 from typing import Any
 
 from ray_tpu.dag.dag_node import AwaitableDAGFuture
-from ray_tpu.exceptions import (GetTimeoutError, RayChannelError,
-                                RayTaskError)
+from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
+                                RayChannelError, RayTaskError)
 from ray_tpu.experimental.channel.channel import ChannelClosed
 from ray_tpu.experimental.channel.mutable_shm import (MutableShmChannel,
                                                       create_mutable_channel)
@@ -113,6 +126,87 @@ def _task_error(label: str, exc: Exception, tb: str = "") -> _PipelineError:
         # the worker's execute_spec fallback)
         err = RayTaskError(label, tb or repr(exc), None)
     return _PipelineError(label, err)
+
+
+class _CtrlMsg:
+    """Base for control payloads that ride the data channels in place of a
+    step value (the in-band recovery protocol)."""
+
+
+class _Reconfigure(_CtrlMsg):
+    """Rewire marker, flooded through the DAG during exec-loop recovery.
+
+    Carries the CUMULATIVE channel remap (old shm path → replacement
+    channel) so a loop that missed an earlier epoch still converges to the
+    current wiring. A loop receiving one mid-step aborts the step, applies
+    the remap, forwards the marker on every out-edge, then drains each
+    in-edge up to its own marker — a per-channel barrier that flushes every
+    stale payload without restarting the loop."""
+
+    __slots__ = ("epoch", "remap")
+
+    def __init__(self, epoch: int, remap: dict):
+        self.epoch = epoch
+        self.remap = remap  # {old /dev/shm path: MutableShmChannel}
+
+    def __reduce__(self):
+        return (_Reconfigure, (self.epoch, self.remap))
+
+    def __repr__(self):
+        return f"_Reconfigure(epoch={self.epoch}, remap={len(self.remap)})"
+
+
+class _ReconfigureDone(_CtrlMsg):
+    """Second barrier wave: a loop forwards this only after draining ALL
+    its in-edges, so its receipt downstream proves every upstream loop has
+    fully resynced — payloads after it are post-recovery data."""
+
+    __slots__ = ("epoch",)
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+
+    def __reduce__(self):
+        return (_ReconfigureDone, (self.epoch,))
+
+    def __repr__(self):
+        return f"_ReconfigureDone(epoch={self.epoch})"
+
+
+class _ResyncSignal(Exception):
+    """Raised inside a step when a channel read returns a `_Reconfigure`:
+    unwinds the partial step so the loop can run the resync protocol."""
+
+    def __init__(self, msg: _Reconfigure, channel: MutableShmChannel):
+        super().__init__(f"resync epoch {msg.epoch}")
+        self.msg = msg
+        self.channel = channel
+
+
+class _PlaneRewired(Exception):
+    """Internal driver signal: a recovery completed while the caller was
+    blocked on a (now replaced) channel — restart the read/write with the
+    executor's fresh channel objects."""
+
+
+class _PlaneDegraded(Exception):
+    """Internal driver signal: the channel plane was dismantled after an
+    unrecoverable actor death; `CompiledDAG` catches this and re-dispatches
+    on the submit-path fallback."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _MoreDead(Exception):
+    """Internal driver signal: another exec loop died while a recovery
+    barrier was in flight — abort this epoch and fold the new failure into
+    the next one."""
+
+    def __init__(self, dead: dict):
+        super().__init__(f"{len(dead)} more loop death(s) during recovery")
+        self.dead = dead
 
 
 class _DagInput:
@@ -258,6 +352,136 @@ def _loop_write(ch: MutableShmChannel, payload: bytes):
                 raise ChannelClosed("channel file unlinked (peer gone)")
 
 
+def _read_step(ch: MutableShmChannel):
+    """Step-path read: data comes back as-is, a `_Reconfigure` aborts the
+    step into resync, a stray `_ReconfigureDone` (already honored during a
+    prior resync drain) is skipped."""
+    while True:
+        v = _loop_read(ch)
+        if not isinstance(v, _CtrlMsg):
+            return v
+        if isinstance(v, _Reconfigure):
+            raise _ResyncSignal(v, ch)
+        # _ReconfigureDone from an epoch this loop already passed: discard
+
+
+def _bcast(chans: list, blob: bytes) -> None:
+    """Round-robin non-blocking fan-out of one control payload. MUST not
+    park on a single full channel: during recovery another out-edge's
+    reader may be the one whose drain unblocks this one, so every pending
+    edge gets retried each round."""
+    pending = list(chans)
+    while pending:
+        progressed = False
+        for ch in list(pending):
+            try:
+                ch.write_serialized(blob, timeout=0)
+                pending.remove(ch)
+                progressed = True
+            except TimeoutError:
+                if not os.path.exists(ch.path):
+                    raise ChannelClosed("channel file unlinked (peer gone)")
+        if pending and not progressed:
+            time.sleep(0.0005)
+
+
+class _LoopState:
+    """The exec loop's mutable wiring: op list + driver input channel,
+    remappable in place by the recovery protocol (arg encodings and out
+    lists are shared structures — one `apply()` rewires every reference)."""
+
+    __slots__ = ("ops", "input", "epoch")
+
+    def __init__(self, ops: list, input_ch):
+        self.ops = ops
+        self.input = input_ch
+        self.epoch = 0
+
+    def in_edges(self) -> list:
+        chans = [] if self.input is None else [self.input]
+        for op in self.ops:
+            for enc in (*op["args"], *op["kwargs"].values()):
+                if enc[0] == "chan":
+                    chans.append(enc[1])
+        return chans
+
+    def out_edges(self) -> list:
+        return [ch for op in self.ops for ch in op["out"]]
+
+    def apply(self, remap: dict) -> None:
+        if not remap:
+            return
+        if self.input is not None and self.input.path in remap:
+            self.input = remap[self.input.path]
+        for op in self.ops:
+            op["args"] = [("chan", remap[e[1].path])
+                          if e[0] == "chan" and e[1].path in remap else e
+                          for e in op["args"]]
+            op["kwargs"] = {k: (("chan", remap[e[1].path])
+                                if e[0] == "chan" and e[1].path in remap
+                                else e)
+                            for k, e in op["kwargs"].items()}
+            op["out"] = [remap.get(ch.path, ch) for ch in op["out"]]
+
+
+def _drain_until(state: _LoopState, epoch: int, skip, want_done: bool):
+    """Consume every in-edge up to its `_Reconfigure` marker (sync wave) or
+    `_ReconfigureDone` (done wave), DISCARDING stale step payloads and
+    stale control messages. Returns a higher-epoch `(_Reconfigure, chan)`
+    if one arrives mid-drain (another failure during recovery) so the
+    caller restarts the protocol, else None."""
+    for ch in state.in_edges():
+        if skip is not None and ch.path == skip.path:
+            continue  # this edge's marker was the trigger, already consumed
+        while True:
+            v = _loop_read(ch)
+            if isinstance(v, _Reconfigure):
+                if v.epoch > epoch:
+                    return v, ch
+                if not want_done and v.epoch >= epoch:
+                    break
+                continue  # stale sync marker
+            if isinstance(v, _ReconfigureDone):
+                if want_done and v.epoch >= epoch:
+                    break
+                continue  # stale done marker
+            # stale step payload from the aborted in-flight window
+    return None
+
+
+def _resync(state: _LoopState, rc: _Reconfigure, trigger) -> None:
+    """The in-band rewire barrier, run inside the exec loop (the surviving
+    loops are never restarted — the protocol rides the data channels):
+
+    1. apply the channel remap (stale endpoints → fresh shm segments);
+    2. forward the sync marker on every (post-remap) out-edge, so the
+       flood reaches loops the driver cannot safely write to;
+    3. drain every in-edge up to its sync marker — flushes in-flight
+       payloads of the aborted step window;
+    4. wait for the done marker on every in-edge (its writer finished ITS
+       drain), proving no stale payload can arrive afterwards;
+    5. forward the done marker downstream and resume stepping.
+
+    A higher-epoch marker arriving mid-protocol (another actor died while
+    recovering) restarts the procedure at that epoch — the marker carries
+    the cumulative remap, so earlier missed epochs are covered."""
+    from ray_tpu._private import serialization as ser
+
+    while True:
+        state.apply(rc.remap)
+        epoch = rc.epoch
+        _bcast(state.out_edges(), ser.dumps(rc))
+        nxt = _drain_until(state, epoch, trigger, want_done=False)
+        if nxt is None:
+            nxt = _drain_until(state, epoch, None, want_done=True)
+        if nxt is not None:
+            rc, trigger = nxt
+            continue
+        _bcast(state.out_edges(), ser.dumps(_ReconfigureDone(epoch)))
+        state.epoch = epoch
+        return
+
+
 def _emit(outs: list, result, label: str):
     """Serialize once, write to every out-edge. Oversized payloads become a
     clear in-band error (the channel stays usable for the next step)."""
@@ -346,15 +570,21 @@ def actor_exec_loop(instance, plan: dict, _execer=None) -> dict:
                ("input",)
       input:   driver input channel (also the pacing tick for actors whose
                ops have no channel in-edges), or None
+      resync:  recovery epoch when this loop replaces one that died — the
+               loop runs the rewire barrier before its first step so its
+               fresh channels synchronize with the surviving loops
       dag_id / metrics / sample: instrumentation identity + knobs, stamped
                at compile time from the driver's RayConfig so workers need
                no env propagation
     """
-    ops = plan["ops"]
-    input_ch = plan.get("input")
+    state = _LoopState(plan["ops"], plan.get("input"))
     instr = _LoopInstr.create(plan)
     try:
-        return _exec_loop_body(instance, ops, input_ch, instr, _execer)
+        if plan.get("resync"):
+            _resync(state, _Reconfigure(int(plan["resync"]), {}), None)
+        return _exec_loop_body(instance, state, instr, _execer)
+    except ChannelClosed:
+        return {"steps": 0, "status": "closed"}
     finally:
         if instr is not None:
             # ANY exit path (ChannelClosed or a crashed loop in a
@@ -363,62 +593,77 @@ def actor_exec_loop(instance, plan: dict, _execer=None) -> dict:
             instr.retire()
 
 
-def _exec_loop_body(instance, ops, input_ch, instr, _execer) -> dict:
+def _exec_loop_body(instance, state: _LoopState, instr, _execer) -> dict:
     steps = 0
     try:
         while True:
-            if instr is None:
-                # untimed path: metrics + sampling disabled — no clock
-                # reads, no emits, no extra allocation per step
-                inp = _loop_read(input_ch) if input_ch is not None else None
-                if type(inp) is _DagInput:
-                    inp = inp.value
-                regs: list[Any] = []
-                for op in ops:
-                    args, kwargs = _materialize_args(op, regs, inp)
-                    result = _compute_op(instance, op, args, kwargs, _execer)
-                    regs.append(result)
-                    if op["out"]:
-                        _emit(op["out"], result, op["label"])
-            else:
-                t0 = time.monotonic()
-                inp = _loop_read(input_ch) if input_ch is not None else None
-                t1 = time.monotonic()
-                in_wait = t1 - t0
-                trace_ctx = None
-                if type(inp) is _DagInput:
-                    trace_ctx = inp.trace_ctx
-                    inp = inp.value
-                regs = []
-                sampled = instr.sample and steps % instr.sample == 0
-                for i, op in enumerate(ops):
-                    # stamps chain op-to-op: t1 is the previous op's write
-                    # end (3 clock reads per op, not 5)
-                    args, kwargs, chan_ctx = _materialize_args_traced(
-                        op, regs, inp)
-                    op_ctx = chan_ctx or trace_ctx
-                    t2 = time.monotonic()
-                    result = _compute_op(instance, op, args, kwargs, _execer)
-                    t3 = time.monotonic()
-                    regs.append(result)
-                    if op["out"]:
-                        wire = result
-                        if (sampled and op_ctx is not None
-                                and not isinstance(result, _PipelineError)):
-                            # forward the trace context downstream in-band
-                            # so later stages' sampled steps join the trace
-                            wire = _DagInput(result, op_ctx)
-                        _emit(op["out"], wire, op["label"])
-                    t4 = time.monotonic()
-                    # the driver-input wait is attributed to the actor's
-                    # first op (the read happens once per step, loop-level)
-                    instr.record(i, op, steps,
-                                 (t2 - t1) + (in_wait if i == 0 else 0.0),
-                                 t3 - t2, t4 - t3, op_ctx)
-                    t1 = t4
+            try:
+                if instr is None:
+                    _one_step(instance, state, _execer)
+                else:
+                    _one_step_traced(instance, state, instr, steps, _execer)
+            except _ResyncSignal as s:
+                # a neighbor died and was re-provisioned: abort the partial
+                # step (its replay — or its per-step error — is the
+                # driver's call), rewire, and keep looping
+                _resync(state, s.msg, s.channel)
+                continue
             steps += 1
     except ChannelClosed:
         return {"steps": steps, "status": "closed"}
+
+
+def _one_step(instance, state: _LoopState, _execer) -> None:
+    # untimed path: metrics + sampling disabled — no clock reads, no
+    # emits, no extra allocation per step
+    inp = _read_step(state.input) if state.input is not None else None
+    if type(inp) is _DagInput:
+        inp = inp.value
+    regs: list[Any] = []
+    for op in state.ops:
+        args, kwargs = _materialize_args(op, regs, inp)
+        result = _compute_op(instance, op, args, kwargs, _execer)
+        regs.append(result)
+        if op["out"]:
+            _emit(op["out"], result, op["label"])
+
+
+def _one_step_traced(instance, state: _LoopState, instr, steps,
+                     _execer) -> None:
+    t0 = time.monotonic()
+    inp = _read_step(state.input) if state.input is not None else None
+    t1 = time.monotonic()
+    in_wait = t1 - t0
+    trace_ctx = None
+    if type(inp) is _DagInput:
+        trace_ctx = inp.trace_ctx
+        inp = inp.value
+    regs: list[Any] = []
+    sampled = instr.sample and steps % instr.sample == 0
+    for i, op in enumerate(state.ops):
+        # stamps chain op-to-op: t1 is the previous op's write
+        # end (3 clock reads per op, not 5)
+        args, kwargs, chan_ctx = _materialize_args_traced(op, regs, inp)
+        op_ctx = chan_ctx or trace_ctx
+        t2 = time.monotonic()
+        result = _compute_op(instance, op, args, kwargs, _execer)
+        t3 = time.monotonic()
+        regs.append(result)
+        if op["out"]:
+            wire = result
+            if (sampled and op_ctx is not None
+                    and not isinstance(result, _PipelineError)):
+                # forward the trace context downstream in-band
+                # so later stages' sampled steps join the trace
+                wire = _DagInput(result, op_ctx)
+            _emit(op["out"], wire, op["label"])
+        t4 = time.monotonic()
+        # the driver-input wait is attributed to the actor's
+        # first op (the read happens once per step, loop-level)
+        instr.record(i, op, steps,
+                     (t2 - t1) + (in_wait if i == 0 else 0.0),
+                     t3 - t2, t4 - t3, op_ctx)
+        t1 = t4
 
 
 def _decode(enc, regs, inp):
@@ -428,7 +673,7 @@ def _decode(enc, regs, inp):
     if kind == "reg":
         return regs[enc[1]]
     if kind == "chan":
-        return _loop_read(enc[1])
+        return _read_step(enc[1])
     if kind == "input":
         return inp
     raise ValueError(f"unknown arg encoding {kind!r}")
@@ -481,7 +726,9 @@ class ChannelExecutor:
     def __init__(self, worker, plans: dict, order: list, in_chans: list,
                  out_chans: list, all_chans: list, *, max_inflight: int,
                  multi: bool, dag_id: str | None = None, sample: int = 0,
-                 metrics_on: bool = False, topology: list | None = None):
+                 metrics_on: bool = False, topology: list | None = None,
+                 ends: dict | None = None, buffer_bytes: int = 1 << 20,
+                 enable_retry: bool = False):
         self._worker = worker
         self._plans = plans
         self._order = order  # actor ids, schedule order
@@ -493,6 +740,31 @@ class ChannelExecutor:
         self._dag_id = dag_id
         self._sample = int(sample or 0)
         self.topology = list(topology or ())  # channel edges, for registry
+        # ---- exec-loop recovery state -----------------------------------
+        # channel endpoints by shm path ("driver" or actor id on each side):
+        # recovery replaces every channel adjacent to a dead actor and must
+        # know who to force-ack (dead reader) vs. where to inject markers
+        self._ends: dict[str, tuple[str, str]] = dict(ends or {})
+        self._buffer_bytes = int(buffer_bytes)
+        self._enable_retry = bool(enable_retry)
+        self._inputs: dict[int, bytes] = {}  # seq → retained input payload
+        self._epoch = 0  # recovery generation (monotonic per executor)
+        # cumulative remap across recoveries, collapsed transitively: a
+        # loop that missed epoch N still lands on epoch N+1's channels
+        self._cum_remap: dict[str, MutableShmChannel] = {}
+        # replaced-but-not-yet-unlinked OLD channels, as (channel,
+        # needs_marker, needs_ack) — flags decided with the endpoint
+        # knowledge of the epoch that replaced them. Kept across _MoreDead-
+        # aborted epochs: a survivor may still be parked on a PREVIOUS
+        # epoch's abandoned edge, so every barrier pump serves the whole
+        # backlog, and unlink happens only after a barrier completes.
+        self._stale: list[tuple[MutableShmChannel, bool, bool]] = []
+        self._degraded: str | None = None
+        self.recoveries = 0
+        # first-op label per actor, for error messages naming the node
+        self._labels = {aid: (plans[aid]["ops"][0]["label"]
+                              if plans[aid]["ops"] else f"actor:{aid[:8]}")
+                        for aid in order}
         self._h_bp = None  # driver-side backpressure-drain phase histogram
         self._h_bp_src = None  # (hist, tags) for series retirement
         if metrics_on and dag_id:
@@ -534,9 +806,14 @@ class ChannelExecutor:
 
     def _provision(self):
         for aid in self._order:
+            # max_task_retries=0 per spec: on actor death the GCS must FAIL
+            # the loop task (resolving the ref — the driver's liveness
+            # signal), never requeue it on the restarted actor, where it
+            # would resurrect a stale loop over dead channels and occupy
+            # the concurrency slot the re-provisioned loop needs
             ref = self._worker.submit_actor_task(
                 aid, EXEC_LOOP_METHOD, (self._plans[aid],), {},
-                num_returns=1)[0]
+                num_returns=1, max_task_retries=0)[0]
             self._loops[aid] = ref
 
     @property
@@ -545,6 +822,13 @@ class ChannelExecutor:
                 "channels": len(self._all_chans),
                 "executions_submitted": self._submitted}
 
+    def _err(self, msg: str, node: str | None = None) -> RayChannelError:
+        """Every driver-raised channel error names the dag and, when known,
+        the faulting node — a bare 'torn down' is undebuggable once several
+        compiled DAGs share a process."""
+        where = f" (node {node})" if node else ""
+        return RayChannelError(f"compiled DAG {self._dag_id}{where}: {msg}")
+
     # --------------------------------------------------------------- execute
 
     def execute(self, input_value) -> ChannelDAGFuture:
@@ -552,7 +836,9 @@ class ChannelExecutor:
 
         with self._lock:
             if self._torn:
-                raise RayChannelError("compiled DAG was torn down")
+                raise self._err("torn down")
+            if self._degraded is not None:
+                raise _PlaneDegraded(self._degraded)
             if self._sample and self._submitted % self._sample == 0:
                 # envelope the driver's trace context only on steps the
                 # loops will actually sample (their step counters advance
@@ -583,16 +869,31 @@ class ChannelExecutor:
             while self._submitted - self._drained >= self._max_inflight:
                 if t_bp is None:
                     t_bp = time.monotonic()
-                self._drain_one(deadline=None)
+                try:
+                    self._drain_one(deadline=None)
+                except _PlaneRewired:
+                    continue  # recovery reset the row; re-check the window
             if t_bp is not None and self._h_bp is not None:
                 self._h_bp.observe(time.monotonic() - t_bp)
-            for ch in self._in_chans:
-                self._write_input(ch, payload)
+            # the seq is ADMITTED (and its input retained) before the first
+            # channel write: a recovery triggered mid-fan-out then treats
+            # this step as in-flight — replayed (enable_retry) or failed —
+            # instead of leaving the loops half-fed and desynchronized
             seq = self._submitted
             self._submitted += 1
+            if self._enable_retry:
+                self._inputs[seq] = payload
             fut = ChannelDAGFuture(self, seq)
             self._live[seq] = fut  # registered under the lock: eviction
             # scans _live, so the row must never look abandoned here
+            try:
+                for ch in self._in_chans:
+                    self._write_input(ch, payload)
+            except (_PlaneRewired, _PlaneDegraded):
+                # the recovery replayed (or error-settled) every in-flight
+                # seq — including this one — over the rewired plane; the
+                # remaining fan-out writes must not run on top of that
+                pass
         return fut
 
     def _write_input(self, ch, payload: bytes):
@@ -607,10 +908,12 @@ class ChannelExecutor:
             except TimeoutError:
                 while self._drain_one_nonblocking():
                     pass
-                self._raise_if_loops_dead()
+                self._check_loops()
             except ChannelClosed as e:
-                raise RayChannelError(
-                    f"DAG input channel closed: {e}") from e
+                dst = self._ends.get(ch.path, ("driver", None))[1]
+                node = self._labels.get(dst, dst)
+                raise self._err(f"input channel closed: {e}",
+                                node=node) from e
 
     # ----------------------------------------------------------------- drain
 
@@ -619,15 +922,20 @@ class ChannelExecutor:
                     else time.monotonic() + timeout)
         with self._lock:
             while seq >= self._drained:
-                self._drain_one(deadline)
+                try:
+                    self._drain_one(deadline)
+                except (_PlaneRewired, _PlaneDegraded):
+                    # recovery (or degrade) may have error-settled this seq
+                    # already — re-check before reading again
+                    continue
             row = self._results.pop(seq, None)
         if row is None:
             if seq < self._expired_below:
-                raise RayChannelError(
+                raise self._err(
                     f"result for execution #{seq} expired: it stayed "
                     f"unconsumed beyond the retention window "
                     f"({self._retain} rows)")
-            raise RayChannelError(
+            raise self._err(
                 f"result for execution #{seq} was already consumed")
         return row
 
@@ -655,17 +963,30 @@ class ChannelExecutor:
         self._store_row()
 
     def _drain_one_nonblocking(self) -> bool:
+        # never blocks, never recovers: this path backs the PUBLIC done()
+        # poll (and the recovery pump's own drains), so it must not call
+        # _check_loops — a recovery starting inside done() would leak
+        # _PlaneRewired out of a non-throwing API
         while len(self._row) < len(self._out_chans):
             ch = self._out_chans[len(self._row)]
             if not ch.poll():
                 return False
-            self._row.append(self._read_out(ch, None))
+            try:
+                v = ch.read(timeout=0)
+            except (TimeoutError, ChannelClosed):
+                return False
+            if isinstance(v, _CtrlMsg):
+                continue  # stray marker from a completed epoch: re-poll
+            if type(v) is _DagInput:
+                v = v.value
+            self._row.append(v)
         self._store_row()
         return True
 
     def _store_row(self):
         self._results[self._drained] = self._row
         self._row = []
+        self._inputs.pop(self._drained, None)  # its replay window closed
         self._drained += 1
         if len(self._results) <= self._retain:
             return
@@ -681,6 +1002,10 @@ class ChannelExecutor:
         while True:
             try:
                 v = ch.read(timeout=_DRIVER_BLOCK_SLICE_S)
+                if isinstance(v, _CtrlMsg):
+                    # stray recovery marker from a completed epoch (e.g. a
+                    # done-wave the pump already accounted): not a value
+                    continue
                 if type(v) is _DagInput:
                     # a sampled step's trace envelope reached a driver
                     # output channel; the caller wants the bare value
@@ -688,38 +1013,392 @@ class ChannelExecutor:
                 return v
             except TimeoutError:
                 if self._torn:
-                    raise RayChannelError("compiled DAG was torn down")
-                self._raise_if_loops_dead()
+                    raise self._err("torn down")
+                self._check_loops()
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(
-                        "timed out waiting for compiled-DAG output")
+                        f"timed out waiting for compiled-DAG {self._dag_id} "
+                        f"output")
             except ChannelClosed as e:
                 if self._torn:
-                    raise RayChannelError(
-                        "compiled DAG was torn down") from e
-                self._raise_if_loops_dead()
-                raise RayChannelError(
-                    f"DAG output channel closed: {e}") from e
+                    raise self._err("torn down") from e
+                self._check_loops()
+                src = self._ends.get(ch.path, (None, "driver"))[0]
+                raise self._err(f"output channel closed: {e}",
+                                node=self._labels.get(src, src)) from e
 
-    def _raise_if_loops_dead(self):
+    # ------------------------------------------------------------- recovery
+
+    def _check_loops(self):
         """A loop task resolving while executions are pending means its
-        actor died (or the loop crashed) — surface that instead of letting
-        the driver block on a channel nobody will ever write."""
+        actor died (or the loop crashed). When the actor has restart budget
+        the plane is RECOVERED in place: fresh channels for the dead
+        actor's edges, a re-provisioned exec loop, and an in-band rewire of
+        the surviving loops. Otherwise the DAG degrades to the submit-path
+        fallback. Caller holds self._lock."""
+        dead = self._dead_loops()
+        if dead:
+            self._recover(dead)
+
+    def _dead_loops(self) -> dict:
         import ray_tpu
 
+        dead: dict[str, Exception] = {}
         for aid, ref in self._loops.items():
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
             if not ready:
                 continue
             try:
                 out = ray_tpu.get(ref)
-            except Exception as e:
-                raise RayChannelError(
-                    f"compiled-DAG execution loop on actor {aid[:8]} died: "
-                    f"{e}") from e
-            raise RayChannelError(
-                f"compiled-DAG execution loop on actor {aid[:8]} exited "
-                f"prematurely: {out!r}")
+                exc: Exception = self._err(
+                    f"execution loop exited prematurely: {out!r}",
+                    node=self._labels.get(aid))
+            except Exception as e:  # noqa: BLE001 — the death reason itself
+                exc = e
+            dead[aid] = exc
+        return dead
+
+    def _recover(self, dead: dict) -> None:
+        """Drive recovery to completion (or degrade). Raises _PlaneRewired
+        so blocked read/write loops restart on the fresh channel objects,
+        or _PlaneDegraded after dismantling the plane."""
+        from ray_tpu._private.ray_config import RayConfig
+
+        t0 = time.monotonic()
+        deadline = t0 + float(
+            getattr(RayConfig.instance(), "dag_recovery_timeout_s", 60.0))
+        first_dead = sorted(dead)
+        try:
+            while dead:
+                try:
+                    self._recover_epoch(dead, deadline)
+                    dead = self._dead_loops()  # another death during replay?
+                except _MoreDead as m:
+                    # an actor died while the barrier was in flight: fold
+                    # the new failure into the next epoch (the cumulative
+                    # remap keeps half-rewired loops convergent)
+                    dead = m.dead
+        except _PlaneDegraded:
+            self._note_recovery("degraded", first_dead, t0)
+            raise
+        self.recoveries += 1
+        self._note_recovery("recovered", first_dead, t0)
+        raise _PlaneRewired()
+
+    def _note_recovery(self, outcome: str, aids: list, t0: float) -> None:
+        """Observability: `ray_tpu_dag_recoveries_total` counter + one
+        timeline span per recovery (joins the PR 4 task-events plumbing:
+        the driver flusher ships it to the GCS, `ray_tpu timeline` renders
+        it under the DAG's row)."""
+        try:
+            from ray_tpu._private import task_events
+            from ray_tpu.util.metrics import Counter, get_or_create
+
+            c = get_or_create(
+                Counter, "ray_tpu_dag_recoveries_total",
+                "compiled-DAG exec-loop recoveries (channel plane), by "
+                "outcome: recovered = plane rewired in place, degraded = "
+                "fell back to the submit path",
+                tag_keys=("dag_id", "outcome"))
+            # unlike the per-step series (retired at teardown), recovery
+            # counts survive the DAG: they only exist for DAGs that hit an
+            # incident, so cardinality is bounded by actual failures and
+            # the evidence outlives the teardown that follows a degrade
+            c.inc(tags={"dag_id": self._dag_id or "", "outcome": outcome})
+            dur = time.monotonic() - t0
+            end = time.time()
+            task_events.emit(
+                "dag:recovery",
+                name="recover:" + "+".join(a[:8] for a in aids),
+                start=end - dur, end=end,
+                dag_id=self._dag_id, actors=[a[:8] for a in aids],
+                epoch=self._epoch, outcome=outcome,
+                duration_s=round(dur, 6))
+        except Exception:  # noqa: BLE001 — observability must not break recovery
+            pass
+
+    def _recover_epoch(self, dead: dict, deadline: float) -> None:
+        """One recovery generation: wait for the core restarts, re-channel
+        the dead actors' edges, re-provision their loops, then pump the
+        in-band barrier until every surviving loop has rewired, and finally
+        replay (or error-settle) the in-flight window."""
+        from ray_tpu._private import serialization as ser
+
+        self._epoch += 1
+        epoch = self._epoch
+        for aid in dead:
+            self._wait_actor_restart(aid, dead, deadline)
+
+        # fresh segments for every edge touching a dead actor; stale ones
+        # are unlinked after the barrier completes. Flags per stale
+        # segment, decided NOW (while `dead` describes this epoch):
+        # needs_marker — a surviving reader may be parked on it, inject
+        # the rewire marker there; needs_ack — its reader is dead, so a
+        # surviving writer parked on the ack needs force_ack to move.
+        remap: dict[str, MutableShmChannel] = {}
+        flags: dict[str, tuple[bool, bool]] = {}
+        for path, (src, dst) in list(self._ends.items()):
+            if src in dead or dst in dead:
+                remap[path] = create_mutable_channel(self._buffer_bytes)
+                flags[path] = (
+                    src in dead and dst not in dead and dst != "driver",
+                    dst in dead)
+        replaced = self._apply_remap(remap)
+        self._stale.extend((ch, *flags[ch.path]) for ch in replaced)
+
+        # re-provision each dead actor's exec loop over the remapped plan;
+        # the resync epoch makes the new loop run the barrier before its
+        # first step (its fresh in-edges synchronize with the survivors)
+        for aid in dead:
+            plan = self._plans[aid]
+            plan["resync"] = epoch
+            try:
+                self._loops[aid] = self._worker.submit_actor_task(
+                    aid, EXEC_LOOP_METHOD, (plan,), {}, num_returns=1,
+                    max_task_retries=0)[0]
+            except Exception as e:  # noqa: BLE001 — submit failure → degrade
+                self._degrade(dead, f"exec-loop re-provision failed: {e!r}")
+
+        self._pump_barrier(dead, epoch, deadline)
+        # barrier done: every loop resynced, so no loop touches ANY stale
+        # segment (this epoch's or an aborted predecessor's) anymore
+        for ch, _marker, _ack in self._stale:
+            try:
+                self._all_chans.remove(ch)
+            except ValueError:
+                pass
+            ch.close()
+            ch.unlink()
+        self._stale.clear()
+        # the same invariant retires the remap history: every loop is on
+        # the current wiring, so future markers only need remaps newer
+        # than this barrier — without this, rc_blob (and every resyncing
+        # loop's channel attach set) grows per recovery forever
+        self._cum_remap.clear()
+        self._replay_or_settle(dead, deadline, ser)
+
+    def _wait_actor_restart(self, aid: str, dead: dict,
+                            deadline: float) -> None:
+        """Block (poll-style, teardown-abortable) until the GCS restarted
+        the actor; degrade when it can't ('actor_death' fallback instead of
+        a bricked DAG)."""
+        label = self._labels.get(aid, aid[:8])
+        while True:
+            if self._torn:
+                raise self._err("torn down during recovery")
+            try:
+                info = self._worker.rpc({"type": "actor_info", "aid": aid})
+            except Exception as e:  # noqa: BLE001 — GCS unreachable
+                self._degrade(dead, f"actor state unavailable ({e!r})")
+            if not info.get("found") or info.get("state") == "dead":
+                self._degrade(
+                    dead, f"actor {aid[:8]} ({label}) died with no restart "
+                          f"budget left")
+            if info.get("state") == "alive":
+                if info.get("host") not in (None, self._worker.host_id):
+                    # restarted onto another host: shm channels can't span
+                    # hosts — the submit path can
+                    self._degrade(
+                        dead, f"actor {aid[:8]} restarted on host "
+                              f"{info.get('host')} (driver on "
+                              f"{self._worker.host_id})")
+                return
+            if time.monotonic() >= deadline:
+                self._degrade(
+                    dead, f"actor {aid[:8]} ({label}) restart timed out")
+            time.sleep(0.05)
+
+    def _apply_remap(self, remap: dict) -> list:
+        """Swap every driver-side reference from the stale channels to the
+        fresh ones; returns the replaced (old) channel objects."""
+        if not remap:
+            return []
+        replaced = []
+        for plan in self._plans.values():
+            st = _LoopState(plan["ops"], plan.get("input"))
+            st.apply(remap)
+            plan["input"] = st.input
+        self._in_chans = [remap.get(c.path, c) for c in self._in_chans]
+        self._out_chans = [remap.get(c.path, c) for c in self._out_chans]
+        for path, new in remap.items():
+            self._ends[new.path] = self._ends.pop(path)
+            self._all_chans.append(new)
+        for ch in self._all_chans:
+            if ch.path in remap:
+                replaced.append(ch)
+        # collapse the history so older epochs' stale paths point at the
+        # CURRENT segment (late loops apply one hop, not a chain)
+        for old_path, tgt in list(self._cum_remap.items()):
+            if tgt.path in remap:
+                self._cum_remap[old_path] = remap[tgt.path]
+        self._cum_remap.update(remap)
+        return replaced
+
+    def _pump_barrier(self, dead: dict, epoch: int,
+                      deadline: float) -> None:
+        """Single-threaded driver pump, all non-blocking slices:
+        - inject sync+done markers into every channel the DRIVER may write
+          (its input channels, post-remap) — the flood covers the rest;
+        - inject sync markers into stale out-edges of dead writers, where
+          a survivor may be blocked reading a channel no one will feed —
+          including edges stranded by a _MoreDead-aborted earlier epoch;
+        - force-ack stale channels whose reader died, so survivors blocked
+          on a dead reader's ack finish their write and reach the marker;
+        - drain every driver out-channel up to its done marker (discarding
+          the aborted window's partials);
+        - watch for teardown, timeout, and further loop deaths."""
+        from ray_tpu._private import serialization as ser
+
+        rc_blob = ser.dumps(_Reconfigure(epoch, dict(self._cum_remap)))
+        done_blob = ser.dumps(_ReconfigureDone(epoch))
+        # MUST-flush injections: the driver's input channels carry the
+        # sync+done waves into the first-stage loops, which consume them
+        # during their resync drains — these always land eventually.
+        # (channel, [payloads still to write, in order])
+        must: list[tuple[MutableShmChannel, list]] = [
+            (ch, [rc_blob, done_blob]) for ch in self._in_chans]
+        # OPPORTUNISTIC injections: a survivor may be parked reading an
+        # abandoned stale edge whose writer died — one sync marker (with
+        # the remap) frees it. But if that survivor resynced via ANOTHER
+        # in-edge first, nobody ever drains this channel again and the
+        # write may never land; the done wave on the output channels
+        # already proves every loop resynced, so completion must not wait
+        # on these. No done wave here: the edge is abandoned post-remap.
+        opportunistic: list[tuple[MutableShmChannel, list]] = [
+            (ch, [rc_blob]) for ch, needs_marker, _a in self._stale
+            if needs_marker]
+        ack = [ch for ch, _m, needs_ack in self._stale if needs_ack]
+        out_state = {ch.path: "sync" for ch in self._out_chans}
+        self._row = []  # partial pre-crash row: replay regenerates it
+        while True:
+            if self._torn:
+                raise self._err("torn down during recovery")
+            if time.monotonic() >= deadline:
+                self._degrade(dead, "recovery barrier timed out")
+            more = {a: e for a, e in self._dead_loops().items()}
+            if more:
+                raise _MoreDead(more)
+            progressed = False
+            for ch, todo in (*must, *opportunistic):
+                if todo:
+                    try:
+                        ch.write_serialized(todo[0], timeout=0)
+                        todo.pop(0)
+                        progressed = True
+                    except (TimeoutError, ValueError):
+                        pass
+                    except ChannelClosed:
+                        todo.clear()
+            for ch in ack:
+                ch.force_ack()
+            for ch in self._out_chans:
+                st = out_state[ch.path]
+                if st == "done":
+                    continue
+                try:
+                    v = ch.read(timeout=0)
+                except (TimeoutError, ChannelClosed):
+                    continue
+                progressed = True
+                if isinstance(v, _Reconfigure) and v.epoch >= epoch:
+                    out_state[ch.path] = "sync_seen"
+                elif isinstance(v, _ReconfigureDone) and v.epoch >= epoch:
+                    out_state[ch.path] = "done"
+                # anything else: stale partial-row payload — discarded
+            if (all(st == "done" for st in out_state.values())
+                    and all(not todo for _ch, todo in must)):
+                return
+            if not progressed:
+                time.sleep(0.001)
+
+    def _replay_or_settle(self, dead: dict, deadline: float, ser) -> None:
+        """The in-flight window [drained, submitted): with enable_retry the
+        retained input rows are re-fed in order (results stay exactly-once
+        at the driver — the barrier flushed every partial payload); without
+        it each step settles as an in-band error naming the dead node."""
+        pending = range(self._drained, self._submitted)
+        if not self._enable_retry:
+            labels = ", ".join(
+                self._labels.get(a, a[:8]) for a in sorted(dead))
+            for seq in pending:
+                # settled driver-locally (never rides a channel): keep the
+                # BARE ActorDiedError so result() raises the same type the
+                # submit plane surfaces for a dead actor
+                err = _PipelineError(labels, ActorDiedError(
+                    f"compiled DAG {self._dag_id}: execution #{seq} was "
+                    f"in flight when node(s) {labels} died "
+                    f"(enable_retry=False; compile with "
+                    f"enable_retry=True to replay)"))
+                self._results[seq] = [err] * len(self._out_chans)
+                self._inputs.pop(seq, None)
+            self._drained = self._submitted
+            self._row = []
+            return
+        labels = ", ".join(self._labels.get(a, a[:8]) for a in sorted(dead))
+        for seq in pending:
+            payload = self._inputs.get(seq)
+            if payload is None:
+                # defensive (every admitted seq retains its row while
+                # enable_retry is on): replay a POISON input so the
+                # pipeline still produces a row for this seq — skipping it
+                # would shift every later seq onto the wrong result row
+                payload = ser.dumps(_PipelineError(labels, ActorDiedError(
+                    f"compiled DAG {self._dag_id}: execution #{seq} lost "
+                    f"its retained input row across the recovery from "
+                    f"node(s) {labels}")))
+            for ch in self._in_chans:
+                while True:
+                    if self._torn:
+                        raise self._err("torn down during recovery")
+                    if time.monotonic() >= deadline:
+                        self._degrade(dead, "in-flight replay timed out")
+                    more = self._dead_loops()
+                    if more:
+                        raise _MoreDead(more)
+                    try:
+                        ch.write_serialized(payload, timeout=0.01)
+                        break
+                    except TimeoutError:
+                        while self._drain_one_nonblocking():
+                            pass
+
+    def _degrade(self, dead: dict, detail: str):
+        """Dismantle the channel plane after an unrecoverable death: close
+        and unlink everything, settle the in-flight window as errors naming
+        the dead node, release the actors, and hand the DAG to the
+        submit-path fallback. Never returns (raises _PlaneDegraded)."""
+        import ray_tpu
+
+        labels = ", ".join(self._labels.get(a, a[:8]) for a in sorted(dead))
+        logger.warning(
+            "compiled DAG %s: degrading to the submit-path fallback after "
+            "death of %s (%s)", self._dag_id, labels, detail)
+        self._degraded = f"actor_death: {labels} ({detail})"
+        for ch in self._all_chans:
+            ch.close()
+        for seq in range(self._drained, self._submitted):
+            err = _PipelineError(labels, ActorDiedError(
+                f"compiled DAG {self._dag_id}: execution #{seq} was in "
+                f"flight when node(s) {labels} died and the channel plane "
+                f"degraded to the submit path ({detail})"))
+            self._results[seq] = [err] * len(self._out_chans)
+        self._drained = self._submitted
+        self._row = []
+        self._inputs.clear()
+        # the loops exit via ChannelClosed; join briefly so the actors'
+        # concurrency slots free before the submit plane targets them
+        t_join = time.monotonic() + 5.0
+        for aid, ref in self._loops.items():
+            if aid in dead:
+                continue  # already resolved (that's how we got here)
+            try:
+                ray_tpu.get(ref, timeout=max(0.1, t_join - time.monotonic()))
+            except Exception:  # noqa: BLE001 — best-effort; teardown re-joins
+                pass
+        for ch in self._all_chans:
+            ch.unlink()
+        _release_actors(self._order)
+        raise _PlaneDegraded(self._degraded)
 
     # -------------------------------------------------------------- teardown
 
@@ -739,7 +1418,7 @@ class ChannelExecutor:
         still_running: set[str] = set()
         for aid, ref in self._loops.items():
             try:
-                ray_tpu.get(ref, timeout=30.0)
+                ray_tpu.get(ref, timeout=self._join_timeout(aid, ref))
             except GetTimeoutError as e:
                 # the loop is wedged in a user op: keep the actor claimed,
                 # or a recompile over it would queue behind the stuck loop
@@ -764,6 +1443,23 @@ class ChannelExecutor:
                 raise errors[0][1]
         return errors
 
+    def _join_timeout(self, aid: str, ref) -> float:
+        """Dead-loop fast path: a loop whose ref is unresolved AND whose
+        actor is no longer alive will never return on its own — joining it
+        with the full budget would burn 30s PER dead actor in teardown.
+        The short grace only covers the GCS death-propagation window."""
+        import ray_tpu
+
+        try:
+            if ray_tpu.wait([ref], num_returns=1, timeout=0)[0]:
+                return 30.0  # resolved: the get() below returns immediately
+            info = self._worker.rpc({"type": "actor_info", "aid": aid})
+            if info.get("found") and info.get("state") == "alive":
+                return 30.0
+        except Exception:  # noqa: BLE001 — fall through to the full join
+            return 30.0
+        return 2.0
+
     def __del__(self):
         # executor dropped without teardown: still release the actors and
         # the /dev/shm bytes. No loop joins here — blocking get()s have no
@@ -787,7 +1483,8 @@ class ChannelExecutor:
 
 
 def try_build(root, schedule, *, max_inflight: int,
-              buffer_bytes: int = 1 << 20, dag_id: str | None = None):
+              buffer_bytes: int = 1 << 20, dag_id: str | None = None,
+              enable_retry: bool = False):
     """Partition `schedule` into per-actor exec-loop plans and provision
     the channel plane. Returns (executor, None) on success or
     (None, fallback_reason) when the graph/topology can't ride SPSC
@@ -860,6 +1557,10 @@ def try_build(root, schedule, *, max_inflight: int,
     # ---- partition into per-actor op lists + allocate per-edge channels
     all_chans: list[MutableShmChannel] = []
     topology: list[dict] = []  # channel edges for the DAG registry
+    # shm path → (writer, reader), each "driver" or an actor id: recovery
+    # must know every channel adjacent to a dead actor, which old endpoint
+    # to force-ack, and where to inject rewire markers
+    ends: dict[str, tuple[str, str]] = {}
 
     def new_chan():
         ch = create_mutable_channel(buffer_bytes)
@@ -895,6 +1596,7 @@ def try_build(root, schedule, *, max_inflight: int,
                     # can't be read twice per step
                     ch = new_chan()
                     plans[p_aid]["ops"][p_reg]["out"].append(ch)
+                    ends[ch.path] = (p_aid, aid)
                     topology.append(
                         {"from": plans[p_aid]["ops"][p_reg]["label"],
                          "to": label})
@@ -927,6 +1629,7 @@ def try_build(root, schedule, *, max_inflight: int,
                 ch = new_chan()
                 plan["input"] = ch
                 in_chans.append(ch)
+                ends[ch.path] = ("driver", aid)
                 topology.append({"from": "driver",
                                  "to": f"loop@actor:{aid[:8]}"})
 
@@ -937,13 +1640,16 @@ def try_build(root, schedule, *, max_inflight: int,
             ch = new_chan()
             plans[aid]["ops"][reg]["out"].append(ch)
             out_chans.append(ch)
+            ends[ch.path] = (aid, "driver")
             topology.append({"from": plans[aid]["ops"][reg]["label"],
                              "to": "driver"})
 
         executor = ChannelExecutor(
             worker, plans, aids, in_chans, out_chans, all_chans,
             max_inflight=max_inflight, multi=multi, dag_id=dag_id,
-            sample=sample, metrics_on=metrics_on, topology=topology)
+            sample=sample, metrics_on=metrics_on, topology=topology,
+            ends=ends, buffer_bytes=buffer_bytes,
+            enable_retry=enable_retry)
         executor._provision()
         return executor, None
     except Exception as e:  # noqa: BLE001 — release shm, then fall back

@@ -73,17 +73,27 @@ class DAGNode:
 
     def experimental_compile(self, *, max_inflight_executions: int = 10,
                              enable_channel_execution: bool = True,
-                             channel_buffer_bytes: int = 1 << 20) -> "CompiledDAG":
+                             channel_buffer_bytes: int = 1 << 20,
+                             enable_retry: bool = False) -> "CompiledDAG":
         """Compile the graph for repeated steady-state execution. When the
         topology allows (actor-method nodes only, every actor on the
         driver's host), per-actor execution loops are provisioned over
         mutable-shm channels and each step skips the task-submission
         control plane entirely; otherwise the cached-schedule submit path
-        is used (`CompiledDAG.fallback_reason` says why)."""
+        is used (`CompiledDAG.fallback_reason` says why).
+
+        `enable_retry` mirrors `max_task_retries` semantics for the channel
+        plane's exec-loop recovery: when an actor with restart budget dies
+        mid-step, the driver retains each in-flight input row and REPLAYS
+        it over the rewired plane (execution becomes at-least-once on
+        surviving actors; results stay exactly-once at the driver). Default
+        off: in-flight steps then surface per-step errors naming the dead
+        node while the recovered DAG keeps serving later executions."""
         return CompiledDAG(self,
                            max_inflight_executions=max_inflight_executions,
                            enable_channel_execution=enable_channel_execution,
-                           channel_buffer_bytes=channel_buffer_bytes)
+                           channel_buffer_bytes=channel_buffer_bytes,
+                           enable_retry=enable_retry)
 
 
 class InputNode(DAGNode):
@@ -183,7 +193,8 @@ class CompiledDAG:
 
     def __init__(self, root: DAGNode, *, max_inflight_executions: int = 10,
                  enable_channel_execution: bool = True,
-                 channel_buffer_bytes: int = 1 << 20):
+                 channel_buffer_bytes: int = 1 << 20,
+                 enable_retry: bool = False):
         import uuid
 
         self._root = root
@@ -203,7 +214,8 @@ class CompiledDAG:
 
             self._channel, self._fallback_reason = try_build(
                 root, self._schedule, max_inflight=self._max_inflight,
-                buffer_bytes=channel_buffer_bytes, dag_id=self._dag_id)
+                buffer_bytes=channel_buffer_bytes, dag_id=self._dag_id,
+                enable_retry=enable_retry)
         else:
             self._fallback_reason = "channel execution disabled by caller"
         # observability: every compile registers its metadata in the GCS
@@ -306,14 +318,51 @@ class CompiledDAG:
             ray_tpu.wait(oldest._refs(), num_returns=len(oldest._refs()))
             self._inflight = [f for f in self._inflight if not f.done()]
 
+    def _channel_execute(self, input_value):
+        """One channel-plane submission, degrading THIS DAG to the submit
+        path when the executor reports an unrecoverable actor death.
+        Returns (handled, future)."""
+        from ray_tpu.dag.channel_execution import _PlaneDegraded
+
+        try:
+            return True, self._channel.execute(input_value)
+        except _PlaneDegraded as e:
+            self._degrade_to_submit(e.reason)
+            return False, None
+
+    def _degrade_to_submit(self, reason: str) -> None:
+        """An actor died beyond recovery (no restart budget, cross-host
+        restart, or a timed-out rewire): the channel plane was dismantled,
+        but the DAG keeps serving on the cached-schedule submit path —
+        degrade, don't brick. `fallback_reason` records the death."""
+        import logging
+
+        ex, self._channel = self._channel, None
+        self._fallback_reason = reason
+        logging.getLogger(__name__).warning(
+            "compiled DAG %s: channel plane degraded to the submit path "
+            "(%s)", self._dag_id, reason)
+        try:
+            # idempotent on a degraded executor: joins the already-exited
+            # loops fast, releases the occupancy claims, retires the
+            # driver-side metric series, re-unlinks the shm files
+            ex.teardown(raise_on_error=False)
+        except Exception:  # noqa: BLE001 — degrade must leave a usable DAG
+            pass
+        if self._registered:
+            self._registered = False
+            self._register()  # refresh plane/fallback_reason in the GCS
+
     def execute(self, input_value: Any = None):
         """Submit one execution. Channel plane → a ChannelDAGFuture
         (`.result()` / `await` / `ray_tpu.get()`); submit plane → the
         output ObjectRef(s). Executions overlap up to the cap."""
         if self._torn:
-            raise ValueError("compiled DAG was torn down")
+            raise ValueError(f"compiled DAG {self._dag_id} was torn down")
         if self._channel is not None:
-            return self._channel.execute(input_value)
+            handled, fut = self._channel_execute(input_value)
+            if handled:
+                return fut
         self._reap_inflight()
         out = self._submit_once(input_value)
         self._inflight.append(DAGFuture(out))
@@ -322,9 +371,11 @@ class CompiledDAG:
     def execute_async(self, input_value: Any = None):
         """Submit one execution; returns a future (`.result()`/`await`)."""
         if self._torn:
-            raise ValueError("compiled DAG was torn down")
+            raise ValueError(f"compiled DAG {self._dag_id} was torn down")
         if self._channel is not None:
-            return self._channel.execute(input_value)
+            handled, fut = self._channel_execute(input_value)
+            if handled:
+                return fut
         self._reap_inflight()
         fut = DAGFuture(self._submit_once(input_value))
         self._inflight.append(fut)

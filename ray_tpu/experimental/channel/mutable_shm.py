@@ -167,6 +167,18 @@ class MutableShmChannel:
         except ValueError:
             pass  # already unmapped
 
+    def force_ack(self) -> None:
+        """Driver-side recovery aid: mark whatever the writer last published
+        as consumed (read_seq = write_seq) so a writer blocked on a DEAD
+        reader's ack can finish its write and reach its next channel read
+        (where the rewire message is waiting). Violates SPSC on purpose —
+        only ever called while the channel's real reader is known dead."""
+        try:
+            w, _r, _n, _c = self._hdr()
+            self._set(read_seq=w)
+        except ValueError:
+            pass  # already unmapped
+
     def unlink(self) -> None:
         try:
             os.unlink(self.path)

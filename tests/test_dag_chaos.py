@@ -1,0 +1,309 @@
+"""Compiled-DAG exec-loop recovery chaos: SIGKILL pipeline actors mid-step
+and assert the channel plane recovers in place.
+
+(reference capability: lineage-based recovery as a first-class dataplane
+property — Ray paper arXiv:1712.05889 §4; preemption-tolerant execution on
+TPU slices is table stakes, arXiv:2605.25645.)
+
+The headline test kills a random pipeline actor's worker process with work
+in flight on a DAG compiled with `enable_retry=True`: the driver must wait
+out the core actor restart, re-provision that actor's exec loop over fresh
+shm channels, rewire the surviving loops in band (no survivor restarts),
+replay the in-flight window from its retained input rows, and keep serving
+— same dag_id, channel plane still active, results exactly-once at the
+driver, zero leaked `/dev/shm/rtpu_chan_*` segments or occupancy-registry
+claims. A non-restartable actor's death instead degrades the DAG to the
+submit-path fallback (`fallback_reason="actor_death: ..."`) without
+bricking it. The long randomized kill loop stays behind `-m slow` so
+tier-1 stays fast (style: test_autoscaler_chaos.py / test_storage_chaos.py).
+"""
+
+import glob
+import os
+import random
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+from ray_tpu.exceptions import ActorDiedError
+
+pytestmark = pytest.mark.dag_chaos
+
+N_STAGES = 4
+
+
+def _shm_chans():
+    return set(glob.glob("/dev/shm/rtpu_chan_*"))
+
+
+@pytest.fixture
+def chaos_cluster():
+    ray_tpu.shutdown()
+    before = _shm_chans()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=12)
+    yield before
+    ray_tpu.shutdown()
+    leaked = _shm_chans() - before
+    assert not leaked, f"/dev/shm channel leak: {leaked}"
+
+
+@ray_tpu.remote(max_restarts=-1)
+class Stage:
+    """Stateless transform (restarts reconstruct it bit-identical), with an
+    optional per-step delay so a SIGKILL deterministically lands mid-step
+    and an init delay so a restart can't outrun a recovery deadline."""
+
+    def __init__(self, bias, step_delay=0.0, init_delay=0.0):
+        if init_delay:
+            time.sleep(init_delay)
+        self.bias = bias
+        self.step_delay = step_delay
+
+    def work(self, x):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        return x + self.bias
+
+
+def _pipeline(actors):
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        node = inp
+        for a in actors:
+            node = a.work.bind(node)
+    return node
+
+
+def _pid_of(actor) -> int:
+    rows = _api._get_worker().rpc({"type": "list_workers"}).get("workers", [])
+    return next(r["pid"] for r in rows
+                if r.get("actor_id") == actor._actor_id and not r.get("dead"))
+
+
+def _sigkill(actor) -> int:
+    pid = _pid_of(actor)
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def _recovered_count(dag_id: str, outcome: str) -> float:
+    from ray_tpu.util import metrics
+
+    for m in metrics.snapshot():
+        if m["name"] != "ray_tpu_dag_recoveries_total":
+            continue
+        for tags, value in m["series"]:
+            t = dict(tuple(kv) for kv in tags)
+            if t.get("dag_id") == dag_id and t.get("outcome") == outcome:
+                return value
+    return 0.0
+
+
+def test_sigkill_mid_step_recovers_with_replay(chaos_cluster):
+    """Headline: SIGKILL a random restartable pipeline actor under load on
+    an enable_retry DAG → the plane rewires in place and replays."""
+    rng = random.Random(0xDA6C4A05)
+    actors = [Stage.remote(1) for _ in range(N_STAGES)]
+    compiled = _pipeline(actors).experimental_compile(
+        enable_retry=True, max_inflight_executions=4)
+    assert compiled.uses_channels, compiled.fallback_reason
+    dag_id = compiled.dag_id
+    for i in range(5):
+        assert compiled.execute(i).result(timeout=60) == i + N_STAGES
+
+    futs = [compiled.execute(100 + i) for i in range(4)]  # window is full
+    _sigkill(rng.choice(actors))
+    futs += [compiled.execute(104 + i) for i in range(8)]
+    # strict equality over EVERY seq is the exactly-once check: a lost or
+    # duplicated replay row would shift all later results off by one
+    assert [f.result(timeout=120) for f in futs] == [
+        100 + i + N_STAGES for i in range(12)]
+
+    # recovered IN PLACE: same dag_id, channel plane still active, no
+    # submit-path degrade
+    assert compiled.uses_channels and compiled.fallback_reason is None
+    assert compiled.dag_id == dag_id
+    assert compiled._channel.recoveries >= 1
+    # replayed futures are repeatable (cached row), not re-executed
+    assert futs[0].result() == 100 + N_STAGES
+
+    # observability: the recovery counter and a timeline span both landed
+    assert _recovered_count(dag_id, "recovered") >= 1
+    deadline = time.monotonic() + 20
+    spans = []
+    while time.monotonic() < deadline and not spans:
+        spans = [e for e in _api.timeline()
+                 if e.get("event") == "dag:recovery"
+                 and e.get("dag_id") == dag_id]
+        time.sleep(0.25)
+    assert spans and spans[0].get("outcome") == "recovered"
+
+    assert compiled.execute(7).result(timeout=60) == 7 + N_STAGES
+    compiled.teardown()
+    # occupancy registry must be claim-free (a leak here silently hangs
+    # the next compile over these actors)
+    from ray_tpu.dag.channel_execution import _occupied_actors
+
+    assert not _occupied_actors
+    assert not _shm_chans() - chaos_cluster
+
+
+def test_death_without_retry_fails_steps_keeps_serving(chaos_cluster):
+    """enable_retry=False (default): in-flight steps at the kill surface
+    as per-step errors naming the dead node; the RECOVERED plane keeps
+    serving subsequent executions over channels."""
+    actors = [Stage.remote(1, step_delay=0.3) for _ in range(2)]
+    compiled = _pipeline(actors).experimental_compile(
+        max_inflight_executions=4)
+    assert compiled.uses_channels, compiled.fallback_reason
+    assert compiled.execute(0).result(timeout=60) == 2
+
+    futs = [compiled.execute(10 + i) for i in range(3)]
+    _sigkill(actors[1])  # step_delay guarantees work is in flight
+    outcomes = []
+    for f in futs:
+        try:
+            outcomes.append(f.result(timeout=120))
+        except ActorDiedError as e:
+            outcomes.append(e)
+    errs = [o for o in outcomes if isinstance(o, ActorDiedError)]
+    assert errs, f"no in-flight step failed: {outcomes}"
+    # the error names the dead node and points at the replay knob
+    assert "work@actor:" in str(errs[0]) and "enable_retry" in str(errs[0])
+
+    # the plane recovered: later steps ride the channels, exact results
+    assert compiled.uses_channels and compiled._channel.recoveries >= 1
+    assert [compiled.execute(20 + i).result(timeout=60)
+            for i in range(3)] == [22 + i for i in range(3)]
+    compiled.teardown()
+    assert not _shm_chans() - chaos_cluster
+
+
+def test_unrestartable_death_degrades_to_submit_path(chaos_cluster):
+    """An actor with no restart budget dying must degrade the DAG to the
+    submit-path fallback (fallback_reason="actor_death: ...") instead of
+    bricking it."""
+
+    @ray_tpu.remote  # max_restarts=0: no budget
+    class Frail:
+        def work(self, x):
+            time.sleep(0.3)
+            return x + 1
+
+    a, b = Frail.remote(), Frail.remote()
+    compiled = _pipeline([a, b]).experimental_compile(
+        max_inflight_executions=4)
+    assert compiled.uses_channels, compiled.fallback_reason
+    dag_id = compiled.dag_id
+    assert compiled.execute(0).result(timeout=60) == 2
+
+    futs = [compiled.execute(i) for i in range(2)]
+    _sigkill(b)
+    for f in futs:
+        with pytest.raises(ActorDiedError, match="work@actor:"):
+            f.result(timeout=120)
+
+    # the NEXT submission flips the DAG to the submit plane — no
+    # "torn down", no RayChannelError: the DAG object stays usable
+    out = compiled.execute(5)
+    assert not compiled.uses_channels
+    assert compiled.fallback_reason.startswith("actor_death")
+    assert _recovered_count(dag_id, "degraded") >= 1
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(out, timeout=60)  # b is still dead on the submit plane
+    # the surviving actor's exec loop was joined: it serves normal calls
+    assert ray_tpu.get(a.work.remote(1), timeout=60) == 2
+    compiled.teardown()
+    assert not _shm_chans() - chaos_cluster
+
+
+def test_degraded_dag_honors_max_task_retries(chaos_cluster):
+    """Satellite: a compiled-then-degraded DAG rides the normal actor
+    retry machinery — in-flight submit-plane calls lost to a later death
+    are retried per the actor's max_task_retries budget (-1 = until they
+    land, 0 = fail immediately), never forever and never not-at-all."""
+    from ray_tpu._private.ray_config import RayConfig
+
+    cfg = RayConfig.instance()
+    old_budget = cfg.dag_recovery_timeout_s
+
+    def degraded_dag(actor):
+        compiled = _pipeline([actor]).experimental_compile()
+        assert compiled.uses_channels, compiled.fallback_reason
+        assert compiled.execute(0).result(timeout=60) == 1
+        # zero recovery budget + slow restart (init_delay) → the kill
+        # degrades the plane instead of rewiring it
+        cfg.dag_recovery_timeout_s = 0.0
+        try:
+            _sigkill(actor)
+            with pytest.raises(ActorDiedError):
+                compiled.execute(1).result(timeout=120)
+            flip_ref = compiled.execute(2)  # flips to the submit plane
+        finally:
+            cfg.dag_recovery_timeout_s = old_budget
+        assert not compiled.uses_channels
+        assert compiled.fallback_reason.startswith("actor_death")
+        _api._get_worker().wait_actor_ready(actor._actor_id, timeout=60)
+        # drain the flip step so the actor is IDLE: the next execute must
+        # be the one in flight when the chaos kill lands (a queued-not-
+        # dispatched spec survives restarts regardless of the budget)
+        assert ray_tpu.get(flip_ref, timeout=120) == 3
+        return compiled
+
+    # -1: an in-flight call lost to a death is retried until it lands
+    patient = Stage.options(max_task_retries=-1).remote(
+        1, step_delay=0.4, init_delay=1.0)
+    compiled = degraded_dag(patient)
+    ref = compiled.execute(10)
+    time.sleep(0.1)  # let the step dispatch so the kill hits it in flight
+    _sigkill(patient)
+    assert ray_tpu.get(ref, timeout=120) == 11
+    compiled.teardown()
+
+    # 0: the lost call fails; the NEXT call (restarted actor) succeeds —
+    # the budget is honored, not ignored in either direction
+    frail = Stage.options(max_task_retries=0).remote(
+        1, step_delay=0.4, init_delay=1.0)
+    compiled = degraded_dag(frail)
+    ref = compiled.execute(10)
+    time.sleep(0.1)
+    _sigkill(frail)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(ref, timeout=120)
+    _api._get_worker().wait_actor_ready(frail._actor_id, timeout=60)
+    assert ray_tpu.get(compiled.execute(3), timeout=120) == 4
+    compiled.teardown()
+
+
+@pytest.mark.slow
+def test_randomized_kill_loop(chaos_cluster):
+    """Sustained load with a SIGKILL of a random stage every round —
+    repeated recoveries (including deaths DURING a recovery) must keep the
+    plane exact and leak-free."""
+    rng = random.Random(0xBADC0DE5)
+    actors = [Stage.remote(1) for _ in range(N_STAGES)]
+    compiled = _pipeline(actors).experimental_compile(
+        enable_retry=True, max_inflight_executions=4)
+    assert compiled.uses_channels, compiled.fallback_reason
+    seq = 0
+    for _round in range(5):
+        futs = [compiled.execute(seq + i) for i in range(4)]
+        try:
+            _sigkill(rng.choice(actors))
+        except StopIteration:
+            pass  # victim mid-restart from the previous round: still chaos
+        futs += [compiled.execute(seq + 4 + i) for i in range(6)]
+        assert [f.result(timeout=120) for f in futs] == [
+            seq + i + N_STAGES for i in range(10)]
+        assert compiled.uses_channels, compiled.fallback_reason
+        seq += 10
+    assert compiled._channel.recoveries >= 2
+    compiled.teardown()
+    from ray_tpu.dag.channel_execution import _occupied_actors
+
+    assert not _occupied_actors
+    assert not _shm_chans() - chaos_cluster

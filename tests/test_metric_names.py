@@ -19,6 +19,27 @@ def test_package_metric_names_are_canonical():
     assert not bad, "\n".join(f"{p}:{ln}: {name!r}" for p, ln, name in bad)
 
 
+def test_expected_exported_metrics_still_constructed():
+    """The flagship exported families (incl. the compiled-DAG recovery
+    counter) must keep their exact names: dashboards and relabel rules key
+    on them, so a rename fails here, not in a scrape."""
+    missing = check_metric_names.check_expected(os.path.join(REPO, "ray_tpu"))
+    assert not missing, f"expected metrics no longer constructed: {missing}"
+    assert ("ray_tpu_dag_recoveries_total"
+            in check_metric_names.EXPECTED_METRICS)
+
+
+def test_checker_flags_expected_removal(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "from ray_tpu.util.metrics import Counter\n"
+        "c = Counter('ray_tpu_dag_recoveries_total')\n")
+    assert check_metric_names.check_expected(str(pkg)) == [
+        n for n in check_metric_names.EXPECTED_METRICS
+        if n != "ray_tpu_dag_recoveries_total"]
+
+
 def test_checker_flags_bad_names(tmp_path):
     src = tmp_path / "mod.py"
     src.write_text(

@@ -23,6 +23,21 @@ METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
 # module objects whose .Counter etc. are NOT metrics
 _NON_METRIC_BASES = {"collections", "typing"}
 
+# Flagship EXPORTED metric families (literal constructor names only — the
+# per-phase DAG step histograms use an f-string and are covered by the
+# namespace head check above). Dashboards, Prometheus relabeling rules,
+# and the README "Observability" tables key on these exact strings: a
+# rename or removal must fail this check, not be discovered in a scrape.
+EXPECTED_METRICS = (
+    "ray_tpu_dag_recoveries_total",
+    "ray_tpu_dag_step_backpressure_drain_seconds",
+    "ray_tpu_autoscaler_instance_transitions_total",
+    "ray_tpu_autoscaler_reconcile_seconds",
+    "ray_tpu_storage_retries_total",
+    "ray_tpu_storage_commit_seconds",
+    "ray_tpu_serve_requests_total",
+)
+
 
 def _ctor_name(func: ast.expr) -> str | None:
     if isinstance(func, ast.Name):
@@ -51,20 +66,23 @@ def _literal_name_arg(call: ast.Call) -> ast.expr | None:
     return None
 
 
-def check_file(path: str) -> list[tuple[str, int, str]]:
+def scan_file(path: str) -> tuple[list[tuple[str, int, str]], set[str]]:
+    """One parse: (violations, literal metric names constructed here)."""
     with open(path, encoding="utf-8") as f:
         try:
             tree = ast.parse(f.read(), path)
         except SyntaxError as e:
-            return [(path, e.lineno or 0, f"<syntax error: {e.msg}>")]
-    bad = []
+            return [(path, e.lineno or 0, f"<syntax error: {e.msg}>")], set()
+    bad: list[tuple[str, int, str]] = []
+    names: set[str] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         arg = _literal_name_arg(node)
-        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
-                and not NAME_RE.match(arg.value)):
-            bad.append((path, node.lineno, arg.value))
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            names.add(arg.value)
+            if not NAME_RE.match(arg.value):
+                bad.append((path, node.lineno, arg.value))
         elif isinstance(arg, ast.JoinedStr):
             # f-string name: the leading LITERAL segment must already
             # carry the canonical prefix (e.g. f"ray_tpu_dag_step_{p}_s")
@@ -76,16 +94,33 @@ def check_file(path: str) -> list[tuple[str, int, str]]:
             if not re.match(r"^ray_tpu_[a-z0-9_]*$", head_str):
                 bad.append((path, node.lineno,
                             f"<f-string head {head_str!r}>"))
-    return bad
+    return bad, names
+
+
+def scan_tree(root: str) -> tuple[list[tuple[str, int, str]], set[str]]:
+    bad: list[tuple[str, int, str]] = []
+    names: set[str] = set()
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                fb, fn = scan_file(os.path.join(dirpath, fname))
+                bad.extend(fb)
+                names.update(fn)
+    return bad, names
+
+
+def check_file(path: str) -> list[tuple[str, int, str]]:
+    return scan_file(path)[0]
 
 
 def check_tree(root: str) -> list[tuple[str, int, str]]:
-    bad = []
-    for dirpath, _dirs, files in os.walk(root):
-        for name in sorted(files):
-            if name.endswith(".py"):
-                bad.extend(check_file(os.path.join(dirpath, name)))
-    return bad
+    return scan_tree(root)[0]
+
+
+def check_expected(root: str) -> list[str]:
+    """EXPECTED_METRICS entries no longer constructed anywhere."""
+    present = scan_tree(root)[1]
+    return [n for n in EXPECTED_METRICS if n not in present]
 
 
 def main(argv=None) -> int:
@@ -93,12 +128,17 @@ def main(argv=None) -> int:
     root = args[0] if args else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "ray_tpu")
-    bad = check_tree(root)
+    bad, present = scan_tree(root)
     for path, line, name in bad:
         print(f"{path}:{line}: metric name {name!r} does not match "
               f"{NAME_RE.pattern}")
-    if bad:
-        print(f"{len(bad)} non-canonical metric name(s)", file=sys.stderr)
+    missing = [n for n in EXPECTED_METRICS if n not in present]
+    for name in missing:
+        print(f"expected exported metric {name!r} is no longer "
+              f"constructed anywhere under {root}")
+    if bad or missing:
+        print(f"{len(bad)} non-canonical / {len(missing)} missing "
+              f"metric name(s)", file=sys.stderr)
         return 1
     return 0
 
