@@ -126,7 +126,7 @@ void* conn_main(void* argp) {
     if (!read_exact(fd, &oid_len, sizeof(oid_len))) break;
     if (oid_len == 0 || oid_len > kMaxOidLen) break;
     std::string oid(oid_len, '\0');
-    if (!read_exact(fd, oid.data(), oid_len)) break;
+    if (!read_exact(fd, &oid[0], oid_len)) break;
     uint64_t size = kNotFound;
     int obj_fd = -1;
     if (oid_ok(oid)) obj_fd = open_object(s, oid, &size);

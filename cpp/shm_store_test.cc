@@ -1,6 +1,7 @@
 // C-level test for the shm arena store: create/seal/get/release/delete,
 // eviction under pressure, pin semantics, hole coalescing, multi-process
-// sharing through fork. Exits 0 on success; any failed check aborts.
+// sharing through fork, no-evict create + LRU victim query, and dead-pid
+// pin reaping. Exits 0 on success; any failed check aborts.
 //
 // Build+run (also driven by tests/test_shm_arena.py):
 //   g++ -O2 -o shm_store_test shm_store_test.cc -ldl -lpthread && ./shm_store_test
@@ -22,6 +23,10 @@ typedef int (*rel_fn)(void*, const char*);
 typedef int (*contains_fn)(void*, const char*);
 typedef int (*del_fn)(void*, const char*);
 typedef uint64_t (*used_fn)(void*);
+typedef int64_t (*create2_fn)(void*, const char*, uint64_t);
+typedef int (*victim_fn)(void*, char*);
+typedef int (*reap_fn)(void*);
+typedef int (*relpid_fn)(void*, int32_t);
 
 #define CHECK(cond)                                                     \
   do {                                                                  \
@@ -47,7 +52,14 @@ int main(int argc, char** argv) {
   auto store_contains = (contains_fn)dlsym(dl, "rtpu_store_contains");
   auto store_delete = (del_fn)dlsym(dl, "rtpu_store_delete");
   auto store_used = (used_fn)dlsym(dl, "rtpu_store_used");
+  auto store_create_noevict =
+      (create2_fn)dlsym(dl, "rtpu_store_create_noevict");
+  auto store_lru_victim = (victim_fn)dlsym(dl, "rtpu_store_lru_victim");
+  auto store_reap_dead = (reap_fn)dlsym(dl, "rtpu_store_reap_dead");
+  auto store_release_pid = (relpid_fn)dlsym(dl, "rtpu_store_release_pid");
   CHECK(store_open && store_create && store_seal && store_get);
+  CHECK(store_create_noevict && store_lru_victim && store_reap_dead &&
+        store_release_pid);
 
   // 1 MiB arena
   void* s = store_open(arena, 1 << 20, 1);
@@ -121,6 +133,71 @@ int main(int argc, char** argv) {
   waitpid(pid, &status, 0);
   CHECK(WIFEXITED(status) && WEXITSTATUS(status) == 0);
   CHECK(store_contains(s, "from_child") == 1);
+
+  // no-evict create: a full arena reports -1 instead of evicting; the LRU
+  // victim query names the object an orchestrated spill would take
+  for (int i = 0; i < 10; i++) {
+    char oid[32];
+    snprintf(oid, sizeof oid, "ne_fill_%d", i);
+    int64_t r = store_create(s, oid, 128 * 1024);
+    if (r > 0) store_seal(s, oid);
+  }
+  uint64_t used_before = store_used(s);
+  CHECK(store_create_noevict(s, "ne_big", 256 * 1024) == -1);
+  CHECK(store_used(s) == used_before);                        // nothing evicted
+  CHECK(store_create_noevict(s, "ne_huge", 4ull << 20) == -4);  // > capacity
+  char victim[48];
+  int64_t off2;
+  while ((off2 = store_create_noevict(s, "ne_big", 256 * 1024)) == -1) {
+    CHECK(store_lru_victim(s, victim) == 0);
+    CHECK(store_contains(s, victim) == 1);
+    CHECK(store_delete(s, victim) == 0);  // what an orchestrated spill does
+  }
+  CHECK(off2 > 0);
+  CHECK(store_seal(s, "ne_big") == 0);
+
+  // dead-pid pin reaping: a child pins an object and exits WITHOUT
+  // releasing; the parent reaps the orphaned pin so eviction can't wedge
+  pid_t pinner = fork();
+  if (pinner == 0) {
+    void* cs = store_open(arena, 1 << 20, 0);
+    if (!cs) _exit(2);
+    uint64_t psz;
+    if (store_get(cs, "ne_big", &psz) <= 0) _exit(3);
+    _exit(0);  // dies holding the pin (no release, no close)
+  }
+  waitpid(pinner, &status, 0);
+  CHECK(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  CHECK(store_reap_dead(s) == 1);  // exactly the orphaned pin
+  CHECK(store_reap_dead(s) == 0);  // idempotent
+
+  // kCreating protection: a live writer's in-progress entry must not be
+  // reclaimed by a concurrent create of the same id…
+  CHECK(store_create(s, "inflight", 1024) > 0);
+  CHECK(store_create(s, "inflight", 1024) == -2);
+  CHECK(store_create_noevict(s, "inflight", 1024) == -2);
+  CHECK(store_seal(s, "inflight") == 0);
+  // …but a DEAD writer's unsealed entry is reclaimed and re-creatable
+  pid_t creator = fork();
+  if (creator == 0) {
+    void* cs = store_open(arena, 1 << 20, 0);
+    if (!cs) _exit(2);
+    if (store_create(cs, "orphaned", 2048) <= 0) _exit(3);
+    _exit(0);  // dies mid-put, entry left kCreating
+  }
+  waitpid(creator, &status, 0);
+  CHECK(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  CHECK(store_create(s, "orphaned", 2048) > 0);
+  CHECK(store_seal(s, "orphaned") == 0);
+
+  // release_pid: clean-exit bulk release of this process's pins
+  uint64_t s1, s2;
+  CHECK(store_get(s, "ne_big", &s1) > 0);
+  CHECK(store_get(s, "ne_big", &s2) > 0);
+  CHECK(store_release_pid(s, (int32_t)getpid()) == 2);
+  uint64_t used2 = store_used(s);
+  CHECK(store_delete(s, "ne_big") == 0);
+  CHECK(store_used(s) == used2 - 256 * 1024);  // freed NOW → refs were 0
 
   store_close(s);
   unlink(arena);

@@ -115,7 +115,10 @@ def init(
             while _time.monotonic() < deadline:
                 if _worker.cluster_state()["num_workers"] >= num_workers:
                     break
-                _time.sleep(0.05)
+                # fine-grained poll: a 50ms step quantizes every session
+                # start to multiples of it (worker boot is ~300-500ms, so
+                # 10ms shaves a mean ~20-40ms off every init in the suite)
+                _time.sleep(0.01)
         return {"session_id": _node.session_id, "session_dir": _node.session_dir}
 
 

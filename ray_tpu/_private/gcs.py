@@ -998,6 +998,11 @@ class GcsServer:
                                   pin=msg.get("pin", False),
                                   contained=msg.get("contained"),
                                   tier=msg.get("tier", "shm"))
+        elif t == "objects_evicted":
+            # arena evict-to-spill on some host: those copies left tmpfs
+            # (still readable from that host's spill tier)
+            self._on_objects_evicted(msg.get("host") or HEAD_HOST,
+                                     msg.get("oids") or [])
         elif t == "lease_workers":
             self._lease_workers(conn, msg, wid)
         elif t == "return_lease":
@@ -1878,11 +1883,33 @@ class GcsServer:
             return []
         return self._unpin_args_locked(spec)
 
+    def _on_objects_evicted(self, host: str, oids: list) -> None:
+        """A host's arena pushed these objects down to its spill tier to
+        make room: drop them from that host's tmpfs accounting so
+        `_maybe_spill` and the object directory's tier info stay truthful.
+        The host keeps serving them (spill-tier reads are transparent), so
+        the location set is untouched."""
+        with self.lock:
+            for oid in oids:
+                e = self.objects.get(oid)
+                if e is not None and host in e.get("shm_live", ()):
+                    e["shm_live"].discard(host)
+                    self.host_shm_bytes[host] -= e.get("size", 0)
+
     def _head_store(self):
         if getattr(self, "_head_store_obj", None) is None:
+            if self.stopped:
+                # a straggler thread lazily constructing the store AFTER
+                # session teardown would recreate the just-unlinked arena
+                # segment in /dev/shm — refuse instead (callers tolerate)
+                raise RuntimeError("GCS stopped; head store torn down")
             from ray_tpu._private.object_store import make_object_store
 
             self._head_store_obj = make_object_store(self.session_id)
+            if hasattr(self._head_store_obj, "on_evict"):
+                # the GCS runs in the driver process: account directly
+                self._head_store_obj.on_evict = (
+                    lambda oids: self._on_objects_evicted(HEAD_HOST, oids))
         return self._head_store_obj
 
     def _free_objects(self, oids: list[str]):

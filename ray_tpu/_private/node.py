@@ -20,7 +20,8 @@ from ray_tpu._private import accelerators
 from ray_tpu._private.accelerators import detect_num_tpu_chips  # noqa: F401 (re-export)
 from ray_tpu._private.gcs import GcsServer
 from ray_tpu._private.ray_config import RayConfig
-from ray_tpu._private.object_store import ShmObjectStore
+from ray_tpu._private.object_store import make_object_store
+from ray_tpu._private.procutil import drain_procs
 
 
 class Node:
@@ -65,7 +66,6 @@ class Node:
         self.gcs.start()
         # the head host's object-plane server: follower hosts pull shm
         # objects from here (and vice versa) over chunked TCP
-        from ray_tpu._private.object_store import make_object_store
         from ray_tpu._private.object_transfer import make_object_server
 
         self.object_server = make_object_server(make_object_store(self.session_id))
@@ -185,10 +185,7 @@ class Node:
         self._renv_agent.stop()
         self.object_server.stop()
         self.gcs.stop()
-        deadline = time.monotonic() + 3.0
-        for p in self._procs:
-            try:
-                p.wait(timeout=max(0.05, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                p.kill()
-        ShmObjectStore(self.session_id).cleanup_session()
+        drain_procs(self._procs)
+        # backend-aware teardown: the arena backend must also unlink its
+        # /dev/shm segment and spill dir, not just per-object files
+        make_object_store(self.session_id).cleanup_session()

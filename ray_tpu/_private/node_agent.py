@@ -26,6 +26,7 @@ from ray_tpu._private import accelerators
 from ray_tpu._private.log_monitor import LogMonitor
 from ray_tpu._private.object_store import make_object_store
 from ray_tpu._private.object_transfer import make_object_server
+from ray_tpu._private.procutil import drain_procs
 from ray_tpu._private.protocol import ConnectionClosed, connect_address
 
 
@@ -47,6 +48,10 @@ class NodeAgent:
         # free; on one machine the namespace keeps the stores honest-disjoint)
         self.store_ns = f"{self.session_id}_{self.host_id}"
         self.store = make_object_store(self.store_ns)
+        if hasattr(self.store, "on_evict"):
+            # arena backend: local evict-to-spill must reach the GCS
+            # accountant, same as the head workers' hook
+            self.store.on_evict = self._report_evictions
         self.obj_server = make_object_server(self.store)
 
         base = session_dir or os.path.join("/tmp", "ray_tpu")
@@ -143,6 +148,13 @@ class NodeAgent:
             if reply.get("rid") == msg["rid"]:
                 return reply
             self._dispatch(reply)
+
+    def _report_evictions(self, oids: list) -> None:
+        try:
+            self.conn.send({"type": "objects_evicted",
+                            "host": self.host_id, "oids": list(oids)})
+        except ConnectionClosed:
+            pass
 
     def _forward_log(self, source: str, line: str):
         try:
@@ -270,12 +282,12 @@ class NodeAgent:
         self._renv_agent.stop()
         self.log_monitor.stop()
         self.obj_server.stop()
-        deadline = time.monotonic() + 3.0
-        for p in self._procs:
+        drain_procs(self._procs)
+        if hasattr(self.store, "release_pid_pins"):
             try:
-                p.wait(timeout=max(0.05, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
-                p.kill()
+                self.store.release_pid_pins()
+            except Exception:
+                pass
         self.store.cleanup_session()
 
 

@@ -11,23 +11,44 @@ single mapped arena instead of one file per object.
 
 from __future__ import annotations
 
+import logging
 import mmap
 import os
 from typing import Iterable
+
+logger = logging.getLogger(__name__)
 
 SHM_DIR = "/dev/shm"
 
 
 def make_object_store(session_id: str):
-    """Backend selector: RAY_TPU_STORE_BACKEND=arena uses the native C++
-    arena (bounded capacity + LRU eviction, cpp/shm_store.cc); the default
-    is one tmpfs file per object."""
+    """Backend selector: the default is the native C++ arena (one mmap'd
+    segment, bounded capacity, LRU evict-to-spill — cpp/shm_store.cc);
+    RAY_TPU_STORE_BACKEND=file selects one tmpfs file per object.
+
+    A broken/missing toolchain (no g++, failed compile) degrades to the
+    file backend with a warning instead of failing ray_tpu.init(). The
+    choice is pinned into this process's environment so every child this
+    host spawns inherits the SAME backend — processes of one session
+    disagreeing on where objects live would strand every put."""
     from ray_tpu._private.ray_config import RayConfig
 
     if RayConfig.get("store_backend") == "arena":
-        from ray_tpu._private.shm_arena import ArenaStore
+        try:
+            from ray_tpu._private import shm_arena
 
-        return ArenaStore(session_id)
+            # only the build/load step may degrade: a transient runtime
+            # error constructing the store (fd exhaustion, bad mount) must
+            # propagate — one process silently flipping backends mid-session
+            # would strand every object it writes
+            shm_arena._ensure_lib()
+        except Exception as e:  # CalledProcessError / missing g++ / dlopen
+            logger.warning(
+                "native shm arena unavailable (%s: %s); falling back to the "
+                "file object-store backend", type(e).__name__, e)
+            os.environ["RAY_TPU_STORE_BACKEND"] = "file"
+        else:
+            return shm_arena.ArenaStore(session_id)
     return ShmObjectStore(session_id)
 
 

@@ -119,14 +119,21 @@ class ObjectPlaneServer:
                 except (FileNotFoundError, OSError):
                     conn.send({"ok": False, "error": "not found"})
                     continue
-                buf = obj.buf
-                size = buf.nbytes if hasattr(buf, "nbytes") else len(buf)
-                conn.send({"ok": True, "size": size})
-                for off in range(0, size, CHUNK):
-                    conn.send({"data": bytes(buf[off:off + CHUNK])})
-                # arena objects pin until released; file objects GC with obj
-                if hasattr(obj, "release"):
-                    obj.release()
+                try:
+                    # chunked sends straight off the pinned view: the pin
+                    # keeps the arena run alive under concurrent eviction,
+                    # no staging copy of the whole object is ever made
+                    buf = obj.buf
+                    size = buf.nbytes if hasattr(buf, "nbytes") else len(buf)
+                    conn.send({"ok": True, "size": size})
+                    for off in range(0, size, CHUNK):
+                        conn.send({"data": bytes(buf[off:off + CHUNK])})
+                finally:
+                    # arena objects pin until released (file objects GC with
+                    # obj); release even on a broken send, or the pin leaks
+                    # and wedges eviction for the whole session
+                    if hasattr(obj, "release"):
+                        obj.release()
         except ConnectionClosed:
             pass
         except Exception:

@@ -37,10 +37,14 @@ class RayConfig:
     # Per-host shm store capacity in bytes before LRU spill kicks in (0 = no
     # limit). Mirrors plasma's capacity + eviction threshold.
     object_store_capacity: int = 0
-    # Arena-backend capacity (cpp/shm_store.cc) in bytes.
+    # Arena-backend capacity (cpp/shm_store.cc) in bytes (capped at 80% of
+    # what /dev/shm can back at arena-creation time).
     store_capacity: int = 1 << 30
-    # Store backend: "file" (tmpfs file-per-object) or "arena" (native C++).
-    store_backend: str = "file"
+    # Store backend: "arena" (native C++ single-segment arena with LRU
+    # evict-to-spill — the default: O(1) tmpfs inodes, bounded memory) or
+    # "file" (one tmpfs file per object — the debuggable fallback, also
+    # what init() degrades to when no C++ toolchain can build the arena).
+    store_backend: str = "arena"
     # Inline-object threshold: values ≤ this many bytes live in the GCS
     # table instead of shm (reference: memory_store small-object tier).
     inline_object_limit: int = 64 * 1024

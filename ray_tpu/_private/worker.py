@@ -234,6 +234,12 @@ class CoreWorker:
         # machine is naturally disjoint; on one box the env keeps it honest)
         self.store = make_object_store(
             os.environ.get("RAY_TPU_STORE_NS", session_id))
+        if hasattr(self.store, "on_evict"):
+            # arena backend: a put that evict-spills LRU victims to disk
+            # must tell the GCS those copies left tmpfs, or its per-host
+            # accounting and the object directory's tier info go stale
+            self.store.on_evict = self._report_evictions
+        self._reported_evictions = 0  # store.evictions already counted
         self._fetcher = None  # lazy ObjectFetcher for cross-host pulls
         self._stream_acks: dict[str, int] = {}  # producing streams: consumed idx
         self._stream_events: dict[str, threading.Event] = {}
@@ -360,6 +366,42 @@ class CoreWorker:
                 last_metrics = now
                 self._flush_telemetry()
 
+    def _report_evictions(self, oids: list) -> None:
+        """on_evict hook (arena backend): fire-and-forget accounting update
+        so GCS `tier_of`/tmpfs bookkeeping track local evict-to-spill."""
+        try:
+            self.send_no_reply({"type": "objects_evicted",
+                                "host": self.host_id, "oids": list(oids)})
+        except Exception:
+            pass  # accounting drift is recoverable; the put must not fail
+
+    def _record_store_metrics(self, _met) -> None:
+        """Arena accounting → exported gauges/counter. Gauges carry a host
+        tag — each host has its own arena, and an unlabeled series would
+        flip-flop between hosts at newest-source-wins aggregation; within
+        one host every process reports the same shared-header value. The
+        eviction counter is per-process (this process's evict-spills) so
+        source summation stays correct."""
+        store = self.store
+        if not hasattr(store, "used"):
+            return  # file backend: no bounded arena to meter
+        tags = {"host": self.host_id}
+        _met.get_or_create(
+            _met.Gauge, "ray_tpu_object_store_used",
+            "bytes live in this host's shm arena",
+        ).set(float(store.used()), tags=tags)
+        _met.get_or_create(
+            _met.Gauge, "ray_tpu_object_store_capacity",
+            "shm arena data-region capacity in bytes",
+        ).set(float(store.capacity()), tags=tags)
+        delta = store.evictions - self._reported_evictions
+        if delta > 0:
+            _met.get_or_create(
+                _met.Counter, "ray_tpu_object_store_evictions_total",
+                "objects this process evict-spilled from the arena to disk",
+            ).inc(delta, tags=tags)
+            self._reported_evictions = store.evictions
+
     def _flush_telemetry(self):
         """Ship user metrics + task/profile events to the GCS (reference:
         task_event_buffer.h batching; metrics agent reporting)."""
@@ -367,6 +409,7 @@ class CoreWorker:
             from ray_tpu._private import task_events as _te
             from ray_tpu.util import metrics as _met
 
+            self._record_store_metrics(_met)
             events = _te.drain()
             if events:
                 for ev in events:
@@ -1892,6 +1935,17 @@ class CoreWorker:
             self._flush_ref_deltas()
         except Exception:
             pass
+        if hasattr(self.store, "release_pid_pins") and self.kind != "driver":
+            # clean-exit pin release: views this process still holds must
+            # not keep blocking arena eviction after it is gone. Driver
+            # processes are excluded: the pid-keyed sweep would also revoke
+            # pins held by the in-process object server / GCS head store
+            # (same pid, other ArenaStore instances), which may still be
+            # serving a chunked send during shutdown.
+            try:
+                self.store.release_pid_pins()
+            except Exception:
+                pass
         try:
             self.conn.close()
         except Exception:

@@ -32,7 +32,9 @@ def test_config_registry_env_override(monkeypatch):
     assert cfg.max_lineage == 123
     assert cfg.auto_gc is False
     assert cfg.hybrid_threshold == 0.75
-    # spawn_env forwards only explicitly-set flags
+    # spawn_env forwards only explicitly-set flags (the backend matrix may
+    # run this suite under RAY_TPU_STORE_BACKEND=..., so clear it here)
+    monkeypatch.delenv("RAY_TPU_STORE_BACKEND", raising=False)
     env = RayConfig.spawn_env()
     assert env["RAY_TPU_MAX_LINEAGE"] == "123"
     assert "RAY_TPU_STORE_BACKEND" not in env

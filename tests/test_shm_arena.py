@@ -67,13 +67,72 @@ def test_zero_copy_numpy_view(arena):
 
 
 def test_eviction_under_pressure(arena):
-    # 1 MiB arena: 12 x 128 KiB puts must evict early objects, not fail
+    # 1 MiB arena: 12 x 128 KiB puts must evict early objects, not fail —
+    # and eviction must SPILL the only copy, never drop it
+    evicted = []
+    arena.on_evict = evicted.extend
     for i in range(12):
         data = bytes([i]) * (128 * 1024)
         arena.put_parts(f"o{i}", [data], len(data))
-    assert not arena.contains("o0")  # LRU gone
-    assert arena.contains("o11")
+    assert arena.tier_of("o0") == "spill"  # LRU left the arena…
+    assert evicted and "o0" in evicted     # …and the hook saw it go
+    assert arena.tier_of("o11") == "shm"
     assert bytes(arena.get("o11").buf[:1]) == bytes([11])
+    # the evicted-only-copy object is still transparently readable
+    assert bytes(arena.get("o0").buf[:1]) == bytes([0])
+
+
+def test_reput_of_deferred_deleted_object_preserves_data(arena):
+    """A re-put while the old entry sits in deferred-delete (a reader still
+    pinned it when it was deleted) must not claim success without writing:
+    the bytes land in the spill tier and stay readable."""
+    data = b"g" * 8192
+    arena.put_parts("ghost", [data], len(data))
+    view = arena.get("ghost")   # pin…
+    arena.delete("ghost")       # …so the delete is deferred (kDeleting)
+    assert arena.tier_of("ghost") is None
+    assert arena.put_parts("ghost", [data], len(data)) == "spill"
+    assert bytes(arena.get("ghost").buf) == data
+    view.release()              # ghost entry frees now
+    assert bytes(arena.get("ghost").buf) == data
+
+
+def _pin_and_die(session_id):
+    # spawn target (module-level so it pickles): pin and vanish
+    from ray_tpu._private.shm_arena import ArenaStore
+
+    st = ArenaStore(session_id, capacity=1 << 20)
+    view = st.get("held")  # pin (held ref: GC must not release it)…
+    assert view.buf[:1] == b"d"
+    os._exit(0)            # …and vanish without releasing
+
+
+def test_dead_reader_pins_are_reaped(arena):
+    """A process that dies holding pinned views must not wedge eviction:
+    its pins are released from the shared registry."""
+    import multiprocessing
+
+    data = b"d" * (256 * 1024)
+    arena.put_parts("held", [data], len(data))
+
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_pin_and_die, args=(arena.session_id,))
+    p.start()
+    p.join(timeout=60)
+    assert p.exitcode == 0
+    assert arena.reap_dead_pins() == 1
+    assert arena.reap_dead_pins() == 0  # idempotent
+
+
+def test_release_pid_pins_neuter_outstanding_views(arena):
+    data = b"v" * 4096
+    arena.put_parts("view", [data], len(data))
+    v1, v2 = arena.get("view"), arena.get("view")
+    assert arena.release_pid_pins() == 2
+    assert v1._released and v2._released
+    v1.release()  # must be a no-op, not a double-unpin
+    arena.delete("view")
+    assert not arena.contains("view")
 
 
 def test_too_large_goes_to_spill_tier(arena):

@@ -36,6 +36,10 @@ EXPECTED_METRICS = (
     "ray_tpu_storage_retries_total",
     "ray_tpu_storage_commit_seconds",
     "ray_tpu_serve_requests_total",
+    # arena object-store accounting (CoreWorker._record_store_metrics)
+    "ray_tpu_object_store_used",
+    "ray_tpu_object_store_capacity",
+    "ray_tpu_object_store_evictions_total",
 )
 
 
