@@ -5,7 +5,9 @@ already hardened with self-terminating TPU children):
 
   1. bench.py                    -> BENCH (train tokens/s + MFU) + LKG
   2. benchmarks/llm_serving_bench.py -> LLM_BENCH.json (TTFT/decode/agg)
-  3. benchmarks/data_train_bench.py  -> DATA_BENCH.json (images/s, wait)
+  3. benchmarks/llm_load_bench.py    -> LLM_BENCH.json `pd` section
+                                        (arrival sweep + PD/mono A/B)
+  4. benchmarks/data_train_bench.py  -> DATA_BENCH.json (images/s, wait)
 
 Stops early (still writing whatever was captured) if the first step lands
 on the CPU fallback — the pool is wedged and burning the budget on two
@@ -52,6 +54,12 @@ def main() -> int:
           (llm or {}).get("aggregate_tokens_per_s"))
     if (llm or {}).get("backend") != "tpu":
         rc = 2  # pool died mid-capture: the artifact is a CPU fallback
+    load = run("benchmarks/llm_load_bench.py",
+               ("RAY_TPU_LLM_LOAD_BENCH_BUDGET_S", "540"))
+    print("pd:", (load or {}).get("backend"),
+          ((load or {}).get("ab") or {}).get("tokens_per_s_ratio"))
+    if (load or {}).get("backend") != "tpu":
+        rc = 2
     data = run("benchmarks/data_train_bench.py",
                ("RAY_TPU_DATA_BENCH_BUDGET_S", "540"))
     print("data:", (data or {}).get("backend"),

@@ -1,0 +1,338 @@
+"""Closed-loop LLM load harness: arrival-rate sweep + PD-vs-monolithic A/B.
+
+The sustained-load counterpart of llm_serving_bench.py (which measures the
+engine's intrinsic TTFT/throughput): this one drives the serving stack the
+way traffic does —
+
+- **closed loop**: N client threads, each issuing its next request the
+  moment the previous one completes (the A/B mode: PD disaggregation vs
+  one monolithic continuous-batching engine at concurrency >= 8);
+- **open loop**: Poisson arrivals at a swept rate (req/s), the regime
+  where queueing shows up in p99 TTFT long before throughput saturates
+  (measurement template: the Gemma-on-TPU serving comparison,
+  arXiv 2605.25645 — PAPERS.md).
+
+The PD stack here is the real transfer plane in-process: prefill worker
+threads run the prompt forward and export paged KV through
+ray_tpu/llm/kv_transfer.py (MutableShmChannel per ticket); the decode
+engine pulls pages and admits them into continuous-batching slots via
+page-granular submit_prefilled. No serve control plane — the handoff and
+the slots are what's under test.
+
+Writes the ``pd`` section of LLM_BENCH.json (merging, not clobbering, the
+serving bench's fields). Capture hardening identical to
+llm_serving_bench.py: self-terminating alarm child, CPU fallback row,
+last-known-good TPU cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+_LKG_PATH = "/tmp/ray_tpu_llm_load_bench_last_good.json"
+_BUDGET_S = float(os.environ.get("RAY_TPU_LLM_LOAD_BENCH_BUDGET_S", "540"))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)  # children run with benchmarks/ as sys.path[0]
+
+
+# ---------------------------------------------------------------- stacks
+
+
+class _MonoStack:
+    """One continuous-batching paged engine: the baseline."""
+
+    def __init__(self, cfg, params, *, page_size, max_slots, max_len,
+                 min_bucket):
+        from ray_tpu.llm.engine import TPUEngine
+
+        self.engine = TPUEngine(cfg, params, max_slots=max_slots,
+                                max_len=max_len, min_bucket=min_bucket,
+                                kv_layout="paged", page_size=page_size)
+
+    def request(self, ids, max_tokens: int):
+        from ray_tpu.llm.engine import SamplingParams
+
+        t0 = time.perf_counter()
+        req = self.engine.submit(ids, SamplingParams(max_tokens=max_tokens))
+        req.out_queue.get()  # first token
+        ttft = time.perf_counter() - t0
+        n = 1 + sum(1 for _ in req)
+        return ttft, n
+
+    def generate(self, ids, max_tokens: int) -> list:
+        from ray_tpu.llm.engine import SamplingParams
+
+        return self.engine.generate(ids,
+                                    SamplingParams(max_tokens=max_tokens))
+
+    def shutdown(self):
+        self.engine.shutdown()
+
+
+class _PDStack:
+    """Disaggregated: prefill worker threads export paged KV over the shm
+    transfer plane; a separate decode engine pulls pages into its slots."""
+
+    def __init__(self, cfg, params, *, page_size, max_slots, max_len,
+                 min_bucket, prefill_workers: int = 2):
+        import jax  # noqa: F401 — imported for the device backend
+
+        from ray_tpu.llm.engine import TPUEngine
+        from ray_tpu.llm.kv_transfer import PagedKVExporter
+
+        self.cfg, self.params = cfg, params
+        self.page_size = page_size
+        self.min_bucket = max(min_bucket, page_size)
+        self.max_len = max_len
+        self.exporter = PagedKVExporter(send_timeout_s=120.0)
+        self.decode = TPUEngine(cfg, params, max_slots=max_slots,
+                                max_len=max_len, min_bucket=self.min_bucket,
+                                kv_layout="paged", page_size=page_size)
+        self.pool = ThreadPoolExecutor(max_workers=prefill_workers,
+                                       thread_name_prefix="pd-prefill")
+
+    def _prefill(self, ids) -> dict:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.llm.engine import bucket_for
+        from ray_tpu.models import decoding
+
+        n = len(ids)
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = ids
+        logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
+                                      jnp.int32(n), self.cfg)
+        first = int(jnp.argmax(logits))  # greedy (temperature 0 workload)
+        return self.exporter.export(np.asarray(kv["k"]), np.asarray(kv["v"]),
+                                    n, first, self.page_size)
+
+    def request(self, ids, max_tokens: int):
+        from ray_tpu.llm.engine import SamplingParams
+        from ray_tpu.llm.kv_transfer import pull_all
+
+        t0 = time.perf_counter()
+        ticket = self.pool.submit(self._prefill, ids).result()
+        ttft = time.perf_counter() - t0  # first token rides the ticket
+        k_pages, v_pages = pull_all(ticket, timeout_s=120.0)
+        req = self.decode.submit_prefilled(
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=SamplingParams(max_tokens=max_tokens),
+            k_pages=k_pages, v_pages=v_pages)
+        n = 1 + sum(1 for _ in req)
+        return ttft, n
+
+    def generate(self, ids, max_tokens: int) -> list:
+        from ray_tpu.llm.engine import SamplingParams
+        from ray_tpu.llm.kv_transfer import pull_all
+
+        ticket = self._prefill(ids)
+        k_pages, v_pages = pull_all(ticket, timeout_s=120.0)
+        req = self.decode.submit_prefilled(
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=SamplingParams(max_tokens=max_tokens),
+            k_pages=k_pages, v_pages=v_pages)
+        return [ticket["first_token"]] + list(req)
+
+    def shutdown(self):
+        self.pool.shutdown(wait=True)
+        self.decode.shutdown()
+        self.exporter.teardown()
+
+
+# ---------------------------------------------------------------- drivers
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _stats(results: list, wall: float) -> dict:
+    ttfts = sorted(r[0] for r in results)
+    return {
+        "requests": len(results),
+        "p50_ttft_ms": round(_pct(ttfts, 0.50) * 1e3, 2),
+        "p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 2),
+        "tokens_per_s": round(sum(r[1] for r in results) / max(wall, 1e-9), 1),
+        "wall_s": round(wall, 2),
+    }
+
+
+def _closed_loop(stack, prompts, *, concurrency: int, n_requests: int,
+                 max_tokens: int) -> dict:
+    """N clients, each firing its next request on completion."""
+    results: list = []
+    lock = threading.Lock()
+    counter = iter(range(n_requests))
+
+    def client():
+        while True:
+            with lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            r = stack.request(prompts[i % len(prompts)], max_tokens)
+            with lock:
+                results.append(r)
+
+    threads = [threading.Thread(target=client) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = _stats(results, time.perf_counter() - t0)
+    out["concurrency"] = concurrency
+    return out
+
+
+def _open_loop(stack, prompts, *, rate_rps: float, duration_s: float,
+               max_tokens: int, rng) -> dict:
+    """Poisson arrivals at rate_rps for duration_s; every arrival gets its
+    own client thread (queueing shows up as TTFT, not as lost arrivals)."""
+    results: list = []
+    lock = threading.Lock()
+    threads: list = []
+    t0 = time.perf_counter()
+    i = 0
+    next_at = t0
+    while True:
+        next_at += rng.exponential(1.0 / rate_rps)
+        now = time.perf_counter()
+        if next_at - t0 > duration_s:
+            break
+        if next_at > now:
+            time.sleep(next_at - now)
+
+        def client(idx=i):
+            r = stack.request(prompts[idx % len(prompts)], max_tokens)
+            with lock:
+                results.append(r)
+
+        th = threading.Thread(target=client)
+        th.start()
+        threads.append(th)
+        i += 1
+    for th in threads:
+        th.join()
+    out = _stats(results, time.perf_counter() - t0)
+    out["rate_rps"] = rate_rps
+    out["offered"] = i
+    return out
+
+
+# ---------------------------------------------------------------- measure
+
+
+def _measure(platform: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama_config, transformer
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg_kw = dict(vocab_size=32000, max_seq_len=2048, d_model=2048,
+                      n_layers=8, n_heads=16, n_kv_heads=8, d_ff=8192,
+                      dtype=jnp.bfloat16, remat=False)
+        page_size, prompt_len, gen_len, conc = 64, 512, 128, 8
+        rates, open_duration_s = [2.0, 4.0, 8.0], 10.0
+        n_ab = 2 * conc
+    else:
+        cfg_kw = dict(vocab_size=512, max_seq_len=256, d_model=128,
+                      n_layers=2, n_heads=4, n_kv_heads=4, d_ff=256,
+                      dtype=jnp.float32, remat=False)
+        page_size, prompt_len, gen_len, conc = 32, 64, 16, 8
+        rates, open_duration_s = [4.0, 8.0, 16.0], 6.0
+        n_ab = 3 * conc
+
+    cfg = llama_config("tiny", **cfg_kw)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompts = [[int(x) for x in rng.integers(
+        1, cfg_kw["vocab_size"] - 1, size=prompt_len)] for _ in range(16)]
+    stack_kw = dict(page_size=page_size, max_slots=conc,
+                    max_len=cfg_kw["max_seq_len"],
+                    min_bucket=max(32, page_size))
+    results: dict = {"backend": jax.default_backend(),
+                     "page_size": page_size, "prompt_len": prompt_len,
+                     "gen_len": gen_len}
+
+    pd = _PDStack(cfg, params, **stack_kw)
+    mono = _MonoStack(cfg, params, **stack_kw)
+    try:
+        # warmup both stacks (prefill + decode compiles) and check the
+        # disaggregated path is token-exact against the monolithic engine
+        exact = pd.generate(prompts[0], gen_len) == mono.generate(
+            prompts[0], gen_len)
+        results["pd_token_exact"] = bool(exact)
+
+        # ---- A/B: closed loop at concurrency `conc` --------------------
+        ab = {}
+        for name, stack in (("pd", pd), ("monolithic", mono)):
+            ab[name] = _closed_loop(stack, prompts, concurrency=conc,
+                                    n_requests=n_ab, max_tokens=gen_len)
+        ab["ttft_p50_speedup"] = round(
+            ab["monolithic"]["p50_ttft_ms"]
+            / max(ab["pd"]["p50_ttft_ms"], 1e-6), 3)
+        ab["tokens_per_s_ratio"] = round(
+            ab["pd"]["tokens_per_s"]
+            / max(ab["monolithic"]["tokens_per_s"], 1e-9), 3)
+        results["ab"] = ab
+
+        # ---- arrival-rate sweep: open loop on the PD stack -------------
+        sweep = []
+        arrival_rng = np.random.default_rng(1)
+        for rate in rates:
+            sweep.append(_open_loop(pd, prompts, rate_rps=rate,
+                                    duration_s=open_duration_s,
+                                    max_tokens=gen_len, rng=arrival_rng))
+        results["arrival_sweep"] = sweep
+    finally:
+        pd.shutdown()
+        mono.shutdown()
+    results["config"] = {k: str(v) for k, v in cfg_kw.items()}
+    return results
+
+
+def main():
+    sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
+    import _capture
+
+    child = os.environ.get("RAY_TPU_LLM_LOAD_BENCH_CHILD")
+    if child:
+        _capture.child_guard("RAY_TPU_LLM_LOAD_BENCH_CHILD", child)
+        _capture.emit(_measure(child))
+        return 0
+
+    out = _capture.orchestrate(
+        os.path.abspath(__file__), "RAY_TPU_LLM_LOAD_BENCH_CHILD",
+        _BUDGET_S, _LKG_PATH, ["ab", "arrival_sweep", "pd_token_exact"],
+        _ROOT)
+    # merge INTO LLM_BENCH.json as the `pd` section — the serving bench
+    # owns the file's top level and preserves this key on rewrite
+    path = os.path.join(_ROOT, "LLM_BENCH.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    data["pd"] = out
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -217,7 +217,15 @@ def main():
         ["ttft_ms_p50", "decode_tokens_per_s_single",
          "aggregate_tokens_per_s"],
         _ROOT)
-    with open(os.path.join(_ROOT, "LLM_BENCH.json"), "w") as f:
+    path = os.path.join(_ROOT, "LLM_BENCH.json")
+    try:  # the `pd` section belongs to llm_load_bench.py: never clobber it
+        with open(path) as f:
+            prev_pd = json.load(f).get("pd")
+    except (OSError, ValueError):
+        prev_pd = None
+    if prev_pd is not None:
+        out["pd"] = prev_pd
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
     return 0

@@ -155,6 +155,24 @@ class MutableShmChannel:
         self._set(read_seq=r + 1)  # ack: the writer may overwrite now
         return value
 
+    def wait_drained(self, timeout: float | None = 60.0) -> None:
+        """Block until the reader consumed the LAST published payload
+        (read_seq caught up to write_seq). The writer's end-of-stream
+        barrier: after it returns, close()+unlink() cannot strand an
+        unread payload in a segment nobody will ever map again. Raises
+        ChannelClosed if the channel was closed underneath the wait."""
+
+        def drained(hdr):
+            w, r, _n, c = hdr
+            if w == r:  # drained wins over closed: the stream completed
+                return True
+            if c:
+                raise ChannelClosed("channel closed")
+            return False
+
+        self._wait(drained, timeout,
+                   "channel drain wait timed out (reader gone?)")
+
     def close(self, drain: bool = False) -> None:
         """Mark closed; peers already attached observe ChannelClosed. The
         NAME stays linked — a consumer that deserializes its channel arg
@@ -178,6 +196,16 @@ class MutableShmChannel:
             self._set(read_seq=w)
         except ValueError:
             pass  # already unmapped
+
+    def close_mapping(self) -> None:
+        """Release THIS handle's mmap without touching the header: the
+        reader-side detach. close() would flip the shared closed flag and
+        make a still-draining writer read its own successful stream as a
+        peer death."""
+        try:
+            self._mm.close()
+        except Exception:
+            pass
 
     def unlink(self) -> None:
         try:

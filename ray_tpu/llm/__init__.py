@@ -6,9 +6,10 @@ continuous-batching engine (ray_tpu/llm/engine.py) instead of vLLM.)
 """
 
 from ray_tpu.llm.batch import Processor, build_llm_processor
-from ray_tpu.llm.config import LLMConfig, ModelLoadingConfig
+from ray_tpu.llm.config import LLMConfig, ModelLoadingConfig, PDConfig
 from ray_tpu.llm.engine import SamplingParams, TPUEngine
 from ray_tpu.llm.guided import GuidedFSM
+from ray_tpu.llm.kv_transfer import KVTransferError, PagedKVExporter
 from ray_tpu.llm.pd import build_pd_openai_app
 from ray_tpu.llm.server import LLMServer, build_openai_app
 from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
@@ -16,9 +17,12 @@ from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
 __all__ = [
     "ByteTokenizer",
     "GuidedFSM",
+    "KVTransferError",
     "LLMConfig",
     "LLMServer",
     "ModelLoadingConfig",
+    "PDConfig",
+    "PagedKVExporter",
     "Processor",
     "SamplingParams",
     "TPUEngine",

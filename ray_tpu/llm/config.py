@@ -31,6 +31,25 @@ class LoraConfig:
 
 
 @dataclass
+class PDConfig:
+    """Prefill/decode disaggregation knobs (ray_tpu/llm/pd.py).
+
+    (reference: serving_patterns/prefill_decode/pd_server.py — the proxy
+    composes separately-sized prefill and decode pools; kv transfer config
+    picks the handoff transport. Here the transport is the paged-KV shm
+    plane — ray_tpu/llm/kv_transfer.py.)"""
+
+    # KV handoff granularity in tokens; must divide the engine buckets, so
+    # the prefill servers bump min_bucket up to it. Power of two.
+    page_size: int = 64
+    # per-page shm handoff timeout: a decode replica that never pulls (or
+    # dies mid-pull) frees the prefill side's channel after this long
+    transfer_timeout_s: float = 60.0
+    num_prefill_replicas: int = 1
+    num_decode_replicas: int = 1
+
+
+@dataclass
 class LLMConfig:
     model_loading_config: ModelLoadingConfig = field(default_factory=ModelLoadingConfig)
     # TransformerConfig kwargs for the built-in families (gpt2/llama/mixtral)
@@ -41,6 +60,8 @@ class LLMConfig:
     deployment_config: dict = field(default_factory=dict)  # serve options
     accelerator_type: str | None = "TPU"
     lora_config: LoraConfig | None = None
+    # PD disaggregation (build_pd_openai_app); None → PDConfig() defaults
+    pd_config: PDConfig | None = None
 
     def build_model(self):
         """Returns (TransformerConfig, params). Cited families live in

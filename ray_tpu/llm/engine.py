@@ -508,35 +508,72 @@ class TPUEngine:
         self._work.set()
         return req
 
-    def submit_prefilled(self, k, v, length: int, first_token: int,
-                         params: SamplingParams | None = None) -> _Request:
-        """Admit a sequence whose prefill ran elsewhere (PD disaggregation):
-        k/v are [L, T, Hkv, Dh] host arrays for the prompt prefix."""
+    def submit_prefilled(self, k=None, v=None, length: int = 0,
+                         first_token: int = 0,
+                         params: SamplingParams | None = None, *,
+                         k_pages: list | None = None,
+                         v_pages: list | None = None) -> _Request:
+        """Admit a sequence whose prefill ran elsewhere (PD disaggregation).
+
+        Two forms:
+        - whole-array: k/v are [L, T, Hkv, Dh] host arrays for the prompt
+          prefix (the legacy object-plane handoff);
+        - page-granular: k_pages/v_pages are ordered lists of
+          [L, page_size, Hkv, Dh] pages (the shm transfer plane's unit).
+          On a paged engine each page is adopted into the slot pool
+          directly — no whole-bucket array is ever assembled.
+        """
         self._check_alive()
         params = params or SamplingParams()
-        if k.shape[1] > self.max_len:
+        paged_form = k_pages is not None or v_pages is not None
+        if paged_form:
+            if k is not None or v is not None:
+                raise ValueError(
+                    "pass either k/v arrays or k_pages/v_pages, not both")
+            if not k_pages or not v_pages or len(k_pages) != len(v_pages):
+                raise ValueError(
+                    "k_pages and v_pages must be equal-length non-empty "
+                    "lists of [L, page_size, Hkv, Dh] pages")
+            P = k_pages[0].shape[1]
+            if any(p.shape[1] != P for p in list(k_pages) + list(v_pages)):
+                raise ValueError("transferred pages have mixed page sizes")
+            if self.kv_layout == "paged" and P != self.page_size:
+                raise ValueError(
+                    f"transferred page size {P} != engine page_size "
+                    f"{self.page_size}: prefill and decode pools must agree")
+            bucket = len(k_pages) * P
+        else:
+            if k is None or v is None:
+                raise ValueError(
+                    "submit_prefilled needs k/v arrays or k_pages/v_pages")
+            bucket = k.shape[1]
+        if bucket > self.max_len:
             raise ValueError(
-                f"transferred prefix bucket {k.shape[1]} exceeds engine "
+                f"transferred prefix bucket {bucket} exceeds engine "
                 f"max_len {self.max_len}")
         if self.kv_layout == "paged":
-            if k.shape[1] % self.page_size:
+            if bucket % self.page_size:
                 raise ValueError(
-                    f"transferred prefix bucket {k.shape[1]} is not a "
+                    f"transferred prefix bucket {bucket} is not a "
                     f"multiple of page_size {self.page_size}: configure the "
                     f"prefill server with min_bucket >= page_size")
-            need = self._pages_needed(int(length), k.shape[1],
-                                      (params or SamplingParams()).max_tokens)
+            need = self._pages_needed(int(length), bucket, params.max_tokens)
             if need > self.num_pages - 1:
                 raise ValueError(
                     f"request needs {need} KV pages but the pool only has "
                     f"{self.num_pages - 1}")
-        if int(length) + params.max_tokens >= self.max_len:
+        if int(length) + params.max_tokens > self.max_len:
             raise ValueError(
                 f"prefix length {int(length)} + max_tokens {params.max_tokens} "
                 f"does not fit engine max_len {self.max_len}")
         req = _Request(next(self._rid), [], params)
-        req.kv_pack = {"k": k, "v": v, "length": int(length),
-                       "first_token": int(first_token)}
+        if paged_form:
+            req.kv_pack = {"k_pages": list(k_pages), "v_pages": list(v_pages),
+                           "length": int(length),
+                           "first_token": int(first_token)}
+        else:
+            req.kv_pack = {"k": k, "v": v, "length": int(length),
+                           "first_token": int(first_token)}
         req.generated = 1  # the transferred first token counts
         self._waiting.put(req)
         self._work.set()
@@ -710,22 +747,32 @@ class TPUEngine:
         return decoding.sample(logits[None, :], sub,
                                req.params.temperature, req.params.top_k)
 
+    def _grant_pages(self, need: int) -> list | None:
+        """Grant `need` pool pages (evicting zero-ref cached blocks when
+        the prefix cache is on), or None when infeasible right now."""
+        if self.enable_prefix_cache:
+            return self._alloc_pages(need)
+        if need > len(self._free_pages):
+            return None
+        return [self._free_pages.pop() for _ in range(need)]
+
+    def _bind_slot(self, req: _Request, slot: int) -> None:
+        """The slot-activation bookkeeping shared by every admission path:
+        device sampling params, LoRA row, request registry."""
+        self._set_row_sampling(slot, req.params)
+        if self.lora_bank is not None:
+            self._slot_lora = self._slot_lora.at[slot].set(req.lora_idx)
+        self._by_slot[slot] = req
+
     def _insert(self, req: _Request, slot: int, kv, length: int, first_token):
         """Layout-dispatching sequence insertion. Returns False when the
         paged pool can't host the sequence right now (caller backlogs)."""
         if self.kv_layout == "paged":
             bucket = kv["k"].shape[1]
             need = self._pages_needed(length, bucket, req.params.max_tokens)
-            if self.enable_prefix_cache:
-                # may evict zero-ref cached blocks to make room
-                alloc = self._alloc_pages(need)
-                if alloc is None:
-                    return False
-                pages = alloc
-            else:
-                if need > len(self._free_pages):
-                    return False
-                pages = [self._free_pages.pop() for _ in range(need)]
+            pages = self._grant_pages(need)
+            if pages is None:
+                return False
             self._slot_pages[slot] = pages
             padded_pages = np.zeros((self.max_pages_per_seq,), np.int32)
             padded_pages[:need] = pages
@@ -737,10 +784,62 @@ class TPUEngine:
             self.state = decoding.insert_sequence(
                 self.state, slot, kv, jnp.int32(length),
                 jnp.asarray(first_token, jnp.int32), self.cfg)
-        self._set_row_sampling(slot, req.params)
-        if self.lora_bank is not None:
-            self._slot_lora = self._slot_lora.at[slot].set(req.lora_idx)
-        self._by_slot[slot] = req
+        self._bind_slot(req, slot)
+        return True
+
+    def _insert_transferred(self, req: _Request, slot: int) -> bool:
+        """PD admission: insert a kv_pack that arrived from a prefill
+        server. Page-granular packs adopt pages straight into the paged
+        pool; whole-array packs (or pages landing on a slot-layout engine)
+        take the legacy _insert path. Returns False when the pool can't
+        host the sequence right now (caller backlogs)."""
+        pack = req.kv_pack
+        if "k_pages" in pack:
+            if self.kv_layout == "paged":
+                return self._insert_pages(req, slot, pack)
+            # slot layout has no page pool: stitch the bucket back together
+            # (host copy — the paged decode pool is the production PD path)
+            kv = {"k": np.concatenate([np.asarray(p)
+                                       for p in pack["k_pages"]], axis=1),
+                  "v": np.concatenate([np.asarray(p)
+                                       for p in pack["v_pages"]], axis=1)}
+        else:
+            kv = {"k": pack["k"], "v": pack["v"]}
+        ktmpl = self.state["k" if self.kv_layout == "slot" else "kp"]
+        kv = {"k": jnp.asarray(kv["k"], ktmpl.dtype),
+              "v": jnp.asarray(kv["v"], ktmpl.dtype)}
+        return self._insert(req, slot, kv, pack["length"],
+                            pack["first_token"])
+
+    def _insert_pages(self, req: _Request, slot: int, pack: dict) -> bool:
+        """Adopt transferred KV pages directly into the paged pool: one
+        write_kv_pages scatter per page (a single [L, P, Hkv, Dh] compile
+        serves every transfer), then activate the row. The whole-bucket
+        [L, T, Hkv, Dh] array is never materialized on this path."""
+        k_pages, v_pages = pack["k_pages"], pack["v_pages"]
+        P = self.page_size
+        length = pack["length"]
+        need = self._pages_needed(length, len(k_pages) * P,
+                                  req.params.max_tokens)
+        pages = self._grant_pages(need)
+        if pages is None:
+            return False
+        self._slot_pages[slot] = pages
+        dt = self.state["kp"].dtype
+        # prefix pages land in block-table order; the tail of `pages`
+        # (granted up front, like every admission) hosts the generation
+        for pid, kp, vp in zip(pages, k_pages, v_pages):
+            self.state = self._dp.write_kv_pages(
+                self.state,
+                {"k": jnp.asarray(np.asarray(kp), dt),
+                 "v": jnp.asarray(np.asarray(vp), dt)},
+                jnp.asarray(np.asarray([pid], np.int32)))
+        block_row = np.zeros((self.max_pages_per_seq,), np.int32)
+        block_row[:need] = pages
+        self.state = self._dp.activate_slot(
+            self.state, slot, jnp.asarray(block_row), jnp.int32(length),
+            jnp.asarray(pack["first_token"], jnp.int32))
+        self._bind_slot(req, slot)
         return True
 
     def _next_waiting(self):
@@ -766,12 +865,9 @@ class TPUEngine:
                     self._lora_release(req)
                     req.out_queue.put(_SENTINEL)
                     continue
-                # PD path: KV arrived from a prefill server over the host plane
-                ktmpl = self.state["k" if self.kv_layout == "slot" else "kp"]
-                kv = {"k": jnp.asarray(req.kv_pack["k"], ktmpl.dtype),
-                      "v": jnp.asarray(req.kv_pack["v"], ktmpl.dtype)}
-                if not self._insert(req, slot, kv, req.kv_pack["length"],
-                                    req.kv_pack["first_token"]):
+                # PD path: KV arrived from a prefill server (shm pages or
+                # legacy whole arrays)
+                if not self._insert_transferred(req, slot):
                     self._free.append(slot)
                     self._backlog.append(req)
                     return  # page pressure: stop admitting this round
@@ -907,8 +1003,7 @@ class TPUEngine:
         self.state = self._dp.insert_sequence_paged_prefix(
             self.state, slot, kv, jnp.asarray(suf_pages),
             jnp.asarray(block_row), jnp.int32(n), first[0], self.cfg)
-        self._set_row_sampling(slot, req.params)
-        self._by_slot[slot] = req
+        self._bind_slot(req, slot)
         if self.enable_prefix_cache:
             self._register_blocks(slot, tokens, hashes, n_pre, priv)
         return int(first[0])
@@ -958,8 +1053,7 @@ class TPUEngine:
         self.state = self._dp.activate_slot(
             self.state, req.slot, jnp.asarray(block_row), jnp.int32(n),
             first[0])
-        self._set_row_sampling(req.slot, req.params)
-        self._by_slot[req.slot] = req
+        self._bind_slot(req, req.slot)
         if self.enable_prefix_cache:
             n_shared = len(self._slot_shared.get(req.slot, ()))
             self._register_blocks(req.slot, tokens, req.pf_hashes, n_shared,

@@ -1,0 +1,244 @@
+"""Paged-KV transfer plane for PD disaggregation.
+
+The prefill→decode handoff moves the prefilled KV prefix at paged-KV
+**page granularity** over `MutableShmChannel` — the compiled-DAG plane's
+seqlock shm transport, reused — with a ticket/pull protocol:
+
+- the prefill side computes the prompt KV, slices it into
+  ``[L, page_size, Hkv, Dh]`` pages, and ``export()``s them: a per-ticket
+  shm channel is created and a sender thread starts streaming pages into
+  it (the seqlock write blocks until the reader consumed the previous
+  page, so at most ONE page is in flight per transfer — natural
+  backpressure, no buffering tier);
+- the proxy only ever sees the **ticket** (a small dict: channel path,
+  page count, shapes, first token) — it never materializes KV;
+- the decode side attaches to the channel by path and ``pull_pages()``
+  them straight into its paged slot pool (engine ``submit_prefilled``
+  adopts pages without reshaping).
+
+Both ends must share one host (/dev/shm), which is the on-pod PD layout:
+prefill and decode replicas co-locate per host and the proxy fans out
+across hosts. Cross-host transfer is the ICI/RDMA follow-on.
+
+(reference: llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py
+— the PDProxyServer + NIXL/LMCache KV-transfer pattern; here the transport
+is the repo's own mutable-shm channel instead of RDMA, and the unit is the
+paged-KV page so decode admission needs no reshape.)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from ray_tpu.experimental.channel.channel import ChannelClosed
+from ray_tpu.experimental.channel.mutable_shm import (MutableShmChannel,
+                                                      create_mutable_channel)
+
+# serialization slack per page message (pickle framing + dict keys); the
+# payload itself is the two out-of-band numpy buffers
+_WIRE_SLACK = 8192
+
+
+class KVTransferError(RuntimeError):
+    """A KV handoff failed mid-flight: the per-REQUEST failure (the other
+    transfers and both replica pools keep serving)."""
+
+
+def _metrics():
+    from ray_tpu.util import metrics as met
+
+    return (
+        met.get_or_create(
+            met.Counter, "ray_tpu_llm_pd_transfer_bytes_total",
+            "KV bytes moved prefill->decode over the shm transfer plane"),
+        met.get_or_create(
+            met.Counter, "ray_tpu_llm_pd_kv_pages_total",
+            "KV pages moved prefill->decode over the shm transfer plane"),
+    )
+
+
+class _Transfer:
+    __slots__ = ("ticket_id", "channel", "thread", "failed")
+
+    def __init__(self, ticket_id: str, channel: MutableShmChannel):
+        self.ticket_id = ticket_id
+        self.channel = channel
+        self.thread: threading.Thread | None = None
+        self.failed: str | None = None
+
+
+class PagedKVExporter:
+    """Prefill-side registry of in-flight page transfers.
+
+    ``export()`` returns the ticket immediately; a sender thread streams
+    the pages and retires the channel (close → unlink) once the reader
+    drained the last one. A receiver that never attaches, or dies
+    mid-pull, times the sender out after ``send_timeout_s`` — the channel
+    is torn down either way, so /dev/shm can't accumulate segments.
+    """
+
+    def __init__(self, *, send_timeout_s: float = 60.0):
+        self.send_timeout_s = float(send_timeout_s)
+        self._live: dict[str, _Transfer] = {}
+        self._lock = threading.Lock()
+        self._m_bytes, self._m_pages = _metrics()
+        self.failures = 0        # transfers that did not complete
+        self.last_failure = ""   # "<ticket>: <reason>" for triage
+
+    # ------------------------------------------------------------- export
+
+    def export(self, k: np.ndarray, v: np.ndarray, length: int,
+               first_token: int, page_size: int) -> dict:
+        """Slice a bucketed prompt KV (``[L, T, Hkv, Dh]``, T a multiple of
+        ``page_size``) into pages and start streaming them. Returns the
+        ticket the proxy forwards to the decode pool."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        L, T = k.shape[0], k.shape[1]
+        if page_size <= 0 or T % page_size:
+            raise ValueError(
+                f"prefill bucket {T} is not a multiple of page_size "
+                f"{page_size}: configure the prefill server with "
+                f"min_bucket >= page_size")
+        n_pages = T // page_size
+        page_bytes = (k.nbytes + v.nbytes) // n_pages
+        ch = create_mutable_channel(page_bytes + _WIRE_SLACK)
+        tid = uuid.uuid4().hex[:16]
+        tr = _Transfer(tid, ch)
+        with self._lock:
+            self._live[tid] = tr
+        tr.thread = threading.Thread(
+            target=self._send, args=(tr, k, v, page_size, n_pages),
+            daemon=True, name=f"pd-kv-send-{tid[:6]}")
+        tr.thread.start()
+        return {
+            "ticket": tid,
+            "path": ch.path,
+            "capacity": ch.capacity,
+            "n_pages": n_pages,
+            "page_size": page_size,
+            "length": int(length),
+            "first_token": int(first_token),
+            "bucket": T,
+            "page_shape": (L, page_size, k.shape[2], k.shape[3]),
+            "dtype": str(k.dtype),
+        }
+
+    def _send(self, tr: _Transfer, k, v, page_size: int, n_pages: int):
+        ch = tr.channel
+        try:
+            for i in range(n_pages):
+                sl = slice(i * page_size, (i + 1) * page_size)
+                kp = np.ascontiguousarray(k[:, sl])
+                vp = np.ascontiguousarray(v[:, sl])
+                ch.write({"i": i, "k": kp, "v": vp},
+                         timeout=self.send_timeout_s)
+                self._m_bytes.inc(kp.nbytes + vp.nbytes)
+                self._m_pages.inc()
+            # the final page is published but possibly unread: wait for the
+            # reader's ack before unlinking the segment
+            ch.wait_drained(timeout=self.send_timeout_s)
+        except ChannelClosed:
+            tr.failed = "closed"  # teardown/abort raced the send: expected
+        except TimeoutError:
+            tr.failed = "timeout"  # receiver never attached or died mid-pull
+            logger.warning("kv transfer %s: send timed out after %.1fs "
+                           "(decode side never pulled, or died mid-pull)",
+                           tr.ticket_id, self.send_timeout_s)
+        except Exception as e:  # noqa: BLE001 — must never leak the segment
+            tr.failed = f"{type(e).__name__}: {e}"
+            logger.warning("kv transfer %s: sender failed: %s",
+                           tr.ticket_id, tr.failed)
+        finally:
+            ch.close()
+            ch.unlink()
+            with self._lock:
+                self._live.pop(tr.ticket_id, None)
+                if tr.failed is not None:
+                    self.failures += 1
+                    self.last_failure = f"{tr.ticket_id}: {tr.failed}"
+
+    # ---------------------------------------------------------- lifecycle
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def abort(self, ticket_id: str) -> None:
+        """Kill one in-flight transfer (its puller observes ChannelClosed →
+        KVTransferError). Used when the prefill replica is shutting down or
+        the request was cancelled upstream."""
+        with self._lock:
+            tr = self._live.get(ticket_id)
+        if tr is None:
+            return
+        tr.channel.close()
+        if tr.thread is not None:
+            tr.thread.join(timeout=5.0)
+
+    def teardown(self) -> None:
+        """Close every live channel, join the senders, unlink the segments.
+        Safe to call twice; after it returns /dev/shm holds none of this
+        exporter's ``rtpu_chan_*`` files."""
+        with self._lock:
+            live = list(self._live.values())
+        for tr in live:
+            tr.channel.close()
+        for tr in live:
+            if tr.thread is not None:
+                tr.thread.join(timeout=5.0)
+            tr.channel.unlink()
+        with self._lock:
+            for tr in live:
+                self._live.pop(tr.ticket_id, None)
+
+
+# ----------------------------------------------------------------- receiver
+
+
+def pull_pages(ticket: dict, timeout_s: float = 60.0):
+    """Decode-side pull: attach to the ticket's channel and yield
+    ``(index, k_page, v_page)`` in order (each ``[L, page_size, Hkv, Dh]``).
+    Every failure mode surfaces as KVTransferError naming the ticket — the
+    per-request error contract."""
+    tid = ticket.get("ticket", "?")
+    try:
+        ch = MutableShmChannel(ticket["path"], ticket["capacity"])
+    except FileNotFoundError:
+        raise KVTransferError(
+            f"kv transfer {tid}: channel {ticket['path']} not found — the "
+            "prefill replica died (or retired the ticket), or prefill and "
+            "decode are not co-hosted (shm transfer is same-host)") from None
+    try:
+        for i in range(ticket["n_pages"]):
+            try:
+                msg = ch.read(timeout=timeout_s)
+            except ChannelClosed:
+                raise KVTransferError(
+                    f"kv transfer {tid}: prefill side closed after "
+                    f"{i}/{ticket['n_pages']} pages (replica death or "
+                    "abort mid-transfer)") from None
+            except TimeoutError:
+                raise KVTransferError(
+                    f"kv transfer {tid}: timed out waiting for page {i} of "
+                    f"{ticket['n_pages']} after {timeout_s}s") from None
+            yield msg["i"], msg["k"], msg["v"]
+    finally:
+        ch.close_mapping()
+
+
+def pull_all(ticket: dict, timeout_s: float = 60.0):
+    """Pull the whole transfer: ``(k_pages, v_pages)`` as ordered lists of
+    per-page arrays, ready for ``TPUEngine.submit_prefilled(k_pages=...)``."""
+    k_pages: list = [None] * ticket["n_pages"]
+    v_pages: list = [None] * ticket["n_pages"]
+    for i, kp, vp in pull_pages(ticket, timeout_s):
+        k_pages[i] = kp
+        v_pages[i] = vp
+    return k_pages, v_pages

@@ -1,26 +1,75 @@
-"""Prefill/decode disaggregation.
+"""Prefill/decode disaggregation over the paged-KV shm transfer plane.
 
 (reference: llm/_internal/serve/serving_patterns/prefill_decode/pd_server.py
 — a PDProxyServer sends each request to a prefill deployment, transfers the
 KV cache to a decode deployment (NIXL/LMCache over RDMA in the reference),
-and streams tokens from the decoder. TPU mapping: prefill replicas own
-prefill-shaped meshes, decode replicas own the slot cache; KV crosses via the
-host object plane here (ICI remote-DMA is the on-pod fast path).)
+and streams tokens from the decoder.)
+
+TPU mapping here:
+
+- **PrefillServer** runs the prompt-only forward on a prefill-shaped mesh
+  and exports the resulting KV as paged-KV **pages** through
+  `ray_tpu/llm/kv_transfer.py` (per-ticket MutableShmChannel + sender
+  thread). Its reply is a small **ticket** — the proxy never materializes
+  KV.
+- **DecodeServer** pulls the pages off the channel and admits the request
+  **directly into a continuous-batching slot** via the engine's
+  page-granular `submit_prefilled` (pages are scattered into the paged
+  pool; no whole-bucket reshape). Tokens stream out as they are produced.
+- **PDProxyServer** composes the two pools and **streams**: the decode
+  call is a serve streaming handle, so the proxy forwards tokens as they
+  arrive instead of blocking on the full completion, and reports
+  first-token latency separately from completion latency.
+
+Prefill and decode replicas must share a host (/dev/shm) — the on-pod PD
+layout. ICI remote-DMA is the cross-host follow-on.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import time
+
 import numpy as np
 
 from ray_tpu import serve
-from ray_tpu.llm.config import LLMConfig
-from ray_tpu.llm.engine import SamplingParams
+from ray_tpu.llm.config import LLMConfig, PDConfig
+from ray_tpu.llm.engine import SamplingParams, bucket_for
+from ray_tpu.llm.kv_transfer import PagedKVExporter, pull_all
 from ray_tpu.llm.tokenizer import load_tokenizer
+
+_TTFT_BOUNDS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0)
+
+
+def _ttft_histogram():
+    from ray_tpu.util import metrics as met
+
+    return met.get_or_create(
+        met.Histogram, "ray_tpu_llm_pd_ttft_seconds",
+        "PD time-to-first-token split by phase (prefill: request->ticket; "
+        "decode: dispatch->first decode-produced token)",
+        boundaries=list(_TTFT_BOUNDS), tag_keys=("phase",))
+
+
+def _pd_engine_kwargs(llm_config: LLMConfig) -> dict:
+    """One normalization of engine_kwargs shared by BOTH pools, so prefill
+    bucketing and the decode page pool can never disagree on shapes: PD
+    defaults to the paged layout with pd_config.page_size, and min_bucket
+    is bumped so every prompt bucket slices into whole pages."""
+    pd = llm_config.pd_config or PDConfig()
+    ek = dict(llm_config.engine_kwargs)
+    ek.setdefault("kv_layout", "paged")
+    ek.setdefault("page_size", pd.page_size)
+    if ek["kv_layout"] == "paged":
+        ek["min_bucket"] = max(ek.get("min_bucket", 32), ek["page_size"])
+    return ek
 
 
 @serve.deployment(max_ongoing_requests=8)
 class PrefillServer:
-    """Prompt-only forward: returns the packed KV + the first sampled token."""
+    """Prompt-only forward: pages the prefilled KV into the transfer plane
+    and returns the ticket + the first sampled token."""
 
     def __init__(self, llm_config: LLMConfig):
         import jax
@@ -30,14 +79,18 @@ class PrefillServer:
         self.cfg, self.params = llm_config.build_model()
         self._decoding = decoding
         self._jax = jax
-        ek = llm_config.engine_kwargs
-        self.min_bucket = ek.get("min_bucket", 32)
+        ek = _pd_engine_kwargs(llm_config)
+        pd = llm_config.pd_config or PDConfig()
+        self.page_size = ek["page_size"]
+        self.min_bucket = max(ek.get("min_bucket", 32), self.page_size)
         self.max_len = ek.get("max_len", self.cfg.max_seq_len)
         self.key = jax.random.PRNGKey(ek.get("seed", 0))
+        self.exporter = PagedKVExporter(
+            send_timeout_s=pd.transfer_timeout_s)
 
     def prefill(self, token_ids: list, temperature: float = 0.0) -> dict:
-        from ray_tpu.llm.engine import bucket_for
-
+        """Returns the transfer TICKET (kv_transfer.py) — the KV itself
+        streams page-by-page to whichever decode replica pulls it."""
         jax, decoding = self._jax, self._decoding
         import jax.numpy as jnp
 
@@ -51,55 +104,159 @@ class PrefillServer:
                                       jnp.int32(n), self.cfg)
         self.key, sub = jax.random.split(self.key)
         first = int(decoding.sample(logits[None, :], sub, temperature)[0])
-        return {"k": np.asarray(kv["k"]), "v": np.asarray(kv["v"]),
-                "length": n, "first_token": first}
+        return self.exporter.export(np.asarray(kv["k"]), np.asarray(kv["v"]),
+                                    n, first, self.page_size)
+
+    def transfer_stats(self) -> dict:
+        return {"pending_transfers": self.exporter.pending(),
+                "failed_transfers": self.exporter.failures,
+                "last_failure": self.exporter.last_failure,
+                "page_size": self.page_size}
+
+    def __del__(self):
+        try:
+            self.exporter.teardown()
+        except Exception:
+            pass
 
 
 @serve.deployment(max_ongoing_requests=8)
 class DecodeServer:
-    """Continues generation from a transferred KV prefix."""
+    """Continues generation from a transferred paged-KV prefix, admitting
+    pulled pages straight into the engine's continuous-batching slots."""
 
     def __init__(self, llm_config: LLMConfig):
         from ray_tpu.llm.engine import TPUEngine
 
-        self.engine = TPUEngine.from_config(llm_config)
+        pd = llm_config.pd_config or PDConfig()
+        cfg = dataclasses.replace(llm_config,
+                                  engine_kwargs=_pd_engine_kwargs(llm_config))
+        self.engine = TPUEngine.from_config(cfg)
+        self.pull_timeout_s = pd.transfer_timeout_s
 
-    def decode(self, kv_pack: dict, params: dict | None = None) -> list:
-        sp = SamplingParams(**(params or {}))
+    def decode_stream(self, ticket: dict, params: dict | None = None):
+        """Generator over generated token ids: the transferred first token
+        immediately (TTFT is not gated on the page transfer), then the
+        engine's tokens as the decode loop produces them. Transfer
+        failures raise KVTransferError — a clean per-request error; the
+        engine and the other in-flight requests keep serving."""
         from ray_tpu.llm.engine import _iter_request
+        from ray_tpu.llm.kv_transfer import pull_pages
 
+        sp = SamplingParams(**(params or {}))
+        yield ticket["first_token"]
+        if sp.max_tokens <= 1:
+            # budget spent by the transferred token: drain the channel so
+            # the prefill side retires it (one page in flight — never the
+            # whole prefix in host memory), but skip slot admission
+            for _ in pull_pages(ticket, timeout_s=self.pull_timeout_s):
+                pass
+            return
+        k_pages, v_pages = pull_all(ticket, timeout_s=self.pull_timeout_s)
         req = self.engine.submit_prefilled(
-            kv_pack["k"], kv_pack["v"], kv_pack["length"],
-            kv_pack["first_token"], sp)
-        out = [kv_pack["first_token"]]
-        out.extend(_iter_request(req))
-        return out
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=sp, k_pages=k_pages, v_pages=v_pages)
+        yield from _iter_request(req)
+
+    def decode(self, ticket: dict, params: dict | None = None) -> list:
+        """Blocking form (compat surface for non-streaming callers)."""
+        return list(self.decode_stream(ticket, params))
+
+    def engine_stats(self) -> dict:
+        return self.engine.stats()
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass
 
 
 @serve.deployment
 class PDProxyServer:
-    """(reference: pd_server.py PDProxyServer — composes the two pools.)"""
+    """(reference: pd_server.py PDProxyServer — composes the two pools.)
 
-    def __init__(self, prefill_handle, decode_handle, tokenizer_spec="byte"):
+    The decode leg is a serve STREAMING handle: tokens forward as they are
+    produced, first-token latency is measured (and exported per phase via
+    ray_tpu_llm_pd_ttft_seconds) instead of being buried in one blocking
+    result() call."""
+
+    def __init__(self, prefill_handle, decode_handle, tokenizer_spec="byte",
+                 request_timeout_s: float = 120.0):
         self.prefill = prefill_handle
         self.decode = decode_handle
         self.tokenizer = load_tokenizer(tokenizer_spec)
+        self.request_timeout_s = request_timeout_s
+        self._m_ttft = _ttft_histogram()
+
+    def _pump(self, body: dict, timing: dict):
+        """Drive one request through both pools, yielding token ids as they
+        arrive; `timing` is filled with the latency split for `usage`."""
+        ids = self.tokenizer.encode(body.get("prompt", ""))
+        timing["prompt_tokens"] = len(ids)
+        t0 = time.monotonic()
+        ticket = self.prefill.prefill.remote(
+            ids, float(body.get("temperature", 0.0))
+        ).result(timeout_s=self.request_timeout_s)
+        # the first token is sampled BY prefill and rides the ticket: its
+        # arrival is the request's time-to-first-token
+        timing["ttft_s"] = time.monotonic() - t0
+        self._m_ttft.observe(timing["ttft_s"], tags={"phase": "prefill"})
+        t1 = time.monotonic()
+        stream = self.decode.options(
+            stream=True, stream_item_timeout_s=self.request_timeout_s,
+        ).decode_stream.remote(
+            ticket, {"max_tokens": int(body.get("max_tokens", 32)),
+                     "temperature": float(body.get("temperature", 0.0))})
+        for i, tok in enumerate(stream):
+            if i == 1:
+                # first DECODE-produced token: page pull + slot admission
+                # + one decode step — the decode half of the TTFT split
+                self._m_ttft.observe(time.monotonic() - t1,
+                                     tags={"phase": "decode"})
+            yield tok
+        timing["total_time_s"] = time.monotonic() - t0
+
+    def _usage(self, timing: dict, n_out: int) -> dict:
+        return {"prompt_tokens": timing.get("prompt_tokens", 0),
+                "completion_tokens": n_out,
+                # first-token latency reported SEPARATELY from completion
+                "ttft_s": round(timing.get("ttft_s", 0.0), 4),
+                "total_time_s": round(timing.get("total_time_s", 0.0), 4)}
 
     def __call__(self, request: dict) -> dict:
         body = request.get("body") or request
-        ids = self.tokenizer.encode(body.get("prompt", ""))
-        kv = self.prefill.prefill.remote(
-            ids, float(body.get("temperature", 0.0))).result(timeout_s=120)
-        out_ids = self.decode.decode.remote(
-            kv, {"max_tokens": int(body.get("max_tokens", 32)),
-                 "temperature": float(body.get("temperature", 0.0))}
-        ).result(timeout_s=120)
-        return {"choices": [{"text": self.tokenizer.decode(out_ids)}],
-                "usage": {"prompt_tokens": len(ids),
-                          "completion_tokens": len(out_ids)}}
+        timing: dict = {}
+        out_ids = list(self._pump(body, timing))
+        return {"choices": [{"index": 0,
+                             "text": self.tokenizer.decode(out_ids),
+                             "finish_reason": "stop"}],
+                "usage": self._usage(timing, len(out_ids))}
+
+    def stream_request(self, request: dict):
+        """Streaming HTTP entry (SSE through the proxy): one chunk per
+        token, then a final usage-bearing chunk — parity with
+        LLMServer.stream_request."""
+        body = request.get("body") or request
+        timing: dict = {}
+        n = 0
+        for tok in self._pump(body, timing):
+            n += 1
+            yield {"object": "text_completion.chunk",
+                   "choices": [{"index": 0,
+                                "text": self.tokenizer.decode([tok]),
+                                "finish_reason": None}]}
+        yield {"object": "text_completion.chunk",
+               "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],
+               "usage": self._usage(timing, n)}
 
 
 def build_pd_openai_app(llm_config: LLMConfig) -> serve.Application:
-    return PDProxyServer.bind(PrefillServer.bind(llm_config),
-                              DecodeServer.bind(llm_config),
-                              llm_config.model_loading_config.tokenizer or "byte")
+    pd = llm_config.pd_config or PDConfig()
+    prefill = PrefillServer.options(
+        num_replicas=pd.num_prefill_replicas).bind(llm_config)
+    decode = DecodeServer.options(
+        num_replicas=pd.num_decode_replicas).bind(llm_config)
+    return PDProxyServer.bind(
+        prefill, decode,
+        llm_config.model_loading_config.tokenizer or "byte")

@@ -208,8 +208,9 @@ class DeploymentResponseGenerator:
     stream=True — serve/handle.py; transport here is the runtime's
     streaming-generator task.)"""
 
-    def __init__(self, ref_gen, on_done):
+    def __init__(self, ref_gen, on_done, item_timeout_s: float | None = None):
         self._gen = ref_gen
+        self._item_timeout_s = item_timeout_s
         self._finalizer = weakref.finalize(self, on_done)
 
     def __iter__(self):
@@ -224,7 +225,16 @@ class DeploymentResponseGenerator:
         except Exception:
             self._finalizer()
             raise
-        return ray_tpu.get(ref)
+        # per-ITEM timeout: a wedged replica mid-stream must surface as an
+        # error to the consumer (e.g. the PD proxy), not hang it forever
+        try:
+            return ray_tpu.get(ref, timeout=self._item_timeout_s)
+        except Exception:
+            # release the router's in-flight slot NOW — deferring to GC
+            # keeps the wedged replica's count elevated while the caller
+            # handles (and retains a traceback reference to) the error
+            self._finalizer()
+            raise
 
 
 class _Router:
@@ -306,7 +316,8 @@ class _Router:
 class DeploymentHandle:
     def __init__(self, deployment_full_name: str, controller=None,
                  method_name: str = "__call__", multiplexed_model_id: str | None = None,
-                 stream: bool = False):
+                 stream: bool = False,
+                 stream_item_timeout_s: float | None = None):
         from ray_tpu.serve.api import _get_controller
 
         self._name = deployment_full_name
@@ -314,17 +325,23 @@ class DeploymentHandle:
         self._method = method_name
         self._model_id = multiplexed_model_id
         self._stream = stream
+        self._stream_item_timeout_s = stream_item_timeout_s
         self._router = _Router(deployment_full_name, self._controller)
 
     def options(self, *, method_name: str | None = None,
                 multiplexed_model_id: str | None = None,
-                stream: bool | None = None, **_ignored) -> "DeploymentHandle":
+                stream: bool | None = None,
+                stream_item_timeout_s: float | None = None,
+                **_ignored) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h._name = self._name
         h._controller = self._controller
         h._method = method_name or self._method
         h._model_id = multiplexed_model_id or self._model_id
         h._stream = self._stream if stream is None else stream
+        h._stream_item_timeout_s = (self._stream_item_timeout_s
+                                    if stream_item_timeout_s is None
+                                    else stream_item_timeout_s)
         h._router = self._router  # share in-flight state across method views
         return h
 
@@ -452,7 +469,8 @@ class DeploymentHandle:
                         num_returns="streaming").remote(
                         self._method, args, kwargs, self._model_id)
                     return DeploymentResponseGenerator(
-                        gen, lambda r=replica_id: self._router.done(r))
+                        gen, lambda r=replica_id: self._router.done(r),
+                        self._stream_item_timeout_s)
                 ref = replica.handle_request.remote(self._method, args, kwargs,
                                                     self._model_id)
                 return DeploymentResponse(
@@ -465,4 +483,5 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._name, None, self._method, self._model_id, self._stream))
+                (self._name, None, self._method, self._model_id, self._stream,
+                 self._stream_item_timeout_s))

@@ -166,7 +166,10 @@ def test_pd_disaggregation(llm_cluster):
                        route_prefix="/pd")
     out = handle.remote({"prompt": "abc", "max_tokens": 6}).result(timeout_s=180)
     assert isinstance(out["choices"][0]["text"], str)
-    assert out["usage"]["completion_tokens"] >= 1
+    # no stop tokens → the budget is spent exactly (first token + decode)
+    assert out["usage"]["completion_tokens"] == 6
+    # first-token latency is reported SEPARATELY from completion latency
+    assert 0 < out["usage"]["ttft_s"] <= out["usage"]["total_time_s"]
     serve.delete("pd")
 
 

@@ -1,0 +1,291 @@
+"""PD disaggregation tests: page-granular KV handoff over shm channels.
+
+Covers the kv_transfer plane (ticket/pull protocol, teardown hygiene,
+mid-transfer death) and the engine's page-granular submit_prefilled
+(decode-slot admission, token-exactness vs the monolithic engine).
+Serve-level composition is covered by tests/test_llm.py
+test_pd_disaggregation; everything here is engine/plane-level and fast.
+"""
+
+import glob
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm.engine import SamplingParams, TPUEngine, bucket_for
+from ray_tpu.llm.kv_transfer import (KVTransferError, PagedKVExporter,
+                                     pull_all, pull_pages)
+from ray_tpu.models import decoding, transformer
+from ray_tpu.models.transformer import TransformerConfig
+
+pytestmark = pytest.mark.pd
+
+TINY = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32, remat=False)
+PAGE = 16
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(**TINY)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _paged_engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("min_bucket", PAGE)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", PAGE)
+    return TPUEngine(cfg, params, **kw)
+
+
+def _prefill_ticket(cfg, params, prompt, exporter, *, page_size=PAGE,
+                    min_bucket=PAGE, max_len=MAX_LEN):
+    """The prefill half of the PD path, serve-free: prompt forward →
+    greedy first token → page export."""
+    n = len(prompt)
+    bucket = bucket_for(n, min_bucket, max_len)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :n] = prompt
+    logits, kv = decoding.prefill(params, jnp.asarray(padded),
+                                  jnp.int32(n), cfg)
+    first = int(jnp.argmax(logits))
+    return exporter.export(np.asarray(kv["k"]), np.asarray(kv["v"]),
+                           n, first, page_size)
+
+
+def _shm_channels() -> set:
+    return set(glob.glob("/dev/shm/rtpu_chan_*"))
+
+
+def _wait(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.02)
+    return True
+
+
+def test_pd_page_handoff_token_exact(tiny_model):
+    """The acceptance bar: prefill → page export → shm pull → page-granular
+    slot admission produces EXACTLY the monolithic engine's tokens."""
+    cfg, params = tiny_model
+    mono = _paged_engine(cfg, params)
+    dec = _paged_engine(cfg, params)
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    try:
+        for prompt in ([1, 5, 9, 2, 7], [3] * 20, list(range(2, 35))):
+            want = mono.generate(prompt, sp)
+            ticket = _prefill_ticket(cfg, params, prompt, exporter)
+            assert ticket["n_pages"] == bucket_for(
+                len(prompt), PAGE, MAX_LEN) // PAGE
+            k_pages, v_pages = pull_all(ticket, timeout_s=10.0)
+            assert all(p.shape[1] == PAGE for p in k_pages)
+            req = dec.submit_prefilled(
+                length=ticket["length"], first_token=ticket["first_token"],
+                params=sp, k_pages=k_pages, v_pages=v_pages)
+            got = [ticket["first_token"]] + list(req)
+            assert got == want
+    finally:
+        exporter.teardown()
+        mono.shutdown()
+        dec.shutdown()
+
+
+def test_pd_transfer_metrics_counted(tiny_model):
+    from ray_tpu.util import metrics as met
+
+    cfg, params = tiny_model
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    try:
+        ticket = _prefill_ticket(cfg, params, list(range(1, 20)), exporter)
+        pull_all(ticket, timeout_s=10.0)
+        by_name = {m["name"]: m for m in met.snapshot()}
+        pages = sum(v for _t, v in
+                    by_name["ray_tpu_llm_pd_kv_pages_total"]["series"])
+        bytes_ = sum(v for _t, v in
+                     by_name["ray_tpu_llm_pd_transfer_bytes_total"]["series"])
+        assert pages >= ticket["n_pages"]
+        assert bytes_ > 0
+    finally:
+        exporter.teardown()
+
+
+def test_decode_slot_admission_under_concurrency(tiny_model):
+    """More transferred requests than decode slots AND a page pool too
+    small to host them all at once: the backlog/requeue path must drain
+    everything, token-exactly, without cross-contamination."""
+    cfg, params = tiny_model
+    mono = _paged_engine(cfg, params)
+    # 2 slots, pool of 5 usable pages; each request needs 2 → at most two
+    # resident, the rest ride the backlog
+    dec = _paged_engine(cfg, params, max_slots=2, num_pages=6)
+    exporter = PagedKVExporter(send_timeout_s=30.0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    prompts = [[i + 1] * 20 for i in range(6)]
+    try:
+        want = [mono.generate(p, sp) for p in prompts]
+        got = [None] * len(prompts)
+
+        def run(i):
+            ticket = _prefill_ticket(cfg, params, prompts[i], exporter)
+            k_pages, v_pages = pull_all(ticket, timeout_s=30.0)
+            req = dec.submit_prefilled(
+                length=ticket["length"], first_token=ticket["first_token"],
+                params=sp, k_pages=k_pages, v_pages=v_pages)
+            got[i] = [ticket["first_token"]] + list(req)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert got == want
+        st = dec.stats()
+        assert st["active"] == 0 and st["free_pages"] == 5
+    finally:
+        exporter.teardown()
+        mono.shutdown()
+        dec.shutdown()
+
+
+def test_transfer_plane_teardown_no_shm_leaks(tiny_model):
+    """Completed, never-pulled, and aborted transfers must all retire
+    their /dev/shm segments."""
+    cfg, params = tiny_model
+    before = _shm_channels()
+    exporter = PagedKVExporter(send_timeout_s=30.0)
+    # short-fuse exporter ONLY for the never-pulled leg — the completed
+    # transfer must not share its timeout (a >0.5s CI stall mid-pull would
+    # otherwise retire the channel under the puller: an unrelated flake)
+    impatient = PagedKVExporter(send_timeout_s=0.5)
+    prompt = list(range(1, 20))
+    # completed transfer
+    t1 = _prefill_ticket(cfg, params, prompt, exporter)
+    pull_all(t1, timeout_s=10.0)
+    # never pulled: the sender times out (0.5s) and unlinks on its own
+    _prefill_ticket(cfg, params, prompt, impatient)
+    # aborted mid-flight
+    t3 = _prefill_ticket(cfg, params, prompt, exporter)
+    exporter.abort(t3["ticket"])
+    assert _wait(lambda: exporter.pending() == 0)
+    assert _wait(lambda: impatient.pending() == 0)
+    exporter.teardown()
+    impatient.teardown()
+    assert _wait(lambda: _shm_channels() - before == set()), \
+        f"leaked: {_shm_channels() - before}"
+
+
+def test_prefill_death_mid_transfer_clean_error(tiny_model):
+    """A prefill replica dying mid-transfer surfaces as KVTransferError
+    naming the ticket — a per-REQUEST failure; the decode engine and other
+    requests keep serving."""
+    cfg, params = tiny_model
+    dec = _paged_engine(cfg, params)
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    try:
+        # prompt spanning several pages so the abort lands mid-stream
+        ticket = _prefill_ticket(cfg, params, list(range(1, 40)), exporter)
+        assert ticket["n_pages"] >= 3
+        pulled = []
+        with pytest.raises(KVTransferError) as ei:
+            for i, kp, vp in pull_pages(ticket, timeout_s=10.0):
+                pulled.append(i)
+                if len(pulled) == 1:
+                    exporter.abort(ticket["ticket"])  # replica death
+        assert ticket["ticket"] in str(ei.value)
+        assert len(pulled) < ticket["n_pages"]
+
+        # a ticket whose channel is already gone (replica restarted):
+        with pytest.raises(KVTransferError, match="not found"):
+            list(pull_pages({**ticket, "ticket": "tkt2",
+                             "path": "/dev/shm/rtpu_chan_gone"}, 1.0))
+
+        # the decode pool is unharmed: a fresh request serves end-to-end
+        mono = _paged_engine(cfg, params)
+        want = mono.generate([1, 5, 9], sp)
+        mono.shutdown()
+        t2 = _prefill_ticket(cfg, params, [1, 5, 9], exporter)
+        k_pages, v_pages = pull_all(t2, timeout_s=10.0)
+        req = dec.submit_prefilled(
+            length=t2["length"], first_token=t2["first_token"], params=sp,
+            k_pages=k_pages, v_pages=v_pages)
+        assert [t2["first_token"]] + list(req) == want
+    finally:
+        exporter.teardown()
+        dec.shutdown()
+
+
+def test_submit_prefilled_exact_fit_and_validation(tiny_model):
+    """The off-by-one: length + max_tokens == max_len EXACTLY fits; one
+    past it is rejected. Mixed/mismatched page forms are rejected."""
+    cfg, params = tiny_model
+    dec = _paged_engine(cfg, params)
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    try:
+        prompt = [1, 5, 9, 2, 7]
+        ticket = _prefill_ticket(cfg, params, prompt, exporter)
+        k_pages, v_pages = pull_all(ticket, timeout_s=10.0)
+        n = ticket["length"]
+        req = dec.submit_prefilled(
+            length=n, first_token=ticket["first_token"],
+            params=SamplingParams(max_tokens=MAX_LEN - n),
+            k_pages=k_pages, v_pages=v_pages)
+        out = [ticket["first_token"]] + list(req)
+        assert len(out) == MAX_LEN - n
+        with pytest.raises(ValueError, match="does not fit"):
+            dec.submit_prefilled(
+                length=n, first_token=0,
+                params=SamplingParams(max_tokens=MAX_LEN - n + 1),
+                k_pages=k_pages, v_pages=v_pages)
+        with pytest.raises(ValueError, match="not both"):
+            dec.submit_prefilled(k_pages[0], v_pages[0], n, 0,
+                                 k_pages=k_pages, v_pages=v_pages)
+        with pytest.raises(ValueError, match="equal-length"):
+            dec.submit_prefilled(length=n, first_token=0,
+                                 k_pages=k_pages, v_pages=[])
+    finally:
+        exporter.teardown()
+        dec.shutdown()
+
+
+def test_submit_prefilled_pages_on_slot_engine(tiny_model):
+    """A slot-layout decode engine still accepts page-form packs (stitch
+    fallback) and the legacy whole-array form — both token-exact."""
+    cfg, params = tiny_model
+    slot_ref = TPUEngine(cfg, params, max_slots=2, max_len=MAX_LEN,
+                         min_bucket=PAGE)
+    dec = TPUEngine(cfg, params, max_slots=2, max_len=MAX_LEN,
+                    min_bucket=PAGE)
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    prompt = [1, 5, 9, 2, 7]
+    try:
+        want = slot_ref.generate(prompt, sp)
+        ticket = _prefill_ticket(cfg, params, prompt, exporter)
+        k_pages, v_pages = pull_all(ticket, timeout_s=10.0)
+        req = dec.submit_prefilled(
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=sp, k_pages=k_pages, v_pages=v_pages)
+        assert [ticket["first_token"]] + list(req) == want
+        # legacy whole-array form
+        k = np.concatenate(k_pages, axis=1)
+        v = np.concatenate(v_pages, axis=1)
+        req = dec.submit_prefilled(k, v, ticket["length"],
+                                   ticket["first_token"], sp)
+        assert [ticket["first_token"]] + list(req) == want
+    finally:
+        exporter.teardown()
+        slot_ref.shutdown()
+        dec.shutdown()

@@ -36,6 +36,11 @@ EXPECTED_METRICS = (
     "ray_tpu_storage_retries_total",
     "ray_tpu_storage_commit_seconds",
     "ray_tpu_serve_requests_total",
+    # PD disaggregation transfer plane + TTFT split (llm/kv_transfer.py,
+    # llm/pd.py)
+    "ray_tpu_llm_pd_transfer_bytes_total",
+    "ray_tpu_llm_pd_kv_pages_total",
+    "ray_tpu_llm_pd_ttft_seconds",
     # arena object-store accounting (CoreWorker._record_store_metrics)
     "ray_tpu_object_store_used",
     "ray_tpu_object_store_capacity",
