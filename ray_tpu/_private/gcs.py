@@ -103,6 +103,7 @@ class _Actor:
         "create_spec", "name",
         "restarts_left", "waiters", "kill_requested", "num_restarts",
         "max_task_retries",
+        "groups", "method_groups", "group_in_flight", "group_queued",
     )
 
     def __init__(self, aid: str, create_spec: dict):
@@ -112,6 +113,20 @@ class _Actor:
         self.queue: collections.deque[dict] = collections.deque()
         self.in_flight = 0  # dispatched, not yet done (≤ max_concurrency)
         self.max_concurrency = int(create_spec.get("max_concurrency") or 1)
+        # concurrency groups dispatch through their own lane (reference:
+        # concurrency_group_manager.h — per-group limits): group methods are
+        # never stuck behind a saturated default FIFO (e.g. serve health
+        # probes vs a full data queue). max_concurrency above is the TOTAL
+        # (default pool + group limits, summed at create_actor).
+        self.groups: dict[str, int] = {
+            str(k): max(1, int(v))
+            for k, v in (create_spec.get("concurrency_groups") or {}).items()}
+        self.method_groups: dict[str, str] = {
+            str(k): str(v) for k, v in
+            (create_spec.get("concurrency_group_methods") or {}).items()
+            if str(v) in self.groups}
+        self.group_in_flight: dict[str, int] = {}
+        self.group_queued = 0  # queued specs bound for ANY group lane
         self.create_spec = create_spec
         self.name: str | None = create_spec.get("name")
         self.restarts_left: int = create_spec.get("max_restarts", 0)
@@ -395,6 +410,13 @@ class GcsServer:
         # from the table): instance_id → record dict, write-through to the
         # sqlite `instances` table when persistence is on
         self.autoscaler_instances: dict[str, dict] = {}
+        # serve control-plane state (reference: the Serve controller
+        # checkpoints ApplicationState/DeploymentState into the GCS,
+        # serve/_private/controller.py:102): key → record dict, write-through
+        # to the sqlite `serve` table. A crash-restarted ServeController
+        # rebuilds deployments/replicas/routes from here and re-adopts live
+        # replica actors instead of restarting them.
+        self.serve_table: dict[str, dict] = {}
         # caller-reported local submission backlogs, piggybacked on lease
         # requests (reference: backlog_size in lease requests feeds the
         # autoscaler's demand view)
@@ -436,6 +458,8 @@ class GcsServer:
                 self.kv[k] = v
             for k, v in self.storage.items("instances"):
                 self.autoscaler_instances[k] = v
+            for k, v in self.storage.items("serve"):
+                self.serve_table[k] = v
         for _, spec in self.storage.items("pgs"):
             self._create_pg(dict(spec), _persist=False)
         for _, spec in self.storage.items("actors"):
@@ -1051,6 +1075,38 @@ class GcsServer:
             with self.lock:
                 recs = [dict(r) for r in self.autoscaler_instances.values()]
             conn.send({"rid": msg["rid"], "instances": recs})
+        elif t == "serve_put":
+            # serve control-plane write-through (reference: serve controller
+            # checkpoints before side effects) — same contract as
+            # instance_put: the reply IS the durability ack, so persist
+            # (memory + sqlite) strictly before sending it
+            key = str(msg["key"])
+            rec = dict(msg["record"])
+            with self.lock:
+                self.serve_table[key] = rec
+            if self.storage is not None:
+                self.storage.put("serve", key, rec)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "serve_delete":
+            key = str(msg["key"])
+            with self.lock:
+                self.serve_table.pop(key, None)
+            if self.storage is not None:
+                self.storage.delete("serve", key)
+            conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "serve_list":
+            with self.lock:
+                if msg.get("keys_only"):
+                    conn.send({"rid": msg["rid"],
+                               "keys": list(self.serve_table)})
+                    return wid
+                # light = control state only: blob rows carry the pickled
+                # callables and must not ship to list-only consumers (the
+                # dashboard polls this endpoint)
+                light = bool(msg.get("light"))
+                rows = {k: dict(r) for k, r in self.serve_table.items()
+                        if not (light and k.startswith("blob:"))}
+            conn.send({"rid": msg["rid"], "rows": rows})
         elif t == "oom_clear":
             # agent declined the pick or its kill failed: drop the tag
             self._note_oom_kill(msg["pid"], None,
@@ -1143,6 +1199,12 @@ class GcsServer:
                             for spec in hit:
                                 spec["_cancelled"] = True
                                 free_args.extend(self._unpin_args_locked(spec))
+                                # keep the group-lane backlog counter exact:
+                                # a stale positive forces the grouped
+                                # dispatch scan on every pass forever
+                                if a.method_groups.get(
+                                        spec.get("method") or "") is not None:
+                                    a.group_queued = max(0, a.group_queued - 1)
                             removed.extend(hit)
                             cancelled = True
                             break
@@ -2787,13 +2849,26 @@ class GcsServer:
                         if holder is not None and not holder.dead:
                             revokes.append((holder.conn, lw.wid))
 
-            # actor method calls (up to max_concurrency in flight per actor)
+            # actor method calls (up to max_concurrency in flight per actor;
+            # group-declared methods dispatch through their own lane so a
+            # control call — e.g. a serve health probe — is never stuck
+            # behind a saturated default queue)
             for actor in self.actors.values():
-                while (actor.state == "alive" and actor.queue
-                       and actor.in_flight < actor.max_concurrency):
-                    w = self.workers.get(actor.worker)
-                    if w is None or w.dead:
-                        break
+                if actor.state != "alive" or not actor.queue:
+                    continue
+                w = self.workers.get(actor.worker)
+                if w is None or w.dead:
+                    continue
+                if actor.group_queued > 0:
+                    self._dispatch_actor_grouped_locked(actor, w, to_send)
+                    continue
+                # fast path: nothing bound for a group lane is queued, so
+                # heads are all default-pool specs — FIFO up to the default
+                # cap (total minus reserved group slots)
+                base_cap = actor.max_concurrency - sum(actor.groups.values())
+                while (actor.queue
+                       and actor.in_flight
+                       - sum(actor.group_in_flight.values()) < base_cap):
                     spec = actor.queue.popleft()
                     actor.in_flight += 1
                     w.running_tasks[spec["task_id"]] = spec
@@ -2912,6 +2987,50 @@ class GcsServer:
             self.spawn_worker_cb(len(assignments), node_id, assignments,
                                  self.runtime_envs.get(rh) if rh else None)
 
+    def _dispatch_actor_grouped_locked(self, actor: _Actor, w: _Worker,
+                                       to_send: list) -> None:
+        """Dispatch an actor's queue with per-lane caps: group-declared
+        methods fill their group's slots regardless of position (a probe
+        queued behind 50 data requests still dispatches), default specs
+        fill the default pool FIFO. Called only when at least one queued
+        spec is bound for a group lane (group_queued > 0)."""
+        base_cap = actor.max_concurrency - sum(actor.groups.values())
+        default_in_flight = actor.in_flight - sum(
+            actor.group_in_flight.values())
+        group_left = actor.group_queued  # group-bound specs not yet visited
+        remaining: collections.deque[dict] = collections.deque()
+        while actor.queue:
+            if default_in_flight >= base_cap and (
+                    group_left <= 0
+                    or all(actor.group_in_flight.get(g, 0) >= lim
+                           for g, lim in actor.groups.items())):
+                # nothing further can dispatch: the default lane is full and
+                # either every group-bound spec has been visited or every
+                # group lane is full — don't churn the (possibly deep)
+                # default backlog
+                remaining.extend(actor.queue)
+                actor.queue.clear()
+                break
+            spec = actor.queue.popleft()
+            g = actor.method_groups.get(spec.get("method") or "")
+            if g is not None:
+                group_left -= 1
+                if actor.group_in_flight.get(g, 0) >= actor.groups[g]:
+                    remaining.append(spec)
+                    continue
+                actor.group_in_flight[g] = actor.group_in_flight.get(g, 0) + 1
+                actor.group_queued -= 1
+                spec["_cgroup"] = g  # for the done/death decrement
+            else:
+                if default_in_flight >= base_cap:
+                    remaining.append(spec)
+                    continue
+                default_in_flight += 1
+            actor.in_flight += 1
+            w.running_tasks[spec["task_id"]] = spec
+            to_send.append((w.conn, {"type": "exec", "spec": spec}))
+        actor.queue = remaining
+
     def _lease_would_help_locked(self, lw: _Worker) -> bool:
         """Would returning this worker's lease make any head-of-queue
         pending spec resource-feasible on its node? Only specs that are
@@ -3018,6 +3137,10 @@ class GcsServer:
                     actor = self.actors.get(spec["actor_id"])
                     if actor is not None:
                         actor.in_flight = max(0, actor.in_flight - 1)
+                        g = spec.get("_cgroup")
+                        if g:
+                            actor.group_in_flight[g] = max(
+                                0, actor.group_in_flight.get(g, 0) - 1)
                 else:
                     if w is not None:
                         w.idle = True
@@ -3152,6 +3275,8 @@ class GcsServer:
             spec["_holds"] = holds
             self._sys_hold_locked(holds, +1)
             actor.queue.append(spec)
+            if actor.method_groups.get(spec.get("method") or "") is not None:
+                actor.group_queued += 1
         self._schedule()
         return True, None
 
@@ -3200,6 +3325,7 @@ class GcsServer:
                 )
                 while actor.queue:
                     fail.append(actor.queue.popleft())
+                actor.group_queued = 0
                 for conn, rid in actor.waiters:
                     try:
                         conn.send({"rid": rid, "ok": False, "error": "ActorDiedError"})
@@ -3605,9 +3731,18 @@ class GcsServer:
                         else:
                             fail.append(s)
                     # lost calls run FIRST on the restarted actor, ahead of
-                    # the queued backlog that never dispatched
+                    # the queued backlog that never dispatched. Retried
+                    # specs go back to QUEUED: shed their in-flight group
+                    # stamp and recount the group-lane backlog.
+                    for s in retry_q:
+                        s.pop("_cgroup", None)
                     actor.queue.extendleft(reversed(retry_q))
                     actor.in_flight = 0
+                    actor.group_in_flight = {}
+                    actor.group_queued = sum(
+                        1 for s in actor.queue
+                        if actor.method_groups.get(s.get("method") or "")
+                        is not None)
                     actor.worker = None
                     if will_restart:
                         if actor.restarts_left > 0:
@@ -3624,6 +3759,7 @@ class GcsServer:
                                      {"actor_id": actor.aid, "state": "dead"})
                         while actor.queue:
                             fail.append(actor.queue.popleft())
+                        actor.group_queued = 0
                         for conn, rid in actor.waiters:
                             try:
                                 conn.send({"rid": rid, "ok": False, "error": "ActorDiedError"})

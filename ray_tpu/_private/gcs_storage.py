@@ -8,7 +8,8 @@ in WAL mode, one table per GCS manager, write-through on every mutation.
 
 Tables: kv (internal KV incl. jobs), actors (create specs of live actors),
 pgs (placement-group specs), session (session metadata), instances
-(autoscaler instance state machine — see autoscaler/instance_manager.py).
+(autoscaler instance state machine — see autoscaler/instance_manager.py),
+serve (serve control-plane state — see serve/controller.py recovery).
 """
 
 from __future__ import annotations
@@ -30,7 +31,7 @@ class GcsStorage:
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
-        for table in ("kv", "actors", "pgs", "session", "instances"):
+        for table in ("kv", "actors", "pgs", "session", "instances", "serve"):
             self._db.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "
                 "(key TEXT PRIMARY KEY, value BLOB)")

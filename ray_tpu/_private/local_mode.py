@@ -76,7 +76,8 @@ class LocalWorker:
     def create_actor(self, cls_blob, args, kwargs, *, resources=None, max_restarts=0, max_task_retries=0,
                      name=None, namespace=None, strategy=None,
                      max_concurrency=1, runtime_env=None,
-                     concurrency_groups=None, class_name=None):
+                     concurrency_groups=None, concurrency_group_methods=None,
+                     class_name=None):
         cls = ser.loads(cls_blob) if isinstance(cls_blob, bytes) else cls_blob
         aid = ActorID().hex()
         args = tuple(self.get_object(a.hex()) if isinstance(a, ObjectRef) else a for a in args)

@@ -1152,6 +1152,7 @@ class CoreWorker:
         max_concurrency: int = 1,
         runtime_env: dict | None = None,
         concurrency_groups: dict | None = None,
+        concurrency_group_methods: dict | None = None,
         class_name: str | None = None,
     ) -> str:
         actor_id = ActorID().hex()
@@ -1183,6 +1184,9 @@ class CoreWorker:
             "max_concurrency": max_concurrency + sum(
                 (concurrency_groups or {}).values()),
             "concurrency_groups": concurrency_groups or {},
+            # method → group map: lets the GCS dispatch group methods
+            # through their own lane (see _dispatch_actor_grouped_locked)
+            "concurrency_group_methods": concurrency_group_methods or {},
             **({"runtime_env": renv, "renv_hash": rhash} if rhash else {}),
             **_trace_field(),
             **spec_part,
@@ -1500,6 +1504,12 @@ class CoreWorker:
                         namespace: str | None = None) -> str | None:
         reply = self.rpc({"type": "get_named_actor", "name": name,
                           "namespace": namespace or self.effective_namespace()})
+        if reply.get("state") == "dead":
+            # a dead actor's name is a tombstone (the GCS lets a new actor
+            # claim it): callers must see "no such actor", not a handle
+            # every call on which fails — e.g. serve._get_controller after
+            # a shutdown must CREATE, and restarting actors still resolve
+            return None
         return reply["aid"]
 
     # ------------------------------------------------------- placement groups

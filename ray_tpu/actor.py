@@ -146,6 +146,20 @@ class ActorClass:
         ac._blob = self._blob
         return ac
 
+    def _concurrency_group_methods(self) -> dict:
+        """method name → declared concurrency group (@ray_tpu.method). The
+        map ships in the create spec so the GCS can dispatch group methods
+        through their own lane instead of the default FIFO — a control call
+        (e.g. a serve health probe) must not wait behind a saturated data
+        queue."""
+        out = {}
+        for klass in reversed(getattr(self._cls, "__mro__", (self._cls,))):
+            for name, fn in vars(klass).items():
+                group = getattr(fn, "__ray_tpu_concurrency_group__", None)
+                if group is not None:
+                    out[name] = group
+        return out
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         from ray_tpu._private.api import _get_worker
         from ray_tpu.util.scheduling_strategies import strategy_to_spec
@@ -164,6 +178,7 @@ class ActorClass:
             max_concurrency=self._max_concurrency,
             runtime_env=self._runtime_env,
             concurrency_groups=self._concurrency_groups,
+            concurrency_group_methods=self._concurrency_group_methods(),
             class_name=getattr(self._cls, "__name__", None),
         )
         return ActorHandle(actor_id)

@@ -120,6 +120,42 @@ class _Handler(BaseHTTPRequestHandler):
                 # compiled-DAG registry (registered at experimental_compile,
                 # dropped at teardown/driver death)
                 self._json(gcs.rpc({"type": "dag_list"}).get("dags", []))
+            elif path == "/api/serve":
+                # serve control plane straight from the persisted GCS
+                # `serve` table — works even while the controller is down
+                # mid-recovery. Per-replica health states let an operator
+                # watch a probe-driven replacement happen.
+                rows = gcs.rpc({"type": "serve_list",
+                                "light": True}).get("rows", {})
+                meta = rows.get("meta") or {}
+                deployments: dict = {}
+                for key, rec in rows.items():
+                    if key.startswith("dep:"):
+                        deployments[key[4:]] = {
+                            "app": rec.get("app_name"),
+                            "target": rec.get("target"),
+                            "deleted": rec.get("deleted", False),
+                            "replicas": {},
+                        }
+                for key, rec in rows.items():
+                    if not key.startswith("rep:"):
+                        continue
+                    dep = deployments.setdefault(
+                        rec.get("full_name"), {"replicas": {}})
+                    state = rec.get("state")
+                    health = {"starting": "recovering",
+                              "running": "healthy",
+                              "unhealthy": "unhealthy-probing",
+                              "draining": "draining",
+                              "stopping": "draining"}.get(state, state)
+                    dep["replicas"][rec.get("tag")] = {
+                        "actor_id": rec.get("actor_id"),
+                        "state": state, "health": health,
+                        "addr": rec.get("addr")}
+                self._json({"version": meta.get("version"),
+                            "routes": meta.get("routes", {}),
+                            "apps": meta.get("apps", {}),
+                            "deployments": deployments})
             elif path == "/api/jobs":
                 keys = gcs.rpc({"type": "kv_keys", "prefix": "job:"})["keys"]
                 jobs = []

@@ -16,11 +16,15 @@ from jax import lax
 
 
 def pvary(x, axes):
-    """Compat shim: mark x as varying over `axes` (jax pcast/pvary rename)."""
+    """Compat shim: mark x as varying over `axes` (jax pcast/pvary rename).
+    jax 0.4.x predates vma typing entirely — there it's an identity."""
     pcast = getattr(lax, "pcast", None)
     if pcast is not None:
         return pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    pv = getattr(lax, "pvary", None)
+    if pv is not None:
+        return pv(x, axes)
+    return x
 
 
 def zeros_varying_like(shape, dtype, ref):
@@ -53,7 +57,7 @@ def broadcast(x, axis_name: str, *, root: int = 0):
 
 
 def ring_permute(x, axis_name: str, *, shift: int = 1):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -67,4 +71,12 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    """STATIC size of a named mesh axis from inside shard_map. jax 0.4.x has
+    no lax.axis_size; there the axis env frame carries the size directly."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    from jax._src.core import axis_frame
+
+    fr = axis_frame(axis_name)
+    return fr if isinstance(fr, int) else fr.size

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.parallel.collectives import pvary as _pvary, zeros_varying_like
+from ray_tpu.parallel.collectives import axis_size, pvary as _pvary, zeros_varying_like
 
 
 def pipeline_apply(
@@ -35,7 +35,7 @@ def pipeline_apply(
 
     stage_fn(stage_params, h) -> h', applied by each stage to each microbatch.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     x = _pvary(x, (axis_name,))  # replicated input enters the varying world
     n_micro = x.shape[0]

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.parallel.collectives import pvary as _pvary, zeros_varying_like
+from ray_tpu.parallel.collectives import axis_size, pvary as _pvary, zeros_varying_like
 
 _NEG_INF = -1e30
 
@@ -51,7 +51,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     B, T, H, D = q.shape
     if scale is None:
         scale = D ** -0.5
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     q_pos = my * T + jnp.arange(T)
 

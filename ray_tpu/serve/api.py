@@ -8,6 +8,7 @@ brings up the proxy; serve.status/delete/shutdown manage lifecycle.)
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import ray_tpu
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
@@ -23,8 +24,57 @@ def _get_controller(create: bool = False):
     except ValueError:
         if not create:
             raise RuntimeError("serve is not running; call serve.run/start first") from None
-        return ServeController.options(
-            name=CONTROLLER_NAME, namespace="_system", num_cpus=0.5).remote()
+    # create path. The name may transiently be held by a DYING controller
+    # (a concurrent serve.shutdown's kill not yet tombstoned) or won by a
+    # concurrent creator — loop resolve→create with backoff so both the
+    # "now tombstoned: create again" and "other creator won: resolve it"
+    # transitions succeed instead of failing the caller.
+    deadline = time.monotonic() + 10.0
+    backoff = 0.05
+    while True:
+        try:
+            # crash-restartable control plane: the GCS restarts the
+            # controller in place (same actor id, name kept) and its
+            # __init__ rebuilds from the persisted serve table; in-flight
+            # calls retry on the restarted incarnation (mutations are
+            # idempotent — deploys compare blobs, persists are upserts)
+            return ServeController.options(
+                name=CONTROLLER_NAME, namespace="_system", num_cpus=0.5,
+                max_restarts=-1, max_task_retries=-1).remote()
+        except ValueError as e:
+            # only a NAME conflict is retryable (dying actor or a creation
+            # race); any other GCS rejection must surface, not be retried
+            # into a misleading "name stayed held" timeout
+            if "already exists" not in str(e):
+                raise
+        try:
+            return ray_tpu.get_actor(CONTROLLER_NAME, namespace="_system")
+        except ValueError:
+            pass  # tombstoned between the two attempts: create next pass
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                "could not create or resolve the serve controller "
+                f"(the name {CONTROLLER_NAME!r} stayed held)")
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 0.5)
+
+
+def _resolve_controller(timeout_s: float = 5.0):
+    """Re-resolve the controller by name with retry/backoff (reference:
+    serve clients look the controller up by name rather than caching a
+    dead handle). Used by routers/proxies healing after a controller death
+    and by creation races."""
+    deadline = time.monotonic() + timeout_s
+    backoff = 0.05
+    while True:
+        try:
+            return ray_tpu.get_actor(CONTROLLER_NAME, namespace="_system")
+        except ValueError:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "serve is not running; call serve.run/start first") from None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.5)
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
@@ -71,6 +121,9 @@ def run(target: Application, *, name: str = "default",
             "autoscaling_config": (dataclasses.asdict(cfg.autoscaling_config)
                                    if cfg.autoscaling_config else None),
             "request_router": cfg.request_router,
+            "health_check_period_s": cfg.health_check_period_s,
+            "health_check_timeout_s": cfg.health_check_timeout_s,
+            "graceful_shutdown_timeout_s": cfg.graceful_shutdown_timeout_s,
         }
         specs.append({
             "name": app.deployment.name,
@@ -130,3 +183,21 @@ def shutdown():
             ray_tpu.kill(controller)
         except Exception:
             pass
+        # wait until the controller actor is actually DEAD (not merely
+        # kill-requested): a next serve.run in this session must either
+        # find no actor under the name (→ create) or a live one — never a
+        # dying one whose in-flight deploys die with it
+        from ray_tpu._private.api import _get_worker
+
+        w = _get_worker()
+        if hasattr(w, "rpc"):
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                try:
+                    info = w.rpc({"type": "actor_info",
+                                  "aid": controller.actor_id})
+                except Exception:  # noqa: BLE001
+                    break
+                if not info.get("found") or info.get("state") == "dead":
+                    break
+                time.sleep(0.05)

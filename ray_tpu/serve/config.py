@@ -30,7 +30,12 @@ class DeploymentConfig:
     ray_actor_options: dict = field(default_factory=dict)
     autoscaling_config: AutoscalingConfig | None = None
     user_config: dict | None = None
+    # active probing: the controller drives ReplicaActor.check_health every
+    # period; a probe that hangs past the timeout (or fails repeatedly)
+    # marks the replica unhealthy → drain-and-replace (reference:
+    # serve/config.py health_check_{period,timeout}_s)
     health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 5.0
     # "pow2" | "prefix_aware" (reference: pluggable RequestRouter —
     # request_router/pow_2_router.py, llm prefix_aware/prefix_tree.py)

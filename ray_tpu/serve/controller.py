@@ -6,6 +6,26 @@ whose reconcile loop creates/kills replica actors to match the target, and
 the autoscaling state (autoscaling_state.py:838) that turns ongoing-request
 metrics into new targets. Routing-table push via LongPoll is replaced by
 versioned pull: routers poll get_routing_table and cache by version.)
+
+Fault tolerance (reference: the controller checkpoints its state into the
+GCS and recovers without touching running replicas — controller.py:102 +
+deployment_state.py's recovery path): every control-plane mutation is
+write-through persisted into the GCS `serve` table BEFORE its side effect
+(replica create/kill) counts as durable, the controller runs as a named
+restartable actor (max_restarts=-1), and a crash-restarted incarnation's
+__init__ rebuilds deployments/routes from the table and RE-ADOPTS live
+replicas by named-actor lookup — healthy replicas are never restarted,
+routers keep serving from their version-cached tables during the outage,
+and stale rows (replica died while the controller was down) are reaped by
+the first reconcile.
+
+Health probing (reference: deployment_state.py drives
+ReplicaActor.check_health on health_check_period_s): the reconcile loop
+actively probes each replica; a probe that raises counts toward a
+consecutive-failure threshold, a probe that HANGS past
+health_check_timeout_s marks the replica unhealthy immediately — either
+way the replica is drained and replaced, distinct from the
+actor-state="dead" path.
 """
 
 from __future__ import annotations
@@ -13,17 +33,61 @@ from __future__ import annotations
 import math
 import threading
 import time
+import uuid
 
 import ray_tpu
+from ray_tpu.actor import ActorHandle
+from ray_tpu.serve.gcs_state import (META_KEY, blob_key, dep_key,
+                                     gcs_serve_store, rep_key)
 from ray_tpu.serve.replica import ReplicaActor
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 RECONCILE_INTERVAL_S = 0.1
+#: consecutive FAILING (raising) health probes before a replica is replaced.
+#: A probe that hangs past health_check_timeout_s replaces immediately —
+#: a wedged replica must be gone within one timeout, not threshold × timeout.
+HEALTH_PROBE_FAILURE_THRESHOLD = 3
+
+
+def _recoveries_counter():
+    from ray_tpu.util.metrics import Counter, get_or_create
+
+    return get_or_create(
+        Counter, "ray_tpu_serve_controller_recoveries_total",
+        "serve controller crash-restart recoveries")
+
+
+def _readopted_counter():
+    from ray_tpu.util.metrics import Counter, get_or_create
+
+    return get_or_create(
+        Counter, "ray_tpu_serve_replicas_readopted_total",
+        "serve replicas re-adopted (not restarted) across controller "
+        "recoveries")
+
+
+def _probe_failure_counter():
+    from ray_tpu.util.metrics import Counter, get_or_create
+
+    return get_or_create(
+        Counter, "ray_tpu_serve_replica_health_check_failures_total",
+        "serve replica health-check probe failures",
+        tag_keys=("deployment", "replica"))
+
+
+def _count(fn):
+    """Metrics must never fail a control-plane transition."""
+    try:
+        fn()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 class _DeploymentState:
     def __init__(self, app_name: str, name: str, callable_blob: bytes,
-                 init_args_blob: bytes, config: dict):
+                 init_args_blob: bytes, config: dict, *,
+                 next_idx: int = 0, nonce: str | None = None,
+                 target: int | None = None, deleted: bool = False):
         self.app_name = app_name
         self.name = name
         self.callable_blob = callable_blob
@@ -33,25 +97,210 @@ class _DeploymentState:
         self.addrs: dict[str, tuple] = {}      # tag → fast-RPC (host, port)
         self.pushed: dict[str, tuple] = {}     # tag → (ongoing, mono_ts)
         self.draining: dict[str, tuple[object, float]] = {}  # tag → (handle, deadline)
-        self.target = config["initial_replicas"]
-        self.next_idx = 0
+        self.target = config["initial_replicas"] if target is None else target
+        self.next_idx = next_idx
+        # names replica actors uniquely across controller generations and
+        # redeploys (a dying previous session's replica may still hold its
+        # name when the next session starts)
+        self.nonce = nonce or uuid.uuid4().hex[:8]
         self.status = "UPDATING"
         self.last_scale_down_ok: float = 0.0
-        self.deleted = False
+        self.deleted = deleted
+        # persisted replica rows mirrored in memory (tag → record) and the
+        # operator-visible health state per tag: recovering / healthy /
+        # unhealthy-probing / draining
+        self.rep_rows: dict[str, dict] = {}
+        self.health: dict[str, str] = {}
+        # active probing state (in-memory only — probes restart clean after
+        # a controller recovery)
+        self.probe_fail: dict[str, int] = {}
+        self.probe_inflight: dict[str, tuple] = {}  # tag → (ref, sent_mono)
+        self.probe_last: dict[str, float] = {}
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.app_name}_{self.name}"
+
+    def to_record(self) -> dict:
+        """Mutable control state only — the (immutable, possibly multi-MB)
+        code blobs live in their own blob:<full>:<nonce> row written once
+        per generation, so target moves and index bumps stay small writes."""
+        return {
+            "app_name": self.app_name, "name": self.name,
+            "config": dict(self.config), "target": self.target,
+            "next_idx": self.next_idx, "nonce": self.nonce,
+            "deleted": self.deleted,
+        }
+
+    def blobs_record(self) -> dict:
+        return {"callable_blob": self.callable_blob,
+                "init_args_blob": self.init_args_blob}
+
+    @classmethod
+    def from_record(cls, rec: dict, blobs: dict) -> "_DeploymentState":
+        return cls(rec["app_name"], rec["name"], blobs["callable_blob"],
+                   blobs["init_args_blob"], rec["config"],
+                   next_idx=rec.get("next_idx", 0), nonce=rec.get("nonce"),
+                   target=rec.get("target"),
+                   deleted=rec.get("deleted", False))
 
 
 @ray_tpu.remote
 class ServeController:
-    def __init__(self):
+    def __init__(self, _store=None, _start_loop: bool = True):
         self.deployments: dict[str, _DeploymentState] = {}  # full_name → state
         self.routes: dict[str, str] = {}  # route_prefix → full deployment name
         self.apps: dict[str, str] = {}    # app name → ingress full name
-        self.version = 0
+        # fresh-start version base is wall-clock ms, NOT 0: a router that
+        # outlives a serve.shutdown()+run() (which clears the table) still
+        # holds the old session's version, and a counter restarting at 0
+        # could climb back to exactly that number with different content —
+        # the router would then be told "up to date" forever. Crash-restart
+        # recovery overwrites this with persisted version + 1 (same
+        # lineage, so continuity is what's correct there).
+        self.version = int(time.time() * 1000)
         self._lock = threading.RLock()
         self._stop = False
-        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True,
-                                        name="serve-reconcile")
-        self._thread.start()
+        self._reconcile_dirty = False  # probe path requests one batched bump
+        self._store = _store if _store is not None else gcs_serve_store()
+        self._recover()
+        self._thread = None
+        if _start_loop:
+            self._thread = threading.Thread(
+                target=self._reconcile_loop, daemon=True,
+                name="serve-reconcile")
+            self._thread.start()
+
+    # ---------------------------------------------------------- persistence
+
+    def _persist_meta(self) -> None:
+        self._store.put(META_KEY, {"version": self.version,
+                                   "routes": dict(self.routes),
+                                   "apps": dict(self.apps)})
+
+    def _bump_version(self) -> None:
+        """Version bumps are persisted with their routes/apps so a recovered
+        controller can never reuse a (version, content) pair a router cached
+        before the crash (recovery restarts from persisted version + 1)."""
+        self.version += 1
+        self._persist_meta()
+
+    def _persist_dep(self, st: _DeploymentState) -> None:
+        self._store.put(dep_key(st.full_name), st.to_record())
+
+    def _persist_rep(self, st: _DeploymentState, tag: str) -> None:
+        self._store.put(rep_key(st.full_name, tag), st.rep_rows[tag])
+
+    def _delete_rep_row(self, st: _DeploymentState, tag: str) -> None:
+        self._store.delete(rep_key(st.full_name, tag))
+        st.rep_rows.pop(tag, None)
+        st.health.pop(tag, None)
+
+    # -------------------------------------------------------------- recovery
+
+    def _actor_state(self, aid: str) -> str | None:
+        from ray_tpu._private.api import _get_worker
+
+        w = _get_worker()
+        if not hasattr(w, "rpc"):
+            return None
+        reply = w.rpc({"type": "actor_info", "aid": aid})
+        return reply.get("state") if reply.get("found") else None
+
+    def _lookup_named(self, name: str) -> str | None:
+        from ray_tpu._private.api import _get_worker
+
+        w = _get_worker()
+        if not hasattr(w, "get_named_actor"):
+            return None
+        try:
+            return w.get_named_actor(name, namespace="_system")
+        except Exception:  # noqa: BLE001 — treat lookup failure as absent
+            return None
+
+    def _recover(self) -> None:
+        """Rebuild from the persisted table (crash-restart path; a no-op on
+        the first-ever start). Live replicas are re-adopted by named-actor
+        lookup — same actor ids, never restarted; rows whose actor died
+        while the controller was down are reaped; rows caught mid-stop get
+        their kill re-issued (idempotent)."""
+        rows = self._store.list()
+        if not rows:
+            return
+        meta = rows.get(META_KEY) or {}
+        self.routes = dict(meta.get("routes") or {})
+        self.apps = dict(meta.get("apps") or {})
+        self.version = int(meta.get("version", 0))
+        live_blob_keys = set()
+        for key, rec in rows.items():
+            if not key.startswith("dep:"):
+                continue
+            bkey = blob_key(f"{rec['app_name']}_{rec['name']}",
+                            rec.get("nonce") or "")
+            blobs = rows.get(bkey)
+            if blobs is None:
+                # a dep row whose generation blobs never landed (crash
+                # between deploy persists): unrecoverable — drop it; its
+                # replica rows become orphans and are reaped below
+                self._store.delete(key)
+                continue
+            live_blob_keys.add(bkey)
+            st = _DeploymentState.from_record(rec, blobs)
+            self.deployments[st.full_name] = st
+        for key in rows:
+            # blob rows left behind by a replaced/deleted generation
+            if key.startswith("blob:") and key not in live_blob_keys:
+                self._store.delete(key)
+        readopted = 0
+        now_mono = time.monotonic()
+        for key, rec in rows.items():
+            if not key.startswith("rep:"):
+                continue
+            full, tag = rec["full_name"], rec["tag"]
+            st = self.deployments.get(full)
+            aid = self._lookup_named(rec["actor_name"])
+            alive = (aid is not None
+                     and self._actor_state(aid) in ("alive", "pending",
+                                                    "restarting"))
+            if st is None:
+                # orphan row (its deployment record is gone): kill whatever
+                # is still running under it and drop the row
+                if alive:
+                    self._kill_replica(ActorHandle(aid))
+                self._store.delete(key)
+                continue
+            if rec.get("state") == "stopping" or not alive:
+                # stopping: the previous incarnation decided to kill this
+                # replica — re-issue (idempotent) and finish the delete.
+                # dead/missing: a stale row; the reconcile loop replaces it.
+                if alive:
+                    self._kill_replica(ActorHandle(aid))
+                self._store.delete(key)
+                continue
+            handle = ActorHandle(aid)
+            if rec.get("state") == "draining":
+                remaining = max(0.0, rec.get("drain_deadline_ts", 0.0)
+                                - time.time())
+                st.draining[tag] = (handle, now_mono + remaining)
+                st.rep_rows[tag] = dict(rec)
+                st.health[tag] = "draining"
+                continue
+            # live replica: re-adopt in place, same actor id
+            st.replicas[tag] = handle
+            if rec.get("addr"):
+                st.addrs[tag] = tuple(rec["addr"])
+            rec = {**rec, "actor_id": aid, "state": "running"}
+            st.rep_rows[tag] = rec
+            self._store.put(key, rec)
+            st.health[tag] = "recovering"  # until the first probe passes
+            st.probe_last[tag] = now_mono
+            readopted += 1
+        _count(lambda: _recoveries_counter().inc())
+        if readopted:
+            _count(lambda: _readopted_counter().inc(readopted))
+        # force every router to refetch: the rebuilt table content may
+        # differ from anything cached under the persisted version
+        self._bump_version()
 
     # ------------------------------------------------------------------- api
 
@@ -64,9 +313,12 @@ class ServeController:
                 if (existing is not None
                         and existing.callable_blob == d["callable_blob"]
                         and existing.init_args_blob == d["init_args_blob"]):
-                    # config-only update: adjust target / user_config in place
+                    # config-only update: adjust target / user_config in
+                    # place — persisted BEFORE the reconfigure side effect
                     existing.config = d["config"]
                     existing.target = d["config"]["initial_replicas"]
+                    existing.deleted = False
+                    self._persist_dep(existing)
                     if d["config"].get("user_config") is not None:
                         for r in existing.replicas.values():
                             r.reconfigure.remote(d["config"]["user_config"])
@@ -75,14 +327,31 @@ class ServeController:
                     self._drop_replicas(existing, list(existing.replicas))
                 new_state = _DeploymentState(
                     app_name, d["name"], d["callable_blob"],
-                    d["init_args_blob"], d["config"])
+                    d["init_args_blob"], d["config"],
+                    # tags must never be reused while old rows/names can
+                    # still exist: the replacement generation continues the
+                    # index sequence and keeps draining bookkeeping
+                    next_idx=existing.next_idx if existing else 0)
                 if existing is not None:
                     new_state.draining = dict(existing.draining)  # finish drains
+                    for tag in new_state.draining:
+                        if tag in existing.rep_rows:
+                            new_state.rep_rows[tag] = existing.rep_rows[tag]
+                        new_state.health[tag] = "draining"
                 self.deployments[full] = new_state
+                # blobs first (written once per generation), THEN the dep
+                # row that references them — a crash in between leaves an
+                # orphan blob row recovery sweeps, never a dep row whose
+                # code is gone
+                self._store.put(blob_key(full, new_state.nonce),
+                                new_state.blobs_record())
+                self._persist_dep(new_state)
+                if existing is not None:
+                    self._store.delete(blob_key(full, existing.nonce))
             if route_prefix is not None:
                 self.routes[route_prefix] = f"{app_name}_{ingress}"
             self.apps[app_name] = f"{app_name}_{ingress}"
-            self.version += 1
+            self._bump_version()
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
@@ -90,10 +359,11 @@ class ServeController:
                 if st.app_name == app_name:
                     st.deleted = True
                     st.target = 0
+                    self._persist_dep(st)
             self.routes = {p: d for p, d in self.routes.items()
                            if not d.startswith(app_name + "_")}
             self.apps.pop(app_name, None)
-            self.version += 1
+            self._bump_version()
 
     def get_routing_table(self, known_version: int = -1) -> dict | None:
         """Replica actor ids per deployment; None if caller is up to date."""
@@ -120,7 +390,10 @@ class ServeController:
         with self._lock:
             return {
                 full: {"status": st.status, "replicas": len(st.replicas),
-                       "target": st.target, "app": st.app_name}
+                       "target": st.target, "app": st.app_name,
+                       # operator view of probe-driven replacement:
+                       # recovering / healthy / unhealthy-probing / draining
+                       "replica_health": dict(st.health)}
                 for full, st in self.deployments.items()
             }
 
@@ -137,6 +410,13 @@ class ServeController:
                 st.replicas.clear()
                 st.draining.clear()
             self.deployments.clear()
+            # an explicit shutdown is terminal: clear the table so the NEXT
+            # serve session starts from nothing instead of "recovering"
+            # this session's deployments
+            try:
+                self._store.clear()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
     # -------------------------------------------------------------- reconcile
 
@@ -158,6 +438,11 @@ class ServeController:
         stats_ok = actor_stats is not None
         lookup = actor_stats or {}
         now = time.monotonic()
+        # ONE batched version bump per pass: the bump is a synchronous
+        # persist RPC under the lock, and a burst (e.g. a node death taking
+        # out 10 replicas) must not serialize 10 round trips while routers'
+        # get_routing_table calls wait on the lock
+        changed = False
         with self._lock:
             for full, st in list(self.deployments.items()):
                 # replica death detection: drop handles whose actor the GCS
@@ -172,42 +457,152 @@ class ServeController:
                         st.replicas.pop(tag)
                         st.addrs.pop(tag, None)
                         st.pushed.pop(tag, None)
-                        self.version += 1
+                        self._forget_probe(st, tag)
+                        self._delete_rep_row(st, tag)
+                        changed = True
+                    # active health probing on each deployment's
+                    # health_check_period_s — distinct from the
+                    # actor-state="dead" path above: these replicas are
+                    # alive but failing/hanging their probes
+                    self._probe_health(st, lookup, now)
                 # drain completion: kill once idle or past the grace deadline
                 for tag, (h, deadline) in list(st.draining.items()):
                     s = lookup.get(h.actor_id, {})
                     idle = stats_ok and s.get("queued", 0) + s.get("in_flight", 0) == 0
                     if idle or now > deadline or s.get("state") == "dead":
                         st.draining.pop(tag)
+                        # persist the decision BEFORE the kill: a crash in
+                        # between re-issues the (idempotent) kill on recovery
+                        row = st.rep_rows.get(tag)
+                        if row is not None:
+                            row["state"] = "stopping"
+                            self._persist_rep(st, tag)
                         self._kill_replica(h)
+                        self._delete_rep_row(st, tag)
                 live = len(st.replicas)
                 if live < st.target:
                     for _ in range(st.target - live):
                         self._start_replica(st)
-                    self.version += 1
+                    changed = True
                 elif live > st.target:
                     drop = list(st.replicas)[: live - st.target]
                     self._drop_replicas(st, drop)
-                    self.version += 1
+                    changed = True
                 st.status = ("HEALTHY" if len(st.replicas) == st.target
                              else "UPDATING")
                 if st.deleted and not st.replicas and not st.draining:
                     del self.deployments[full]
-                    self.version += 1
+                    self._store.delete(dep_key(full))
+                    self._store.delete(blob_key(full, st.nonce))
+                    changed = True
+            if changed or self._reconcile_dirty:
+                self._reconcile_dirty = False
+                self._bump_version()
+
+    # --------------------------------------------------------- health probes
+
+    def _forget_probe(self, st: _DeploymentState, tag: str) -> None:
+        st.probe_fail.pop(tag, None)
+        st.probe_inflight.pop(tag, None)
+        st.probe_last.pop(tag, None)
+
+    def _probe_health(self, st: _DeploymentState, lookup: dict, now: float):
+        period = st.config.get("health_check_period_s") or 2.0
+        timeout_s = st.config.get("health_check_timeout_s") or 30.0
+        for tag, h in list(st.replicas.items()):
+            ref, sent = st.probe_inflight.get(tag, (None, 0.0))
+            if ref is not None:
+                done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                if done:
+                    st.probe_inflight.pop(tag, None)
+                    try:
+                        ray_tpu.get(ref, timeout=5.0)
+                        st.probe_fail[tag] = 0
+                        if st.health.get(tag) != "healthy":
+                            st.health[tag] = "healthy"
+                            row = st.rep_rows.get(tag)
+                            if row is not None and row.get("state") != "running":
+                                row["state"] = "running"
+                                self._persist_rep(st, tag)
+                    except Exception:  # noqa: BLE001 — any error = failed probe
+                        self._probe_failed(st, tag)
+                elif now - sent > timeout_s:
+                    # hung probe: the replica is wedged, not dead — replace
+                    # NOW (waiting out a failure threshold would stretch the
+                    # outage to threshold × timeout)
+                    st.probe_inflight.pop(tag, None)
+                    self._probe_failed(st, tag, hung=True)
+                continue
+            if lookup.get(h.actor_id, {}).get("state") != "alive":
+                continue  # still starting/restarting: don't time its init
+            if now - st.probe_last.get(tag, 0.0) >= period:
+                st.probe_last[tag] = now
+                try:
+                    st.probe_inflight[tag] = (h.check_health.remote(), now)
+                except Exception:  # noqa: BLE001 — submit failure: next tick
+                    pass
+
+    def _probe_failed(self, st: _DeploymentState, tag: str,
+                      hung: bool = False):
+        st.probe_fail[tag] = st.probe_fail.get(tag, 0) + 1
+        _count(lambda: _probe_failure_counter().inc(
+            tags={"deployment": st.full_name, "replica": tag}))
+        st.health[tag] = "unhealthy-probing"
+        row = st.rep_rows.get(tag)
+        if row is not None and row.get("state") != "unhealthy":
+            # persisted too, so /api/serve (reading the table, not this
+            # actor) shows the probing window of a replacement in progress
+            row["state"] = "unhealthy"
+            self._persist_rep(st, tag)
+        if hung or st.probe_fail[tag] >= HEALTH_PROBE_FAILURE_THRESHOLD:
+            # unhealthy → drain-and-replace: it leaves the routing table
+            # now, dies once idle (or at the grace deadline), and the
+            # target/live gap starts its replacement this same tick
+            # (version bump batched into this reconcile pass)
+            self._drop_replicas(st, [tag])
+            self._reconcile_dirty = True
+
+    # ------------------------------------------------------ replica lifecycle
 
     def _start_replica(self, st: _DeploymentState):
         tag = f"{st.name}#{st.next_idx}"
         st.next_idx += 1
+        # persist the advanced index BEFORE creating anything: tags are
+        # burned once, so a crash anywhere past here can never hand a new
+        # replica a name that an old (possibly still dying) actor holds
+        self._persist_dep(st)
+        actor_name = f"SERVE_REPLICA:{st.full_name}:{tag}:{st.nonce}"
+        row = {"full_name": st.full_name, "tag": tag,
+               "actor_name": actor_name, "actor_id": None, "addr": None,
+               "state": "starting", "drain_deadline_ts": None}
+        st.rep_rows[tag] = row
+        # the row is durable BEFORE the create side effect: a crash between
+        # persist and create leaves a row recovery resolves by named-actor
+        # lookup (found → adopt; not found → reap and recreate)
+        self._persist_rep(st, tag)
         opts = dict(st.config.get("ray_actor_options") or {})
-        handle = ReplicaActor.options(
-            num_cpus=opts.get("num_cpus", 1.0),
-            num_tpus=opts.get("num_tpus"),
-            resources=opts.get("resources"),
-            max_concurrency=st.config["max_ongoing_requests"],
-        ).remote(f"{st.app_name}_{st.name}", tag, st.callable_blob,
-                 st.init_args_blob, st.config.get("user_config"),
-                 st.config["max_ongoing_requests"])
+        try:
+            handle = ReplicaActor.options(
+                name=actor_name, namespace="_system",
+                num_cpus=opts.get("num_cpus", 1.0),
+                num_tpus=opts.get("num_tpus"),
+                resources=opts.get("resources"),
+                # data-plane concurrency; health probes ride the replica's
+                # dedicated 'control' concurrency group (replica.py), so a
+                # saturated request queue can never starve them into a
+                # spurious hung-probe replacement
+                max_concurrency=st.config["max_ongoing_requests"],
+            ).remote(st.full_name, tag, st.callable_blob,
+                     st.init_args_blob, st.config.get("user_config"),
+                     st.config["max_ongoing_requests"])
+        except Exception:  # noqa: BLE001 — e.g. the name is still held
+            self._delete_rep_row(st, tag)  # retry next tick with a new tag
+            return
+        row["actor_id"] = handle.actor_id
+        self._persist_rep(st, tag)
         st.replicas[tag] = handle
+        st.health[tag] = "recovering"  # until its first probe passes
+        st.probe_last[tag] = time.monotonic()
 
     def note_replica_addr(self, full_name: str, tag: str, addr) -> None:
         """Replica pushes its fast-RPC (host, port) once listening; routers
@@ -220,8 +615,12 @@ class ServeController:
             addr = tuple(addr)
             if st.addrs.get(tag) == addr:
                 return  # periodic re-advertisement: no change, no version bump
+            row = st.rep_rows.get(tag)
+            if row is not None:
+                row["addr"] = list(addr)
+                self._persist_rep(st, tag)
             st.addrs[tag] = addr
-            self.version += 1
+            self._bump_version()
 
     def note_replica_stats(self, full_name: str, tag: str,
                            ongoing: int) -> None:
@@ -242,11 +641,21 @@ class ServeController:
         grace = st.config.get("graceful_shutdown_timeout_s", 5.0)
         deadline = time.monotonic() + grace
         for tag in tags:
+            # drain decision persisted (wall-clock deadline: it must stay
+            # meaningful to a recovered controller) BEFORE the replica
+            # leaves the routing table
+            row = st.rep_rows.get(tag)
+            if row is not None:
+                row["state"] = "draining"
+                row["drain_deadline_ts"] = time.time() + grace
+                self._persist_rep(st, tag)
             h = st.replicas.pop(tag, None)
             st.addrs.pop(tag, None)
             st.pushed.pop(tag, None)
+            self._forget_probe(st, tag)
             if h is not None:
                 st.draining[tag] = (h, deadline)
+                st.health[tag] = "draining"
 
     def _kill_replica(self, h):
         try:
@@ -293,8 +702,10 @@ class ServeController:
                 if desired > st.target:
                     st.target = desired
                     st.last_scale_down_ok = now + cfg["downscale_delay_s"]
+                    self._persist_dep(st)
                 elif desired < st.target:
                     if now >= st.last_scale_down_ok:
                         st.target = desired
+                        self._persist_dep(st)
                 else:
                     st.last_scale_down_ok = now + cfg["downscale_delay_s"]

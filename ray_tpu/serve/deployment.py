@@ -22,7 +22,9 @@ class Deployment:
     def options(self, *, name=None, num_replicas=None, max_ongoing_requests=None,
                 ray_actor_options=None, autoscaling_config=None,
                 user_config=None, request_router=None,
-                graceful_shutdown_timeout_s=None, **_ignored) -> "Deployment":
+                graceful_shutdown_timeout_s=None,
+                health_check_period_s=None, health_check_timeout_s=None,
+                **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=(self.config.num_replicas if num_replicas is None
                           else (None if num_replicas == "auto" else num_replicas)),
@@ -42,6 +44,12 @@ class Deployment:
                 self.config.graceful_shutdown_timeout_s
                 if graceful_shutdown_timeout_s is None
                 else graceful_shutdown_timeout_s),
+            health_check_period_s=(self.config.health_check_period_s
+                                   if health_check_period_s is None
+                                   else health_check_period_s),
+            health_check_timeout_s=(self.config.health_check_timeout_s
+                                    if health_check_timeout_s is None
+                                    else health_check_timeout_s),
         )
         if num_replicas == "auto" and cfg.autoscaling_config is None:
             cfg.autoscaling_config = AutoscalingConfig()
@@ -82,6 +90,8 @@ def deployment(func_or_class=None, *, name=None, num_replicas=1,
                max_ongoing_requests=8, ray_actor_options=None,
                autoscaling_config=None, user_config=None,
                health_check_period_s: float = 2.0,
+               health_check_timeout_s: float = 30.0,
+               graceful_shutdown_timeout_s: float = 5.0,
                request_router: str = "pow2"):
     """Decorator usable bare or with options.
     (reference: serve/api.py:333.)"""
@@ -98,6 +108,8 @@ def deployment(func_or_class=None, *, name=None, num_replicas=1,
                                 else autoscaling_config),
             user_config=user_config,
             health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             request_router=request_router,
         )
         if num_replicas == "auto" and cfg.autoscaling_config is None:

@@ -240,22 +240,56 @@ class DeploymentResponseGenerator:
 class _Router:
     def __init__(self, deployment_full_name: str, controller):
         self.name = deployment_full_name
-        self.controller = controller
+        self.controller = controller  # may be None: resolved lazily by name
         self.version = -1
         self.replicas: list[str] = []
         self.addrs: dict[str, tuple] = {}  # replica actor_id -> fast-RPC addr
         self.inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._pending_table = None  # in-flight get_routing_table ref
         self._prefix_policy = None  # created when the table asks for it
+
+    def _controller_handle(self):
+        c = self.controller
+        if c is not None:
+            return c
+        from ray_tpu.serve.api import _resolve_controller
+
+        # single resolve attempt (timeout 0): _refresh runs on the REQUEST
+        # path, so an outage must cost one fast lookup, not a retry loop
+        self.controller = _resolve_controller(timeout_s=0.0)
+        return self.controller
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
         if not force and now - self._last_refresh < ROUTING_REFRESH_S:
             return
         self._last_refresh = now
-        table = ray_tpu.get(
-            self.controller.get_routing_table.remote(self.version), timeout=10.0)
+        try:
+            # the table fetch is ASYNC with a short completion wait: during
+            # a controller outage (crash-restart queues the call) pick()
+            # must keep serving from the version-cached table after a
+            # bounded pause, not hang for the restart's duration. An
+            # unanswered fetch stays pending and is re-checked by the next
+            # refresh tick.
+            if self._pending_table is None:
+                self._pending_table = self._controller_handle() \
+                    .get_routing_table.remote(self.version)
+            done, _ = ray_tpu.wait([self._pending_table], num_returns=1,
+                                   timeout=1.0 if force else 0.25)
+            if not done:
+                return  # still in flight: serve the cached table
+            ref, self._pending_table = self._pending_table, None
+            table = ray_tpu.get(ref, timeout=5.0)
+        except Exception:  # noqa: BLE001 — controller outage
+            # the controller was killed and recreated under the same name
+            # (or the call died with it): KEEP SERVING from the cached
+            # table — replicas are routed direct, no controller on the
+            # request path — and re-resolve the controller next refresh
+            self._pending_table = None
+            self.controller = None
+            return
         if table is None:
             return
         with self._lock:
@@ -321,7 +355,15 @@ class DeploymentHandle:
         from ray_tpu.serve.api import _get_controller
 
         self._name = deployment_full_name
-        self._controller = controller or _get_controller()
+        if controller is None:
+            # a handle may be (de)serialized on a worker while the
+            # controller is mid-recreation: resolve lazily in the router
+            # instead of failing construction
+            try:
+                controller = _get_controller()
+            except RuntimeError:
+                controller = None
+        self._controller = controller
         self._method = method_name
         self._model_id = multiplexed_model_id
         self._stream = stream

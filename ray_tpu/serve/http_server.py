@@ -89,6 +89,17 @@ class AsyncHTTPServer:
                 await self._server.serve_forever()
             except asyncio.CancelledError:
                 pass
+        # Python 3.10's Server.wait_closed() returns once the LISTENER
+        # closes — it does not wait for open client connections. Returning
+        # here would stop the event loop with in-flight handlers stranded
+        # mid-await, their responses never written (the graceful-drain bug:
+        # stop() then times out waiting for an inflight count that can
+        # never reach zero). Park instead: the loop stays alive until
+        # stop() has observed the drain and cancels every task, us included.
+        try:
+            await asyncio.Event().wait()
+        except asyncio.CancelledError:
+            pass
 
     # ------------------------------------------------------------ connection
 

@@ -33,6 +33,7 @@ class ProxyActor:
         self._lock = threading.Lock()
         self._routes_ts = 0.0  # last successful refresh (monotonic)
         self._refresh_lock = threading.Lock()
+        self._pending_table = None  # in-flight get_routing_table ref
         self.server = AsyncHTTPServer(self._handle_request, host, port).start()
         self.port = self.server.port
 
@@ -95,9 +96,36 @@ class ProxyActor:
             window = 0.05 if force else self._ROUTE_TTL_S
             if time.monotonic() - self._routes_ts < window:
                 return
-            table = ray_tpu.get(
-                self.controller.get_routing_table.remote(self._version),
-                timeout=10.0)
+            try:
+                # async fetch + short completion wait: route refreshes run
+                # on the request path, so a controller mid-restart (whose
+                # queued calls answer only after recovery) costs a bounded
+                # pause, not seconds per request — the pending ref is
+                # re-checked by later refreshes
+                if self._pending_table is None:
+                    self._pending_table = \
+                        self.controller.get_routing_table.remote(self._version)
+                done, _ = ray_tpu.wait([self._pending_table], num_returns=1,
+                                       timeout=1.0 if force else 0.25)
+                if not done:
+                    self._routes_ts = time.monotonic()
+                    return  # still in flight: serve the cached routes
+                ref, self._pending_table = self._pending_table, None
+                table = ray_tpu.get(ref, timeout=5.0)
+            except Exception:  # noqa: BLE001 — controller outage
+                # controller killed and recreated under the same name: keep
+                # serving the version-cached routes (requests go straight
+                # to replicas) and re-resolve for the next refresh (single
+                # attempt — this is the request path)
+                from ray_tpu.serve.api import _resolve_controller
+
+                self._pending_table = None
+                self._routes_ts = time.monotonic()  # don't hammer mid-outage
+                try:
+                    self.controller = _resolve_controller(timeout_s=0.0)
+                except RuntimeError:
+                    pass
+                return
             self._routes_ts = time.monotonic()
             if table is not None:
                 with self._lock:

@@ -50,7 +50,7 @@ def get_multiplexed_model_id() -> str | None:
     return getattr(_replica_ctx, "model_id", None)
 
 
-@ray_tpu.remote
+@ray_tpu.remote(concurrency_groups={"control": 2})
 class ReplicaActor:
     def __init__(self, deployment_name: str, replica_tag: str,
                  callable_blob: bytes, init_args_blob: bytes,
@@ -146,6 +146,11 @@ class ReplicaActor:
                         self._rpc_addr)
             except Exception:
                 controller = None  # controller restart: re-resolve
+                # re-advertise the addr on the FIRST tick after the
+                # re-resolve rather than up to 5s later — a recovered
+                # controller restores addrs from its persisted rows, but a
+                # RECREATED one (serve.shutdown + run race) starts empty
+                tick = 0
 
     # ------------------------------------------------------- fast data plane
 
@@ -316,7 +321,14 @@ class ReplicaActor:
         if fn is not None:
             fn(user_config)
 
+    @ray_tpu.method(concurrency_group="control")
     def check_health(self) -> bool:
+        """Controller-driven liveness probe. Dispatched through the
+        'control' concurrency lane: the GCS schedules it past any backlog
+        of queued data requests and the worker runs it on a dedicated
+        thread pool — a saturated (but healthy) replica must answer its
+        probes, or the controller would drain-and-replace it under
+        ordinary heavy load."""
         fn = getattr(self.user, "check_health", None)
         if fn is not None:
             fn()

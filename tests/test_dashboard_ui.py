@@ -298,3 +298,37 @@ def test_serve_metrics_reach_prometheus_endpoint(live_dash):
         assert "ray_tpu_serve_request_latency_ms" in text
     finally:
         serve.shutdown()
+
+
+def test_api_serve_surfaces_replica_health(live_dash):
+    """/api/serve reads the persisted GCS serve table directly (it answers
+    even while the controller is down mid-recovery) and exposes per-replica
+    health so operators can watch a probe-driven replacement happen."""
+    port, _ = live_dash
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Hello:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Hello.bind(), name="dash", route_prefix="/dash")
+    try:
+        assert h.remote(1).result(timeout_s=30) == 1
+        deadline = time.time() + 30
+        dep = None
+        while time.time() < deadline:
+            data = _get_json(port, "/api/serve")
+            dep = (data.get("deployments") or {}).get("dash_Hello")
+            if dep and dep.get("replicas"):
+                break
+            time.sleep(0.2)
+        assert dep and dep.get("replicas"), data
+        (tag, rep), = dep["replicas"].items()
+        assert tag.startswith("Hello#")
+        assert rep["actor_id"]
+        assert rep["health"] in ("recovering", "healthy")
+        assert data["apps"].get("dash") == "dash_Hello"
+        assert "/dash" in data["routes"]
+    finally:
+        serve.shutdown()

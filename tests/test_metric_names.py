@@ -27,6 +27,11 @@ def test_expected_exported_metrics_still_constructed():
     assert not missing, f"expected metrics no longer constructed: {missing}"
     assert ("ray_tpu_dag_recoveries_total"
             in check_metric_names.EXPECTED_METRICS)
+    # serve control-plane fault tolerance counters (serve/controller.py)
+    for name in ("ray_tpu_serve_controller_recoveries_total",
+                 "ray_tpu_serve_replicas_readopted_total",
+                 "ray_tpu_serve_replica_health_check_failures_total"):
+        assert name in check_metric_names.EXPECTED_METRICS
 
 
 def test_checker_flags_expected_removal(tmp_path):

@@ -4,11 +4,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import gpt2_config, llama_config, mixtral_config, transformer, vit, vit_config
-from ray_tpu.parallel import MeshSpec, param_shardings
+from ray_tpu.parallel import MeshSpec, param_shardings, shard_map
 
 
 def tiny_gpt2():

@@ -5,7 +5,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ray_tpu.parallel import (
     MeshSpec,
@@ -13,6 +12,7 @@ from ray_tpu.parallel import (
     pipeline_apply,
     reference_attention,
     ring_attention,
+    shard_map,
     stack_stage_params,
 )
 

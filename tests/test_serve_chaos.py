@@ -1,0 +1,376 @@
+"""Serve control-plane fault tolerance chaos: SIGKILL the controller under
+traffic and prove zero dropped requests + live-replica re-adoption; kill a
+replica and the controller together and prove convergence; hang a replica
+and prove the health probes drain-and-replace it.
+
+(reference: the Serve controller checkpoints its state in the GCS and
+recovers without touching running replicas — serve/_private/controller.py:102
++ deployment_state.py recovery; here the state rides the GCS `serve` sqlite
+table and the controller is a named restartable actor whose __init__
+re-adopts live replicas by named-actor lookup. See serve/controller.py and
+serve/gcs_state.py.)
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import api as _api
+
+pytestmark = pytest.mark.serve_chaos
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=16)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def serve_session(chaos_cluster):
+    yield
+    serve.shutdown()
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _controller():
+    from ray_tpu.serve.api import _get_controller
+
+    return _get_controller()
+
+
+def _pid_of_actor(actor_id: str) -> int:
+    rows = _api._get_worker().rpc({"type": "list_workers"}).get("workers", [])
+    return next(r["pid"] for r in rows
+                if r.get("actor_id") == actor_id and not r.get("dead"))
+
+
+def _sigkill_controller():
+    ctl = _controller()
+    pid = _pid_of_actor(ctl.actor_id)
+    os.kill(pid, signal.SIGKILL)
+    return ctl
+
+
+def _replica_ids(full_name: str) -> list[str]:
+    table = ray_tpu.get(_controller().get_routing_table.remote(-1),
+                        timeout=30.0)
+    dep = table["deployments"].get(full_name) or {}
+    return sorted(dep.get("replicas") or [])
+
+
+def _serve_rows() -> dict:
+    return _api._get_worker().rpc({"type": "serve_list"})["rows"]
+
+
+def _wait(predicate, timeout=30.0, interval=0.2, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = predicate()
+        except Exception:  # noqa: BLE001 — controller mid-restart etc.
+            out = None
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def _gcs_counter(name: str, tag_match: dict | None = None) -> float:
+    """Cluster-aggregated counter value (controller metrics flush to the
+    GCS on the worker telemetry cadence)."""
+    snap = _api._get_worker().rpc({"type": "metrics_snapshot"})["metrics"]
+    rec = snap.get(name)
+    if not rec:
+        return 0.0
+    total = 0.0
+    for series in rec["series"].values():
+        for tags, value in series:
+            t = dict(tuple(kv) for kv in tags)
+            if tag_match and any(t.get(k) != v for k, v in tag_match.items()):
+                continue
+            total += value
+    return total
+
+
+def test_controller_sigkill_under_traffic_zero_drops(serve_session):
+    """Headline: SIGKILL SERVE_CONTROLLER under concurrent HTTP + handle
+    traffic → zero failed requests, replicas re-adopted without restart
+    (actor ids unchanged), and scale-up / delete work after recovery."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class Echo:
+        def __call__(self, x):
+            return {"ok": True}
+
+    h = serve.run(Echo.bind(), name="ct", route_prefix="/ct")
+    serve.start(http_port=0)
+    host, port = serve.http_address()
+    assert h.remote(0).result(timeout_s=30) == {"ok": True}
+    ids_before = _replica_ids("ct_Echo")
+    assert len(ids_before) == 2
+
+    recoveries0 = _gcs_counter("ray_tpu_serve_controller_recoveries_total")
+    errors: list = []
+    counts = {"http": 0, "handle": 0}
+    stop = threading.Event()
+
+    def http_loop():
+        while not stop.is_set():
+            try:
+                status, out = _post(f"http://{host}:{port}/ct", {}, timeout=30)
+                assert status == 200 and out == {"ok": True}, (status, out)
+                counts["http"] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("http", repr(e)))
+                return
+
+    def handle_loop():
+        while not stop.is_set():
+            try:
+                assert h.remote(1).result(timeout_s=30) == {"ok": True}
+                counts["handle"] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("handle", repr(e)))
+                return
+
+    threads = [threading.Thread(target=http_loop) for _ in range(2)] + \
+              [threading.Thread(target=handle_loop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)  # steady state, requests in flight
+    _sigkill_controller()
+    time.sleep(2.5)  # traffic rides the cached routing tables through the
+    stop.set()       # outage and the recovery
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"dropped requests across controller death: {errors[:3]}"
+    assert counts["http"] > 5 and counts["handle"] > 5, counts
+
+    # recovery: same controller name answers, replicas re-adopted in place
+    st = _wait(lambda: serve.status().get("ct_Echo"),
+               desc="controller recovery")
+    assert _replica_ids("ct_Echo") == ids_before, \
+        "replicas were restarted, not re-adopted"
+    assert st["replicas"] == 2
+
+    # the recovery + re-adoption counters reached the GCS metrics plane
+    _wait(lambda: _gcs_counter("ray_tpu_serve_controller_recoveries_total")
+          >= recoveries0 + 1, desc="recovery counter flush")
+    assert _gcs_counter("ray_tpu_serve_replicas_readopted_total") >= 2
+
+    # control plane fully functional post-recovery: scale up, then delete
+    serve.run(Echo.options(num_replicas=3).bind(), name="ct",
+              route_prefix="/ct")
+    _wait(lambda: serve.status()["ct_Echo"]["replicas"] == 3,
+          desc="post-recovery scale-up")
+    ids_scaled = _replica_ids("ct_Echo")
+    assert set(ids_before) <= set(ids_scaled), \
+        "config-only scale-up must keep the adopted replicas"
+    assert h.remote(2).result(timeout_s=30) == {"ok": True}
+    serve.delete("ct")
+    _wait(lambda: "ct_Echo" not in serve.status(),
+          desc="post-recovery delete")
+
+
+def test_replica_and_controller_killed_together_converges(serve_session):
+    @serve.deployment(num_replicas=2)
+    class P:
+        def __call__(self, _):
+            return os.getpid()
+
+    h = serve.run(P.bind(), name="dk", route_prefix="/dk")
+    _wait(lambda: serve.status()["dk_P"]["replicas"] == 2,
+          desc="2 replicas up")
+    assert h.remote(None).result(timeout_s=30)
+    ids = _replica_ids("dk_P")
+    replica_pid = _pid_of_actor(ids[0])
+    ctl_pid = _pid_of_actor(_controller().actor_id)
+    os.kill(replica_pid, signal.SIGKILL)
+    os.kill(ctl_pid, signal.SIGKILL)
+
+    def converged():
+        st = serve.status().get("dk_P")
+        if not st or st["replicas"] != 2:
+            return None
+        new_ids = _replica_ids("dk_P")
+        # the dead replica's stale row was reaped and a replacement started;
+        # the surviving replica was re-adopted
+        return (ids[1] in new_ids and ids[0] not in new_ids
+                and len(new_ids) == 2)
+
+    _wait(converged, timeout=60, desc="converge after double kill")
+    # call_sync is the death-retrying path (same as the proxy): the router
+    # may still cache the dead replica for up to its refresh interval
+    assert h.call_sync(None, timeout_s=30)
+
+
+def test_hung_replica_replaced_by_health_probes(serve_session):
+    """A hung (not dead) replica fails its probes and is drained and
+    replaced within health_check_timeout_s — the probe path, distinct from
+    actor-state='dead' handling."""
+
+    @serve.deployment(health_check_period_s=0.2, health_check_timeout_s=1.0,
+                      graceful_shutdown_timeout_s=1.0)
+    class Wedgeable:
+        def __init__(self):
+            self.hang = False
+
+        def __call__(self, cmd):
+            if cmd == "hang":
+                self.hang = True
+            return "ok"
+
+        def check_health(self):
+            if self.hang:
+                time.sleep(3600)
+
+    h = serve.run(Wedgeable.bind(), name="hw", route_prefix="/hw")
+    assert h.remote("x").result(timeout_s=30) == "ok"
+    aid0 = _replica_ids("hw_Wedgeable")[0]
+    fails0 = _gcs_counter(
+        "ray_tpu_serve_replica_health_check_failures_total",
+        {"deployment": "hw_Wedgeable"})
+    h.remote("hang").result(timeout_s=30)
+    t0 = time.monotonic()
+
+    def replaced():
+        ids = _replica_ids("hw_Wedgeable")
+        return ids and ids != [aid0] and aid0 not in ids
+
+    _wait(replaced, timeout=20, desc="probe-driven replacement")
+    # period 0.2 + timeout 1.0 + drain 1.0 + scheduling slack: well inside
+    # a few multiples of health_check_timeout_s
+    assert time.monotonic() - t0 < 15.0
+    assert h.remote("y").result(timeout_s=30) == "ok"
+    _wait(lambda: _gcs_counter(
+        "ray_tpu_serve_replica_health_check_failures_total",
+        {"deployment": "hw_Wedgeable"}) > fails0,
+        desc="probe-failure counter flush")
+    st = serve.status()["hw_Wedgeable"]
+    assert st["replicas"] == 1
+
+
+def test_saturated_replica_survives_probes(serve_session):
+    """Health probes ride the replica's dedicated 'control' dispatch lane:
+    a replica whose data queue is saturated with slow actor-plane requests
+    (queued well past health_check_timeout_s) must keep answering probes
+    and must NOT be drained as hung."""
+
+    @serve.deployment(max_ongoing_requests=1, health_check_period_s=0.2,
+                      health_check_timeout_s=1.0)
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.5)
+            return "ok"
+
+    h = serve.run(Slow.bind(), name="sat", route_prefix="/sat")
+    assert h.remote(0).result(timeout_s=30) == "ok"
+    aid0 = _replica_ids("sat_Slow")[0]
+    # saturate: with max_ongoing=1 and 0.5 s/request, 8 requests keep the
+    # default lane busy (and queued) for ~4 s — four probe timeouts' worth
+    pending = [h.remote(i) for i in range(8)]
+    results = [p.result(timeout_s=60) for p in pending]
+    assert results == ["ok"] * 8
+    assert _replica_ids("sat_Slow") == [aid0], \
+        "healthy-but-busy replica was replaced by starved probes"
+    st = serve.status()["sat_Slow"]
+    assert st["replicas"] == 1
+
+
+def test_deploy_is_idempotent_double_persist(serve_session):
+    """Deploying the same app twice (the at-least-once path a restarted
+    controller's retried deploy_application takes) must not duplicate rows
+    or restart replicas."""
+
+    @serve.deployment(num_replicas=2)
+    class Idem:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Idem.bind(), name="ip", route_prefix="/ip")
+    _wait(lambda: serve.status()["ip_Idem"]["replicas"] == 2,
+          desc="replicas up")
+    ids = _replica_ids("ip_Idem")
+    rows1 = {k for k in _serve_rows() if k.startswith(("dep:ip_", "rep:ip_"))}
+
+    serve.run(Idem.bind(), name="ip", route_prefix="/ip")  # double persist
+    time.sleep(0.5)
+    rows2 = {k for k in _serve_rows() if k.startswith(("dep:ip_", "rep:ip_"))}
+    assert rows1 == rows2, "double deploy duplicated persisted rows"
+    assert _replica_ids("ip_Idem") == ids, "double deploy restarted replicas"
+    assert h.remote(7).result(timeout_s=30) == 7
+    dep_rows = [k for k in rows2 if k.startswith("dep:")]
+    rep_rows = [k for k in rows2 if k.startswith("rep:")]
+    assert len(dep_rows) == 1 and len(rep_rows) == 2, rows2
+
+
+def test_recovery_reaps_stale_replica_row(serve_session):
+    """A replica row whose actor died while the controller was down (here: a
+    forged row pointing at nothing) is reaped by recovery, and the
+    deployment converges back to target."""
+
+    @serve.deployment
+    class S:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(S.bind(), name="sr", route_prefix="/sr")
+    _wait(lambda: serve.status()["sr_S"]["replicas"] == 1, desc="replica up")
+    w = _api._get_worker()
+    stale_key = "rep:sr_S:S#999"
+    w.rpc({"type": "serve_put", "key": stale_key, "record": {
+        "full_name": "sr_S", "tag": "S#999",
+        "actor_name": "SERVE_REPLICA:sr_S:S#999:bogus",
+        "actor_id": "deadbeef" * 4, "addr": None, "state": "running",
+        "drain_deadline_ts": None}})
+    assert stale_key in _serve_rows()
+    _sigkill_controller()
+    _wait(lambda: serve.status().get("sr_S"), desc="controller recovery")
+    _wait(lambda: stale_key not in _serve_rows(), desc="stale row reaped")
+    _wait(lambda: serve.status()["sr_S"]["replicas"] == 1,
+          desc="converged to target")
+    assert h.remote(5).result(timeout_s=30) == 5
+
+
+def test_config_only_redeploy_after_recovery(serve_session):
+    """After a crash-recovery, a config-only redeploy (same code blobs) is
+    recognized as such: the adopted replica is kept, only the target moves."""
+
+    @serve.deployment
+    class C:
+        def __call__(self, x):
+            return x * 2
+
+    dep = C  # one Deployment object → identical blobs across serve.run calls
+    h = serve.run(dep.bind(), name="cr", route_prefix="/cr")
+    assert h.remote(4).result(timeout_s=30) == 8
+    ids = _replica_ids("cr_C")
+    _sigkill_controller()
+    _wait(lambda: serve.status().get("cr_C"), desc="controller recovery")
+    assert _replica_ids("cr_C") == ids  # re-adopted, not restarted
+
+    serve.run(dep.options(num_replicas=2).bind(), name="cr",
+              route_prefix="/cr")
+    _wait(lambda: serve.status()["cr_C"]["replicas"] == 2,
+          desc="scale-up after recovery")
+    assert set(ids) <= set(_replica_ids("cr_C")), \
+        "config-only redeploy after recovery restarted the adopted replica"
+    assert h.remote(5).result(timeout_s=30) == 10

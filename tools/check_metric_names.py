@@ -36,6 +36,12 @@ EXPECTED_METRICS = (
     "ray_tpu_storage_retries_total",
     "ray_tpu_storage_commit_seconds",
     "ray_tpu_serve_requests_total",
+    # serve control-plane fault tolerance (serve/controller.py): controller
+    # crash-restart recoveries, replicas re-adopted without restart, and
+    # active health-probe failures driving drain-and-replace
+    "ray_tpu_serve_controller_recoveries_total",
+    "ray_tpu_serve_replicas_readopted_total",
+    "ray_tpu_serve_replica_health_check_failures_total",
     # PD disaggregation transfer plane + TTFT split (llm/kv_transfer.py,
     # llm/pd.py)
     "ray_tpu_llm_pd_transfer_bytes_total",
