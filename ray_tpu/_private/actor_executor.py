@@ -26,10 +26,12 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional
 
+from ray_tpu._private.constants import CONCURRENCY_GROUP_ATTR
+
 
 def method_concurrency_group(instance, method_name: str) -> Optional[str]:
     fn = getattr(type(instance), method_name, None)
-    return getattr(fn, "__ray_tpu_concurrency_group__", None)
+    return getattr(fn, CONCURRENCY_GROUP_ATTR, None)
 
 
 class ActorExecutor:
@@ -108,7 +110,7 @@ class ActorExecutor:
         group's thread pool (or inline for plain actors)."""
         method_name = spec.get("method", "")
         fn = getattr(type(self.instance), method_name, None)
-        group = getattr(fn, "__ray_tpu_concurrency_group__", None)
+        group = getattr(fn, CONCURRENCY_GROUP_ATTR, None)
         if self.has_async and fn is not None and inspect.iscoroutinefunction(fn):
             sem = self._sem_for(group)
 

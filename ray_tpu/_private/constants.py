@@ -1,0 +1,65 @@
+"""Shared cross-process protocol constants.
+
+Names that cross a process boundary — shm segment prefixes, named-actor
+name schemes, magic actor-task method names — must come from ONE module:
+a producer and a consumer compiled from different call sites can never
+drift apart, and the `graft_check` static suite (tools/graft_check)
+enforces that these strings are never re-spelled as literals elsewhere
+in the package.
+
+(reference: ray_constants.py / src/ray/common/constants.h — the reference
+keeps every wire-visible magic string in one constants module for the
+same reason.)
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------- shm names
+
+#: tmpfs directory all shm segments live in (channels, arenas, spill).
+SHM_DIR = "/dev/shm"
+
+#: every per-session shm object (arena segment, file-backed object store
+#: entries) is named f"{SHM_SESSION_PREFIX}{session_id}_..." — leak checks
+#: and teardown sweeps key on this prefix.
+SHM_SESSION_PREFIX = "rtpu_"
+
+#: mutable seqlock channel segments (compiled-DAG edges, PD KV transfer):
+#: f"{SHM_CHANNEL_PREFIX}{uuid}" under SHM_DIR. Teardown leak checks glob
+#: SHM_CHANNEL_GLOB and must agree with the creator's naming.
+SHM_CHANNEL_PREFIX = "rtpu_chan_"
+
+#: glob matching every live channel segment (teardown/leak sweeps).
+SHM_CHANNEL_GLOB = SHM_DIR + "/" + SHM_CHANNEL_PREFIX + "*"
+
+# ----------------------------------------------------- cross-process methods
+
+#: actor-task method name the worker routes to the compiled-DAG channel
+#: exec loop (ray_tpu/dag/channel_execution.py) on a dedicated thread —
+#: the spec producer (driver) and the worker dispatcher share this one
+#: definition. Re-exported by task_spec.py for back-compat.
+EXEC_LOOP_METHOD = "__ray_tpu_channel_exec_loop__"
+
+#: function attribute `@ray_tpu.method(concurrency_group=...)` stamps on a
+#: method and the actor executor / GCS create-spec introspection read back.
+CONCURRENCY_GROUP_ATTR = "__ray_tpu_concurrency_group__"
+
+#: function attribute `@ray_tpu.method(tensor_transport=...)` stamps; the
+#: worker's result-serialization path reads it to route device tensors.
+TENSOR_TRANSPORT_ATTR = "__ray_tpu_tensor_transport__"
+
+# ------------------------------------------------------------- named actors
+
+#: the serve controller's named-actor name (namespace "_system").
+SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+#: serve replica actors are named
+#: f"{SERVE_REPLICA_NAME_PREFIX}{full_name}:{tag}:{nonce}" (namespace
+#: "_system") — the controller's crash-recovery re-adopts replicas by
+#: exactly this name, so creator and recovery must share the scheme.
+SERVE_REPLICA_NAME_PREFIX = "SERVE_REPLICA:"
+
+# ------------------------------------------------------------------ metrics
+
+#: canonical exported-metric namespace (tools/graft_check metric-name check).
+METRIC_NAME_PREFIX = "ray_tpu_"

@@ -21,6 +21,12 @@ import threading
 from typing import Any, Iterator, Optional
 
 
+#: every persisted GCS table. The graft_check rpc-pairing checker verifies
+#: that any table literal the GCS server reads/writes appears here, so a
+#: handler can never target a table this module never created.
+TABLES = ("kv", "actors", "pgs", "session", "instances", "serve")
+
+
 class GcsStorage:
     """Write-through table store. All methods are thread-safe."""
 
@@ -31,7 +37,7 @@ class GcsStorage:
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
-        for table in ("kv", "actors", "pgs", "session", "instances", "serve"):
+        for table in TABLES:
             self._db.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "
                 "(key TEXT PRIMARY KEY, value BLOB)")

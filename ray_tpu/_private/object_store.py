@@ -16,9 +16,10 @@ import mmap
 import os
 from typing import Iterable
 
-logger = logging.getLogger(__name__)
+from ray_tpu._private.constants import SHM_DIR  # noqa: F401 — re-exported
+from ray_tpu._private.constants import SHM_SESSION_PREFIX
 
-SHM_DIR = "/dev/shm"
+logger = logging.getLogger(__name__)
 
 
 def make_object_store(session_id: str):
@@ -76,7 +77,7 @@ class ShmObjectStore:
     (reference: spill orchestration, raylet/local_object_manager.h:43)."""
 
     def __init__(self, session_id: str):
-        self.prefix = f"rtpu_{session_id}_"
+        self.prefix = f"{SHM_SESSION_PREFIX}{session_id}_"
         self.spill_dir = spill_dir_for(session_id)
         self._created: set[str] = set()
 

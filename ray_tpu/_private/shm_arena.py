@@ -34,6 +34,7 @@ _LIB = os.path.join(_LIB_DIR, "libshmstore.so")
 _build_lock = threading.Lock()
 _lib = None
 
+from ray_tpu._private.constants import SHM_DIR, SHM_SESSION_PREFIX
 from ray_tpu._private.ray_config import RayConfig
 
 # Puts at or above this size bypass the mmap store and pwrite() instead:
@@ -144,8 +145,8 @@ class ArenaStore:
         from ray_tpu._private.object_store import spill_dir_for
 
         self.session_id = session_id
-        self.prefix = f"rtpu_{session_id}_"
-        self.path = os.path.join("/dev/shm", f"rtpu_{session_id}_arena")
+        self.prefix = f"{SHM_SESSION_PREFIX}{session_id}_"
+        self.path = os.path.join(SHM_DIR, self.prefix + "arena")
         self.spill_dir = spill_dir_for(session_id)
         self._dll = _ensure_lib()
         cap = capacity or RayConfig.get("store_capacity")
@@ -153,7 +154,7 @@ class ArenaStore:
             # plasma-style capping: an arena bigger than tmpfs can hold
             # would SIGBUS writers when pages can't be allocated — cap at
             # 80% of what /dev/shm can actually back right now
-            vfs = os.statvfs("/dev/shm")
+            vfs = os.statvfs(SHM_DIR)
             cap = max(1 << 20, min(cap, int(vfs.f_bavail * vfs.f_frsize * 0.8)))
         except OSError:
             pass
@@ -353,13 +354,13 @@ class ArenaStore:
         """Unlink the arena segment, the spill dir, and any per-object tmpfs
         files a file-backend fallback process of the same session created."""
         try:
-            names = os.listdir("/dev/shm")
+            names = os.listdir(SHM_DIR)
         except FileNotFoundError:
             names = []
         for name in names:
             if name.startswith(self.prefix):
                 try:
-                    os.unlink(os.path.join("/dev/shm", name))
+                    os.unlink(os.path.join(SHM_DIR, name))
                 except OSError:
                     pass
         import shutil

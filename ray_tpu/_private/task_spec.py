@@ -15,14 +15,13 @@ import dataclasses
 import math
 from typing import Any
 
+from ray_tpu._private.constants import EXEC_LOOP_METHOD  # noqa: F401 — re-export:
+# the exec-loop method name moved to the shared constants module; existing
+# importers (worker.py, dag/channel_execution.py historical sites) keep
+# resolving it from here.
+
 VALID_STRATEGY_KINDS = ("pg", "node_affinity", "node_label")
 _MAX_NAME = 512
-
-# actor-task method name the worker routes to the compiled-DAG channel
-# exec loop (ray_tpu/dag/channel_execution.py) on a dedicated thread —
-# defined here so the spec producer and the worker dispatcher share one
-# source of truth
-EXEC_LOOP_METHOD = "__ray_tpu_channel_exec_loop__"
 
 
 class SpecError(ValueError):

@@ -22,7 +22,8 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import make_object_store
 from ray_tpu._private.protocol import ConnectionClosed, connect_address
-from ray_tpu._private.task_spec import EXEC_LOOP_METHOD
+from ray_tpu._private.constants import (EXEC_LOOP_METHOD,
+                                        TENSOR_TRANSPORT_ATTR)
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -1763,7 +1764,7 @@ class CoreWorker:
                     else:
                         out = method(*args, **kwargs)
                     if getattr(getattr(method, "__func__", method),
-                               "__ray_tpu_tensor_transport__", None):
+                               TENSOR_TRANSPORT_ATTR, None):
                         _extract_dev = True
             else:
                 raise RayTpuError(f"unknown task kind {kind}")

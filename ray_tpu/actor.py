@@ -10,6 +10,8 @@ from __future__ import annotations
 from typing import Any
 
 from ray_tpu._private import serialization as ser
+from ray_tpu._private.constants import (CONCURRENCY_GROUP_ATTR,
+                                        TENSOR_TRANSPORT_ATTR)
 from ray_tpu.remote_function import _build_resources
 
 
@@ -25,13 +27,13 @@ def method(*, concurrency_group: str | None = None,
 
     def decorate(fn):
         if concurrency_group is not None:
-            fn.__ray_tpu_concurrency_group__ = concurrency_group
+            setattr(fn, CONCURRENCY_GROUP_ATTR, concurrency_group)
         if tensor_transport is not None:
             if tensor_transport not in ("device", "tpu"):
                 raise ValueError(
                     f"tensor_transport must be 'device' (alias 'tpu'), got "
                     f"{tensor_transport!r}")
-            fn.__ray_tpu_tensor_transport__ = tensor_transport
+            setattr(fn, TENSOR_TRANSPORT_ATTR, tensor_transport)
         return fn
 
     return decorate
@@ -155,7 +157,7 @@ class ActorClass:
         out = {}
         for klass in reversed(getattr(self._cls, "__mro__", (self._cls,))):
             for name, fn in vars(klass).items():
-                group = getattr(fn, "__ray_tpu_concurrency_group__", None)
+                group = getattr(fn, CONCURRENCY_GROUP_ATTR, None)
                 if group is not None:
                     out[name] = group
         return out

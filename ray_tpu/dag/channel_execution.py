@@ -64,7 +64,7 @@ logger = logging.getLogger(__name__)
 # actor-task method name the worker routes to actor_exec_loop() on a
 # dedicated thread (never the shared exec thread — a blocked loop must not
 # starve other actors hosted by the same worker process)
-from ray_tpu._private.task_spec import EXEC_LOOP_METHOD  # noqa: E402
+from ray_tpu._private.constants import EXEC_LOOP_METHOD  # noqa: E402
 
 # loops re-check liveness at this cadence while blocked on a channel: if the
 # backing file vanished (driver died without teardown), they exit instead of

@@ -26,11 +26,12 @@ import struct
 import time
 import uuid
 
+from ray_tpu._private.constants import SHM_CHANNEL_PREFIX, SHM_DIR
 from ray_tpu.experimental.channel.channel import ChannelClosed
 
 _HDR = struct.Struct("<qqqq")  # write_seq, read_seq, payload_len, closed
 _HDR_SIZE = 64  # padded: keep the data region cacheline-separated
-_DIR = "/dev/shm"
+_DIR = SHM_DIR
 
 
 class MutableShmChannel:
@@ -230,7 +231,7 @@ class MutableShmChannel:
 
 
 def create_mutable_channel(buffer_bytes: int = 1 << 20) -> MutableShmChannel:
-    path = os.path.join(_DIR, f"rtpu_chan_{uuid.uuid4().hex[:12]}")
+    path = os.path.join(_DIR, f"{SHM_CHANNEL_PREFIX}{uuid.uuid4().hex[:12]}")
     ch = MutableShmChannel(path, buffer_bytes, _create=True)
     ch._creator = True  # this handle's GC unlinks the backing file
     return ch

@@ -36,12 +36,14 @@ import time
 import uuid
 
 import ray_tpu
+from ray_tpu._private.constants import (SERVE_CONTROLLER_NAME,
+                                        SERVE_REPLICA_NAME_PREFIX)
 from ray_tpu.actor import ActorHandle
 from ray_tpu.serve.gcs_state import (META_KEY, blob_key, dep_key,
                                      gcs_serve_store, rep_key)
 from ray_tpu.serve.replica import ReplicaActor
 
-CONTROLLER_NAME = "SERVE_CONTROLLER"
+CONTROLLER_NAME = SERVE_CONTROLLER_NAME
 RECONCILE_INTERVAL_S = 0.1
 #: consecutive FAILING (raising) health probes before a replica is replaced.
 #: A probe that hangs past health_check_timeout_s replaces immediately —
@@ -571,7 +573,8 @@ class ServeController:
         # burned once, so a crash anywhere past here can never hand a new
         # replica a name that an old (possibly still dying) actor holds
         self._persist_dep(st)
-        actor_name = f"SERVE_REPLICA:{st.full_name}:{tag}:{st.nonce}"
+        actor_name = (f"{SERVE_REPLICA_NAME_PREFIX}"
+                      f"{st.full_name}:{tag}:{st.nonce}")
         row = {"full_name": st.full_name, "tag": tag,
                "actor_name": actor_name, "actor_id": None, "addr": None,
                "state": "starting", "drain_deadline_ts": None}

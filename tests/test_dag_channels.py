@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu._private.constants import SHM_CHANNEL_GLOB
 from ray_tpu.exceptions import RayChannelError, RayTaskError
 
 pytestmark = pytest.mark.dag
@@ -26,7 +27,7 @@ N_STAGES = 4
 
 
 def _shm_chans():
-    return set(glob.glob("/dev/shm/rtpu_chan_*"))
+    return set(glob.glob(SHM_CHANNEL_GLOB))
 
 
 @pytest.fixture

@@ -27,6 +27,7 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu._private.constants import SHM_CHANNEL_GLOB
 from ray_tpu._private import api as _api
 from ray_tpu.exceptions import ActorDiedError
 
@@ -36,7 +37,7 @@ N_STAGES = 4
 
 
 def _shm_chans():
-    return set(glob.glob("/dev/shm/rtpu_chan_*"))
+    return set(glob.glob(SHM_CHANNEL_GLOB))
 
 
 @pytest.fixture

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from ray_tpu._private.constants import SHM_CHANNEL_GLOB
 from ray_tpu.llm.engine import SamplingParams, TPUEngine, bucket_for
 from ray_tpu.llm.kv_transfer import (KVTransferError, PagedKVExporter,
                                      pull_all, pull_pages)
@@ -62,7 +63,7 @@ def _prefill_ticket(cfg, params, prompt, exporter, *, page_size=PAGE,
 
 
 def _shm_channels() -> set:
-    return set(glob.glob("/dev/shm/rtpu_chan_*"))
+    return set(glob.glob(SHM_CHANNEL_GLOB))
 
 
 def _wait(pred, timeout_s=5.0):

@@ -1,0 +1,409 @@
+"""Tier-1 tooling check: the graft_check AST invariant suite.
+
+Two halves:
+
+- the REAL tree must be clean: `python -m tools.graft_check` semantics —
+  zero unsuppressed findings over ray_tpu/ with the checked-in baseline
+  (every suppression justified, none stale) — in well under the 15s
+  budget;
+
+- every checker must actually FIRE: per-checker negative tests feed small
+  fixture snippets (an `await` under a lock, a missing persist, a literal
+  `rtpu_chan_` string, an unpaired RPC type, ...) and assert the right
+  check id at the right line, so a refactor can't silently lobotomize a
+  checker while the tree stays green.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_check import (load_baseline, run_checks,  # noqa: E402
+                               run_default)
+from tools.graft_check.checkers import (AsyncBlockingChecker,  # noqa: E402
+                                        LockDisciplineChecker,
+                                        MetricNamesChecker,
+                                        PersistOrderChecker,
+                                        RpcPairingChecker,
+                                        ShmLifecycleChecker, all_check_ids)
+
+
+def _run(tree_dir, checkers):
+    return run_checks(str(tree_dir), checkers)
+
+
+def _ids(report):
+    return [(f.check_id, f.path, f.line) for f in report.findings]
+
+
+# --------------------------------------------------------------- real tree
+
+
+@pytest.fixture(scope="module")
+def tree_report():
+    """One full-tree run shared by the real-tree tests (parsing ray_tpu/
+    twice would double this module's wall clock for no coverage)."""
+    t0 = time.monotonic()
+    report = run_default()
+    report.elapsed_s = time.monotonic() - t0
+    return report
+
+
+def test_tree_is_clean_under_budget(tree_report):
+    """The headline gate: zero unsuppressed findings over ray_tpu/ with
+    the checked-in baseline, in well under the 15s budget."""
+    assert not tree_report.parse_errors, "\n".join(
+        f.render() for f in tree_report.parse_errors)
+    assert not tree_report.findings, "\n".join(
+        f.render() for f in tree_report.findings)
+    assert tree_report.elapsed_s < 15.0, (
+        f"graft_check took {tree_report.elapsed_s:.1f}s (budget 15s)")
+
+
+def test_baseline_entries_all_used(tree_report):
+    """Redundant with the stale-baseline findings above, but asserts the
+    mechanism directly: every baseline entry matched >= 1 finding."""
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "graft_check", "baseline.txt"))
+    assert baseline, "baseline file should exist with justified entries"
+    suppressed_keys = {f.key for f in tree_report.suppressed}
+    unused = [e for e in baseline if e.key not in suppressed_keys]
+    assert not unused, f"stale baseline entries: {unused}"
+
+
+def test_cli_lists_every_check_id(capsys):
+    from tools.graft_check.__main__ import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for check_id, _desc in all_check_ids():
+        assert check_id in out
+    for expected in ("async-blocking", "await-under-lock",
+                     "blocking-under-lock", "guarded-attr", "persist-order",
+                     "shm-lifecycle", "shm-prefix", "rpc-pairing",
+                     "rpc-table", "rpc-method-literal", "metric-name",
+                     "metric-expected", "stale-baseline"):
+        assert expected in out, f"--list is missing {expected}"
+
+
+def test_cli_nonzero_on_violation(tmp_path, capsys):
+    from tools.graft_check.__main__ import main
+
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n")
+    assert main([str(tmp_path), "--no-baseline", "--quiet"]) == 1
+    assert "async-blocking" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------- async-blocking
+
+
+def test_async_blocking_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import asyncio, time\n"
+        "async def bad():\n"
+        "    time.sleep(0.1)\n"                      # line 3: fires
+        "    w.rpc({'type': 'kv_get'})\n"            # line 4: fires
+        "    ray_tpu.get(ref)\n"                     # line 5: fires
+        "    chan.read()\n"                          # line 6: fires
+        "async def fine():\n"
+        "    await asyncio.sleep(0.1)\n"             # awaited: ok
+        "    done, _ = ray_tpu.wait([r], timeout=0)\n"  # poll: ok
+        "    def blocking_helper():\n"
+        "        time.sleep(1)\n"                    # nested sync def: ok
+        "    chan.poll()\n")                         # non-blocking: ok
+    report = _run(tmp_path, [AsyncBlockingChecker()])
+    assert _ids(report) == [("async-blocking", "m.py", 3),
+                            ("async-blocking", "m.py", 4),
+                            ("async-blocking", "m.py", 5),
+                            ("async-blocking", "m.py", 6)]
+
+
+# ------------------------------------------------------------ lock checks
+
+
+def test_await_under_lock_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class C:\n"
+        "    async def bad(self):\n"
+        "        with self._lock:\n"
+        "            await self.g()\n"               # line 4: fires
+        "    async def fine(self):\n"
+        "        async with self._alock:\n"
+        "            await self.g()\n")              # asyncio lock: ok
+    report = _run(tmp_path, [LockDisciplineChecker()])
+    assert ("await-under-lock", "m.py", 4) in _ids(report)
+    assert not any(f.line == 7 for f in report.findings)
+
+
+def test_nested_def_under_lock_is_exempt(tmp_path):
+    """A def nested inside a `with lock:` block runs later (callback /
+    executor target), not while the lock is held."""
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "class C:\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            def drain():\n"
+        "                time.sleep(0.1)\n"          # runs later: ok
+        "            self._pool.submit(drain)\n")
+    report = _run(tmp_path, [LockDisciplineChecker()])
+    assert not report.findings
+
+
+def test_blocking_under_lock_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "class C:\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"              # line 5: fires
+        "            self._store.rpc({'type': 'serve_put'})\n"  # 6: fires
+        "            self._persist_rep(st, tag)\n"   # line 7: fires
+        "    def fine(self):\n"
+        "        time.sleep(0.1)\n"                  # no lock: ok
+        "        with self._lock:\n"
+        "            self.n += 1\n")
+    report = _run(tmp_path, [LockDisciplineChecker()])
+    got = [k for k in _ids(report) if k[0] == "blocking-under-lock"]
+    assert got == [("blocking-under-lock", "m.py", 5),
+                   ("blocking-under-lock", "m.py", 6),
+                   ("blocking-under-lock", "m.py", 7)]
+
+
+def test_guarded_attr_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "        self.done = False\n"
+        "    def put(self, x):\n"
+        "        with self._lock:\n"
+        "            self.items = self.items + [x]\n"
+        "            self.done = True\n"
+        "    def peek(self):\n"
+        "        return self.items[0]\n"             # line 12: fires
+        "    def is_done(self):\n"
+        "        return self.done\n"                 # bool flag: ok
+        "    def _count_locked(self):\n"
+        "        return len(self.items)\n")          # _locked suffix: ok
+    report = _run(tmp_path, [LockDisciplineChecker()])
+    got = [k for k in _ids(report) if k[0] == "guarded-attr"]
+    assert got == [("guarded-attr", "m.py", 12)]
+
+
+# ------------------------------------------------------------ persist-order
+
+
+def test_persist_order_fires(tmp_path):
+    (tmp_path / "controller.py").write_text(
+        "class C:\n"
+        "    def scale_up(self):\n"
+        "        h = Replica.options(name='r').remote()\n"  # line 3: fires
+        "        return h\n"
+        "    def scale_down(self, inst):\n"
+        "        self.storage.put(inst.to_dict())\n"
+        "        self.provider.terminate_node(inst.node_id)\n"  # ok\n
+        "    def sweep(self):\n"
+        "        self.provider.terminate_node('leak')\n"    # line 9: fires
+        "    def _kill_replica(self, h):\n"
+        "        ray_tpu.kill(h)\n")                 # helper body: exempt
+    checker = PersistOrderChecker(scope=("controller.py",))
+    report = _run(tmp_path, [checker])
+    assert _ids(report) == [("persist-order", "controller.py", 3),
+                            ("persist-order", "controller.py", 9)]
+
+
+def test_persist_order_scope(tmp_path):
+    """Modules outside the control-plane scope are not checked."""
+    (tmp_path / "other.py").write_text(
+        "def f(p):\n"
+        "    p.terminate_node('n')\n")
+    report = _run(tmp_path, [PersistOrderChecker(scope=("controller.py",))])
+    assert not report.findings
+
+
+# ------------------------------------------------------------ shm lifecycle
+
+
+def test_shm_lifecycle_fires(tmp_path):
+    (tmp_path / "leaky.py").write_text(
+        "from ray_tpu.experimental.channel.mutable_shm import "
+        "create_mutable_channel\n"
+        "def make():\n"
+        "    ch = create_mutable_channel(1024)\n"    # line 3: fires
+        "    return ch.path\n")
+    (tmp_path / "paired.py").write_text(
+        "from ray_tpu.experimental.channel.mutable_shm import "
+        "create_mutable_channel\n"
+        "def make():\n"
+        "    ch = create_mutable_channel(1024)\n"
+        "    try:\n"
+        "        return ch.read()\n"
+        "    finally:\n"
+        "        ch.unlink()\n")                     # paired: ok
+    (tmp_path / "factory.py").write_text(
+        "from ray_tpu.experimental.channel.mutable_shm import "
+        "create_mutable_channel\n"
+        "def make():\n"
+        "    return create_mutable_channel(1024)\n")  # ownership out: ok
+    report = _run(tmp_path, [ShmLifecycleChecker()])
+    got = [k for k in _ids(report) if k[0] == "shm-lifecycle"]
+    assert got == [("shm-lifecycle", "leaky.py", 3)]
+
+
+def test_shm_prefix_literal_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import glob\n"
+        "PREFIX = 'rtpu_chan_'\n"                    # line 2: fires
+        "def leaked():\n"
+        "    return glob.glob('/dev/shm/rtpu_chan_*')\n")  # line 4: fires
+    report = _run(tmp_path, [ShmLifecycleChecker()])
+    got = [k for k in _ids(report) if k[0] == "shm-prefix"]
+    assert got == [("shm-prefix", "m.py", 2), ("shm-prefix", "m.py", 4)]
+
+
+def test_shm_prefix_allowed_in_constants(tmp_path):
+    d = tmp_path / "_private"
+    d.mkdir()
+    (d / "constants.py").write_text("SHM_CHANNEL_PREFIX = 'rtpu_chan_'\n")
+    report = _run(tmp_path, [ShmLifecycleChecker()])
+    assert not report.findings
+
+
+# -------------------------------------------------------------- rpc pairing
+
+
+def _rpc_fixture(tmp_path, client_body):
+    (tmp_path / "gcs.py").write_text(
+        "class Server:\n"
+        "    def handle(self, msg):\n"
+        "        t = msg['type']\n"
+        "        if t == 'known_rpc':\n"
+        "            self.storage.put('kv', 'k', 1)\n"
+        "        elif t == 'other_rpc':\n"
+        "            self.storage.put('nope', 'k', 1)\n")
+    (tmp_path / "gcs_storage.py").write_text("TABLES = ('kv',)\n")
+    (tmp_path / "client.py").write_text(client_body)
+    return RpcPairingChecker(gcs_module="gcs.py",
+                             gcs_storage_module="gcs_storage.py",
+                             method_name_modules=("constants.py",))
+
+
+def test_rpc_pairing_fires(tmp_path):
+    checker = _rpc_fixture(
+        tmp_path,
+        "def call(w):\n"
+        "    w.rpc({'type': 'known_rpc'})\n"         # paired: ok
+        "    w.rpc({'type': 'unknown_rpc'})\n")      # line 3: fires
+    report = _run(tmp_path, [checker])
+    assert ("rpc-pairing", "client.py", 3) in _ids(report)
+    assert not any(f.line == 2 and f.path == "client.py"
+                   for f in report.findings)
+
+
+def test_rpc_table_fires(tmp_path):
+    checker = _rpc_fixture(tmp_path, "")
+    report = _run(tmp_path, [checker])
+    # gcs.py line 7 writes table 'nope' which gcs_storage never creates
+    assert ("rpc-table", "gcs.py", 7) in _ids(report)
+    assert not any(f.path == "gcs.py" and f.line == 5
+                   for f in report.findings)
+
+
+def test_rpc_method_literal_fires(tmp_path):
+    checker = _rpc_fixture(
+        tmp_path,
+        "LOOP = '__ray_tpu_bogus_loop__'\n")         # line 1: fires
+    report = _run(tmp_path, [checker])
+    assert ("rpc-method-literal", "client.py", 1) in _ids(report)
+
+
+# ------------------------------------------------------------- metric names
+
+
+def test_metric_name_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "from ray_tpu.util.metrics import Counter, Histogram, get_or_create\n"
+        "import collections\n"
+        "c1 = Counter('requests_total')\n"           # line 3: bad prefix
+        "c2 = Counter('ray_tpu_Bad_Case')\n"         # line 4: bad case
+        "c3 = Counter('ray_tpu_good_total')\n"       # ok
+        "h = get_or_create(Histogram, 'lat_seconds')\n"  # line 6: bad
+        "cc = collections.Counter('not a metric')\n"     # ignored
+        "f1 = Counter(f'ray_tpu_x_{1}_total')\n"         # ok head
+        "f2 = Counter(f'serve_{1}_total')\n")            # line 9: bad head
+    report = _run(tmp_path, [MetricNamesChecker(expected=())])
+    got = [k for k in _ids(report) if k[0] == "metric-name"]
+    assert got == [("metric-name", "m.py", 3), ("metric-name", "m.py", 4),
+                   ("metric-name", "m.py", 6), ("metric-name", "m.py", 9)]
+
+
+def test_metric_expected_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "from ray_tpu.util.metrics import Counter\n"
+        "c = Counter('ray_tpu_present_total')\n")
+    report = _run(tmp_path, [MetricNamesChecker(
+        expected=("ray_tpu_present_total", "ray_tpu_gone_total"))])
+    got = [f for f in report.findings if f.check_id == "metric-expected"]
+    assert len(got) == 1 and "ray_tpu_gone_total" in got[0].message
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_suppresses_and_stale_fires(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "async def bad():\n"
+        "    time.sleep(1)\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "async-blocking  m.py  bad  # fixture justification\n"
+        "async-blocking  m.py  vanished  # no longer exists\n")
+    baseline = load_baseline(str(bl))
+    report = run_checks(str(tmp_path), [AsyncBlockingChecker()], baseline,
+                        baseline_path="baseline.txt")
+    assert len(report.suppressed) == 1
+    stale = [f for f in report.findings if f.check_id == "stale-baseline"]
+    assert len(stale) == 1 and "vanished" in stale[0].message
+    assert len(report.findings) == 1  # ONLY the stale entry remains
+
+
+def test_baseline_count_pin_catches_new_violation(tmp_path):
+    """`=N` pins the exact finding count: a NEW violation at an already-
+    baselined symbol must overflow the pin, not hide behind it."""
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "async def bad():\n"
+        "    time.sleep(1)\n"
+        "    time.sleep(2)\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("async-blocking  m.py  bad  =1  # pinned to one sleep\n")
+    report = run_checks(str(tmp_path), [AsyncBlockingChecker()],
+                        load_baseline(str(bl)), baseline_path="baseline.txt")
+    assert len(report.suppressed) == 2
+    overflow = [f for f in report.findings if f.check_id == "stale-baseline"]
+    assert len(overflow) == 1 and "matched 2" in overflow[0].message
+    # with the accurate pin the tree is clean again
+    bl.write_text("async-blocking  m.py  bad  =2  # pinned to both sleeps\n")
+    report = run_checks(str(tmp_path), [AsyncBlockingChecker()],
+                        load_baseline(str(bl)), baseline_path="baseline.txt")
+    assert not report.findings and len(report.suppressed) == 2
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("async-blocking  m.py  bad\n")  # no justification
+    with pytest.raises(ValueError, match="malformed baseline entry"):
+        load_baseline(str(bl))

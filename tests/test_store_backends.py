@@ -23,6 +23,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private import api as _api
+from ray_tpu._private.constants import SHM_DIR, SHM_SESSION_PREFIX
 from ray_tpu._private.object_store import make_object_store
 from ray_tpu._private.object_transfer import ObjectFetcher, ObjectPlaneServer
 
@@ -32,7 +33,7 @@ BACKENDS = ("arena", "file")
 
 
 def _shm_entries() -> set:
-    return set(glob.glob("/dev/shm/rtpu_*"))
+    return set(glob.glob(os.path.join(SHM_DIR, SHM_SESSION_PREFIX + "*")))
 
 
 @pytest.fixture(params=BACKENDS)

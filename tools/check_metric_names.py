@@ -1,84 +1,30 @@
 #!/usr/bin/env python
-"""Static check: canonical metric names.
+"""Static check: canonical metric names — THIN SHIM.
 
-Every `Counter`/`Gauge`/`Histogram` constructed with a literal name inside
-the `ray_tpu` package (including via `metrics.get_or_create(Counter, ...)`)
-must match ``ray_tpu_[a-z0-9_]+`` — snake_case with the `ray_tpu_` prefix —
-so dashboards, Prometheus relabeling, and docs can rely on one namespace.
-
-Run directly (`python tools/check_metric_names.py [package_dir]`) or via the
-tier-1 test (tests/test_metric_names.py). Exit code 1 lists every violation
-as `path:line: name`.
+The real implementation moved into the graft_check invariant suite
+(tools/graft_check/checkers/metric_names.py, check ids `metric-name` /
+`metric-expected`; run `python -m tools.graft_check`). This module keeps
+the original API and CLI surface — `check_file` / `check_tree` /
+`check_expected` / `EXPECTED_METRICS` / `main`, violations as
+`(path, line, name)` tuples — so tests/test_metric_names.py and docs
+keep working unchanged.
 """
 
 from __future__ import annotations
 
 import ast
 import os
-import re
 import sys
 
-NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
-METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
-# module objects whose .Counter etc. are NOT metrics
-_NON_METRIC_BASES = {"collections", "typing"}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `import check_metric_names` with only tools/
+    sys.path.insert(0, _REPO)  # on the path (the tier-1 test does this)
 
-# Flagship EXPORTED metric families (literal constructor names only — the
-# per-phase DAG step histograms use an f-string and are covered by the
-# namespace head check above). Dashboards, Prometheus relabeling rules,
-# and the README "Observability" tables key on these exact strings: a
-# rename or removal must fail this check, not be discovered in a scrape.
-EXPECTED_METRICS = (
-    "ray_tpu_dag_recoveries_total",
-    "ray_tpu_dag_step_backpressure_drain_seconds",
-    "ray_tpu_autoscaler_instance_transitions_total",
-    "ray_tpu_autoscaler_reconcile_seconds",
-    "ray_tpu_storage_retries_total",
-    "ray_tpu_storage_commit_seconds",
-    "ray_tpu_serve_requests_total",
-    # serve control-plane fault tolerance (serve/controller.py): controller
-    # crash-restart recoveries, replicas re-adopted without restart, and
-    # active health-probe failures driving drain-and-replace
-    "ray_tpu_serve_controller_recoveries_total",
-    "ray_tpu_serve_replicas_readopted_total",
-    "ray_tpu_serve_replica_health_check_failures_total",
-    # PD disaggregation transfer plane + TTFT split (llm/kv_transfer.py,
-    # llm/pd.py)
-    "ray_tpu_llm_pd_transfer_bytes_total",
-    "ray_tpu_llm_pd_kv_pages_total",
-    "ray_tpu_llm_pd_ttft_seconds",
-    # arena object-store accounting (CoreWorker._record_store_metrics)
-    "ray_tpu_object_store_used",
-    "ray_tpu_object_store_capacity",
-    "ray_tpu_object_store_evictions_total",
-)
+from tools.graft_check.checkers.metric_names import (  # noqa: E402
+    EXPECTED_METRICS, METRIC_CTORS, NAME_RE, iter_metric_names)
 
-
-def _ctor_name(func: ast.expr) -> str | None:
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        base = func.value
-        if isinstance(base, ast.Name) and base.id in _NON_METRIC_BASES:
-            return None
-        return func.attr
-    return None
-
-
-def _literal_name_arg(call: ast.Call) -> ast.expr | None:
-    """The metric-name argument of a constructor call, or of
-    `get_or_create(<Ctor>, name, ...)`."""
-    fn = _ctor_name(call.func)
-    if fn in METRIC_CTORS:
-        if call.args:
-            return call.args[0]
-        return next((k.value for k in call.keywords if k.arg == "name"), None)
-    if fn == "get_or_create" and len(call.args) >= 2:
-        first = _ctor_name(call.args[0]) if isinstance(
-            call.args[0], (ast.Name, ast.Attribute)) else None
-        if first in METRIC_CTORS:
-            return call.args[1]
-    return None
+__all__ = ["EXPECTED_METRICS", "METRIC_CTORS", "NAME_RE", "check_file",
+           "check_tree", "check_expected", "scan_file", "scan_tree", "main"]
 
 
 def scan_file(path: str) -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -90,25 +36,11 @@ def scan_file(path: str) -> tuple[list[tuple[str, int, str]], set[str]]:
             return [(path, e.lineno or 0, f"<syntax error: {e.msg}>")], set()
     bad: list[tuple[str, int, str]] = []
     names: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        arg = _literal_name_arg(node)
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            names.add(arg.value)
-            if not NAME_RE.match(arg.value):
-                bad.append((path, node.lineno, arg.value))
-        elif isinstance(arg, ast.JoinedStr):
-            # f-string name: the leading LITERAL segment must already
-            # carry the canonical prefix (e.g. f"ray_tpu_dag_step_{p}_s")
-            # — otherwise dynamic names would be a blind spot in the
-            # namespace guarantee
-            head = arg.values[0] if arg.values else None
-            head_str = (head.value if isinstance(head, ast.Constant)
-                        and isinstance(head.value, str) else "")
-            if not re.match(r"^ray_tpu_[a-z0-9_]*$", head_str):
-                bad.append((path, node.lineno,
-                            f"<f-string head {head_str!r}>"))
+    for lineno, descriptor, name, canonical in iter_metric_names(tree):
+        if name is not None:
+            names.add(name)
+        if not canonical:
+            bad.append((path, lineno, descriptor))
     return bad, names
 
 
@@ -140,9 +72,7 @@ def check_expected(root: str) -> list[str]:
 
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
-    root = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "ray_tpu")
+    root = args[0] if args else os.path.join(_REPO, "ray_tpu")
     bad, present = scan_tree(root)
     for path, line, name in bad:
         print(f"{path}:{line}: metric name {name!r} does not match "

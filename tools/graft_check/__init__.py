@@ -1,0 +1,38 @@
+"""graft_check: AST-based invariant suite for the ray_tpu tree.
+
+Run as a CLI (`python -m tools.graft_check`) or through the tier-1 test
+(tests/test_static_checks.py). See tools/graft_check/core.py for the
+framework and tools/graft_check/checkers/ for the invariants.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.graft_check.checkers import (ALL_CHECKERS, all_check_ids,
+                                        make_suite)
+from tools.graft_check.core import (BaselineEntry, Checker, Finding,
+                                    ParsedModule, Report, load_baseline,
+                                    run_checks)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_ROOT = os.path.join(REPO_ROOT, "ray_tpu")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.txt")
+
+
+def run_default(root: str = "", baseline_path: str = "",
+                use_baseline: bool = True) -> Report:
+    """The full suite with the checked-in baseline — what tier-1 runs."""
+    root = root or DEFAULT_ROOT
+    bl_path = baseline_path or DEFAULT_BASELINE
+    baseline = load_baseline(bl_path) if use_baseline else []
+    return run_checks(root, make_suite(), baseline,
+                      baseline_path=os.path.relpath(bl_path, REPO_ROOT))
+
+
+__all__ = ["ALL_CHECKERS", "BaselineEntry", "Checker", "Finding",
+           "ParsedModule", "Report", "all_check_ids", "load_baseline",
+           "make_suite", "run_checks", "run_default", "DEFAULT_ROOT",
+           "DEFAULT_BASELINE"]

@@ -1,0 +1,59 @@
+"""CLI: `python -m tools.graft_check [ROOT] [--list] [--no-baseline] ...`
+
+Exit status: 0 when the tree is clean (all findings suppressed by a
+justified baseline), 1 when any unsuppressed finding (including stale
+baseline entries) remains, 2 on unparsable sources.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tools.graft_check import (DEFAULT_BASELINE, DEFAULT_ROOT, all_check_ids,
+                               run_default)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graft_check",
+        description="AST-based invariant suite for the ray_tpu tree")
+    p.add_argument("root", nargs="?", default=DEFAULT_ROOT,
+                   help="package directory to scan (default: ray_tpu/)")
+    p.add_argument("--list", action="store_true",
+                   help="enumerate check ids and exit")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="suppression file (default: "
+                        "tools/graft_check/baseline.txt)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--quiet", action="store_true",
+                   help="findings only, no summary line")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for check_id, desc in all_check_ids():
+            print(f"{check_id:22s} {desc}")
+        return 0
+
+    t0 = time.monotonic()
+    report = run_default(args.root, args.baseline,
+                         use_baseline=not args.no_baseline)
+    for f in report.parse_errors:
+        print(f.render())
+    for f in report.findings:
+        print(f.render())
+    if not args.quiet:
+        dt = time.monotonic() - t0
+        print(f"graft_check: {len(report.findings)} finding(s), "
+              f"{len(report.suppressed)} suppressed by baseline, "
+              f"{len(report.parse_errors)} parse error(s) "
+              f"[{dt:.2f}s]", file=sys.stderr)
+    if report.parse_errors:
+        return 2
+    return 0 if not report.findings else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Checker registry: one factory per invariant family."""
+
+from __future__ import annotations
+
+from tools.graft_check.checkers.async_blocking import AsyncBlockingChecker
+from tools.graft_check.checkers.lock_discipline import LockDisciplineChecker
+from tools.graft_check.checkers.metric_names import (EXPECTED_METRICS,
+                                                     MetricNamesChecker)
+from tools.graft_check.checkers.persist_order import PersistOrderChecker
+from tools.graft_check.checkers.rpc_pairing import RpcPairingChecker
+from tools.graft_check.checkers.shm_lifecycle import ShmLifecycleChecker
+
+#: default suite, in reporting order. Each entry is a zero-arg factory so
+#: every run gets fresh checker state (rpc pairing etc. accumulate).
+ALL_CHECKERS = (
+    AsyncBlockingChecker,
+    LockDisciplineChecker,
+    PersistOrderChecker,
+    ShmLifecycleChecker,
+    RpcPairingChecker,
+    MetricNamesChecker,
+)
+
+
+def make_suite():
+    return [cls() for cls in ALL_CHECKERS]
+
+
+def all_check_ids():
+    """[(check_id, description)] over the default suite, stable order."""
+    out = []
+    for cls in ALL_CHECKERS:
+        out.extend(cls.ids)
+    out.append(("stale-baseline",
+                "every baseline entry still matches a real finding"))
+    return out
+
+
+__all__ = ["ALL_CHECKERS", "make_suite", "all_check_ids", "EXPECTED_METRICS",
+           "AsyncBlockingChecker", "LockDisciplineChecker",
+           "MetricNamesChecker", "PersistOrderChecker", "RpcPairingChecker",
+           "ShmLifecycleChecker"]

@@ -1,0 +1,123 @@
+"""async-blocking: no blocking waits inside `async def` bodies.
+
+An event loop runs every coroutine of its process on one thread; a
+blocking call inside `async def` (a `time.sleep`, a seqlock channel
+`read`/`_wait` spin, a synchronous GCS round trip via `.rpc(...)`, a
+blocking `ray_tpu.get`/`ray_tpu.wait`) stalls ALL of them — the
+probe-starvation class of bug PR 9 fixed by hand. Blocking work belongs
+on an executor (`loop.run_in_executor`) or behind the async variants
+(`asyncio.sleep`, `rpc_async`).
+
+Only the nearest enclosing function matters: a sync `def` nested inside
+an `async def` (an executor target) may block freely. A call that is
+directly awaited is exempt — it returned an awaitable, it didn't block.
+A `timeout=0` keyword marks a non-blocking poll and is exempt too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graft_check.core import (Checker, Finding, ParsedModule,
+                                    call_target, kwarg_value)
+
+CHECK_ID = "async-blocking"
+
+#: (receiver, attr) pairs that always block.
+_BLOCKING_QUALIFIED = {("time", "sleep")}
+#: attrs that block regardless of receiver (seqlock/channel/GCS waits).
+_BLOCKING_ATTRS = {"rpc", "_wait", "wait_drained", "pull_all", "pull_pages",
+                   "serve_put", "instance_put"}
+#: ray_tpu module-level blocking APIs.
+_RAY_BLOCKING = {"get", "wait", "kill"}
+#: channel data-plane methods: blocking when the receiver looks like a
+#: channel (seqlock MutableShmChannel handles are conventionally named
+#: `ch` / `chan` / `channel` / `*_chan*`).
+_CHANNEL_ATTRS = {"read", "write", "write_serialized"}
+
+
+def _is_channel_receiver(base: str) -> bool:
+    return "chan" in base.lower() or base in ("ch", "c.ch")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: ParsedModule, out: List[Finding]):
+        self.mod = mod
+        self.out = out
+        self.func_stack: List[bool] = []  # True = async
+        self.awaited: set = set()  # id() of directly-awaited Call nodes
+
+    # -- scope tracking ----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(False)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.func_stack.append(True)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self.awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- the check ---------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (self.func_stack and self.func_stack[-1]
+                and id(node) not in self.awaited):
+            self._check_call(node)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        base, attr = call_target(node)
+        if not attr:
+            return
+        nonblocking_poll = kwarg_value(node, "timeout") == 0 \
+            or kwarg_value(node, "timeout_s") == 0
+        what = f"{base}.{attr}" if base else attr
+        if (base, attr) in _BLOCKING_QUALIFIED:
+            self.out.append(self.mod.finding(
+                CHECK_ID, node,
+                f"blocking call {what}() inside `async def` stalls the "
+                f"event loop — use `await asyncio.sleep(...)` or move the "
+                f"work to an executor"))
+            return
+        if base.split(".")[-1] == "ray_tpu" and attr in _RAY_BLOCKING:
+            if nonblocking_poll:
+                return
+            self.out.append(self.mod.finding(
+                CHECK_ID, node,
+                f"blocking {what}() inside `async def` — await the ref, "
+                f"poll with timeout=0, or run_in_executor"))
+            return
+        if attr in _BLOCKING_ATTRS:
+            if nonblocking_poll:
+                return
+            self.out.append(self.mod.finding(
+                CHECK_ID, node,
+                f"blocking call {what}() inside `async def` (synchronous "
+                f"GCS/channel wait) — use the async variant or an executor"))
+            return
+        if attr in _CHANNEL_ATTRS and _is_channel_receiver(base):
+            if nonblocking_poll:
+                return
+            self.out.append(self.mod.finding(
+                CHECK_ID, node,
+                f"seqlock channel {what}() inside `async def` spins the "
+                f"event-loop thread — poll() + executor, or timeout=0"))
+
+
+class AsyncBlockingChecker(Checker):
+    ids = ((CHECK_ID,
+            "no time.sleep / sync GCS RPC / seqlock channel wait inside "
+            "`async def` bodies"),)
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        _Visitor(mod, out).visit(mod.tree)
+        return out

@@ -1,0 +1,239 @@
+"""Lock discipline: awaits and slow calls under locks, guarded attributes.
+
+Three invariants over `with <lock>` critical sections:
+
+- `await-under-lock`: an `await` lexically inside a SYNC `with ...lock...`
+  block parks the coroutine while the thread still holds the lock — any
+  other task needing it deadlocks the loop. (`async with` an asyncio lock
+  is fine and not matched.)
+
+- `blocking-under-lock`: a sleep, a synchronous GCS round trip
+  (`.rpc(...)`, `serve_put`/`instance_put`, `_persist_*`/`_bump_version`
+  write-through helpers) or a seqlock channel wait under a hot-path lock
+  serializes every contender behind I/O — PR 9's one-persist-per-pass and
+  probe-starvation fixes were exactly this class. Sites where the ordering
+  is the point (write-through persist inside the mutation's critical
+  section) are baselined with justification, so NEW ones still fail.
+
+- `guarded-attr`: an attribute written under a given lock in one method
+  but read with no lock held in another method of the same class — the
+  lock protects writers from each other but readers see torn state. Reads
+  in `__init__`/dunders are exempt (no concurrency yet / teardown), as are
+  two established idioms: attributes every write of which assigns a bare
+  bool/None constant (monotonic flags — a read observes the old or the
+  new value, both valid, never torn state), and reads inside methods whose
+  name ends in `_locked` (this codebase's convention for "caller holds the
+  lock").
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graft_check.core import (Checker, Finding, ParsedModule,
+                                    call_target, kwarg_value)
+
+AWAIT_ID = "await-under-lock"
+BLOCKING_ID = "blocking-under-lock"
+GUARDED_ID = "guarded-attr"
+
+_LOCK_RE = re.compile(r"lock|mutex|\bmu\b", re.IGNORECASE)
+
+#: methods whose bare reads/writes are exempt (single-threaded phases).
+_EXEMPT_METHODS = {"__init__", "__del__", "__reduce__", "__getstate__",
+                   "__setstate__", "__repr__", "__enter__", "__exit__"}
+
+_BLOCKING_QUALIFIED = {("time", "sleep")}
+_GCS_ATTRS = {"rpc", "serve_put", "instance_put", "_bump_version"}
+_CHANNEL_WAIT_ATTRS = {"_wait", "wait_drained", "pull_all", "pull_pages"}
+_RAY_BLOCKING = {"get", "wait"}
+
+
+def _locked_withitem(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # noqa: BLE001
+        return False
+    return bool(_LOCK_RE.search(text))
+
+
+class _ClassState:
+    def __init__(self, name: str):
+        self.name = name
+        #: attr -> set of methods that WRITE it under a lock
+        self.locked_writes: Dict[str, Set[str]] = {}
+        #: attr -> first bare READ per method: (method, line)
+        self.bare_reads: Dict[str, Dict[str, int]] = {}
+        #: attrs with at least one write whose value is NOT a bool/None
+        #: constant — everything else is a monotonic flag (atomic rebind)
+        self.non_flag_attrs: Set[str] = set()
+        self.has_lock_attr = False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: ParsedModule, out: List[Finding]):
+        self.mod = mod
+        self.out = out
+        self.lock_depth = 0
+        self.class_stack: List[_ClassState] = []
+        self.method_stack: List[str] = []
+        self.classes: List[_ClassState] = []
+        self._flag_stores: set = set()  # id() of self-attr Store nodes
+        #                                 whose assigned value is bool/None
+
+    # -- scope tracking ----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        st = _ClassState(node.name)
+        self.class_stack.append(st)
+        self.classes.append(st)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        # a nested def inside a `with lock:` block runs LATER (callback /
+        # executor target), not while the lock is held — its body starts
+        # from lock depth 0
+        saved, self.lock_depth = self.lock_depth, 0
+        self.method_stack.append(node.name)
+        self.generic_visit(node)
+        self.method_stack.pop()
+        self.lock_depth = saved
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_locked_withitem(i) for i in node.items)
+        if locked:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.lock_depth -= 1
+
+    # `async with` acquires an asyncio lock — awaiting under it is its
+    # normal use, so it does not open a sync critical section here.
+
+    # -- await / blocking calls -------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.lock_depth:
+            self.out.append(self.mod.finding(
+                AWAIT_ID, node,
+                "`await` inside a sync `with ...lock` block parks the "
+                "coroutine while the thread holds the lock — release the "
+                "lock first, or use an asyncio lock with `async with`"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.lock_depth:
+            self._check_blocking(node)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        base, attr = call_target(node)
+        if not attr:
+            return
+        what = f"{base}.{attr}" if base else attr
+        nonblocking = kwarg_value(node, "timeout") == 0 \
+            or kwarg_value(node, "timeout_s") == 0
+        if (base, attr) in _BLOCKING_QUALIFIED:
+            self.out.append(self.mod.finding(
+                BLOCKING_ID, node,
+                f"{what}() while holding a lock serializes every contender "
+                f"behind the sleep — sleep outside the critical section"))
+            return
+        if attr in _GCS_ATTRS or attr.startswith("_persist"):
+            self.out.append(self.mod.finding(
+                BLOCKING_ID, node,
+                f"synchronous GCS round trip {what}() under a lock — "
+                f"contenders (data-plane callers) wait out the RPC; move "
+                f"it outside, batch it, or baseline with justification if "
+                f"write-through ordering requires it"))
+            return
+        if attr in _CHANNEL_WAIT_ATTRS and not nonblocking:
+            self.out.append(self.mod.finding(
+                BLOCKING_ID, node,
+                f"channel wait {what}() under a lock — a slow/dead peer "
+                f"wedges every thread contending for the lock"))
+            return
+        if (base.split(".")[-1] == "ray_tpu" and attr in _RAY_BLOCKING
+                and not nonblocking):
+            self.out.append(self.mod.finding(
+                BLOCKING_ID, node,
+                f"blocking {what}() under a lock — resolve the ref outside "
+                f"the critical section (or poll with timeout=0)"))
+
+    # -- guarded attributes ------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (isinstance(node.value, ast.Constant)
+                and (node.value.value is None
+                     or isinstance(node.value.value, bool))):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    self._flag_stores.add(id(tgt))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (self.class_stack and self.method_stack
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            st = self.class_stack[-1]
+            method = self.method_stack[-1]
+            attr = node.attr
+            if _LOCK_RE.search(attr):
+                st.has_lock_attr = True
+            elif isinstance(node.ctx, ast.Store):
+                if id(node) not in self._flag_stores:
+                    st.non_flag_attrs.add(attr)
+                if self.lock_depth and method not in _EXEMPT_METHODS:
+                    st.locked_writes.setdefault(attr, set()).add(method)
+            elif isinstance(node.ctx, ast.Load):
+                if (not self.lock_depth and method not in _EXEMPT_METHODS
+                        and not method.endswith("_locked")):
+                    st.bare_reads.setdefault(attr, {}).setdefault(
+                        method, node.lineno)
+        self.generic_visit(node)
+
+
+class LockDisciplineChecker(Checker):
+    ids = (
+        (AWAIT_ID, "no `await` lexically inside a sync `with <lock>` block"),
+        (BLOCKING_ID,
+         "no sleep / sync GCS RPC / channel wait while holding a lock"),
+        (GUARDED_ID,
+         "an attribute written under a class's lock in one method must not "
+         "be read bare in another method of the same class"),
+    )
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        v = _Visitor(mod, out)
+        v.visit(mod.tree)
+        for st in v.classes:
+            if not st.has_lock_attr:
+                continue
+            for attr, writers in sorted(st.locked_writes.items()):
+                if attr not in st.non_flag_attrs:
+                    continue  # monotonic bool/None flag: rebinds are atomic
+                reads = st.bare_reads.get(attr, {})
+                for method, line in sorted(reads.items(),
+                                           key=lambda kv: kv[1]):
+                    if method in writers:
+                        continue  # same method both writes+reads: one site
+                    out.append(Finding(
+                        GUARDED_ID, mod.relpath, line,
+                        mod.symbol_at(line),
+                        f"{st.name}.{attr} is written under a lock in "
+                        f"{sorted(writers)} but read with no lock held in "
+                        f"{method}() — readers can see torn state; take "
+                        f"the lock or document the attr as single-writer"))
+        return out

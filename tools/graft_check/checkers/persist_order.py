@@ -1,0 +1,153 @@
+"""persist-order: control planes persist BEFORE side effects.
+
+The crash-restartable control planes (serve controller, autoscaler) only
+recover correctly because every record is durable BEFORE the side effect
+it describes: a replica row lands before the actor create, TERMINATING
+lands before the provider terminate. The invariant (hand-enforced in PRs
+2 and 9) checked here: within any function of the scoped control-plane
+modules, a side-effect call (provider `create_node`/`terminate_node`,
+actor `.options(...).remote(...)` create, `ray_tpu.kill`, kill helpers)
+must be lexically preceded in the same function by a persistence call
+(`storage.put`, `_im.transition/create`, `_persist_*`, `_bump_version`,
+`store.delete`, ...).
+
+This is statement-order domination per function — a lint, not a proof:
+helpers that ARE the side effect (`_kill_replica`) are treated as
+side-effect sites at their callers instead, and teardown paths that are
+deliberately provider-first carry baseline entries with justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tools.graft_check.core import Checker, Finding, ParsedModule, call_target
+
+CHECK_ID = "persist-order"
+
+#: control-plane modules the invariant applies to.
+DEFAULT_SCOPE = (
+    "serve/controller.py",
+    "autoscaler/autoscaler.py",
+    "autoscaler/instance_manager.py",
+    "autoscaler/monitor.py",
+)
+
+#: functions that ARE the side effect — calls TO them are checked at the
+#: caller; their own bodies are exempt.
+SIDE_EFFECT_HELPERS = {"_kill_replica"}
+
+#: provider / actor-plane side-effect attrs.
+_SIDE_EFFECT_ATTRS = {"create_node", "terminate_node"}
+_KILL_ATTRS = {"kill", "kill_actor"}
+
+#: persistence-call attrs, gated on a storage-looking receiver.
+_PERSIST_STORE_ATTRS = {"put", "delete", "clear"}
+_PERSIST_IM_ATTRS = {"transition", "create"}
+_PERSIST_ANY_ATTRS = {"serve_put", "instance_put"}
+
+
+def _is_persist(node: ast.Call) -> bool:
+    base, attr = call_target(node)
+    tail = base.split(".")[-1].lower()
+    if attr.startswith("_persist") or attr == "_bump_version":
+        return True
+    if attr in _PERSIST_ANY_ATTRS:
+        return True
+    if attr in _PERSIST_STORE_ATTRS and ("store" in tail or "storage" in tail):
+        return True
+    if attr in _PERSIST_IM_ATTRS and ("_im" in base or tail in ("im", "m")
+                                      or "manager" in tail):
+        return True
+    return False
+
+
+def _actor_create(node: ast.Call) -> bool:
+    """`<X>.options(...).remote(...)` — an actor create side effect."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "remote"):
+        return False
+    inner = fn.value
+    return (isinstance(inner, ast.Call)
+            and isinstance(inner.func, ast.Attribute)
+            and inner.func.attr == "options")
+
+
+def _side_effect(node: ast.Call) -> Optional[str]:
+    base, attr = call_target(node)
+    if attr in _SIDE_EFFECT_ATTRS:
+        return f"{base}.{attr}" if base else attr
+    if attr in _KILL_ATTRS and base.split(".")[-1] == "ray_tpu":
+        return f"{base}.{attr}"
+    if attr in SIDE_EFFECT_HELPERS:
+        return attr
+    if _actor_create(node):
+        try:
+            return ast.unparse(node.func)
+        except Exception:  # noqa: BLE001
+            return "<actor-create>.options(...).remote"
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Collect (persist, side-effect) call sites of ONE function body,
+    without descending into nested function defs."""
+
+    def __init__(self):
+        self.persists: List[int] = []
+        self.effects: List[Tuple[int, str, ast.Call]] = []
+        self._depth = 0
+
+    def _nested(self, node) -> None:
+        pass  # nested defs are their own scope, visited separately
+
+    visit_FunctionDef = _nested
+    visit_AsyncFunctionDef = _nested
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_persist(node):
+            self.persists.append(node.lineno)
+        else:
+            eff = _side_effect(node)
+            if eff is not None:
+                self.effects.append((node.lineno, eff, node))
+        self.generic_visit(node)
+
+
+class PersistOrderChecker(Checker):
+    ids = ((CHECK_ID,
+            "control-plane side effects (node create/terminate, replica "
+            "create/kill) must be preceded in-function by a persistence "
+            "call"),)
+
+    def __init__(self, scope: Sequence[str] = DEFAULT_SCOPE):
+        self._scope = tuple(scope)
+
+    def _in_scope(self, relpath: str) -> bool:
+        return any(relpath.endswith(s) for s in self._scope)
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        if not self._in_scope(mod.relpath):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in SIDE_EFFECT_HELPERS or node.name == "__del__":
+                continue
+            fv = _FuncVisitor()
+            for stmt in node.body:
+                fv.visit(stmt)
+            if not fv.effects:
+                continue
+            first_persist = min(fv.persists) if fv.persists else None
+            for line, eff, call in fv.effects:
+                if first_persist is None or first_persist >= line:
+                    out.append(mod.finding(
+                        CHECK_ID, call,
+                        f"side effect {eff}() in {node.name}() has no "
+                        f"preceding persistence call in the same function — "
+                        f"a crash here leaves state the recovery path can't "
+                        f"resolve (persist the intent first)"))
+        return out

@@ -1,0 +1,179 @@
+"""rpc-pairing: every client RPC reaches a real server handler.
+
+The GCS protocol is framed dicts dispatched on `msg["type"]`; clients
+build `{"type": "<x>", ...}` literals at dozens of call sites. A typo'd
+or removed handler surfaces as a hang/timeout three hops away — the
+`task_spec` drift PR 3 fixed. Three invariants:
+
+- `rpc-pairing`: every `{"type": ...}` literal passed to an `.rpc(...)`/
+  `.rpc_async(...)`/`._call(...)`/`._rpc(...)` call must name a type the
+  GCS server module handles (a `t == "<x>"` dispatch arm).
+
+- `rpc-table`: every storage-table literal the GCS server reads/writes
+  (`self.storage.put("serve", ...)`) must be a table `gcs_storage.py`
+  creates (its `TABLES` tuple).
+
+- `rpc-method-literal`: cross-process magic method names
+  (`__ray_tpu_*__`) must come from the shared constants module, never be
+  re-spelled as literals.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set, Tuple
+
+from tools.graft_check.core import Checker, Finding, ParsedModule, call_target
+
+PAIRING_ID = "rpc-pairing"
+TABLE_ID = "rpc-table"
+METHOD_ID = "rpc-method-literal"
+
+#: defaults match the real tree; tests override with fixture paths.
+GCS_MODULE = "_private/gcs.py"
+GCS_STORAGE_MODULE = "_private/gcs_storage.py"
+#: modules allowed to define magic cross-process method names (task_spec
+#: only re-imports EXEC_LOOP_METHOD nowadays, so it gets no exemption —
+#: re-spelling the literal there is exactly the PR 3 drift bug).
+METHOD_NAME_MODULES = ("_private/constants.py",)
+
+_RPC_ATTRS = {"rpc", "rpc_async", "_call", "_rpc"}
+_STORAGE_ATTRS = {"put", "get", "delete", "items"}
+_MAGIC_METHOD_RE = re.compile(r"^__ray_tpu_\w+__$")
+
+
+def _dict_type_literal(node: ast.Call):
+    """The "type" value of a dict-literal first argument, if literal."""
+    if not node.args:
+        return None
+    d = node.args[0]
+    if not isinstance(d, ast.Dict):
+        return None
+    for k, v in zip(d.keys, d.values):
+        if (isinstance(k, ast.Constant) and k.value == "type"
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            return v.value
+    return None
+
+
+class RpcPairingChecker(Checker):
+    ids = (
+        (PAIRING_ID,
+         "every client-side {'type': ...} RPC literal must have a matching "
+         "GCS server dispatch arm"),
+        (TABLE_ID,
+         "every storage-table literal the GCS touches must be created by "
+         "gcs_storage.py (TABLES)"),
+        (METHOD_ID,
+         "cross-process __ray_tpu_*__ method names must come from the "
+         "shared constants module"),
+    )
+
+    def __init__(self, gcs_module: str = GCS_MODULE,
+                 gcs_storage_module: str = GCS_STORAGE_MODULE,
+                 method_name_modules: Tuple[str, ...] = METHOD_NAME_MODULES):
+        self._gcs_module = gcs_module
+        self._storage_module = gcs_storage_module
+        self._method_modules = tuple(method_name_modules)
+        self._handled: Set[str] = set()
+        self._tables: Set[str] = set()
+        self._saw_gcs = False
+        self._saw_storage = False
+        #: deferred sites: (finding-args) resolved in finish()
+        self._client_sites: List[Tuple[ParsedModule, ast.Call, str]] = []
+        self._table_sites: List[Tuple[ParsedModule, ast.Call, str]] = []
+
+    # -- per module --------------------------------------------------------
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if mod.relpath.endswith(self._gcs_module):
+            self._saw_gcs = True
+            self._collect_handlers(mod)
+        if mod.relpath.endswith(self._storage_module):
+            self._saw_storage = True
+            self._collect_tables(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                base, attr = call_target(node)
+                if attr in _RPC_ATTRS:
+                    t = _dict_type_literal(node)
+                    if t is not None:
+                        self._client_sites.append((mod, node, t))
+                if (attr in _STORAGE_ATTRS and "storage" in base
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    self._table_sites.append((mod, node, node.args[0].value))
+            head = (node.value if isinstance(node, ast.Constant)
+                    and isinstance(node.value, str) else None)
+            if (head and _MAGIC_METHOD_RE.match(head)
+                    and not any(mod.relpath.endswith(m)
+                                for m in self._method_modules)):
+                out.append(mod.finding(
+                    METHOD_ID, node,
+                    f"cross-process method name {head!r} spelled as a "
+                    f"literal — import it from ray_tpu._private.constants "
+                    f"(the producer and the dispatcher must share one "
+                    f"definition)"))
+        return out
+
+    def _collect_handlers(self, mod: ParsedModule) -> None:
+        """Dispatch arms: any comparison of a name `t`/`type`/`msg_type`
+        against a string literal in the GCS server module."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            left = node.left
+            if not (isinstance(left, ast.Name)
+                    and left.id in ("t", "type", "msg_type", "mtype")):
+                continue
+            for comparator in node.comparators:
+                if (isinstance(comparator, ast.Constant)
+                        and isinstance(comparator.value, str)):
+                    self._handled.add(comparator.value)
+                elif isinstance(comparator, (ast.Tuple, ast.Set, ast.List)):
+                    for elt in comparator.elts:
+                        if (isinstance(elt, ast.Constant)
+                                and isinstance(elt.value, str)):
+                            self._handled.add(elt.value)
+
+    def _collect_tables(self, mod: ParsedModule) -> None:
+        """The TABLES = (...) tuple in the storage module."""
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "TABLES"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                for elt in node.value.elts:
+                    if (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)):
+                        self._tables.add(elt.value)
+
+    # -- tree-wide ---------------------------------------------------------
+
+    def finish(self) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if self._saw_gcs:
+            for mod, node, t in self._client_sites:
+                if t not in self._handled:
+                    out.append(mod.finding(
+                        PAIRING_ID, node,
+                        f"client RPC type {t!r} has no dispatch arm in the "
+                        f"GCS server ({self._gcs_module}) — the call can "
+                        f"only hang or error at runtime"))
+        if self._saw_storage and self._tables:
+            for mod, node, table in self._table_sites:
+                if table not in self._tables:
+                    out.append(mod.finding(
+                        TABLE_ID, node,
+                        f"storage table {table!r} is not created by "
+                        f"gcs_storage.py (TABLES={sorted(self._tables)}) — "
+                        f"the first touch raises sqlite OperationalError"))
+        self._client_sites.clear()
+        self._table_sites.clear()
+        self._handled.clear()
+        self._tables.clear()
+        self._saw_gcs = self._saw_storage = False
+        return out

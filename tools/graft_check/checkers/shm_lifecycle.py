@@ -1,0 +1,113 @@
+"""shm-lifecycle: every created segment has a reachable release; shm
+names come from the shared constants module.
+
+/dev/shm segments outlive their creating process: a module that calls
+`create_mutable_channel(...)` or `MutableShmChannel(..., _create=True)`
+without any reachable `unlink`/`close`/`teardown`/`close_mapping` call in
+the same module leaks tmpfs on every crash path — the leak class PRs 3, 6
+and 7 each had to close by hand. A creation whose result is immediately
+`return`ed transfers ownership to the caller and is exempt (factory).
+
+`shm-prefix`: the `rtpu_`/`rtpu_chan_` name prefixes are cross-process
+protocol (teardown sweeps and leak checks glob them) and must come from
+`ray_tpu/_private/constants.py` — a re-spelled literal elsewhere can
+silently diverge from what the sweeper globs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graft_check.core import (Checker, Finding, ParsedModule,
+                                    call_target, kwarg_value, str_head)
+
+LIFECYCLE_ID = "shm-lifecycle"
+PREFIX_ID = "shm-prefix"
+
+#: the one module allowed to spell the prefixes out.
+CONSTANTS_MODULE = "_private/constants.py"
+
+_CREATE_FUNCS = {"create_mutable_channel"}
+_RELEASE_ATTRS = {"unlink", "teardown", "close", "close_mapping", "shutdown"}
+_PREFIXES = ("rtpu_", "/dev/shm/rtpu")
+
+
+def _is_creation(node: ast.Call) -> bool:
+    base, attr = call_target(node)
+    if attr in _CREATE_FUNCS:
+        return True
+    if attr == "MutableShmChannel" and kwarg_value(node, "_create") is True:
+        return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self):
+        self.creations: List[ast.Call] = []
+        self.has_release = False
+        self.returned_calls: set = set()
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if isinstance(node.value, ast.Call):
+            self.returned_calls.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        _base, attr = call_target(node)
+        if _is_creation(node) and id(node) not in self.returned_calls:
+            self.creations.append(node)
+        if attr in _RELEASE_ATTRS:
+            self.has_release = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node) -> None:
+        if node.name in _RELEASE_ATTRS:
+            # module defines the release itself (channel/exporter classes)
+            self.has_release = True
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class ShmLifecycleChecker(Checker):
+    ids = (
+        (LIFECYCLE_ID,
+         "a module creating shm segments (create_mutable_channel / "
+         "MutableShmChannel(_create=True)) must contain a reachable "
+         "close/unlink/teardown"),
+        (PREFIX_ID,
+         "shm name prefixes (rtpu_*, rtpu_chan_*) must come from "
+         "ray_tpu/_private/constants.py, never string literals"),
+    )
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        v = _Visitor()
+        v.visit(mod.tree)
+        if v.creations and not v.has_release:
+            node = v.creations[0]
+            out.append(mod.finding(
+                LIFECYCLE_ID, node,
+                f"{mod.relpath} creates shm segments but contains no "
+                f"close/unlink/teardown call — every crash path leaks "
+                f"tmpfs; pair the create with a reachable release"))
+        if not mod.relpath.endswith(CONSTANTS_MODULE):
+            in_fstring: set = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.JoinedStr):
+                    # flag the f-string once, not its literal segments too
+                    in_fstring.update(id(v) for v in node.values)
+                if id(node) in in_fstring:
+                    continue
+                head = str_head(node)
+                if head is None:
+                    continue
+                if head.startswith(_PREFIXES):
+                    out.append(mod.finding(
+                        PREFIX_ID, node,
+                        f"shm name literal {head!r} — import the prefix "
+                        f"from ray_tpu._private.constants (SHM_SESSION_"
+                        f"PREFIX / SHM_CHANNEL_PREFIX / SHM_CHANNEL_GLOB) "
+                        f"so sweeps and creators can never diverge"))
+        return out

@@ -1,0 +1,280 @@
+"""graft_check framework: parsed modules, findings, baseline, runner.
+
+The suite encodes the cross-cutting invariants the first nine PRs enforced
+by hand in review (persist-before-side-effect, no blocking waits in async
+or under hot-path locks, shm segments always released, cross-process names
+from shared constants, RPC client/server pairing, canonical metric names)
+as stdlib-`ast` checkers. Each checker sees every module once (one shared
+parse per file) and may also emit tree-wide findings in `finish()`.
+
+Suppressions live in a baseline file (`tools/graft_check/baseline.txt`);
+entries match findings by (check_id, path, enclosing symbol) — line-drift
+safe — and every entry MUST still match a real finding: stale suppressions
+surface as `stale-baseline` findings so the file can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation at `path:line` (path repo-root-relative)."""
+
+    check_id: str
+    path: str
+    line: int
+    symbol: str  # enclosing `Class.method` / `function` / "<module>"
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline-matching identity (line numbers drift; symbols don't)."""
+        return (self.check_id, self.path, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check_id}] {self.message} "
+                f"(in {self.symbol})")
+
+
+class ParsedModule:
+    """One source file, parsed once and shared by every checker."""
+
+    def __init__(self, root: str, path: str):
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, path)
+        self._scopes: Optional[List[Tuple[int, int, str]]] = None
+
+    # -- symbol lookup -----------------------------------------------------
+
+    def _build_scopes(self) -> List[Tuple[int, int, str]]:
+        scopes: List[Tuple[int, int, str]] = []
+
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    scopes.append((child.lineno,
+                                   child.end_lineno or child.lineno, qual))
+                    walk(child, qual)
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+        return scopes
+
+    def symbol_at(self, line: int) -> str:
+        """Qualname of the innermost class/function enclosing `line`."""
+        if self._scopes is None:
+            self._scopes = self._build_scopes()
+        best = "<module>"
+        best_span = None
+        for start, end, qual in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def finding(self, check_id: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(check_id, self.relpath, line,
+                       self.symbol_at(line), message)
+
+
+class Checker:
+    """One invariant. Subclasses set `ids` (every check id they can emit,
+    for --list and --checks filtering) and override `check_module`; tree-
+    wide invariants accumulate state there and emit from `finish`."""
+
+    ids: Tuple[Tuple[str, str], ...] = ()  # ((check_id, description), ...)
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        return ()
+
+
+# ---------------------------------------------------------------- call utils
+
+
+def call_target(node: ast.Call) -> Tuple[str, str]:
+    """(receiver_text, attr_or_name) for a call — ('time', 'sleep') for
+    time.sleep(...), ('', 'foo') for foo(...). Receiver text is the
+    unparsed value expression ('self._store' for self._store.put)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    if isinstance(fn, ast.Attribute):
+        try:
+            base = ast.unparse(fn.value)
+        except Exception:  # noqa: BLE001 — exotic expr: best effort
+            base = ""
+        return base, fn.attr
+    return "", ""
+
+
+def kwarg_value(node: ast.Call, name: str):
+    """The literal value of keyword `name`, or None."""
+    for kw in node.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def str_head(node: ast.AST) -> Optional[str]:
+    """The literal text of a string constant, or the leading literal
+    segment of an f-string (enough to check name prefixes)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+        return ""  # f-string starting with an interpolation: unknown head
+    return None
+
+
+# ------------------------------------------------------------------ baseline
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    check_id: str
+    path: str
+    symbol: str
+    justification: str
+    line: int  # line in the baseline file (for stale reports)
+    count: Optional[int] = None  # exact expected finding count (None = any)
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.check_id, self.path, self.symbol)
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    """Parse the suppression file. Format, one entry per line:
+
+        <check-id>  <relpath>  <symbol>  [=N]  # one-line justification
+
+    The justification is REQUIRED — an unexplained suppression is a parse
+    error, not a suppression. The optional `=N` pins the EXACT number of
+    findings the entry covers: without it a single suppression would
+    silently swallow every future violation of that check in that
+    function; with it, a new violation at an already-baselined symbol
+    overflows the count and fails the suite."""
+    entries: List[BaselineEntry] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, justification = line.partition("#")
+            fields = body.split()
+            count: Optional[int] = None
+            if len(fields) == 4 and re.fullmatch(r"=\d+", fields[3]):
+                count = int(fields[3][1:])
+                fields = fields[:3]
+            if len(fields) != 3 or not justification.strip():
+                raise ValueError(
+                    f"{path}:{lineno}: malformed baseline entry (want "
+                    f"'<check-id> <relpath> <symbol> [=N] # justification')"
+                    f": {line!r}")
+            entries.append(BaselineEntry(fields[0], fields[1], fields[2],
+                                         justification.strip(), lineno,
+                                         count=count))
+    return entries
+
+
+# -------------------------------------------------------------------- runner
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # unsuppressed (incl. stale-baseline)
+    suppressed: List[Finding]        # matched a baseline entry
+    parse_errors: List[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def run_checks(root: str, checkers: Sequence[Checker],
+               baseline: Sequence[BaselineEntry] = (),
+               baseline_path: str = "") -> Report:
+    """Run every checker over every .py file under `root` (one parse per
+    file), apply the baseline, and report stale suppressions as findings."""
+    findings: List[Finding] = []
+    parse_errors: List[Finding] = []
+    for path in iter_py_files(root):
+        try:
+            mod = ParsedModule(root, path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            parse_errors.append(Finding(
+                "parse-error", rel, getattr(e, "lineno", 0) or 0,
+                "<module>", f"cannot parse: {e}"))
+            continue
+        for checker in checkers:
+            findings.extend(checker.check_module(mod))
+    for checker in checkers:
+        findings.extend(checker.finish())
+
+    by_key: dict = {}
+    for entry in baseline:
+        by_key.setdefault(entry.key, []).append(entry)
+    unsuppressed: List[Finding] = []
+    suppressed: List[Finding] = []
+    matched: dict = {}
+    for f in findings:
+        if f.key in by_key:
+            matched[f.key] = matched.get(f.key, 0) + 1
+            suppressed.append(f)
+        else:
+            unsuppressed.append(f)
+    bl_rel = baseline_path or "tools/graft_check/baseline.txt"
+    for entry in baseline:
+        n = matched.get(entry.key, 0)
+        if n == 0:
+            unsuppressed.append(Finding(
+                "stale-baseline", bl_rel, entry.line,
+                "<baseline>",
+                f"suppression {entry.check_id} {entry.path} {entry.symbol} "
+                f"no longer matches any finding — delete it"))
+        elif entry.count is not None and n != entry.count:
+            # a count overflow means a NEW violation is hiding behind an
+            # old justification; an underflow means some were fixed and
+            # the pin must shrink with them
+            unsuppressed.append(Finding(
+                "stale-baseline", bl_rel, entry.line,
+                "<baseline>",
+                f"suppression {entry.check_id} {entry.path} {entry.symbol} "
+                f"is pinned to ={entry.count} finding(s) but matched {n} — "
+                f"{'a new violation hides behind it' if n > entry.count else 'update the pin'}"))
+    unsuppressed.sort(key=lambda f: (f.path, f.line, f.check_id))
+    return Report(unsuppressed, suppressed, parse_errors)
