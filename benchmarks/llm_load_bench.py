@@ -149,6 +149,48 @@ class _PDStack:
 # ---------------------------------------------------------------- drivers
 
 
+def _phase_totals() -> dict:
+    """{phase: (sum_s, count)} for the PD-relevant phase histograms in
+    THIS process's metrics registry (the whole harness is in-process).
+    Deltas around a round attribute its time: transfer wait, admission
+    wait, decode inter-token — the breakdown the next PD-optimization PR
+    starts from."""
+    from ray_tpu.util import metrics as met
+
+    out: dict = {}
+    for m in met.snapshot():
+        if m["name"] not in ("ray_tpu_llm_pd_phase_seconds",
+                             "ray_tpu_llm_engine_phase_seconds"):
+            continue
+        for tags, st in m["series"]:
+            phase = dict(tuple(t) for t in tags).get("phase")
+            s, c = out.get(phase, (0.0, 0))
+            out[phase] = (s + st.get("sum", 0.0), c + st.get("count", 0))
+    return out
+
+
+def _phase_breakdown(pre: dict, post: dict, n_requests: int) -> dict:
+    """Per-phase mean/total deltas between two _phase_totals snapshots."""
+    out: dict = {}
+    for phase in ("transfer_wait", "transfer_send_wait", "admission_wait",
+                  "inter_token"):
+        s0, c0 = pre.get(phase, (0.0, 0))
+        s1, c1 = post.get(phase, (0.0, 0))
+        if c1 > c0:
+            out[phase] = {
+                "mean_ms": round((s1 - s0) / (c1 - c0) * 1e3, 4),
+                "total_s": round(s1 - s0, 4),
+                "count": c1 - c0,
+            }
+    # derived: where one request's time went on average, the attribution
+    # view the PD-vs-monolithic gap analysis needs
+    if n_requests:
+        for phase, rec in out.items():
+            rec["per_request_ms"] = round(
+                rec["total_s"] / n_requests * 1e3, 3)
+    return out
+
+
 def _pct(sorted_vals: list, q: float) -> float:
     if not sorted_vals:
         return 0.0
@@ -280,8 +322,15 @@ def _measure(platform: str) -> dict:
         # ---- A/B: closed loop at concurrency `conc` --------------------
         ab = {}
         for name, stack in (("pd", pd), ("monolithic", mono)):
+            pre = _phase_totals()
             ab[name] = _closed_loop(stack, prompts, concurrency=conc,
                                     n_requests=n_ab, max_tokens=gen_len)
+            if name == "pd":
+                # per-phase attribution for the PD round: transfer wait,
+                # admission wait, decode inter-token (ISSUE 11 — the next
+                # PD-optimization PR starts from this, not guesswork)
+                results["phase_breakdown"] = _phase_breakdown(
+                    pre, _phase_totals(), n_ab)
         ab["ttft_p50_speedup"] = round(
             ab["monolithic"]["p50_ttft_ms"]
             / max(ab["pd"]["p50_ttft_ms"], 1e-6), 3)
@@ -317,7 +366,8 @@ def main():
 
     out = _capture.orchestrate(
         os.path.abspath(__file__), "RAY_TPU_LLM_LOAD_BENCH_CHILD",
-        _BUDGET_S, _LKG_PATH, ["ab", "arrival_sweep", "pd_token_exact"],
+        _BUDGET_S, _LKG_PATH,
+        ["ab", "arrival_sweep", "pd_token_exact", "phase_breakdown"],
         _ROOT)
     # merge INTO LLM_BENCH.json as the `pd` section — the serving bench
     # owns the file's top level and preserves this key on rewrite

@@ -378,6 +378,27 @@ class GcsServer:
         # scoped like task_events — a DAG cannot outlive its driver, so the
         # table is in-memory only.
         self.compiled_dags: dict[str, dict] = {}
+        # serve flight-recorder log: last-N request summaries shipped by
+        # worker flushers (request_log_report), read by `ray_tpu trace list`
+        # and the dashboard's /api/requests
+        self.request_log: collections.deque = collections.deque(maxlen=1024)
+        # server-side RPC latency per request type — the measurement floor
+        # for control-plane scale work. UNREGISTERED histogram: the GCS
+        # often shares a process with the driver, whose flusher would
+        # otherwise ship the same series a second time; instead the series
+        # folds into metrics_snapshot under the reserved "gcs" source.
+        from ray_tpu.util.metrics import Histogram
+
+        self._rpc_hist = Histogram(
+            "ray_tpu_gcs_rpc_seconds",
+            "server-side GCS RPC handler latency per request type "
+            "(includes any handler-side blocking)",
+            boundaries=[0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+                        0.1, 0.5, 1.0, 5.0],
+            tag_keys=("rpc",), register=False)
+        self._rpc_bound: dict[str, object] = {}
+        self._rpc_other = self._rpc_hist.bind({"rpc": "other"})
+        self._rpc_bound_lock = threading.Lock()
         # retained metric TIME SERIES, head-side (reference: the dashboard's
         # metrics stack — per-node agents scraped into Prometheus,
         # dashboard/modules/metrics/metrics_head.py; here the GCS keeps a
@@ -769,11 +790,35 @@ class GcsServer:
         except OSError:
             pass
 
+    # label-cardinality cap for the per-RPC-type histogram: the type string
+    # is client-supplied, so without a bound a misbehaving/skewed client
+    # could grow GCS memory and every snapshot with garbage series. The
+    # real dispatch table is ~100 types; overflow buckets as "other".
+    _RPC_TYPE_CAP = 160
+
+    def _observe_rpc(self, rpc_type, seconds: float) -> None:
+        """Per-type server-side latency. Bound labelsets are cached so the
+        steady-state cost is one lock-free histogram observe per request;
+        past the cap, unseen types share one uncached "other" bind so
+        neither the series set nor the cache grows."""
+        b = self._rpc_bound.get(rpc_type)
+        if b is None:
+            with self._rpc_bound_lock:
+                b = self._rpc_bound.get(rpc_type)
+                if b is None:
+                    if len(self._rpc_bound) < self._RPC_TYPE_CAP:
+                        b = self._rpc_bound[rpc_type] = self._rpc_hist.bind(
+                            {"rpc": str(rpc_type)})
+                    else:
+                        b = self._rpc_other
+        b.observe(seconds)
+
     def _serve_conn(self, conn: MsgConnection):
         wid = None
         try:
             while True:
                 msg = conn.recv()
+                _t0 = time.perf_counter()
                 try:
                     wid = self._handle(conn, msg, wid)
                 except ConnectionClosed:
@@ -786,6 +831,9 @@ class GcsServer:
                                        "error": "internal error; see GCS log"})
                         except ConnectionClosed:
                             raise
+                finally:
+                    self._observe_rpc(msg.get("type"),
+                                      time.perf_counter() - _t0)
         except ConnectionClosed:
             if wid is not None:
                 self._on_worker_death(wid)
@@ -1628,6 +1676,13 @@ class GcsServer:
                         "description": "task terminal states",
                         "series": {"gcs": []}})["series"]["gcs"].append(
                             [[["state", k]], float(v)])
+                # server-side RPC latency: unregistered histogram folded in
+                # under the reserved "gcs" source (see __init__)
+                snap["ray_tpu_gcs_rpc_seconds"] = {
+                    "kind": "histogram",
+                    "description": self._rpc_hist.description,
+                    "series": {"gcs": self._rpc_hist._snapshot_series()},
+                    "ts": {"gcs": time.time()}}
             conn.send({"rid": msg["rid"], "metrics": snap})
         elif t == "events_report":
             with self.lock:
@@ -1651,6 +1706,21 @@ class GcsServer:
             with self.lock:
                 events = list(self.task_events)
             conn.send({"rid": msg["rid"], "events": events})
+        elif t == "request_log_report":
+            # serve flight-recorder entries (no reply — fire-and-forget
+            # like events_report; the flusher bounds each batch to the
+            # sender's ring size)
+            with self.lock:
+                for rec in msg.get("entries", []):
+                    rec.setdefault("source", msg.get("source", wid or ""))
+                    self.request_log.append(rec)
+        elif t == "list_requests":
+            with self.lock:
+                rows = [dict(r) for r in self.request_log]
+            limit = int(msg.get("limit", 0) or 0)
+            if limit:
+                rows = rows[-limit:]
+            conn.send({"rid": msg["rid"], "requests": rows})
         elif t == "dag_register":
             # compiled-DAG registry (tentpole: observability for the channel
             # execution plane). The registering connection's wid is recorded

@@ -156,6 +156,23 @@ class RayConfig:
     # CoreWorker flusher) every Nth compiled-DAG step; 0 = off. Sampled at
     # compile time into the exec-loop plan so workers need no env override.
     dag_span_sample_every: int = 100
+    # Serve/PD request-path instrumentation: always-on pre-bound phase
+    # histograms for the serving hot path (proxy accept/parse/route/handle,
+    # handle pick/RTT, replica queue-wait/execute, PD per-page transfer
+    # wait, decode-slot admission wait, inter-token gap) plus the
+    # flight-recorder ring of recent request summaries. 0/false disables
+    # entirely (the serving bench A/B baseline).
+    serve_metrics: bool = True
+    # Emit a full cross-process span tree (task_events) for every Nth serve
+    # request entering the HTTP proxy; 0 = off. Same knob pattern as
+    # dag_span_sample_every: sampling keeps the hot path cheap while one
+    # request in N yields a complete phase timeline
+    # (`ray_tpu trace show <request_id>`).
+    serve_span_sample_every: int = 100
+    # In-process flight recorder: how many recent request summaries each
+    # serving process retains (and ships to the GCS request log) so a slow
+    # request can be explained after the fact without sampling luck.
+    serve_flight_recorder_size: int = 256
     # Compiled-DAG exec-loop recovery budget: total seconds the driver
     # waits per recovery for the core actor restart + the in-band rewire
     # barrier + the in-flight replay before degrading the DAG to the

@@ -73,6 +73,66 @@ def drain() -> list:
     return out
 
 
+# ------------------------------------------------------- flight recorder
+
+# In-process ring of the last N serve request summaries (always-on, unlike
+# span sampling): a slow request can be explained after the fact without
+# sampling luck. The worker flusher ships new entries to the GCS request
+# log at the metrics cadence; the ring itself answers local inspection.
+_req_lock = threading.Lock()
+_req_ring: collections.deque | None = None
+_req_seq = 0
+_req_flushed_seq = 0
+
+
+def _ring() -> collections.deque:
+    global _req_ring
+    if _req_ring is None:
+        _req_ring = collections.deque(maxlen=max(
+            1, RayConfig.instance().serve_flight_recorder_size))
+    return _req_ring
+
+
+def record_request(summary: dict) -> None:
+    """Append one request summary ({request_id, path, phases, ...}) to the
+    flight-recorder ring."""
+    global _req_seq
+    rec = dict(summary)
+    rec.setdefault("pid", os.getpid())
+    with _req_lock:
+        _req_seq += 1
+        rec["seq"] = _req_seq
+        _ring().append(rec)
+
+
+def recent_requests() -> list:
+    """The ring's current contents, oldest first (local inspection/tests)."""
+    with _req_lock:
+        return [dict(r) for r in (_req_ring or ())]
+
+
+def drain_request_log() -> list:
+    """Entries recorded since the last drain that are STILL in the ring
+    (older ones already rotated out — exactly the last-N semantics). Called
+    by the worker's telemetry flusher."""
+    global _req_flushed_seq
+    with _req_lock:
+        out = [dict(r) for r in (_req_ring or ())
+               if r["seq"] > _req_flushed_seq]
+        if out:
+            _req_flushed_seq = out[-1]["seq"]
+    return out
+
+
+def reset_request_log() -> None:
+    """Test helper: drop the ring so a new RayConfig size takes effect."""
+    global _req_ring, _req_seq, _req_flushed_seq
+    with _req_lock:
+        _req_ring = None
+        _req_seq = 0
+        _req_flushed_seq = 0
+
+
 def normalize_events(events: list) -> list:
     """Normalize GCS-side completion records (ts only) into zero-length
     spans so every export path renders them identically — the chrome-trace
@@ -131,10 +191,12 @@ def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
 
     Rows: one per (worker-id, pid) — except compiled-DAG step spans, which
     carry a `dag_id` and are grouped under one row per DAG (tid = DAG node)
-    so a pipeline's steps line up regardless of which worker ran them.
-    Durations become complete ('X') events with microsecond timestamps,
-    matching what chrome://tracing / Perfetto ingests from the reference's
-    `ray timeline` output.
+    so a pipeline's steps line up regardless of which worker ran them, and
+    serve/PD request spans, which carry a `request_id` and group under one
+    row per request (tid = emitting pid) so one request's cross-process
+    phases line up as a timeline. Durations become complete ('X') events
+    with microsecond timestamps, matching what chrome://tracing / Perfetto
+    ingests from the reference's `ray timeline` output.
     """
     worker_names = worker_names or {}
     trace = []
@@ -145,6 +207,9 @@ def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
         if ev.get("dag_id"):
             row = f"dag:{ev['dag_id']}"
             tid = ev.get("node") or ev.get("pid", 0)
+        elif ev.get("request_id"):
+            row = f"req:{ev['request_id']}"
+            tid = ev.get("pid", 0)
         else:
             row = worker_names.get(wid, wid)
             tid = ev.get("pid", 0)

@@ -416,6 +416,13 @@ class CoreWorker:
                 for ev in events:
                     ev["worker_id"] = self.wid
                 self.send_no_reply({"type": "events_report", "events": events})
+            reqs = _te.drain_request_log()
+            if reqs:
+                # serve flight-recorder entries -> the GCS request log
+                # (bounded per flush by the ring size: only entries still
+                # in the last-N ring ship)
+                self.send_no_reply({"type": "request_log_report",
+                                    "source": self.wid, "entries": reqs})
             snap = _met.snapshot()
             if snap:
                 self.send_no_reply({"type": "metrics_report",

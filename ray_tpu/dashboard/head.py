@@ -11,6 +11,7 @@ Endpoints:
   GET /api/cluster           — cluster_state JSON
   GET /api/nodes|actors|placement_groups|jobs|tasks
   GET /api/dags              — compiled-DAG registry (state API twin)
+  GET /api/requests          — serve flight-recorder request log
   GET /api/logs              — list log files; /api/logs/<name>?tail=N
   GET /api/timeline          — chrome://tracing JSON of task events
   GET /metrics               — Prometheus text format
@@ -120,6 +121,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # compiled-DAG registry (registered at experimental_compile,
                 # dropped at teardown/driver death)
                 self._json(gcs.rpc({"type": "dag_list"}).get("dags", []))
+            elif path == "/api/requests":
+                # serve flight-recorder log: last-N request summaries with
+                # per-phase seconds (request tracing tentpole) — newest last
+                limit = int(q.get("limit", [0])[0] or 0)
+                self._json(gcs.rpc({"type": "list_requests",
+                                    "limit": limit}).get("requests", []))
             elif path == "/api/serve":
                 # serve control plane straight from the persisted GCS
                 # `serve` table — works even while the controller is down

@@ -22,6 +22,7 @@ import dataclasses
 import itertools
 import queue
 import threading
+import time
 from typing import Any
 
 import jax
@@ -57,6 +58,12 @@ class _Request:
     pf_done: int = 0
     pf_pages: list | None = None
     pf_hashes: list | None = None
+    # request-phase stamps (wall clock): submit → decode-slot bind is the
+    # admission wait; _emit tracks the inter-token gap off last_emit_ts.
+    # Read by llm/pd.py decode_stream to emit retroactive phase spans.
+    submitted_ts: float = 0.0
+    admitted_ts: float = 0.0
+    last_emit_ts: float = 0.0
     # full token history (prompt + emitted) for the n-gram draft proposer,
     # plus an incremental index: trailing-ngram tuple → (latest, previous)
     # continuation-start positions, so proposal is O(1) per step instead of
@@ -333,6 +340,19 @@ class TPUEngine:
         self._work = threading.Event()
         self._stop = False
         self._error: BaseException | None = None
+        # serving-phase instrumentation (decode-slot admission wait,
+        # inter-token gap): pre-bound histograms resolved ONCE per engine —
+        # the per-token cost is one clock read + one lock-free observe.
+        # None when RayConfig.serve_metrics is off (the bench A/B baseline).
+        try:
+            from ray_tpu.serve import request_context as _rc
+
+            self._phase_admit = _rc.phase_observer(_rc.ENGINE_PHASE,
+                                                   "admission_wait")
+            self._phase_gap = _rc.phase_observer(_rc.ENGINE_PHASE,
+                                                 "inter_token")
+        except Exception:  # pragma: no cover — metrics must never gate boot
+            self._phase_admit = self._phase_gap = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpu-engine")
         self._thread.start()
@@ -504,6 +524,7 @@ class TPUEngine:
                 self._lora_refs[lora_idx] += 1
         req = _Request(next(self._rid), token_ids, params,
                        history=list(token_ids), lora_idx=lora_idx)
+        req.submitted_ts = time.time()
         self._waiting.put(req)
         self._work.set()
         return req
@@ -567,6 +588,7 @@ class TPUEngine:
                 f"prefix length {int(length)} + max_tokens {params.max_tokens} "
                 f"does not fit engine max_len {self.max_len}")
         req = _Request(next(self._rid), [], params)
+        req.submitted_ts = time.time()
         if paged_form:
             req.kv_pack = {"k_pages": list(k_pages), "v_pages": list(v_pages),
                            "length": int(length),
@@ -763,6 +785,11 @@ class TPUEngine:
         if self.lora_bank is not None:
             self._slot_lora = self._slot_lora.at[slot].set(req.lora_idx)
         self._by_slot[slot] = req
+        req.admitted_ts = time.time()
+        if self._phase_admit is not None and req.submitted_ts:
+            # decode-slot admission wait: submit → slot bind, covering the
+            # waiting queue, page-pressure backlog, and (PD) the page pull
+            self._phase_admit.observe(req.admitted_ts - req.submitted_ts)
 
     def _insert(self, req: _Request, slot: int, kv, length: int, first_token):
         """Layout-dispatching sequence insertion. Returns False when the
@@ -1134,6 +1161,12 @@ class TPUEngine:
             self.state, jnp.asarray(last), jnp.asarray(counts))
 
     def _emit(self, req: _Request, token_id: int):
+        if self._phase_gap is not None:
+            now = time.time()
+            last = req.last_emit_ts or req.admitted_ts
+            if last:
+                self._phase_gap.observe(now - last)
+            req.last_emit_ts = now
         req.generated += 1
         req.history.append(token_id)
         if self.speculative_k and req.ngram_index is not None:

@@ -64,13 +64,17 @@ def _metrics():
 
 
 class _Transfer:
-    __slots__ = ("ticket_id", "channel", "thread", "failed")
+    __slots__ = ("ticket_id", "channel", "thread", "failed", "trace_ctx")
 
-    def __init__(self, ticket_id: str, channel: MutableShmChannel):
+    def __init__(self, ticket_id: str, channel: MutableShmChannel,
+                 trace_ctx: dict | None = None):
         self.ticket_id = ticket_id
         self.channel = channel
         self.thread: threading.Thread | None = None
         self.failed: str | None = None
+        # sampled request's span context, captured at export: the sender
+        # thread runs outside the request's contextvar scope
+        self.trace_ctx = trace_ctx
 
 
 class PagedKVExporter:
@@ -94,10 +98,13 @@ class PagedKVExporter:
     # ------------------------------------------------------------- export
 
     def export(self, k: np.ndarray, v: np.ndarray, length: int,
-               first_token: int, page_size: int) -> dict:
+               first_token: int, page_size: int,
+               trace_ctx: dict | None = None) -> dict:
         """Slice a bucketed prompt KV (``[L, T, Hkv, Dh]``, T a multiple of
         ``page_size``) into pages and start streaming them. Returns the
-        ticket the proxy forwards to the decode pool."""
+        ticket the proxy forwards to the decode pool. ``trace_ctx`` (a
+        sampled request's span context) makes the sender emit a
+        ``pd:kv_send`` span covering the whole transfer."""
         k = np.asarray(k)
         v = np.asarray(v)
         L, T = k.shape[0], k.shape[1]
@@ -110,7 +117,7 @@ class PagedKVExporter:
         page_bytes = (k.nbytes + v.nbytes) // n_pages
         ch = create_mutable_channel(page_bytes + _WIRE_SLACK)
         tid = uuid.uuid4().hex[:16]
-        tr = _Transfer(tid, ch)
+        tr = _Transfer(tid, ch, trace_ctx)
         with self._lock:
             self._live[tid] = tr
         tr.thread = threading.Thread(
@@ -131,14 +138,25 @@ class PagedKVExporter:
         }
 
     def _send(self, tr: _Transfer, k, v, page_size: int, n_pages: int):
+        import time as _time
+
+        from ray_tpu.serve import request_context as rc
+
         ch = tr.channel
+        t_send0 = _time.time()
         try:
             for i in range(n_pages):
                 sl = slice(i * page_size, (i + 1) * page_size)
                 kp = np.ascontiguousarray(k[:, sl])
                 vp = np.ascontiguousarray(v[:, sl])
+                t_w = _time.perf_counter()
                 ch.write({"i": i, "k": kp, "v": vp},
                          timeout=self.send_timeout_s)
+                # per-page backpressure wait: the seqlock write blocks
+                # until the reader consumed the previous page, so this IS
+                # how long the handoff serialized on the decode side
+                rc.observe_phase(rc.PD_PHASE, "transfer_send_wait",
+                                 _time.perf_counter() - t_w)
                 self._m_bytes.inc(kp.nbytes + vp.nbytes)
                 self._m_pages.inc()
             # the final page is published but possibly unread: wait for the
@@ -163,6 +181,13 @@ class PagedKVExporter:
                 if tr.failed is not None:
                     self.failures += 1
                     self.last_failure = f"{tr.ticket_id}: {tr.failed}"
+            if tr.trace_ctx:
+                from ray_tpu.util import tracing
+
+                tracing.emit_span_for(
+                    tr.trace_ctx, "pd:kv_send", t_send0, _time.time(),
+                    ok=tr.failed is None, ticket=tr.ticket_id,
+                    pages=n_pages, failed=tr.failed or "")
 
     # ---------------------------------------------------------- lifecycle
 
@@ -207,6 +232,10 @@ def pull_pages(ticket: dict, timeout_s: float = 60.0):
     ``(index, k_page, v_page)`` in order (each ``[L, page_size, Hkv, Dh]``).
     Every failure mode surfaces as KVTransferError naming the ticket — the
     per-request error contract."""
+    import time as _time
+
+    from ray_tpu.serve import request_context as rc
+
     tid = ticket.get("ticket", "?")
     try:
         ch = MutableShmChannel(ticket["path"], ticket["capacity"])
@@ -217,6 +246,7 @@ def pull_pages(ticket: dict, timeout_s: float = 60.0):
             "decode are not co-hosted (shm transfer is same-host)") from None
     try:
         for i in range(ticket["n_pages"]):
+            t_r = _time.perf_counter()
             try:
                 msg = ch.read(timeout=timeout_s)
             except ChannelClosed:
@@ -228,6 +258,10 @@ def pull_pages(ticket: dict, timeout_s: float = 60.0):
                 raise KVTransferError(
                     f"kv transfer {tid}: timed out waiting for page {i} of "
                     f"{ticket['n_pages']} after {timeout_s}s") from None
+            # per-page channel wait: how long decode admission stalled on
+            # the transfer plane for this page
+            rc.observe_phase(rc.PD_PHASE, "transfer_wait",
+                             _time.perf_counter() - t_r)
             yield msg["i"], msg["k"], msg["v"]
     finally:
         ch.close_mapping()

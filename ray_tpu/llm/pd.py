@@ -37,6 +37,8 @@ from ray_tpu.llm.config import LLMConfig, PDConfig
 from ray_tpu.llm.engine import SamplingParams, bucket_for
 from ray_tpu.llm.kv_transfer import PagedKVExporter, pull_all
 from ray_tpu.llm.tokenizer import load_tokenizer
+from ray_tpu.serve import request_context as _rc
+from ray_tpu.util import tracing as _tracing
 
 _TTFT_BOUNDS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5, 5.0, 10.0)
@@ -100,12 +102,18 @@ class PrefillServer:
             raise ValueError(f"prompt of {n} tokens exceeds max_len {self.max_len}")
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = token_ids
+        t0 = time.time()
         logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
                                       jnp.int32(n), self.cfg)
         self.key, sub = jax.random.split(self.key)
         first = int(decoding.sample(logits[None, :], sub, temperature)[0])
+        _tracing.emit_child_span("pd:prefill_forward", t0, time.time(),
+                                 tokens=n, bucket=bucket)
+        # sampled requests: the sender thread runs outside the request's
+        # contextvar scope, so its pd:kv_send span context rides the ticket
         return self.exporter.export(np.asarray(kv["k"]), np.asarray(kv["v"]),
-                                    n, first, self.page_size)
+                                    n, first, self.page_size,
+                                    trace_ctx=_tracing.inject())
 
     def transfer_stats(self) -> dict:
         return {"pending_transfers": self.exporter.pending(),
@@ -139,10 +147,19 @@ class DecodeServer:
         immediately (TTFT is not gated on the page transfer), then the
         engine's tokens as the decode loop produces them. Transfer
         failures raise KVTransferError — a clean per-request error; the
-        engine and the other in-flight requests keep serving."""
+        engine and the other in-flight requests keep serving.
+
+        Sampled requests emit the decode-side phase spans here:
+        ``pd:kv_transfer`` (the page pull), ``pd:admission`` (submit →
+        slot bind, retroactive from the engine's request stamps) and
+        ``pd:decode`` (first engine token → stream end)."""
         from ray_tpu.llm.engine import _iter_request
         from ray_tpu.llm.kv_transfer import pull_pages
 
+        # capture: the generator body runs across many __next__ calls but
+        # always on the activated task's thread — the captured context is
+        # the one stable handle for retroactive span emission
+        ctx = _tracing.current_context()
         sp = SamplingParams(**(params or {}))
         yield ticket["first_token"]
         if sp.max_tokens <= 1:
@@ -152,11 +169,30 @@ class DecodeServer:
             for _ in pull_pages(ticket, timeout_s=self.pull_timeout_s):
                 pass
             return
+        t_pull = time.time()
         k_pages, v_pages = pull_all(ticket, timeout_s=self.pull_timeout_s)
+        _tracing.emit_span_for(ctx, "pd:kv_transfer", t_pull, time.time(),
+                               ticket=ticket.get("ticket", ""),
+                               pages=ticket["n_pages"])
         req = self.engine.submit_prefilled(
             length=ticket["length"], first_token=ticket["first_token"],
             params=sp, k_pages=k_pages, v_pages=v_pages)
-        yield from _iter_request(req)
+        n = 0
+        t_dec = time.time()
+        try:
+            it = _iter_request(req)
+            for tok in it:
+                if n == 0 and ctx is not None and req.admitted_ts:
+                    # the engine stamped the slot bind: emit the admission
+                    # wait retroactively now that it is known
+                    _tracing.emit_span_for(ctx, "pd:admission",
+                                           req.submitted_ts, req.admitted_ts)
+                n += 1
+                yield tok
+        finally:
+            if ctx is not None:
+                _tracing.emit_span_for(ctx, "pd:decode", t_dec, time.time(),
+                                       tokens=n)
 
     def decode(self, ticket: dict, params: dict | None = None) -> list:
         """Blocking form (compat surface for non-streaming callers)."""
@@ -195,6 +231,7 @@ class PDProxyServer:
         ids = self.tokenizer.encode(body.get("prompt", ""))
         timing["prompt_tokens"] = len(ids)
         t0 = time.monotonic()
+        w0 = time.time()
         ticket = self.prefill.prefill.remote(
             ids, float(body.get("temperature", 0.0))
         ).result(timeout_s=self.request_timeout_s)
@@ -202,7 +239,10 @@ class PDProxyServer:
         # arrival is the request's time-to-first-token
         timing["ttft_s"] = time.monotonic() - t0
         self._m_ttft.observe(timing["ttft_s"], tags={"phase": "prefill"})
+        _tracing.emit_child_span("pd:prefill", w0, w0 + timing["ttft_s"],
+                                 prompt_tokens=len(ids))
         t1 = time.monotonic()
+        w1 = time.time()
         stream = self.decode.options(
             stream=True, stream_item_timeout_s=self.request_timeout_s,
         ).decode_stream.remote(
@@ -212,8 +252,11 @@ class PDProxyServer:
             if i == 1:
                 # first DECODE-produced token: page pull + slot admission
                 # + one decode step — the decode half of the TTFT split
-                self._m_ttft.observe(time.monotonic() - t1,
-                                     tags={"phase": "decode"})
+                decode_ttft = time.monotonic() - t1
+                timing["decode_ttft_s"] = decode_ttft
+                self._m_ttft.observe(decode_ttft, tags={"phase": "decode"})
+                _tracing.emit_child_span("pd:decode_first_token", w1,
+                                         w1 + decode_ttft)
             yield tok
         timing["total_time_s"] = time.monotonic() - t0
 
@@ -224,10 +267,31 @@ class PDProxyServer:
                 "ttft_s": round(timing.get("ttft_s", 0.0), 4),
                 "total_time_s": round(timing.get("total_time_s", 0.0), 4)}
 
+    def _record(self, request: dict, timing: dict, t0: float,
+                n_out: int, status) -> None:
+        """PD-phase flight-recorder entry: richer than the HTTP proxy's
+        (prefill vs decode TTFT split), same ring/GCS log."""
+        rec = {"request_id": request.get("request_id") or _rc.new_request_id(),
+               "component": "pd_proxy", "ts": time.time(),
+               "phases": {"prefill": round(timing.get("ttft_s", 0.0), 6),
+                          "decode_first_token": round(
+                              timing.get("decode_ttft_s", 0.0), 6)},
+               "completion_tokens": n_out}
+        _rc.record_request(rec, t0, status=status)
+
     def __call__(self, request: dict) -> dict:
         body = request.get("body") or request
         timing: dict = {}
-        out_ids = list(self._pump(body, timing))
+        t0 = time.perf_counter()
+        status = "error"
+        out_ids: list = []
+        try:
+            out_ids = list(self._pump(body, timing))
+            status = 200
+        finally:
+            # failed requests (KVTransferError, replica death) are exactly
+            # the ones the flight recorder must explain — record either way
+            self._record(request, timing, t0, len(out_ids), status)
         return {"choices": [{"index": 0,
                              "text": self.tokenizer.decode(out_ids),
                              "finish_reason": "stop"}],
@@ -240,12 +304,18 @@ class PDProxyServer:
         body = request.get("body") or request
         timing: dict = {}
         n = 0
-        for tok in self._pump(body, timing):
-            n += 1
-            yield {"object": "text_completion.chunk",
-                   "choices": [{"index": 0,
-                                "text": self.tokenizer.decode([tok]),
-                                "finish_reason": None}]}
+        t0 = time.perf_counter()
+        status = "aborted"  # GeneratorExit (client gone) or mid-stream error
+        try:
+            for tok in self._pump(body, timing):
+                n += 1
+                yield {"object": "text_completion.chunk",
+                       "choices": [{"index": 0,
+                                    "text": self.tokenizer.decode([tok]),
+                                    "finish_reason": None}]}
+            status = "stream"
+        finally:
+            self._record(request, timing, t0, n, status)
         yield {"object": "text_completion.chunk",
                "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],
                "usage": self._usage(timing, n)}

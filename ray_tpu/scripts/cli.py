@@ -324,6 +324,70 @@ def cmd_dag(args):
                      indent=1, default=str))
 
 
+def _print_span(span: dict, depth: int = 0) -> None:
+    start, end = span.get("start"), span.get("end")
+    dur = f"{(end - start) * 1e3:9.2f} ms" if start and end else " " * 12
+    line = f"{dur}  {'  ' * depth}{span.get('name') or span.get('span_kind')}"
+    if not span.get("ok", True):
+        line += "  [FAILED]"
+    if span.get("pid"):
+        line += f"  (pid {span['pid']})"
+    print(line)
+    for child in span.get("children", ()):
+        _print_span(child, depth + 1)
+
+
+def cmd_trace(args):
+    """Serve request tracing: `ray_tpu trace list` shows the flight-recorder
+    log of recent request summaries (always-on, last N per process);
+    `ray_tpu trace show <request_id>` prints the sampled cross-process span
+    tree for one request (trace id == request id), falling back to the
+    flight-recorder summary when that request wasn't span-sampled."""
+    from ray_tpu.util.tracing import assemble
+
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        if args.action == "list":
+            rows = c.rpc({"type": "list_requests"}).get("requests", [])
+            if args.json:
+                print(json.dumps(rows, indent=1, default=str))
+                return
+            print(f"{'request_id':<34} {'component':<11} {'status':<7} "
+                  f"{'dur_ms':>9}  phases")
+            for r in rows[-50:]:
+                phases = " ".join(
+                    f"{k}={v * 1e3:.1f}ms"
+                    for k, v in (r.get("phases") or {}).items())
+                print(f"{r.get('request_id', '?'):<34} "
+                      f"{r.get('component', '?'):<11} "
+                      f"{str(r.get('status', '')):<7} "
+                      f"{(r.get('duration_s') or 0) * 1e3:>9.2f}  {phases}")
+            return
+        if not args.request_id:
+            print("trace show needs a request id", file=sys.stderr)
+            sys.exit(2)
+        events = c.rpc({"type": "task_events"}).get("events", [])
+        tree = assemble(events, args.request_id)
+        if tree is not None:
+            print(f"trace {args.request_id}")
+            _print_span(tree["root"])
+            return
+        rows = [r for r in c.rpc({"type": "list_requests"}).get(
+            "requests", []) if r.get("request_id") == args.request_id]
+        if rows:
+            print(f"request {args.request_id} was not span-sampled "
+                  "(RAY_TPU_SERVE_SPAN_SAMPLE_EVERY); flight-recorder "
+                  "summary:")
+            print(json.dumps(rows, indent=1, default=str))
+            return
+        print(f"no trace or request summary for {args.request_id!r}",
+              file=sys.stderr)
+        sys.exit(1)
+    finally:
+        c.close()
+
+
 def cmd_dashboard(args):
     from ray_tpu.dashboard.head import DashboardHead
 
@@ -528,6 +592,17 @@ def main(argv=None):
     sp.add_argument("--json", action="store_true",
                     help="list: raw JSON instead of the table")
     sp.set_defaults(fn=cmd_dag)
+
+    sp = sub.add_parser("trace",
+                        help="serve request tracing: list recent request "
+                             "summaries / show one request's span tree")
+    sp.add_argument("action", choices=["list", "show"])
+    sp.add_argument("request_id", nargs="?",
+                    help="show: the request id (from trace list, the "
+                         "flight recorder, or /api/requests)")
+    sp.add_argument("--json", action="store_true",
+                    help="list: raw JSON instead of the table")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     sp.add_argument("--host", default="127.0.0.1")

@@ -17,6 +17,8 @@ import weakref
 import ray_tpu
 from ray_tpu._private.protocol import ConnectionClosed
 from ray_tpu.actor import ActorHandle
+from ray_tpu.serve import request_context as _rc
+from ray_tpu.util import tracing as _tracing
 
 ROUTING_REFRESH_S = 1.0
 
@@ -98,7 +100,7 @@ class _FastChannel:
                 w.event.set()
 
     def submit(self, method: str, args: tuple, kwargs: dict,
-               model_id: str | None) -> _Pending:
+               model_id: str | None, trace_ctx: dict | None = None) -> _Pending:
         if self.dead:
             raise _channel_dead_error()
         with self._lock:
@@ -106,9 +108,15 @@ class _FastChannel:
             self._next_rid += 1
             w = _Pending(self, rid)
             self._waiters[rid] = w
+        msg = {"rid": rid, "method": method, "args": args,
+               "kwargs": kwargs, "model_id": model_id}
+        if trace_ctx:
+            # the fast plane bypasses task specs, so the sampled request's
+            # context rides the frame itself (the replica activates it
+            # around execution — replica._rpc_execute)
+            msg["trace_ctx"] = trace_ctx
         try:
-            self._conn.send({"rid": rid, "method": method, "args": args,
-                             "kwargs": kwargs, "model_id": model_id})
+            self._conn.send(msg)
         except (ConnectionClosed, ConnectionError, OSError) as e:
             with self._lock:
                 self._waiters.pop(rid, None)
@@ -142,8 +150,10 @@ class _FastChannel:
         return w
 
     def call(self, method: str, args: tuple, kwargs: dict,
-             model_id: str | None, timeout_s: float):
-        return self.submit(method, args, kwargs, model_id).wait(timeout_s)
+             model_id: str | None, timeout_s: float,
+             trace_ctx: dict | None = None):
+        return self.submit(method, args, kwargs, model_id,
+                           trace_ctx).wait(timeout_s)
 
 
 _channels: dict[tuple, _FastChannel] = {}
@@ -411,6 +421,7 @@ class DeploymentHandle:
 
         deadline = _time.monotonic() + timeout_s
         last: Exception | None = None
+        tctx = _tracing.inject()  # None unless this request was sampled
         for _ in range(4):
             remaining = deadline - _time.monotonic()
             if remaining <= 0:
@@ -419,7 +430,11 @@ class DeploymentHandle:
                         f"call_sync to {self._name} timed out after "
                         f"{timeout_s}s before any attempt completed")
                 break
+            t_pick = _time.perf_counter()
             replica_id = self._router.pick(_routing_hint)
+            _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
+                              _time.perf_counter() - t_pick)
+            t_rtt = _time.perf_counter()
             ch = None
             addr = self._router.addrs.get(replica_id)
             if addr is not None:
@@ -433,8 +448,11 @@ class DeploymentHandle:
                 # fast data plane: one framed round-trip on a persistent
                 # socket, no per-request task submission
                 try:
-                    return ch.call(self._method, args, kwargs,
-                                   self._model_id, remaining)
+                    result = ch.call(self._method, args, kwargs,
+                                     self._model_id, remaining, tctx)
+                    _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
+                                      _time.perf_counter() - t_rtt)
+                    return result
                 except TimeoutError as e:
                     last = e
                     continue  # deadline loop exits when budget is spent
@@ -458,7 +476,10 @@ class DeploymentHandle:
                 self._router.drop(replica_id)
                 continue
             try:
-                return ray_tpu.get(ref, timeout=remaining)
+                result = ray_tpu.get(ref, timeout=remaining)
+                _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
+                                  _time.perf_counter() - t_rtt)
+                return result
             except (ActorDiedError, WorkerCrashedError) as e:
                 last = e
                 self._router.drop(replica_id)
@@ -482,8 +503,12 @@ class DeploymentHandle:
         has_refs = (any(isinstance(a, ObjectRef) for a in args)
                     or any(isinstance(v, ObjectRef) for v in kwargs.values()))
         last_err = None
+        tctx = _tracing.inject()  # None unless this request was sampled
         for _ in range(3):  # retry on replica death with a fresh table
+            t_pick = time.perf_counter()
             replica_id = self._router.pick(hint)
+            _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
+                              time.perf_counter() - t_pick)
             if not self._stream and not has_refs:
                 addr = self._router.addrs.get(replica_id)
                 ch = None
@@ -495,7 +520,7 @@ class DeploymentHandle:
                 if ch is not None:
                     try:
                         pending = ch.submit(
-                            self._method, args, kwargs, self._model_id)
+                            self._method, args, kwargs, self._model_id, tctx)
                         return _FastResponse(
                             pending,
                             lambda r=replica_id: self._router.done(r))

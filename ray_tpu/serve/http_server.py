@@ -19,6 +19,7 @@ import asyncio
 import concurrent.futures
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
@@ -28,6 +29,18 @@ class _BadRequest(Exception):
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 512 * 1024 * 1024
+
+
+def _observe_accept(seconds: float) -> None:
+    """Executor dispatch wait (request fully read → handler running): the
+    'accept' phase of the proxy breakdown. Queueing here means the bounded
+    executor is the bottleneck, not the downstream handle."""
+    try:
+        from ray_tpu.serve import request_context as rc
+
+        rc.observe_phase(rc.PROXY_PHASE, "accept", seconds)
+    except Exception:  # noqa: BLE001 — metrics must never fail a request
+        pass
 
 
 class AsyncHTTPServer:
@@ -172,9 +185,15 @@ class AsyncHTTPServer:
     async def _respond(self, writer: asyncio.StreamWriter, method: str,
                        path: str, headers: dict, body: bytes) -> bool:
         loop = asyncio.get_running_loop()
+        _t_queued = time.perf_counter()
+
+        def _run_handler():
+            _observe_accept(time.perf_counter() - _t_queued)
+            return self.handler(method, path, headers, body)
+
         try:
             status, ctype, payload = await loop.run_in_executor(
-                self._executor, self.handler, method, path, headers, body)
+                self._executor, _run_handler)
         except Exception as e:  # noqa: BLE001 — the server must answer
             payload = json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}).encode()

@@ -16,7 +16,9 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu.serve import request_context as rc
 from ray_tpu.serve.http_server import AsyncHTTPServer
+from ray_tpu.util import tracing
 
 PROXY_NAME = "SERVE_PROXY"
 
@@ -44,25 +46,58 @@ class ProxyActor:
 
     def _handle_request(self, method: str, path: str, headers: dict,
                         body: bytes):
-        """Runs on the HTTP server's executor (may block on the handle)."""
+        """Runs on the HTTP server's executor (may block on the handle).
+
+        Every request gets a request id; every Nth
+        (`RayConfig.serve_span_sample_every`) additionally opens a root
+        span whose context rides the handle into the replicas, so one
+        request id yields one cross-process span tree. Either way a
+        summary lands in the flight-recorder ring."""
+        rid = rc.new_request_id()
+        rec = {"request_id": rid, "component": "http_proxy",
+               "path": path, "method": method, "ts": time.time(),
+               "sampled": rc.sample_request()}
+        t_in = time.perf_counter()
+        span = (tracing.begin_request_trace(rid, path=path, method=method)
+                if rec["sampled"] else None)
         if self._wants_stream(headers, body):
             try:
-                gen = self._dispatch_stream(path, method, body)
+                gen = self._dispatch_stream(path, method, body, rid, rec)
             except Exception as e:  # noqa: BLE001 — the proxy must answer
+                tracing.finish_request_trace(span, ok=False)
+                rc.record_request(rec, t_in, status=500)
                 return 500, "application/json", json.dumps(
                     {"error": f"{type(e).__name__}: {e}"}).encode()
+            # the stream outlives this dispatch thread: deactivate the
+            # context here, close the root span (and record) when the
+            # BODY completes so the root's duration covers the stream
+            tracing.detach_request_trace(span)
 
             def sse():
-                for item in gen:
-                    yield b"data: " + json.dumps(item, default=str).encode() + b"\n\n"
-                yield b"data: [DONE]\n\n"
+                ok = False
+                try:
+                    for item in gen:
+                        yield (b"data: "
+                               + json.dumps(item, default=str).encode()
+                               + b"\n\n")
+                    yield b"data: [DONE]\n\n"
+                    ok = True
+                finally:
+                    tracing.finish_request_trace(span, ok=ok)
+                    rc.record_request(rec, t_in,
+                                      status="stream" if ok else "aborted")
 
             return 200, "text/event-stream", sse()
+        ok = True
         try:
-            status, payload = self._dispatch(path, method, body)
+            status, payload = self._dispatch(path, method, body, rid, rec)
         except Exception as e:  # noqa: BLE001
+            ok = False
             status, payload = 500, json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}).encode()
+        finally:
+            tracing.finish_request_trace(span, ok=ok)
+        rc.record_request(rec, t_in, status=status)
         return status, "application/json", payload
 
     @staticmethod
@@ -134,19 +169,28 @@ class ProxyActor:
         finally:
             self._refresh_lock.release()
 
-    def _dispatch(self, path: str, method: str, body: bytes) -> tuple[int, bytes]:
-        handle = self._resolve_handle(path)
+    def _parse_body(self, body: bytes, rec: dict):
+        with rc.timed_phase(rc.PROXY_PHASE, "parse", rec, span="proxy:parse"):
+            return json.loads(body) if body else None
+
+    def _dispatch(self, path: str, method: str, body: bytes,
+                  request_id: str, rec: dict) -> tuple[int, bytes]:
+        body_obj = self._parse_body(body, rec)
+        with rc.timed_phase(rc.PROXY_PHASE, "route", rec, span="proxy:route"):
+            handle = self._resolve_handle(path)
         if handle is None:
             return 404, json.dumps({"error": f"no route for {path}"}).encode()
         request = {
-            "path": path, "method": method,
-            "body": json.loads(body) if body else None,
+            "path": path, "method": method, "body": body_obj,
+            "request_id": request_id,
         }
         # replica-death failures retry on survivors, dropping the dead
         # replica from the router between attempts (see handle.call_sync)
-        result = handle.call_sync(
-            request, timeout_s=60.0,
-            _routing_hint=self._routing_hint(request))
+        with rc.timed_phase(rc.PROXY_PHASE, "handle", rec,
+                            span="proxy:handle"):
+            result = handle.call_sync(
+                request, timeout_s=60.0,
+                _routing_hint=self._routing_hint(request))
         return 200, json.dumps(result, default=str).encode()
 
     @staticmethod
@@ -187,13 +231,16 @@ class ProxyActor:
             handle = self._handles[dep] = DeploymentHandle(dep, self.controller)
         return handle
 
-    def _dispatch_stream(self, path: str, method: str, body: bytes):
-        handle = self._resolve_handle(path)
+    def _dispatch_stream(self, path: str, method: str, body: bytes,
+                         request_id: str, rec: dict):
+        body_obj = self._parse_body(body, rec)
+        with rc.timed_phase(rc.PROXY_PHASE, "route", rec, span="proxy:route"):
+            handle = self._resolve_handle(path)
         if handle is None:
             raise ValueError(f"no route for {path}")
         request = {
-            "path": path, "method": method,
-            "body": json.loads(body) if body else None,
+            "path": path, "method": method, "body": body_obj,
+            "request_id": request_id,
         }
         return handle.options(stream=True, method_name="stream_request").remote(
             request, _routing_hint=self._routing_hint(request))

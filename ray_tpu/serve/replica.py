@@ -25,6 +25,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import ray_tpu
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_tcp
+from ray_tpu.serve import request_context as _rc
+from ray_tpu.util import tracing as _tracing
 
 _replica_ctx = threading.local()
 
@@ -202,8 +204,17 @@ class ReplicaActor:
             else:
                 args, kwargs = tuple(msg.get("args") or ()), \
                     msg.get("kwargs") or {}
-            result = self.handle_request(
-                msg["method"], args, kwargs, msg.get("model_id"))
+            # the fast plane has no task spec: a sampled request's trace
+            # context rides the frame, activated here so user code (and
+            # nested handle calls) chain under the caller's span. Named
+            # distinctly from _record_phases' "replica:…" child (the
+            # actor plane's equivalent wrapper is the task span, named by
+            # method) so by-name span aggregation never double-counts.
+            with _tracing.activate(
+                    msg.get("trace_ctx"), kind="serve_rpc",
+                    name=f"rpc:{self.deployment_name}.{msg['method']}"):
+                result = self.handle_request(
+                    msg["method"], args, kwargs, msg.get("model_id"))
             reply = {"rid": rid, "ok": True, "error_text": None,
                      "result": result}
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
@@ -250,25 +261,34 @@ class ReplicaActor:
         # threads share one max_ongoing_requests budget
         with self._lock:
             self._pending += 1
+        t_q = time.perf_counter()
+        w_q = time.time()
         self._admission.acquire()
+        wait_s = time.perf_counter() - t_q
         with self._lock:
             self._pending -= 1
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()
+        ok = True
         try:
             fn = getattr(self.user, method, None)
             if fn is None:
                 raise AttributeError(
                     f"deployment {self.deployment_name} has no method {method!r}")
             return fn(*args, **kwargs)
+        except BaseException:
+            ok = False
+            raise
         finally:
             _replica_ctx.model_id = None
             with self._lock:
                 self._ongoing -= 1
             self._admission.release()
-            self._record_request(time.perf_counter() - t0)
+            exec_s = time.perf_counter() - t0
+            self._record_request(exec_s)
+            self._record_phases(method, w_q, wait_s, exec_s, ok)
 
     def _record_request(self, elapsed_s: float) -> None:
         try:
@@ -276,6 +296,21 @@ class ReplicaActor:
             self._m_latency.observe(elapsed_s * 1e3)
         except Exception:
             pass  # metrics must never fail a request
+
+    def _record_phases(self, method: str, wall_start: float, wait_s: float,
+                       exec_s: float, ok: bool) -> None:
+        """Queue-wait vs execute split (always-on histograms) + one child
+        span when this request's trace is active in the calling thread."""
+        try:
+            _rc.observe_phase(_rc.REPLICA_PHASE, "queue_wait", wait_s)
+            _rc.observe_phase(_rc.REPLICA_PHASE, "execute", exec_s)
+            _tracing.emit_child_span(
+                f"replica:{self.deployment_name}.{method}",
+                wall_start, wall_start + wait_s + exec_s, ok=ok,
+                deployment=self.deployment_name, replica=self.replica_tag,
+                queue_wait_s=round(wait_s, 6), execute_s=round(exec_s, 6))
+        except Exception:
+            pass  # instrumentation must never fail a request
 
     def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
                               model_id: str | None = None):
@@ -285,19 +320,26 @@ class ReplicaActor:
         The admission slot is held for the stream's whole lifetime."""
         with self._lock:
             self._pending += 1
+        t_q = time.perf_counter()
+        w_q = time.time()
         self._admission.acquire()
+        wait_s = time.perf_counter() - t_q
         with self._lock:
             self._pending -= 1
             self._ongoing += 1
             self._total += 1
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()
+        ok = True
         try:
             fn = getattr(self.user, method, None)
             if fn is None:
                 raise AttributeError(
                     f"deployment {self.deployment_name} has no method {method!r}")
             yield from fn(*args, **kwargs)
+        except BaseException:
+            ok = False
+            raise
         finally:
             _replica_ctx.model_id = None
             with self._lock:
@@ -305,7 +347,9 @@ class ReplicaActor:
             self._admission.release()
             # latency here is the full stream duration — that IS the
             # request's occupancy of the replica
-            self._record_request(time.perf_counter() - t0)
+            exec_s = time.perf_counter() - t0
+            self._record_request(exec_s)
+            self._record_phases(method, w_q, wait_s, exec_s, ok)
 
     def ongoing(self) -> int:
         return self._ongoing

@@ -1,0 +1,176 @@
+"""Per-request observability for the serve/PD data plane.
+
+One module owns the three request-path instruments (tentpole: end-to-end
+request tracing + phase attribution):
+
+- **phase histograms** — always-on, pre-bound (`Histogram.bind`, the
+  compiled-DAG fast path from PR 4) per (metric, phase) labelset, gated by
+  `RayConfig.serve_metrics`. One histogram family per layer so dashboards
+  can slice the serving hot path: proxy accept/parse/route/handle, handle
+  pick/RTT, replica queue-wait/execute, engine admission-wait/inter-token,
+  PD per-page transfer waits.
+- **request ids + span sampling** — every request entering the HTTP proxy
+  gets a 16-byte id; every Nth (`RayConfig.serve_span_sample_every`) opens
+  a `tracing.request_trace` root whose context propagates through handles
+  (fast-RPC frames and actor-plane specs alike) so one request id yields
+  one cross-process span tree.
+- **flight recorder** — request summaries appended to the in-process ring
+  (`task_events.record_request`), shipped to the GCS request log by the
+  worker flusher, surfaced as `ray_tpu trace list` / `GET /api/requests`.
+
+(reference: python/ray/util/tracing/tracing_helper.py:165 — trace context
+in every task/actor spec; serve's per-phase latency metrics in
+serve/_private/proxy.py + replica.py.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from ray_tpu._private.ray_config import RayConfig
+
+# histogram families (EXPECTED_METRICS in tools/graft_check — a rename
+# fails tier-1, not a scrape)
+PROXY_PHASE = "ray_tpu_serve_proxy_phase_seconds"
+HANDLE_PHASE = "ray_tpu_serve_handle_phase_seconds"
+REPLICA_PHASE = "ray_tpu_serve_replica_phase_seconds"
+ENGINE_PHASE = "ray_tpu_llm_engine_phase_seconds"
+PD_PHASE = "ray_tpu_llm_pd_phase_seconds"
+
+# sub-ms-resolving buckets: the serving phases this instruments range from
+# ~10 µs (router pick) to seconds (decode)
+_PHASE_BOUNDS = (0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_lock = threading.Lock()
+_hists: dict | None = None           # metric name -> live Histogram
+_bound: dict = {}                    # (metric, phase) -> BoundHistogram
+_sample_counter = itertools.count()
+
+
+def metrics_enabled() -> bool:
+    # read through the singleton each call: tests/benches toggle via
+    # RayConfig.reset(), and the read is trivial next to a request
+    return RayConfig.instance().serve_metrics
+
+
+def _make_histograms() -> dict:
+    from ray_tpu.util import metrics as met
+
+    kw = dict(boundaries=list(_PHASE_BOUNDS), tag_keys=("phase",))
+    return {
+        PROXY_PHASE: met.get_or_create(
+            met.Histogram, "ray_tpu_serve_proxy_phase_seconds",
+            "serve HTTP proxy request phases (accept = executor dispatch "
+            "wait, parse, route, handle = downstream RTT)", **kw),
+        HANDLE_PHASE: met.get_or_create(
+            met.Histogram, "ray_tpu_serve_handle_phase_seconds",
+            "DeploymentHandle phases (pick = router choice incl. "
+            "no-replica wait, rtt = submit->reply)", **kw),
+        REPLICA_PHASE: met.get_or_create(
+            met.Histogram, "ray_tpu_serve_replica_phase_seconds",
+            "replica request phases (queue_wait = admission-semaphore "
+            "wait, execute = user callable)", **kw),
+        ENGINE_PHASE: met.get_or_create(
+            met.Histogram, "ray_tpu_llm_engine_phase_seconds",
+            "engine request phases (admission_wait = submit->decode-slot "
+            "bind, inter_token = gap between emitted tokens)", **kw),
+        PD_PHASE: met.get_or_create(
+            met.Histogram, "ray_tpu_llm_pd_phase_seconds",
+            "PD transfer-plane phases (transfer_wait = reader-side "
+            "per-page channel wait, transfer_send_wait = sender-side "
+            "per-page backpressure wait)", **kw),
+    }
+
+
+def phase_observer(metric: str, phase: str):
+    """BoundHistogram for one (metric, phase) labelset, or None when serve
+    metrics are off. The cache is registry-aware: after a test clears the
+    metrics registry the stale bound objects are rebuilt instead of
+    recording into orphans no snapshot exports (the get_or_create
+    contract)."""
+    if not metrics_enabled():
+        return None
+    global _hists
+    from ray_tpu.util import metrics as met
+
+    b = _bound.get((metric, phase))
+    if b is not None and met._registry.get(metric) is b._hist:
+        return b
+    with _lock:
+        if _hists is None or met._registry.get(metric) is not _hists.get(metric):
+            _hists = _make_histograms()
+            _bound.clear()
+        b = _bound.get((metric, phase))
+        if b is None:
+            b = _bound[(metric, phase)] = _hists[metric].bind({"phase": phase})
+        return b
+
+
+def observe_phase(metric: str, phase: str, seconds: float,
+                  rec: dict | None = None) -> None:
+    """Record one phase duration into its pre-bound histogram (no-op when
+    serve metrics are off) and, when a flight-recorder entry is being
+    assembled, into its ``phases`` map."""
+    b = phase_observer(metric, phase)
+    if b is not None:
+        b.observe(seconds)
+    if rec is not None:
+        rec.setdefault("phases", {})[phase] = round(seconds, 6)
+
+
+@contextmanager
+def timed_phase(metric: str, phase: str, rec: dict | None = None, *,
+                span: str | None = None, **span_extra):
+    """Time a block as one phase: histogram observe + flight-recorder entry
+    + (when a trace is active and `span` is named) a child span."""
+    t0 = time.perf_counter()
+    w0 = time.time()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        observe_phase(metric, phase, dt, rec)
+        if span is not None:
+            from ray_tpu.util import tracing
+
+            tracing.emit_child_span(span, w0, w0 + dt, **span_extra)
+
+
+# ------------------------------------------------------------- request ids
+
+
+def new_request_id() -> str:
+    """16 random bytes hex — the same format as a trace id, because for
+    sampled requests it IS the trace id."""
+    return os.urandom(16).hex()
+
+
+def sample_request() -> bool:
+    """Every Nth request entering a proxy opens a full span tree
+    (`RayConfig.serve_span_sample_every`; 0 = never). Counter is
+    per-process; the first request is always sampled so short sessions
+    still yield a timeline."""
+    every = RayConfig.instance().serve_span_sample_every
+    if every <= 0 or not metrics_enabled():
+        return False
+    return next(_sample_counter) % every == 0
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def record_request(rec: dict, t0: float, *, status) -> None:
+    """Finalize one request's flight-recorder entry (duration + status) and
+    append it to the in-process ring. No-op when serve metrics are off."""
+    if not metrics_enabled():
+        return
+    from ray_tpu._private import task_events
+
+    rec["duration_s"] = round(time.perf_counter() - t0, 6)
+    rec["status"] = status
+    task_events.record_request(rec)

@@ -84,11 +84,37 @@ class PrefixTree:
                 stack.extend(node.children.values())
 
 
+def _route_counter():
+    from ray_tpu.util import metrics as met
+
+    return met.get_or_create(
+        met.Counter, "ray_tpu_serve_router_prefix_route_total",
+        "prefix-aware routing outcomes (sticky = prefix-matched replica "
+        "chosen, fallback = pow2 despite a hint, no_hint = no prompt)",
+        tag_keys=("outcome",))
+
+
 class PrefixAwarePolicy:
     """Replica-choice policy layered over the handle's in-flight counts."""
 
     def __init__(self):
         self.tree = PrefixTree()
+        self._counter = None  # resolved lazily; registry-staleness checked
+
+    def _count(self, outcome: str) -> None:
+        from ray_tpu.serve import request_context as rc
+        from ray_tpu.util import metrics as met
+
+        if not rc.metrics_enabled():
+            return
+        # cache the counter on the policy: _count runs on the router-pick
+        # hot path, where get_or_create's two global locks per pick would
+        # serialize concurrent proxies (same registry-aware staleness check
+        # as request_context.phase_observer)
+        c = self._counter
+        if c is None or met._registry.get(c.name) is not c:
+            c = self._counter = _route_counter()
+        c.inc(tags={"outcome": outcome})
 
     def pick(self, replicas: list[str], inflight: dict, hint: str | None,
              pow2_pick) -> str:
@@ -98,10 +124,12 @@ class PrefixAwarePolicy:
                 least = min((inflight.get(r, 0) for r in replicas), default=0)
                 if inflight.get(sticky, 0) <= least + PREFIX_IMBALANCE_SLACK:
                     self.tree.insert(hint, sticky)
+                    self._count("sticky")
                     return sticky
         choice = pow2_pick()
         if hint:
             self.tree.insert(hint, choice)
+        self._count("fallback" if hint else "no_hint")
         return choice
 
     def on_replica_dead(self, replica: str) -> None:

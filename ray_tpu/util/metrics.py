@@ -37,7 +37,8 @@ class Metric:
     kind = "base"
 
     def __init__(self, name: str, description: str = "",
-                 tag_keys: Optional[Tuple[str, ...]] = None):
+                 tag_keys: Optional[Tuple[str, ...]] = None, *,
+                 register: bool = True):
         if not name or not name.replace("_", "").replace(":", "").isalnum():
             raise ValueError(f"invalid metric name: {name!r}")
         self.name = name
@@ -47,6 +48,13 @@ class Metric:
         # series: tag-tuple -> value (float for counter/gauge, list for hist)
         self._series: Dict[Tuple, object] = {}
         self._series_lock = threading.Lock()
+        if not register:
+            # unregistered metric: for host processes (e.g. the GCS) that
+            # export through their own channel instead of the CoreWorker
+            # flusher — keeping it out of the process registry prevents a
+            # co-located driver's flusher from shipping the same series a
+            # second time under a different source id
+            return
         with _lock:
             prev = _registry.get(name)
             if prev is not None and prev.kind != self.kind:
@@ -115,8 +123,9 @@ class Gauge(Metric):
 class Histogram(Metric):
     kind = "histogram"
 
-    def __init__(self, name, description="", boundaries=None, tag_keys=None):
-        super().__init__(name, description, tag_keys)
+    def __init__(self, name, description="", boundaries=None, tag_keys=None,
+                 *, register: bool = True):
+        super().__init__(name, description, tag_keys, register=register)
         self.boundaries = tuple(boundaries or DEFAULT_BUCKETS)
 
     def observe(self, value: float, tags: Optional[dict] = None) -> None:

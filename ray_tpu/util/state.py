@@ -191,6 +191,25 @@ def summarize_dag(dag_id: str) -> Optional[dict]:
     return None
 
 
+def list_requests(*, filters: Optional[List[Tuple]] = None,
+                  limit: int = 1000) -> list:
+    """The serve flight-recorder log: recent request summaries (request_id,
+    path, component, duration_s, per-phase seconds) shipped to the GCS by
+    every serving process. Answers "what did the last N requests cost"
+    without span-sampling luck."""
+    rows = _worker().rpc({"type": "list_requests"}).get("requests", [])
+    return _apply(rows, filters, limit)
+
+
+def get_request_trace(request_id: str) -> Optional[dict]:
+    """The sampled span tree for one serve request (trace id == request
+    id), or None when that request wasn't sampled — fall back to
+    :func:`list_requests` for its flight-recorder summary."""
+    from ray_tpu.util import tracing
+
+    return tracing.get_trace(request_id)
+
+
 def get_actor(actor_id: str) -> Optional[dict]:
     for row in list_actors(filters=[("actor_id", "=", actor_id)], limit=1):
         return row
@@ -204,8 +223,10 @@ def get_node(node_id: str) -> Optional[dict]:
 
 
 __all__ = [
-    "get_actor", "get_node", "list_actors", "list_compiled_dags",
+    "get_actor", "get_node", "get_request_trace", "list_actors",
+    "list_compiled_dags",
     "list_jobs", "list_nodes", "list_objects", "list_placement_groups",
+    "list_requests",
     "list_tasks", "list_workers", "summarize_dag", "summarize_dag_metrics",
     "summarize_task_events", "summarize_tasks",
 ]

@@ -53,14 +53,19 @@ def inject() -> dict | None:
     """Context dict to stamp into an outgoing spec (None = no active trace).
 
     Mirrors _DictPropagator.inject_current_context (tracing_helper.py:168):
-    the CURRENT span becomes the remote task's parent.
+    the CURRENT span becomes the remote task's parent. Gated on an ACTIVE
+    context rather than the global `enable_tracing` flag: a context only
+    exists when a root was opened — by :func:`trace` (which checks the
+    flag) or by per-request sampling (:func:`request_trace`, gated by
+    `serve_span_sample_every`) — so presence IS the sampling decision.
     """
-    if not enabled():
-        return None
     ctx = _current.get()
     if ctx is None:
         return None
-    return {"trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
+    out = {"trace_id": ctx["trace_id"], "parent_span_id": ctx["span_id"]}
+    if "request_id" in ctx:
+        out["request_id"] = ctx["request_id"]
+    return out
 
 
 def to_traceparent(ctx: dict) -> str:
@@ -86,16 +91,24 @@ def trace(name: str = "trace"):
                    start=t0, end=time.time(), ok=True)
 
 
+def _child_ctx(trace_ctx: dict) -> dict:
+    ctx = {"trace_id": trace_ctx["trace_id"], "span_id": _new_id(8)}
+    if "request_id" in trace_ctx:
+        ctx["request_id"] = trace_ctx["request_id"]
+    return ctx
+
+
 @contextmanager
 def activate(trace_ctx: dict | None, *, name: str, task_id: str = "",
              kind: str = "task"):
     """Executor-side: run user code under a fresh child span of the
     propagated context. Emits the span on exit (ok=False if user code
-    raised). No-op when the spec carries no context."""
-    if not enabled() or not trace_ctx:
+    raised). No-op when the spec carries no context (the context's
+    presence already encodes the root's sampling decision — see inject)."""
+    if not trace_ctx:
         yield
         return
-    ctx = {"trace_id": trace_ctx["trace_id"], "span_id": _new_id(8)}
+    ctx = _child_ctx(trace_ctx)
     tok = _current.set(ctx)
     t0 = time.time()
     ok = True
@@ -112,23 +125,28 @@ def activate(trace_ctx: dict | None, *, name: str, task_id: str = "",
 
 
 def _emit_span(*, name: str, kind: str, ctx: dict, parent_span_id: str,
-               start: float, end: float, ok: bool, task_id: str = "") -> None:
+               start: float, end: float, ok: bool, task_id: str = "",
+               **extra) -> None:
     from ray_tpu._private import task_events
 
+    if "request_id" in ctx:
+        # serve request spans carry the request id so to_chrome_trace can
+        # group the whole cross-process tree under one `req:<id>` row
+        extra.setdefault("request_id", ctx["request_id"])
     task_events.emit(
         "trace:span", task_id=task_id, name=name, start=start, end=end,
         trace_id=ctx["trace_id"], span_id=ctx["span_id"],
-        parent_span_id=parent_span_id, span_kind=kind, ok=ok)
+        parent_span_id=parent_span_id, span_kind=kind, ok=ok, **extra)
 
 
 def begin_task_span(trace_ctx: dict | None):
     """Non-context-manager form of :func:`activate` for executors that
     already own a try/finally (worker.execute_spec). Returns an opaque
-    handle for :func:`end_task_span`, or None when tracing is off / the
-    spec carries no context."""
-    if not enabled() or not trace_ctx:
+    handle for :func:`end_task_span`, or None when the spec carries no
+    context (no root was opened upstream, so nothing was sampled)."""
+    if not trace_ctx:
         return None
-    ctx = {"trace_id": trace_ctx["trace_id"], "span_id": _new_id(8)}
+    ctx = _child_ctx(trace_ctx)
     tok = _current.set(ctx)
     return (tok, ctx, trace_ctx.get("parent_span_id", ""), time.time())
 
@@ -141,6 +159,88 @@ def end_task_span(handle, *, name: str, task_id: str, kind: str,
     _current.reset(tok)
     _emit_span(name=name, kind=kind, ctx=ctx, parent_span_id=parent,
                start=t0, end=time.time(), ok=ok, task_id=task_id)
+
+
+# ------------------------------------------------------- serve request spans
+
+
+def begin_request_trace(request_id: str, **extra) -> list:
+    """Open the root span for one SAMPLED serve request. The trace id IS
+    the request id (both are 16 random bytes hex), so `ray_tpu trace show
+    <request_id>` needs no lookup table, and every span in the tree carries
+    ``request_id`` for per-request chrome-trace rows. Unlike :func:`trace`
+    this ignores `enable_tracing`: the caller (the HTTP proxy) already made
+    the sampling decision via `serve_span_sample_every`.
+
+    Split begin/detach/finish (instead of one context manager) because a
+    STREAMING request outlives its dispatch thread: the proxy detaches the
+    context when dispatch returns the generator, and finishes the root —
+    with the real end time — when the stream body completes."""
+    ctx = {"trace_id": request_id, "span_id": _new_id(8),
+           "request_id": request_id}
+    return [_current.set(ctx), ctx, time.time(), extra]
+
+
+def detach_request_trace(handle) -> None:
+    """Deactivate the request context on the dispatch thread (idempotent).
+    The root span is NOT emitted yet — finish_request_trace does that."""
+    if handle and handle[0] is not None:
+        _current.reset(handle[0])
+        handle[0] = None
+
+
+def finish_request_trace(handle, *, ok: bool = True,
+                         name: str = "serve:request") -> None:
+    """Emit the root span with the request's real end time. Safe from any
+    thread (detaches first if the dispatch thread never did)."""
+    if not handle:
+        return
+    detach_request_trace(handle)
+    _tok, ctx, t0, extra = handle
+    _emit_span(name=name, kind="root", ctx=ctx, parent_span_id="",
+               start=t0, end=time.time(), ok=ok, **extra)
+
+
+@contextmanager
+def request_trace(request_id: str, *, name: str = "serve:request", **extra):
+    """Context-manager form of begin/finish for same-thread request scopes."""
+    handle = begin_request_trace(request_id, **extra)
+    ok = True
+    try:
+        yield handle[1]
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        finish_request_trace(handle, ok=ok, name=name)
+
+
+def emit_span_for(parent_ctx: dict | None, name: str, start: float,
+                  end: float, *, ok: bool = True, kind: str = "phase",
+                  **extra) -> None:
+    """Emit a completed child span under an EXPLICIT parent context —
+    for phase spans measured with their own start/end stamps, and for
+    helper threads (e.g. the KV sender) that hold a captured context
+    instead of the contextvar. Accepts both an ACTIVE context (its
+    span_id is the parent) and an inject()ed one (parent_span_id already
+    names the parent). No-op without a parent."""
+    if not parent_ctx or not parent_ctx.get("trace_id"):
+        return
+    parent = (parent_ctx.get("span_id")
+              or parent_ctx.get("parent_span_id", ""))
+    _emit_span(name=name, kind=kind, ctx=_child_ctx(parent_ctx),
+               parent_span_id=parent, start=start, end=end,
+               ok=ok, **extra)
+
+
+def emit_child_span(name: str, start: float, end: float, *, ok: bool = True,
+                    **extra) -> None:
+    """emit_span_for under the ACTIVE context (no-op when no trace is
+    active in this task/thread) — the cheap per-phase emission guard on
+    the serving path: one contextvar read when unsampled."""
+    ctx = _current.get()
+    if ctx is not None:
+        emit_span_for(ctx, name, start, end, ok=ok, **extra)
 
 
 # --------------------------------------------------------------- assembly

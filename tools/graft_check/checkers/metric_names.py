@@ -57,6 +57,20 @@ EXPECTED_METRICS = (
     "ray_tpu_object_store_used",
     "ray_tpu_object_store_capacity",
     "ray_tpu_object_store_evictions_total",
+    # serve/PD request-path phase attribution (serve/request_context.py):
+    # always-on pre-bound phase histograms for the serving hot path —
+    # proxy accept/parse/route/handle, handle pick/RTT, replica
+    # queue-wait/execute, engine admission-wait/inter-token, PD per-page
+    # transfer waits — plus prefix-router outcomes and the GCS's
+    # server-side per-RPC-type latency histogram (gcs.py, unregistered —
+    # folded into metrics_snapshot under the "gcs" source)
+    "ray_tpu_serve_proxy_phase_seconds",
+    "ray_tpu_serve_handle_phase_seconds",
+    "ray_tpu_serve_replica_phase_seconds",
+    "ray_tpu_llm_engine_phase_seconds",
+    "ray_tpu_llm_pd_phase_seconds",
+    "ray_tpu_serve_router_prefix_route_total",
+    "ray_tpu_gcs_rpc_seconds",
 )
 
 
