@@ -3,6 +3,10 @@
 Runs, sequentially (one process owns the chip at a time, each harness
 already hardened with self-terminating TPU children):
 
+  0. benchmarks/collective_bench.py  -> MICROBENCH.json `collective_*`
+                                        (host ring: fp32 vs int8_block
+                                        bytes-on-wire + wall time; no chip
+                                        needed, runs even on a wedged pool)
   1. bench.py                    -> BENCH (train tokens/s + MFU) + LKG
   2. benchmarks/llm_serving_bench.py -> LLM_BENCH.json (TTFT/decode/agg)
   3. benchmarks/llm_load_bench.py    -> LLM_BENCH.json `pd` section
@@ -41,6 +45,11 @@ def run(script: str, budget_env: tuple[str, str]) -> dict | None:
 
 
 def main() -> int:
+    # host-plane collective bench first: it needs no chip (the ring moves
+    # host tensors), so it must not be hostage to a wedged pool — and its
+    # children are pinned to CPU so a wedged pool can't block jax import
+    coll = run("benchmarks/collective_bench.py", ("JAX_PLATFORMS", "cpu"))
+    print("collective:", ((coll or {}).get("worlds") or {}))
     out = run("bench.py", ("RAY_TPU_BENCH_BUDGET_S", "540"))
     backend = ((out or {}).get("detail") or {}).get("backend")
     print("bench:", backend, (out or {}).get("value"))

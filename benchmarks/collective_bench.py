@@ -1,6 +1,13 @@
-"""Host-collective bench: ring allreduce time + per-rank bytes vs world
-size. The ring moves ~2*(W-1)/W * N bytes per rank regardless of W; the
-old rendezvous-star moved W*N through one actor.
+"""Host-collective bench: ring allreduce time + bytes-on-wire vs world
+size and wire compression. The ring moves ~2*(W-1)/W * N bytes per rank
+regardless of W (the old rendezvous-star moved W*N through one actor);
+compression="int8_block" (EQuARX-style, quantization.py) cuts that ~3.9x
+again. Bytes are MEASURED from ray_tpu_collective_bytes_total inside the
+worker, not computed from the formula.
+
+Rows land in MICROBENCH.json as `collective_*` (merge-preserving, like
+the other benches) and the last stdout line is a one-object summary for
+capture_tpu_all.py.
 
 Usage: python benchmarks/collective_bench.py [mb] [worlds...]
 """
@@ -31,14 +38,53 @@ class Bench:
         self.rank = rank
         self.g = group_name
 
-    def run(self, n_float32, iters=3):
+    def run(self, n_float32, compression, iters=3):
+        from ray_tpu.util import metrics as met
+
         x = np.ones((n_float32,), np.float32) * (self.rank + 1)
-        self.col.allreduce(x, group_name=self.g, timeout=300.0)  # warm
+        self.col.allreduce(x, group_name=self.g, timeout=300.0,
+                           compression=compression)  # warm
+        counter = met.get_or_create(met.Counter,
+                                    "ray_tpu_collective_bytes_total")
+        tag = ("compression", compression or "none")
+        before = sum(v for tags, v in counter._snapshot_series()
+                     if tag in tags)
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = self.col.allreduce(x, group_name=self.g, timeout=300.0)
+            out = self.col.allreduce(x, group_name=self.g, timeout=300.0,
+                                     compression=compression)
         dt = (time.perf_counter() - t0) / iters
-        return dt, float(out[0])
+        after = sum(v for tags, v in counter._snapshot_series()
+                    if tag in tags)
+        return dt, float(out[0]), (after - before) / iters
+
+
+def bench_world(w: int, n: int, mb: float) -> dict:
+    actors = [Bench.remote() for _ in range(w)]
+    col_mod.create_collective_group(actors, w, list(range(w)),
+                                    group_name=f"bench{w}")
+    out = {"world": w, "tensor_mb": mb}
+    for compression in (None, "int8_block"):
+        outs = ray_tpu.get([a.run.remote(n, compression) for a in actors],
+                           timeout=600)
+        dt = max(o[0] for o in outs)
+        expect = w * (w + 1) / 2
+        if compression is None:
+            assert all(o[1] == expect for o in outs), outs
+        else:
+            assert all(abs(o[1] - expect) < 0.05 * expect for o in outs), outs
+        wire_mb = max(o[2] for o in outs) / (1 << 20)  # per-rank, measured
+        mode = compression or "fp32"
+        out[mode] = {
+            "sec_per_allreduce": round(dt, 4),
+            "per_rank_wire_mb": round(wire_mb, 3),
+            "agg_bandwidth_mb_s": round(w * wire_mb / dt, 1),
+        }
+    out["wire_ratio"] = round(out["fp32"]["per_rank_wire_mb"]
+                              / out["int8_block"]["per_rank_wire_mb"], 2)
+    for a in actors:
+        ray_tpu.kill(a)
+    return out
 
 
 def main():
@@ -46,22 +92,41 @@ def main():
     worlds = [int(w) for w in sys.argv[2:]] or [2, 4]
     n = int(mb * (1 << 20) / 4)
     ray_tpu.init(num_cpus=32, num_workers=2, max_workers=12)
+    rows, results = [], []
     for w in worlds:
-        actors = [Bench.remote() for _ in range(w)]
-        col_mod.create_collective_group(actors, w, list(range(w)),
-                                        group_name=f"bench{w}")
-        outs = ray_tpu.get([a.run.remote(n) for a in actors], timeout=600)
-        dt = max(o[0] for o in outs)
-        expect = w * (w + 1) / 2
-        assert all(o[1] == expect for o in outs), outs
-        per_rank_mb = 2 * (w - 1) / w * mb
-        print(json.dumps({
-            "world": w, "tensor_mb": mb, "sec_per_allreduce": round(dt, 3),
-            "per_rank_transfer_mb": round(per_rank_mb, 2),
-            "agg_bandwidth_mb_s": round(w * per_rank_mb / dt, 1)}))
-        for a in actors:
-            ray_tpu.kill(a)
+        r = bench_world(w, n, mb)
+        results.append(r)
+        print(json.dumps(r))
+        for mode in ("fp32", "int8_block"):
+            m = r[mode]
+            prefix = f"collective_allreduce_w{w}_{int(mb)}mb_{mode}"
+            rows += [
+                {"name": prefix, "ops_per_s": None, "value": None,
+                 "us_per_op": round(m["sec_per_allreduce"] * 1e6, 1)},
+                {"name": prefix + "_wire_mb", "ops_per_s": None,
+                 "value": m["per_rank_wire_mb"], "us_per_op": None},
+                {"name": prefix + "_agg_mb_s",
+                 "ops_per_s": m["agg_bandwidth_mb_s"], "value": None,
+                 "us_per_op": None},
+            ]
+        rows.append({"name": f"collective_allreduce_w{w}_{int(mb)}mb"
+                             "_int8_wire_ratio",
+                     "ops_per_s": None, "value": r["wire_ratio"],
+                     "us_per_op": None})
     ray_tpu.shutdown()
+
+    from ray_tpu._private.ray_perf import merge_microbench
+
+    merge_microbench(os.path.join(os.path.dirname(__file__), "..",
+                                  "MICROBENCH.json"), rows)
+    # one-line summary for capture_tpu_all.py (last stdout JSON line)
+    print(json.dumps({
+        "bench": "collective", "tensor_mb": mb,
+        "worlds": {str(r["world"]): {
+            "fp32_sec": r["fp32"]["sec_per_allreduce"],
+            "int8_sec": r["int8_block"]["sec_per_allreduce"],
+            "wire_ratio": r["wire_ratio"]} for r in results},
+    }))
 
 
 if __name__ == "__main__":

@@ -25,6 +25,13 @@ from ray_tpu.train.session import (
     get_context,
     get_dataset_shard,
     report,
+    report_opt_state,
+)
+from ray_tpu.train import zero
+from ray_tpu.train.zero import (
+    ZeroShardedOptimizer,
+    make_zero_train_step,
+    match_partition_rules,
 )
 from ray_tpu.train.spmd import (
     init_sharded,
@@ -62,5 +69,10 @@ __all__ = [
     "make_sp_pp_train_step",
     "make_train_step",
     "report",
+    "report_opt_state",
     "storage",
+    "zero",
+    "ZeroShardedOptimizer",
+    "make_zero_train_step",
+    "match_partition_rules",
 ]

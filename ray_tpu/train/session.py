@@ -197,6 +197,30 @@ def get_dataset_shard(name: str = "train"):
     return get_session().datasets.get(name)
 
 
+def report_opt_state(opt_state, rank: int | None = None) -> int:
+    """Record this worker's optimizer-state footprint as the
+    ``ray_tpu_train_opt_state_bytes`` gauge (per-rank tag), using
+    train/optim.py's `optimizer_state_bytes`. The CoreWorker flusher ships
+    it into the GCS aggregate, so a ZeRO-sharded run's ~W x smaller
+    per-replica state is observable in `metrics_snapshot` — not just in
+    the bench. Callable from any train fn (and called automatically by
+    zero.ZeroShardedOptimizer); outside a session, pass `rank`.
+    Returns the byte count."""
+    from ray_tpu.train.optim import optimizer_state_bytes
+    from ray_tpu.util import metrics as met
+
+    nbytes = (opt_state if isinstance(opt_state, int)
+              else optimizer_state_bytes(opt_state))
+    if rank is None:
+        rank = _session.rank if _session is not None else 0
+    gauge = met.get_or_create(
+        met.Gauge, "ray_tpu_train_opt_state_bytes",
+        "Optimizer-state bytes held by this training worker.",
+        tag_keys=("rank",))
+    gauge.set(nbytes, {"rank": rank})
+    return nbytes
+
+
 def _next_coll_key(s: TrainSession, key: str) -> str:
     # every rank calls collectives in the same program order, so a per-key
     # sequence number keeps repeated calls within one iteration distinct

@@ -30,14 +30,39 @@ def make_train_step(
     *,
     batch_spec: P = P(("dp", "fsdp")),
     donate: bool = True,
+    partition_rules=None,       # [(regex, PartitionSpec)] over param paths
+    params_template=None,       # params (or their eval_shape) for the rules
+    zero_axis: str | None = None,  # ZeRO-1: shard opt state over this axis
 ):
     """Returns (step, shard_params, batch_sharding).
 
     step(params, opt_state, batch) -> (params, opt_state, loss); all
     collectives (grad psum over dp, fsdp all-gathers/reduce-scatters, tp
     activation collectives) are inserted by XLA from the shardings.
-    """
-    p_shardings = param_shardings(mesh, logical_axes, DEFAULT_RULES)
+
+    Two ways to name the param shardings: `logical_axes` (pytree of
+    logical-dimension tuples, mesh.py rules) or `partition_rules` + a
+    `params_template` (regex over '/'-joined param paths — zero.py's
+    `match_partition_rules`). With `zero_axis` (requires the rules form)
+    the jitted step additionally pins the optimizer state to ZeRO-1
+    shardings over that axis, so XLA lowers reduce-scatter -> 1/W update
+    -> all-gather natively (see train/zero.py; init the state with
+    `zero.make_zero_train_step`'s init_opt_state to never materialize it
+    unsharded)."""
+    if partition_rules is not None:
+        if params_template is None:
+            raise ValueError("partition_rules needs params_template "
+                             "(a params pytree or its eval_shape)")
+        from ray_tpu.train import zero as zero_mod
+
+        p_shardings = zero_mod.param_shardings_from_rules(
+            partition_rules, params_template, mesh)
+    else:
+        if zero_axis is not None:
+            raise ValueError(
+                "zero_axis needs partition_rules + params_template: the "
+                "optimizer-state shardings are derived from the rules")
+        p_shardings = param_shardings(mesh, logical_axes, DEFAULT_RULES)
     batch_sharding = NamedSharding(mesh, batch_spec)
 
     def step(params, opt_state, batch):
@@ -46,7 +71,14 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    jit_step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    jit_kwargs: dict = {"donate_argnums": (0, 1) if donate else ()}
+    if zero_axis is not None:
+        opt_shardings = zero_mod.zero_opt_shardings(
+            optimizer, params_template, partition_rules, mesh,
+            axis=zero_axis)
+        jit_kwargs["out_shardings"] = (p_shardings, opt_shardings,
+                                       NamedSharding(mesh, P()))
+    jit_step = jax.jit(step, **jit_kwargs)
 
     def shard_params(params):
         return jax.device_put(params, p_shardings)
@@ -54,10 +86,20 @@ def make_train_step(
     return jit_step, shard_params, batch_sharding
 
 
-def init_sharded(init_fn: Callable, logical_axes, mesh: Mesh, *args):
+def init_sharded(init_fn: Callable, logical_axes, mesh: Mesh, *args,
+                 partition_rules=None):
     """Initialize params directly with their target shardings (no host→device
-    reshard of the full tree; XLA initializes each shard in place)."""
-    shardings = param_shardings(mesh, logical_axes, DEFAULT_RULES)
+    reshard of the full tree; XLA initializes each shard in place).
+    `partition_rules` ([(regex, PartitionSpec)], zero.py idiom) replaces
+    `logical_axes` when given — shapes come from eval_shape of init_fn."""
+    if partition_rules is not None:
+        from ray_tpu.train import zero as zero_mod
+
+        template = jax.eval_shape(init_fn, *args)
+        shardings = zero_mod.param_shardings_from_rules(
+            partition_rules, template, mesh)
+    else:
+        shardings = param_shardings(mesh, logical_axes, DEFAULT_RULES)
     return jax.jit(init_fn, out_shardings=shardings)(*args)
 
 

@@ -21,12 +21,59 @@ each operation's rendezvous.)
 from __future__ import annotations
 
 import time
+from typing import NamedTuple
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.util.collective import quantization
 
 _groups: dict[str, "_GroupHandle"] = {}  # group_name → this process's handle
+
+# Opt-in wire compressions for the reduction collectives. "int8_block" is
+# EQuARX-style per-block-absmax int8 with error feedback (quantization.py):
+# ~3.9x fewer bytes per hop, residual carried per (group, ef_key, hop site)
+# so repeated calls telescope instead of drifting.
+COMPRESSIONS = ("int8_block",)
+
+
+def _check_compression(compression: str | None, op: str,
+                       dtype: np.dtype) -> None:
+    if compression is None:
+        return
+    if compression not in COMPRESSIONS:
+        raise ValueError(f"unknown compression {compression!r}; "
+                         f"supported: {COMPRESSIONS}")
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"compression={compression!r} only composes with op in "
+            "('sum', 'mean'): quantization error feedback corrects a "
+            f"telescoping sum, not order statistics like {op!r}")
+    if not np.issubdtype(dtype, np.floating):
+        raise ValueError(
+            f"compression={compression!r} needs a floating dtype, got {dtype}")
+
+
+def _coll_metrics():
+    from ray_tpu.util import metrics as met
+
+    c = met.get_or_create(
+        met.Counter, "ray_tpu_collective_bytes_total",
+        "Per-rank payload bytes put on the wire by host-plane collectives.",
+        tag_keys=("op", "compression"))
+    h = met.get_or_create(
+        met.Histogram, "ray_tpu_collective_seconds",
+        "Wall time of host-plane collective calls.",
+        tag_keys=("op", "compression"))
+    return c, h
+
+
+def _record_collective(op_kind: str, compression: str | None, nbytes: int,
+                       seconds: float) -> None:
+    counter, hist = _coll_metrics()
+    tags = {"op": op_kind, "compression": compression or "none"}
+    counter.inc(nbytes, tags)
+    hist.observe(seconds, tags)
 
 
 @ray_tpu.remote
@@ -121,6 +168,7 @@ def create_collective_group(actors: list, world_size: int, ranks: list[int], *,
 
 def destroy_collective_group(group_name: str = "default") -> None:
     g = _groups.pop(group_name, None)
+    quantization.release_group_residuals(group_name)
     if g is not None and g.rank == 0:
         try:
             ray_tpu.kill(g.actor)
@@ -130,6 +178,10 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
 def get_rank(group_name: str = "default") -> int:
     return _groups[group_name].rank
+
+
+def get_world_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
 
 
 def _group(group_name: str) -> _GroupHandle:
@@ -197,80 +249,154 @@ def _ring_recv(g: _GroupHandle, src: int, tag, timeout: float) -> np.ndarray:
 
 
 def _ring_reduce_phase(g: _GroupHandle, buffers: list, op: str, seq: int,
-                       keep: list, timeout: float) -> None:
+                       keep: list, timeout: float, *,
+                       compression: str | None = None,
+                       ef_key: str | None = None,
+                       sent_bytes: list | None = None) -> None:
     """In-place ring reduce-scatter over `buffers` (one chunk per rank):
-    after W-1 steps, buffers[(rank+1) % W] holds the full reduction."""
+    after W-1 steps, buffers[(rank+1) % W] holds the full reduction.
+
+    With compression, every hop's partial sum is quantized before the send
+    (its own error-feedback site, keyed by step index — stable across
+    calls) and dequantized+combined on receive."""
     W, rank = g.world_size, g.rank
     nxt, prv = (rank + 1) % W, (rank - 1) % W
     for s in range(W - 1):
         si = (rank - s) % W
         ri = (rank - s - 1) % W
-        ref = ray_tpu.put(buffers[si])
+        payload = buffers[si]
+        if compression == "int8_block":
+            payload = quantization.quantize_with_feedback(
+                payload, g.name, ef_key or "", f"rs:{s}")
+        if sent_bytes is not None:
+            sent_bytes[0] += quantization.wire_bytes(payload)
+        ref = ray_tpu.put(payload)
         keep.append(ref)  # alive until the end-of-op barrier
         _ring_send(g, nxt, ("__ring__", seq, s), ref, timeout)
         inc = _ring_recv(g, prv, ("__ring__", seq, s), timeout)
+        if isinstance(inc, quantization.QuantizedChunk):
+            inc = quantization.dequantize_block(inc)
         buffers[ri] = _combine(buffers[ri], inc, op)
 
 
-def _ring_allreduce(g: _GroupHandle, tensor: np.ndarray, op: str,
-                    timeout: float) -> np.ndarray:
-    """Chunked ring allreduce: reduce-scatter then allgather, payloads by
-    ref through the object plane (reference: the standard 2(W-1)-step ring,
-    nccl_collective_group.py:121)."""
-    W, rank = g.world_size, g.rank
-    nxt, prv = (rank + 1) % W, (rank - 1) % W
+def _flat_chunks(tensor: np.ndarray, W: int, op: str):
+    """Flatten + pad to W equal chunks (the ring layout). Returns
+    (chunk list, original element count, chunk size)."""
     flat = np.ascontiguousarray(tensor).ravel()
     n = flat.size
     per = -(-n // W)
     padded = np.resize(flat, per * W) if per * W != n else flat
     if per * W != n:
         padded[n:] = 0 if op in ("sum", "mean") else flat[-1]
-    buffers = [padded[i * per:(i + 1) * per].copy() for i in range(W)]
+    return [padded[i * per:(i + 1) * per].copy() for i in range(W)], n, per
+
+
+def _ring_allreduce(g: _GroupHandle, tensor: np.ndarray, op: str,
+                    timeout: float, compression: str | None = None,
+                    ef_key: str | None = None) -> tuple[np.ndarray, int]:
+    """Chunked ring allreduce: reduce-scatter then allgather, payloads by
+    ref through the object plane (reference: the standard 2(W-1)-step ring,
+    nccl_collective_group.py:121). Returns (result, per-rank wire bytes).
+
+    Compressed mode quantizes each reduce-phase hop (with per-hop error
+    feedback) and each rank's fully-reduced chunk ONCE at allgather
+    injection; forwarding ranks relay the quantized payload verbatim, and
+    the injecting rank adopts its own dequantized copy — so every rank
+    reconstructs bit-identical values and replicas cannot diverge."""
+    W, rank = g.world_size, g.rank
+    nxt, prv = (rank + 1) % W, (rank - 1) % W
+    buffers, n, per = _flat_chunks(tensor, W, op)
     keep: list = []
+    sent = [0]
     seq = g.next_seq()
-    _ring_reduce_phase(g, buffers, op, seq, keep, timeout)
+    _ring_reduce_phase(g, buffers, op, seq, keep, timeout,
+                       compression=compression, ef_key=ef_key,
+                       sent_bytes=sent)
     # allgather phase: circulate the reduced chunks
     seq2 = g.next_seq()
-    for s in range(W - 1):
-        si = (rank + 1 - s) % W
-        ri = (rank - s) % W
-        ref = ray_tpu.put(buffers[si])
-        keep.append(ref)
-        _ring_send(g, nxt, ("__ring__", seq2, s), ref, timeout)
-        buffers[ri] = _ring_recv(g, prv, ("__ring__", seq2, s), timeout)
+    if compression == "int8_block":
+        own = (rank + 1) % W
+        carry = quantization.quantize_with_feedback(
+            buffers[own], g.name, ef_key or "", "ag")
+        buffers[own] = quantization.dequantize_block(carry)
+        for s in range(W - 1):
+            ri = (rank - s) % W
+            sent[0] += carry.wire_bytes
+            ref = ray_tpu.put(carry)
+            keep.append(ref)
+            _ring_send(g, nxt, ("__ring__", seq2, s), ref, timeout)
+            carry = _ring_recv(g, prv, ("__ring__", seq2, s), timeout)
+            buffers[ri] = quantization.dequantize_block(carry)
+    else:
+        for s in range(W - 1):
+            si = (rank + 1 - s) % W
+            ri = (rank - s) % W
+            sent[0] += buffers[si].nbytes
+            ref = ray_tpu.put(buffers[si])
+            keep.append(ref)
+            _ring_send(g, nxt, ("__ring__", seq2, s), ref, timeout)
+            buffers[ri] = _ring_recv(g, prv, ("__ring__", seq2, s), timeout)
     _exchange(g, None, timeout)  # all pulls done before refs drop
     keep.clear()
     out = np.concatenate(buffers)[:n].reshape(tensor.shape)
     if op == "mean":
         out = out / W
-    return out.astype(tensor.dtype) if op != "mean" else out
+    return (out.astype(tensor.dtype) if op != "mean" else out), sent[0]
+
+
+def _default_ef_key(kind: str, op: str, tensor: np.ndarray) -> str:
+    # stable per (call kind, op, shape, dtype): the collective contract
+    # already requires every rank to issue the same ops in the same order
+    # with the same shapes, so this names "the same allreduce" across
+    # iterations. Callers mixing several same-shaped tensors per iteration
+    # pass an explicit ef_key to keep their residuals apart.
+    return f"{kind}:{op}:{tensor.shape}:{tensor.dtype}"
 
 
 def allreduce(tensor: np.ndarray, *, op: str = "sum",
-              group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
+              group_name: str = "default", timeout: float = 60.0,
+              compression: str | None = None,
+              ef_key: str | None = None) -> np.ndarray:
     """(reference: collective.py allreduce:325.)
 
     Every rank MUST pass the same shape and dtype (the standard collective
     contract — NCCL requires it too): the ring-vs-star choice is made from
     the local tensor's byte size, and uniform inputs guarantee all ranks
-    choose the same path."""
+    choose the same path.
+
+    compression="int8_block" (sum/mean, float dtypes) rides the ring
+    regardless of size, block-quantizing every hop with per-site error
+    feedback keyed by `ef_key` (defaults to op+shape+dtype)."""
     g = _group(group_name)
     tensor = np.asarray(tensor)
-    if tensor.nbytes >= RING_MIN_BYTES and g.world_size > 1:
-        return _ring_allreduce(g, tensor, op, timeout)
+    _check_compression(compression, op, tensor.dtype)
+    t0 = time.perf_counter()
+    if g.world_size > 1 and (compression is not None
+                             or tensor.nbytes >= RING_MIN_BYTES):
+        if compression is not None and ef_key is None:
+            ef_key = _default_ef_key("allreduce", op, tensor)
+        out, sent = _ring_allreduce(g, tensor, op, timeout, compression,
+                                    ef_key)
+        _record_collective("allreduce", compression, sent,
+                           time.perf_counter() - t0)
+        return out
     parts = _exchange(g, tensor, timeout)
     stack = np.stack([parts[r] for r in range(g.world_size)])
     if op == "sum":
-        return stack.sum(axis=0)
-    if op == "mean":
-        return stack.mean(axis=0)
-    if op == "max":
-        return stack.max(axis=0)
-    if op == "min":
-        return stack.min(axis=0)
-    if op == "prod":
-        return stack.prod(axis=0)
-    raise ValueError(f"unknown reduce op {op!r}")
+        out = stack.sum(axis=0)
+    elif op == "mean":
+        out = stack.mean(axis=0)
+    elif op == "max":
+        out = stack.max(axis=0)
+    elif op == "min":
+        out = stack.min(axis=0)
+    elif op == "prod":
+        out = stack.prod(axis=0)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    _record_collective("allreduce", None, tensor.nbytes,
+                       time.perf_counter() - t0)
+    return out
 
 
 def reduce(tensor: np.ndarray, *, dst_rank: int = 0, op: str = "sum",
@@ -309,37 +435,123 @@ def broadcast(tensor: np.ndarray | None, *, src_rank: int = 0,
 
 
 def allgather(tensor: np.ndarray, *, group_name: str = "default",
-              timeout: float = 60.0) -> list[np.ndarray]:
+              timeout: float = 60.0, compression: str | None = None,
+              ef_key: str | None = None) -> list[np.ndarray]:
     """(reference: :554.) Per-rank tensors may differ in shape/size; each
     rank independently ships either the array (small) or a ref (large) and
-    receivers resolve by payload type, so mixed modes can't diverge."""
+    receivers resolve by payload type, so mixed modes can't diverge.
+
+    compression="int8_block" quantizes this rank's contribution once at
+    the source (error feedback keyed by ef_key); every rank — including
+    the source, which adopts its own dequantized copy — reconstructs the
+    same values."""
     g = _group(group_name)
     tensor = np.asarray(tensor)
-    big_mine = tensor.nbytes >= RING_MIN_BYTES and g.world_size > 1
-    to_send = ray_tpu.put(tensor) if big_mine else tensor
+    t0 = time.perf_counter()
+    payload: object = tensor
+    if compression is not None:
+        _check_compression(compression, "sum", tensor.dtype)
+        if ef_key is None:
+            ef_key = _default_ef_key("allgather", "id", tensor)
+        payload = quantization.quantize_with_feedback(
+            tensor, g.name, ef_key, "allgather")
+    nbytes = quantization.wire_bytes(payload)
+    big_mine = nbytes >= RING_MIN_BYTES and g.world_size > 1
+    to_send = ray_tpu.put(payload) if big_mine else payload
     parts = _exchange(g, to_send, timeout)
     saw_ref = big_mine or any(hasattr(parts[r], "hex")
                               for r in range(g.world_size))
-    out = [tensor.copy() if r == g.rank
-           else (ray_tpu.get(parts[r]) if hasattr(parts[r], "hex")
-                 else parts[r])
-           for r in range(g.world_size)]
+
+    def _resolve(r: int):
+        if r == g.rank:
+            # no re-fetch of our own payload through the object store; the
+            # compressed path still adopts the DEQUANTIZED copy so every
+            # rank reconstructs bit-identical values
+            if isinstance(payload, quantization.QuantizedChunk):
+                return quantization.dequantize_block(payload).reshape(
+                    payload.shape)
+            return tensor.copy()
+        p = parts[r]
+        if hasattr(p, "hex"):
+            p = ray_tpu.get(p)
+        if isinstance(p, quantization.QuantizedChunk):
+            return quantization.dequantize_block(p).reshape(p.shape)
+        return p
+
+    out = [_resolve(r) for r in range(g.world_size)]
     if saw_ref:
         # every rank computed the same predicate from the same exchanged
         # data: refs stay live until all pulls completed
         _exchange(g, None, timeout)
+    _record_collective("allgather", compression, nbytes,
+                       time.perf_counter() - t0)
     return out
 
 
 def reducescatter(tensor: np.ndarray, *, op: str = "sum",
-                  group_name: str = "default", timeout: float = 60.0) -> np.ndarray:
+                  group_name: str = "default", timeout: float = 60.0,
+                  compression: str | None = None,
+                  ef_key: str | None = None) -> np.ndarray:
     """Reduce then return this rank's 1/world shard along axis 0.
     (reference: :629. Rides allreduce, which is a scalable ring for large
-    tensors; the local slice is free.)"""
+    tensors; the local slice is free.) `compression` forwards to the ring
+    (see allreduce); ZeRO-style flat sharding wants `reducescatter_flat`,
+    which runs ONLY the reduce phase — half the bytes."""
     g = _group(group_name)
-    total = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
+    total = allreduce(tensor, op=op, group_name=group_name, timeout=timeout,
+                      compression=compression, ef_key=ef_key)
     shards = np.array_split(total, g.world_size, axis=0)
     return shards[g.rank]
+
+
+class FlatShard(NamedTuple):
+    """This rank's chunk of a flattened ring-reduced tensor."""
+
+    chunk: np.ndarray    # [chunk_size] reduced values (padded tail zeros)
+    index: int           # which of the W flat chunks this rank owns
+    chunk_size: int      # elements per chunk (ceil(n / W))
+    total_size: int      # original (unpadded) element count
+
+
+def reducescatter_flat(tensor: np.ndarray, *, op: str = "sum",
+                       group_name: str = "default", timeout: float = 60.0,
+                       compression: str | None = None,
+                       ef_key: str | None = None) -> FlatShard:
+    """Ring reduce-scatter over the FLAT tensor: runs only the reduce
+    phase (W-1 hops, ~half an allreduce's bytes) and returns the one chunk
+    this rank ends up owning — the input to a ZeRO-1 sharded optimizer
+    update (train/zero.py). Chunk ownership follows the ring: rank r owns
+    flat chunk (r+1) % W; reassemble with the indices, not the ranks."""
+    g = _group(group_name)
+    tensor = np.asarray(tensor)
+    _check_compression(compression, op, tensor.dtype)
+    if op not in ("sum", "mean"):
+        raise ValueError(f"reducescatter_flat supports sum/mean, got {op!r}")
+    t0 = time.perf_counter()
+    W = g.world_size
+    if W == 1:
+        out = np.ascontiguousarray(tensor).ravel().copy()
+        _record_collective("reducescatter", compression, 0,
+                           time.perf_counter() - t0)
+        return FlatShard(out, 0, out.size, out.size)
+    if compression is not None and ef_key is None:
+        ef_key = _default_ef_key("reducescatter", op, tensor)
+    buffers, n, per = _flat_chunks(tensor, W, op)
+    keep: list = []
+    sent = [0]
+    seq = g.next_seq()
+    _ring_reduce_phase(g, buffers, op, seq, keep, timeout,
+                       compression=compression, ef_key=ef_key,
+                       sent_bytes=sent)
+    _exchange(g, None, timeout)  # all pulls done before refs drop
+    keep.clear()
+    own = (g.rank + 1) % W
+    chunk = buffers[own]
+    if op == "mean":
+        chunk = chunk / W
+    _record_collective("reducescatter", compression, sent[0],
+                       time.perf_counter() - t0)
+    return FlatShard(np.asarray(chunk), own, per, n)
 
 
 def barrier(group_name: str = "default", timeout: float = 60.0) -> None:

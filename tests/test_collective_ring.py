@@ -6,6 +6,8 @@ cross-host groups move bytes host-to-host.
 nccl_collective_group.py:121 — VERDICT round-2 item 4.)
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -60,6 +62,31 @@ class RingWorker:
         out = self.col.allreduce(x, group_name=self.g, timeout=120.0)
         return float(out.sum()), out.shape
 
+    def odd_allreduce_ops(self, n):
+        """Non-divisible n through the padded np.resize path, every op the
+        pad value participates in: sum/mean pad 0, max/min pad flat[-1]."""
+        base = np.arange(n, dtype=np.float32)
+        x = base + float(self.rank)          # rank r holds base + r
+        out = {}
+        for op in ("sum", "mean", "max", "min"):
+            got = self.col.allreduce(x, op=op, group_name=self.g,
+                                     timeout=120.0)
+            out[op] = (got[0], got[n // 2], got[-1], str(got.dtype),
+                       got.shape)
+        return out
+
+    def mean_dtype(self, n, dtype):
+        x = np.full((n,), float(self.rank + 1), dtype)
+        out = self.col.allreduce(x, op="mean", group_name=self.g,
+                                 timeout=120.0)
+        return str(out.dtype), float(out[0]), float(out[-1])
+
+    def destroy(self):
+        from ray_tpu.util.collective import collective as cmod
+
+        self.col.destroy_collective_group(self.g)
+        return self.g in cmod._groups
+
 
 BIG = 1 << 19  # 2 MB float32 — over RING_MIN_BYTES
 
@@ -97,6 +124,74 @@ def test_ring_allgather_and_broadcast_by_ref(prim_cluster):
     out = ray_tpu.get([w.big_broadcast.remote(BIG) for w in ws], timeout=180)
     for last, shape in out:
         assert last == float(BIG - 1) and tuple(shape) == (BIG,)
+
+
+def test_ring_padded_path_all_ops(prim_cluster):
+    """n = BIG + 3 over world 2: every chunk boundary falls mid-tensor and
+    the np.resize pad tail is live during the reduce — sum/mean/max/min
+    must all come back exact and trimmed to n."""
+    n = BIG + 3
+    ws = _mkgroup(2, "ringops")
+    out = ray_tpu.get([w.odd_allreduce_ops.remote(n) for w in ws],
+                      timeout=240)
+    for got in out:
+        first, mid, last, dtype, shape = got["sum"]
+        # rank0 holds arange, rank1 arange+1: sum = 2*arange + 1
+        assert (first, mid, last) == (1.0, 2.0 * (n // 2) + 1.0,
+                                      2.0 * (n - 1) + 1.0)
+        assert tuple(shape) == (n,)
+        first, mid, last, dtype, shape = got["mean"]
+        assert (first, mid, last) == (0.5, n // 2 + 0.5, n - 1 + 0.5)
+        first, mid, last, dtype, shape = got["max"]
+        assert (first, mid, last) == (1.0, n // 2 + 1.0, float(n))
+        assert dtype == "float32"  # non-mean ops restore the input dtype
+        first, mid, last, dtype, shape = got["min"]
+        assert (first, mid, last) == (0.0, float(n // 2), float(n - 1))
+        assert tuple(shape) == (n,)
+
+
+def test_ring_mean_preserves_float_dtype(prim_cluster):
+    """Mean through the ring keeps the input's float dtype — f32 inputs
+    must not silently widen to f64 on the way out (downstream buffers are
+    dtype-sized)."""
+    ws = _mkgroup(2, "ringdt")
+    for dtype, n in (("float32", BIG + 1), ("float64", BIG // 2 + 1)):
+        out = ray_tpu.get([w.mean_dtype.remote(n, dtype) for w in ws],
+                          timeout=240)
+        for got_dtype, first, last in out:
+            assert got_dtype == dtype
+            assert first == last == 1.5
+
+
+def test_destroy_collective_group_releases_everything(prim_cluster):
+    """After destroy: the rendezvous actor is gone from the system
+    namespace (no stranded refs keep it alive) and the process-local group
+    registry is empty, so the name is immediately reusable."""
+    from ray_tpu.util.state import list_actors
+
+    ws = _mkgroup(2, "ringgone")
+    ray_tpu.get([w.big_allreduce.remote(BIG) for w in ws], timeout=180)
+    name = "__collective::ringgone"
+    assert any(a.get("name") == name and a.get("state").lower() == "alive"
+               for a in list_actors())
+    still_member = ray_tpu.get([w.destroy.remote() for w in ws], timeout=60)
+    assert still_member == [False, False]
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        alive = [a for a in list_actors()
+                 if a.get("name") == name
+                 and a.get("state", "").lower() == "alive"]
+        if not alive:
+            break
+        time.sleep(0.2)
+    assert not alive, "rendezvous actor leaked past destroy_collective_group"
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor(name, namespace="_system")
+    # the same group name can be formed again from scratch
+    ws2 = _mkgroup(2, "ringgone")
+    out = ray_tpu.get([w.big_allreduce.remote(BIG) for w in ws2], timeout=180)
+    for first, last, shape in out:
+        assert first == last == 3.0
 
 
 @pytest.mark.slow

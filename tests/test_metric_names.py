@@ -32,6 +32,12 @@ def test_expected_exported_metrics_still_constructed():
                  "ray_tpu_serve_replicas_readopted_total",
                  "ray_tpu_serve_replica_health_check_failures_total"):
         assert name in check_metric_names.EXPECTED_METRICS
+    # quantized + ZeRO-sharded training collectives (util/collective,
+    # train/session.py)
+    for name in ("ray_tpu_collective_bytes_total",
+                 "ray_tpu_collective_seconds",
+                 "ray_tpu_train_opt_state_bytes"):
+        assert name in check_metric_names.EXPECTED_METRICS
 
 
 def test_checker_flags_expected_removal(tmp_path):

@@ -71,6 +71,13 @@ EXPECTED_METRICS = (
     "ray_tpu_llm_pd_phase_seconds",
     "ray_tpu_serve_router_prefix_route_total",
     "ray_tpu_gcs_rpc_seconds",
+    # quantized + ZeRO-sharded training collectives (util/collective/
+    # collective.py, train/session.py): per-rank bytes-on-wire (the int8
+    # ring's ~4x win keys on this), collective wall time, and per-worker
+    # optimizer-state footprint (the ZeRO ~W x drop keys on this)
+    "ray_tpu_collective_bytes_total",
+    "ray_tpu_collective_seconds",
+    "ray_tpu_train_opt_state_bytes",
 )
 
 
