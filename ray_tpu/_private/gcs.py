@@ -967,12 +967,6 @@ class GcsServer:
                 except ConnectionClosed:
                     pass
             return wid
-        if t == "object_locations":
-            with self.lock:
-                entry = self.objects.get(msg["oid"]) or {}
-                locs = self._object_locations_locked(entry)
-            conn.send({"rid": msg["rid"], "locations": locs})
-            return wid
         if t == "ref_delta":
             self._on_ref_delta(msg["deltas"], wid)
             return wid
@@ -3162,7 +3156,11 @@ class GcsServer:
             # prefer the GCS-side spec: it carries the _paid accounting tag the
             # worker's lite echo doesn't (the worker never sees reservations)
             if w is not None:
-                gcs_spec = w.running_tasks.pop(spec.get("task_id"), None)
+                # the top-level task_id is authoritative (direct dispatch
+                # keys on it too); the lite spec echo is the fallback for
+                # cross-language peers that omit it
+                gcs_spec = w.running_tasks.pop(
+                    msg.get("task_id") or spec.get("task_id"), None)
                 if gcs_spec is not None:
                     spec = gcs_spec
             kind = spec["kind"]

@@ -1634,8 +1634,7 @@ class CoreWorker:
                 oid = f"{task_id}s{produced:06d}"
                 (parts, total), refs = _serialize_capturing(ser.dumps_into, val)
                 msg = {"type": "stream_item", "wid": self.wid, "task_id": task_id,
-                       "index": produced, "oid": oid, "size": total,
-                       "contained": refs}
+                       "oid": oid, "size": total, "contained": refs}
                 if total <= INLINE_LIMIT:
                     blob = b"".join(bytes(p) if not isinstance(p, bytes) else p
                                     for p in parts)

@@ -247,6 +247,28 @@ def cmd_start(args):
         sys.exit(2)
 
 
+def cmd_unquarantine(args):
+    """Re-enable TPU chips quarantined by an OOM kill, once the operator
+    has confirmed the host device pool is healthy again (the GCS-side
+    recovery path for `unquarantine_chips`)."""
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        msg = {"type": "unquarantine_chips"}
+        if args.node:
+            msg["node_id"] = args.node
+        if args.chips:
+            msg["chips"] = [int(x) for x in args.chips.split(",")]
+        reply = c.rpc(msg)
+        restored = reply.get("restored") or []
+        if restored:
+            print(f"restored chips: {restored}")
+        else:
+            print("no quarantined chips matched")
+    finally:
+        c.close()
+
+
 def cmd_monitor(args):
     from ray_tpu._private import monitor
 
@@ -572,6 +594,12 @@ def main(argv=None):
     sp.add_argument("--keep-nodes-on-exit", action="store_true",
                     help="monitor leaves provider nodes running on exit")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("unquarantine",
+                        help="re-enable chips quarantined by an OOM kill")
+    sp.add_argument("--node", help="node id (default: the head's local node)")
+    sp.add_argument("--chips", help="comma-separated chip ids (default: all)")
+    sp.set_defaults(fn=cmd_unquarantine)
 
     sp = sub.add_parser("monitor",
                         help="run the autoscaler monitor process "

@@ -1,6 +1,6 @@
 """Tier-1 tooling check: the graft_check AST invariant suite.
 
-Two halves:
+Three halves (PR 10 + the interprocedural v2):
 
 - the REAL tree must be clean: `python -m tools.graft_check` semantics —
   zero unsuppressed findings over ray_tpu/ with the checked-in baseline
@@ -8,13 +8,22 @@ Two halves:
   budget;
 
 - every checker must actually FIRE: per-checker negative tests feed small
-  fixture snippets (an `await` under a lock, a missing persist, a literal
-  `rtpu_chan_` string, an unpaired RPC type, ...) and assert the right
-  check id at the right line, so a refactor can't silently lobotomize a
-  checker while the tree stays green.
+  fixture snippets (an `await` under a lock, a missing persist, a lock-
+  order cycle split across methods, a handler reading a field no client
+  sends, ...) and assert the right check id at the right line — and a
+  registry test asserts EVERY id `--list` reports has a firing fixture,
+  so a future checker can't land untested;
+
+- the incremental machinery works: the on-disk analysis cache replays
+  findings and call-graph summaries without reparsing, `--changed`/scope
+  filters reporting while analysis stays tree-wide, and `--format json`
+  emits CI-consumable output.
 """
 
+import json
 import os
+import shutil
+import subprocess
 import sys
 import time
 
@@ -28,18 +37,29 @@ from tools.graft_check import (load_baseline, run_checks,  # noqa: E402
                                run_default)
 from tools.graft_check.checkers import (AsyncBlockingChecker,  # noqa: E402
                                         LockDisciplineChecker,
+                                        LockOrderChecker,
                                         MetricNamesChecker,
                                         PersistOrderChecker,
+                                        RpcFieldSchemaChecker,
                                         RpcPairingChecker,
-                                        ShmLifecycleChecker, all_check_ids)
+                                        ShmLifecycleChecker,
+                                        TransitiveBlockingChecker,
+                                        all_check_ids)
 
 
-def _run(tree_dir, checkers):
-    return run_checks(str(tree_dir), checkers)
+def _run(tree_dir, checkers, **kw):
+    return run_checks(str(tree_dir), checkers, **kw)
 
 
 def _ids(report):
     return [(f.check_id, f.path, f.line) for f in report.findings]
+
+
+def _write_tree(tmp_path, files):
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
 
 
 # --------------------------------------------------------------- real tree
@@ -84,11 +104,12 @@ def test_cli_lists_every_check_id(capsys):
     out = capsys.readouterr().out
     for check_id, _desc in all_check_ids():
         assert check_id in out
-    for expected in ("async-blocking", "await-under-lock",
-                     "blocking-under-lock", "guarded-attr", "persist-order",
+    for expected in ("async-blocking", "transitive-blocking",
+                     "await-under-lock", "blocking-under-lock",
+                     "guarded-attr", "lock-order", "persist-order",
                      "shm-lifecycle", "shm-prefix", "rpc-pairing",
-                     "rpc-table", "rpc-method-literal", "metric-name",
-                     "metric-expected", "stale-baseline"):
+                     "rpc-table", "rpc-method-literal", "rpc-field-schema",
+                     "metric-name", "metric-expected", "stale-baseline"):
         assert expected in out, f"--list is missing {expected}"
 
 
@@ -99,8 +120,27 @@ def test_cli_nonzero_on_violation(tmp_path, capsys):
         "import time\n"
         "async def f():\n"
         "    time.sleep(1)\n")
-    assert main([str(tmp_path), "--no-baseline", "--quiet"]) == 1
+    assert main([str(tmp_path), "--no-baseline", "--no-cache",
+                 "--quiet"]) == 1
     assert "async-blocking" in capsys.readouterr().out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    from tools.graft_check.__main__ import main
+
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n")
+    assert main([str(tmp_path), "--no-baseline", "--no-cache",
+                 "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["parse_errors"] == []
+    assert payload["suppressed"] == 0
+    (finding,) = [f for f in payload["findings"]
+                  if f["check_id"] == "async-blocking"]
+    assert finding["path"] == "m.py" and finding["line"] == 3
+    assert finding["symbol"] == "f" and "message" in finding
 
 
 # ----------------------------------------------------------- async-blocking
@@ -125,6 +165,67 @@ def test_async_blocking_fires(tmp_path):
                             ("async-blocking", "m.py", 4),
                             ("async-blocking", "m.py", 5),
                             ("async-blocking", "m.py", 6)]
+
+
+# ------------------------------------------------------ transitive-blocking
+
+
+_TRANSITIVE_FIXTURE = (
+    "import time\n"
+    "class C:\n"
+    "    async def handler(self):\n"
+    "        self._drain()\n"                        # line 4: fires
+    "        self._poll(timeout=0)\n"                # poll kwarg: ok
+    "        await self._adrain()\n"                 # awaited async: ok
+    "    def _drain(self):\n"
+    "        self._flush()\n"
+    "    def _flush(self):\n"
+    "        time.sleep(0.5)\n"                      # the primitive
+    "    def _poll(self, timeout=None):\n"
+    "        time.sleep(timeout or 1)\n"
+    "    async def _adrain(self):\n"
+    "        pass\n")
+
+
+def test_transitive_blocking_fires_with_chain(tmp_path):
+    (tmp_path / "m.py").write_text(_TRANSITIVE_FIXTURE)
+    report = _run(tmp_path, [TransitiveBlockingChecker()])
+    got = [f for f in report.findings
+           if f.check_id == "transitive-blocking"]
+    assert [(f.path, f.line) for f in got] == [("m.py", 4)]
+    # the finding carries the whole call chain down to the primitive
+    assert "C._drain" in got[0].message
+    assert "C._flush() (m.py:8)" in got[0].message
+    assert "time.sleep() (m.py:10)" in got[0].message
+    assert got[0].symbol == "C.handler"
+
+
+def test_transitive_blocking_crosses_modules(tmp_path):
+    """A helper imported from another module is followed too."""
+    _write_tree(tmp_path, {
+        "util.py": ("import time\n"
+                    "def fetch_all(x):\n"
+                    "    time.sleep(1)\n"),
+        "srv.py": ("from util import fetch_all\n"
+                   "async def handle():\n"
+                   "    fetch_all(1)\n")})           # line 3: fires
+    report = _run(tmp_path, [TransitiveBlockingChecker()])
+    assert _ids(report) == [("transitive-blocking", "srv.py", 3)]
+
+
+def test_transitive_blocking_generator_and_executor_exempt(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "def gen():\n"
+        "    yield 1\n"
+        "    time.sleep(1)\n"
+        "async def ok():\n"
+        "    gen()\n"                    # calling a generator: no body runs
+        "    loop.run_in_executor(None, helper)\n"   # passed, not called
+        "def helper():\n"
+        "    time.sleep(1)\n")
+    report = _run(tmp_path, [TransitiveBlockingChecker()])
+    assert not report.findings
 
 
 # ------------------------------------------------------------ lock checks
@@ -200,6 +301,94 @@ def test_guarded_attr_fires(tmp_path):
     report = _run(tmp_path, [LockDisciplineChecker()])
     got = [k for k in _ids(report) if k[0] == "guarded-attr"]
     assert got == [("guarded-attr", "m.py", 12)]
+
+
+# -------------------------------------------------------------- lock-order
+
+
+_LOCK_ORDER_FIXTURE = (
+    "import threading\n"
+    "class A:\n"
+    "    def __init__(self):\n"
+    "        self._lock_a = threading.Lock()\n"
+    "        self._lock_b = threading.Lock()\n"
+    "    def one(self):\n"
+    "        with self._lock_a:\n"
+    "            self._take_b()\n"          # a -> b through the call graph
+    "    def _take_b(self):\n"
+    "        with self._lock_b:\n"
+    "            pass\n"
+    "    def two(self):\n"
+    "        with self._lock_b:\n"
+    "            with self._lock_a:\n"      # b -> a lexically
+    "                pass\n")
+
+
+def test_lock_order_cycle_fires_with_both_paths(tmp_path):
+    (tmp_path / "m.py").write_text(_LOCK_ORDER_FIXTURE)
+    report = _run(tmp_path, [LockOrderChecker()])
+    got = [f for f in report.findings if f.check_id == "lock-order"]
+    assert len(got) == 1, _ids(report)
+    msg = got[0].message
+    # the report names BOTH acquisition paths, interprocedural one included
+    assert "Acquisition path 1" in msg and "Acquisition path 2" in msg
+    assert "A.one" in msg and "A.two" in msg
+    assert "A._take_b" in msg  # the call-graph hop is spelled out
+    assert "m.py:A._lock_a" in msg and "m.py:A._lock_b" in msg
+
+
+def test_lock_order_multi_item_with_fires(tmp_path):
+    """`with a, b:` acquires b while a is held — the edge must exist, so
+    an opposite-order `with b: with a:` elsewhere is still a cycle."""
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def one(self):\n"
+        "        with self._lock_a, self._lock_b:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._lock_b:\n"
+        "            with self._lock_a:\n"
+        "                pass\n")
+    report = _run(tmp_path, [LockOrderChecker()])
+    got = [f for f in report.findings if f.check_id == "lock-order"]
+    assert len(got) == 1, _ids(report)
+    assert "_lock_a" in got[0].message and "_lock_b" in got[0].message
+
+
+def test_lock_order_consistent_ordering_is_clean(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def one(self):\n"
+        "        with self._lock_a:\n"
+        "            with self._lock_b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._lock_a:\n"
+        "            with self._lock_b:\n"   # same global order: ok
+        "                pass\n")
+    report = _run(tmp_path, [LockOrderChecker()])
+    assert not report.findings
+
+
+def test_lock_order_distinct_classes_not_unified(tmp_path):
+    """`self._lock` of two different classes are different locks — no
+    false cycle from the shared attribute name."""
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            g()\n"
+        "class B:\n"
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "def g():\n"
+        "    pass\n")
+    report = _run(tmp_path, [LockOrderChecker()])
+    assert not report.findings
 
 
 # ------------------------------------------------------------ persist-order
@@ -329,6 +518,137 @@ def test_rpc_method_literal_fires(tmp_path):
     assert ("rpc-method-literal", "client.py", 1) in _ids(report)
 
 
+# --------------------------------------------------------- rpc field schema
+
+
+_SCHEMA_SERVER = (
+    "class Server:\n"
+    "    def _handle(self, conn, msg):\n"
+    "        t = msg['type']\n"
+    "        if t == 'ping':\n"
+    "            conn.send({'rid': msg['rid'], 'seq': msg['seq']})\n"  # l5
+    "        if t == 'fwd':\n"
+    "            self._deep(msg)\n"
+    "        if t == 'built':\n"
+    "            conn.send({'rid': msg['rid'], 'x': msg.get('x')})\n"
+    "        if t == 'orphan':\n"                    # line 10: dead arm
+    "            conn.send({'rid': msg['rid']})\n"
+    "    def _deep(self, msg):\n"
+    "        return msg['deep']\n")                  # line 13: via forward
+
+_SCHEMA_CLIENT = (
+    "def call(w):\n"
+    "    w.rpc({'type': 'ping', 'extra': 1})\n"      # line 2: dead 'extra'
+    "    w.rpc({'type': 'fwd'})\n"
+    "def _mk():\n"
+    "    return {'type': 'built', 'x': 1}\n"
+    "def send_built(w):\n"
+    "    w.send_no_reply(_mk())\n")
+
+
+def _schema_report(tmp_path):
+    _write_tree(tmp_path, {"gcs.py": _SCHEMA_SERVER,
+                           "client.py": _SCHEMA_CLIENT})
+    return _run(tmp_path, [RpcFieldSchemaChecker(gcs_module="gcs.py")])
+
+
+def test_rpc_field_schema_missing_field_fires(tmp_path):
+    report = _schema_report(tmp_path)
+    missing = [f for f in report.findings
+               if "hard-reads" in f.message]
+    # ping hard-reads msg['seq'] no client sends; fwd's helper hard-reads
+    # msg['deep'] through the call-graph forward
+    assert ("rpc-field-schema", "gcs.py", 5) in [
+        (f.check_id, f.path, f.line) for f in missing]
+    assert any("'deep'" in f.message and f.path == "gcs.py"
+               for f in missing)
+
+
+def test_rpc_field_schema_dead_field_fires(tmp_path):
+    report = _schema_report(tmp_path)
+    dead = [f for f in report.findings if "never" in f.message
+            and f.path == "client.py"]
+    assert [(f.check_id, f.path, f.line) for f in dead] == [
+        ("rpc-field-schema", "client.py", 2)]
+    assert "'extra'" in dead[0].message
+
+
+def test_rpc_field_schema_dead_arm_fires(tmp_path):
+    report = _schema_report(tmp_path)
+    dead_arms = [f for f in report.findings
+                 if "dead protocol surface" in f.message]
+    assert [(f.path, f.line) for f in dead_arms] == [("gcs.py", 10)]
+    assert "'orphan'" in dead_arms[0].message
+
+
+def test_rpc_field_schema_helper_returned_payload_resolves(tmp_path):
+    """`w.send_no_reply(_mk())` counts as a client site for 'built' via
+    the helper's return dict — so 'built' is neither a dead arm nor does
+    its soft-read x produce noise."""
+    report = _schema_report(tmp_path)
+    assert not any("'built'" in f.message for f in report.findings)
+
+
+def test_rpc_field_schema_wholesale_and_incomplete_suppress(tmp_path):
+    _write_tree(tmp_path, {
+        "gcs.py": ("class S:\n"
+                   "    def _handle(self, conn, msg):\n"
+                   "        t = msg['type']\n"
+                   "        if t == 'store':\n"
+                   "            self.db.put('tbl', msg)\n"  # wholesale
+                   "        if t == 'splat':\n"
+                   "            conn.send({'rid': msg['rid']})\n"
+                   "        if t == 'dyn':\n"
+                   "            k = msg['key']\n"
+                   "            conn.send({'rid': msg['rid'], 'v': msg[k]})\n"),
+        "client.py": ("def call(w, extra):\n"
+                      "    w.rpc({'type': 'store', 'anything': 1})\n"
+                      "    w.rpc({'type': 'splat', **extra})\n"
+                      "    w.rpc({'type': 'dyn', 'key': 'x', 'x': 1})\n")})
+    report = _run(tmp_path, [RpcFieldSchemaChecker(gcs_module="gcs.py")])
+    # wholesale store: 'anything' is not dead; ** site: type skipped;
+    # dyn's msg[k] computed read: 'x' must NOT be reported dead
+    assert not report.findings
+
+
+def test_rpc_field_schema_dynamic_client_suppresses_dead_arm(tmp_path):
+    """A payload built too dynamically to resolve must not get its arm
+    reported dead: the spelled-out type string is the escape hatch."""
+    _write_tree(tmp_path, {
+        "gcs.py": ("class S:\n"
+                   "    def _handle(self, conn, msg):\n"
+                   "        t = msg['type']\n"
+                   "        if t == 'maybe':\n"
+                   "            conn.send({'rid': msg['rid']})\n"),
+        "client.py": ("def call(w, flag):\n"
+                      "    m = ({'type': 'maybe'} if flag\n"
+                      "         else {'type': 'maybe', 'x': 1})\n"
+                      "    w.rpc(m)\n")})
+    report = _run(tmp_path, [RpcFieldSchemaChecker(gcs_module="gcs.py")])
+    assert not report.findings
+
+
+def test_rpc_field_schema_branch_built_payload_resolves(tmp_path):
+    """`m = {...}` rebuilt per branch with the same type unions the keys
+    instead of going opaque."""
+    _write_tree(tmp_path, {
+        "gcs.py": ("class S:\n"
+                   "    def _handle(self, conn, msg):\n"
+                   "        t = msg['type']\n"
+                   "        if t == 'put':\n"
+                   "            conn.send({'rid': msg['rid'],\n"
+                   "                       'a': msg.get('a'),\n"
+                   "                       'b': msg.get('b')})\n"),
+        "client.py": ("def call(w, flag):\n"
+                      "    if flag:\n"
+                      "        m = {'type': 'put', 'a': 1}\n"
+                      "    else:\n"
+                      "        m = {'type': 'put', 'b': 2}\n"
+                      "    w.rpc(m)\n")})
+    report = _run(tmp_path, [RpcFieldSchemaChecker(gcs_module="gcs.py")])
+    assert not report.findings
+
+
 # ------------------------------------------------------------- metric names
 
 
@@ -402,8 +722,280 @@ def test_baseline_count_pin_catches_new_violation(tmp_path):
     assert not report.findings and len(report.suppressed) == 2
 
 
+@pytest.mark.parametrize("check_id,fixture,checker_cls", [
+    ("transitive-blocking", _TRANSITIVE_FIXTURE, TransitiveBlockingChecker),
+    ("lock-order", _LOCK_ORDER_FIXTURE, LockOrderChecker),
+])
+def test_baseline_and_count_pin_cover_new_checkers(tmp_path, check_id,
+                                                   fixture, checker_cls):
+    """The new interprocedural ids ride the same baseline machinery:
+    suppression by (id, file, symbol) works, `=N` pins are enforced, and
+    removing the violation turns the entry stale."""
+    (tmp_path / "m.py").write_text(fixture)
+    report = _run(tmp_path, [checker_cls()])
+    (finding,) = [f for f in report.findings if f.check_id == check_id]
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =1  # fixture\n")
+    report = run_checks(str(tmp_path), [checker_cls()],
+                        load_baseline(str(bl)), baseline_path="baseline.txt")
+    assert not report.findings and len(report.suppressed) == 1
+    # a wrong pin overflows instead of hiding
+    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =2  # fixture\n")
+    report = run_checks(str(tmp_path), [checker_cls()],
+                        load_baseline(str(bl)), baseline_path="baseline.txt")
+    stale = [f for f in report.findings if f.check_id == "stale-baseline"]
+    assert len(stale) == 1 and "matched 1" in stale[0].message
+    # fixing the violation makes the entry stale
+    (tmp_path / "m.py").write_text("def fine():\n    pass\n")
+    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =1  # fixture\n")
+    report = run_checks(str(tmp_path), [checker_cls()],
+                        load_baseline(str(bl)), baseline_path="baseline.txt")
+    stale = [f for f in report.findings if f.check_id == "stale-baseline"]
+    assert len(stale) == 1
+
+
 def test_baseline_requires_justification(tmp_path):
     bl = tmp_path / "baseline.txt"
     bl.write_text("async-blocking  m.py  bad\n")  # no justification
     with pytest.raises(ValueError, match="malformed baseline entry"):
         load_baseline(str(bl))
+
+
+# ------------------------------------------------- every checker must fire
+
+
+#: check id -> (fixture files, checker factory). The registry test below
+#: asserts this covers EVERY id `--list` reports, so a future checker
+#: cannot land without a firing fixture.
+FIRING_FIXTURES = {
+    "async-blocking": (
+        {"m.py": "import time\nasync def f():\n    time.sleep(1)\n"},
+        lambda: [AsyncBlockingChecker()]),
+    "transitive-blocking": (
+        {"m.py": _TRANSITIVE_FIXTURE},
+        lambda: [TransitiveBlockingChecker()]),
+    "await-under-lock": (
+        {"m.py": ("class C:\n"
+                  "    async def f(self):\n"
+                  "        with self._lock:\n"
+                  "            await self.g()\n")},
+        lambda: [LockDisciplineChecker()]),
+    "blocking-under-lock": (
+        {"m.py": ("import time\n"
+                  "class C:\n"
+                  "    def f(self):\n"
+                  "        with self._lock:\n"
+                  "            time.sleep(1)\n")},
+        lambda: [LockDisciplineChecker()]),
+    "guarded-attr": (
+        {"m.py": ("class C:\n"
+                  "    def __init__(self):\n"
+                  "        self._lock = object()\n"
+                  "    def w(self):\n"
+                  "        with self._lock:\n"
+                  "            self.items = [1]\n"
+                  "    def r(self):\n"
+                  "        return self.items\n")},
+        lambda: [LockDisciplineChecker()]),
+    "lock-order": (
+        {"m.py": _LOCK_ORDER_FIXTURE},
+        lambda: [LockOrderChecker()]),
+    "persist-order": (
+        {"controller.py": ("class C:\n"
+                           "    def f(self):\n"
+                           "        self.provider.terminate_node('n')\n")},
+        lambda: [PersistOrderChecker(scope=("controller.py",))]),
+    "shm-lifecycle": (
+        {"m.py": ("def f():\n"
+                  "    ch = create_mutable_channel(1)\n"
+                  "    return ch.path\n")},
+        lambda: [ShmLifecycleChecker()]),
+    "shm-prefix": (
+        {"m.py": "P = 'rtpu_chan_'\n"},
+        lambda: [ShmLifecycleChecker()]),
+    "rpc-pairing": (
+        {"gcs.py": ("def h(msg):\n"
+                    "    t = msg['type']\n"
+                    "    if t == 'known':\n"
+                    "        pass\n"),
+         "client.py": "def c(w):\n    w.rpc({'type': 'nope'})\n"},
+        lambda: [RpcPairingChecker(gcs_module="gcs.py",
+                                   gcs_storage_module="gcs_storage.py")]),
+    "rpc-table": (
+        {"gcs.py": ("class S:\n"
+                    "    def h(self):\n"
+                    "        self.storage.put('ghost', 'k', 1)\n"),
+         "gcs_storage.py": "TABLES = ('kv',)\n"},
+        lambda: [RpcPairingChecker(gcs_module="gcs.py",
+                                   gcs_storage_module="gcs_storage.py")]),
+    "rpc-method-literal": (
+        {"m.py": "LOOP = '__ray_tpu_bogus__'\n"},
+        lambda: [RpcPairingChecker()]),
+    "rpc-field-schema": (
+        {"gcs.py": _SCHEMA_SERVER, "client.py": _SCHEMA_CLIENT},
+        lambda: [RpcFieldSchemaChecker(gcs_module="gcs.py")]),
+    "metric-name": (
+        {"m.py": ("from ray_tpu.util.metrics import Counter\n"
+                  "c = Counter('bad_name')\n")},
+        lambda: [MetricNamesChecker(expected=())]),
+    "metric-expected": (
+        {"m.py": "x = 1\n"},
+        lambda: [MetricNamesChecker(expected=("ray_tpu_gone_total",))]),
+}
+
+#: ids that fire through dedicated machinery, with their own tests above.
+_SPECIAL_IDS = {"stale-baseline"}
+
+
+def test_every_registered_checker_has_firing_fixture():
+    """`--list`-driven audit: a checker registered in the default suite
+    without an entry here fails — no checker lands untested."""
+    listed = {check_id for check_id, _ in all_check_ids()}
+    assert listed - _SPECIAL_IDS == set(FIRING_FIXTURES), (
+        "every registered check id needs a firing fixture in "
+        "FIRING_FIXTURES (or an explicit _SPECIAL_IDS entry with its own "
+        "dedicated test)")
+
+
+@pytest.mark.parametrize("check_id", sorted(FIRING_FIXTURES))
+def test_firing_fixture_fires(check_id, tmp_path):
+    files, make = FIRING_FIXTURES[check_id]
+    _write_tree(tmp_path, files)
+    report = _run(tmp_path, make())
+    assert any(f.check_id == check_id for f in report.findings), (
+        f"{check_id} fixture produced {_ids(report)}")
+
+
+# --------------------------------------------------- cache / changed scope
+
+
+def test_analysis_cache_roundtrip_and_invalidation(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "m.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n")
+    cache = tmp_path / "cache.bin"
+    r1 = run_checks(str(tree), [AsyncBlockingChecker()],
+                    cache_path=str(cache))
+    assert cache.exists()
+    # warm run replays cached findings (no reparse path)
+    r2 = run_checks(str(tree), [AsyncBlockingChecker()],
+                    cache_path=str(cache))
+    assert _ids(r1) == _ids(r2) == [("async-blocking", "m.py", 3)]
+    # (path, mtime, size) key: editing the file invalidates its entry
+    (tree / "m.py").write_text("async def f():\n    pass\n")
+    r3 = run_checks(str(tree), [AsyncBlockingChecker()],
+                    cache_path=str(cache))
+    assert not r3.findings
+    # a vanished file's entry is pruned, not replayed
+    (tree / "n.py").write_text(
+        "import time\nasync def g():\n    time.sleep(1)\n")
+    run_checks(str(tree), [AsyncBlockingChecker()], cache_path=str(cache))
+    (tree / "n.py").unlink()
+    r4 = run_checks(str(tree), [AsyncBlockingChecker()],
+                    cache_path=str(cache))
+    assert not r4.findings
+
+
+def test_cache_replays_call_graph_summaries(tmp_path):
+    """Interprocedural checkers must work from CACHED module summaries —
+    a warm run reparses nothing but still resolves the call chain."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "m.py").write_text(_TRANSITIVE_FIXTURE)
+    cache = tmp_path / "cache.bin"
+    r1 = run_checks(str(tree), [TransitiveBlockingChecker()],
+                    cache_path=str(cache))
+    r2 = run_checks(str(tree), [TransitiveBlockingChecker()],
+                    cache_path=str(cache))
+    assert _ids(r1) == _ids(r2)
+    assert any(f.check_id == "transitive-blocking" for f in r2.findings)
+    # facts-based checkers replay their collected facts the same way
+    _write_tree(tree, {"gcs.py": _SCHEMA_SERVER,
+                       "client.py": _SCHEMA_CLIENT})
+    rs1 = run_checks(str(tree), [RpcFieldSchemaChecker(gcs_module="gcs.py")],
+                     cache_path=str(cache))
+    rs2 = run_checks(str(tree), [RpcFieldSchemaChecker(gcs_module="gcs.py")],
+                     cache_path=str(cache))
+    assert _ids(rs1) == _ids(rs2)
+    assert any(f.check_id == "rpc-field-schema" for f in rs2.findings)
+
+
+def test_corrupt_cache_is_rebuilt(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "m.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n")
+    cache = tmp_path / "cache.bin"
+    cache.write_bytes(b"\x80garbage")
+    report = run_checks(str(tree), [AsyncBlockingChecker()],
+                        cache_path=str(cache))
+    assert _ids(report) == [("async-blocking", "m.py", 3)]
+
+
+def test_scope_filters_reporting_not_analysis(tmp_path):
+    """--changed semantics: findings are filtered to the scoped files,
+    but cross-file analysis still sees the whole tree (a scoped client's
+    pairing is judged against the UNSCOPED server module)."""
+    _write_tree(tmp_path, {
+        "a.py": "import time\nasync def f():\n    time.sleep(1)\n",
+        "b.py": "import time\nasync def g():\n    time.sleep(1)\n",
+        "gcs.py": ("def h(msg):\n"
+                   "    t = msg['type']\n"
+                   "    if t == 'known':\n"
+                   "        pass\n"),
+        "client.py": "def c(w):\n    w.rpc({'type': 'nope'})\n"})
+    checkers = lambda: [AsyncBlockingChecker(),  # noqa: E731
+                        RpcPairingChecker(gcs_module="gcs.py",
+                                          gcs_storage_module="gs.py")]
+    full = _run(tmp_path, checkers())
+    assert {f.path for f in full.findings} == {"a.py", "b.py", "client.py"}
+    scoped = _run(tmp_path, checkers(), scope=["b.py", "client.py"])
+    assert {f.path for f in scoped.findings} == {"b.py", "client.py"}
+    # the pairing finding survived scoping even though gcs.py is outside
+    assert any(f.check_id == "rpc-pairing" for f in scoped.findings)
+
+
+def test_scope_never_hides_parse_errors(tmp_path):
+    """An unparsable file voids tree-wide analysis, so --changed runs
+    must still fail loud even when the broken file is out of scope."""
+    _write_tree(tmp_path, {
+        "ok.py": "def fine():\n    pass\n",
+        "broken.py": "def oops(:\n"})
+    report = run_checks(str(tmp_path), [AsyncBlockingChecker()],
+                        scope=["ok.py"])
+    assert [f.path for f in report.parse_errors] == ["broken.py"]
+
+
+def test_scope_judges_stale_entries_only_for_scoped_files(tmp_path):
+    (tmp_path / "m.py").write_text("def fine():\n    pass\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("async-blocking  m.py  gone  # stale on full runs\n")
+    baseline = load_baseline(str(bl))
+    full = run_checks(str(tmp_path), [AsyncBlockingChecker()], baseline,
+                      baseline_path="baseline.txt")
+    assert any(f.check_id == "stale-baseline" for f in full.findings)
+    scoped = run_checks(str(tmp_path), [AsyncBlockingChecker()], baseline,
+                        baseline_path="baseline.txt", scope=["other.py"])
+    assert not scoped.findings
+
+
+@pytest.mark.skipif(shutil.which("git") is None, reason="needs git")
+def test_changed_relpaths_from_git(tmp_path, monkeypatch):
+    import tools.graft_check as gc
+
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "a.py").write_text("A = 1\n")
+    (pkg / "b.py").write_text("B = 1\n")
+    env_git = ["git", "-C", str(repo), "-c", "user.email=t@t",
+               "-c", "user.name=t"]
+    subprocess.run(["git", "-C", str(repo), "init", "-q"], check=True)
+    subprocess.run(env_git + ["add", "."], check=True)
+    subprocess.run(env_git + ["commit", "-qm", "seed"], check=True)
+    (pkg / "a.py").write_text("A = 2\n")          # tracked modification
+    (pkg / "c.py").write_text("C = 1\n")          # untracked
+    (repo / "outside.py").write_text("X = 1\n")   # outside the scan root
+    monkeypatch.setattr(gc, "REPO_ROOT", str(repo))
+    assert sorted(gc.changed_relpaths(str(pkg))) == ["a.py", "c.py"]

@@ -20,19 +20,62 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
 DEFAULT_ROOT = os.path.join(REPO_ROOT, "ray_tpu")
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.txt")
+#: on-disk analysis cache (gitignored): per-file findings/facts/summaries
+#: keyed by (path, mtime, size) + a digest of the graft_check sources.
+DEFAULT_CACHE = os.path.join(REPO_ROOT, ".graft_check_cache")
 
 
 def run_default(root: str = "", baseline_path: str = "",
-                use_baseline: bool = True) -> Report:
-    """The full suite with the checked-in baseline — what tier-1 runs."""
+                use_baseline: bool = True, scope=None,
+                cache_path=None) -> Report:
+    """The full suite with the checked-in baseline — what tier-1 runs.
+
+    `scope`: optional relpath set (`--changed`) to filter REPORTED
+    findings to; the call graph and pairing facts stay tree-wide.
+    `cache_path`: None = use the default cache when scanning the default
+    tree (cache keys are root-relative paths, so a custom root gets no
+    implicit cache); "" = disable."""
     root = root or DEFAULT_ROOT
     bl_path = baseline_path or DEFAULT_BASELINE
     baseline = load_baseline(bl_path) if use_baseline else []
+    if cache_path is None:
+        cache_path = DEFAULT_CACHE if os.path.abspath(root) == \
+            os.path.abspath(DEFAULT_ROOT) else ""
     return run_checks(root, make_suite(), baseline,
-                      baseline_path=os.path.relpath(bl_path, REPO_ROOT))
+                      baseline_path=os.path.relpath(bl_path, REPO_ROOT),
+                      scope=scope, cache_path=cache_path)
+
+
+def changed_relpaths(root: str = "") -> list:
+    """Repo-relative .py files under `root` that differ from HEAD
+    (tracked modifications + untracked), as root-relative paths — the
+    `--changed` file set. Returns None when git is unavailable (callers
+    fall back to a full-tree report)."""
+    import subprocess
+
+    root = os.path.abspath(root or DEFAULT_ROOT)
+    try:
+        diff = subprocess.run(
+            ["git", "-C", REPO_ROOT, "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, timeout=30, check=True)
+        untracked = subprocess.run(
+            ["git", "-C", REPO_ROOT, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30, check=True)
+    except Exception:  # noqa: BLE001 — no git / not a repo: full run
+        return None
+    rels = []
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        line = line.strip()
+        if not line.endswith(".py"):
+            continue
+        ap = os.path.abspath(os.path.join(REPO_ROOT, line))
+        if ap.startswith(root + os.sep) and os.path.exists(ap):
+            rels.append(os.path.relpath(ap, root).replace(os.sep, "/"))
+    return rels
 
 
 __all__ = ["ALL_CHECKERS", "BaselineEntry", "Checker", "Finding",
-           "ParsedModule", "Report", "all_check_ids", "load_baseline",
-           "make_suite", "run_checks", "run_default", "DEFAULT_ROOT",
-           "DEFAULT_BASELINE"]
+           "ParsedModule", "Report", "all_check_ids", "changed_relpaths",
+           "load_baseline", "make_suite", "run_checks", "run_default",
+           "DEFAULT_ROOT", "DEFAULT_BASELINE", "DEFAULT_CACHE"]

@@ -4,20 +4,27 @@ from __future__ import annotations
 
 from tools.graft_check.checkers.async_blocking import AsyncBlockingChecker
 from tools.graft_check.checkers.lock_discipline import LockDisciplineChecker
+from tools.graft_check.checkers.lock_order import LockOrderChecker
 from tools.graft_check.checkers.metric_names import (EXPECTED_METRICS,
                                                      MetricNamesChecker)
 from tools.graft_check.checkers.persist_order import PersistOrderChecker
 from tools.graft_check.checkers.rpc_pairing import RpcPairingChecker
+from tools.graft_check.checkers.rpc_schema import RpcFieldSchemaChecker
 from tools.graft_check.checkers.shm_lifecycle import ShmLifecycleChecker
+from tools.graft_check.checkers.transitive_blocking import (
+    TransitiveBlockingChecker)
 
 #: default suite, in reporting order. Each entry is a zero-arg factory so
-#: every run gets fresh checker state (rpc pairing etc. accumulate).
+#: every run gets fresh checker state (memoized call-graph walks etc.).
 ALL_CHECKERS = (
     AsyncBlockingChecker,
+    TransitiveBlockingChecker,
     LockDisciplineChecker,
+    LockOrderChecker,
     PersistOrderChecker,
     ShmLifecycleChecker,
     RpcPairingChecker,
+    RpcFieldSchemaChecker,
     MetricNamesChecker,
 )
 
@@ -38,5 +45,6 @@ def all_check_ids():
 
 __all__ = ["ALL_CHECKERS", "make_suite", "all_check_ids", "EXPECTED_METRICS",
            "AsyncBlockingChecker", "LockDisciplineChecker",
-           "MetricNamesChecker", "PersistOrderChecker", "RpcPairingChecker",
-           "ShmLifecycleChecker"]
+           "LockOrderChecker", "MetricNamesChecker", "PersistOrderChecker",
+           "RpcFieldSchemaChecker", "RpcPairingChecker",
+           "ShmLifecycleChecker", "TransitiveBlockingChecker"]

@@ -21,24 +21,16 @@ from typing import Iterable, List
 
 from tools.graft_check.core import (Checker, Finding, ParsedModule,
                                     call_target, kwarg_value)
+# one shared primitive table: `transitive-blocking` extends exactly this
+# checker through the call graph, so the two must never drift
+from tools.graft_check.core import (BLOCKING_ATTRS as _BLOCKING_ATTRS,
+                                    BLOCKING_QUALIFIED as _BLOCKING_QUALIFIED,
+                                    CHANNEL_ATTRS as _CHANNEL_ATTRS,
+                                    RAY_BLOCKING as _RAY_BLOCKING,
+                                    is_channel_receiver as
+                                    _is_channel_receiver)
 
 CHECK_ID = "async-blocking"
-
-#: (receiver, attr) pairs that always block.
-_BLOCKING_QUALIFIED = {("time", "sleep")}
-#: attrs that block regardless of receiver (seqlock/channel/GCS waits).
-_BLOCKING_ATTRS = {"rpc", "_wait", "wait_drained", "pull_all", "pull_pages",
-                   "serve_put", "instance_put"}
-#: ray_tpu module-level blocking APIs.
-_RAY_BLOCKING = {"get", "wait", "kill"}
-#: channel data-plane methods: blocking when the receiver looks like a
-#: channel (seqlock MutableShmChannel handles are conventionally named
-#: `ch` / `chan` / `channel` / `*_chan*`).
-_CHANNEL_ATTRS = {"read", "write", "write_serialized"}
-
-
-def _is_channel_receiver(base: str) -> bool:
-    return "chan" in base.lower() or base in ("ch", "c.ch")
 
 
 class _Visitor(ast.NodeVisitor):

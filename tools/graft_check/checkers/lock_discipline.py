@@ -29,17 +29,15 @@ Three invariants over `with <lock>` critical sections:
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, Iterable, List, Set, Tuple
 
-from tools.graft_check.core import (Checker, Finding, ParsedModule,
-                                    call_target, kwarg_value)
+from tools.graft_check.core import (LOCK_NAME_RE as _LOCK_RE, Checker,
+                                    Finding, ParsedModule, call_target,
+                                    kwarg_value)
 
 AWAIT_ID = "await-under-lock"
 BLOCKING_ID = "blocking-under-lock"
 GUARDED_ID = "guarded-attr"
-
-_LOCK_RE = re.compile(r"lock|mutex|\bmu\b", re.IGNORECASE)
 
 #: methods whose bare reads/writes are exempt (single-threaded phases).
 _EXEMPT_METHODS = {"__init__", "__del__", "__reduce__", "__getstate__",

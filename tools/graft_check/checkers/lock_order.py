@@ -1,0 +1,119 @@
+"""lock-order: the global lock-acquisition graph must be acyclic.
+
+Two threads acquiring the same two locks in opposite orders deadlock the
+process the first time their critical sections interleave — and the two
+acquisitions are almost never in the same function, which is why the
+per-function `lock_discipline` checks can't see them. This checker builds
+the project-wide lock-acquisition graph: an edge A -> B whenever lock B
+is acquired while A is held, either lexically (`with a: ... with b:`) or
+interprocedurally (`with a: self.helper()` where `helper` — transitively,
+through the shared call graph — acquires B). Every cycle is reported as a
+potential deadlock with BOTH acquisition paths spelled out, so the report
+alone is enough to pick which side to reorder.
+
+Lock identity is `module:Class.attr` for `self.<attr>` locks (every
+instance of a class shares one ordering discipline) and `module:<text>`
+for globals — a lock object passed between modules under different names
+is NOT unified, so the graph under-approximates: a clean run is evidence,
+not proof. Self-edges (re-acquiring the lock you hold) are skipped: they
+are instance-identity questions (RLock / sibling instances), not
+ordering ones.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graft_check.core import Checker, Finding
+
+CHECK_ID = "lock-order"
+
+
+class LockOrderChecker(Checker):
+    ids = ((CHECK_ID,
+            "the project-wide lock-acquisition graph (lexical + through "
+            "the call graph) must have no cycles"),)
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        if project is None:
+            return ()
+        graph = project.graph
+        #: (A, B) -> (description, anchor relpath, line, symbol)
+        edges: Dict[Tuple[str, str], Tuple[str, str, int, str]] = {}
+
+        def add_edge(a: str, b: str, desc: str, rel: str, line: int,
+                     symbol: str) -> None:
+            if a != b:
+                edges.setdefault((a, b), (desc, rel, line, symbol))
+
+        for rel, summary in project.summaries.items():
+            for fs in summary.functions.values():
+                for tok, line, held in fs.acquires:
+                    b = graph.global_lock(rel, fs, tok)
+                    for h in held:
+                        add_edge(
+                            graph.global_lock(rel, fs, h), b,
+                            f"with {h} then with {tok} in {fs.qualname} "
+                            f"({rel}:{line})", rel, line, fs.qualname)
+                for site in fs.calls:
+                    if not site.held:
+                        continue
+                    hit = graph.resolve(rel, fs, site)
+                    if hit is None:
+                        continue
+                    crel, callee = hit
+                    for b, chain in graph.acquired_locks(
+                            crel, callee).items():
+                        for h in site.held:
+                            add_edge(
+                                graph.global_lock(rel, fs, h), b,
+                                f"with {h} in {fs.qualname} "
+                                f"({rel}:{site.line}) -> "
+                                + " -> ".join(chain),
+                                rel, site.line, fs.qualname)
+
+        adj: Dict[str, List[str]] = collections.defaultdict(list)
+        for (a, b) in edges:
+            adj[a].append(b)
+
+        def path_back(src: str, dst: str) -> Optional[List[Tuple[str, str]]]:
+            """BFS for a path src -> ... -> dst; returns its edge list."""
+            prev: Dict[str, str] = {src: ""}
+            queue = collections.deque([src])
+            while queue:
+                cur = queue.popleft()
+                if cur == dst:
+                    hops: List[Tuple[str, str]] = []
+                    while prev[cur]:
+                        hops.append((prev[cur], cur))
+                        cur = prev[cur]
+                    return list(reversed(hops))
+                for nxt in adj.get(cur, ()):
+                    if nxt not in prev:
+                        prev[nxt] = cur
+                        queue.append(nxt)
+            return None
+
+        out: List[Finding] = []
+        reported = set()
+        for (a, b) in sorted(edges):
+            back = path_back(b, a)
+            if back is None:
+                continue
+            cycle_nodes = frozenset([a, b] + [x for hop in back for x in hop])
+            if cycle_nodes in reported:
+                continue
+            reported.add(cycle_nodes)
+            desc, rel, line, symbol = edges[(a, b)]
+            back_descs = [edges[hop][0] for hop in back]
+            cyc = " -> ".join([a, b] + [hop[1] for hop in back])
+            out.append(Finding(
+                CHECK_ID, rel, line, symbol,
+                f"potential deadlock: lock-order cycle {cyc}. "
+                f"Acquisition path 1: {desc}. "
+                + " ".join(f"Acquisition path {i + 2}: {d}."
+                           for i, d in enumerate(back_descs))
+                + " Reorder one side (or merge the locks) so every thread "
+                  "acquires them in one global order"))
+        return out

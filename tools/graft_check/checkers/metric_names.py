@@ -156,24 +156,36 @@ class MetricNamesChecker(Checker):
          "every EXPECTED_METRICS family is still constructed somewhere"),
     )
 
+    facts_name = "metric-names"
+
     def __init__(self, expected=EXPECTED_METRICS):
         self._expected = tuple(expected)
-        self._present: Set[str] = set()
-        self._first_mod: Optional[str] = None
+        self._last = None  # (module, scan result): check_module + collect
+        #                    run back-to-back on the same module — one walk
+
+    def _scan(self, mod: ParsedModule):
+        if self._last is None or self._last[0] is not mod:
+            self._last = (mod, scan_module(mod))
+        return self._last[1]
 
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
-        if self._first_mod is None:
-            self._first_mod = mod.relpath
-        bad, names = scan_module(mod)
-        self._present.update(names)
+        bad, _names = self._scan(mod)
         return bad
 
-    def finish(self) -> Iterable[Finding]:
-        out = [Finding(EXPECTED_ID, self._first_mod or "<tree>", 0,
-                       "<module>",
-                       f"expected exported metric {name!r} is no longer "
-                       f"constructed anywhere in the scanned tree")
-               for name in self._expected if name not in self._present]
-        self._present.clear()
-        self._first_mod = None
-        return out
+    def collect(self, mod: ParsedModule):
+        _bad, names = self._scan(mod)
+        return sorted(names)
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        present: Set[str] = set()
+        first_mod: Optional[str] = None
+        if project is not None:
+            for rel, names in project.facts(self.facts_name).items():
+                if first_mod is None:
+                    first_mod = rel
+                present.update(names)
+        return [Finding(EXPECTED_ID, first_mod or "<tree>", 0,
+                        "<module>",
+                        f"expected exported metric {name!r} is no longer "
+                        f"constructed anywhere in the scanned tree")
+                for name in self._expected if name not in present]

@@ -70,42 +70,20 @@ class RpcPairingChecker(Checker):
          "shared constants module"),
     )
 
+    facts_name = "rpc-pairing"
+
     def __init__(self, gcs_module: str = GCS_MODULE,
                  gcs_storage_module: str = GCS_STORAGE_MODULE,
                  method_name_modules: Tuple[str, ...] = METHOD_NAME_MODULES):
         self._gcs_module = gcs_module
         self._storage_module = gcs_storage_module
         self._method_modules = tuple(method_name_modules)
-        self._handled: Set[str] = set()
-        self._tables: Set[str] = set()
-        self._saw_gcs = False
-        self._saw_storage = False
-        #: deferred sites: (finding-args) resolved in finish()
-        self._client_sites: List[Tuple[ParsedModule, ast.Call, str]] = []
-        self._table_sites: List[Tuple[ParsedModule, ast.Call, str]] = []
 
     # -- per module --------------------------------------------------------
 
     def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
         out: List[Finding] = []
-        if mod.relpath.endswith(self._gcs_module):
-            self._saw_gcs = True
-            self._collect_handlers(mod)
-        if mod.relpath.endswith(self._storage_module):
-            self._saw_storage = True
-            self._collect_tables(mod)
         for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Call):
-                base, attr = call_target(node)
-                if attr in _RPC_ATTRS:
-                    t = _dict_type_literal(node)
-                    if t is not None:
-                        self._client_sites.append((mod, node, t))
-                if (attr in _STORAGE_ATTRS and "storage" in base
-                        and node.args
-                        and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    self._table_sites.append((mod, node, node.args[0].value))
             head = (node.value if isinstance(node, ast.Constant)
                     and isinstance(node.value, str) else None)
             if (head and _MAGIC_METHOD_RE.match(head)
@@ -119,61 +97,86 @@ class RpcPairingChecker(Checker):
                     f"definition)"))
         return out
 
-    def _collect_handlers(self, mod: ParsedModule) -> None:
-        """Dispatch arms: any comparison of a name `t`/`type`/`msg_type`
-        against a string literal in the GCS server module."""
+    def collect(self, mod: ParsedModule) -> dict:
+        """Per-module pairing facts: dispatch arms and TABLES defined here
+        (used only when the module IS the configured server/storage
+        module), plus every client RPC-literal and storage-table call
+        site. Pure + picklable, so the cache can replay it."""
+        handlers: Set[str] = set()
+        tables: Set[str] = set()
+        client_sites: List[Tuple[int, str, str]] = []
+        table_sites: List[Tuple[int, str, str]] = []
         for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Compare):
-                continue
-            left = node.left
-            if not (isinstance(left, ast.Name)
-                    and left.id in ("t", "type", "msg_type", "mtype")):
-                continue
-            for comparator in node.comparators:
-                if (isinstance(comparator, ast.Constant)
-                        and isinstance(comparator.value, str)):
-                    self._handled.add(comparator.value)
-                elif isinstance(comparator, (ast.Tuple, ast.Set, ast.List)):
-                    for elt in comparator.elts:
-                        if (isinstance(elt, ast.Constant)
-                                and isinstance(elt.value, str)):
-                            self._handled.add(elt.value)
-
-    def _collect_tables(self, mod: ParsedModule) -> None:
-        """The TABLES = (...) tuple in the storage module."""
-        for node in ast.walk(mod.tree):
-            if (isinstance(node, ast.Assign)
+            if isinstance(node, ast.Compare):
+                left = node.left
+                if (isinstance(left, ast.Name)
+                        and left.id in ("t", "type", "msg_type", "mtype")):
+                    for comparator in node.comparators:
+                        if (isinstance(comparator, ast.Constant)
+                                and isinstance(comparator.value, str)):
+                            handlers.add(comparator.value)
+                        elif isinstance(comparator,
+                                        (ast.Tuple, ast.Set, ast.List)):
+                            for elt in comparator.elts:
+                                if (isinstance(elt, ast.Constant)
+                                        and isinstance(elt.value, str)):
+                                    handlers.add(elt.value)
+            elif (isinstance(node, ast.Assign)
                     and any(isinstance(t, ast.Name) and t.id == "TABLES"
                             for t in node.targets)
                     and isinstance(node.value, (ast.Tuple, ast.List))):
                 for elt in node.value.elts:
                     if (isinstance(elt, ast.Constant)
                             and isinstance(elt.value, str)):
-                        self._tables.add(elt.value)
+                        tables.add(elt.value)
+            elif isinstance(node, ast.Call):
+                base, attr = call_target(node)
+                if attr in _RPC_ATTRS:
+                    t = _dict_type_literal(node)
+                    if t is not None:
+                        client_sites.append(
+                            (node.lineno, mod.symbol_at(node.lineno), t))
+                if (attr in _STORAGE_ATTRS and "storage" in base
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    table_sites.append(
+                        (node.lineno, mod.symbol_at(node.lineno),
+                         node.args[0].value))
+        return {"handlers": sorted(handlers), "tables": sorted(tables),
+                "client_sites": client_sites, "table_sites": table_sites}
 
     # -- tree-wide ---------------------------------------------------------
 
-    def finish(self) -> Iterable[Finding]:
+    def finish(self, project=None) -> Iterable[Finding]:
         out: List[Finding] = []
-        if self._saw_gcs:
-            for mod, node, t in self._client_sites:
-                if t not in self._handled:
-                    out.append(mod.finding(
-                        PAIRING_ID, node,
-                        f"client RPC type {t!r} has no dispatch arm in the "
-                        f"GCS server ({self._gcs_module}) — the call can "
-                        f"only hang or error at runtime"))
-        if self._saw_storage and self._tables:
-            for mod, node, table in self._table_sites:
-                if table not in self._tables:
-                    out.append(mod.finding(
-                        TABLE_ID, node,
-                        f"storage table {table!r} is not created by "
-                        f"gcs_storage.py (TABLES={sorted(self._tables)}) — "
-                        f"the first touch raises sqlite OperationalError"))
-        self._client_sites.clear()
-        self._table_sites.clear()
-        self._handled.clear()
-        self._tables.clear()
-        self._saw_gcs = self._saw_storage = False
+        facts = project.facts(self.facts_name) if project else {}
+        handled: Set[str] = set()
+        tables: Set[str] = set()
+        saw_gcs = saw_storage = False
+        for rel, f in facts.items():
+            if rel.endswith(self._gcs_module):
+                saw_gcs = True
+                handled.update(f["handlers"])
+            if rel.endswith(self._storage_module):
+                saw_storage = True
+                tables.update(f["tables"])
+        for rel, f in facts.items():
+            if saw_gcs:
+                for line, symbol, t in f["client_sites"]:
+                    if t not in handled:
+                        out.append(Finding(
+                            PAIRING_ID, rel, line, symbol,
+                            f"client RPC type {t!r} has no dispatch arm in "
+                            f"the GCS server ({self._gcs_module}) — the "
+                            f"call can only hang or error at runtime"))
+            if saw_storage and tables:
+                for line, symbol, table in f["table_sites"]:
+                    if table not in tables:
+                        out.append(Finding(
+                            TABLE_ID, rel, line, symbol,
+                            f"storage table {table!r} is not created by "
+                            f"gcs_storage.py (TABLES={sorted(tables)}) — "
+                            f"the first touch raises sqlite "
+                            f"OperationalError"))
         return out

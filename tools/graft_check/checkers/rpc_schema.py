@@ -1,0 +1,507 @@
+"""rpc-field-schema: client-sent RPC fields and handler-read fields agree.
+
+`rpc-pairing` proves every client `{"type": ...}` literal has a GCS
+dispatch arm; this checker goes one level deeper and compares the FIELDS.
+For each RPC type it computes (a) the union of keys any client call site
+sends — dict payloads passed to `.rpc`/`.rpc_async`/`._call`/`._rpc`/
+`.send`/`.send_no_reply`: inline literals, `dict(type=..., k=...)` calls,
+simple local builds (`m = {...}; m["k"] = v; m.update(k2=...)`), and
+payloads produced by a helper the call graph can resolve (every `return
+{...}` of the callee) — and (b) the keys the dispatch arm reads:
+`msg["k"]` (hard — KeyError if absent), `msg.get("k")` (soft), and,
+through the call graph, reads performed by helpers the arm forwards `msg`
+to. It fails on:
+
+- a handler `msg["x"]` index no client ever sends — a latent KeyError
+  that surfaces as an 'internal error' reply three hops from the typo;
+- a client-sent field no handler code ever reads — dead wire weight that
+  usually marks a protocol drift (the reader was renamed or removed);
+- a dispatch arm whose type has NO client call site anywhere in the
+  scanned tree — dead protocol surface (or an operator RPC that lost its
+  client).
+
+Conservative by construction: a type with any non-literal client site is
+skipped for field comparison, and an arm that uses `msg` wholesale
+(stores it, iterates it, forwards it outside the scanned tree)
+suppresses dead-field reports for that type. `type` and `rid` (stamped
+by the RPC transport) are always exempt. The GCS server module's own
+`.send` calls are server->client pushes, not client sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graft_check.core import (CallSite, Checker, Finding,
+                                    ParsedModule, call_target)
+
+CHECK_ID = "rpc-field-schema"
+
+#: defaults match the real tree; tests override with fixture paths.
+GCS_MODULE = "_private/gcs.py"
+
+_SEND_ATTRS = {"rpc", "rpc_async", "_call", "_rpc", "send",
+               "send_no_reply"}
+_DISPATCH_VARS = {"t", "type", "msg_type", "mtype"}
+#: fields the transport stamps / the dispatcher itself consumes.
+_EXEMPT_FIELDS = {"type", "rid"}
+#: string constants that could name an RPC type (for the dead-arm check's
+#: escape hatch: a payload built too dynamically to resolve still has to
+#: SPELL its type literal somewhere).
+_TYPEISH_RE = re.compile(r"^[a-z][a-z0-9_]{2,40}$")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _own_walk(node):
+    """Source-order walk over a function's OWN body: nested function /
+    lambda bodies are skipped (they get their own pass)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+            yield from _own_walk(child)
+
+
+def _var_reads(var: str, body: List[ast.stmt]) -> dict:
+    """How `var` (a message dict) is consumed inside `body`:
+    {"hard": {key: line}, "soft": [keys], "forwards": [(recv, name, line,
+    argpos, kwname)], "wholesale": bool}."""
+    hard: Dict[str, int] = {}
+    soft: Set[str] = set()
+    forwards: List[Tuple[str, str, int, int, str]] = []
+    consumed: Set[int] = set()
+    dynamic_read = False
+    nodes = [n for stmt in body for n in ast.walk(stmt)]
+    for node in nodes:
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == var):
+            key = _const_str(node.slice)
+            consumed.add(id(node.value))
+            if isinstance(node.ctx, ast.Load):
+                if key is not None:
+                    hard.setdefault(key, node.lineno)
+                else:
+                    # msg[k] with a computed key: ANY field may be read —
+                    # dead-field reports for this arm would be guesses
+                    dynamic_read = True
+            # store/del: handler-created fields, not reads
+        elif isinstance(node, ast.Call):
+            base, attr = call_target(node)
+            if (attr == "get" and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == var and node.args):
+                key = _const_str(node.args[0])
+                consumed.add(id(node.func.value))
+                if key is not None:
+                    soft.add(key)
+                continue
+            if attr:
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and arg.id == var:
+                        consumed.add(id(arg))
+                        forwards.append((base, attr, node.lineno, pos, ""))
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id == var and kw.arg:
+                        consumed.add(id(kw.value))
+                        forwards.append((base, attr, node.lineno, -1,
+                                         kw.arg))
+    wholesale = dynamic_read or any(
+        isinstance(n, ast.Name) and n.id == var
+        and isinstance(n.ctx, ast.Load) and id(n) not in consumed
+        for n in nodes)
+    return {"hard": hard, "soft": sorted(soft), "forwards": forwards,
+            "wholesale": wholesale}
+
+
+def _dict_expr(node) -> Optional[Tuple[Optional[str], List[str], bool]]:
+    """(type, keys, complete) for a dict-building expression — a literal
+    `{...}` or a `dict(...)` call — or None if it isn't one."""
+    if isinstance(node, ast.Dict):
+        keys: List[str] = []
+        complete = True
+        typ = None
+        for k, v in zip(node.keys, node.values):
+            if k is None:  # **expansion
+                complete = False
+                continue
+            ks = _const_str(k)
+            if ks is None:
+                complete = False
+                continue
+            keys.append(ks)
+            if ks == "type":
+                typ = _const_str(v)
+        return typ, keys, complete
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "dict" and not node.args):
+        keys, complete, typ = [], True, None
+        for kw in node.keywords:
+            if kw.arg is None:
+                complete = False
+                continue
+            keys.append(kw.arg)
+            if kw.arg == "type":
+                typ = _const_str(kw.value)
+        return typ, keys, complete
+    return None
+
+
+class _LocalDicts:
+    """Track `m = {...}` / `m = dict(...)` / `m = helper()` local message
+    builds plus `m["k"] = v` and `m.update(...)` augmentations within one
+    function body. Entries: ("lit", type, keys, complete) or
+    ("call", recv, name) or ("opaque",)."""
+
+    def __init__(self, fnode):
+        self.entries: Dict[str, tuple] = {}
+        for stmt in _own_walk(fnode):
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                name = stmt.targets[0].id
+                if (isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is None):
+                    continue  # `m = None` sentinel init: neutral
+                dk = _dict_expr(stmt.value)
+                prev = self.entries.get(name)
+                if dk is not None and (prev is None or (
+                        prev[0] == "lit" and prev[1] == dk[0])):
+                    # first build, or a same-type branch rebuild: union the
+                    # keys (either branch may be the one sent)
+                    keys = (list(prev[2]) if prev else []) + list(dk[1])
+                    complete = dk[2] and (prev is None or prev[3])
+                    self.entries[name] = ("lit", dk[0], keys, complete)
+                elif prev is not None:
+                    self.entries[name] = ("opaque",)  # diverged: give up
+                elif isinstance(stmt.value, ast.Call):
+                    base, attr = call_target(stmt.value)
+                    self.entries[name] = (("call", base, attr) if attr
+                                          else ("opaque",))
+                else:
+                    self.entries[name] = ("opaque",)
+            elif (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Subscript)
+                    and isinstance(stmt.targets[0].value, ast.Name)
+                    and stmt.targets[0].value.id in self.entries):
+                self._augment(stmt.targets[0].value.id,
+                              [_const_str(stmt.targets[0].slice)])
+            elif isinstance(stmt, ast.Call):
+                base, attr = call_target(stmt)
+                if (attr == "update"
+                        and isinstance(stmt.func, ast.Attribute)
+                        and isinstance(stmt.func.value, ast.Name)
+                        and stmt.func.value.id in self.entries):
+                    keys: List[Optional[str]] = []
+                    for kw in stmt.keywords:
+                        keys.append(kw.arg)  # None (**) poisons
+                    for arg in stmt.args:
+                        dk = _dict_expr(arg)
+                        if dk is None:
+                            keys.append(None)
+                        else:
+                            keys.extend(dk[1])
+                            if not dk[2]:
+                                keys.append(None)
+                    self._augment(stmt.func.value.id, keys)
+
+    def _augment(self, name: str, keys: List[Optional[str]]) -> None:
+        entry = self.entries[name]
+        if entry[0] != "lit":
+            return
+        _tag, typ, cur, complete = entry
+        for k in keys:
+            if k is None:
+                complete = False
+            else:
+                cur.append(k)
+        self.entries[name] = ("lit", typ, cur, complete)
+
+    def get(self, name: str) -> Optional[tuple]:
+        return self.entries.get(name)
+
+
+class RpcFieldSchemaChecker(Checker):
+    ids = ((CHECK_ID,
+            "every field a GCS dispatch arm hard-reads is sent by some "
+            "client site, every client-sent field is read by the handler "
+            "(through the call graph), and every arm has a client"),)
+
+    facts_name = "rpc-schema"
+
+    def __init__(self, gcs_module: str = GCS_MODULE):
+        self._gcs_module = gcs_module
+
+    # -- per module --------------------------------------------------------
+
+    def collect(self, mod: ParsedModule) -> dict:
+        #: (type, func qual, reads, arm line)
+        arms: List[Tuple[str, str, dict, int]] = []
+        param_reads: Dict[Tuple[str, str], dict] = {}
+        #: ("lit", type, keys, complete, line, symbol) |
+        #: ("call", recv, name, caller qual, line, symbol)
+        client_sites: List[tuple] = []
+        #: function qual -> [("lit", type, keys, complete) | ("call", ...)]
+        returns: Dict[str, List[tuple]] = {}
+        #: every type-shaped string literal in the module — the dead-arm
+        #: check's escape hatch for dynamically-built payloads
+        strings: Set[str] = set()
+
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _TYPEISH_RE.match(node.value)):
+                strings.add(node.value)
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = mod.symbol_at(node.lineno)
+            if not qual.endswith(node.name):
+                qual = node.name
+            # (a) dispatch arms: find `t = msg["type"]`, then every
+            # `if t == "x":` arm and what it reads from msg
+            tvar = msgvar = None
+            for stmt in _own_walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id in _DISPATCH_VARS
+                        and isinstance(stmt.value, ast.Subscript)
+                        and isinstance(stmt.value.value, ast.Name)
+                        and _const_str(stmt.value.slice) == "type"):
+                    tvar = stmt.targets[0].id
+                    msgvar = stmt.value.value.id
+                    break
+            if tvar is not None:
+                for iff in _own_walk(node):
+                    if not (isinstance(iff, ast.If)
+                            and isinstance(iff.test, ast.Compare)
+                            and isinstance(iff.test.left, ast.Name)
+                            and iff.test.left.id == tvar):
+                        continue
+                    types: List[str] = []
+                    for comp in iff.test.comparators:
+                        ts = _const_str(comp)
+                        if ts is not None:
+                            types.append(ts)
+                        elif isinstance(comp, (ast.Tuple, ast.Set,
+                                               ast.List)):
+                            types.extend(
+                                t for t in map(_const_str, comp.elts)
+                                if t is not None)
+                    if not types:
+                        continue
+                    reads = _var_reads(msgvar, iff.body)
+                    for t in types:
+                        arms.append((t, qual, reads, iff.lineno))
+            # (b) per-(function, param) message reads, for forwarded msgs
+            params = [a.arg for a in (node.args.posonlyargs
+                                      + node.args.args)]
+            for p in params:
+                if p in ("self", "cls"):
+                    continue
+                reads = _var_reads(p, node.body)
+                if reads["hard"] or reads["soft"] or reads["forwards"]:
+                    param_reads[(qual, p)] = reads
+            # (c) client send sites and dict-returning helpers
+            local = _LocalDicts(node)
+
+            def _payload(expr, local=local, qual=qual):
+                """Resolve a payload expression to a tagged record."""
+                dk = _dict_expr(expr)
+                if dk is not None:
+                    return ("lit", dk[0], tuple(dk[1]), dk[2])
+                if isinstance(expr, ast.Name):
+                    ent = local.get(expr.id)
+                    if ent is not None and ent[0] == "lit":
+                        return ("lit", ent[1], tuple(ent[2]), ent[3])
+                    if ent is not None and ent[0] == "call":
+                        return ("call", ent[1], ent[2], qual)
+                    return None
+                if isinstance(expr, ast.Call):
+                    base, attr = call_target(expr)
+                    if attr:
+                        return ("call", base, attr, qual)
+                return None
+
+            for stmt in _own_walk(node):
+                if isinstance(stmt, ast.Call):
+                    _base, attr = call_target(stmt)
+                    if attr in _SEND_ATTRS and stmt.args:
+                        rec = _payload(stmt.args[0])
+                        if rec is not None and not (rec[0] == "lit"
+                                                    and rec[1] is None):
+                            client_sites.append(
+                                rec + (stmt.lineno,
+                                       mod.symbol_at(stmt.lineno)))
+                elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                    rec = _payload(stmt.value)
+                    if rec is not None and not (rec[0] == "lit"
+                                                and rec[1] is None):
+                        returns.setdefault(qual, []).append(rec[:4])
+        return {"arms": arms, "param_reads": param_reads,
+                "client_sites": client_sites, "returns": returns,
+                "strings": sorted(strings)}
+
+    # -- tree-wide ---------------------------------------------------------
+
+    def _effective_reads(self, project, rel: str, qual: str,
+                         reads: dict, seen: Set) -> Tuple[
+                             Dict[str, int], Set[str], bool]:
+        """(hard, soft, wholesale) of an arm/helper, following forwarded
+        `msg` params through the call graph."""
+        hard = dict(reads["hard"])
+        soft = set(reads["soft"])
+        wholesale = reads["wholesale"]
+        caller = project.summaries.get(rel)
+        caller_fs = caller.functions.get(qual) if caller else None
+        for recv, name, line, argpos, kwname in reads["forwards"]:
+            hit = None
+            if caller_fs is not None:
+                hit = project.graph.resolve(
+                    rel, caller_fs,
+                    CallSite(line, recv, name, (), False, False))
+            if hit is None:
+                wholesale = True  # msg left the scanned tree
+                continue
+            crel, callee = hit
+            if kwname:
+                param = kwname
+            else:
+                pos = argpos + (1 if callee.params[:1] in (("self",),
+                                                           ("cls",))
+                                else 0)
+                if pos >= len(callee.params):
+                    wholesale = True
+                    continue
+                param = callee.params[pos]
+            key = (crel, callee.qualname, param)
+            if key in seen:
+                continue
+            seen.add(key)
+            sub = project.facts(self.facts_name).get(crel, {}) or {}
+            sub_reads = sub.get("param_reads", {}).get(
+                (callee.qualname, param))
+            if sub_reads is None:
+                continue  # helper never touches the dict's fields
+            h, s, w = self._effective_reads(project, crel, callee.qualname,
+                                            sub_reads, seen)
+            hard.update(h)
+            soft.update(s)
+            wholesale = wholesale or w
+        return hard, soft, wholesale
+
+    def _expand_site(self, project, rel: str, site: tuple, out: List[tuple],
+                     depth: int = 0) -> None:
+        """Resolve a tagged client-site record to ("lit", ...) payloads —
+        following helper-returned dicts through the call graph."""
+        if site[0] == "lit":
+            _tag, typ, keys, complete, line, symbol = site
+            if typ is not None:
+                out.append((typ, keys, complete, rel, line, symbol))
+            return
+        _tag, recv, name, qual, line, symbol = site
+        if depth >= 4:
+            return
+        summary = project.summaries.get(rel)
+        caller_fs = summary.functions.get(qual) if summary else None
+        if caller_fs is None:
+            return
+        hit = project.graph.resolve(
+            rel, caller_fs, CallSite(line, recv, name, (), False, False))
+        if hit is None:
+            return
+        crel, callee = hit
+        rets = (project.facts(self.facts_name).get(crel, {}) or {}).get(
+            "returns", {}).get(callee.qualname, ())
+        for ret in rets:
+            self._expand_site(project, crel,
+                              ret + (line, symbol) if ret[0] == "lit"
+                              else (ret[0], ret[1], ret[2],
+                                    callee.qualname, callee.line, symbol),
+                              out, depth + 1)
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        if project is None:
+            return ()
+        facts = project.facts(self.facts_name)
+        #: type -> merged arm info
+        arms: Dict[str, dict] = {}
+        gcs_rels = [rel for rel in facts if rel.endswith(self._gcs_module)]
+        for rel in gcs_rels:
+            for typ, qual, reads, line in (facts[rel] or {}).get("arms", ()):
+                hard, soft, wholesale = self._effective_reads(
+                    project, rel, qual, reads, set())
+                arm = arms.setdefault(
+                    typ, {"hard": {}, "soft": set(), "wholesale": False,
+                          "rel": rel, "qual": qual, "line": line})
+                arm["hard"].update(hard)
+                arm["soft"].update(soft)
+                arm["wholesale"] = arm["wholesale"] or wholesale
+        if not arms:
+            return ()
+        #: type -> union of client-sent keys + per-site anchors
+        sent: Dict[str, dict] = {}
+        #: type strings mentioned ANYWHERE outside the server module: a
+        #: client too dynamic to resolve still spells its type literal, so
+        #: only a type mentioned nowhere is truly clientless
+        mentioned: Set[str] = set()
+        for rel, f in facts.items():
+            if rel.endswith(self._gcs_module):
+                continue  # the server's own sends are pushes, not requests
+            mentioned.update((f or {}).get("strings", ()))
+            for site in (f or {}).get("client_sites", ()):
+                expanded: List[tuple] = []
+                self._expand_site(project, rel, site, expanded)
+                for typ, keys, complete, srel, line, symbol in expanded:
+                    ent = sent.setdefault(
+                        typ, {"keys": set(), "complete": True, "sites": []})
+                    ent["keys"].update(keys)
+                    ent["complete"] = ent["complete"] and complete
+                    ent["sites"].append((keys, srel, line, symbol))
+        out: List[Finding] = []
+        for typ in sorted(arms):
+            arm = arms[typ]
+            ent = sent.get(typ)
+            if ent is None:
+                if typ not in mentioned:
+                    out.append(Finding(
+                        CHECK_ID, arm["rel"], arm["line"], arm["qual"],
+                        f"dispatch arm for RPC type {typ!r} has no client "
+                        f"call site (and the type string appears nowhere "
+                        f"else in the scanned tree) — dead protocol "
+                        f"surface, or an operator RPC that lost its "
+                        f"client; remove the arm or add the client"))
+                continue
+            if not ent["complete"]:
+                continue  # some payload unresolvable: nothing to compare
+            union = ent["keys"]
+            for key in sorted(arm["hard"]):
+                if key in _EXEMPT_FIELDS or key in union:
+                    continue
+                out.append(Finding(
+                    CHECK_ID, arm["rel"], arm["hard"][key], arm["qual"],
+                    f"handler for RPC {typ!r} hard-reads msg[{key!r}] but "
+                    f"no client call site ever sends {key!r} "
+                    f"({len(ent['sites'])} resolvable site(s) checked) — "
+                    f"latent KeyError; send the field or use .get()"))
+            if arm["wholesale"]:
+                continue
+            read = set(arm["hard"]) | arm["soft"]
+            for key in sorted(union - read - _EXEMPT_FIELDS):
+                keys, srel, line, symbol = next(
+                    s for s in ent["sites"] if key in s[0])
+                out.append(Finding(
+                    CHECK_ID, srel, line, symbol,
+                    f"client sends field {key!r} in RPC {typ!r} but the "
+                    f"handler (and every helper it forwards msg to) never "
+                    f"reads it — dead wire weight or protocol drift; drop "
+                    f"the field or read it server-side"))
+        return out

@@ -1,0 +1,77 @@
+"""transitive-blocking: `async-blocking`, extended through the call graph.
+
+`async-blocking` flags a `time.sleep` / sync `.rpc` / seqlock wait written
+directly inside an `async def`; this checker flags the same primitives
+when they hide one or more calls down: an `async def` calling a sync
+helper whose (transitive) body sleeps or does a blocking GCS round trip
+stalls the event loop exactly the same, but no single function shows the
+defect. Each finding is anchored at the call site inside the `async def`
+and carries the full call chain down to the blocking primitive, so the
+report reads like a stack trace.
+
+Precision rules: only calls the shared call graph can actually resolve
+are followed (bare/imported module-level functions, `self.`/`cls.`
+methods, `ClassName(...)` constructors); awaited calls and `timeout=0`
+polls are exempt; async callees don't count (calling one just builds a
+coroutine); generator functions don't count (calling one doesn't run the
+body); calls that `async-blocking` already flags directly are skipped so
+one defect never yields two findings.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from tools.graft_check.core import (BLOCKING_ATTRS, BLOCKING_QUALIFIED,
+                                    CHANNEL_ATTRS, RAY_BLOCKING, CallSite,
+                                    Checker, Finding, is_channel_receiver)
+
+CHECK_ID = "transitive-blocking"
+
+
+def _directly_flagged(site: CallSite) -> bool:
+    """Would `async-blocking` already report this exact call site?"""
+    if (site.recv, site.name) in BLOCKING_QUALIFIED:
+        return True
+    if site.recv.split(".")[-1] == "ray_tpu" and site.name in RAY_BLOCKING:
+        return True
+    if site.name in BLOCKING_ATTRS:
+        return True
+    return site.name in CHANNEL_ATTRS and is_channel_receiver(site.recv)
+
+
+class TransitiveBlockingChecker(Checker):
+    ids = ((CHECK_ID,
+            "no sync helper reachable from an `async def` (through the "
+            "call graph) may sleep or do a blocking GCS/channel wait"),)
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        if project is None:
+            return ()
+        graph = project.graph
+        out: List[Finding] = []
+        for rel, summary in project.summaries.items():
+            for fs in summary.functions.values():
+                if not fs.is_async:
+                    continue
+                for site in fs.calls:
+                    if site.awaited or site.poll or _directly_flagged(site):
+                        continue
+                    hit = graph.resolve(rel, fs, site)
+                    if hit is None:
+                        continue
+                    crel, callee = hit
+                    if callee.is_async or callee.is_generator:
+                        continue
+                    chain = graph.blocking_chain(crel, callee)
+                    if chain is None:
+                        continue
+                    out.append(Finding(
+                        CHECK_ID, rel, site.line, fs.qualname,
+                        f"`async def {fs.name}` reaches a blocking call "
+                        f"through {callee.qualname}(): "
+                        + " -> ".join(chain)
+                        + " — the event loop stalls for every task on it; "
+                          "await an async variant, or run the helper in an "
+                          "executor"))
+        return out
