@@ -124,8 +124,15 @@ class ShmObjectStore:
             f = open(self._path(object_hex), "rb")
         except FileNotFoundError:
             f = open(self._spill_path(object_hex), "rb")
-        size = os.fstat(f.fileno()).st_size
-        mm = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        try:
+            size = os.fstat(f.fileno()).st_size
+            mm = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        except BaseException:
+            # mmap raises on an empty/truncated file; the workers calling
+            # get() under memory pressure are exactly the ones that cannot
+            # afford to bleed one fd per failed read
+            f.close()
+            raise
         return PlasmaObject(memoryview(mm), mm, f)
 
     def contains(self, object_hex: str) -> bool:

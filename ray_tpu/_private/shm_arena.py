@@ -293,8 +293,12 @@ class ArenaStore:
                     f"object {object_hex} not in arena (evicted?)") from None
             from ray_tpu._private.object_store import PlasmaObject
 
-            n = os.fstat(f.fileno()).st_size
-            mm = mmap.mmap(f.fileno(), n, prot=mmap.PROT_READ)
+            try:
+                n = os.fstat(f.fileno()).st_size
+                mm = mmap.mmap(f.fileno(), n, prot=mmap.PROT_READ)
+            except BaseException:
+                f.close()  # mmap of an empty/torn spill file raises
+                raise
             return PlasmaObject(memoryview(mm), mm, f)
         view = memoryview(self._mm)[off:off + size.value]
         obj = _ArenaObject(view, self, object_hex)

@@ -1137,12 +1137,22 @@ class ChannelExecutor:
         # surviving writer parked on the ack needs force_ack to move.
         remap: dict[str, MutableShmChannel] = {}
         flags: dict[str, tuple[bool, bool]] = {}
-        for path, (src, dst) in list(self._ends.items()):
-            if src in dead or dst in dead:
-                remap[path] = create_mutable_channel(self._buffer_bytes)
-                flags[path] = (
-                    src in dead and dst not in dead and dst != "driver",
-                    dst in dead)
+        try:
+            for path, (src, dst) in list(self._ends.items()):
+                if src in dead or dst in dead:
+                    remap[path] = create_mutable_channel(self._buffer_bytes)
+                    flags[path] = (
+                        src in dead and dst not in dead and dst != "driver",
+                        dst in dead)
+        except BaseException:
+            # a failed create mid-loop (ENOSPC on /dev/shm is the likely
+            # one during an incident) must not strand the replacements
+            # already created: they are not yet in _all_chans, so neither
+            # teardown nor degrade would ever unlink them
+            for ch in remap.values():
+                ch.close()
+                ch.unlink()
+            raise
         replaced = self._apply_remap(remap)
         self._stale.extend((ch, *flags[ch.path]) for ch in replaced)
 

@@ -44,15 +44,25 @@ class MutableShmChannel:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
             try:
                 os.ftruncate(fd, _HDR_SIZE + capacity)
-            except OSError:
+                self._mm = mmap.mmap(fd, _HDR_SIZE + capacity)
+            except BaseException:
+                # the O_EXCL create already burned the NAME: rolling back
+                # only the fd would leave a zero-reader tmpfs file no
+                # teardown sweep owns (creation failed, so no handle with
+                # _creator=True will ever unlink it)
                 os.close(fd)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
                 raise
+            os.close(fd)
         else:
             fd = os.open(path, os.O_RDWR)
-        try:
-            self._mm = mmap.mmap(fd, _HDR_SIZE + capacity)
-        finally:
-            os.close(fd)
+            try:
+                self._mm = mmap.mmap(fd, _HDR_SIZE + capacity)
+            finally:
+                os.close(fd)
 
     # ------------------------------------------------------------- header
 

@@ -115,15 +115,26 @@ class PagedKVExporter:
                 f"min_bucket >= page_size")
         n_pages = T // page_size
         page_bytes = (k.nbytes + v.nbytes) // n_pages
-        ch = create_mutable_channel(page_bytes + _WIRE_SLACK)
         tid = uuid.uuid4().hex[:16]
-        tr = _Transfer(tid, ch, trace_ctx)
-        with self._lock:
-            self._live[tid] = tr
-        tr.thread = threading.Thread(
-            target=self._send, args=(tr, k, v, page_size, n_pages),
-            daemon=True, name=f"pd-kv-send-{tid[:6]}")
-        tr.thread.start()
+        ch = create_mutable_channel(page_bytes + _WIRE_SLACK)
+        try:
+            tr = _Transfer(tid, ch, trace_ctx)
+            with self._lock:
+                self._live[tid] = tr
+            tr.thread = threading.Thread(
+                target=self._send, args=(tr, k, v, page_size, n_pages),
+                daemon=True, name=f"pd-kv-send-{tid[:6]}")
+            # thread spawn can fail (ulimit/fragmentation under load);
+            # until start() succeeds the sender's finally owns nothing, so
+            # the segment (and the ticket registration) must be rolled
+            # back here or /dev/shm leaks one segment per failed export
+            tr.thread.start()
+        except BaseException:
+            with self._lock:
+                self._live.pop(tid, None)
+            ch.close()
+            ch.unlink()
+            raise
         return {
             "ticket": tid,
             "path": ch.path,

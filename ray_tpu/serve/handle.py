@@ -432,57 +432,61 @@ class DeploymentHandle:
                 break
             t_pick = _time.perf_counter()
             replica_id = self._router.pick(_routing_hint)
-            _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
-                              _time.perf_counter() - t_pick)
-            t_rtt = _time.perf_counter()
-            ch = None
-            addr = self._router.addrs.get(replica_id)
-            if addr is not None:
+            # ONE release per attempt, in the outer finally: return,
+            # continue and raise all route through it, so nothing between
+            # pick() and the transport call (phase observes, address
+            # lookup) can strand the router's in-flight slot — the slot
+            # leak class the resource-leak static check flags
+            try:
+                _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
+                                  _time.perf_counter() - t_pick)
+                t_rtt = _time.perf_counter()
+                ch = None
+                addr = self._router.addrs.get(replica_id)
+                if addr is not None:
+                    try:
+                        ch = _get_channel(addr)
+                    except OSError:
+                        # unroutable from THIS host (not replica death):
+                        # the actor plane below still works — don't drop it
+                        ch = None
+                if ch is not None:
+                    # fast data plane: one framed round-trip on a
+                    # persistent socket, no per-request task submission
+                    try:
+                        result = ch.call(self._method, args, kwargs,
+                                         self._model_id, remaining, tctx)
+                        _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
+                                          _time.perf_counter() - t_rtt)
+                        return result
+                    except TimeoutError as e:
+                        last = e
+                        continue  # deadline loop exits when budget spent
+                    except ActorDiedError as e:
+                        # transport failures surface ONLY as
+                        # ActorDiedError (submit/recv wrap socket errors)
+                        # — a user exception that happens to subclass
+                        # OSError must NOT be read as replica death and
+                        # drop a healthy replica
+                        last = e
+                        self._router.drop(replica_id)
+                        continue
+                replica = ActorHandle(replica_id)
                 try:
-                    ch = _get_channel(addr)
-                except OSError:
-                    # unroutable from THIS host (not replica death): the
-                    # actor plane below still works — don't drop it
-                    ch = None
-            if ch is not None:
-                # fast data plane: one framed round-trip on a persistent
-                # socket, no per-request task submission
-                try:
-                    result = ch.call(self._method, args, kwargs,
-                                     self._model_id, remaining, tctx)
-                    _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
-                                      _time.perf_counter() - t_rtt)
-                    return result
-                except TimeoutError as e:
-                    last = e
-                    continue  # deadline loop exits when budget is spent
-                except ActorDiedError as e:
-                    # transport failures surface ONLY as ActorDiedError
-                    # (submit/recv wrap socket errors) — a user exception
-                    # that happens to subclass OSError must NOT be read
-                    # as replica death and drop a healthy replica
+                    ref = replica.handle_request.remote(
+                        self._method, args, kwargs, self._model_id)
+                except Exception as e:  # submission failed: replica gone
                     last = e
                     self._router.drop(replica_id)
                     continue
-                finally:
-                    self._router.done(replica_id)
-            replica = ActorHandle(replica_id)
-            try:
-                ref = replica.handle_request.remote(
-                    self._method, args, kwargs, self._model_id)
-            except Exception as e:  # submission failed: replica gone
-                last = e
-                self._router.done(replica_id)
-                self._router.drop(replica_id)
-                continue
-            try:
-                result = ray_tpu.get(ref, timeout=remaining)
-                _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
-                                  _time.perf_counter() - t_rtt)
-                return result
-            except (ActorDiedError, WorkerCrashedError) as e:
-                last = e
-                self._router.drop(replica_id)
+                try:
+                    result = ray_tpu.get(ref, timeout=remaining)
+                    _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
+                                      _time.perf_counter() - t_rtt)
+                    return result
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    last = e
+                    self._router.drop(replica_id)
             finally:
                 self._router.done(replica_id)
         raise last
@@ -507,45 +511,56 @@ class DeploymentHandle:
         for _ in range(3):  # retry on replica death with a fresh table
             t_pick = time.perf_counter()
             replica_id = self._router.pick(hint)
-            _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
-                              time.perf_counter() - t_pick)
-            if not self._stream and not has_refs:
-                addr = self._router.addrs.get(replica_id)
-                ch = None
-                if addr is not None:
-                    try:
-                        ch = _get_channel(addr)
-                    except OSError:
-                        ch = None  # unroutable from here: actor plane below
-                if ch is not None:
-                    try:
-                        pending = ch.submit(
-                            self._method, args, kwargs, self._model_id, tctx)
-                        return _FastResponse(
-                            pending,
-                            lambda r=replica_id: self._router.done(r))
-                    except Exception as e:  # channel down: drop + retry
-                        last_err = e
-                        self._router.done(replica_id)
-                        self._router.drop(replica_id)
-                        continue
-            replica = ActorHandle(replica_id)
+            # on success the slot's release rides the response object's
+            # on_done closure; every OTHER exit from this attempt —
+            # handled submit failures below, but also an unexpected raise
+            # from instrumentation or response construction — must
+            # release it here, or the dead attempt skews pow2 routing
+            # against this replica forever
             try:
-                if self._stream:
-                    gen = replica.handle_request_stream.options(
-                        num_returns="streaming").remote(
+                _rc.observe_phase(_rc.HANDLE_PHASE, "pick",
+                                  time.perf_counter() - t_pick)
+                if not self._stream and not has_refs:
+                    addr = self._router.addrs.get(replica_id)
+                    ch = None
+                    if addr is not None:
+                        try:
+                            ch = _get_channel(addr)
+                        except OSError:
+                            ch = None  # unroutable: actor plane below
+                    if ch is not None:
+                        try:
+                            pending = ch.submit(
+                                self._method, args, kwargs,
+                                self._model_id, tctx)
+                            return _FastResponse(
+                                pending,
+                                lambda r=replica_id: self._router.done(r))
+                        except Exception as e:  # channel down: drop+retry
+                            last_err = e
+                            self._router.done(replica_id)
+                            self._router.drop(replica_id)
+                            continue
+                replica = ActorHandle(replica_id)
+                try:
+                    if self._stream:
+                        gen = replica.handle_request_stream.options(
+                            num_returns="streaming").remote(
+                            self._method, args, kwargs, self._model_id)
+                        return DeploymentResponseGenerator(
+                            gen, lambda r=replica_id: self._router.done(r),
+                            self._stream_item_timeout_s)
+                    ref = replica.handle_request.remote(
                         self._method, args, kwargs, self._model_id)
-                    return DeploymentResponseGenerator(
-                        gen, lambda r=replica_id: self._router.done(r),
-                        self._stream_item_timeout_s)
-                ref = replica.handle_request.remote(self._method, args, kwargs,
-                                                    self._model_id)
-                return DeploymentResponse(
-                    ref, lambda r=replica_id: self._router.done(r))
-            except Exception as e:
-                last_err = e
+                    return DeploymentResponse(
+                        ref, lambda r=replica_id: self._router.done(r))
+                except Exception as e:
+                    last_err = e
+                    self._router.done(replica_id)
+                    self._router.drop(replica_id)
+            except BaseException:
                 self._router.done(replica_id)
-                self._router.drop(replica_id)
+                raise
         raise RuntimeError(f"could not assign request to {self._name}: {last_err}")
 
     def __reduce__(self):
