@@ -18,10 +18,13 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import json
+import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
+
+logger = logging.getLogger(__name__)
 
 
 class _BadRequest(Exception):
@@ -39,8 +42,8 @@ def _observe_accept(seconds: float) -> None:
         from ray_tpu.serve import request_context as rc
 
         rc.observe_phase(rc.PROXY_PHASE, "accept", seconds)
-    except Exception:  # noqa: BLE001 — metrics must never fail a request
-        pass
+    except Exception as e:  # noqa: BLE001 — must never fail a request
+        logger.debug("proxy accept-phase metric emit failed: %r", e)
 
 
 class AsyncHTTPServer:
@@ -144,8 +147,8 @@ class AsyncHTTPServer:
                         + f"Content-Length: {len(body)}\r\n".encode()
                         + b"Connection: close\r\n\r\n" + body)
                     await writer.drain()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # client hung up before reading the 400
             except (asyncio.IncompleteReadError, ConnectionResetError,
                     asyncio.LimitOverrunError, BrokenPipeError):
                 pass
@@ -153,8 +156,8 @@ class AsyncHTTPServer:
                 try:
                     writer.close()
                     await writer.wait_closed()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # peer already reset the connection
 
     async def _read_request(self, reader: asyncio.StreamReader):
         try:
@@ -246,8 +249,9 @@ class AsyncHTTPServer:
                 if close is not None:
                     try:
                         close()  # release the deployment generator
-                    except Exception:
-                        pass
+                    except Exception as e:  # noqa: BLE001 — user generator
+                        logger.debug("stream generator close() raised "
+                                     "during teardown: %r", e)
 
         self._executor.submit(pump)
         try:

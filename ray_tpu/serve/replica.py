@@ -18,6 +18,7 @@ address is known.)
 from __future__ import annotations
 
 import inspect
+import logging
 import os
 import threading
 import time
@@ -27,6 +28,8 @@ import ray_tpu
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_tcp
 from ray_tpu.serve import request_context as _rc
 from ray_tpu.util import tracing as _tracing
+
+logger = logging.getLogger(__name__)
 
 _replica_ctx = threading.local()
 
@@ -166,8 +169,12 @@ class ReplicaActor:
 
             _get_controller().note_replica_addr.remote(
                 self.deployment_name, self.replica_tag, self._rpc_addr)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — actor plane still works
+            # losing this push silently demotes the replica to the slow
+            # actor plane until the stats loop re-advertises (~5s): worth
+            # a log line, never worth failing __init__
+            logger.debug("replica %s: fast-RPC addr push failed: %r",
+                         self.replica_tag, e)
 
     def rpc_address(self) -> tuple | None:
         return self._rpc_addr
@@ -225,8 +232,10 @@ class ReplicaActor:
             return
         except (ConnectionClosed, OSError):
             return  # client gone: nothing to reply to
-        except Exception:  # noqa: BLE001 — frame pickle rejected the payload
-            pass
+        except Exception as e:  # noqa: BLE001 — frame pickle rejected payload
+            logger.debug("replica %s rid=%s: frame pickle rejected the "
+                         "reply, retrying with cloudpickle: %r",
+                         self.replica_tag, rid, e)
         # parity with the actor plane: stdlib pickle (the frame codec)
         # can't take lambdas/closures that cloudpickle can — retry the
         # payload through the runtime's serializer before giving up
@@ -242,8 +251,10 @@ class ReplicaActor:
             return
         except (ConnectionClosed, OSError):
             return
-        except Exception:  # noqa: BLE001 — truly unserializable
-            pass
+        except Exception as e:  # noqa: BLE001 — truly unserializable
+            logger.debug("replica %s rid=%s: cloudpickle also rejected the "
+                         "reply, shipping a string stand-in: %r",
+                         self.replica_tag, rid, e)
         # the rid MUST get a reply or the caller waits forever: ship a
         # plain-string stand-in for whatever refused to serialize
         try:
@@ -252,8 +263,10 @@ class ReplicaActor:
                            "reply not serializable over fast-rpc: "
                            + (reply.get("error_text")
                               or type(reply.get("result")).__name__))})
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — caller times out instead
+            logger.warning("replica %s rid=%s: could not deliver ANY "
+                           "reply (caller will time out): %r",
+                           self.replica_tag, rid, e)
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        model_id: str | None = None):
@@ -294,8 +307,9 @@ class ReplicaActor:
         try:
             self._m_requests.inc()
             self._m_latency.observe(elapsed_s * 1e3)
-        except Exception:
-            pass  # metrics must never fail a request
+        except Exception as e:  # noqa: BLE001 — must never fail a request
+            logger.debug("replica %s: request metrics emit failed: %r",
+                         self.replica_tag, e)
 
     def _record_phases(self, method: str, wall_start: float, wait_s: float,
                        exec_s: float, ok: bool) -> None:
@@ -309,8 +323,9 @@ class ReplicaActor:
                 wall_start, wall_start + wait_s + exec_s, ok=ok,
                 deployment=self.deployment_name, replica=self.replica_tag,
                 queue_wait_s=round(wait_s, 6), execute_s=round(exec_s, 6))
-        except Exception:
-            pass  # instrumentation must never fail a request
+        except Exception as e:  # noqa: BLE001 — must never fail a request
+            logger.debug("replica %s: phase instrumentation failed: %r",
+                         self.replica_tag, e)
 
     def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
                               model_id: str | None = None):
@@ -383,11 +398,12 @@ class ReplicaActor:
         if getattr(self, "_rpc_sock", None) is not None:
             try:
                 self._rpc_sock.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already closed by the accept loop's error path
         fn = getattr(self.user, "__del__", None)
         if fn is not None:
             try:
                 fn()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — user teardown code
+                logger.warning("replica %s: user __del__ raised during "
+                               "shutdown: %r", self.replica_tag, e)

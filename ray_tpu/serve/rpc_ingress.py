@@ -88,8 +88,8 @@ class RPCProxyActor:
         finally:
             try:
                 conn.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # peer already reset the socket
 
     def stop(self):
         self._stop = True
@@ -171,8 +171,8 @@ class RPCClient:
                 else:
                     try:
                         self._conn.close()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # reset is the point: server stops producing
                     self._conn = connect_tcp(self._host, self._port,
                                              timeout=30.0)
             self._streaming = False
@@ -180,8 +180,8 @@ class RPCClient:
     def close(self):
         try:
             self._conn.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # already closed/reset: close() stays idempotent
 
 
 _INGRESS_NAME = "_serve_rpc_ingress"
