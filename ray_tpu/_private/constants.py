@@ -59,6 +59,26 @@ SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
 #: exactly this name, so creator and recovery must share the scheme.
 SERVE_REPLICA_NAME_PREFIX = "SERVE_REPLICA:"
 
+# ---------------------------------------------------------------- mesh axes
+
+#: the SPMD mesh-axis vocabulary. These strings are program-wide protocol:
+#: a collective's `axis_name`, a `PartitionSpec` entry, and the mesh
+#: construction in parallel/mesh.py must all agree, and a typo'd axis only
+#: explodes at runtime on the real device mesh. The `spmd-consistency`
+#: static check resolves every axis string in train/, parallel/, ops/ and
+#: llm/ against MESH_AXES, so drift fails tier-1 instead of a TPU job.
+MESH_AXIS_DP = "dp"        # data parallel (gradient psum)
+MESH_AXIS_FSDP = "fsdp"    # fully-sharded data parallel
+MESH_AXIS_EP = "ep"        # expert parallel (MoE)
+MESH_AXIS_PP = "pp"        # pipeline parallel (layer stages)
+MESH_AXIS_SP = "sp"        # sequence/context parallel (ring attention)
+MESH_AXIS_TP = "tp"        # tensor parallel (heads / mlp / vocab)
+
+#: canonical mesh-axis order, outermost→innermost (tp innermost so its
+#: collectives ride the shortest ICI hops).
+MESH_AXES = (MESH_AXIS_DP, MESH_AXIS_FSDP, MESH_AXIS_EP, MESH_AXIS_PP,
+             MESH_AXIS_SP, MESH_AXIS_TP)
+
 # ------------------------------------------------------------------ metrics
 
 #: canonical exported-metric namespace (tools/graft_check metric-name check).

@@ -27,7 +27,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+from ray_tpu._private.constants import (MESH_AXES, MESH_AXIS_DP,
+                                        MESH_AXIS_EP, MESH_AXIS_FSDP,
+                                        MESH_AXIS_PP, MESH_AXIS_SP,
+                                        MESH_AXIS_TP)
+
+# the vocabulary lives in _private/constants.py so every axis string in
+# the tree resolves against ONE spelling (spmd-consistency enforces it);
+# AXES stays exported as this module's public name for it
+AXES = MESH_AXES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,27 +147,27 @@ class ShardingRules:
 
 DEFAULT_RULES = ShardingRules(
     params={
-        "vocab": "tp",
-        "embed": "fsdp",       # ZeRO-3-style weight shard; all-gathered by XLA at use
-        "heads": "tp",
-        "kv_heads": "tp",
+        "vocab": MESH_AXIS_TP,
+        "embed": MESH_AXIS_FSDP,  # ZeRO-3-style weight shard; all-gathered by XLA at use
+        "heads": MESH_AXIS_TP,
+        "kv_heads": MESH_AXIS_TP,
         "head_dim": None,
-        "mlp": "tp",
-        "expert": "ep",
+        "mlp": MESH_AXIS_TP,
+        "expert": MESH_AXIS_EP,
         "layers": None,
-        "stage": "pp",
+        "stage": MESH_AXIS_PP,
     },
     acts={
-        "batch": ("dp", "fsdp"),   # global batch split over both data axes
-        "seq": "sp",
+        "batch": (MESH_AXIS_DP, MESH_AXIS_FSDP),  # global batch over both data axes
+        "seq": MESH_AXIS_SP,
         "embed": None,
-        "heads": "tp",
-        "kv_heads": "tp",
+        "heads": MESH_AXIS_TP,
+        "kv_heads": MESH_AXIS_TP,
         "head_dim": None,
-        "mlp": "tp",
-        "vocab": "tp",
-        "expert": "ep",
-        "stage": "pp",
+        "mlp": MESH_AXIS_TP,
+        "vocab": MESH_AXIS_TP,
+        "expert": MESH_AXIS_EP,
+        "stage": MESH_AXIS_PP,
     },
 )
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu._private.constants import MESH_AXIS_PP
 from ray_tpu.parallel.collectives import axis_size, pvary as _pvary, zeros_varying_like
 
 
@@ -28,7 +29,7 @@ def pipeline_apply(
     stage_params,
     x,                      # [n_micro, micro_batch, ...] same on every stage
     *,
-    axis_name: str = "pp",
+    axis_name: str = MESH_AXIS_PP,
 ):
     """Run microbatches through the pipeline; returns [n_micro, ...] outputs
     (valid on every device — the final outputs are broadcast over the axis).

@@ -19,6 +19,7 @@ import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private.constants import MESH_AXIS_DP, MESH_AXIS_FSDP
 from ray_tpu.parallel import DEFAULT_RULES, param_shardings
 
 
@@ -28,7 +29,7 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     *,
-    batch_spec: P = P(("dp", "fsdp")),
+    batch_spec: P = P((MESH_AXIS_DP, MESH_AXIS_FSDP)),
     donate: bool = True,
     partition_rules=None,       # [(regex, PartitionSpec)] over param paths
     params_template=None,       # params (or their eval_shape) for the rules

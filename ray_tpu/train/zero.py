@@ -39,6 +39,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private.constants import MESH_AXIS_DP, MESH_AXIS_FSDP
+
 # ------------------------------------------------------------ rules plane
 
 
@@ -98,7 +100,7 @@ def _spec_axes(spec: P) -> set:
 
 
 def zero_shard_spec(spec: P, shape: Sequence[int], mesh: Mesh,
-                    axis: str = "dp") -> P:
+                    axis: str = MESH_AXIS_DP) -> P:
     """Fold `axis` into the first dimension the spec leaves unsharded and
     whose size divides by the axis — the greedy ZeRO-1 placement. A leaf
     already sharded over `axis`, or with no divisible free dimension,
@@ -117,7 +119,7 @@ def zero_shard_spec(spec: P, shape: Sequence[int], mesh: Mesh,
 
 def zero_opt_shardings(optimizer: optax.GradientTransformation, params,
                        rules: Sequence[tuple[str, P]], mesh: Mesh,
-                       *, axis: str = "dp"):
+                       *, axis: str = MESH_AXIS_DP):
     """NamedSharding pytree for `optimizer.init(params)`'s state with the
     ZeRO-1 dp sharding applied on top of the regex rules (unmatched state
     leaves — schedule counts, scalars — fall back to replicated)."""
@@ -147,8 +149,8 @@ def make_zero_train_step(
     optimizer: optax.GradientTransformation,
     rules: Sequence[tuple[str, P]],
     *,
-    batch_spec: P = P(("dp", "fsdp")),
-    axis: str = "dp",
+    batch_spec: P = P((MESH_AXIS_DP, MESH_AXIS_FSDP)),
+    axis: str = MESH_AXIS_DP,
     donate: bool = True,
 ):
     """gspmd ZeRO-1: returns (step, init_opt_state, shard_params,

@@ -1,0 +1,282 @@
+"""Unit tests for the per-function CFG (tools/graft_check/cfg.py).
+
+The resource-leak checker's verdicts are only as good as the graph, so
+the control-flow shapes it depends on are pinned here directly:
+branches, loops (back edges, break/continue), try/except/finally
+routing, with-exit semantics, early returns and raises, and the
+exception-edge discipline (which statements may raise, and where the
+exception goes)."""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_check.cfg import build_cfg, stmt_can_raise  # noqa: E402
+
+
+def _cfg(src: str):
+    """CFG of the single function in `src`."""
+    tree = ast.parse(src)
+    (fn,) = [n for n in tree.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return build_cfg(fn)
+
+
+def _node_at(cfg, line: int):
+    """The stmt node anchored at source line `line`."""
+    for n in cfg.nodes:
+        if n.kind == "stmt" and getattr(n.stmt, "lineno", None) == line:
+            return n
+    raise AssertionError(f"no stmt node at line {line}")
+
+
+def _reaches(cfg, start_line: int, goal: int, blocked_lines=()) -> bool:
+    start = _node_at(cfg, start_line).idx
+    blocked = {_node_at(cfg, ln).idx for ln in blocked_lines}
+    return goal in cfg.reachable(start, blocked, skip_start_exc=True)
+
+
+# ------------------------------------------------------------------ basics
+
+
+def test_straight_line_reaches_exit():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"          # 2
+               "    b = 2\n"          # 3
+               "    return b\n")      # 4
+    assert _reaches(cfg, 2, cfg.exit)
+    # no calls anywhere: the exceptional exit is unreachable
+    assert not _reaches(cfg, 2, cfg.raise_exit)
+
+
+def test_branch_joins_and_blocking_one_arm_keeps_the_other():
+    cfg = _cfg("def f(x):\n"
+               "    a = 1\n"          # 2
+               "    if x:\n"          # 3
+               "        b = 2\n"      # 4
+               "    else:\n"
+               "        c = 3\n"      # 6
+               "    return a\n")      # 7
+    assert _reaches(cfg, 2, cfg.exit)
+    # blocking only the then-arm: the else-arm still reaches exit
+    assert _reaches(cfg, 2, cfg.exit, blocked_lines=(4,))
+    # blocking both arms: exit unreachable
+    assert not _reaches(cfg, 2, cfg.exit, blocked_lines=(4, 6))
+
+
+def test_loop_back_edge_and_break():
+    cfg = _cfg("def f(xs):\n"
+               "    acc = 0\n"            # 2
+               "    for x in xs:\n"       # 3
+               "        if x < 0:\n"      # 4
+               "            break\n"      # 5
+               "        acc += x\n"       # 6
+               "    return acc\n")        # 7
+    # the loop body is reachable from itself (back edge)
+    body = _node_at(cfg, 6).idx
+    assert body in cfg.reachable(body)
+    assert _reaches(cfg, 2, cfg.exit)
+    # break bypasses the rest of the body: blocking line 6 still exits
+    assert _reaches(cfg, 2, cfg.exit, blocked_lines=(6,))
+
+
+def test_while_true_without_break_never_exits_normally():
+    cfg = _cfg("def f():\n"
+               "    n = 0\n"          # 2
+               "    while True:\n"    # 3
+               "        n += 1\n")    # 4
+    # the loop header's false-edge is over-approximated as existing, so
+    # exit is formally reachable — but the body must loop back
+    body = _node_at(cfg, 4).idx
+    assert body in cfg.reachable(body)
+
+
+def test_continue_routes_to_loop_head():
+    cfg = _cfg("def f(xs):\n"
+               "    out = []\n"            # 2
+               "    for x in xs:\n"        # 3
+               "        if not x:\n"       # 4
+               "            continue\n"    # 5
+               "        out.append(x)\n"   # 6
+               "    return out\n")         # 7
+    # continue path re-enters the loop and can still reach the append
+    assert _reaches(cfg, 5, _node_at(cfg, 6).idx)
+
+
+# ------------------------------------------------------ exceptional flow
+
+
+def test_call_statement_gets_exception_edge_to_raise_exit():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"          # 2
+               "    use(a)\n"         # 3
+               "    return a\n")      # 4
+    assert _reaches(cfg, 2, cfg.raise_exit)  # via line 3's may-raise
+    # starting AT the call with skip_start_exc: its own edge is dropped,
+    # and nothing later can raise
+    assert not _reaches(cfg, 3, cfg.raise_exit)
+
+
+def test_never_raises_table():
+    assert not stmt_can_raise(ast.parse("t = time.monotonic()").body[0])
+    assert not stmt_can_raise(ast.parse("n = len(xs)").body[0])
+    assert stmt_can_raise(ast.parse("x = open(p)").body[0])
+    assert stmt_can_raise(ast.parse("raise ValueError").body[0])
+    assert stmt_can_raise(ast.parse("assert x").body[0])
+    # compound headers only contribute their own expressions
+    assert not stmt_can_raise(ast.parse(
+        "with lock:\n    use(x)\n").body[0])
+    assert stmt_can_raise(ast.parse(
+        "with open(p) as f:\n    pass\n").body[0])
+    assert not stmt_can_raise(ast.parse(
+        "if x:\n    use(x)\n").body[0])
+
+
+def test_early_raise_goes_to_raise_exit_not_exit():
+    cfg = _cfg("def f(x):\n"
+               "    a = 1\n"                  # 2
+               "    if x:\n"                  # 3
+               "        raise ValueError\n"   # 4
+               "    return a\n")              # 5
+    assert _reaches(cfg, 4, cfg.raise_exit)
+    assert not _reaches(cfg, 4, cfg.exit)
+    assert _reaches(cfg, 2, cfg.exit)
+
+
+def test_catch_all_handler_stops_escape():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"              # 2
+               "    try:\n"               # 3
+               "        use(a)\n"         # 4
+               "    except Exception:\n"  # 5
+               "        a = 0\n"          # 6
+               "    return a\n")          # 7
+    assert not _reaches(cfg, 2, cfg.raise_exit)
+    assert _reaches(cfg, 2, cfg.exit)
+
+
+def test_narrow_handler_lets_exception_escape():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"             # 2
+               "    try:\n"              # 3
+               "        use(a)\n"        # 4
+               "    except OSError:\n"   # 5
+               "        a = 0\n"         # 6
+               "    return a\n")         # 7
+    assert _reaches(cfg, 2, cfg.raise_exit)  # non-OSError escapes
+
+
+# ------------------------------------------------------------- finally
+
+
+def test_finally_on_exception_path():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"           # 2
+               "    try:\n"            # 3
+               "        use(a)\n"      # 4
+               "    finally:\n"        # 5
+               "        cleanup()\n"   # 6
+               "    return a\n")       # 7
+    # every escape routes through the finally: blocking it seals BOTH
+    assert _reaches(cfg, 2, cfg.raise_exit)
+    assert not _reaches(cfg, 2, cfg.raise_exit, blocked_lines=(6,))
+    assert not _reaches(cfg, 2, cfg.exit, blocked_lines=(6,))
+
+
+def test_finally_on_early_return_path():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"            # 2
+               "    try:\n"             # 3
+               "        return use(a)\n"  # 4
+               "    finally:\n"         # 5
+               "        cleanup()\n")   # 6
+    # the return routes through the finally before reaching exit
+    assert not _reaches(cfg, 2, cfg.exit, blocked_lines=(6,))
+
+
+def test_nested_finally_chain():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"              # 2
+               "    try:\n"               # 3
+               "        try:\n"           # 4
+               "            use(a)\n"     # 5
+               "        finally:\n"       # 6
+               "            inner()\n"    # 7
+               "    finally:\n"           # 8
+               "        outer()\n"        # 9
+               "    return a\n")          # 10
+    # an escaping exception must cross BOTH finallys, inner first
+    assert not _reaches(cfg, 2, cfg.raise_exit, blocked_lines=(7,))
+    assert not _reaches(cfg, 2, cfg.raise_exit, blocked_lines=(9,))
+
+
+def test_handler_exception_still_runs_finally():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"              # 2
+               "    try:\n"               # 3
+               "        use(a)\n"         # 4
+               "    except Exception:\n"  # 5
+               "        retry(a)\n"       # 6
+               "    finally:\n"           # 7
+               "        cleanup()\n"      # 8
+               "    return a\n")          # 9
+    # retry() raising routes through the finally, then escapes
+    assert _reaches(cfg, 2, cfg.raise_exit)
+    assert not _reaches(cfg, 2, cfg.raise_exit, blocked_lines=(8,))
+
+
+# ---------------------------------------------------------------- with
+
+
+def test_with_exit_covers_exception_and_fallthrough():
+    cfg = _cfg("def f():\n"
+               "    a = 1\n"                 # 2
+               "    with open('p') as g:\n"  # 3
+               "        use(g)\n"            # 4
+               "    return a\n")             # 5
+    wexit = next(n.idx for n in cfg.nodes if n.kind == "with_exit")
+    # from INSIDE the body, both the normal path and an exception cross
+    # the with_exit (__exit__ runs either way)
+    reach = cfg.reachable(_node_at(cfg, 4).idx, {wexit})
+    assert cfg.exit not in reach
+    assert cfg.raise_exit not in reach
+    # but the with HEADER raising (open() fails) escapes without
+    # __exit__ — the manager was never entered
+    reach_hdr = cfg.reachable(_node_at(cfg, 3).idx, {wexit})
+    assert cfg.raise_exit in reach_hdr
+
+
+def test_with_exit_covers_return_out_of_body():
+    cfg = _cfg("def f():\n"
+               "    with open('p') as g:\n"  # 2
+               "        return use(g)\n")    # 3
+    wexit = next(n.idx for n in cfg.nodes if n.kind == "with_exit")
+    reach = cfg.reachable(_node_at(cfg, 3).idx, {wexit})
+    # the return cannot reach exit without running __exit__
+    assert cfg.exit not in reach
+
+
+def test_with_lock_that_cannot_raise_adds_no_escape():
+    cfg = _cfg("def f(self):\n"
+               "    a = 1\n"               # 2
+               "    with self._lock:\n"    # 3
+               "        self.n += 1\n"     # 4
+               "    done(a)\n"             # 5
+               "    return a\n")           # 6
+    # nothing before line 5 can raise: raise_exit reachable ONLY via 5
+    assert not _reaches(cfg, 2, cfg.raise_exit, blocked_lines=(5,))
+
+
+# ------------------------------------------------------------ dead code
+
+
+def test_code_after_return_is_disconnected():
+    cfg = _cfg("def f():\n"
+               "    return 1\n"   # 2
+               "    use(x)\n")    # 3
+    dead = _node_at(cfg, 3).idx
+    assert dead not in cfg.reachable(cfg.entry)

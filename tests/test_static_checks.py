@@ -40,9 +40,12 @@ from tools.graft_check.checkers import (AsyncBlockingChecker,  # noqa: E402
                                         LockOrderChecker,
                                         MetricNamesChecker,
                                         PersistOrderChecker,
+                                        ResourceLeakChecker,
                                         RpcFieldSchemaChecker,
                                         RpcPairingChecker,
                                         ShmLifecycleChecker,
+                                        SilentSwallowChecker,
+                                        SpmdConsistencyChecker,
                                         TransitiveBlockingChecker,
                                         all_check_ids)
 
@@ -86,6 +89,19 @@ def test_tree_is_clean_under_budget(tree_report):
         f"graft_check took {tree_report.elapsed_s:.1f}s (budget 15s)")
 
 
+def test_warm_cache_full_tree_under_one_second(tree_report):
+    """The perf gate for the incremental loop (tools/precommit.sh): with
+    the analysis cache warm — tree_report just populated it — a full-tree
+    run costs stats + the finish()-phase replay, no parsing. The CFG and
+    SPMD facts must replay from the cache too, or the v3 checkers would
+    quietly reintroduce the parse cost the cache exists to avoid."""
+    t0 = time.monotonic()
+    report = run_default()
+    dt = time.monotonic() - t0
+    assert report.ok, [f.render() for f in report.findings]
+    assert dt < 1.0, f"warm-cache full-tree run took {dt:.2f}s (budget 1s)"
+
+
 def test_baseline_entries_all_used(tree_report):
     """Redundant with the stale-baseline findings above, but asserts the
     mechanism directly: every baseline entry matched >= 1 finding."""
@@ -107,7 +123,8 @@ def test_cli_lists_every_check_id(capsys):
     for expected in ("async-blocking", "transitive-blocking",
                      "await-under-lock", "blocking-under-lock",
                      "guarded-attr", "lock-order", "persist-order",
-                     "shm-lifecycle", "shm-prefix", "rpc-pairing",
+                     "shm-lifecycle", "shm-prefix", "resource-leak",
+                     "spmd-consistency", "silent-swallow", "rpc-pairing",
                      "rpc-table", "rpc-method-literal", "rpc-field-schema",
                      "metric-name", "metric-expected", "stale-baseline"):
         assert expected in out, f"--list is missing {expected}"
@@ -123,6 +140,27 @@ def test_cli_nonzero_on_violation(tmp_path, capsys):
     assert main([str(tmp_path), "--no-baseline", "--no-cache",
                  "--quiet"]) == 1
     assert "async-blocking" in capsys.readouterr().out
+
+
+def test_cli_github_format(tmp_path, capsys):
+    """--format github emits one ::error workflow command per finding,
+    with %/newlines escaped so multi-line messages stay one annotation."""
+    from tools.graft_check.__main__ import main
+
+    (tmp_path / "m.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n")
+    assert main([str(tmp_path), "--no-baseline", "--no-cache",
+                 "--quiet", "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("::error")]
+    assert lines, out
+    (line,) = [ln for ln in lines if "async-blocking" in ln]
+    assert "file=" in line and ",line=3," in line
+    assert "title=graft_check async-blocking" in line
+    assert "::[async-blocking]" in line
+    assert "\n" not in line.rstrip("\n")
 
 
 def test_cli_json_format(tmp_path, capsys):
@@ -649,6 +687,287 @@ def test_rpc_field_schema_branch_built_payload_resolves(tmp_path):
     assert not report.findings
 
 
+# ------------------------------------------------------------ resource-leak
+
+
+_LEAK_FIXTURE = (
+    "def leaky():\n"
+    "    ch = create_mutable_channel(1024)\n"   # line 2: fires
+    "    publish(ch.path)\n"                    # can raise -> leak
+    "    ch.close()\n"
+    "    ch.unlink()\n")
+
+
+def test_resource_leak_fires_on_exception_path(tmp_path):
+    (tmp_path / "m.py").write_text(_LEAK_FIXTURE)
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    (f,) = [x for x in report.findings if x.check_id == "resource-leak"]
+    assert (f.path, f.line, f.symbol) == ("m.py", 2, "leaky")
+    assert "exception path" in f.message and "`ch`" in f.message
+
+
+def test_resource_leak_clean_shapes(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "def fin():\n"
+        "    ch = create_mutable_channel(1)\n"
+        "    try:\n"
+        "        publish(ch.path)\n"
+        "    finally:\n"
+        "        ch.close()\n"
+        "def ctx(p):\n"
+        "    with open(p) as f:\n"
+        "        return f.read()\n"
+        "def factory():\n"
+        "    ch = create_mutable_channel(1)\n"      # returned: caller owns
+        "    return ch\n"
+        "def stored(self):\n"
+        "    ch = create_mutable_channel(1)\n"      # self owns it now
+        "    self._ch = ch\n"
+        "def handed_off():\n"
+        "    ch = create_mutable_channel(1)\n"      # registry owns it now
+        "    register(ch)\n")
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    assert not report.findings, _ids(report)
+
+
+def test_resource_leak_semaphore_needs_finally(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class C:\n"
+        "    def bad(self):\n"
+        "        self._admission.acquire()\n"   # line 3: fires
+        "        work()\n"
+        "        self._admission.release()\n"
+        "    def good(self):\n"
+        "        self._admission.acquire()\n"
+        "        try:\n"
+        "            work()\n"
+        "        finally:\n"
+        "            self._admission.release()\n"
+        "    def cross_method_hold(self):\n"
+        "        self._admission.acquire()\n"   # no release here at all:
+        "        self.held = True\n")           # a protocol, not a leak
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    got = [k for k in _ids(report) if k[0] == "resource-leak"]
+    assert got == [("resource-leak", "m.py", 3)]
+
+
+def test_resource_leak_router_token_not_transferred_by_use(tmp_path):
+    """The PR 11 bug shape: a router slot id PASSED to the transport call
+    is still this function's obligation — only done()/return/a deferred-
+    release closure discharge it."""
+    (tmp_path / "m.py").write_text(
+        "class H:\n"
+        "    def bad(self):\n"
+        "        rid = self._router.pick()\n"    # line 3: fires
+        "        res = transport(rid)\n"         # use, NOT a transfer
+        "        self._router.done(rid)\n"
+        "        return res\n"
+        "    def good(self):\n"
+        "        rid = self._router.pick()\n"
+        "        try:\n"
+        "            return transport(rid)\n"
+        "        finally:\n"
+        "            self._router.done(rid)\n"
+        "    def deferred(self):\n"
+        "        rid = self._router.pick()\n"
+        "        return Resp(lambda r=rid: self._router.done(r))\n")
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    got = [k for k in _ids(report) if k[0] == "resource-leak"]
+    assert got == [("resource-leak", "m.py", 3)]
+
+
+def test_resource_leak_interprocedural_factory(tmp_path):
+    """`x = helper()` where the helper (transitively, cross-module)
+    returns a fresh acquisition is an acquisition in the CALLER."""
+    _write_tree(tmp_path, {
+        "lib.py": ("def make_chan(n):\n"
+                   "    ch = create_mutable_channel(n)\n"
+                   "    return ch\n"
+                   "def make_wrapped(n):\n"
+                   "    return make_chan(n)\n"),
+        "use.py": ("from lib import make_chan, make_wrapped\n"
+                   "def bad():\n"
+                   "    ch = make_chan(1)\n"        # line 3: fires
+                   "    publish(ch.path)\n"
+                   "    ch.close()\n"
+                   "def bad2():\n"
+                   "    ch = make_wrapped(1)\n"     # line 7: fires
+                   "    publish(ch.path)\n"
+                   "    ch.close()\n"
+                   "def good():\n"
+                   "    ch = make_chan(1)\n"
+                   "    try:\n"
+                   "        publish(ch.path)\n"
+                   "    finally:\n"
+                   "        ch.close()\n")})
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    got = [k for k in _ids(report) if k[0] == "resource-leak"]
+    assert got == [("resource-leak", "use.py", 3),
+                   ("resource-leak", "use.py", 7)]
+    assert all("factory" in f.message for f in report.findings)
+
+
+def test_resource_leak_loop_reacquisition(tmp_path):
+    """Per-iteration acquire with an unprotected use leaks once per lap;
+    a finally inside the loop is clean (the back edge must not smear the
+    next iteration's release onto this one's escape)."""
+    (tmp_path / "m.py").write_text(
+        "def bad(paths):\n"
+        "    for p in paths:\n"
+        "        f = open(p)\n"       # line 3: fires
+        "        data = f.read()\n"
+        "        f.close()\n"
+        "def good(paths):\n"
+        "    for p in paths:\n"
+        "        f = open(p)\n"
+        "        try:\n"
+        "            f.read()\n"
+        "        finally:\n"
+        "            f.close()\n")
+    report = _run(tmp_path, [ResourceLeakChecker()])
+    got = [k for k in _ids(report) if k[0] == "resource-leak"]
+    assert got == [("resource-leak", "m.py", 3)]
+
+
+# --------------------------------------------------------- spmd-consistency
+
+
+_SPMD_CONSTANTS = ("MESH_AXIS_DP = 'dp'\n"
+                   "MESH_AXIS_TP = 'tp'\n"
+                   "MESH_AXES = (MESH_AXIS_DP, MESH_AXIS_TP)\n")
+
+_SPMD_FIXTURE = {
+    "_private/constants.py": _SPMD_CONSTANTS,
+    "train/step.py": (
+        "from jax import lax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f(x):\n"
+        "    return lax.psum(x, 'dpp')\n"),       # line 4: unknown axis
+}
+
+
+def test_spmd_axis_vocabulary_fires(tmp_path):
+    _write_tree(tmp_path, _SPMD_FIXTURE)
+    report = _run(tmp_path, [SpmdConsistencyChecker()])
+    (f,) = [x for x in report.findings
+            if x.check_id == "spmd-consistency"]
+    assert (f.path, f.line) == ("train/step.py", 4)
+    assert "'dpp'" in f.message and "MESH_AXES" in f.message
+
+
+def test_spmd_constant_names_resolve(tmp_path):
+    """Axis values spelled as constants-module names resolve to their
+    strings; in-vocabulary uses stay clean."""
+    _write_tree(tmp_path, {
+        "_private/constants.py": _SPMD_CONSTANTS,
+        "train/step.py": (
+            "from jax import lax\n"
+            "from ray_tpu._private.constants import MESH_AXIS_DP\n"
+            "def f(x):\n"
+            "    return lax.pmean(x, MESH_AXIS_DP)\n"
+            "def g(x, axis_name='tp'):\n"
+            "    return lax.psum(x, axis_name)\n")})
+    report = _run(tmp_path, [SpmdConsistencyChecker()])
+    assert not report.findings, _ids(report)
+
+
+def test_spmd_duplicate_axis_in_spec_fires(tmp_path):
+    _write_tree(tmp_path, {
+        "_private/constants.py": _SPMD_CONSTANTS,
+        "train/step.py": (
+            "from jax.sharding import PartitionSpec as P\n"
+            "BAD = P('dp', 'dp')\n"               # line 2: duplicate
+            "OK = P('dp', None, 'tp')\n")})
+    report = _run(tmp_path, [SpmdConsistencyChecker()])
+    got = [f for f in report.findings if "appears 2x" in f.message]
+    assert [(f.path, f.line) for f in got] == [("train/step.py", 2)]
+
+
+def test_spmd_over_rank_spec_fires(tmp_path):
+    """Arity is counted over NAMED axes, not spec length: a spec is as
+    long as the ARRAY rank, and trailing None entries (replicated dims)
+    are valid on any mesh."""
+    _write_tree(tmp_path, {
+        "_private/constants.py": _SPMD_CONSTANTS,
+        "train/step.py": (
+            "from jax.sharding import PartitionSpec as P\n"
+            "BAD = P(('dp', 'tp'), 'dp', None)\n"   # names 3 axes, 2 exist
+            "OK = P('dp', None, None, None)\n")})   # rank-4 array: fine
+    report = _run(tmp_path, [SpmdConsistencyChecker()])
+    got = [f for f in report.findings if "names 3 mesh axes" in f.message]
+    assert [(f.path, f.line) for f in got] == [("train/step.py", 2)]
+    assert not any(f.line == 3 for f in report.findings), _ids(report)
+
+
+def test_spmd_dynamic_values_and_out_of_scope_skipped(tmp_path):
+    _write_tree(tmp_path, {
+        "_private/constants.py": _SPMD_CONSTANTS,
+        "train/step.py": (
+            "from jax import lax\n"
+            "def f(x, mesh):\n"
+            "    return lax.psum(x, mesh.axis_names[0])\n"),  # dynamic: ok
+        "serve/other.py": (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'not_an_axis')\n")})      # out of scope
+    report = _run(tmp_path, [SpmdConsistencyChecker()])
+    assert not report.findings, _ids(report)
+
+
+def test_spmd_real_tree_vocabulary_matches_mesh(tree_report):
+    """The hoisted MESH_AXES in constants.py IS parallel/mesh.py's AXES —
+    if they drift, the whole vocabulary check is checking the wrong
+    thing."""
+    from ray_tpu._private.constants import MESH_AXES
+
+    import ast as _ast
+
+    src = open(os.path.join(REPO, "ray_tpu", "parallel",
+                            "mesh.py")).read()
+    assert "AXES = MESH_AXES" in src
+    assert MESH_AXES == ("dp", "fsdp", "ep", "pp", "sp", "tp")
+    _ast.parse(src)
+
+
+# ----------------------------------------------------------- silent-swallow
+
+
+def test_silent_swallow_fires_and_exemptions(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "def bad():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"     # line 6: fires
+        "        pass\n"
+        "def bare():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"               # line 11: fires
+        "        pass\n"
+        "def base():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except BaseException:\n"  # line 16: fires
+        "        pass\n"
+        "def narrowed():\n"
+        "    try:\n"
+        "        sock.close()\n"
+        "    except OSError:\n"        # narrow: ok
+        "        pass\n"
+        "def logged():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"  # logs: ok
+        "        logger.debug('failed: %r', e)\n")
+    report = _run(tmp_path, [SilentSwallowChecker()])
+    got = [k for k in _ids(report) if k[0] == "silent-swallow"]
+    assert got == [("silent-swallow", "m.py", 6),
+                   ("silent-swallow", "m.py", 11),
+                   ("silent-swallow", "m.py", 16)]
+
+
 # ------------------------------------------------------------- metric names
 
 
@@ -725,29 +1044,39 @@ def test_baseline_count_pin_catches_new_violation(tmp_path):
 @pytest.mark.parametrize("check_id,fixture,checker_cls", [
     ("transitive-blocking", _TRANSITIVE_FIXTURE, TransitiveBlockingChecker),
     ("lock-order", _LOCK_ORDER_FIXTURE, LockOrderChecker),
+    ("resource-leak", _LEAK_FIXTURE, ResourceLeakChecker),
+    ("spmd-consistency", _SPMD_FIXTURE, SpmdConsistencyChecker),
+    ("silent-swallow", ("def f():\n"
+                        "    try:\n"
+                        "        work()\n"
+                        "    except Exception:\n"
+                        "        pass\n"), SilentSwallowChecker),
 ])
 def test_baseline_and_count_pin_cover_new_checkers(tmp_path, check_id,
                                                    fixture, checker_cls):
-    """The new interprocedural ids ride the same baseline machinery:
-    suppression by (id, file, symbol) works, `=N` pins are enforced, and
-    removing the violation turns the entry stale."""
-    (tmp_path / "m.py").write_text(fixture)
+    """Every post-v1 id (the v2 interprocedural ones AND the v3 CFG/SPMD/
+    swallow ones) rides the same baseline machinery: suppression by (id,
+    file, symbol) works, `=N` pins are enforced, and removing the
+    violation turns the entry stale."""
+    files = fixture if isinstance(fixture, dict) else {"m.py": fixture}
+    _write_tree(tmp_path, files)
     report = _run(tmp_path, [checker_cls()])
     (finding,) = [f for f in report.findings if f.check_id == check_id]
     bl = tmp_path / "baseline.txt"
-    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =1  # fixture\n")
+    entry = f"{check_id}  {finding.path}  {finding.symbol}"
+    bl.write_text(f"{entry}  =1  # fixture\n")
     report = run_checks(str(tmp_path), [checker_cls()],
                         load_baseline(str(bl)), baseline_path="baseline.txt")
     assert not report.findings and len(report.suppressed) == 1
     # a wrong pin overflows instead of hiding
-    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =2  # fixture\n")
+    bl.write_text(f"{entry}  =2  # fixture\n")
     report = run_checks(str(tmp_path), [checker_cls()],
                         load_baseline(str(bl)), baseline_path="baseline.txt")
     stale = [f for f in report.findings if f.check_id == "stale-baseline"]
     assert len(stale) == 1 and "matched 1" in stale[0].message
     # fixing the violation makes the entry stale
-    (tmp_path / "m.py").write_text("def fine():\n    pass\n")
-    bl.write_text(f"{check_id}  m.py  {finding.symbol}  =1  # fixture\n")
+    (tmp_path / finding.path).write_text("def fine():\n    pass\n")
+    bl.write_text(f"{entry}  =1  # fixture\n")
     report = run_checks(str(tmp_path), [checker_cls()],
                         load_baseline(str(bl)), baseline_path="baseline.txt")
     stale = [f for f in report.findings if f.check_id == "stale-baseline"]
@@ -834,6 +1163,19 @@ FIRING_FIXTURES = {
     "rpc-field-schema": (
         {"gcs.py": _SCHEMA_SERVER, "client.py": _SCHEMA_CLIENT},
         lambda: [RpcFieldSchemaChecker(gcs_module="gcs.py")]),
+    "resource-leak": (
+        {"m.py": _LEAK_FIXTURE},
+        lambda: [ResourceLeakChecker()]),
+    "spmd-consistency": (
+        dict(_SPMD_FIXTURE),
+        lambda: [SpmdConsistencyChecker()]),
+    "silent-swallow": (
+        {"m.py": ("def f():\n"
+                  "    try:\n"
+                  "        work()\n"
+                  "    except Exception:\n"
+                  "        pass\n")},
+        lambda: [SilentSwallowChecker()]),
     "metric-name": (
         {"m.py": ("from ray_tpu.util.metrics import Counter\n"
                   "c = Counter('bad_name')\n")},

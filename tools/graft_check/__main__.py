@@ -15,11 +15,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
-from tools.graft_check import (DEFAULT_BASELINE, DEFAULT_ROOT, all_check_ids,
-                               changed_relpaths, run_default)
+from tools.graft_check import (DEFAULT_BASELINE, DEFAULT_ROOT, REPO_ROOT,
+                               all_check_ids, changed_relpaths, run_default)
 
 
 def main(argv=None) -> int:
@@ -38,9 +39,11 @@ def main(argv=None) -> int:
     p.add_argument("--changed", action="store_true",
                    help="report findings only for git-changed files "
                         "(analysis still runs tree-wide)")
-    p.add_argument("--format", choices=("text", "json"), default="text",
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
                    help="output format (json: one object with findings/"
-                        "parse_errors arrays, for CI annotation)")
+                        "parse_errors arrays; github: workflow-command "
+                        "::error annotations that render inline on PRs)")
     p.add_argument("--no-cache", action="store_true",
                    help="disable the on-disk analysis cache")
     p.add_argument("--quiet", action="store_true",
@@ -65,7 +68,31 @@ def main(argv=None) -> int:
                          scope=scope,
                          cache_path="" if args.no_cache else None)
     dt = time.monotonic() - t0
-    if args.format == "json":
+    if args.format == "github":
+        # workflow commands: one ::error per finding, annotated at the
+        # offending file:line in the PR diff view. Paths are repo-relative
+        # (the scan root is ray_tpu/ inside the repo). Messages must be
+        # single-line with %/CR/LF escaped per the workflow-command spec.
+        def esc(s: str) -> str:
+            return (s.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A"))
+
+        root_rel = os.path.relpath(os.path.abspath(args.root),
+                                   REPO_ROOT).replace(os.sep, "/")
+        # a scan root outside the repo can't be annotated repo-relative:
+        # fall back to the bare scan-root-relative path
+        prefix = ("" if root_rel in (".", "") or root_rel.startswith("..")
+                  else root_rel + "/")
+        for f in (*report.parse_errors, *report.findings):
+            print(f"::error file={prefix}{f.path},line={f.line},"
+                  f"title=graft_check {f.check_id}::"
+                  f"{esc(f'[{f.check_id}] {f.message} (in {f.symbol})')}")
+        if not args.quiet:
+            print(f"graft_check: {len(report.findings)} finding(s), "
+                  f"{len(report.suppressed)} suppressed, "
+                  f"{len(report.parse_errors)} parse error(s) [{dt:.2f}s]",
+                  file=sys.stderr)
+    elif args.format == "json":
         as_dict = lambda f: {  # noqa: E731
             "check_id": f.check_id, "path": f.path, "line": f.line,
             "symbol": f.symbol, "message": f.message}

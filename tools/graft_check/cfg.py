@@ -1,0 +1,418 @@
+"""Per-function control-flow graphs for path-sensitive checkers.
+
+The per-module summaries (core.py) and the call graph answer "what does
+this function call, holding what"; they cannot answer "is there a path
+from THIS statement to a function exit that skips THAT statement" — the
+question every acquire→release (resource-leak) analysis needs. This
+module builds a statement-level CFG for one function:
+
+- branches (`if`/`elif`/`else`), loops (`for`/`while` with back edges,
+  `break`/`continue`), `with` blocks, early `return`s and `raise`s;
+- **exceptional flow**: every statement that can raise (any statement
+  containing a call outside a small never-raises table, plus `raise` and
+  `assert`) gets an edge to the innermost live exception target — the
+  enclosing `try`'s handler dispatch, a `finally`, a `with` exit, or the
+  function's exceptional exit;
+- `try`/`except`/`finally`: handler dispatch fans out to each handler
+  body; when no handler is a catch-all the exception also escapes past
+  them. A `finally` body is built ONCE and fans out to every
+  continuation routed through it (normal fall-through, returns, breaks,
+  escaping exceptions) — an over-approximation of paths that can only
+  ADD paths, never hide one, so a may-leak analysis stays sound on it;
+- `with` blocks are modeled as try/finally whose "finally" is a single
+  `with_exit` node — `__exit__` runs on normal completion, on `return`
+  out of the body, and on an escaping exception, which is exactly where
+  a context-managed resource is released.
+
+Two virtual exits: `EXIT` (normal completion / return) and `RAISE_EXIT`
+(an exception escaping the function). "An exception path leaks the
+resource" is then literally "RAISE_EXIT is reachable from the
+acquisition without crossing a release".
+
+Everything here is stdlib-`ast` only and deterministic. Checkers derive
+picklable per-node EVENTS from the graph (see resource_leak.py) rather
+than pickling AST nodes, so the analysis replays from the on-disk cache
+without reparsing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+#: (receiver, name) calls that cannot meaningfully raise — clock reads and
+#: type probes between an acquire and its `try` must not manufacture a
+#: phantom exception path.
+NEVER_RAISES = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("", "len"), ("", "isinstance"), ("", "id"), ("", "type"),
+    ("", "repr"), ("", "str"), ("", "int"), ("", "float"), ("", "bool"),
+}
+
+#: exception names that catch everything (for the "can the exception
+#: escape past the handlers" decision).
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+class Node:
+    """One CFG node. `stmt` is the owning ast node (None for the virtual
+    entry/exit/join nodes); `kind` tags the structural role. Normal flow
+    lives in `succ`; the statement's own may-raise edge lives in `exc`
+    separately, so an analysis can ignore the edge on the statement it
+    starts FROM (if the acquire call itself raises, nothing was acquired)
+    while honoring it everywhere else."""
+
+    __slots__ = ("idx", "kind", "stmt", "succ", "exc")
+
+    def __init__(self, idx: int, kind: str, stmt: Optional[ast.AST]):
+        self.idx = idx
+        self.kind = kind  # "stmt" | "entry" | "exit" | "raise_exit" |
+        #                   "join" | "with_exit" | "dispatch" | "handler" |
+        #                   "finally"
+        self.stmt = stmt
+        self.succ: Set[int] = set()
+        self.exc: Optional[int] = None
+
+    def __repr__(self):  # pragma: no cover — debugging aid
+        line = getattr(self.stmt, "lineno", "-")
+        return (f"<Node {self.idx} {self.kind} L{line} -> "
+                f"{sorted(self.succ)} exc={self.exc}>")
+
+
+class CFG:
+    """entry/exit/raise_exit are node indices into `nodes`."""
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.entry = self._new("entry")
+        self.exit = self._new("exit")
+        self.raise_exit = self._new("raise_exit")
+        #: id(with stmt) -> with_exit node index (the release point of
+        #: that statement's context managers)
+        self.with_exits: Dict[int, int] = {}
+
+    def _new(self, kind: str, stmt: Optional[ast.AST] = None) -> int:
+        n = Node(len(self.nodes), kind, stmt)
+        self.nodes.append(n)
+        return n.idx
+
+    def edge(self, a: int, b: int) -> None:
+        self.nodes[a].succ.add(b)
+
+    def _neighbors(self, idx: int, skip_exc: bool) -> List[int]:
+        node = self.nodes[idx]
+        out = list(node.succ)
+        if node.exc is not None and not skip_exc:
+            out.append(node.exc)
+        return out
+
+    def reachable(self, start: int, blocked: Set[int] = frozenset(),
+                  skip_start_exc: bool = False) -> Set[int]:
+        """Nodes reachable from `start` along paths that never CROSS a
+        node in `blocked` (blocked nodes are reached but not expanded).
+        `skip_start_exc` drops the start node's own may-raise edge."""
+        seen = {start}
+        stack = [start]
+        while stack:
+            cur = stack.pop()
+            if cur != start and cur in blocked:
+                continue
+            for nxt in self._neighbors(cur, skip_exc=(
+                    cur == start and skip_start_exc)):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+class _Frame:
+    """One enclosing cleanup frame during the build — a `finally` body or
+    a `with` exit. Abrupt exits (return/break/continue) and escaping
+    exceptions register their eventual continuation here and jump to
+    `entry` instead; once the frame's body is built, its tails fan out to
+    every registered continuation. `saw_exc` records whether any
+    exception edge actually flowed INTO the frame — only then does the
+    frame get an outward exception continuation, so a `with lock:` whose
+    body cannot raise does not manufacture a phantom escape path."""
+
+    __slots__ = ("entry", "continuations", "saw_exc")
+
+    def __init__(self, entry: int):
+        self.entry = entry
+        self.continuations: Set[int] = set()
+        self.saw_exc = False
+
+
+def exprs_can_raise(roots) -> bool:
+    """Any call outside NEVER_RAISES in the given expression trees
+    (nested function bodies excluded — they run later)."""
+    stack: List[ast.AST] = [r for r in roots if r is not None]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested body runs later, its calls don't raise HERE
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                key = ("", fn.id)
+            elif isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name):
+                key = (fn.value.id, fn.attr)
+            else:
+                return True
+            if key not in NEVER_RAISES:
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def stmt_can_raise(stmt: ast.stmt) -> bool:
+    """Conservative 'may raise' for the expressions evaluated AT this
+    statement's CFG node: compound statements only contribute their
+    header (their bodies have their own nodes)."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (ast.If, ast.While)):
+        return exprs_can_raise([stmt.test])
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return exprs_can_raise([stmt.iter])
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return exprs_can_raise([it.context_expr for it in stmt.items])
+    if isinstance(stmt, ast.Try):
+        return False
+    return exprs_can_raise([stmt])
+
+
+class _Builder:
+    def __init__(self):
+        self.cfg = CFG()
+        #: innermost-last exception targets: handler-dispatch node ids and
+        #: cleanup `_Frame`s, in nesting order. An exception at any
+        #: statement goes to the top; a frame's continuations carry it
+        #: further out once its cleanup body ran.
+        self.exc_stack: List[object] = []  # int (dispatch) | _Frame
+        #: innermost-last cleanup frames only (for routing return/break)
+        self.frames: List[_Frame] = []
+        #: (continue_target, break_join, frame_depth) per enclosing loop
+        self.loops: List[Tuple[int, int, int]] = []
+
+    # -- routing helpers ---------------------------------------------------
+
+    def _route_abrupt(self, src: int, target: int, depth: int) -> None:
+        """Connect an abrupt exit from `src` to `target` through every
+        cleanup frame above `depth`, innermost first."""
+        hop = target
+        for frame in self.frames[depth:]:
+            frame.continuations.add(hop)
+            hop = frame.entry
+        self.cfg.edge(src, hop)
+
+    def _exc_edge_target(self) -> int:
+        """Where an exception raised at the current nesting lands FIRST.
+        Chaining further out happens as frames pop: a frame that saw an
+        exception adds the then-current exception target to its
+        continuations, so a nested escape routes frame-by-frame without
+        global bookkeeping. Marks the receiving frame as exception-
+        carrying."""
+        if not self.exc_stack:
+            return self.cfg.raise_exit
+        top = self.exc_stack[-1]
+        if isinstance(top, _Frame):
+            top.saw_exc = True
+            return top.entry
+        return top
+
+    def _maybe_exc_edge(self, node_idx: int, stmt: ast.stmt) -> None:
+        if stmt_can_raise(stmt):
+            self.cfg.nodes[node_idx].exc = self._exc_edge_target()
+
+    # -- statement sequences ----------------------------------------------
+
+    def build_body(self, body: List[ast.stmt], entry: int) -> Optional[int]:
+        """Wire `body` starting from `entry`; returns the fall-through
+        node (None when the body always exits abruptly)."""
+        cur: Optional[int] = entry
+        for stmt in body:
+            if cur is None:
+                # dead code after return/raise: still built (it may hold
+                # releases the author believes run), but disconnected
+                cur = self.cfg._new("join")
+            cur = self.build_stmt(stmt, cur)
+        return cur
+
+    def build_stmt(self, stmt: ast.stmt, pred: int) -> Optional[int]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.Return):
+            n = cfg._new("stmt", stmt)
+            cfg.edge(pred, n)
+            self._maybe_exc_edge(n, stmt)
+            self._route_abrupt(n, cfg.exit, 0)
+            return None
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new("stmt", stmt)
+            cfg.edge(pred, n)
+            cfg.edge(n, self._exc_edge_target())
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            n = cfg._new("stmt", stmt)
+            cfg.edge(pred, n)
+            if self.loops:
+                cont, brk, depth = self.loops[-1]
+                target = brk if isinstance(stmt, ast.Break) else cont
+                self._route_abrupt(n, target, depth)
+            return None
+        if isinstance(stmt, ast.If):
+            return self._build_if(stmt, pred)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._build_loop(stmt, pred)
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, pred)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._build_with(stmt, pred)
+        # simple statement (assignment, expression, def, import, ...)
+        n = cfg._new("stmt", stmt)
+        cfg.edge(pred, n)
+        self._maybe_exc_edge(n, stmt)
+        return n
+
+    # -- structured statements --------------------------------------------
+
+    def _build_if(self, stmt: ast.If, pred: int) -> Optional[int]:
+        cfg = self.cfg
+        test = cfg._new("stmt", stmt)  # the test expression
+        cfg.edge(pred, test)
+        self._maybe_exc_edge(test, stmt)
+        join = cfg._new("join")
+        then_tail = self.build_body(stmt.body, test)
+        if then_tail is not None:
+            cfg.edge(then_tail, join)
+        if stmt.orelse:
+            else_tail = self.build_body(stmt.orelse, test)
+            if else_tail is not None:
+                cfg.edge(else_tail, join)
+        else:
+            cfg.edge(test, join)  # false edge falls through
+        return join
+
+    def _build_loop(self, stmt, pred: int) -> Optional[int]:
+        cfg = self.cfg
+        head = cfg._new("stmt", stmt)  # test / iterator advance
+        cfg.edge(pred, head)
+        self._maybe_exc_edge(head, stmt)
+        brk = cfg._new("join")
+        self.loops.append((head, brk, len(self.frames)))
+        body_tail = self.build_body(stmt.body, head)
+        if body_tail is not None:
+            cfg.edge(body_tail, head)  # back edge
+        self.loops.pop()
+        if stmt.orelse:
+            else_tail = self.build_body(stmt.orelse, head)
+            if else_tail is not None:
+                cfg.edge(else_tail, brk)
+        else:
+            cfg.edge(head, brk)  # condition false / iterator exhausted
+        return brk
+
+    def _push_frame(self, entry: int) -> _Frame:
+        frame = _Frame(entry)
+        self.frames.append(frame)
+        self.exc_stack.append(frame)
+        return frame
+
+    def _pop_frame(self, frame: _Frame) -> None:
+        assert self.frames.pop() is frame
+        assert self.exc_stack.pop() is frame
+
+    def _build_with(self, stmt, pred: int) -> Optional[int]:
+        cfg = self.cfg
+        enter = cfg._new("stmt", stmt)  # context-manager __enter__ calls
+        cfg.edge(pred, enter)
+        self._maybe_exc_edge(enter, stmt)
+        wexit = cfg._new("with_exit", stmt)
+        cfg.with_exits[id(stmt)] = wexit
+        frame = self._push_frame(wexit)
+        tail = self.build_body(stmt.body, enter)
+        self._pop_frame(frame)
+        if frame.saw_exc:
+            # an exception that actually entered the frame continues
+            # outward after __exit__
+            frame.continuations.add(self._exc_edge_target())
+        after: Optional[int] = None
+        if tail is not None:
+            cfg.edge(tail, wexit)
+            after = cfg._new("join")
+            frame.continuations.add(after)
+        for cont in frame.continuations:
+            cfg.edge(wexit, cont)
+        return after
+
+    def _build_try(self, stmt: ast.Try, pred: int) -> Optional[int]:
+        cfg = self.cfg
+        join = cfg._new("join")
+        frame: Optional[_Frame] = None
+        if stmt.finalbody:
+            frame = self._push_frame(cfg._new("finally", stmt))
+
+        dispatch: Optional[int] = None
+        if stmt.handlers:
+            dispatch = cfg._new("dispatch", stmt)
+            self.exc_stack.append(dispatch)
+
+        # --- try body
+        body_entry = cfg._new("join")
+        cfg.edge(pred, body_entry)
+        body_tail = self.build_body(stmt.body, body_entry)
+        if dispatch is not None:
+            self.exc_stack.pop()  # orelse/handler exceptions escape this try
+        if body_tail is not None and stmt.orelse:
+            body_tail = self.build_body(stmt.orelse, body_tail)
+        if body_tail is not None:
+            self._route_abrupt(body_tail, join,
+                               len(self.frames) - (1 if frame else 0))
+
+        # --- handlers: their own exceptions propagate past this try (but
+        # still through this try's finally — `frame` is still pushed)
+        if dispatch is not None:
+            catch_all = False
+            for handler in stmt.handlers:
+                h_entry = cfg._new("handler", handler)
+                cfg.edge(dispatch, h_entry)
+                h_tail = self.build_body(handler.body, h_entry)
+                if h_tail is not None:
+                    self._route_abrupt(h_tail, join,
+                                       len(self.frames) - (1 if frame else 0))
+                if handler.type is None:
+                    catch_all = True
+                else:
+                    names = (list(handler.type.elts)
+                             if isinstance(handler.type, ast.Tuple)
+                             else [handler.type])
+                    for nm in names:
+                        if isinstance(nm, ast.Name) and nm.id in _CATCH_ALL:
+                            catch_all = True
+            if not catch_all:
+                # unmatched exception escapes past the handlers, running
+                # this try's finally (still pushed) on the way out
+                cfg.edge(dispatch, self._exc_edge_target())
+
+        if frame is not None:
+            self._pop_frame(frame)
+            if frame.saw_exc:
+                # an exception that entered the finally (try/finally with
+                # no matching handler) continues outward after it
+                frame.continuations.add(self._exc_edge_target())
+            f_tail = self.build_body(stmt.finalbody, frame.entry)
+            if f_tail is not None:
+                for cont in frame.continuations:
+                    cfg.edge(f_tail, cont)
+        return join
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG of one FunctionDef/AsyncFunctionDef body. Nested defs are
+    opaque single statements (their bodies get their own CFG)."""
+    b = _Builder()
+    tail = b.build_body(list(func.body), b.cfg.entry)
+    if tail is not None:
+        b.cfg.edge(tail, b.cfg.exit)
+    return b.cfg

@@ -8,9 +8,13 @@ from tools.graft_check.checkers.lock_order import LockOrderChecker
 from tools.graft_check.checkers.metric_names import (EXPECTED_METRICS,
                                                      MetricNamesChecker)
 from tools.graft_check.checkers.persist_order import PersistOrderChecker
+from tools.graft_check.checkers.resource_leak import ResourceLeakChecker
 from tools.graft_check.checkers.rpc_pairing import RpcPairingChecker
 from tools.graft_check.checkers.rpc_schema import RpcFieldSchemaChecker
 from tools.graft_check.checkers.shm_lifecycle import ShmLifecycleChecker
+from tools.graft_check.checkers.silent_swallow import SilentSwallowChecker
+from tools.graft_check.checkers.spmd_consistency import (
+    SpmdConsistencyChecker)
 from tools.graft_check.checkers.transitive_blocking import (
     TransitiveBlockingChecker)
 
@@ -23,6 +27,9 @@ ALL_CHECKERS = (
     LockOrderChecker,
     PersistOrderChecker,
     ShmLifecycleChecker,
+    ResourceLeakChecker,
+    SpmdConsistencyChecker,
+    SilentSwallowChecker,
     RpcPairingChecker,
     RpcFieldSchemaChecker,
     MetricNamesChecker,
@@ -46,5 +53,7 @@ def all_check_ids():
 __all__ = ["ALL_CHECKERS", "make_suite", "all_check_ids", "EXPECTED_METRICS",
            "AsyncBlockingChecker", "LockDisciplineChecker",
            "LockOrderChecker", "MetricNamesChecker", "PersistOrderChecker",
-           "RpcFieldSchemaChecker", "RpcPairingChecker",
-           "ShmLifecycleChecker", "TransitiveBlockingChecker"]
+           "ResourceLeakChecker", "RpcFieldSchemaChecker",
+           "RpcPairingChecker", "ShmLifecycleChecker",
+           "SilentSwallowChecker", "SpmdConsistencyChecker",
+           "TransitiveBlockingChecker"]

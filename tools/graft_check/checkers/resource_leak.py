@@ -1,0 +1,701 @@
+"""resource-leak: every acquired resource is released on EVERY path.
+
+The bug class PRs 6, 7 and 11 each closed by hand in review: a resource
+acquired (an shm channel created, an arena view pinned, a router
+in-flight slot taken, an fd opened, an admission-semaphore slot held) and
+released on the happy path — but not on an exception path, so the first
+error under load leaks tmpfs bytes / pins / slots forever. `shm-lifecycle`
+catches the module-level "no release anywhere" shape; this checker is
+**path-sensitive**: it builds the per-function CFG (tools/graft_check/
+cfg.py) and flags any acquisition from which a function exit — the
+exceptional exit especially — is reachable without crossing a release.
+
+The acquire→release vocabulary is a declarative pair table (`PAIRS`):
+
+- value resources (`x = create_mutable_channel(...)`, `x = os.open(...)`,
+  `fd = SharedMemory(...)`, `view = store.pin(oid)`,
+  `rid = self._router.pick(...)`, `b = hist.bind(tags)`): released by a
+  method on the variable (`x.close()`, `x.unlink()`, ...) or by passing
+  it to a paired call (`os.close(fd)`, `router.done(rid)`);
+- receiver resources (`self._admission.acquire()`): released by the
+  matching call on the SAME receiver text (`self._admission.release()`).
+  Analyzed only when the function releases that receiver somewhere —
+  cross-method hold protocols (acquire in start(), release in stop())
+  are a design, not a leak.
+
+**Ownership-transfer exemption**: an acquisition that escapes the
+function stops being its responsibility — `return x` / `yield x`,
+storing into an attribute/subscript/container, aliasing to another name,
+or passing `x` to any call (the callee — or the object it's stored in —
+owns it now). `with acquire() as x:` is release-on-all-exits by
+construction (the CFG's with_exit node).
+
+**Interprocedural**: a helper whose return value IS a fresh acquisition
+(`def new_chan(): ch = create_mutable_channel(...); ...; return ch`) is a
+factory; `x = new_chan()` at a resolvable call site is then an
+acquisition of the same kind in the caller, analyzed with the caller's
+CFG. Factory status propagates transitively through `return helper()`
+chains via the shared call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graft_check.cfg import CFG, build_cfg
+from tools.graft_check.core import (CallSite, Checker, Finding,
+                                    ParsedModule)
+
+CHECK_ID = "resource-leak"
+
+
+class ResourcePair:
+    """One acquire→release family of the pair table."""
+
+    __slots__ = ("kind", "acquire_calls", "acquire_qual", "acquire_attrs",
+                 "recv_acquire_attrs", "recv_re", "release_attrs",
+                 "release_arg_attrs", "what", "token")
+
+    def __init__(self, kind: str, *, acquire_calls: Sequence[str] = (),
+                 acquire_qual: Sequence[Tuple[str, str]] = (),
+                 acquire_attrs: Sequence[str] = (),
+                 recv_acquire_attrs: Sequence[str] = (),
+                 recv_re: str = "", release_attrs: Sequence[str] = (),
+                 release_arg_attrs: Sequence[str] = (), what: str = "",
+                 token: bool = False):
+        self.kind = kind
+        self.acquire_calls = frozenset(acquire_calls)
+        self.acquire_qual = frozenset(acquire_qual)
+        self.acquire_attrs = frozenset(acquire_attrs)
+        self.recv_acquire_attrs = frozenset(recv_acquire_attrs)
+        self.recv_re = re.compile(recv_re) if recv_re else None
+        self.release_attrs = frozenset(release_attrs)
+        self.release_arg_attrs = frozenset(release_arg_attrs)
+        self.what = what or kind
+        #: token resources are small IDs, not owned objects: passing the
+        #: token to an unrelated call (or aliasing it) does NOT hand off
+        #: the obligation to release it
+        self.token = token
+
+    def recv_ok(self, recv: str) -> bool:
+        return self.recv_re is None or bool(self.recv_re.search(recv))
+
+
+#: the declarative pair table. Order is stable (pair index is pickled in
+#: the cross-module facts, and the cache digest covers this file — editing
+#: the table invalidates stale facts automatically).
+PAIRS: Tuple[ResourcePair, ...] = (
+    ResourcePair(
+        "shm-channel",
+        acquire_calls=("create_mutable_channel", "MutableShmChannel"),
+        release_attrs=("close", "close_mapping", "unlink", "teardown"),
+        what="mutable shm channel (tmpfs segment / mapping)"),
+    ResourcePair(
+        "shared-memory",
+        acquire_calls=("SharedMemory",),
+        acquire_qual=(("shared_memory", "SharedMemory"),),
+        release_attrs=("close", "unlink"),
+        what="multiprocessing SharedMemory segment"),
+    ResourcePair(
+        "arena-pin",
+        acquire_attrs=("pin",),
+        release_attrs=("release", "unpin"),
+        release_arg_attrs=("release", "unpin", "release_pin"),
+        what="shm-arena pinned view (blocks eviction while held)"),
+    ResourcePair(
+        "router-slot",
+        acquire_attrs=("pick",), recv_re=r"router",
+        release_arg_attrs=("done",), token=True,
+        what="router in-flight slot (skews pow2 routing while held)"),
+    ResourcePair(
+        "fd",
+        acquire_qual=(("os", "open"), ("os", "dup"), ("os", "memfd_create")),
+        release_attrs=("close",), release_arg_attrs=("close", "fdopen"),
+        what="raw file descriptor"),
+    ResourcePair(
+        "file",
+        acquire_calls=("open",), acquire_qual=(("io", "open"),
+                                               ("gzip", "open")),
+        release_attrs=("close",),
+        what="file object"),
+    ResourcePair(
+        "mmap",
+        acquire_qual=(("mmap", "mmap"),),
+        release_attrs=("close",),
+        what="mmap mapping"),
+    ResourcePair(
+        "semaphore",
+        recv_acquire_attrs=("acquire",),
+        release_attrs=("release",),
+        what="semaphore/occupancy slot"),
+    ResourcePair(
+        "bound-series",
+        acquire_attrs=("bind",), recv_re=r"hist|metr|_m_|_h_",
+        release_arg_attrs=("remove", "retire"),
+        what="bound metric series (grows every scrape until retired)"),
+)
+
+_PAIR_IDX = {p.kind: i for i, p in enumerate(PAIRS)}
+
+# ------------------------------------------------------------------ events
+#
+# Per-CFG-node event tuples (picklable — the cross-module tier replays
+# them from the cache without reparsing):
+#   ("acq",  pair_idx, var, line)          value acquisition
+#   ("racq", pair_idx, recv, line)         receiver acquisition
+#   ("call", recv, attr, argvars, line)    any attribute call (releases)
+#   ("xfer", var)                          ownership escape
+#   ("asgn", var)                          var rebound (tracking ends)
+#   ("rctx", var)                          with-managed release (with_exit)
+#   ("cand", var, recv, name, line)        x = helper() — factory candidate
+
+
+def _own_exprs(node) -> list:
+    """The expressions evaluated AT this CFG node (compound statements'
+    bodies have their own nodes)."""
+    stmt = node.stmt
+    if stmt is None:
+        return []
+    if node.kind == "with_exit":
+        return []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [it.context_expr for it in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, ast.ExceptHandler):
+        return []
+    return [stmt]
+
+
+def _iter_exprs(roots) -> Iterable[ast.AST]:
+    """Walk expression trees, skipping nested function/lambda bodies."""
+    stack = [r for r in roots if r is not None]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _call_key(call: ast.Call) -> Tuple[str, str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    if isinstance(fn, ast.Attribute):
+        try:
+            return ast.unparse(fn.value), fn.attr
+        except Exception:  # noqa: BLE001 — exotic receiver
+            return "?", fn.attr
+    return "?", ""
+
+
+def _match_acquire(call: ast.Call) -> Optional[int]:
+    recv, name = _call_key(call)
+    for i, pair in enumerate(PAIRS):
+        if recv == "" and name in pair.acquire_calls:
+            return i
+        if (recv, name) in pair.acquire_qual:
+            return i
+        if name in pair.acquire_attrs and recv not in ("", "?") \
+                and pair.recv_ok(recv):
+            return i
+    return None
+
+
+def _escape_vars(value: ast.AST) -> Set[str]:
+    """Names whose OWNERSHIP escapes through `value` when it is returned,
+    yielded, or stored outside the frame: direct names, names inside
+    container literals, names passed as call arguments. Names under an
+    Attribute/Subscript base (`ch.path`) do NOT escape."""
+    out: Set[str] = set()
+    stack = [value]
+    while stack:
+        n = stack.pop()
+        if n is None:
+            continue
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            stack.extend(n.elts)
+        elif isinstance(n, ast.Dict):
+            stack.extend(v for v in n.values if v is not None)
+        elif isinstance(n, ast.Call):
+            stack.extend(n.args)
+            stack.extend(k.value for k in n.keywords)
+        elif isinstance(n, ast.Starred):
+            stack.append(n.value)
+        elif isinstance(n, (ast.IfExp,)):
+            stack.extend([n.body, n.orelse])
+        elif isinstance(n, ast.Await):
+            stack.append(n.value)
+    return out
+
+
+def extract_events(cfg: CFG) -> Dict[int, List[tuple]]:
+    """Per-node resource events for `cfg` (see the table above)."""
+    events: Dict[int, List[tuple]] = {}
+
+    def add(idx: int, ev: tuple) -> None:
+        events.setdefault(idx, []).append(ev)
+
+    for node in cfg.nodes:
+        stmt = node.stmt
+        if node.kind == "with_exit":
+            for item in stmt.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    add(node.idx, ("rctx", item.optional_vars.id))
+            continue
+        exprs = _own_exprs(node)
+        if not exprs:
+            continue
+
+        # every attribute call (release matching) + transfer via call args
+        for n in _iter_exprs(exprs):
+            if isinstance(n, ast.Call):
+                recv, name = _call_key(n)
+                argvars = tuple(
+                    a.id for a in n.args if isinstance(a, ast.Name)
+                ) + tuple(k.value.id for k in n.keywords
+                          if isinstance(k.value, ast.Name))
+                if name:
+                    add(node.idx, ("call", recv, name, argvars,
+                                   n.lineno))
+
+        st = stmt
+        # acquisitions: x = <acquire-call>, plus `with acquire() as x`
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            var = st.targets[0].id
+            pi = _match_acquire(st.value)
+            if pi is not None:
+                add(node.idx, ("acq", pi, var, st.lineno))
+            else:
+                recv, name = _call_key(st.value)
+                if recv in ("", "self", "cls") and name:
+                    add(node.idx, ("cand", var, recv, name, st.lineno))
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        isinstance(item.optional_vars, ast.Name):
+                    pi = _match_acquire(item.context_expr)
+                    if pi is not None:
+                        add(node.idx, ("acq", pi, item.optional_vars.id,
+                                       st.lineno))
+        # bare receiver acquisition: self._sem.acquire()
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            recv, name = _call_key(st.value)
+            for i, pair in enumerate(PAIRS):
+                if name in pair.recv_acquire_attrs and \
+                        recv not in ("", "?") and pair.recv_ok(recv):
+                    add(node.idx, ("racq", i, recv, st.lineno))
+
+        # rebinds and ownership escapes (the third element records HOW the
+        # name escaped: token resources only honor "ret"/"store" escapes)
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            escapes_target = False
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    add(node.idx, ("asgn", t.id))
+                elif isinstance(t, (ast.Attribute, ast.Subscript,
+                                    ast.Tuple, ast.List)):
+                    escapes_target = True
+            value = getattr(st, "value", None)
+            if value is not None and (escapes_target or any(
+                    isinstance(t, ast.Name) for t in targets)):
+                # storing into self.x / d[k] transfers; `y = x` aliases
+                # (ownership follows the alias — tracked no further)
+                why = "store" if escapes_target else "alias"
+                for var in _escape_vars(value):
+                    add(node.idx, ("xfer", var, why))
+        elif isinstance(st, (ast.Return,)):
+            for var in _escape_vars(st.value):
+                add(node.idx, ("xfer", var, "ret"))
+        elif isinstance(st, ast.Expr):
+            v = st.value
+            if isinstance(v, (ast.Yield, ast.YieldFrom)):
+                for var in _escape_vars(v.value):
+                    add(node.idx, ("xfer", var, "ret"))
+            elif isinstance(v, ast.Await) and isinstance(v.value, ast.Call):
+                for var in _escape_vars(v.value):
+                    add(node.idx, ("xfer", var, "callarg"))
+            elif isinstance(v, ast.Call):
+                for var in _escape_vars(v):
+                    if isinstance(v.func, ast.Attribute) and \
+                            isinstance(v.func.value, ast.Name) and \
+                            v.func.value.id == var:
+                        continue  # x.method(...): use, not escape
+                    add(node.idx, ("xfer", var, "callarg"))
+        elif isinstance(st, ast.Raise):
+            for var in _escape_vars(st.exc):
+                add(node.idx, ("xfer", var, "store"))
+        # yields nested in assignments: `got = yield x`
+        for n in _iter_exprs(exprs):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)) and \
+                    not isinstance(st, ast.Expr):
+                for var in _escape_vars(n.value):
+                    add(node.idx, ("xfer", var, "ret"))
+        # names captured by a nested def/lambda: cleanup is deferred to
+        # the closure (e.g. weakref.finalize(self, on_done)) — the
+        # obligation transferred with it
+        for n in _iter_exprs(exprs):
+            for sub in ast.iter_child_nodes(n):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    for name in ast.walk(sub):
+                        if isinstance(name, ast.Name):
+                            add(node.idx, ("capt", name.id))
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for name in ast.walk(st):
+                if isinstance(name, ast.Name):
+                    add(node.idx, ("capt", name.id))
+    return events
+
+
+# ---------------------------------------------------------------- analysis
+
+
+def _release_nodes(events: Dict[int, List[tuple]], pair: ResourcePair,
+                   var: str) -> Set[int]:
+    out: Set[int] = set()
+    for idx, evs in events.items():
+        for ev in evs:
+            if ev[0] == "rctx" and ev[1] == var:
+                out.add(idx)
+            elif ev[0] == "call":
+                _tag, recv, attr, argvars, _line = ev
+                if attr in pair.release_attrs and (
+                        recv == var or recv.startswith(var + ".")):
+                    out.add(idx)
+                elif attr in pair.release_arg_attrs and var in argvars \
+                        and pair.recv_ok(recv):
+                    out.add(idx)
+    return out
+
+
+def _recv_release_nodes(events: Dict[int, List[tuple]],
+                        pair: ResourcePair, recv: str) -> Set[int]:
+    out: Set[int] = set()
+    for idx, evs in events.items():
+        for ev in evs:
+            if ev[0] == "call" and ev[2] in pair.release_attrs \
+                    and ev[1] == recv:
+                out.add(idx)
+    return out
+
+
+def _transfer_nodes(events: Dict[int, List[tuple]], pair: ResourcePair,
+                    var: str, acq_node: int) -> Set[int]:
+    out: Set[int] = set()
+    for idx, evs in events.items():
+        for ev in evs:
+            if ev[0] == "capt" and ev[1] == var:
+                out.add(idx)  # release deferred to a closure
+            elif ev[0] == "xfer" and ev[1] == var:
+                # tokens (router slot ids, ...) are not owned objects:
+                # passing one to an unrelated call or aliasing it does
+                # not hand off the release obligation — only returning
+                # it or storing it somewhere durable does
+                if not pair.token or ev[2] in ("ret", "store"):
+                    out.add(idx)
+            elif ev[0] == "call" and var in ev[3] and not pair.token:
+                out.add(idx)  # passed to a call: callee owns it now
+            elif ev[0] == "asgn" and ev[1] == var and idx != acq_node:
+                out.add(idx)  # rebound: tracking ends
+    return out
+
+
+class _Adj:
+    """CFG shape reduced to what analysis needs — buildable from a live
+    CFG or from pickled facts. The start node's own may-raise edge is
+    skipped: if the acquire call itself raises, nothing was acquired."""
+
+    __slots__ = ("succ", "exc", "exit", "raise_exit")
+
+    def __init__(self, succ: List[tuple], exc: List[Optional[int]],
+                 exit_idx: int, rexit: int):
+        self.succ = succ
+        self.exc = exc
+        self.exit = exit_idx
+        self.raise_exit = rexit
+
+    @classmethod
+    def of(cls, cfg: CFG) -> "_Adj":
+        return cls([tuple(n.succ) for n in cfg.nodes],
+                   [n.exc for n in cfg.nodes], cfg.exit, cfg.raise_exit)
+
+    def reachable(self, start: int, blocked: Set[int]) -> Set[int]:
+        seen = {start}
+        stack = [start]
+        while stack:
+            cur = stack.pop()
+            if cur != start and cur in blocked:
+                continue
+            neigh = list(self.succ[cur])
+            if self.exc[cur] is not None and cur != start:
+                neigh.append(self.exc[cur])
+            for nxt in neigh:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+
+def analyze_acquisition(adj: _Adj, events: Dict[int, List[tuple]],
+                        pair: ResourcePair, acq_node: int,
+                        var: str) -> Optional[str]:
+    """None when every path from the acquisition crosses a release or an
+    ownership transfer; otherwise which exits escape ('exception path' /
+    'normal return path' / both)."""
+    blocked = _release_nodes(events, pair, var) \
+        | _transfer_nodes(events, pair, var, acq_node)
+    reach = adj.reachable(acq_node, blocked)
+    exc = adj.raise_exit in reach
+    ret = adj.exit in reach
+    if not exc and not ret:
+        return None
+    if exc and ret:
+        return "both an exception path and a normal return path escape"
+    if exc:
+        return "an exception path escapes"
+    return "a return path escapes"
+
+
+def analyze_receiver(adj: _Adj, events: Dict[int, List[tuple]],
+                     pair: ResourcePair, acq_node: int,
+                     recv: str) -> Optional[str]:
+    rel = _recv_release_nodes(events, pair, recv)
+    if not rel:
+        return None  # cross-method hold protocol: not this checker's call
+    reach = adj.reachable(acq_node, rel)
+    exc = adj.raise_exit in reach
+    ret = adj.exit in reach
+    if not exc and not ret:
+        return None
+    if exc and ret:
+        return "both an exception path and a normal return path escape"
+    return ("an exception path escapes" if exc
+            else "a return path escapes")
+
+
+# ----------------------------------------------------------------- checker
+
+
+def _iter_functions(tree) -> Iterable[Tuple[str, ast.AST]]:
+    """(qualname, func node) over a module, matching core's qualnames."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield qual, child
+                yield from walk(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, qual)
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+class ResourceLeakChecker(Checker):
+    ids = ((CHECK_ID,
+            "every acquired resource (shm channel/segment, arena pin, "
+            "router slot, fd/mmap, semaphore, bound metric series) is "
+            "released on every path — exception paths included"),)
+    facts_name = "resource_leak"
+
+    def __init__(self):
+        self._memo: Dict[str, dict] = {}  # relpath → per-function data
+
+    # -- shared per-module pass -------------------------------------------
+
+    def _functions(self, mod: ParsedModule) -> dict:
+        data = self._memo.get(mod.relpath)
+        if data is not None:
+            return data
+        data = {}
+        for qual, func in _iter_functions(mod.tree):
+            cfg = build_cfg(func)
+            events = extract_events(cfg)
+            data[qual] = (cfg, events)
+        self._memo[mod.relpath] = data
+        return data
+
+    # -- local tier --------------------------------------------------------
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for qual, (cfg, events) in self._functions(mod).items():
+            adj = _Adj.of(cfg)
+            for idx, evs in sorted(events.items()):
+                for ev in evs:
+                    if ev[0] == "acq":
+                        _t, pi, var, line = ev
+                        pair = PAIRS[pi]
+                        how = analyze_acquisition(adj, events, pair, idx,
+                                                  var)
+                        if how is not None:
+                            out.append(Finding(
+                                CHECK_ID, mod.relpath, line, qual,
+                                f"{pair.what} `{var}` acquired here but "
+                                f"{how} without a reachable release "
+                                f"({'/'.join(sorted(pair.release_attrs | pair.release_arg_attrs))}) "
+                                f"— release in a finally/with, or "
+                                f"transfer ownership explicitly"))
+                    elif ev[0] == "racq":
+                        _t, pi, recv, line = ev
+                        pair = PAIRS[pi]
+                        how = analyze_receiver(adj, events, pair, idx,
+                                               recv)
+                        if how is not None:
+                            out.append(Finding(
+                                CHECK_ID, mod.relpath, line, qual,
+                                f"{pair.what} `{recv}.acquire()` is "
+                                f"released on some paths but {how} "
+                                f"without `{recv}.release()` — move the "
+                                f"release into a finally"))
+        return out
+
+    # -- cross-module tier -------------------------------------------------
+
+    def collect(self, mod: ParsedModule):
+        factories: Dict[str, int] = {}
+        ret_calls: Dict[str, List[Tuple[str, str]]] = {}
+        funcs: Dict[str, dict] = {}
+        for qual, (cfg, events) in self._functions(mod).items():
+            cands = []
+            acq_vars: Dict[str, int] = {}
+            returned_vars: Set[str] = set()
+            for idx, evs in events.items():
+                for ev in evs:
+                    if ev[0] == "acq":
+                        acq_vars[ev[2]] = ev[1]
+                    elif ev[0] == "cand":
+                        cands.append((ev[1], ev[2], ev[3], idx, ev[4]))
+            # direct returns: `return x` / `return f(...)`
+            for node in cfg.nodes:
+                st = node.stmt
+                if node.kind == "stmt" and isinstance(st, ast.Return) \
+                        and st.value is not None:
+                    if isinstance(st.value, ast.Name):
+                        returned_vars.add(st.value.id)
+                    elif isinstance(st.value, ast.Call):
+                        pi = _match_acquire(st.value)
+                        if pi is not None:
+                            factories.setdefault(qual, pi)
+                        else:
+                            recv, name = _call_key(st.value)
+                            if recv in ("", "self", "cls") and name:
+                                ret_calls.setdefault(qual, []).append(
+                                    (recv, name))
+            for var, pi in acq_vars.items():
+                if var in returned_vars:
+                    factories.setdefault(qual, pi)
+            if cands:
+                funcs[qual] = {
+                    "adj": [tuple(n.succ) for n in cfg.nodes],
+                    "exc": [n.exc for n in cfg.nodes],
+                    "exit": cfg.exit, "rexit": cfg.raise_exit,
+                    "events": {i: list(evs)
+                               for i, evs in events.items()},
+                    "cands": [(v, r, n, i, ln)
+                              for (v, r, n, i, ln) in cands
+                              if v not in acq_vars],
+                    "returned": sorted(returned_vars),
+                }
+        self._memo.pop(mod.relpath, None)  # free ASTs once both passes ran
+        return {"factories": factories, "ret_calls": ret_calls,
+                "funcs": funcs}
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        if project is None:
+            return ()
+        facts = project.facts(self.facts_name)
+        graph = project.graph
+
+        # 1) factory closure: direct factories, then `return helper()` and
+        # `x = helper(); ...; return x` chains through the call graph
+        factories: Dict[Tuple[str, str], int] = {}
+        for rel, f in facts.items():
+            if not f:
+                continue
+            for qual, pi in f["factories"].items():
+                factories[(rel, qual)] = pi
+
+        def resolve(rel: str, qual: str, recv: str,
+                    name: str) -> Optional[Tuple[str, str]]:
+            caller = graph.func(rel, qual)
+            if caller is None:
+                return None
+            site = CallSite(0, recv, name, (), False, False)
+            hit = graph.resolve(rel, caller, site)
+            return (hit[0], hit[1].qualname) if hit else None
+
+        changed = True
+        rounds = 0
+        while changed and rounds < 8:
+            changed = False
+            rounds += 1
+            for rel, f in facts.items():
+                if not f:
+                    continue
+                for qual, calls in f["ret_calls"].items():
+                    if (rel, qual) in factories:
+                        continue
+                    for recv, name in calls:
+                        tgt = resolve(rel, qual, recv, name)
+                        if tgt is not None and tgt in factories:
+                            factories[(rel, qual)] = factories[tgt]
+                            changed = True
+                            break
+                for qual, fn in f["funcs"].items():
+                    if (rel, qual) in factories:
+                        continue
+                    returned = set(fn["returned"])
+                    for var, recv, name, _idx, _line in fn["cands"]:
+                        if var not in returned:
+                            continue
+                        tgt = resolve(rel, qual, recv, name)
+                        if tgt is not None and tgt in factories:
+                            factories[(rel, qual)] = factories[tgt]
+                            changed = True
+                            break
+
+        # 2) analyze factory-returned acquisitions in their callers
+        out: List[Finding] = []
+        for rel in sorted(facts):
+            f = facts[rel]
+            if not f:
+                continue
+            for qual in sorted(f["funcs"]):
+                fn = f["funcs"][qual]
+                adj = _Adj(fn["adj"], fn["exc"], fn["exit"], fn["rexit"])
+                events = fn["events"]
+                for var, recv, name, idx, line in fn["cands"]:
+                    tgt = resolve(rel, qual, recv, name)
+                    if tgt is None or tgt not in factories:
+                        continue
+                    pair = PAIRS[factories[tgt]]
+                    how = analyze_acquisition(adj, events, pair, idx, var)
+                    if how is not None:
+                        out.append(Finding(
+                            CHECK_ID, rel, line, qual,
+                            f"{pair.what} `{var}` acquired via factory "
+                            f"{tgt[1]}() but {how} without a reachable "
+                            f"release "
+                            f"({'/'.join(sorted(pair.release_attrs | pair.release_arg_attrs))})"
+                            f" — release in a finally/with, or transfer "
+                            f"ownership explicitly"))
+        return out

@@ -1,0 +1,68 @@
+"""silent-swallow: no broad `except: pass` without a story.
+
+An `except Exception: pass` (or bare `except:` / `except BaseException:`)
+whose body is ONLY `pass` destroys the evidence of every failure that
+crosses it — the serving hot path had handlers eating replica-address
+registration failures, reply-serialization failures, and stream teardown
+errors with nothing in any log. A narrow guard (`except OSError: pass`
+around a close()) states which failures are expected; a broad one states
+nothing.
+
+Every site must do one of:
+
+- **narrow** the exception to the types the code actually expects
+  (`except (ConnectionClosed, OSError):`) — narrowed handlers are not
+  flagged even when they pass;
+- **log** (or count, or re-raise) — any statement besides the lone
+  `pass` clears the finding, so `logger.debug(...)` + implicit fall
+  through is enough;
+- carry a **baseline justification** with an `=N` pin naming why the
+  swallow is deliberate (teardown guards where the peer may already be
+  gone, metrics that must never fail a request, ...). New swallows at a
+  pinned symbol overflow the pin and fail tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graft_check.core import Checker, Finding, ParsedModule
+
+CHECK_ID = "silent-swallow"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare `except:`
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+class SilentSwallowChecker(Checker):
+    ids = ((CHECK_ID,
+            "no broad `except Exception: pass` — narrow the type, log, "
+            "or justify in the baseline"),)
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                out.append(mod.finding(
+                    CHECK_ID, node,
+                    "broad exception silently swallowed (`except "
+                    "Exception: pass`) — narrow the exception type, log "
+                    "the failure, or add a justified `=N`-pinned "
+                    "baseline entry"))
+        return out

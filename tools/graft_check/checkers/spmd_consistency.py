@@ -1,0 +1,277 @@
+"""spmd-consistency: collective axis names and PartitionSpecs resolve
+against ONE mesh-axis vocabulary.
+
+A wrong axis string in `lax.psum(x, "db")`, a `PartitionSpec` naming an
+axis the mesh doesn't have, or a spec sharding one array dimension over
+the same axis twice all pass import, pass jit tracing on a single
+device, and explode only at runtime on the real 8-device mesh — the
+"runtime-or-nothing" class the GSPMD bet (ahead-of-time sharding
+propagation, arXiv 2105.04663) exists to eliminate. This checker makes
+the axis vocabulary a static artifact:
+
+- the vocabulary is the `MESH_AXES` tuple in
+  `ray_tpu/_private/constants.py` (hoisted there so producers —
+  parallel/mesh.py — and every consumer share one spelling; drift now
+  fails tier-1 instead of a TPU job);
+- inside the SPMD scope (`train/`, `parallel/`, `ops/`, `llm/`) every
+  resolvable axis value — `axis_name=`/`zero_axis=` keywords, string
+  `axis=` keywords, string defaults of `axis`/`axis_name` parameters,
+  the positional axis argument of `lax.psum`/`pmean`/`ppermute`/
+  `psum_scatter`/`all_gather`/`all_to_all`/`axis_index`/`pvary`, and
+  every entry of a literal `P(...)`/`PartitionSpec(...)` — must be in
+  the vocabulary. Names imported from the constants module resolve to
+  their string values; dynamic values (`mesh.axis_names[0]`) are
+  skipped, never guessed;
+- arity/validity: one `P(...)` must not name the same mesh axis twice
+  (invalid GSPMD sharding), must not have more entries than the mesh
+  has axes, and a literal axis tuple passed to `Mesh(devices, (...))`
+  must be duplicate-free vocabulary axes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graft_check.core import Checker, Finding, ParsedModule
+
+CHECK_ID = "spmd-consistency"
+
+#: the real tree's layout; tests override via the constructor.
+CONSTANTS_MODULE = "_private/constants.py"
+SCOPE_PREFIXES = ("train/", "parallel/", "ops/", "llm/")
+
+#: jax.lax collectives whose positional arg 1 is the axis name.
+_COLLECTIVES = {"psum", "pmean", "ppermute", "psum_scatter", "all_gather",
+                "all_to_all", "axis_index", "pvary"}
+#: keyword names that always carry a mesh-axis value.
+_AXIS_KWARGS = {"axis_name", "zero_axis"}
+#: parameter names whose STRING defaults carry a mesh-axis value.
+_AXIS_PARAMS = {"axis_name", "zero_axis", "axis"}
+#: spec constructors (PartitionSpec is conventionally aliased to P).
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _axis_value(node) -> Optional[tuple]:
+    """('str', value) | ('name', ident) | ('tuple', [parts...]) | None for
+    an expression standing where a mesh axis belongs."""
+    s = _const_str(node)
+    if s is not None:
+        return ("str", s)
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        parts = [_axis_value(e) for e in node.elts]
+        return ("tuple", parts)
+    return None
+
+
+class SpmdConsistencyChecker(Checker):
+    ids = ((CHECK_ID,
+            "collective axis names / PartitionSpec axes resolve against "
+            "the MESH_AXES vocabulary in _private/constants.py; no "
+            "duplicate axes or over-rank specs"),)
+    facts_name = "spmd_consistency"
+
+    def __init__(self, constants_module: str = CONSTANTS_MODULE,
+                 scope_prefixes: Sequence[str] = SCOPE_PREFIXES,
+                 axes: Optional[Sequence[str]] = None):
+        self.constants_module = constants_module
+        self.scope_prefixes = tuple(scope_prefixes)
+        self.axes_override = tuple(axes) if axes is not None else None
+
+    # ------------------------------------------------------------- collect
+
+    def _collect_constants(self, mod: ParsedModule) -> dict:
+        """String constants (and tuples of strings) defined at module
+        level of the constants module — the resolution table."""
+        consts: Dict[str, object] = {}
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                    or not isinstance(stmt.targets[0], ast.Name):
+                continue
+            name = stmt.targets[0].id
+            v = stmt.value
+            s = _const_str(v)
+            if s is not None:
+                consts[name] = s
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                parts = [_const_str(e) for e in v.elts]
+                # resolve names defined earlier in the same module
+                for i, e in enumerate(v.elts):
+                    if parts[i] is None and isinstance(e, ast.Name) and \
+                            isinstance(consts.get(e.id), str):
+                        parts[i] = consts[e.id]
+                if all(p is not None for p in parts):
+                    consts[name] = tuple(parts)
+        return {"consts": consts}
+
+    def collect(self, mod: ParsedModule):
+        if mod.relpath.endswith(self.constants_module):
+            return self._collect_constants(mod)
+        if not mod.relpath.startswith(self.scope_prefixes):
+            return None
+        sites: List[tuple] = []
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # string defaults of axis-ish parameters
+                args = node.args
+                all_params = (args.posonlyargs + args.args
+                              + args.kwonlyargs)
+                defaults = ([None] * (len(args.posonlyargs + args.args)
+                                      - len(args.defaults))
+                            + list(args.defaults) + list(args.kw_defaults))
+                for param, default in zip(all_params, defaults):
+                    if param.arg in _AXIS_PARAMS and default is not None:
+                        av = _axis_value(default)
+                        if av is not None and av[0] != "name":
+                            sites.append(("axis", av, default.lineno,
+                                          mod.symbol_at(default.lineno),
+                                          f"default of {param.arg}="))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            # keyword axis values
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS or (
+                        kw.arg == "axis"
+                        and _const_str(kw.value) is not None):
+                    av = _axis_value(kw.value)
+                    if av is not None:
+                        sites.append(("axis", av, node.lineno,
+                                      mod.symbol_at(node.lineno),
+                                      f"{fname}({kw.arg}=...)"))
+            # positional axis of the lax collectives
+            if fname in _COLLECTIVES and len(node.args) >= 2:
+                av = _axis_value(node.args[1])
+                if av is not None:
+                    sites.append(("axis", av, node.lineno,
+                                  mod.symbol_at(node.lineno),
+                                  f"{fname}(..., axis)"))
+            # literal PartitionSpecs
+            if fname in _SPEC_NAMES:
+                entries = []
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and arg.value is None:
+                        entries.append(("none",))
+                    else:
+                        entries.append(_axis_value(arg))
+                sites.append(("spec", entries, node.lineno,
+                              mod.symbol_at(node.lineno), f"{fname}(...)"))
+            # Mesh(devices, (axis, ...)) literal axis tuples
+            if fname == "Mesh" and len(node.args) >= 2:
+                av = _axis_value(node.args[1])
+                if av is not None and av[0] == "tuple":
+                    sites.append(("mesh", av, node.lineno,
+                                  mod.symbol_at(node.lineno), "Mesh(...)"))
+        return {"sites": sites} if sites else None
+
+    # -------------------------------------------------------------- finish
+
+    def finish(self, project=None) -> Iterable[Finding]:
+        if project is None:
+            return ()
+        facts = project.facts(self.facts_name)
+        consts: Dict[str, object] = {}
+        for rel, f in facts.items():
+            if f and "consts" in f:
+                consts = f["consts"]
+                break
+        if self.axes_override is not None:
+            axes: Tuple[str, ...] = self.axes_override
+        else:
+            mesh_axes = consts.get("MESH_AXES")
+            axes = tuple(mesh_axes) if isinstance(mesh_axes, tuple) else ()
+        if not axes:
+            return ()  # no vocabulary to check against (fixture trees)
+        vocab = set(axes)
+
+        def resolve(av) -> Tuple[Optional[List[str]], bool]:
+            """(axis names, resolved?) for one axis value."""
+            if av is None:
+                return None, False
+            tag = av[0]
+            if tag == "none":
+                return [], True
+            if tag == "str":
+                return [av[1]], True
+            if tag == "name":
+                val = consts.get(av[1])
+                if isinstance(val, str):
+                    return [val], True
+                return None, False
+            if tag == "tuple":
+                out: List[str] = []
+                for part in av[1]:
+                    names, ok = resolve(part)
+                    if not ok:
+                        return None, False
+                    out.extend(names)
+                return out, True
+            return None, False
+
+        out: List[Finding] = []
+        for rel in sorted(facts):
+            f = facts[rel]
+            if not f or "sites" not in f:
+                continue
+            for kind, payload, line, symbol, where in f["sites"]:
+                if kind == "axis":
+                    names, ok = resolve(payload)
+                    if not ok:
+                        continue
+                    for name in names:
+                        if name not in vocab:
+                            out.append(Finding(
+                                CHECK_ID, rel, line, symbol,
+                                f"axis {name!r} at {where} is not a mesh "
+                                f"axis — MESH_AXES is {axes} "
+                                f"(ray_tpu/_private/constants.py); this "
+                                f"would only fail at runtime on the "
+                                f"mesh"))
+                elif kind in ("spec", "mesh"):
+                    entries = (payload if kind == "spec"
+                               else [p for p in payload[1]])
+                    seen: Dict[str, int] = {}
+                    n_axes = 0  # resolved NON-None axis-naming entries
+                    for entry in entries:
+                        names, ok = resolve(entry)
+                        if not ok:
+                            continue
+                        n_axes += len(names)
+                        for name in names:
+                            if name not in vocab:
+                                out.append(Finding(
+                                    CHECK_ID, rel, line, symbol,
+                                    f"axis {name!r} in {where} is not a "
+                                    f"mesh axis — MESH_AXES is {axes}"))
+                            seen[name] = seen.get(name, 0) + 1
+                    for name, n in seen.items():
+                        if n > 1 and name in vocab:
+                            out.append(Finding(
+                                CHECK_ID, rel, line, symbol,
+                                f"axis {name!r} appears {n}x in {where} — "
+                                f"sharding two dimensions (or one twice) "
+                                f"over one mesh axis is invalid GSPMD; "
+                                f"XLA rejects it only at lowering time"))
+                    # arity: a spec's LENGTH is the array rank (trailing
+                    # None entries replicate extra dims — valid), but it
+                    # cannot NAME more axes than the mesh has
+                    if kind == "spec" and n_axes > len(axes):
+                        out.append(Finding(
+                            CHECK_ID, rel, line, symbol,
+                            f"{where} names {n_axes} mesh axes but the "
+                            f"mesh has only {len(axes)} ({axes}) — more "
+                            f"sharded dims than axes cannot all be "
+                            f"distinct"))
+        return out
