@@ -66,7 +66,9 @@ def main() -> int:
     load = run("benchmarks/llm_load_bench.py",
                ("RAY_TPU_LLM_LOAD_BENCH_BUDGET_S", "540"))
     print("pd:", (load or {}).get("backend"),
-          ((load or {}).get("ab") or {}).get("tokens_per_s_ratio"))
+          ((load or {}).get("ab") or {}).get("tokens_per_s_ratio"),
+          "decode_step ragged x",
+          ((load or {}).get("decode_step") or {}).get("speedup"))
     if (load or {}).get("backend") != "tpu":
         rc = 2
     data = run("benchmarks/data_train_bench.py",

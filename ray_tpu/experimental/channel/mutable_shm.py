@@ -122,6 +122,20 @@ class MutableShmChannel:
         w, r, _n, _c = self._hdr()
         return w > r
 
+    def closed(self) -> bool:
+        """Non-blocking: True iff a peer flipped the closed flag. An
+        unread payload may still be pending — poll()/read() first if the
+        stream should be drained before treating the close as death."""
+        _w, _r, _n, c = self._hdr()
+        return bool(c)
+
+    def drained(self) -> bool:
+        """Non-blocking: True iff at least one payload was published and
+        every published payload was consumed (the poll twin of
+        wait_drained)."""
+        w, r, _n, _c = self._hdr()
+        return w > 0 and r >= w
+
     def write(self, value, timeout: float | None = 60.0) -> None:
         from ray_tpu._private import serialization as ser
 
@@ -148,6 +162,57 @@ class MutableShmChannel:
         w, r, _n, _c = self._hdr()
         self._set(plen=len(payload))
         self._set(write_seq=w + 1)  # publish LAST (TSO: payload visible)
+
+    def write_vectored(self, parts, timeout: float | None = 60.0) -> None:
+        """Write the concatenation of ``parts`` (bytes-like, e.g. numpy
+        memoryviews) as ONE payload without materializing the join — the
+        zero-copy path for multi-buffer messages (PD KV pages: header +
+        raw page bytes)."""
+        total = sum(len(memoryview(p).cast("B")) for p in parts)
+        if total > self.capacity:
+            raise ValueError(
+                f"payload {total}B exceeds channel capacity "
+                f"{self.capacity}B (pick buffer_bytes at create_channel)")
+
+        def writable(hdr):
+            w, r, _n, c = hdr
+            if c:
+                raise ChannelClosed("channel closed")
+            return w == r  # previous payload consumed
+
+        self._wait(writable, timeout,
+                   "channel write timed out (reader too slow)")
+        off = _HDR_SIZE
+        for p in parts:
+            b = memoryview(p).cast("B")
+            self._mm[off:off + len(b)] = b
+            off += len(b)
+        w, r, _n, _c = self._hdr()
+        self._set(plen=total)
+        self._set(write_seq=w + 1)  # publish LAST (TSO: payload visible)
+
+    def read_view(self, timeout: float | None = 60.0):
+        """Zero-copy read: a memoryview over the published payload, valid
+        ONLY until ``ack_read()`` — the caller must copy what it keeps
+        BEFORE acking (the writer may overwrite the buffer after)."""
+
+        def readable(hdr):
+            w, r, _n, c = hdr
+            if w > r:
+                return True
+            if c:
+                raise ChannelClosed("channel closed and drained")
+            return False
+
+        self._wait(readable, timeout, "channel read timed out")
+        _w, _r, n, _c = self._hdr()
+        return memoryview(self._mm)[_HDR_SIZE:_HDR_SIZE + n]
+
+    def ack_read(self) -> None:
+        """Consume the payload returned by ``read_view``: the writer may
+        overwrite the buffer from here on."""
+        _w, r, _n, _c = self._hdr()
+        self._set(read_seq=r + 1)
 
     def read(self, timeout: float | None = 60.0):
         from ray_tpu._private import serialization as ser

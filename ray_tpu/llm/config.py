@@ -45,6 +45,22 @@ class PDConfig:
     # per-page shm handoff timeout: a decode replica that never pulls (or
     # dies mid-pull) frees the prefill side's channel after this long
     transfer_timeout_s: float = 60.0
+    # pages per transfer message — the in-flight prefetch window. >1
+    # amortizes the seqlock handshake + pickle framing over several pages
+    # at the cost of prefetch_depth*page_bytes of channel buffer per
+    # in-flight transfer
+    prefetch_depth: int = 2
+    # route decode-side pulls through the shared BatchedKVPuller (one
+    # polling thread for ALL in-flight transfers) + streamed slot
+    # admission (pages adopted as they arrive). False restores the
+    # pull-everything-then-admit path (debug/A-B escape hatch).
+    batched_pull: bool = True
+    # prefill-tier admission batching (pd.py PrefillCoalescer): concurrent
+    # same-bucket prompts coalesce into ONE [B, T] prefill forward. The
+    # window is how long the batch leader waits for stragglers; 0 batches
+    # only what is already queued.
+    prefill_batch_max: int = 4
+    prefill_batch_window_s: float = 0.0015
     num_prefill_replicas: int = 1
     num_decode_replicas: int = 1
 

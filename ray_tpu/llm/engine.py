@@ -54,6 +54,12 @@ class _Request:
     slot: int = -1
     generated: int = 0
     kv_pack: dict | None = None  # prefilled elsewhere (PD disaggregation)
+    # streamed PD admission: pages adopted as they arrive off the transfer
+    # plane (kv_transfer.KVPageStream protocol); length0 mirrors the
+    # row's device length host-side so the ragged decode step can bound
+    # its page sweep without a device readback
+    kv_stream: object | None = None
+    length0: int = 0
     # chunked-prefill progress (engine._prefill_step)
     pf_done: int = 0
     pf_pages: list | None = None
@@ -90,12 +96,21 @@ class _EngineError:
         self.exc = exc
 
 
+class _RequestError(_EngineError):
+    """End-of-stream marker for a PER-REQUEST failure (e.g. the KV
+    transfer feeding a streamed admission died): the carried exception is
+    re-raised to this caller; the engine and every other request keep
+    serving."""
+
+
 def _iter_request(req: "_Request"):
     """Yield a request's tokens; raise if the engine died mid-stream."""
     while True:
         tok = req.out_queue.get()
         if tok is _SENTINEL:
             return
+        if isinstance(tok, _RequestError):
+            raise tok.exc
         if isinstance(tok, _EngineError):
             raise RuntimeError("engine scheduler died mid-generation") from tok.exc
         yield tok
@@ -178,7 +193,8 @@ class TPUEngine:
                  enable_prefix_cache: bool = False,
                  prefill_chunk: int | None = None,
                  speculative_k: int = 0, ngram_size: int = 2,
-                 mesh=None, max_loras: int = 0, lora_rank: int = 8):
+                 mesh=None, max_loras: int = 0, lora_rank: int = 8,
+                 attn_impl: str = "auto"):
         self.cfg = cfg
         self.max_len = max_len or cfg.max_seq_len
         if self.max_len > cfg.max_seq_len:
@@ -265,7 +281,25 @@ class TPUEngine:
             self.prefill_chunk = prefill_chunk
             self._prefilling: list = []  # requests mid-chunked-prefill
             self.prefill_chunks_run = 0
+            # decode attention: "ragged" = one ragged-paged-attention
+            # launch over the batch's live page tables (ops/
+            # ragged_paged_attention.py — Pallas kernel on TPU, the
+            # bit-consistent pure-JAX reference elsewhere); "gather" =
+            # the legacy full-block-table gather + masked softmax
+            if attn_impl == "auto":
+                attn_impl = "ragged"
+            if attn_impl not in ("ragged", "gather"):
+                raise ValueError(
+                    f"attn_impl must be 'auto', 'ragged' or 'gather', "
+                    f"got {attn_impl!r}")
+            self.attn_impl = attn_impl
+            # the Pallas kernel needs an unsharded pool (the reference is
+            # plain XLA ops, so tp-sharded states keep the ragged path)
+            self._ragged_kernel = (attn_impl == "ragged" and mesh is None
+                                   and jax.default_backend() == "tpu")
         else:
+            self.attn_impl = "gather"
+            self._ragged_kernel = False
             self.enable_prefix_cache = False
             self.prefill_chunk = None
             self._prefilling = []
@@ -318,6 +352,8 @@ class TPUEngine:
             # serializes bank read-modify-write: concurrent loads from
             # replica threads must not lose each other's writes
             self._lora_lock = threading.Lock()
+        self.decode_steps = 0
+        self.decode_slot_steps = 0  # sum of active slots over decode steps
         self.spec_steps = 0
         self.spec_slot_steps = 0   # sum of active slots over verify steps
         self.spec_drafted = 0
@@ -336,6 +372,7 @@ class TPUEngine:
         self._by_slot: dict[int, _Request] = {}
         self._waiting: queue.SimpleQueue = queue.SimpleQueue()
         self._backlog: list = []  # paged: admitted-later queue (page pressure)
+        self._streaming: list = []  # slot granted, pages still streaming in
         self._rid = itertools.count()
         self._work = threading.Event()
         self._stop = False
@@ -353,6 +390,25 @@ class TPUEngine:
                                                  "inter_token")
         except Exception:  # pragma: no cover — metrics must never gate boot
             self._phase_admit = self._phase_gap = None
+        # per-decode-step wall time (device step + sampling sync) split by
+        # attention impl: the ragged-vs-gather attribution the decode
+        # microbench and dashboards key on
+        self._step_obs = None
+        try:
+            from ray_tpu.serve import request_context as _rc2
+            from ray_tpu.util import metrics as met
+
+            if self.kv_layout == "paged" and _rc2.metrics_enabled():
+                h = met.get_or_create(
+                    met.Histogram, "ray_tpu_llm_decode_step_seconds",
+                    "paged decode step wall time (device step + sampling "
+                    "sync) by attention impl (ragged|gather)",
+                    boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                                0.05, 0.1, 0.25, 0.5, 1.0],
+                    tag_keys=("impl",))
+                self._step_obs = h.bind({"impl": self.attn_impl})
+        except Exception:  # pragma: no cover — metrics must never gate boot
+            self._step_obs = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpu-engine")
         self._thread.start()
@@ -378,6 +434,7 @@ class TPUEngine:
                    prefill_chunk=ek.get("prefill_chunk"),
                    speculative_k=ek.get("speculative_k", 0),
                    ngram_size=ek.get("ngram_size", 2),
+                   attn_impl=ek.get("attn_impl", "auto"),
                    mesh=ek.get("mesh"),
                    max_loras=ek.get(
                        "max_loras",
@@ -533,21 +590,39 @@ class TPUEngine:
                          first_token: int = 0,
                          params: SamplingParams | None = None, *,
                          k_pages: list | None = None,
-                         v_pages: list | None = None) -> _Request:
+                         v_pages: list | None = None,
+                         kv_stream=None) -> _Request:
         """Admit a sequence whose prefill ran elsewhere (PD disaggregation).
 
-        Two forms:
+        Three forms:
         - whole-array: k/v are [L, T, Hkv, Dh] host arrays for the prompt
           prefix (the legacy object-plane handoff);
         - page-granular: k_pages/v_pages are ordered lists of
           [L, page_size, Hkv, Dh] pages (the shm transfer plane's unit).
           On a paged engine each page is adopted into the slot pool
-          directly — no whole-bucket array is ever assembled.
+          directly — no whole-bucket array is ever assembled;
+        - streamed: kv_stream is a kv_transfer.KVPageStream the transfer
+          plane is still feeding. The slot and its pages are granted NOW
+          and each page is adopted the moment it arrives — the decode
+          loop keeps stepping other slots while later pages stream in,
+          and the row activates on the LAST page instead of waiting for
+          pull-then-submit. A transfer failure surfaces as a per-request
+          error; the slot and its granted pages are reclaimed.
         """
         self._check_alive()
         params = params or SamplingParams()
         paged_form = k_pages is not None or v_pages is not None
-        if paged_form:
+        if kv_stream is not None:
+            if paged_form or k is not None or v is not None:
+                raise ValueError(
+                    "pass kv_stream alone, not with k/v or k_pages/v_pages")
+            P = int(kv_stream.page_size)
+            if self.kv_layout == "paged" and P != self.page_size:
+                raise ValueError(
+                    f"streamed page size {P} != engine page_size "
+                    f"{self.page_size}: prefill and decode pools must agree")
+            bucket = int(kv_stream.n_pages) * P
+        elif paged_form:
             if k is not None or v is not None:
                 raise ValueError(
                     "pass either k/v arrays or k_pages/v_pages, not both")
@@ -566,7 +641,8 @@ class TPUEngine:
         else:
             if k is None or v is None:
                 raise ValueError(
-                    "submit_prefilled needs k/v arrays or k_pages/v_pages")
+                    "submit_prefilled needs k/v arrays, k_pages/v_pages, "
+                    "or kv_stream")
             bucket = k.shape[1]
         if bucket > self.max_len:
             raise ValueError(
@@ -589,7 +665,14 @@ class TPUEngine:
                 f"does not fit engine max_len {self.max_len}")
         req = _Request(next(self._rid), [], params)
         req.submitted_ts = time.time()
-        if paged_form:
+        if kv_stream is not None:
+            req.kv_stream = kv_stream
+            req.kv_pack = {"length": int(length),
+                           "first_token": int(first_token)}
+            # feed()/finish()/fail() wake the scheduler so a parked loop
+            # adopts new pages immediately instead of on its poll tick
+            kv_stream._wake = self._work.set
+        elif paged_form:
             req.kv_pack = {"k_pages": list(k_pages), "v_pages": list(v_pages),
                            "length": int(length),
                            "first_token": int(first_token)}
@@ -632,6 +715,10 @@ class TPUEngine:
             self._lora_release(req)
             req.out_queue.put(marker)
         self._prefilling.clear()
+        for req in self._streaming:
+            self._lora_release(req)
+            req.out_queue.put(marker)
+        self._streaming.clear()
         while True:
             try:
                 r = self._waiting.get_nowait()
@@ -778,9 +865,14 @@ class TPUEngine:
             return None
         return [self._free_pages.pop() for _ in range(need)]
 
-    def _bind_slot(self, req: _Request, slot: int) -> None:
+    def _bind_slot(self, req: _Request, slot: int,
+                   length: int | None = None) -> None:
         """The slot-activation bookkeeping shared by every admission path:
-        device sampling params, LoRA row, request registry."""
+        device sampling params, LoRA row, request registry. `length` is
+        the row's device length at activation — mirrored host-side so the
+        ragged decode step can bound its page sweep without a readback."""
+        if length is not None:
+            req.length0 = int(length)
         self._set_row_sampling(slot, req.params)
         if self.lora_bank is not None:
             self._slot_lora = self._slot_lora.at[slot].set(req.lora_idx)
@@ -811,7 +903,7 @@ class TPUEngine:
             self.state = decoding.insert_sequence(
                 self.state, slot, kv, jnp.int32(length),
                 jnp.asarray(first_token, jnp.int32), self.cfg)
-        self._bind_slot(req, slot)
+        self._bind_slot(req, slot, length)
         return True
 
     def _insert_transferred(self, req: _Request, slot: int) -> bool:
@@ -866,8 +958,172 @@ class TPUEngine:
         self.state = self._dp.activate_slot(
             self.state, slot, jnp.asarray(block_row), jnp.int32(length),
             jnp.asarray(pack["first_token"], jnp.int32))
-        self._bind_slot(req, slot)
+        self._bind_slot(req, slot, length)
         return True
+
+    # ------------------------------------------------- streamed admission
+
+    def _admit_stream(self, req: _Request, slot: int) -> bool:
+        """Streamed PD admission (tentpole: overlap transfer with decode):
+        grant the slot and every page the sequence will EVER need now;
+        pages are written into the pool as the transfer plane delivers
+        them (_drain_streams) and the row activates on the LAST page —
+        the decode loop keeps stepping other slots in between. Returns
+        False when the page pool can't host the sequence yet (caller
+        backlogs; arrived pages keep buffering host-side in the stream)."""
+        st = req.kv_stream
+        if self.kv_layout == "paged":
+            need = self._pages_needed(req.kv_pack["length"],
+                                      st.n_pages * self.page_size,
+                                      req.params.max_tokens)
+            pages = self._grant_pages(need)
+            if pages is None:
+                return False
+            self._slot_pages[slot] = pages
+        req.slot = slot
+        req.pf_done = 0
+        self._streaming.append(req)
+        return True
+
+    def _granted_block_row(self, slot: int) -> np.ndarray:
+        """Zero-padded block-table row over the slot's granted pages —
+        the activation layout shared by every page-granular admission."""
+        granted = self._slot_pages[slot]
+        row = np.zeros((self.max_pages_per_seq,), np.int32)
+        row[:len(granted)] = granted
+        return row
+
+    def _fail_stream(self, req: _Request, err) -> None:
+        """Reclaim a streamed admission whose transfer died: the slot was
+        granted but never activated, so only host bookkeeping unwinds —
+        a per-REQUEST error; every other request keeps serving."""
+        if req in self._streaming:
+            self._streaming.remove(req)
+        if self.kv_layout == "paged":
+            self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
+        self._free.append(req.slot)
+        self._lora_release(req)
+        if not isinstance(err, BaseException):
+            err = RuntimeError(str(err))
+        req.out_queue.put(_RequestError(err))
+
+    def _drain_streams(self) -> bool:
+        """Adopt every page that arrived since the last scheduler pass:
+        page-granular write_kv_pages into the slot's granted pages, slot
+        activation once all pages landed. Runs between decode steps, so
+        running requests keep emitting while transfers stream in."""
+        progressed = False
+        for req in list(self._streaming):
+            st = req.kv_stream
+            err = st.take_error()
+            if err is not None:
+                self._fail_stream(req, err)
+                progressed = True
+                continue
+            try:
+                ready = st.take_ready()
+                if ready:
+                    progressed = True
+                    if (self.kv_layout == "paged" and req.pf_done == 0
+                            and len(ready) == st.n_pages):
+                        # the whole transfer beat the scheduler here (fast
+                        # sender / short prompt — the common case): write
+                        # + activate in the ONE dispatch the non-streamed
+                        # admission pays, instead of write_kv_pages +
+                        # activate_slot
+                        ready.sort(key=lambda t: t[0])
+                        dt = self.state["kp"].dtype
+                        block_row = self._granted_block_row(req.slot)
+                        kv = {"k": jnp.asarray(np.concatenate(
+                                  [np.asarray(t[1]) for t in ready],
+                                  axis=1), dt),
+                              "v": jnp.asarray(np.concatenate(
+                                  [np.asarray(t[2]) for t in ready],
+                                  axis=1), dt)}
+                        length = req.kv_pack["length"]
+                        self.state = self._dp.insert_sequence_paged(
+                            self.state, req.slot, kv, jnp.int32(length),
+                            jnp.asarray(req.kv_pack["first_token"],
+                                        jnp.int32),
+                            jnp.asarray(block_row), self.cfg)
+                        self._streaming.remove(req)
+                        self._bind_slot(req, req.slot, length)
+                        continue
+                    if self.kv_layout == "paged":
+                        pages = self._slot_pages[req.slot]
+                        dt = self.state["kp"].dtype
+                        # consecutive arrivals collapse into ONE scatter
+                        # per run (pages stream in order, so a whole
+                        # prefetch window is usually one write); run
+                        # lengths are bounded by the prefetch depth, so
+                        # compile count stays small
+                        ready.sort(key=lambda t: t[0])
+                        runs: list = []
+                        for i, kp, vp in ready:
+                            if runs and runs[-1][0] + len(runs[-1][1]) == i:
+                                runs[-1][1].append(kp)
+                                runs[-1][2].append(vp)
+                            else:
+                                runs.append((i, [kp], [vp]))
+                        for start, kps, vps in runs:
+                            ids = pages[start:start + len(kps)]
+                            kcat = np.concatenate(
+                                [np.asarray(p) for p in kps], axis=1)
+                            vcat = np.concatenate(
+                                [np.asarray(p) for p in vps], axis=1)
+                            self.state = self._dp.write_kv_pages(
+                                self.state,
+                                {"k": jnp.asarray(kcat, dt),
+                                 "v": jnp.asarray(vcat, dt)},
+                                jnp.asarray(np.asarray(ids, np.int32)))
+                            req.pf_done += len(kps)
+                    else:
+                        # slot layout has no page pool: buffer, then take
+                        # the stitch fallback at completion
+                        kps = req.kv_pack.setdefault(
+                            "k_pages", [None] * st.n_pages)
+                        vps = req.kv_pack.setdefault(
+                            "v_pages", [None] * st.n_pages)
+                        for i, kp, vp in ready:
+                            kps[i], vps[i] = kp, vp
+                            req.pf_done += 1
+                if req.pf_done >= st.n_pages:
+                    self._streaming.remove(req)
+                    if self.kv_layout == "paged":
+                        length = req.kv_pack["length"]
+                        block_row = self._granted_block_row(req.slot)
+                        self.state = self._dp.activate_slot(
+                            self.state, req.slot, jnp.asarray(block_row),
+                            jnp.int32(length),
+                            jnp.asarray(req.kv_pack["first_token"],
+                                        jnp.int32))
+                        self._bind_slot(req, req.slot, length)
+                    else:
+                        req.kv_stream = None
+                        self._insert_transferred(req, req.slot)
+                    progressed = True
+            except Exception as e:  # noqa: BLE001 — a malformed page must
+                # fail THIS request, not the scheduler (engine death would
+                # drop every other in-flight request)
+                self._fail_stream(req, e)
+                progressed = True
+        return progressed
+
+    def _pages_bound(self) -> int:
+        """Power-of-two bound on the batch's LIVE page span (host mirror
+        of the device lengths): the ragged decode step sweeps only this
+        many block-table columns, so attention FLOPs/HBM traffic track
+        the longest RESIDENT row instead of max_len, and compile count
+        stays O(log max_pages)."""
+        P = self.page_size
+        need = 1
+        for req in self._by_slot.values():
+            pos = req.length0 + max(0, req.generated - 1)
+            need = max(need, pos // P + 1)
+        b = 1
+        while b < need:
+            b *= 2
+        return min(b, self.max_pages_per_seq)
 
     def _next_waiting(self):
         if self._backlog:
@@ -891,6 +1147,17 @@ class TPUEngine:
                     self._free.append(slot)
                     self._lora_release(req)
                     req.out_queue.put(_SENTINEL)
+                    continue
+                if req.kv_stream is not None:
+                    # streamed PD admission: slot + pages granted now,
+                    # pages adopted as they arrive (_drain_streams). Pure
+                    # bookkeeping — no prefill compute — so it does NOT
+                    # count against the per-step prefill budget: a burst
+                    # of transfers grabs every free slot in one round
+                    if not self._admit_stream(req, slot):
+                        self._free.append(slot)
+                        self._backlog.append(req)
+                        return  # page pressure: stop admitting this round
                     continue
                 # PD path: KV arrived from a prefill server (shm pages or
                 # legacy whole arrays)
@@ -1030,7 +1297,7 @@ class TPUEngine:
         self.state = self._dp.insert_sequence_paged_prefix(
             self.state, slot, kv, jnp.asarray(suf_pages),
             jnp.asarray(block_row), jnp.int32(n), first[0], self.cfg)
-        self._bind_slot(req, slot)
+        self._bind_slot(req, slot, n)
         if self.enable_prefix_cache:
             self._register_blocks(slot, tokens, hashes, n_pre, priv)
         return int(first[0])
@@ -1080,7 +1347,7 @@ class TPUEngine:
         self.state = self._dp.activate_slot(
             self.state, req.slot, jnp.asarray(block_row), jnp.int32(n),
             first[0])
-        self._bind_slot(req, req.slot)
+        self._bind_slot(req, req.slot, n)
         if self.enable_prefix_cache:
             n_shared = len(self._slot_shared.get(req.slot, ()))
             self._register_blocks(req.slot, tokens, req.pf_hashes, n_shared,
@@ -1207,23 +1474,37 @@ class TPUEngine:
     def _loop_inner(self):
         while not self._stop:
             if (not self._by_slot and self._waiting.empty()
-                    and not self._backlog and not self._prefilling):
+                    and not self._backlog and not self._prefilling
+                    and not self._streaming):
                 self._work.wait(timeout=0.1)
                 self._work.clear()
                 continue
             self._admit()
+            stream_progress = (self._drain_streams() if self._streaming
+                               else False)
             if self._prefilling:
                 # one chunk per iteration: decode below keeps running
                 # requests emitting while a long prompt streams in
                 self._prefill_step()
             if not self._by_slot:
+                if self._streaming and not stream_progress:
+                    # nothing decodable and no new pages yet: park until
+                    # the transfer plane's feed() wakes us
+                    self._work.wait(timeout=0.005)
+                    self._work.clear()
                 continue
             if self.speculative_k:
                 self._speculative_step()
                 continue
+            t_step = time.perf_counter()
             if self.kv_layout == "paged":
-                self.state, logits = self._dp.decode_step_paged(
-                    self.params, self.state, self.cfg)
+                if self.attn_impl == "ragged":
+                    self.state, logits = self._dp.decode_step_paged_ragged(
+                        self.params, self.state, self.cfg,
+                        self._pages_bound(), self._ragged_kernel)
+                else:
+                    self.state, logits = self._dp.decode_step_paged(
+                        self.params, self.state, self.cfg)
             elif self.lora_bank is not None:
                 self.state, logits = decoding.decode_step(
                     self.params, self.state, self.cfg,
@@ -1250,6 +1531,12 @@ class TPUEngine:
             toks = decoding.sample_per_row(logits, sub, self._temps, self._topks)
             self.state = decoding.commit_tokens(self.state, toks)
             toks_host = np.asarray(toks)
+            self.decode_steps += 1
+            self.decode_slot_steps += len(self._by_slot)
+            if self._step_obs is not None:
+                # device step + sampling sync: the ragged-vs-gather
+                # attribution surface (LLM_BENCH decode_step row)
+                self._step_obs.observe(time.perf_counter() - t_step)
             for slot, req in list(self._by_slot.items()):
                 self._emit(req, int(toks_host[slot]))
 
@@ -1258,8 +1545,13 @@ class TPUEngine:
     def stats(self) -> dict:
         out = {"free_slots": len(self._free), "active": len(self._by_slot),
                "waiting": self._waiting.qsize() + len(self._backlog),
+               "streaming": len(self._streaming),
                "max_slots": self.max_slots, "buckets": list(self.buckets),
-               "kv_layout": self.kv_layout}
+               "kv_layout": self.kv_layout, "attn_impl": self.attn_impl,
+               "decode_steps": self.decode_steps,
+               "decode_occupancy": (self.decode_slot_steps
+                                    / self.decode_steps
+                                    if self.decode_steps else 0.0)}
         if self.speculative_k:
             drafted = self.spec_drafted
             out["speculative"] = {

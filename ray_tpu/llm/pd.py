@@ -12,10 +12,12 @@ TPU mapping here:
   `ray_tpu/llm/kv_transfer.py` (per-ticket MutableShmChannel + sender
   thread). Its reply is a small **ticket** — the proxy never materializes
   KV.
-- **DecodeServer** pulls the pages off the channel and admits the request
-  **directly into a continuous-batching slot** via the engine's
-  page-granular `submit_prefilled` (pages are scattered into the paged
-  pool; no whole-bucket reshape). Tokens stream out as they are produced.
+- **DecodeServer** runs STREAMED admission: the ticket registers with the
+  replica's shared `BatchedKVPuller` (one polling thread for every
+  in-flight transfer) and the engine adopts pages into the paged pool AS
+  THEY ARRIVE (`submit_prefilled(kv_stream=...)`) — the decode loop keeps
+  stepping other slots while later pages stream, and the row activates on
+  the last page. Tokens stream out as they are produced.
 - **PDProxyServer** composes the two pools and **streams**: the decode
   call is a serve streaming handle, so the proxy forwards tokens as they
   arrive instead of blocking on the full completion, and reports
@@ -35,7 +37,8 @@ import numpy as np
 from ray_tpu import serve
 from ray_tpu.llm.config import LLMConfig, PDConfig
 from ray_tpu.llm.engine import SamplingParams, bucket_for
-from ray_tpu.llm.kv_transfer import PagedKVExporter, pull_all
+from ray_tpu.llm.kv_transfer import (BatchedKVPuller, KVPageStream,
+                                     PagedKVExporter, pull_all)
 from ray_tpu.llm.tokenizer import load_tokenizer
 from ray_tpu.serve import request_context as _rc
 from ray_tpu.util import tracing as _tracing
@@ -68,6 +71,144 @@ def _pd_engine_kwargs(llm_config: LLMConfig) -> dict:
     return ek
 
 
+class _PrefillJob:
+    __slots__ = ("ids", "n", "bucket", "event", "logits", "k", "v", "error")
+
+    def __init__(self, ids, n, bucket):
+        import threading
+
+        self.ids = ids
+        self.n = n
+        self.bucket = bucket
+        self.event = threading.Event()
+        self.logits = self.k = self.v = None
+        self.error: BaseException | None = None
+
+
+class PrefillCoalescer:
+    """Admission batching for the dedicated prefill tier.
+
+    Concurrent same-bucket prompts coalesce into ONE ``[B, T]``
+    ``decoding.prefill_batch`` forward — the structural advantage of
+    disaggregation the monolithic engine cannot copy: its prefills
+    interleave with decode steps one prompt at a time. Baton-passing
+    combiner, no dedicated thread: the first waiting caller becomes the
+    leader, runs ONE batch (everything same-bucket queued at that
+    moment, including its own job), releases leadership, and waiting
+    callers promote themselves — batching emerges from bursts without
+    adding a scheduling hop or an artificial wait (``window_s`` can add
+    one for sparse arrivals). Each row's logits/KV are bit-identical to
+    a solo ``[1, T]`` prefill — causality keeps rows independent."""
+
+    def __init__(self, params, cfg, *, min_bucket: int, max_len: int,
+                 max_batch: int = 4, window_s: float = 0.0):
+        import threading
+
+        self.params = params
+        self.cfg = cfg
+        self.min_bucket = min_bucket
+        self.max_len = max_len
+        self.max_batch = max(1, int(max_batch))
+        self.window_s = float(window_s)
+        self._cond = threading.Condition()
+        self._pending: list = []
+        self._leader_active = False
+        self._stop = False
+        self.batches = 0   # forwards run
+        self.jobs = 0      # prompts served (jobs/batches = mean batch)
+
+    def _run(self, batch: list) -> None:
+        import jax.numpy as jnp
+
+        from ray_tpu.models import decoding
+
+        try:
+            T = batch[0].bucket
+            # prefill() floors the batch take to a power of two, so the
+            # row count here is always one of O(log max_batch) shapes —
+            # no pad rows, no wasted forward FLOPs
+            tb = np.zeros((len(batch), T), np.int32)
+            lens = np.zeros((len(batch),), np.int32)
+            for b, j in enumerate(batch):
+                tb[b, :j.n] = j.ids
+                lens[b] = j.n
+            logits, kv = decoding.prefill_batch(
+                self.params, jnp.asarray(tb), jnp.asarray(lens), self.cfg)
+            for b, j in enumerate(batch):
+                j.logits = logits[b]
+                j.k = kv["k"][:, b]
+                j.v = kv["v"][:, b]
+            self.batches += 1
+            self.jobs += len(batch)
+        except BaseException as e:  # noqa: BLE001 — the waiters MUST be
+            # released with the failure, or every straggler hangs forever
+            for j in batch:
+                j.error = e
+        finally:
+            for j in batch:
+                j.event.set()
+
+    def prefill(self, token_ids: list):
+        """Blocking: returns (logits_at_last [V], k [L, T, Hkv, Dh],
+        v [L, T, Hkv, Dh], bucket) for this prompt, computed inside
+        whichever coalesced forward picked the job up."""
+        n = len(token_ids)
+        job = _PrefillJob(token_ids, n, bucket_for(n, self.min_bucket,
+                                                   self.max_len))
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("prefill coalescer is torn down")
+            self._pending.append(job)
+        while not job.event.is_set():
+            with self._cond:
+                while (not job.event.is_set() and self._leader_active
+                       and not self._stop):
+                    self._cond.wait(timeout=0.5)
+                if job.event.is_set():
+                    break
+                if self._stop:
+                    job.error = RuntimeError(
+                        "prefill coalescer torn down mid-batch")
+                    break
+                self._leader_active = True
+            try:
+                if self.window_s:
+                    time.sleep(self.window_s)  # sparse arrivals: wait a beat
+                with self._cond:
+                    batch = []
+                    if self._pending:
+                        bucket = self._pending[0].bucket  # FIFO fairness
+                        group = [j for j in self._pending
+                                 if j.bucket == bucket][:self.max_batch]
+                        # floor power of two: prefill_batch compiles per
+                        # pow2 row count, and padding 3→4 or 5→8 would
+                        # BURN the rows batching is supposed to save —
+                        # leftovers catch the next baton immediately
+                        take = 1 << (len(group).bit_length() - 1)
+                        batch = group[:take]
+                        for j in batch:
+                            self._pending.remove(j)
+                if batch:
+                    self._run(batch)
+            finally:
+                with self._cond:
+                    self._leader_active = False
+                    self._cond.notify_all()
+        if job.error is not None:
+            raise job.error
+        return job.logits, job.k, job.v, job.bucket
+
+    def teardown(self) -> None:
+        """Fail queued jobs and refuse new ones. Safe to call twice."""
+        with self._cond:
+            self._stop = True
+            pending, self._pending = self._pending, []
+            self._cond.notify_all()
+        for j in pending:
+            j.error = RuntimeError("prefill coalescer torn down")
+            j.event.set()
+
+
 @serve.deployment(max_ongoing_requests=8)
 class PrefillServer:
     """Prompt-only forward: pages the prefilled KV into the transfer plane
@@ -86,32 +227,44 @@ class PrefillServer:
         self.page_size = ek["page_size"]
         self.min_bucket = max(ek.get("min_bucket", 32), self.page_size)
         self.max_len = ek.get("max_len", self.cfg.max_seq_len)
+        import threading
+
         self.key = jax.random.PRNGKey(ek.get("seed", 0))
+        # replica methods run on several threads, and the coalescer wakes
+        # a whole batch of them at once: the read-split-write of the
+        # shared key must be atomic or concurrent requests sample with
+        # the SAME subkey (correlated first tokens)
+        self._key_lock = threading.Lock()
         self.exporter = PagedKVExporter(
-            send_timeout_s=pd.transfer_timeout_s)
+            send_timeout_s=pd.transfer_timeout_s,
+            prefetch_pages=pd.prefetch_depth)
+        # admission batching: concurrent prompts share one [B, T] forward
+        self.coalescer = PrefillCoalescer(
+            self.params, self.cfg, min_bucket=self.min_bucket,
+            max_len=self.max_len, max_batch=pd.prefill_batch_max,
+            window_s=pd.prefill_batch_window_s)
 
     def prefill(self, token_ids: list, temperature: float = 0.0) -> dict:
         """Returns the transfer TICKET (kv_transfer.py) — the KV itself
-        streams page-by-page to whichever decode replica pulls it."""
+        streams page-by-page to whichever decode replica pulls it.
+        Concurrent calls coalesce into one batched forward
+        (PrefillCoalescer) before each row exports its own ticket."""
         jax, decoding = self._jax, self._decoding
-        import jax.numpy as jnp
 
         n = len(token_ids)
         bucket = bucket_for(n, self.min_bucket, self.max_len)
         if n > bucket:
             raise ValueError(f"prompt of {n} tokens exceeds max_len {self.max_len}")
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = token_ids
         t0 = time.time()
-        logits, kv = decoding.prefill(self.params, jnp.asarray(padded),
-                                      jnp.int32(n), self.cfg)
-        self.key, sub = jax.random.split(self.key)
+        logits, k, v, bucket = self.coalescer.prefill(list(token_ids))
+        with self._key_lock:
+            self.key, sub = jax.random.split(self.key)
         first = int(decoding.sample(logits[None, :], sub, temperature)[0])
         _tracing.emit_child_span("pd:prefill_forward", t0, time.time(),
                                  tokens=n, bucket=bucket)
         # sampled requests: the sender thread runs outside the request's
         # contextvar scope, so its pd:kv_send span context rides the ticket
-        return self.exporter.export(np.asarray(kv["k"]), np.asarray(kv["v"]),
+        return self.exporter.export(np.asarray(k), np.asarray(v),
                                     n, first, self.page_size,
                                     trace_ctx=_tracing.inject())
 
@@ -119,10 +272,13 @@ class PrefillServer:
         return {"pending_transfers": self.exporter.pending(),
                 "failed_transfers": self.exporter.failures,
                 "last_failure": self.exporter.last_failure,
-                "page_size": self.page_size}
+                "page_size": self.page_size,
+                "prefill_batches": self.coalescer.batches,
+                "prefill_jobs": self.coalescer.jobs}
 
     def __del__(self):
         try:
+            self.coalescer.teardown()
             self.exporter.teardown()
         except Exception:
             pass
@@ -141,13 +297,20 @@ class DecodeServer:
                                   engine_kwargs=_pd_engine_kwargs(llm_config))
         self.engine = TPUEngine.from_config(cfg)
         self.pull_timeout_s = pd.transfer_timeout_s
+        # ONE polling thread multiplexes every in-flight transfer on this
+        # replica (streamed admission); None = legacy pull-then-admit
+        self.puller = BatchedKVPuller() if pd.batched_pull else None
 
     def decode_stream(self, ticket: dict, params: dict | None = None):
         """Generator over generated token ids: the transferred first token
         immediately (TTFT is not gated on the page transfer), then the
-        engine's tokens as the decode loop produces them. Transfer
-        failures raise KVTransferError — a clean per-request error; the
-        engine and the other in-flight requests keep serving.
+        engine's tokens as the decode loop produces them. The default
+        path STREAMS admission: the ticket registers with the replica's
+        batched puller and the engine adopts pages as they arrive, so
+        decode of other slots overlaps this request's transfer and the
+        slot activates on the last page. Transfer failures raise
+        KVTransferError — a clean per-request error; the engine and the
+        other in-flight requests keep serving.
 
         Sampled requests emit the decode-side phase spans here:
         ``pd:kv_transfer`` (the page pull), ``pd:admission`` (submit →
@@ -164,29 +327,51 @@ class DecodeServer:
         yield ticket["first_token"]
         if sp.max_tokens <= 1:
             # budget spent by the transferred token: drain the channel so
-            # the prefill side retires it (one page in flight — never the
-            # whole prefix in host memory), but skip slot admission
-            for _ in pull_pages(ticket, timeout_s=self.pull_timeout_s):
-                pass
+            # the prefill side retires it, but skip slot admission — via
+            # the SAME batched puller (one wake serves this drain and
+            # every live transfer), never the whole prefix in host memory
+            if self.puller is not None:
+                self.puller.drain(ticket, timeout_s=self.pull_timeout_s)
+            else:
+                for _ in pull_pages(ticket, timeout_s=self.pull_timeout_s):
+                    pass
             return
         t_pull = time.time()
-        k_pages, v_pages = pull_all(ticket, timeout_s=self.pull_timeout_s)
-        _tracing.emit_span_for(ctx, "pd:kv_transfer", t_pull, time.time(),
-                               ticket=ticket.get("ticket", ""),
-                               pages=ticket["n_pages"])
-        req = self.engine.submit_prefilled(
-            length=ticket["length"], first_token=ticket["first_token"],
-            params=sp, k_pages=k_pages, v_pages=v_pages)
+        if self.puller is not None:
+            stream = KVPageStream(ticket["n_pages"], ticket["page_size"])
+            self.puller.pull(ticket, stream, timeout_s=self.pull_timeout_s)
+            req = self.engine.submit_prefilled(
+                length=ticket["length"], first_token=ticket["first_token"],
+                params=sp, kv_stream=stream)
+        else:
+            stream = None
+            k_pages, v_pages = pull_all(ticket, timeout_s=self.pull_timeout_s)
+            _tracing.emit_span_for(ctx, "pd:kv_transfer", t_pull, time.time(),
+                                   ticket=ticket.get("ticket", ""),
+                                   pages=ticket["n_pages"])
+            req = self.engine.submit_prefilled(
+                length=ticket["length"], first_token=ticket["first_token"],
+                params=sp, k_pages=k_pages, v_pages=v_pages)
         n = 0
         t_dec = time.time()
         try:
             it = _iter_request(req)
             for tok in it:
-                if n == 0 and ctx is not None and req.admitted_ts:
-                    # the engine stamped the slot bind: emit the admission
-                    # wait retroactively now that it is known
-                    _tracing.emit_span_for(ctx, "pd:admission",
-                                           req.submitted_ts, req.admitted_ts)
+                if n == 0 and ctx is not None:
+                    if stream is not None:
+                        # streamed path: the transfer overlapped decode;
+                        # its span closes at the stream's last page
+                        _tracing.emit_span_for(
+                            ctx, "pd:kv_transfer", t_pull,
+                            stream.finished_ts or time.time(),
+                            ticket=ticket.get("ticket", ""),
+                            pages=ticket["n_pages"])
+                    if req.admitted_ts:
+                        # the engine stamped the slot bind: emit the
+                        # admission wait retroactively now that it is known
+                        _tracing.emit_span_for(ctx, "pd:admission",
+                                               req.submitted_ts,
+                                               req.admitted_ts)
                 n += 1
                 yield tok
         finally:
@@ -199,10 +384,15 @@ class DecodeServer:
         return list(self.decode_stream(ticket, params))
 
     def engine_stats(self) -> dict:
-        return self.engine.stats()
+        st = self.engine.stats()
+        if self.puller is not None:
+            st["pulls_in_flight"] = self.puller.pending()
+        return st
 
     def __del__(self):
         try:
+            if self.puller is not None:
+                self.puller.teardown()
             self.engine.shutdown()
         except Exception:
             pass

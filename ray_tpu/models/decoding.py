@@ -161,6 +161,51 @@ def prefill(params, tokens, length, cfg: TransformerConfig,
     return logits.astype(jnp.float32), {"k": kv[0], "v": kv[1]}
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_batch(params, tokens, lengths, cfg: TransformerConfig):
+    """Batched prompt prefill: [B, T] (one shared bucket, padded; true
+    per-row lengths in `lengths` [B]).
+
+    Returns (logits_at_last [B, V], kv {k, v: [L, B, T, Hkv, Dh]}).
+
+    The PD prefill tier's admission batching: several queued prompts
+    share ONE forward instead of B sequential [1, T] calls — the
+    dedicated tier can coalesce because it never interleaves with decode
+    steps (llm/pd.py PrefillCoalescer). Causality keeps rows independent:
+    positions past a row's length only produce KV that the consumer
+    masks by length, exactly as in the single-prompt path."""
+    dt = cfg.dtype
+    B, T = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][:T].astype(dt)
+    cos, sin = _rope(cfg)
+
+    def block(h, layer_p):
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin)
+            k = ops.apply_rope(k, cos, sin)
+        out = ops.attention(q, k, v, causal=True)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return h, (k, v)
+
+    x, kv = jax.lax.scan(block, x, params["layers"])
+    x = _norm(x, params["final_norm"], cfg)
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None], axis=1)[:, 0]        # [B, E]
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"].astype(dt).T
+    else:
+        logits = last @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), {"k": kv[0], "v": kv[1]}
+
+
 @functools.partial(jax.jit, donate_argnames=("state",), static_argnames=("cfg",))
 def insert_sequence(state, slot, kv, length, first_token, cfg: TransformerConfig):
     """Graft a prefilled sequence into decode row `slot` (in place: donated)."""

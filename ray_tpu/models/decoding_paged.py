@@ -131,6 +131,75 @@ def decode_step_paged(params, state, cfg: TransformerConfig):
     return state, logits.astype(jnp.float32)
 
 
+@functools.partial(jax.jit, donate_argnames=("state",),
+                   static_argnames=("cfg", "pages_bound", "kernel"))
+def decode_step_paged_ragged(params, state, cfg: TransformerConfig,
+                             pages_bound: int, kernel: bool = False):
+    """Advance every active row one token — ragged paged attention.
+
+    Same per-step scatter as decode_step_paged, but the attention core is
+    ONE ragged launch over the batch's block tables (ops/
+    ragged_paged_attention.py): no [B, max_pages*page] gather, and the
+    sweep stops at `pages_bound` — the engine's host-side bound on the
+    batch's LIVE page count (power of two, so compile count stays
+    O(log(max_pages))). `kernel=True` runs the Pallas TPU kernel;
+    False runs the bit-consistent pure-JAX reference (the CPU path).
+    """
+    from ray_tpu.ops.ragged_paged_attention import ragged_decode_attention
+
+    dt = cfg.dtype
+    B, MP = state["block"].shape
+    P = state["kp"].shape[2]
+    tokens = state["last_token"][:, None]
+    pos = state["length"]                                      # [B]
+    page_ids = jnp.take_along_axis(state["block"],
+                                   (pos // P)[:, None], axis=1)[:, 0]  # [B]
+    page_ids = jnp.where(state["active"], page_ids, 0)
+    offsets = pos % P                                          # [B]
+    # the ragged sweep only walks the batch's live prefix of each table;
+    # positions past a row's `pos` inside that prefix are masked in-kernel
+    tbl = state["block"][:, :pages_bound]
+    x = params["embed"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(dt)[pos][:, None]
+    cos, sin = _rope(cfg)
+    G = cfg.n_heads // cfg.kv_heads
+
+    def block(carry, layer_in):
+        h, = carry
+        layer_p, kp, vp = layer_in               # pools [num_pages, P, Hkv, Dh]
+        normed = _norm(h, layer_p["norm1"], cfg)
+        q, k, v = _attn_qkv(normed, layer_p["attn"], cfg)      # [B, 1, H, Dh]
+        if cfg.pos == "rope":
+            q = ops.apply_rope(q, cos, sin, positions=pos[:, None])
+            k = ops.apply_rope(k, cos, sin, positions=pos[:, None])
+        kp = kp.at[page_ids, offsets].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page_ids, offsets].set(v[:, 0].astype(vp.dtype))
+        qh = q[:, 0].reshape(B, cfg.kv_heads, G, cfg.head_dim)
+        out = ragged_decode_attention(
+            qh, kp, vp, tbl, pos, scale=cfg.head_dim ** -0.5,
+            impl="kernel" if kernel else "reference")
+        out = out.reshape(B, 1, cfg.n_heads, cfg.head_dim).astype(dt)
+        out = jnp.einsum("bthd,hde->bte", out, layer_p["attn"]["wo"].astype(dt))
+        if cfg.bias:
+            out = out + layer_p["attn"]["bo"].astype(dt)
+        h = h + out
+        h = h + _mlp_block(_norm(h, layer_p["norm2"], cfg), layer_p, cfg)
+        return (h,), (kp, vp)
+
+    (x,), (kp_new, vp_new) = jax.lax.scan(
+        block, (x,), (params["layers"], state["kp"], state["vp"]))
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x[:, 0] @ params["embed"].astype(dt).T
+    else:
+        logits = x[:, 0] @ params["lm_head"].astype(dt)
+    state = dict(state)
+    state["kp"], state["vp"] = kp_new, vp_new
+    state["length"] = jnp.where(state["active"], state["length"] + 1, state["length"])
+    return state, logits.astype(jnp.float32)
+
+
 @functools.partial(jax.jit, donate_argnames=("state",))
 def release_slot_paged(state, slot):
     state = dict(state)

@@ -4,6 +4,8 @@ from ray_tpu.ops.flash_attention import flash_attention, flash_attention_forward
 from ray_tpu.ops.losses import fused_head_cross_entropy, softmax_cross_entropy
 from ray_tpu.ops.moe import RoutingInfo, moe_apply, topk_routing
 from ray_tpu.ops.norms import layer_norm, rms_norm
+from ray_tpu.ops.ragged_paged_attention import (
+    ragged_decode_attention, ragged_decode_attention_reference)
 from ray_tpu.ops.rope import apply_rope, rope_frequencies
 
 __all__ = [
@@ -17,6 +19,8 @@ __all__ = [
     "gelu",
     "layer_norm",
     "moe_apply",
+    "ragged_decode_attention",
+    "ragged_decode_attention_reference",
     "repeat_kv",
     "rms_norm",
     "rope_frequencies",

@@ -1,0 +1,202 @@
+"""Ragged paged attention for the decode step (Pallas TPU + reference).
+
+One launch covers the WHOLE continuous batch against its paged KV: each
+row attends over exactly the pages its block table names, up to its own
+length — no per-slot gather of the full [max_pages, page] span, no
+padding compute for short rows (arXiv 2604.15464, Ragged Paged Attention;
+PAPERS.md). The previous decode step gathered every row's full block
+table (`kp[state["block"]]` → [B, max_pages*page, Hkv, Dh]) and masked —
+HBM traffic and FLOPs scale with the LONGEST POSSIBLE sequence for every
+row, not with the tokens actually resident.
+
+Two implementations with ONE accumulation order so they agree bitwise:
+
+- ``_ragged_kernel`` — Pallas TPU kernel, grid (batch, page); the block
+  table and per-row positions ride scalar prefetch so the page BlockSpec
+  index map gathers each row's next page straight out of the HBM pool,
+  and ``pl.when`` skips pages past the row's length (the ragged part —
+  dead pages cost neither FLOPs nor VMEM bandwidth). Online-softmax
+  accumulators live in VMEM scratch across the page sweep, like
+  flash_attention.py.
+- ``ragged_decode_attention_reference`` — pure JAX mirror of the same
+  per-page online-softmax math (fori_loop over pages, f32 accumulators,
+  identical op order), so tier-1 on ``JAX_PLATFORMS=cpu`` asserts the
+  kernel (interpret mode) is bit-consistent with the path the CPU engine
+  actually decodes with.
+
+The engine bounds the page sweep host-side (`pages_bound` in
+models/decoding_paged.py decode_step_paged_ragged): the block table is
+sliced to the batch's live maximum before either impl runs, so even the
+reference does work proportional to the longest RESIDENT row.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; tests run the kernel via interpret
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _ragged_kernel(tbl_ref, pos_ref, q_ref, kp_ref, vp_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale: float, page_size: int,
+                   kv_heads: int, q_per_kv: int):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    H = kv_heads * q_per_kv
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    p0 = pos_ref[b]
+    # page j holds cache positions [j*P, (j+1)*P); live iff its first
+    # position is attendable (<= the row's current position) — dead pages
+    # are skipped entirely, which is what makes the sweep ragged
+    live = j * page_size <= p0
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # [Hkv, G, Dh]
+        k = kp_ref[0].astype(jnp.float32)             # [P, Hkv, Dh]
+        v = vp_ref[0].astype(jnp.float32)
+        s = jnp.einsum("kgd,pkd->kgp", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= p0, s, _NEG_INF)
+        sf = s.reshape(H, page_size)
+        m_prev = m_scr[:, :1]                         # [H, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, sf.max(axis=-1, keepdims=True))
+        p = jnp.exp(sf - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        pv = jnp.einsum("kgp,pkd->kgd",
+                        p.reshape(kv_heads, q_per_kv, page_size), v,
+                        preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv.reshape(H, -1)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        out = (acc_scr[:] / l).reshape(kv_heads, q_per_kv, -1)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _ragged_kernel_call(q, kp, vp, block_table, pos, *, scale: float,
+                        interpret: bool):
+    B, Hkv, G, Dh = q.shape
+    P = kp.shape[1]
+    nb = block_table.shape[1]
+    H = Hkv * G
+    if pltpu is None:  # pragma: no cover — CPU wheels lack the TPU backend
+        raise RuntimeError(
+            "pallas TPU backend unavailable; use impl='reference'")
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_table, pos
+        grid=(B, nb),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, G, Dh), lambda b, j, tbl, pos: (b, 0, 0, 0)),
+            # the ragged gather: page j of row b streams in from wherever
+            # the block table says it lives in the pool
+            pl.BlockSpec((1, P, Hkv, Dh),
+                         lambda b, j, tbl, pos: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, P, Hkv, Dh),
+                         lambda b, j, tbl, pos: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, G, Dh),
+                               lambda b, j, tbl, pos: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, scale=scale, page_size=P,
+                               kv_heads=Hkv, q_per_kv=G)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_table, pos, q, kp, vp)
+
+
+def ragged_decode_attention_reference(q, kp, vp, block_table, pos, *,
+                                      scale: float):
+    """Pure-JAX mirror of the kernel: fori_loop over pages with the SAME
+    f32 online-softmax accumulation per page, so the two are
+    bit-consistent (asserted in tier-1). Dead pages keep the previous
+    accumulators untouched — the where() twin of the kernel's pl.when."""
+    B, Hkv, G, Dh = q.shape
+    P = kp.shape[1]
+    nb = block_table.shape[1]
+    H = Hkv * G
+    qf = q.astype(jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        pid = block_table[:, j]                        # [B]
+        k = kp[pid].astype(jnp.float32)                # [B, P, Hkv, Dh]
+        v = vp[pid].astype(jnp.float32)
+        s = jnp.einsum("bkgd,bpkd->bkgp", qf, k,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = j * P + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(kpos <= pos[:, None, None, None], s, _NEG_INF)
+        sf = s.reshape(B, H, P)
+        m_new = jnp.maximum(m, sf.max(axis=-1, keepdims=True))
+        p = jnp.exp(sf - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        pv = jnp.einsum("bkgp,bpkd->bkgd",
+                        p.reshape(B, Hkv, G, P), v,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr + pv.reshape(B, H, Dh)
+        live = (j * P <= pos)[:, None, None]           # [B, 1, 1]
+        return (jnp.where(live, m_new, m), jnp.where(live, l_new, l),
+                jnp.where(live, acc_new, acc))
+
+    m0 = jnp.full((B, H, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, Dh), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, Hkv, G, Dh).astype(q.dtype)
+
+
+def ragged_decode_attention(q, kp, vp, block_table, pos, *,
+                            scale: float | None = None,
+                            impl: str = "reference",
+                            interpret: bool = False):
+    """One decode-attention launch over the whole continuous batch.
+
+    q: [B, Hkv, G, Dh] — this step's queries (one token per row, grouped
+    by kv head); kp/vp: [num_pages, P, Hkv, Dh] — one layer's page pool;
+    block_table: [B, nb] int32 page ids (pre-sliced to the batch's live
+    page bound); pos: [B] int32 — row b attends cache positions <= pos[b].
+    Returns [B, Hkv, G, Dh] in q's dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl == "kernel":
+        return _ragged_kernel_call(q, kp, vp, block_table, pos,
+                                   scale=scale, interpret=interpret)
+    if impl != "reference":
+        raise ValueError(f"impl must be 'kernel' or 'reference', got {impl!r}")
+    return ragged_decode_attention_reference(q, kp, vp, block_table, pos,
+                                             scale=scale)

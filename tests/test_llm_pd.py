@@ -18,7 +18,8 @@ import pytest
 
 from ray_tpu._private.constants import SHM_CHANNEL_GLOB
 from ray_tpu.llm.engine import SamplingParams, TPUEngine, bucket_for
-from ray_tpu.llm.kv_transfer import (KVTransferError, PagedKVExporter,
+from ray_tpu.llm.kv_transfer import (BatchedKVPuller, KVPageStream,
+                                     KVTransferError, PagedKVExporter,
                                      pull_all, pull_pages)
 from ray_tpu.models import decoding, transformer
 from ray_tpu.models.transformer import TransformerConfig
@@ -258,6 +259,201 @@ def test_submit_prefilled_exact_fit_and_validation(tiny_model):
                                  k_pages=k_pages, v_pages=[])
     finally:
         exporter.teardown()
+        dec.shutdown()
+
+
+def test_streamed_admission_token_exact_partial_pages(tiny_model):
+    """Tentpole acceptance: a SLOW sender streams pages while the decode
+    engine keeps emitting tokens for another request — and the slow
+    request's output is still token-exact. The fast request must finish
+    while the slow transfer is still open (the overlap, observed)."""
+    cfg, params = tiny_model
+    mono = _paged_engine(cfg, params)
+    dec = _paged_engine(cfg, params)
+    # one page per message, 120ms apart: a 4-page transfer stays open
+    # ~0.5s while decode runs
+    slow = PagedKVExporter(send_timeout_s=30.0, prefetch_pages=1,
+                          page_interval_s=0.12)
+    puller = BatchedKVPuller()
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    prompt = list(range(2, 50))
+    fast_prompt = [1, 5, 9]
+    try:
+        want = mono.generate(prompt, sp)
+        fast_want = mono.generate(fast_prompt,
+                                  SamplingParams(max_tokens=6,
+                                                 temperature=0.0))
+        # warm the decode engine's compiles so the fast request's wall
+        # time below measures steady state, not XLA compilation
+        dec.generate(fast_prompt, SamplingParams(max_tokens=2,
+                                                 temperature=0.0))
+
+        ticket = _prefill_ticket(cfg, params, prompt, slow)
+        assert ticket["n_pages"] >= 3 and not ticket.get("sync")
+        stream = KVPageStream(ticket["n_pages"], ticket["page_size"])
+        puller.pull(ticket, stream, timeout_s=30.0)
+        req = dec.submit_prefilled(
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=sp, kv_stream=stream)
+        # while pages stream, a fresh request decodes end-to-end
+        fast = dec.submit(fast_prompt, SamplingParams(max_tokens=6,
+                                                      temperature=0.0))
+        fast_got = list(fast)
+        fast_done_ts = time.time()
+        assert fast_got == fast_want
+        got = [ticket["first_token"]] + list(req)
+        assert got == want
+        # the overlap really happened: the fast request finished before
+        # the slow transfer delivered its last page
+        assert stream.finished_ts is not None
+        assert fast_done_ts < stream.finished_ts, \
+            "decode did not emit while pages were still streaming"
+        st = dec.stats()
+        assert st["streaming"] == 0 and st["active"] == 0
+    finally:
+        slow.teardown()
+        puller.teardown()
+        mono.shutdown()
+        dec.shutdown()
+
+
+def test_prefill_death_mid_stream_after_first_page(tiny_model):
+    """Prefill dies AFTER the first page was admitted into the slot: the
+    request fails with a per-request KVTransferError, the slot and every
+    granted page are reclaimed, no /dev/shm leaks, and the engine keeps
+    serving."""
+    cfg, params = tiny_model
+    before = _shm_channels()
+    dec = _paged_engine(cfg, params)
+    slow = PagedKVExporter(send_timeout_s=30.0, prefetch_pages=1,
+                          page_interval_s=0.1)
+    exporter = PagedKVExporter(send_timeout_s=10.0)
+    puller = BatchedKVPuller()
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    try:
+        free_pages0 = dec.stats()["free_pages"]
+        ticket = _prefill_ticket(cfg, params, list(range(2, 50)), slow)
+        stream = KVPageStream(ticket["n_pages"], ticket["page_size"])
+        puller.pull(ticket, stream, timeout_s=30.0)
+        req = dec.submit_prefilled(
+            length=ticket["length"], first_token=ticket["first_token"],
+            params=sp, kv_stream=stream)
+        assert _wait(lambda: stream.fed >= 1)
+        slow.abort(ticket["ticket"])  # the replica "dies" mid-stream
+        with pytest.raises(KVTransferError) as ei:
+            list(req)
+        assert ticket["ticket"] in str(ei.value)
+        # slot + granted pages reclaimed
+        assert _wait(lambda: dec.stats()["streaming"] == 0)
+        st = dec.stats()
+        assert st["active"] == 0
+        assert st["free_slots"] == st["max_slots"]
+        assert st["free_pages"] == free_pages0
+        # the engine keeps serving (streamed path)
+        mono = _paged_engine(cfg, params)
+        want = mono.generate([1, 5, 9], sp)
+        mono.shutdown()
+        t2 = _prefill_ticket(cfg, params, [1, 5, 9], exporter)
+        s2 = KVPageStream(t2["n_pages"], t2["page_size"])
+        puller.pull(t2, s2, timeout_s=10.0)
+        req2 = dec.submit_prefilled(
+            length=t2["length"], first_token=t2["first_token"], params=sp,
+            kv_stream=s2)
+        assert [t2["first_token"]] + list(req2) == want
+        assert _wait(lambda: slow.pending() == 0)
+        assert _wait(lambda: exporter.pending() == 0)
+    finally:
+        slow.teardown()
+        exporter.teardown()
+        puller.teardown()
+        dec.shutdown()
+    assert _wait(lambda: _shm_channels() - before == set()), \
+        f"leaked: {_shm_channels() - before}"
+
+
+def test_batched_puller_multiplexes_concurrent_transfers(tiny_model):
+    """One puller drives N concurrent transfers (one polling thread, not
+    N parked readers) and the warm-path drain retires a ticket without
+    adopting it."""
+    cfg, params = tiny_model
+    before = _shm_channels()
+    # force the threaded (non-sync) path so the puller actually
+    # multiplexes live channels
+    exporter = PagedKVExporter(send_timeout_s=30.0, prefetch_pages=1,
+                               page_interval_s=0.01)
+    puller = BatchedKVPuller()
+    prompts = [[i + 1] * 40 for i in range(4)]
+    try:
+        tickets = [_prefill_ticket(cfg, params, p, exporter)
+                   for p in prompts]
+        streams = [KVPageStream(t["n_pages"], t["page_size"])
+                   for t in tickets]
+        for t, s in zip(tickets, streams):
+            puller.pull(t, s, timeout_s=30.0)
+        assert _wait(lambda: all(s.finished_ts for s in streams))
+        # pages arrived complete and in-order per ticket
+        for t, s in zip(tickets, streams):
+            got = sorted(i for i, _k, _v in s.take_ready())
+            assert got == list(range(t["n_pages"]))
+        assert puller.pending() == 0
+        # warm path: drain without adopting — sender retires the channel
+        t = _prefill_ticket(cfg, params, prompts[0], exporter)
+        puller.drain(t, timeout_s=30.0)
+        assert _wait(lambda: exporter.pending() == 0)
+    finally:
+        exporter.teardown()
+        puller.teardown()
+    assert _wait(lambda: _shm_channels() - before == set())
+
+
+def test_transfer_roundtrip_bfloat16():
+    """The TPU KV dtype crosses the raw wire bit-exactly: ml_dtypes
+    bfloat16 has no buffer protocol of its own, so the frame must route
+    through the uint8 reinterpret on BOTH the sync and threaded paths."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 32, 2, 16)).astype(bf16)
+    v = rng.standard_normal((2, 32, 2, 16)).astype(bf16)
+    sync_ex = PagedKVExporter(send_timeout_s=10.0)
+    slow_ex = PagedKVExporter(send_timeout_s=10.0, prefetch_pages=1,
+                              page_interval_s=0.01)  # forces threaded
+    puller = BatchedKVPuller()
+    try:
+        t = sync_ex.export(k, v, 20, 7, 16)
+        assert t["sync"]
+        kp, vp = pull_all(t, timeout_s=10.0)
+        assert kp[0].dtype == bf16
+        for i in range(t["n_pages"]):
+            assert np.array_equal(kp[i], k[:, i * 16:(i + 1) * 16])
+            assert np.array_equal(vp[i], v[:, i * 16:(i + 1) * 16])
+        t2 = slow_ex.export(k, v, 20, 7, 16)
+        assert not t2["sync"]
+        stream = KVPageStream(t2["n_pages"], 16)
+        puller.pull(t2, stream, timeout_s=10.0)
+        assert _wait(lambda: stream.finished_ts is not None)
+        for i, kpage, _vpage in sorted(stream.take_ready()):
+            assert np.array_equal(kpage, k[:, i * 16:(i + 1) * 16])
+    finally:
+        sync_ex.teardown()
+        slow_ex.teardown()
+        puller.teardown()
+
+
+def test_submit_prefilled_kv_stream_validation(tiny_model):
+    cfg, params = tiny_model
+    dec = _paged_engine(cfg, params)
+    try:
+        stream = KVPageStream(2, PAGE)
+        with pytest.raises(ValueError, match="kv_stream alone"):
+            dec.submit_prefilled(length=5, first_token=0,
+                                 k_pages=[None], v_pages=[None],
+                                 kv_stream=stream)
+        with pytest.raises(ValueError, match="must agree"):
+            dec.submit_prefilled(length=5, first_token=0,
+                                 kv_stream=KVPageStream(2, PAGE * 2))
+    finally:
         dec.shutdown()
 
 

@@ -53,6 +53,12 @@ EXPECTED_METRICS = (
     "ray_tpu_llm_pd_transfer_bytes_total",
     "ray_tpu_llm_pd_kv_pages_total",
     "ray_tpu_llm_pd_ttft_seconds",
+    # streamed PD admission (ISSUE 15): pages pulled onto the decode host
+    # ahead of slot activation by the batched puller / inline sync pull,
+    # and the per-decode-step wall-time histogram split by attention impl
+    # (ragged vs gather — the decode-kernel half of the PD win)
+    "ray_tpu_llm_pd_pages_prefetched_total",
+    "ray_tpu_llm_decode_step_seconds",
     # arena object-store accounting (CoreWorker._record_store_metrics)
     "ray_tpu_object_store_used",
     "ray_tpu_object_store_capacity",
