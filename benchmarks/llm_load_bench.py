@@ -267,6 +267,99 @@ def _open_loop(stack, prompts, *, rate_rps: float, duration_s: float,
     return out
 
 
+class _AdmissionGate:
+    """Replica-admission semantics (serve/replica.py) for an in-process
+    stack: at most `max_ongoing` requests executing, at most `max_queued`
+    waiting for a slot — anything beyond is SHED with RequestShedError
+    instead of queued, exactly what a bounded replica does at 3x load."""
+
+    def __init__(self, max_ongoing: int, max_queued: int):
+        self._sem = threading.BoundedSemaphore(max_ongoing)
+        self._max_queued = max_queued
+        self._pending = 0
+        self._lock = threading.Lock()
+
+    def enter(self) -> None:
+        from ray_tpu.exceptions import RequestShedError
+
+        if self._sem.acquire(blocking=False):
+            return
+        with self._lock:
+            if self._pending >= self._max_queued:
+                raise RequestShedError(
+                    f"admission queue full ({self._max_queued} waiting)")
+            self._pending += 1
+        self._sem.acquire()
+        with self._lock:
+            self._pending -= 1
+
+    def leave(self) -> None:
+        self._sem.release()
+
+
+def _overload_round(stack, prompts, *, capacity_rps: float, factor: float,
+                    duration_s: float, max_tokens: int, max_ongoing: int,
+                    max_queued: int, rng) -> dict:
+    """Open loop at `factor` x the measured closed-loop capacity against a
+    bounded admission gate: the overload row. Records the shed rate and
+    the ACCEPTED requests' p99 TTFT — the property under test is that
+    bounded admission keeps latency for admitted work flat while excess
+    arrivals get a fast refusal, instead of every request drowning in an
+    unbounded queue."""
+    from ray_tpu.exceptions import RequestShedError
+
+    gate = _AdmissionGate(max_ongoing, max_queued)
+    rate = max(capacity_rps * factor, 0.5)
+    accepted: list = []
+    shed = [0]
+    lock = threading.Lock()
+    threads: list = []
+    t0 = time.perf_counter()
+    i = 0
+    next_at = t0
+    while True:
+        next_at += rng.exponential(1.0 / rate)
+        now = time.perf_counter()
+        if next_at - t0 > duration_s:
+            break
+        if next_at > now:
+            time.sleep(next_at - now)
+
+        def client(idx=i):
+            try:
+                gate.enter()
+            except RequestShedError:
+                with lock:
+                    shed[0] += 1
+                return
+            try:
+                r = stack.request(prompts[idx % len(prompts)], max_tokens)
+            finally:
+                gate.leave()
+            with lock:
+                accepted.append(r)
+
+        th = threading.Thread(target=client)
+        th.start()
+        threads.append(th)
+        i += 1
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    out = _stats(accepted, wall)
+    out.update({
+        "offered": i,
+        "offered_rps": round(rate, 2),
+        "capacity_rps": round(capacity_rps, 2),
+        "overload_factor": factor,
+        "shed": shed[0],
+        "shed_rate": round(shed[0] / max(i, 1), 3),
+        "max_ongoing": max_ongoing,
+        "max_queued": max_queued,
+    })
+    return out
+
+
 # ----------------------------------------------------- decode-step microbench
 
 
@@ -432,6 +525,18 @@ def _measure(platform: str) -> dict:
                                     duration_s=open_duration_s,
                                     max_tokens=gen_len, rng=arrival_rng))
         results["arrival_sweep"] = sweep
+
+        # ---- overload row: ~3x capacity against bounded admission ------
+        # capacity = the stack's measured closed-loop completion rate; at
+        # 3x offered load the bounded gate sheds the excess fast and the
+        # admitted requests' p99 TTFT stays near the closed-loop value
+        # (the ISSUE 16 overload-shedding acceptance row)
+        capacity_rps = ab["pd"]["requests"] / max(ab["pd"]["wall_s"], 1e-9)
+        results["overload"] = _overload_round(
+            pd, prompts, capacity_rps=capacity_rps, factor=3.0,
+            duration_s=open_duration_s, max_tokens=gen_len,
+            max_ongoing=conc, max_queued=conc,
+            rng=np.random.default_rng(2))
     finally:
         pd.shutdown()
         mono.shutdown()
@@ -462,7 +567,7 @@ def main():
         os.path.abspath(__file__), "RAY_TPU_LLM_LOAD_BENCH_CHILD",
         _BUDGET_S, _LKG_PATH,
         ["ab", "arrival_sweep", "pd_token_exact", "phase_breakdown",
-         "decode_step"],
+         "decode_step", "overload"],
         _ROOT)
     # merge INTO LLM_BENCH.json as the `pd` section — the serving bench
     # owns the file's top level and preserves this key on rewrite

@@ -83,3 +83,12 @@ MESH_AXES = (MESH_AXIS_DP, MESH_AXIS_FSDP, MESH_AXIS_EP, MESH_AXIS_PP,
 
 #: canonical exported-metric namespace (tools/graft_check metric-name check).
 METRIC_NAME_PREFIX = "ray_tpu_"
+
+# ---------------------------------------------------------------- deadlines
+
+#: HTTP request header carrying the per-request deadline budget in seconds
+#: (float). The proxy converts it to an absolute wall-clock deadline that
+#: rides the request-context envelope through handle → replica → engine;
+#: every hop refuses work it can no longer finish. Clients and the
+#: load-bench speak this exact header, so it is wire protocol.
+HTTP_DEADLINE_HEADER = "x-ray-tpu-deadline-s"

@@ -173,6 +173,11 @@ class RayConfig:
     # serving process retains (and ships to the GCS request log) so a slow
     # request can be explained after the fact without sampling luck.
     serve_flight_recorder_size: int = 256
+    # HTTP proxy per-request budget: ceiling on the blocking handle call
+    # behind each non-streaming HTTP request (previously a hardcoded 60 s).
+    # A request carrying its own deadline (x-ray-tpu-deadline-s header)
+    # clamps further to the remaining budget; expiry surfaces as 504.
+    serve_request_timeout_s: float = 60.0
     # Compiled-DAG exec-loop recovery budget: total seconds the driver
     # waits per recovery for the core actor restart + the in-band rewire
     # barrier + the in-flight replay before degrading the DAG to the

@@ -57,3 +57,35 @@ class RayChannelError(RayTpuError):
     """A compiled-DAG channel operation failed: peer loop/actor died, the
     channel was closed mid-execution, or the DAG was torn down (reference:
     ray.exceptions.RayChannelError)."""
+
+
+class RequestCancelledError(RayTpuError):
+    """The serve request was cancelled before completing: the client
+    disconnected mid-stream, `DeploymentResponse.cancel()` was called, or a
+    timed-out caller sent a best-effort cancel (reference:
+    ray.serve.exceptions.RequestCancelledError)."""
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's deadline expired before this hop could finish it.
+
+    Raised per-hop: the proxy refuses dispatch, the replica refuses
+    admission after queue-wait, and the engine aborts expired rows between
+    decode steps — work the client will never see is never started."""
+
+
+class RequestShedError(RayTpuError):
+    """Admission control refused the request instead of queueing it.
+
+    Raised when the replica's admission queue is at `max_queued_requests`
+    or the router's client-side in-flight window is saturated; the HTTP
+    proxy maps it to `503` + `Retry-After` (reference:
+    ray.serve BackPressureError semantics)."""
+
+    def __init__(self, msg: str = "request shed by admission control",
+                 retry_after_s: float = 1.0):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (RequestShedError, (self.args[0], self.retry_after_s))

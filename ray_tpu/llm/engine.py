@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -29,8 +30,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.exceptions import DeadlineExceededError, RequestCancelledError
 from ray_tpu.models import decoding
 from ray_tpu.models.transformer import TransformerConfig
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -79,6 +83,10 @@ class _Request:
     # multi-LoRA: bank index this request decodes with (0 = base model)
     lora_idx: int = 0
     lora_released: bool = False
+    # absolute wall-clock deadline (0 = none): the scheduler aborts the
+    # row between steps once expired, and refuses admission for a request
+    # whose queue-wait already spent the budget
+    deadline_ts: float = 0.0
 
     def __iter__(self):
         """Yield generated tokens as they are produced (public surface for
@@ -377,6 +385,15 @@ class TPUEngine:
         self._work = threading.Event()
         self._stop = False
         self._error: BaseException | None = None
+        # cancellation plane: abort_request() is called from request
+        # threads; rids land here and the scheduler applies them at the
+        # top of its next pass (slot + pages reclaimed in one step).
+        # _abort_pending keeps rids whose request is still in _waiting
+        # (a SimpleQueue can't be searched) until _admit pops them;
+        # values are monotonic stamps so stale rids age out.
+        self._abort_q: queue.SimpleQueue = queue.SimpleQueue()
+        self._abort_pending: dict[int, float] = {}
+        self.aborts = 0  # requests reclaimed via abort/deadline
         # serving-phase instrumentation (decode-slot admission wait,
         # inter-token gap): pre-bound histograms resolved ONCE per engine —
         # the per-token cost is one clock read + one lock-free observe.
@@ -537,7 +554,8 @@ class TPUEngine:
                     0, self._lora_refs.get(req.lora_idx, 1) - 1)
 
     def submit(self, token_ids: list, params: SamplingParams | None = None,
-               *, lora: str | None = None) -> _Request:
+               *, lora: str | None = None,
+               deadline_ts: float = 0.0) -> _Request:
         self._check_alive()
         params = params or SamplingParams()
         if params.guided is not None:
@@ -580,7 +598,8 @@ class TPUEngine:
                 lora_idx = self._lora_ids[lora]
                 self._lora_refs[lora_idx] += 1
         req = _Request(next(self._rid), token_ids, params,
-                       history=list(token_ids), lora_idx=lora_idx)
+                       history=list(token_ids), lora_idx=lora_idx,
+                       deadline_ts=float(deadline_ts or 0.0))
         req.submitted_ts = time.time()
         self._waiting.put(req)
         self._work.set()
@@ -591,7 +610,8 @@ class TPUEngine:
                          params: SamplingParams | None = None, *,
                          k_pages: list | None = None,
                          v_pages: list | None = None,
-                         kv_stream=None) -> _Request:
+                         kv_stream=None,
+                         deadline_ts: float = 0.0) -> _Request:
         """Admit a sequence whose prefill ran elsewhere (PD disaggregation).
 
         Three forms:
@@ -663,7 +683,8 @@ class TPUEngine:
             raise ValueError(
                 f"prefix length {int(length)} + max_tokens {params.max_tokens} "
                 f"does not fit engine max_len {self.max_len}")
-        req = _Request(next(self._rid), [], params)
+        req = _Request(next(self._rid), [], params,
+                       deadline_ts=float(deadline_ts or 0.0))
         req.submitted_ts = time.time()
         if kv_stream is not None:
             req.kv_stream = kv_stream
@@ -694,6 +715,15 @@ class TPUEngine:
         """Yields token ids as they are produced."""
         req = self.submit(token_ids, params, lora=lora)
         yield from _iter_request(req)
+
+    def abort_request(self, rid: int) -> None:
+        """Cancel an in-flight request by rid: the scheduler reclaims its
+        decode slot and every granted KV page at the top of its next pass
+        (one decode step, not at max_tokens), and the caller's iterator
+        raises RequestCancelledError. Thread-safe; a rid that already
+        finished (or never existed) is a no-op that ages out."""
+        self._abort_q.put(int(rid))
+        self._work.set()
 
     def shutdown(self):
         self._stop = True
@@ -1139,6 +1169,8 @@ class TPUEngine:
             req = self._next_waiting()
             if req is None:
                 return
+            if self._cancel_at_admission(req):
+                continue
             slot = self._free.pop()
             req.slot = slot
             if req.kv_pack is not None:
@@ -1447,21 +1479,122 @@ class TPUEngine:
         if not eos:
             req.out_queue.put(token_id)
         if eos or req.generated >= req.params.max_tokens:
-            if self.kv_layout == "paged":
-                self.state = self._dp.release_slot_paged(self.state, req.slot)
-                self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
-                if self.enable_prefix_cache:
-                    self._release_shared(req.slot)
-            else:
-                self.state = decoding.release_slot(self.state, req.slot)
-            if self.lora_bank is not None:
-                self._slot_lora = self._slot_lora.at[req.slot].set(0)
-            self._lora_release(req)
-            self._guided_fsm.pop(req.slot, None)
-            self._guided_state.pop(req.slot, None)
-            self._free.append(req.slot)
-            del self._by_slot[req.slot]
+            self._release_active(req)
             req.out_queue.put(_SENTINEL)
+
+    def _release_active(self, req: _Request) -> None:
+        """Return an ACTIVE row's slot, pages, LoRA ref and guided-FSM
+        state to their pools — the one release path shared by normal
+        completion (_emit) and mid-stream abort (_abort_one)."""
+        if self.kv_layout == "paged":
+            self.state = self._dp.release_slot_paged(self.state, req.slot)
+            self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
+            if self.enable_prefix_cache:
+                self._release_shared(req.slot)
+        else:
+            self.state = decoding.release_slot(self.state, req.slot)
+        if self.lora_bank is not None:
+            self._slot_lora = self._slot_lora.at[req.slot].set(0)
+        self._lora_release(req)
+        self._guided_fsm.pop(req.slot, None)
+        self._guided_state.pop(req.slot, None)
+        self._free.append(req.slot)
+        del self._by_slot[req.slot]
+
+    # -------------------------------------------------- cancellation plane
+
+    def _count_cancel(self) -> None:
+        self.aborts += 1
+        try:
+            from ray_tpu.serve import request_context as _rc
+
+            _rc.count_cancellation("engine")
+        except Exception as e:  # pragma: no cover — metrics must never
+            # kill the scheduler (every in-flight request would die)
+            logger.debug("cancellation metric failed: %r", e)
+
+    def _abort_one(self, req: _Request, err: BaseException) -> bool:
+        """Reclaim one request wherever it currently lives (active slot,
+        streamed admission, staged chunked prefill, page-pressure backlog)
+        and surface `err` to its caller. Scheduler thread only. Returns
+        False when the request is in none of the searchable registries
+        (still in _waiting, or already finished)."""
+        if req.slot >= 0 and self._by_slot.get(req.slot) is req:
+            self._release_active(req)
+        elif req in self._streaming:
+            # _fail_stream reclaims + puts its own _RequestError
+            self._fail_stream(req, err)
+            self._count_cancel()
+            return True
+        elif req in self._prefilling:
+            self._prefilling.remove(req)
+            if self.kv_layout == "paged":
+                self._free_pages.extend(self._slot_pages.pop(req.slot, ()))
+                self._release_shared(req.slot)
+            self._free.append(req.slot)
+            self._lora_release(req)
+        elif req in self._backlog:
+            self._backlog.remove(req)
+            self._lora_release(req)
+        else:
+            return False
+        req.out_queue.put(_RequestError(err))
+        self._count_cancel()
+        return True
+
+    def _apply_aborts(self) -> None:
+        """Drain abort_request() rids and reclaim their rows. Rids not yet
+        admitted stay pending so _admit cancels them at pop time; stale
+        ones (request already finished) age out after 120 s."""
+        now = time.monotonic()
+        while True:
+            try:
+                self._abort_pending.setdefault(self._abort_q.get_nowait(),
+                                               now)
+            except queue.Empty:
+                break
+        if not self._abort_pending:
+            return
+        for req in (list(self._by_slot.values()) + list(self._streaming)
+                    + list(self._prefilling) + list(self._backlog)):
+            if req.rid in self._abort_pending and self._abort_one(
+                    req, RequestCancelledError(
+                        f"request {req.rid} cancelled")):
+                del self._abort_pending[req.rid]
+        for rid, t in list(self._abort_pending.items()):
+            if now - t > 120.0:
+                del self._abort_pending[rid]
+
+    def _expire_deadlines(self) -> None:
+        """Abort every admitted request whose deadline passed — between
+        decode steps, so an expired row never costs another step. Requests
+        still in _waiting are checked at admission instead."""
+        now = time.time()
+        for reqs in (self._by_slot.values(), self._streaming,
+                     self._prefilling, self._backlog):
+            for req in list(reqs):
+                if req.deadline_ts and now > req.deadline_ts:
+                    self._abort_one(req, DeadlineExceededError(
+                        f"request {req.rid} deadline exceeded "
+                        f"({now - req.deadline_ts:.3f}s past)"))
+                    self._abort_pending.pop(req.rid, None)
+
+    def _cancel_at_admission(self, req: _Request) -> bool:
+        """Refuse a popped waiting-queue request that was cancelled or
+        whose queue-wait already spent its deadline budget — before any
+        prefill compute or page grant."""
+        if self._abort_pending.pop(req.rid, None) is not None:
+            err: BaseException = RequestCancelledError(
+                f"request {req.rid} cancelled before admission")
+        elif req.deadline_ts and time.time() > req.deadline_ts:
+            err = DeadlineExceededError(
+                f"request {req.rid} deadline expired during queue wait")
+        else:
+            return False
+        self._lora_release(req)
+        req.out_queue.put(_RequestError(err))
+        self._count_cancel()
+        return True
 
     def _loop(self):
         try:
@@ -1473,6 +1606,11 @@ class TPUEngine:
 
     def _loop_inner(self):
         while not self._stop:
+            # cancellation + deadline sweep first: an aborted/expired row's
+            # slot and pages are back in the pool before this pass admits
+            # or steps anything (reclaim within one decode step)
+            self._apply_aborts()
+            self._expire_deadlines()
             if (not self._by_slot and self._waiting.empty()
                     and not self._backlog and not self._prefilling
                     and not self._streaming):
@@ -1549,6 +1687,7 @@ class TPUEngine:
                "max_slots": self.max_slots, "buckets": list(self.buckets),
                "kv_layout": self.kv_layout, "attn_impl": self.attn_impl,
                "decode_steps": self.decode_steps,
+               "aborts": self.aborts,
                "decode_occupancy": (self.decode_slot_steps
                                     / self.decode_steps
                                     if self.decode_steps else 0.0)}

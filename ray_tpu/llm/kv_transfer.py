@@ -638,7 +638,7 @@ def pull_sync(ticket: dict, sink) -> bool:
 
 class _Pull:
     __slots__ = ("ticket_id", "channel", "sink", "n_pages", "next_i",
-                 "timeout_s", "last_progress")
+                 "timeout_s", "last_progress", "aborted")
 
     def __init__(self, ticket_id, channel, sink, n_pages, timeout_s, now):
         self.ticket_id = ticket_id
@@ -648,6 +648,7 @@ class _Pull:
         self.next_i = 0
         self.timeout_s = timeout_s
         self.last_progress = now
+        self.aborted = False  # abort(): finished by the polling thread
 
 
 class BatchedKVPuller:
@@ -725,6 +726,22 @@ class BatchedKVPuller:
     def pending(self) -> int:
         with self._lock:
             return len(self._pulls)
+
+    def abort(self, ticket_id: str) -> bool:
+        """Cancel an in-flight registered pull (decode-tier ticket abort:
+        the request was cancelled downstream). The polling thread — the
+        only channel reader — closes the channel (the flipped shared flag
+        stops the sender's stream in one write) and fails the sink on its
+        next cycle, so no page read races the teardown. Thread-safe; a
+        ticket already finished (or consumed inline by pull_sync) returns
+        False."""
+        with self._lock:
+            for p in self._pulls:
+                if p.ticket_id == ticket_id:
+                    p.aborted = True
+                    self._work.set()
+                    return True
+        return False
 
     # ------------------------------------------------------------- loop
 
@@ -807,6 +824,17 @@ class BatchedKVPuller:
             progressed = False
             for p in pulls:
                 try:
+                    if p.aborted:
+                        # reader-side close: the shared flag stops the
+                        # sender's stream at its next write, then the sink
+                        # fails so the engine reclaims the granted slot
+                        p.channel.close()
+                        self._finish(p, KVTransferError(
+                            f"kv transfer {p.ticket_id}: cancelled by the "
+                            f"decode side after {p.next_i}/{p.n_pages} "
+                            "pages (request aborted)"))
+                        progressed = True
+                        continue
                     progressed |= self._sweep_one(p, _time.monotonic())
                 except ChannelClosed:
                     self._finish(p, KVTransferError(

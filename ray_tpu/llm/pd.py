@@ -30,11 +30,14 @@ layout. ICI remote-DMA is the cross-host follow-on.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 
 import numpy as np
 
 from ray_tpu import serve
+from ray_tpu.exceptions import DeadlineExceededError
+from ray_tpu.serve import replica as _replica
 from ray_tpu.llm.config import LLMConfig, PDConfig
 from ray_tpu.llm.engine import SamplingParams, bucket_for
 from ray_tpu.llm.kv_transfer import (BatchedKVPuller, KVPageStream,
@@ -42,6 +45,8 @@ from ray_tpu.llm.kv_transfer import (BatchedKVPuller, KVPageStream,
 from ray_tpu.llm.tokenizer import load_tokenizer
 from ray_tpu.serve import request_context as _rc
 from ray_tpu.util import tracing as _tracing
+
+logger = logging.getLogger(__name__)
 
 _TTFT_BOUNDS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5, 5.0, 10.0)
@@ -268,6 +273,13 @@ class PrefillServer:
                                     n, first, self.page_size,
                                     trace_ctx=_tracing.inject())
 
+    def abort_transfer(self, ticket_id: str) -> None:
+        """Best-effort: retire an exported ticket whose consumer went away
+        (client disconnect before/while the decode side pulled) so the
+        sender thread stops now instead of at its send timeout. A ticket
+        another replica exported — or one already settled — is a no-op."""
+        self.exporter.abort(ticket_id)
+
     def transfer_stats(self) -> dict:
         return {"pending_transfers": self.exporter.pending(),
                 "failed_transfers": self.exporter.failures,
@@ -337,12 +349,13 @@ class DecodeServer:
                     pass
             return
         t_pull = time.time()
+        deadline_ts = _replica.request_deadline() or 0.0
         if self.puller is not None:
             stream = KVPageStream(ticket["n_pages"], ticket["page_size"])
             self.puller.pull(ticket, stream, timeout_s=self.pull_timeout_s)
             req = self.engine.submit_prefilled(
                 length=ticket["length"], first_token=ticket["first_token"],
-                params=sp, kv_stream=stream)
+                params=sp, kv_stream=stream, deadline_ts=deadline_ts)
         else:
             stream = None
             k_pages, v_pages = pull_all(ticket, timeout_s=self.pull_timeout_s)
@@ -351,7 +364,29 @@ class DecodeServer:
                                    pages=ticket["n_pages"])
             req = self.engine.submit_prefilled(
                 length=ticket["length"], first_token=ticket["first_token"],
-                params=sp, k_pages=k_pages, v_pages=v_pages)
+                params=sp, k_pages=k_pages, v_pages=v_pages,
+                deadline_ts=deadline_ts)
+
+        fin = {"done": False}
+
+        def _abort():
+            """Reclaim BOTH planes mid-stream: the decode slot + granted
+            KV pages (engine abort) and the in-flight page transfer
+            (puller abort closes the channel, which also makes the
+            prefill-side sender retire its ticket). Idempotent: finished
+            requests no-op in both registries."""
+            if fin["done"]:
+                return
+            try:
+                self.engine.abort_request(req.rid)
+                if self.puller is not None:
+                    self.puller.abort(ticket.get("ticket", ""))
+            finally:
+                _rc.count_cancellation("pd")
+
+        # serve-plane cancel (client disconnect seen by the proxy, explicit
+        # cancel(), timed-out caller) lands here via the replica's holder
+        _replica.on_cancel(_abort)
         n = 0
         t_dec = time.time()
         try:
@@ -374,7 +409,12 @@ class DecodeServer:
                                                req.admitted_ts)
                 n += 1
                 yield tok
+            fin["done"] = True
         finally:
+            if not fin["done"]:
+                # consumer abandoned the stream (GeneratorExit from the
+                # replica's close()) or it failed mid-decode: reclaim now
+                _abort()
             if ctx is not None:
                 _tracing.emit_span_for(ctx, "pd:decode", t_dec, time.time(),
                                        tokens=n)
@@ -422,9 +462,23 @@ class PDProxyServer:
         timing["prompt_tokens"] = len(ids)
         t0 = time.monotonic()
         w0 = time.time()
+        # the proxy's own request deadline (set by the HTTP ingress) rides
+        # into both pools; each leg's blocking wait is clamped to the
+        # remaining budget so a queued prefill can't eat the decode's time
+        deadline_ts = _replica.request_deadline()
+        budget_s = self.request_timeout_s
+        if deadline_ts:
+            rem = _rc.deadline_remaining(deadline_ts)
+            if rem is not None:
+                if rem <= 0:
+                    _rc.count_cancellation("pd")
+                    raise DeadlineExceededError(
+                        "pd proxy: deadline expired before prefill dispatch")
+                budget_s = min(budget_s, rem)
         ticket = self.prefill.prefill.remote(
-            ids, float(body.get("temperature", 0.0))
-        ).result(timeout_s=self.request_timeout_s)
+            ids, float(body.get("temperature", 0.0)),
+            _deadline_ts=deadline_ts,
+        ).result(timeout_s=budget_s)
         # the first token is sampled BY prefill and rides the ticket: its
         # arrival is the request's time-to-first-token
         timing["ttft_s"] = time.monotonic() - t0
@@ -437,17 +491,38 @@ class PDProxyServer:
             stream=True, stream_item_timeout_s=self.request_timeout_s,
         ).decode_stream.remote(
             ticket, {"max_tokens": int(body.get("max_tokens", 32)),
-                     "temperature": float(body.get("temperature", 0.0))})
-        for i, tok in enumerate(stream):
-            if i == 1:
-                # first DECODE-produced token: page pull + slot admission
-                # + one decode step — the decode half of the TTFT split
-                decode_ttft = time.monotonic() - t1
-                timing["decode_ttft_s"] = decode_ttft
-                self._m_ttft.observe(decode_ttft, tags={"phase": "decode"})
-                _tracing.emit_child_span("pd:decode_first_token", w1,
-                                         w1 + decode_ttft)
-            yield tok
+                     "temperature": float(body.get("temperature", 0.0))},
+            _deadline_ts=deadline_ts)
+        finished = False
+        try:
+            for i, tok in enumerate(stream):
+                if i == 1:
+                    # first DECODE-produced token: page pull + slot admission
+                    # + one decode step — the decode half of the TTFT split
+                    decode_ttft = time.monotonic() - t1
+                    timing["decode_ttft_s"] = decode_ttft
+                    self._m_ttft.observe(decode_ttft, tags={"phase": "decode"})
+                    _tracing.emit_child_span("pd:decode_first_token", w1,
+                                             w1 + decode_ttft)
+                yield tok
+            finished = True
+        finally:
+            if not finished:
+                # abandoned mid-decode (client gone) or failed: cancel the
+                # decode replica's stream (which aborts the engine request
+                # and the page pull) and best-effort retire the exported
+                # ticket on the prefill tier so its sender stops too
+                cancel = getattr(stream, "cancel", None)
+                if cancel is not None:
+                    try:
+                        cancel()
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        logger.debug("pd decode-stream cancel failed: %r", e)
+                try:
+                    self.prefill.abort_transfer.remote(
+                        ticket.get("ticket", ""))
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    logger.debug("pd prefill abort_transfer failed: %r", e)
         timing["total_time_s"] = time.monotonic() - t0
 
     def _usage(self, timing: dict, n_out: int) -> dict:
@@ -496,8 +571,9 @@ class PDProxyServer:
         n = 0
         t0 = time.perf_counter()
         status = "aborted"  # GeneratorExit (client gone) or mid-stream error
+        gen = self._pump(body, timing)
         try:
-            for tok in self._pump(body, timing):
+            for tok in gen:
                 n += 1
                 yield {"object": "text_completion.chunk",
                        "choices": [{"index": 0,
@@ -505,6 +581,10 @@ class PDProxyServer:
                                     "finish_reason": None}]}
             status = "stream"
         finally:
+            # explicit close: on abandonment the pump's finally must run
+            # NOW (cancel the decode stream, retire the prefill ticket),
+            # not whenever the suspended frame gets collected
+            gen.close()
             self._record(request, timing, t0, n, status)
         yield {"object": "text_completion.chunk",
                "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],

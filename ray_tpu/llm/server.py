@@ -12,6 +12,7 @@ import os
 import time
 
 from ray_tpu import serve
+from ray_tpu.serve import replica as _replica
 from ray_tpu.llm.config import LLMConfig
 from ray_tpu.llm.engine import SamplingParams, TPUEngine
 from ray_tpu.llm.tokenizer import load_tokenizer
@@ -200,13 +201,21 @@ class LLMServer:
         evict the adapter between ensure() and submit. One shared path for
         blocking and streaming completions; returns the engine request
         (iterable over generated tokens)."""
+        deadline_ts = _replica.request_deadline() or 0.0
         try:
-            return self.engine.submit(ids, params, lora=lora)
+            req = self.engine.submit(ids, params, lora=lora,
+                                     deadline_ts=deadline_ts)
         except KeyError:
             if lora is None:
                 raise
             self._get_adapter(lora).ensure()
-            return self.engine.submit(ids, params, lora=lora)
+            req = self.engine.submit(ids, params, lora=lora,
+                                     deadline_ts=deadline_ts)
+        # a cancel observed by the serve plane (client disconnect, explicit
+        # cancel(), timed-out caller) reclaims this request's decode slot
+        # and KV pages in one step instead of decoding to max_tokens
+        _replica.on_cancel(lambda: self.engine.abort_request(req.rid))
+        return req
 
     def completions(self, body: dict) -> dict:
         prompt = body.get("prompt", "")

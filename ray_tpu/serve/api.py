@@ -116,6 +116,7 @@ def run(target: Application, *, name: str = "default",
         cfg_dict = {
             "initial_replicas": cfg.initial_replicas,
             "max_ongoing_requests": cfg.max_ongoing_requests,
+            "max_queued_requests": cfg.max_queued_requests,
             "ray_actor_options": cfg.ray_actor_options,
             "user_config": cfg.user_config,
             "autoscaling_config": (dataclasses.asdict(cfg.autoscaling_config)

@@ -27,6 +27,13 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int | None = 1
     max_ongoing_requests: int = 8
+    # admission-queue bound: requests beyond max_ongoing wait; once the
+    # wait line reaches this depth further arrivals are SHED with
+    # RequestShedError (HTTP 503 + Retry-After) instead of queued. -1 =
+    # unbounded (the pre-overload-control behavior). Routers also derive
+    # their per-replica in-flight window from it (max_ongoing + this).
+    # (reference: serve/config.py max_queued_requests)
+    max_queued_requests: int = -1
     ray_actor_options: dict = field(default_factory=dict)
     autoscaling_config: AutoscalingConfig | None = None
     user_config: dict | None = None

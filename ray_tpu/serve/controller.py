@@ -379,6 +379,8 @@ class ServeController:
                 "deployments": {
                     full: {"replicas": [h.actor_id for h in st.replicas.values()],
                            "max_ongoing": st.config["max_ongoing_requests"],
+                           "max_queued": st.config.get("max_queued_requests",
+                                                       -1),
                            "request_router": st.config.get("request_router", "pow2"),
                            "replica_addrs": {
                                h.actor_id: st.addrs[tag]
@@ -597,7 +599,8 @@ class ServeController:
                 max_concurrency=st.config["max_ongoing_requests"],
             ).remote(st.full_name, tag, st.callable_blob,
                      st.init_args_blob, st.config.get("user_config"),
-                     st.config["max_ongoing_requests"])
+                     st.config["max_ongoing_requests"],
+                     st.config.get("max_queued_requests", -1))
         except Exception:  # noqa: BLE001 — e.g. the name is still held
             self._delete_rep_row(st, tag)  # retry next tick with a new tag
             return

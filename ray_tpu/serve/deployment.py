@@ -20,6 +20,7 @@ class Deployment:
         self.config = config
 
     def options(self, *, name=None, num_replicas=None, max_ongoing_requests=None,
+                max_queued_requests=None,
                 ray_actor_options=None, autoscaling_config=None,
                 user_config=None, request_router=None,
                 graceful_shutdown_timeout_s=None,
@@ -30,6 +31,9 @@ class Deployment:
                           else (None if num_replicas == "auto" else num_replicas)),
             max_ongoing_requests=(self.config.max_ongoing_requests
                                   if max_ongoing_requests is None else max_ongoing_requests),
+            max_queued_requests=(self.config.max_queued_requests
+                                 if max_queued_requests is None
+                                 else max_queued_requests),
             ray_actor_options=(dict(self.config.ray_actor_options)
                                if ray_actor_options is None else ray_actor_options),
             autoscaling_config=(self.config.autoscaling_config
@@ -87,7 +91,8 @@ class Application:
 
 
 def deployment(func_or_class=None, *, name=None, num_replicas=1,
-               max_ongoing_requests=8, ray_actor_options=None,
+               max_ongoing_requests=8, max_queued_requests=-1,
+               ray_actor_options=None,
                autoscaling_config=None, user_config=None,
                health_check_period_s: float = 2.0,
                health_check_timeout_s: float = 30.0,
@@ -102,6 +107,7 @@ def deployment(func_or_class=None, *, name=None, num_replicas=1,
         cfg = DeploymentConfig(
             num_replicas=None if num_replicas == "auto" else num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             ray_actor_options=ray_actor_options or {},
             autoscaling_config=(AutoscalingConfig(**autoscaling_config)
                                 if isinstance(autoscaling_config, dict)

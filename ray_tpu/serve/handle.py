@@ -9,6 +9,8 @@ resolved or garbage-collected) and picks the lighter of two random replicas.)
 
 from __future__ import annotations
 
+import logging
+import os
 import random
 import threading
 import time
@@ -17,10 +19,19 @@ import weakref
 import ray_tpu
 from ray_tpu._private.protocol import ConnectionClosed
 from ray_tpu.actor import ActorHandle
+from ray_tpu.exceptions import DeadlineExceededError, RequestShedError
 from ray_tpu.serve import request_context as _rc
 from ray_tpu.util import tracing as _tracing
 
+logger = logging.getLogger(__name__)
+
 ROUTING_REFRESH_S = 1.0
+
+
+def _new_cancel_key() -> str:
+    """Per-request cancellation address: rides the request to the replica,
+    and a later cancel frame / cancel_request() call quotes it back."""
+    return os.urandom(8).hex()
 
 
 def _channel_dead_error():
@@ -35,13 +46,14 @@ def _channel_dead_error():
 class _Pending:
     """A rid's in-flight slot on a _FastChannel."""
 
-    __slots__ = ("event", "reply", "chan", "rid")
+    __slots__ = ("event", "reply", "chan", "rid", "cancel_key")
 
-    def __init__(self, chan=None, rid=None):
+    def __init__(self, chan=None, rid=None, cancel_key=None):
         self.event = threading.Event()
         self.reply = None
         self.chan = chan
         self.rid = rid
+        self.cancel_key = cancel_key
 
     def wait(self, timeout_s: float | None):
         if not self.event.wait(timeout_s):
@@ -50,6 +62,11 @@ class _Pending:
             if self.chan is not None:
                 with self.chan._lock:
                     self.chan._waiters.pop(self.rid, None)
+                # a timed-out caller must not leave the replica doing dead
+                # work: best-effort cancel so the replica/engine stop and
+                # the admission slot frees (the reply, if any, is dropped)
+                self.chan.send_cancel(self.cancel_key)
+                _rc.count_cancellation("handle")
             raise TimeoutError(f"fast-rpc call timed out after {timeout_s}s")
         if self.reply is None:  # woken by channel death
             raise _channel_dead_error()
@@ -100,16 +117,22 @@ class _FastChannel:
                 w.event.set()
 
     def submit(self, method: str, args: tuple, kwargs: dict,
-               model_id: str | None, trace_ctx: dict | None = None) -> _Pending:
+               model_id: str | None, trace_ctx: dict | None = None,
+               cancel_key: str | None = None,
+               deadline_ts: float | None = None) -> _Pending:
         if self.dead:
             raise _channel_dead_error()
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            w = _Pending(self, rid)
+            w = _Pending(self, rid, cancel_key)
             self._waiters[rid] = w
         msg = {"rid": rid, "method": method, "args": args,
                "kwargs": kwargs, "model_id": model_id}
+        if cancel_key:
+            msg["cancel_key"] = cancel_key
+        if deadline_ts:
+            msg["deadline_ts"] = deadline_ts
         if trace_ctx:
             # the fast plane bypasses task specs, so the sampled request's
             # context rides the frame itself (the replica activates it
@@ -129,9 +152,14 @@ class _FastChannel:
             from ray_tpu._private import serialization as ser
 
             try:
-                self._conn.send({"rid": rid, "method": method,
-                                 "args_ser": ser.dumps((args, kwargs)),
-                                 "model_id": model_id})
+                fb = {"rid": rid, "method": method,
+                      "args_ser": ser.dumps((args, kwargs)),
+                      "model_id": model_id}
+                if cancel_key:
+                    fb["cancel_key"] = cancel_key
+                if deadline_ts:
+                    fb["deadline_ts"] = deadline_ts
+                self._conn.send(fb)
             except (ConnectionClosed, ConnectionError, OSError) as e:
                 with self._lock:
                     self._waiters.pop(rid, None)
@@ -149,11 +177,27 @@ class _FastChannel:
             w.event.set()
         return w
 
+    def send_cancel(self, cancel_key: str | None):
+        """Best-effort control frame: no rid, no reply expected. The
+        replica's conn loop dispatches it straight to cancel_request
+        without occupying an rpc-pool slot (a saturated pool is exactly
+        when cancels matter most)."""
+        if not cancel_key or self.dead:
+            return
+        try:
+            self._conn.send({"cancel_key": cancel_key})
+        except (ConnectionClosed, ConnectionError, OSError):
+            self.dead = True
+        except Exception as e:  # noqa: BLE001 — cancel is best-effort
+            logger.debug("cancel frame send failed: %r", e)
+
     def call(self, method: str, args: tuple, kwargs: dict,
              model_id: str | None, timeout_s: float,
-             trace_ctx: dict | None = None):
-        return self.submit(method, args, kwargs, model_id,
-                           trace_ctx).wait(timeout_s)
+             trace_ctx: dict | None = None,
+             cancel_key: str | None = None,
+             deadline_ts: float | None = None):
+        return self.submit(method, args, kwargs, model_id, trace_ctx,
+                           cancel_key, deadline_ts).wait(timeout_s)
 
 
 _channels: dict[tuple, _FastChannel] = {}
@@ -178,8 +222,9 @@ class DeploymentResponse:
     """(reference: serve/handle.py DeploymentResponse — resolvable future;
     passing it to another .remote() call chains without blocking.)"""
 
-    def __init__(self, ref, on_done):
+    def __init__(self, ref, on_done, cancel=None):
         self._ref = ref
+        self._cancel = cancel
         self._finalizer = weakref.finalize(self, on_done)
 
     def result(self, timeout_s: float | None = None):
@@ -187,6 +232,16 @@ class DeploymentResponse:
             return ray_tpu.get(self._ref, timeout=timeout_s)
         finally:
             self._finalizer()
+
+    def cancel(self):
+        """Best-effort: tell the replica to stop this request (interrupt
+        its queue wait / engine generation) and release the router slot.
+        The caller may still observe a completed result if the reply was
+        already in flight."""
+        c, self._cancel = self._cancel, None
+        if c is not None:
+            c()
+        self._finalizer()
 
     def _to_object_ref(self):
         return self._ref
@@ -197,8 +252,9 @@ class _FastResponse:
     rid-tagged reply instead of an object ref. Chaining into another
     .remote() materializes through the object store on demand."""
 
-    def __init__(self, pending: "_Pending", on_done):
+    def __init__(self, pending: "_Pending", on_done, cancel=None):
         self._pending = pending
+        self._cancel = cancel
         self._finalizer = weakref.finalize(self, on_done)
 
     def result(self, timeout_s: float | None = None):
@@ -206,6 +262,17 @@ class _FastResponse:
             return self._pending.wait(timeout_s)
         finally:
             self._finalizer()
+
+    def cancel(self):
+        c, self._cancel = self._cancel, None
+        if c is not None:
+            c()
+        # unregister the waiter so a late reply doesn't accumulate
+        chan, rid = self._pending.chan, self._pending.rid
+        if chan is not None:
+            with chan._lock:
+                chan._waiters.pop(rid, None)
+        self._finalizer()
 
     def _to_object_ref(self):
         return ray_tpu.put(self.result())
@@ -218,13 +285,32 @@ class DeploymentResponseGenerator:
     stream=True — serve/handle.py; transport here is the runtime's
     streaming-generator task.)"""
 
-    def __init__(self, ref_gen, on_done, item_timeout_s: float | None = None):
+    def __init__(self, ref_gen, on_done, item_timeout_s: float | None = None,
+                 cancel=None):
         self._gen = ref_gen
         self._item_timeout_s = item_timeout_s
+        self._cancel = cancel
         self._finalizer = weakref.finalize(self, on_done)
 
     def __iter__(self):
         return self
+
+    def cancel(self):
+        """Abandon the stream mid-flight: fire the replica-side cancel (so
+        the generator — and through it the engine — stops producing), close
+        the transport generator, and release the router slot."""
+        c, self._cancel = self._cancel, None
+        if c is not None:
+            c()
+        close = getattr(self._gen, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception as e:  # noqa: BLE001 — teardown is best-effort
+                logger.debug("stream close failed: %r", e)
+        self._finalizer()
+
+    close = cancel  # generator-protocol alias (contextlib.closing etc.)
 
     def __next__(self):
         try:
@@ -255,6 +341,11 @@ class _Router:
         self.replicas: list[str] = []
         self.addrs: dict[str, tuple] = {}  # replica actor_id -> fast-RPC addr
         self.inflight: dict[str, int] = {}
+        # per-replica client-side admission window (max_ongoing +
+        # max_queued when the deployment bounds its queue, else None =
+        # unbounded): pick() sheds instead of queueing when EVERY replica
+        # is already at the window from this client's perspective
+        self.window: int | None = None
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._pending_table = None  # in-flight get_routing_table ref
@@ -307,6 +398,9 @@ class _Router:
             dep = table["deployments"].get(self.name)
             self.replicas = dep["replicas"] if dep else []
             self.addrs = dict(dep.get("replica_addrs") or {}) if dep else {}
+            mq = dep.get("max_queued", -1) if dep else -1
+            self.window = (dep.get("max_ongoing", 8) + mq
+                           if dep and mq >= 0 else None)
             self.inflight = {r: self.inflight.get(r, 0) for r in self.replicas}
             if dep and dep.get("request_router") == "prefix_aware" \
                     and self._prefix_policy is None:
@@ -328,6 +422,17 @@ class _Router:
             backoff = min(backoff * 2, 0.5)  # don't hammer the controller
             self._refresh(force=True)
         with self._lock:
+            if self.window is not None and self.replicas and all(
+                    self.inflight.get(r, 0) >= self.window
+                    for r in self.replicas):
+                # every replica already holds a full admission window of
+                # this client's requests: queueing more just builds dead
+                # backlog — shed fast so the caller can back off / retry
+                _rc.count_shed("router")
+                raise RequestShedError(
+                    f"deployment {self.name}: all {len(self.replicas)} "
+                    f"replica(s) at in-flight window {self.window}")
+
             def pow2():
                 if len(self.replicas) == 1:
                     return self.replicas[0]
@@ -402,8 +507,27 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(method_name=name)
 
+    def _send_cancel(self, replica_id: str, cancel_key: str | None):
+        """Best-effort cancel delivery: fast-RPC control frame when the
+        replica has a channel, actor-plane cancel_request otherwise. Never
+        raises — cancellation losing a race with completion is fine."""
+        if not cancel_key:
+            return
+        addr = self._router.addrs.get(replica_id)
+        if addr is not None:
+            try:
+                _get_channel(addr).send_cancel(cancel_key)
+                return
+            except OSError as e:
+                logger.debug("fast cancel to %s failed: %r", replica_id, e)
+        try:
+            ActorHandle(replica_id).cancel_request.remote(cancel_key)
+        except Exception as e:  # noqa: BLE001 — replica may be gone already
+            logger.debug("actor cancel to %s failed: %r", replica_id, e)
+
     def call_sync(self, *args, timeout_s: float = 60.0,
-                  _routing_hint=None, **kwargs):
+                  _routing_hint=None, _deadline_ts: float | None = None,
+                  **kwargs):
         """Submit AND wait, retrying replica-death failures on surviving
         replicas (reference: Serve's proxy retries requests whose replica
         died). Semantics are AT-LEAST-ONCE: a replica may have executed the
@@ -419,6 +543,16 @@ class DeploymentHandle:
         from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
                                         WorkerCrashedError)
 
+        if _deadline_ts:
+            budget = _rc.deadline_remaining(_deadline_ts)
+            if budget <= 0:
+                # per-hop refusal: don't ship work downstream that can't
+                # finish inside the caller's deadline
+                _rc.count_cancellation("handle")
+                raise DeadlineExceededError(
+                    f"call_sync to {self._name}: deadline already expired "
+                    f"({-budget:.3f}s past) before dispatch")
+            timeout_s = min(timeout_s, budget)
         deadline = _time.monotonic() + timeout_s
         last: Exception | None = None
         tctx = _tracing.inject()  # None unless this request was sampled
@@ -431,6 +565,8 @@ class DeploymentHandle:
                         f"{timeout_s}s before any attempt completed")
                 break
             t_pick = _time.perf_counter()
+            cancel_key = _new_cancel_key()  # fresh per attempt: a retry
+            # must not be killable by the previous attempt's stale cancel
             replica_id = self._router.pick(_routing_hint)
             # ONE release per attempt, in the outer finally: return,
             # continue and raise all route through it, so nothing between
@@ -455,7 +591,8 @@ class DeploymentHandle:
                     # persistent socket, no per-request task submission
                     try:
                         result = ch.call(self._method, args, kwargs,
-                                         self._model_id, remaining, tctx)
+                                         self._model_id, remaining, tctx,
+                                         cancel_key, _deadline_ts)
                         _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
                                           _time.perf_counter() - t_rtt)
                         return result
@@ -474,7 +611,8 @@ class DeploymentHandle:
                 replica = ActorHandle(replica_id)
                 try:
                     ref = replica.handle_request.remote(
-                        self._method, args, kwargs, self._model_id)
+                        self._method, args, kwargs, self._model_id,
+                        cancel_key, _deadline_ts)
                 except Exception as e:  # submission failed: replica gone
                     last = e
                     self._router.drop(replica_id)
@@ -484,11 +622,25 @@ class DeploymentHandle:
                     _rc.observe_phase(_rc.HANDLE_PHASE, "rtt",
                                       _time.perf_counter() - t_rtt)
                     return result
+                except GetTimeoutError as e:
+                    # the caller's budget is spent but the replica is still
+                    # executing: best-effort cancel so the admission slot
+                    # and engine resources free now, not at completion
+                    self._send_cancel(replica_id, cancel_key)
+                    _rc.count_cancellation("handle")
+                    if _deadline_ts:
+                        raise DeadlineExceededError(str(e)) from e
+                    raise
                 except (ActorDiedError, WorkerCrashedError) as e:
                     last = e
                     self._router.drop(replica_id)
             finally:
                 self._router.done(replica_id)
+        if _deadline_ts and isinstance(last, TimeoutError) \
+                and not isinstance(last, DeadlineExceededError):
+            # the budget that ran out WAS the request's deadline: surface
+            # it as such (the HTTP proxy maps this to 504, not 500)
+            raise DeadlineExceededError(str(last)) from last
         raise last
 
     def remote(self, *args, **kwargs):
@@ -502,6 +654,7 @@ class DeploymentHandle:
                       else v)
                   for k, v in kwargs.items()}
         hint = kwargs.pop("_routing_hint", None)
+        deadline_ts = kwargs.pop("_deadline_ts", None)
         # object-ref arguments need the task plane's ref resolution — the
         # fast channel ships plain values only
         has_refs = (any(isinstance(a, ObjectRef) for a in args)
@@ -510,6 +663,7 @@ class DeploymentHandle:
         tctx = _tracing.inject()  # None unless this request was sampled
         for _ in range(3):  # retry on replica death with a fresh table
             t_pick = time.perf_counter()
+            cancel_key = _new_cancel_key()
             replica_id = self._router.pick(hint)
             # on success the slot's release rides the response object's
             # on_done closure; every OTHER exit from this attempt —
@@ -532,10 +686,13 @@ class DeploymentHandle:
                         try:
                             pending = ch.submit(
                                 self._method, args, kwargs,
-                                self._model_id, tctx)
+                                self._model_id, tctx, cancel_key,
+                                deadline_ts)
                             return _FastResponse(
                                 pending,
-                                lambda r=replica_id: self._router.done(r))
+                                lambda r=replica_id: self._router.done(r),
+                                lambda r=replica_id, k=cancel_key:
+                                    self._send_cancel(r, k))
                         except Exception as e:  # channel down: drop+retry
                             last_err = e
                             self._router.done(replica_id)
@@ -546,14 +703,20 @@ class DeploymentHandle:
                     if self._stream:
                         gen = replica.handle_request_stream.options(
                             num_returns="streaming").remote(
-                            self._method, args, kwargs, self._model_id)
+                            self._method, args, kwargs, self._model_id,
+                            cancel_key, deadline_ts)
                         return DeploymentResponseGenerator(
                             gen, lambda r=replica_id: self._router.done(r),
-                            self._stream_item_timeout_s)
+                            self._stream_item_timeout_s,
+                            lambda r=replica_id, k=cancel_key:
+                                self._send_cancel(r, k))
                     ref = replica.handle_request.remote(
-                        self._method, args, kwargs, self._model_id)
+                        self._method, args, kwargs, self._model_id,
+                        cancel_key, deadline_ts)
                     return DeploymentResponse(
-                        ref, lambda r=replica_id: self._router.done(r))
+                        ref, lambda r=replica_id: self._router.done(r),
+                        lambda r=replica_id, k=cancel_key:
+                            self._send_cancel(r, k))
                 except Exception as e:
                     last_err = e
                     self._router.done(replica_id)

@@ -131,8 +131,8 @@ class AsyncHTTPServer:
                     self._inflight += 1
                     self._inflight_zero.clear()
                     try:
-                        keep = await self._respond(writer, method, path,
-                                                   headers, body)
+                        keep = await self._respond(writer, reader, method,
+                                                   path, headers, body)
                     finally:
                         self._inflight -= 1
                         if self._inflight == 0:
@@ -185,7 +185,8 @@ class AsyncHTTPServer:
         body = await reader.readexactly(n) if n else b""
         return method, path, headers, body
 
-    async def _respond(self, writer: asyncio.StreamWriter, method: str,
+    async def _respond(self, writer: asyncio.StreamWriter,
+                       reader: asyncio.StreamReader, method: str,
                        path: str, headers: dict, body: bytes) -> bool:
         loop = asyncio.get_running_loop()
         _t_queued = time.perf_counter()
@@ -194,19 +195,24 @@ class AsyncHTTPServer:
             _observe_accept(time.perf_counter() - _t_queued)
             return self.handler(method, path, headers, body)
 
+        extra: dict | None = None
         try:
-            status, ctype, payload = await loop.run_in_executor(
-                self._executor, _run_handler)
+            result = await loop.run_in_executor(self._executor, _run_handler)
+            if len(result) == 4:  # optional extra headers (e.g. Retry-After)
+                status, ctype, payload, extra = result
+            else:
+                status, ctype, payload = result
         except Exception as e:  # noqa: BLE001 — the server must answer
             payload = json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}).encode()
             status, ctype = 500, "application/json"
         keep = (headers.get("connection", "").lower() != "close"
                 and not self._stopping)
+        extra_hdrs = "".join(f"{k}: {v}\r\n" for k, v in (extra or {}).items())
         if isinstance(payload, (bytes, bytearray)):
             writer.write(
                 f"HTTP/1.1 {status} X\r\nContent-Type: {ctype}\r\n"
-                f"Content-Length: {len(payload)}\r\n"
+                f"Content-Length: {len(payload)}\r\n{extra_hdrs}"
                 f"Connection: {'keep-alive' if keep else 'close'}\r\n"
                 f"\r\n".encode() + payload)
             await writer.drain()
@@ -254,9 +260,23 @@ class AsyncHTTPServer:
                                      "during teardown: %r", e)
 
         self._executor.submit(pump)
+        # half-closed-socket watch: an SSE client sends nothing after its
+        # request, so any readability — EOF or stray bytes — means it went
+        # away. Without this, a disconnect is only noticed at the next
+        # chunk WRITE, which for a slow/stalled stream may be never; the
+        # abort must interrupt the wait for the next item, not ride on it.
+        disconnect = asyncio.ensure_future(reader.read(1))
+        get_task: asyncio.Task | None = None
+        item = None
         try:
             while True:
-                item = await q.get()
+                get_task = asyncio.ensure_future(q.get())
+                await asyncio.wait({get_task, disconnect},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if not get_task.done():
+                    break  # client disconnected while the stream was quiet
+                item = get_task.result()
+                get_task = None
                 if item is DONE:
                     break
                 if isinstance(item, Exception):
@@ -267,10 +287,19 @@ class AsyncHTTPServer:
                     chunk = item if isinstance(item, (bytes, bytearray)) else str(item).encode()
                 writer.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
                 await writer.drain()
-            writer.write(b"0\r\n\r\n")
-            await writer.drain()
+                if disconnect.done():
+                    break  # write "succeeded" into a dead socket: stop
+            if item is DONE and not disconnect.done():
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
         finally:
+            # aborted unblocks the pump; closing its queue path makes the
+            # pump's finally close the deployment generator, which carries
+            # the cancel upstream (replica → engine slot/page reclaim)
             aborted.set()
+            disconnect.cancel()
+            if get_task is not None:
+                get_task.cancel()
         return False
 
     # ----------------------------------------------------------------- stop

@@ -12,13 +12,19 @@ ingress (serve/rpc_ingress.py) is the low-latency alternative path.)
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 
 import ray_tpu
+from ray_tpu._private.constants import HTTP_DEADLINE_HEADER
+from ray_tpu._private.ray_config import RayConfig
+from ray_tpu.exceptions import DeadlineExceededError, RequestShedError
 from ray_tpu.serve import request_context as rc
 from ray_tpu.serve.http_server import AsyncHTTPServer
 from ray_tpu.util import tracing
+
+logger = logging.getLogger(__name__)
 
 PROXY_NAME = "SERVE_PROXY"
 
@@ -58,16 +64,20 @@ class ProxyActor:
                "path": path, "method": method, "ts": time.time(),
                "sampled": rc.sample_request()}
         t_in = time.perf_counter()
+        deadline_ts = self._parse_deadline(headers)
         span = (tracing.begin_request_trace(rid, path=path, method=method)
                 if rec["sampled"] else None)
         if self._wants_stream(headers, body):
             try:
-                gen = self._dispatch_stream(path, method, body, rid, rec)
+                gen = self._dispatch_stream(path, method, body, rid, rec,
+                                            deadline_ts)
             except Exception as e:  # noqa: BLE001 — the proxy must answer
+                status, payload, extra = self._error_response(e)
                 tracing.finish_request_trace(span, ok=False)
-                rc.record_request(rec, t_in, status=500)
-                return 500, "application/json", json.dumps(
-                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                rc.record_request(rec, t_in, status=status)
+                if extra:
+                    return status, "application/json", payload, extra
+                return status, "application/json", payload
             # the stream outlives this dispatch thread: deactivate the
             # context here, close the root span (and record) when the
             # BODY completes so the root's duration covers the stream
@@ -83,22 +93,67 @@ class ProxyActor:
                     yield b"data: [DONE]\n\n"
                     ok = True
                 finally:
+                    if not ok:
+                        # abandoned mid-stream (client disconnect observed
+                        # by the HTTP server, or a write failure): tell the
+                        # replica — and through it the engine — to stop
+                        # producing, so the decode slot and KV pages free
+                        # in one step instead of at max_tokens
+                        cancel = getattr(gen, "cancel", None)
+                        if cancel is not None:
+                            try:
+                                cancel()
+                            except Exception as e:  # noqa: BLE001
+                                logger.debug("stream cancel failed: %r", e)
+                        rc.count_cancellation("proxy")
                     tracing.finish_request_trace(span, ok=ok)
                     rc.record_request(rec, t_in,
                                       status="stream" if ok else "aborted")
 
             return 200, "text/event-stream", sse()
         ok = True
+        extra = None
         try:
-            status, payload = self._dispatch(path, method, body, rid, rec)
+            status, payload = self._dispatch(path, method, body, rid, rec,
+                                             deadline_ts)
         except Exception as e:  # noqa: BLE001
             ok = False
-            status, payload = 500, json.dumps(
-                {"error": f"{type(e).__name__}: {e}"}).encode()
+            status, payload, extra = self._error_response(e)
         finally:
             tracing.finish_request_trace(span, ok=ok)
         rc.record_request(rec, t_in, status=status)
+        if extra:
+            return status, "application/json", payload, extra
         return status, "application/json", payload
+
+    @staticmethod
+    def _parse_deadline(headers: dict) -> float | None:
+        """`x-ray-tpu-deadline-s: <seconds of budget>` → absolute deadline.
+        The absolute form rides the request envelope so every hop (handle,
+        replica admission, engine decode loop) measures remaining budget
+        against its own clock without accumulating per-hop latency."""
+        raw = headers.get(HTTP_DEADLINE_HEADER)
+        if not raw:
+            return None
+        try:
+            budget = float(raw)
+        except ValueError:
+            return None  # malformed header: treat as no deadline
+        return time.time() + max(budget, 0.0)
+
+    @staticmethod
+    def _error_response(e: Exception) -> tuple[int, bytes, dict | None]:
+        """Map data-plane failures to HTTP: shed → 503 + Retry-After (the
+        client should back off, not retry immediately), deadline → 504,
+        anything else → 500."""
+        payload = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+        if isinstance(e, RequestShedError):
+            # the shedding component (router/replica) already counted it
+            return 503, payload, {
+                "Retry-After": f"{max(e.retry_after_s, 0.0):g}"}
+        if isinstance(e, DeadlineExceededError):
+            return 504, payload, None
+        return 500, payload, None
 
     @staticmethod
     def _wants_stream(headers: dict, body: bytes) -> bool:
@@ -174,7 +229,8 @@ class ProxyActor:
             return json.loads(body) if body else None
 
     def _dispatch(self, path: str, method: str, body: bytes,
-                  request_id: str, rec: dict) -> tuple[int, bytes]:
+                  request_id: str, rec: dict,
+                  deadline_ts: float | None = None) -> tuple[int, bytes]:
         body_obj = self._parse_body(body, rec)
         with rc.timed_phase(rc.PROXY_PHASE, "route", rec, span="proxy:route"):
             handle = self._resolve_handle(path)
@@ -184,13 +240,19 @@ class ProxyActor:
             "path": path, "method": method, "body": body_obj,
             "request_id": request_id,
         }
+        if deadline_ts:
+            request["deadline_ts"] = deadline_ts
         # replica-death failures retry on survivors, dropping the dead
-        # replica from the router between attempts (see handle.call_sync)
+        # replica from the router between attempts (see handle.call_sync);
+        # the timeout is the configured ceiling, clamped further by the
+        # request's own deadline inside call_sync
         with rc.timed_phase(rc.PROXY_PHASE, "handle", rec,
                             span="proxy:handle"):
             result = handle.call_sync(
-                request, timeout_s=60.0,
-                _routing_hint=self._routing_hint(request))
+                request,
+                timeout_s=RayConfig.instance().serve_request_timeout_s,
+                _routing_hint=self._routing_hint(request),
+                _deadline_ts=deadline_ts)
         return 200, json.dumps(result, default=str).encode()
 
     @staticmethod
@@ -232,7 +294,8 @@ class ProxyActor:
         return handle
 
     def _dispatch_stream(self, path: str, method: str, body: bytes,
-                         request_id: str, rec: dict):
+                         request_id: str, rec: dict,
+                         deadline_ts: float | None = None):
         body_obj = self._parse_body(body, rec)
         with rc.timed_phase(rc.PROXY_PHASE, "route", rec, span="proxy:route"):
             handle = self._resolve_handle(path)
@@ -242,8 +305,11 @@ class ProxyActor:
             "path": path, "method": method, "body": body_obj,
             "request_id": request_id,
         }
+        if deadline_ts:
+            request["deadline_ts"] = deadline_ts
         return handle.options(stream=True, method_name="stream_request").remote(
-            request, _routing_hint=self._routing_hint(request))
+            request, _routing_hint=self._routing_hint(request),
+            _deadline_ts=deadline_ts)
 
     def shutdown(self):
         self.server.stop(graceful=True)

@@ -26,12 +26,66 @@ from concurrent.futures import ThreadPoolExecutor
 
 import ray_tpu
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_tcp
+from ray_tpu.exceptions import (DeadlineExceededError, RequestCancelledError,
+                                RequestShedError)
 from ray_tpu.serve import request_context as _rc
 from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
 _replica_ctx = threading.local()
+
+
+class _CancelHolder:
+    """Per-request cancellation latch. The cancel RPC sets it (firing any
+    registered callbacks) and the in-request `on_cancel` hook registers
+    callbacks — in either order: registering after the cancel landed fires
+    the callback immediately, so the replica↔engine handoff has no
+    lost-cancel window."""
+
+    __slots__ = ("_lock", "_cbs", "cancelled")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cbs: list = []
+        self.cancelled = False
+
+    def register(self, cb) -> None:
+        with self._lock:
+            if not self.cancelled:
+                self._cbs.append(cb)
+                return
+        cb()  # cancel already landed: fire on the registrant's thread
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self.cancelled:
+                return
+            self.cancelled = True
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 — one bad callback must
+                # not stop the rest of the request's teardown
+                logger.warning("cancel callback raised: %r", e)
+
+
+def on_cancel(callback) -> None:
+    """Register a callback fired if THIS request is cancelled (client
+    disconnect, explicit `DeploymentResponse.cancel()`, timed-out caller).
+    Valid inside a replica handling a request — LLM servers use it to
+    route the cancel into `engine.abort_request` — and a no-op elsewhere.
+    If the cancel already landed, the callback fires immediately."""
+    holder = getattr(_replica_ctx, "cancel_holder", None)
+    if holder is not None:
+        holder.register(callback)
+
+
+def request_deadline() -> float | None:
+    """Absolute wall-clock deadline (epoch seconds) of the request being
+    handled, or None when the caller set none. Valid inside a replica."""
+    return getattr(_replica_ctx, "deadline_ts", None) or None
 
 
 def _node_ip() -> str:
@@ -60,7 +114,8 @@ class ReplicaActor:
     def __init__(self, deployment_name: str, replica_tag: str,
                  callable_blob: bytes, init_args_blob: bytes,
                  user_config: dict | None = None,
-                 max_ongoing_requests: int = 8):
+                 max_ongoing_requests: int = 8,
+                 max_queued_requests: int = -1):
         from ray_tpu._private import serialization as ser
 
         self.deployment_name = deployment_name
@@ -75,6 +130,15 @@ class ReplicaActor:
         self._pending = 0  # admission-queued (either plane), not yet running
         self._total = 0
         self._lock = threading.Lock()
+        # overload shedding: bound the admission queue; -1 = unbounded
+        # (reference: serve's max_queued_requests). Shed requests raise
+        # RequestShedError, which the HTTP proxy maps to 503 + Retry-After.
+        self._max_queued = int(max_queued_requests)
+        # cancellation plane: cancel_key -> latch for in-flight requests,
+        # plus tombstones for cancels that beat their request here (the
+        # cancel frame can overtake a queued data frame)
+        self._cancels: dict[str, _CancelHolder] = {}
+        self._cancelled_keys: dict[str, float] = {}
         # serve metrics on the cluster metrics plane (reference: serve
         # emits request count/latency per deployment into the metrics
         # agent; the Grafana serve dashboard targets these names)
@@ -197,6 +261,11 @@ class ReplicaActor:
         try:
             while not self._rpc_stop:
                 msg = conn.recv()
+                if "method" not in msg and "cancel_key" in msg:
+                    # control frame: cancel must jump the execution pool's
+                    # queue (the request it targets may be stuck in it)
+                    self.cancel_request(msg["cancel_key"])
+                    continue
                 self._rpc_pool.submit(self._rpc_execute, conn, msg)
         except (ConnectionClosed, OSError):
             pass
@@ -221,7 +290,9 @@ class ReplicaActor:
                     msg.get("trace_ctx"), kind="serve_rpc",
                     name=f"rpc:{self.deployment_name}.{msg['method']}"):
                 result = self.handle_request(
-                    msg["method"], args, kwargs, msg.get("model_id"))
+                    msg["method"], args, kwargs, msg.get("model_id"),
+                    cancel_key=msg.get("cancel_key"),
+                    deadline_ts=msg.get("deadline_ts"))
             reply = {"rid": rid, "ok": True, "error_text": None,
                      "result": result}
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
@@ -268,20 +339,120 @@ class ReplicaActor:
                            "reply (caller will time out): %r",
                            self.replica_tag, rid, e)
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       model_id: str | None = None):
-        # cross-plane admission: fast-RPC pool threads and actor-plane
-        # threads share one max_ongoing_requests budget
+    def _register_cancel(self, cancel_key: str | None) -> _CancelHolder:
+        holder = _CancelHolder()
+        if cancel_key:
+            fire = False
+            with self._lock:
+                if self._cancelled_keys.pop(cancel_key, None) is not None:
+                    fire = True  # the cancel frame beat this request here
+                else:
+                    self._cancels[cancel_key] = holder
+            if fire:
+                holder.cancel()
+        return holder
+
+    def _unregister_cancel(self, cancel_key: str | None) -> None:
+        if cancel_key:
+            with self._lock:
+                self._cancels.pop(cancel_key, None)
+
+    @ray_tpu.method(concurrency_group="control")
+    def cancel_request(self, cancel_key: str) -> bool:
+        """Best-effort cancel of an in-flight request by its cancel key:
+        fires the request's registered on_cancel callbacks (LLM servers
+        route these into engine.abort_request) and interrupts its admission
+        wait / stream loop. Unknown keys leave a tombstone so a cancel that
+        overtakes its queued request still lands. Runs on the 'control'
+        concurrency lane — a saturated replica must still take cancels."""
         with self._lock:
-            self._pending += 1
+            holder = self._cancels.get(cancel_key)
+            if holder is None:
+                now = time.monotonic()
+                self._cancelled_keys[cancel_key] = now
+                for k in [k for k, t in self._cancelled_keys.items()
+                          if now - t > 120.0]:
+                    del self._cancelled_keys[k]
+        if holder is None:
+            return False
+        holder.cancel()
+        _rc.count_cancellation("replica")
+        return True
+
+    def _enter(self, cancel_key: str | None, deadline_ts: float | None):
+        """Cross-plane admission shared by both request paths (fast-RPC
+        pool threads and actor-plane threads share one
+        max_ongoing_requests budget), with the PR's three refusals wired
+        in: shed when the admission queue is at max_queued_requests,
+        refuse once queue-wait spends the deadline budget, and interrupt
+        the wait when a cancel lands. Raises WITHOUT holding the admission
+        slot; on success the caller owns one slot (+ ongoing count) and
+        must release both. Returns (holder, wait_s, wall_start)."""
+        holder = self._register_cancel(cancel_key)
         t_q = time.perf_counter()
         w_q = time.time()
-        self._admission.acquire()
-        wait_s = time.perf_counter() - t_q
+        acquired = self._admission.acquire(blocking=False)
+        if not acquired:
+            with self._lock:
+                if 0 <= self._max_queued <= self._pending:
+                    shed = True
+                else:
+                    shed = False
+                    self._pending += 1
+            if shed:
+                self._unregister_cancel(cancel_key)
+                _rc.count_shed("replica")
+                raise RequestShedError(
+                    f"deployment {self.deployment_name} replica "
+                    f"{self.replica_tag}: admission queue full "
+                    f"({self._pending} waiting >= max_queued_requests="
+                    f"{self._max_queued})")
+            try:
+                if cancel_key is None and not deadline_ts:
+                    self._admission.acquire()
+                    acquired = True
+                while not acquired:
+                    if holder.cancelled:
+                        raise RequestCancelledError(
+                            f"request cancelled during queue wait on "
+                            f"{self.deployment_name}")
+                    remaining = _rc.deadline_remaining(deadline_ts)
+                    if remaining is not None and remaining <= 0:
+                        _rc.count_cancellation("replica")
+                        raise DeadlineExceededError(
+                            f"deadline expired after "
+                            f"{time.perf_counter() - t_q:.3f}s queue wait "
+                            f"on {self.deployment_name}")
+                    acquired = self._admission.acquire(
+                        timeout=0.02 if remaining is None
+                        else min(0.02, remaining))
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                if not acquired:
+                    self._unregister_cancel(cancel_key)
         with self._lock:
-            self._pending -= 1
             self._ongoing += 1
             self._total += 1
+        _replica_ctx.model_id = None
+        _replica_ctx.cancel_holder = holder
+        _replica_ctx.deadline_ts = deadline_ts
+        return holder, time.perf_counter() - t_q, w_q
+
+    def _exit(self, cancel_key: str | None) -> None:
+        _replica_ctx.model_id = None
+        _replica_ctx.cancel_holder = None
+        _replica_ctx.deadline_ts = None
+        self._unregister_cancel(cancel_key)
+        with self._lock:
+            self._ongoing -= 1
+        self._admission.release()
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       model_id: str | None = None,
+                       cancel_key: str | None = None,
+                       deadline_ts: float | None = None):
+        holder, wait_s, w_q = self._enter(cancel_key, deadline_ts)
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()
         ok = True
@@ -295,10 +466,7 @@ class ReplicaActor:
             ok = False
             raise
         finally:
-            _replica_ctx.model_id = None
-            with self._lock:
-                self._ongoing -= 1
-            self._admission.release()
+            self._exit(cancel_key)
             exec_s = time.perf_counter() - t0
             self._record_request(exec_s)
             self._record_phases(method, w_q, wait_s, exec_s, ok)
@@ -328,21 +496,17 @@ class ReplicaActor:
                          self.replica_tag, e)
 
     def handle_request_stream(self, method: str, args: tuple, kwargs: dict,
-                              model_id: str | None = None):
+                              model_id: str | None = None,
+                              cancel_key: str | None = None,
+                              deadline_ts: float | None = None):
         """Streaming variant: the user method is a generator; each yielded
         item ships incrementally via the runtime's streaming-generator task
         (reference: serve replicas stream generator chunks back — replica.py).
-        The admission slot is held for the stream's whole lifetime."""
-        with self._lock:
-            self._pending += 1
-        t_q = time.perf_counter()
-        w_q = time.time()
-        self._admission.acquire()
-        wait_s = time.perf_counter() - t_q
-        with self._lock:
-            self._pending -= 1
-            self._ongoing += 1
-            self._total += 1
+        The admission slot is held for the stream's whole lifetime. A
+        cancel landing mid-stream interrupts the loop between items and
+        closes the user generator (GeneratorExit runs its finally hooks —
+        the LLM servers abort their engine request there)."""
+        holder, wait_s, w_q = self._enter(cancel_key, deadline_ts)
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()
         ok = True
@@ -351,15 +515,27 @@ class ReplicaActor:
             if fn is None:
                 raise AttributeError(
                     f"deployment {self.deployment_name} has no method {method!r}")
-            yield from fn(*args, **kwargs)
+            gen = fn(*args, **kwargs)
+            try:
+                for item in gen:
+                    if holder.cancelled:
+                        raise RequestCancelledError(
+                            f"request cancelled mid-stream on "
+                            f"{self.deployment_name}")
+                    yield item
+            finally:
+                # explicit close on EVERY exit (cancel, consumer gone,
+                # error): the user generator's finally hooks release
+                # engine slots/KV pages now, not at GC. Plain iterables
+                # (a user method returning a list) have no close.
+                close = getattr(gen, "close", None)
+                if close is not None:
+                    close()
         except BaseException:
             ok = False
             raise
         finally:
-            _replica_ctx.model_id = None
-            with self._lock:
-                self._ongoing -= 1
-            self._admission.release()
+            self._exit(cancel_key)
             # latency here is the full stream duration — that IS the
             # request's occupancy of the replica
             exec_s = time.perf_counter() - t0

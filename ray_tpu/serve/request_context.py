@@ -161,6 +161,63 @@ def sample_request() -> bool:
     return next(_sample_counter) % every == 0
 
 
+# ------------------------------------------------- deadlines + admission
+
+
+def deadline_remaining(deadline_ts: float | None) -> float | None:
+    """Seconds of budget left before an absolute wall-clock deadline, or
+    None when no deadline is set. Non-positive means already expired —
+    callers refuse work they cannot finish (per-hop deadline refusal)."""
+    if not deadline_ts:
+        return None
+    return deadline_ts - time.time()
+
+
+def count_cancellation(stage: str) -> None:
+    """Count one request cancellation at the stage where it took effect
+    (`proxy` = client disconnect observed / deadline refusal at dispatch,
+    `handle` = timed-out caller's best-effort cancel, `replica` =
+    queue-wait interruption or deadline refusal at admission, `engine` =
+    mid-stream slot/page reclaim, `pd` = decode-tier transfer abort).
+    Stages attribute where cancels land, they do not dedupe one request.
+    Must never fail a request: metrics are best-effort."""
+    if not metrics_enabled():
+        return
+    try:
+        from ray_tpu.util import metrics as met
+
+        met.get_or_create(
+            met.Counter, "ray_tpu_serve_request_cancellations_total",
+            "serve requests cancelled (client disconnect, explicit "
+            "cancel(), timed-out caller, deadline expiry), by the stage "
+            "that applied the cancel",
+            tag_keys=("stage",)).inc(tags={"stage": stage})
+    except Exception as e:  # pragma: no cover - metrics must not fail requests
+        import logging
+
+        logging.getLogger(__name__).debug("cancel metric failed: %r", e)
+
+
+def count_shed(component: str) -> None:
+    """Count one request refused by admission control (`router` =
+    client-side in-flight window saturated, `replica` = admission queue at
+    max_queued_requests). Best-effort, never fails the shed path."""
+    if not metrics_enabled():
+        return
+    try:
+        from ray_tpu.util import metrics as met
+
+        met.get_or_create(
+            met.Counter, "ray_tpu_serve_requests_shed_total",
+            "serve requests shed by admission control instead of queued "
+            "(surfaced to HTTP clients as 503 + Retry-After)",
+            tag_keys=("component",)).inc(tags={"component": component})
+    except Exception as e:  # pragma: no cover - metrics must not fail requests
+        import logging
+
+        logging.getLogger(__name__).debug("shed metric failed: %r", e)
+
+
 # --------------------------------------------------------- flight recorder
 
 

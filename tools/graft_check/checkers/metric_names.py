@@ -84,6 +84,12 @@ EXPECTED_METRICS = (
     "ray_tpu_collective_bytes_total",
     "ray_tpu_collective_seconds",
     "ray_tpu_train_opt_state_bytes",
+    # request cancellation + overload shedding (serve/request_context.py):
+    # cancels by the stage that applied them (proxy/handle/replica/engine/
+    # pd) and requests refused by admission control (router window /
+    # replica queue bound) instead of queued
+    "ray_tpu_serve_request_cancellations_total",
+    "ray_tpu_serve_requests_shed_total",
 )
 
 
