@@ -84,6 +84,36 @@ MESH_AXES = (MESH_AXIS_DP, MESH_AXIS_FSDP, MESH_AXIS_EP, MESH_AXIS_PP,
 #: canonical exported-metric namespace (tools/graft_check metric-name check).
 METRIC_NAME_PREFIX = "ray_tpu_"
 
+# ------------------------------------------------------------- node drain
+
+#: GCS RPC type that marks a node DRAINING (scheduler stops placing there,
+#: resident workers get a `drain_notice` push, the autoscaler
+#: drains-then-terminates). Documented here as protocol; RPC call sites and
+#: the gcs.py dispatch arm spell the literal so the rpc-pairing /
+#: rpc-field-schema checkers can pair them lexically.
+NODE_DRAIN_RPC = "node_drain"
+
+#: unsolicited GCS→worker/agent push announcing the worker's node is
+#: draining; CoreWorker._recv_loop records it and train sessions read it as
+#: the "save a preemption-grace checkpoint now" flag.
+DRAIN_NOTICE_PUSH = "drain_notice"
+
+#: node lifecycle state names surfaced by list_nodes / cluster_state and by
+#: the autoscaler instance state machine's DRAINING state — one vocabulary
+#: across the GCS node table and the instance table.
+NODE_STATE_ALIVE = "ALIVE"
+NODE_STATE_DRAINING = "DRAINING"
+NODE_STATE_DEAD = "DEAD"
+
+#: TrainWorker.poll() payload keys for cooperative-stop acknowledgement and
+#: per-step progress heartbeats: producer (train/worker_group.py) and
+#: consumer (train/controller.py hang watchdog) live in different
+#: processes, so the keys are wire protocol. Progress rides as an AGE
+#: (seconds since the rank's last session.report), not a timestamp —
+#: controller and worker clocks need not agree.
+TRAIN_POLL_STOP_OBSERVED = "stop_observed"
+TRAIN_POLL_PROGRESS_AGE = "progress_age_s"
+
 # ---------------------------------------------------------------- deadlines
 
 #: HTTP request header carrying the per-request deadline budget in seconds

@@ -42,6 +42,9 @@ INLINE_LIMIT = RayConfig.get("inline_object_limit")  # results below this live i
 
 DEFAULT_NODE = "node-0"
 HEAD_HOST = "host-0"
+# node-drain records persist in the kv table under this prefix; a restarted
+# GCS (or a node re-registering) re-applies them — a drain survives both
+_DRAIN_KV_PREFIX = "__node_drain::"
 MAX_RECONSTRUCTIONS = 3
 MAX_LINEAGE = RayConfig.get("max_lineage")
 # chip spawns can block minutes in TPU plugin init; plain spawns are fast
@@ -148,7 +151,8 @@ class _VNode:
     SURVEY.md §4.2.)"""
 
     __slots__ = ("node_id", "total", "available", "labels", "alive",
-                 "chip_pool", "quarantined_chips")
+                 "chip_pool", "quarantined_chips", "draining", "drain_reason",
+                 "drain_since")
 
     def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
         self.node_id = node_id
@@ -158,6 +162,12 @@ class _VNode:
         self.available = dict(self.total)
         self.labels = dict(labels or {})
         self.alive = True
+        # DRAINING: alive (running work continues + releases normally) but
+        # excluded from every placement decision; one-way until node death
+        # (reference: the reference GCS's DrainNode state, SURVEY §3.4)
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_since: float | None = None
         # unbound TPU chip ids; chips leave the pool when a worker is spawned
         # with them visible and return when that worker dies (reference:
         # TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36)
@@ -399,6 +409,17 @@ class GcsServer:
         self._rpc_bound: dict[str, object] = {}
         self._rpc_other = self._rpc_hist.bind({"rpc": "other"})
         self._rpc_bound_lock = threading.Lock()
+        # nodes currently DRAINING — same unregistered pattern as _rpc_hist
+        # (the value is computed from self.nodes at snapshot time; the Gauge
+        # object exists so the metric is declared head-side, not shipped
+        # twice by a co-resident driver flusher)
+        from ray_tpu.util.metrics import Gauge
+
+        self._draining_gauge = Gauge(
+            "ray_tpu_nodes_draining",
+            "nodes in DRAINING state: no new placements; resident train "
+            "workers grace-checkpoint before the node is terminated",
+            register=False)
         # retained metric TIME SERIES, head-side (reference: the dashboard's
         # metrics stack — per-node agents scraped into Prometheus,
         # dashboard/modules/metrics/metrics_head.py; here the GCS keeps a
@@ -460,9 +481,13 @@ class GcsServer:
 
     @property
     def available(self) -> dict:
+        # draining nodes are excluded: their capacity is unschedulable, so
+        # counting it would make elastic restarts size attempts against
+        # nodes that are about to terminate (and would hide the unmet
+        # demand the autoscaler should replace)
         out: dict[str, int] = {}
         for n in self.nodes.values():
-            if n.alive:
+            if n.alive and not n.draining:
                 for k, v in n.available.items():
                     out[k] = out.get(k, 0) + v
         return fp.float_dict(out)
@@ -922,6 +947,7 @@ class GcsServer:
                 self.node_hosts[node_id] = host_id
                 self.nodes[node_id] = _VNode(
                     node_id, msg["resources"], msg.get("labels"))
+                self._reapply_drain_locked(self.nodes[node_id])
             conn.send({"rid": msg["rid"], "ok": True,
                        "session_id": self.session_id})
             self._schedule()
@@ -1345,15 +1371,59 @@ class GcsServer:
             with self.lock:
                 node_id = msg["node_id"]
                 self.nodes[node_id] = _VNode(node_id, msg["resources"], msg.get("labels"))
+                self._reapply_drain_locked(self.nodes[node_id])
             conn.send({"rid": msg["rid"], "ok": True})
             self._schedule()
         elif t == "remove_node":
             self._remove_node(msg["node_id"])
             conn.send({"rid": msg["rid"], "ok": True})
+        elif t == "node_drain":
+            node_id = msg["node_id"]
+            grace = msg.get("grace_s")
+            reason = msg.get("reason") or ""
+            ok, err = True, None
+            notify: list = []
+            with self.lock:
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    ok, err = False, f"unknown or dead node {node_id!r}"
+                else:
+                    record = {"node_id": node_id, "reason": reason,
+                              "grace_s": grace, "ts": time.time()}
+                    # persist BEFORE any side effect (state flip, worker
+                    # notices): a GCS restart re-applies the drain instead
+                    # of resurrecting the node as placeable
+                    if self.storage is not None:
+                        self.storage.put("kv", _DRAIN_KV_PREFIX + node_id,
+                                         record)
+                    self.kv[_DRAIN_KV_PREFIX + node_id] = record
+                    if not node.draining:
+                        node.draining = True
+                        node.drain_reason = reason
+                        node.drain_since = time.time()
+                    # fan the notice out to every resident worker (and the
+                    # node's host agent) so train sessions can land a
+                    # preemption-grace checkpoint inside the window
+                    for w in self.workers.values():
+                        if w.node_id == node_id and not w.dead:
+                            notify.append(w.conn)
+                    host_id = self.node_hosts.get(node_id)
+                    info = self.hosts.get(host_id) if host_id else None
+                    if info is not None and info.get("conn") is not None:
+                        notify.append(info["conn"])
+            push = {"type": "drain_notice", "node_id": node_id,
+                    "grace_s": grace, "reason": reason}
+            for c in notify:
+                try:
+                    c.send(push)
+                except ConnectionClosed:
+                    pass
+            conn.send({"rid": msg["rid"], "ok": ok, "error": err})
         elif t == "list_nodes":
             with self.lock:
                 nodes = [
-                    {"node_id": n.node_id, "alive": n.alive, "labels": dict(n.labels),
+                    {"node_id": n.node_id, "alive": n.alive,
+                     "draining": n.draining, "labels": dict(n.labels),
                      "total": fp.float_dict(n.total),
                      "available": fp.float_dict(n.available),
                      "quarantined_chips": list(n.quarantined_chips),
@@ -1442,7 +1512,8 @@ class GcsServer:
                         for a in self.actors.values()
                     },
                     "nodes": {
-                        n.node_id: {"alive": n.alive, "labels": dict(n.labels),
+                        n.node_id: {"alive": n.alive, "draining": n.draining,
+                                    "labels": dict(n.labels),
                                     "total": fp.float_dict(n.total),
                                     "available": fp.float_dict(n.available)}
                         for n in self.nodes.values()
@@ -1658,6 +1729,13 @@ class GcsServer:
                     "series": {"gcs": [[[], float(sum(
                         1 for w in self.workers.values()
                         if w.kind == "worker" and not w.dead))]]}}
+                self._draining_gauge.set(float(sum(
+                    1 for n in self.nodes.values()
+                    if n.alive and n.draining)))
+                snap["ray_tpu_nodes_draining"] = {
+                    "kind": "gauge",
+                    "description": self._draining_gauge.description,
+                    "series": {"gcs": self._draining_gauge._snapshot_series()}}
                 snap["ray_tpu_node_mem_usage"] = {
                     "kind": "gauge",
                     "description": "host memory usage fraction per node",
@@ -2286,11 +2364,13 @@ class GcsServer:
         if strat and strat.get("kind") == "node_label":
             hard = strat.get("hard", {})
             cands = [n for n in self.nodes.values() if n.alive
+                     and not n.draining
                      and all(n.labels.get(k) == v for k, v in hard.items())]
             return pg_policy.pick_node_hybrid(cands, res, self.local_node_id)
         if strat and strat.get("kind") == "node_affinity":
             n = self.nodes.get(strat["node_id"])
-            if n is not None and n.alive and pg_policy._fits(n.available, res):
+            if (n is not None and n.alive and not n.draining
+                    and pg_policy._fits(n.available, res)):
                 return n.node_id
             if strat.get("soft"):
                 return pg_policy.pick_node_hybrid(list(self.nodes.values()), res, self.local_node_id)
@@ -2370,7 +2450,7 @@ class GcsServer:
                     if len(grants) >= count:
                         break
                     node = self.nodes.get(w.node_id)
-                    if node is None or not node.alive:
+                    if node is None or not node.alive or node.draining:
                         continue
                     if not pg_policy._fits(node.available, res_fp):
                         continue
@@ -2418,7 +2498,8 @@ class GcsServer:
                 if need == 0:
                     assignments.append(None)
                     continue
-                if node is None or not node.alive or len(node.chip_pool) < need:
+                if (node is None or not node.alive or node.draining
+                        or len(node.chip_pool) < need):
                     break
                 chips = tuple(node.chip_pool[:need])
                 del node.chip_pool[:need]
@@ -3565,6 +3646,16 @@ class GcsServer:
             self.host_shm_bytes.pop(host_id, None)
         for node_id in doomed_nodes:
             self._remove_node(node_id)
+
+    def _reapply_drain_locked(self, node: "_VNode") -> None:
+        """Restore a persisted drain onto a (re)registering node: a drain
+        record in kv means the node was marked DRAINING before a GCS
+        restart / reconnect — it must come back unplaceable."""
+        rec = self.kv.get(_DRAIN_KV_PREFIX + node.node_id)
+        if rec:
+            node.draining = True
+            node.drain_reason = rec.get("reason") or ""
+            node.drain_since = rec.get("ts")
 
     def _remove_node(self, node_id: str):
         """Mark a virtual node dead: its workers die, its PG bundles unplace."""

@@ -227,8 +227,35 @@ class NodeAgent:
                 self.conn.send({"type": "pong", "host_id": self.host_id})
             except ConnectionClosed:
                 pass
+        elif t == "drain_notice":
+            # the GCS already fanned the notice out to resident workers
+            # (they connect to it directly); the agent just logs it and
+            # keeps serving through the grace window
+            print(f"node agent {self.host_id}: node {msg.get('node_id')} "
+                  f"draining ({msg.get('reason')}), grace "
+                  f"{msg.get('grace_s')}s", flush=True)
         elif t == "exit":
             raise ConnectionClosed()
+
+    def self_drain(self, reason: str) -> None:
+        """Ask the GCS to drain this host's node (SIGTERM / preemption
+        notice path). Runs on a dedicated connection so it cannot interleave
+        with the main dispatch socket's request/reply traffic."""
+        from ray_tpu._private.ray_config import RayConfig
+
+        try:
+            conn = connect_address(self.gcs_address)
+            conn.send({"type": "node_drain", "rid": 1,
+                       "node_id": self.host_id,
+                       "grace_s": RayConfig.get("drain_grace_s"),
+                       "reason": reason})
+            reply = conn.recv()
+            print(f"node agent {self.host_id}: self-drain ({reason}) → "
+                  f"{reply}", flush=True)
+            conn.close()
+        except (ConnectionClosed, OSError) as e:
+            print(f"node agent {self.host_id}: self-drain failed: {e}",
+                  flush=True)
 
     def _spawn_workers(self, assignments: list, node_id: str,
                        runtime_env: dict | None = None):
@@ -300,6 +327,18 @@ def main(argv=None):
     args = p.parse_args(argv)
     agent = NodeAgent(address=args.address, host_id=args.host_id,
                       num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+
+    # GCE preemption delivers SIGTERM ahead of the instance kill: turn it
+    # into a node drain so resident train workers grace-checkpoint. The
+    # agent keeps serving; actual termination is the provider's (or the
+    # autoscaler's) job after the grace window.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        threading.Thread(target=agent.self_drain, args=("SIGTERM",),
+                         daemon=True, name="agent-self-drain").start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     print(f"node agent {agent.host_id} joined {args.address} "
           f"(objects at {agent.obj_server.address})", flush=True)
     agent.serve_forever()

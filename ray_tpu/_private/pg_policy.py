@@ -52,7 +52,9 @@ def place_bundles(nodes: list, bundles: list[dict], strategy: str) -> list[str] 
     """Return [node_id per bundle] or None. Does not mutate node state."""
     if strategy not in STRATEGIES:
         raise ValueError(f"unknown placement strategy {strategy!r}")
-    alive = [n for n in nodes if n.alive]
+    # DRAINING nodes are alive but scheduled around: running work drains
+    # off; nothing new lands (reference: GCS DrainNode semantics)
+    alive = [n for n in nodes if n.alive and not getattr(n, "draining", False)]
     if not alive:
         return None
 
@@ -150,7 +152,7 @@ def pick_node_hybrid(nodes: list, res: dict, local_node_id: str | None,
         from ray_tpu._private.ray_config import RayConfig
 
         threshold = RayConfig.instance().hybrid_threshold
-    alive = [n for n in nodes if n.alive]
+    alive = [n for n in nodes if n.alive and not getattr(n, "draining", False)]
     ordered = sorted(alive, key=lambda n: (n.node_id != local_node_id, n.node_id))
     for n in ordered:
         if _utilization(n) < threshold and _fits(n.available, res):

@@ -94,6 +94,26 @@ class RayConfig:
     # dashboard.
     resource_view_interval_s: float = 2.0
 
+    # --- collectives / fault detection ----------------------------------
+    # While blocked in a host-plane collective wait, poll the liveness of
+    # peer ranks' actors (via the GCS actor_info RPC) this often, so a dead
+    # rank surfaces as CollectiveError within ~this interval instead of as
+    # a TimeoutError after the full op timeout. 0 disables the in-wait
+    # polling (a timeout still triggers one final liveness sweep).
+    collective_liveness_interval_s: float = 2.0
+    # How long init_collective_group waits for the rendezvous actor to
+    # appear AND for all ranks to register before failing with an error
+    # naming the missing ranks (previously a hardcoded 60.0).
+    collective_group_create_timeout_s: float = 60.0
+
+    # --- node drain / preemption ----------------------------------------
+    # Grace window between a node being marked DRAINING and its
+    # termination: how long resident train workers get to land a
+    # preemption-grace checkpoint. Used by the node agent's SIGTERM
+    # self-drain (the GCE preemption notice path) and as the autoscaler's
+    # default drain-then-terminate window.
+    drain_grace_s: float = 20.0
+
     # --- worker pool ----------------------------------------------------
     # Warm-pool floor: keep this many idle no-runtime-env CPU workers per
     # node, replenished asynchronously as they are consumed by dispatch or

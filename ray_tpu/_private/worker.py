@@ -609,6 +609,12 @@ class CoreWorker:
                             self._direct.revoke(msg["wid"])
                         except Exception:
                             pass
+                elif msg.get("type") == "drain_notice":
+                    # this worker's node is DRAINING (preemption notice /
+                    # scale-down): record it process-wide so train sessions
+                    # observe the "save a grace checkpoint now" flag at the
+                    # next step boundary
+                    _set_drain(msg)
         except ConnectionClosed:
             if self.kind == "driver" and not self._disconnecting:
                 # drivers outlive a GCS restart: retry connect + re-register
@@ -1970,6 +1976,33 @@ class CoreWorker:
 
 
 _global_worker: CoreWorker | None = None
+
+# Process-wide drain state, set by the GCS `drain_notice` push when this
+# worker's node enters DRAINING (preemption notice, autoscaler scale-down,
+# `ray_tpu drain`). Train sessions poll drain_info() at step boundaries to
+# trigger the preemption-grace checkpoint.
+_drain_event = threading.Event()
+_drain_info: dict | None = None
+
+
+def _set_drain(msg: dict) -> None:
+    global _drain_info
+    if _drain_info is None:
+        _drain_info = {"node_id": msg.get("node_id"),
+                       "reason": msg.get("reason"),
+                       "grace_s": msg.get("grace_s"),
+                       "ts": time.time()}
+    _drain_event.set()
+
+
+def drain_info() -> dict | None:
+    """The drain notice this process received, or None. Sticky for the
+    process lifetime: a draining node never un-drains."""
+    return _drain_info
+
+
+def drain_requested() -> bool:
+    return _drain_event.is_set()
 
 
 def get_global_worker() -> CoreWorker:

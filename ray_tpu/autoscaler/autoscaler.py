@@ -62,11 +62,19 @@ class Autoscaler:
     def __init__(self, gcs_address: str, provider: NodeProvider,
                  node_types: List[NodeType], *, interval_s: float = 2.0,
                  idle_timeout_s: float = 60.0,
-                 node_startup_grace_s: float = 60.0):
+                 node_startup_grace_s: float = 60.0,
+                 drain_grace_s: Optional[float] = None):
+        from ray_tpu._private.ray_config import RayConfig
+
         self.provider = provider
         self.node_types = {nt.name: nt for nt in node_types}
         self.interval_s = interval_s
         self.idle_timeout_s = idle_timeout_s
+        # scale-down is drain-then-terminate: the node_drain RPC stops new
+        # placements and lets resident train workers grace-checkpoint, and
+        # the provider terminate waits out this window (0 = same pass)
+        self.drain_grace_s = (RayConfig.get("drain_grace_s")
+                              if drain_grace_s is None else float(drain_grace_s))
         # launched nodes get this long to join before their capacity stops
         # counting as pending (reference: the resource demand scheduler
         # subtracts launching nodes from unmet demand so each reconcile
@@ -172,7 +180,7 @@ class Autoscaler:
 
     def _reconcile_once(self) -> dict:
         actions = {"launched": [], "terminated": [], "adopted": [],
-                   "reaped": [], "swept": []}
+                   "reaped": [], "swept": [], "drained": []}
         if not self._recovered:
             self._recover(actions)
             self._recovered = True
@@ -299,14 +307,26 @@ class Autoscaler:
             if nid is not None:
                 actions["launched"].append((nt.name, nid))
 
-        # 4. terminate idle above-min nodes (no demand and nothing running
-        #    on them — approximated by zero unmet demand + full availability)
+        # 4. drain-then-terminate idle above-min nodes (no demand and nothing
+        #    running on them — approximated by zero unmet demand + full
+        #    availability). Idle past the timeout → DRAINING (the GCS stops
+        #    placing there; resident train workers grace-checkpoint) →
+        #    terminate once the drain window elapses.
         if not unmet and not demand["pg_demands"]:
             live_insts = self._im.instances(*im.LIVE_STATES)
             alive_counts = self._im.counts(states=im.LIVE_STATES)
             for inst in live_insts:
                 nt = self.node_types.get(inst.node_type)
                 if nt is None:
+                    continue
+                if inst.state == im.DRAINING:
+                    # drain is one-way — even below the min floor the node
+                    # is already unplaceable, so terminate on schedule and
+                    # let the min-floor step relaunch a fresh one
+                    if now >= inst.drain_deadline:
+                        if self._terminate_instance(inst, actions):
+                            alive_counts[inst.node_type] = (
+                                alive_counts.get(inst.node_type, 1) - 1)
                     continue
                 if alive_counts.get(inst.node_type, 0) <= nt.min_nodes:
                     if inst.state == im.IDLE_TRACKED:
@@ -324,12 +344,20 @@ class Autoscaler:
                     inst = self._im.transition(inst, im.IDLE_TRACKED,
                                                idle_since=now)
                 if now - (inst.idle_since or now) >= self.idle_timeout_s:
-                    if self._terminate_instance(inst, actions):
-                        alive_counts[inst.node_type] = (
-                            alive_counts.get(inst.node_type, 1) - 1)
+                    inst = self._drain_instance(inst, now, actions)
+                    if inst.state == im.DRAINING and now >= inst.drain_deadline:
+                        # grace 0: terminate in the same pass
+                        if self._terminate_instance(inst, actions):
+                            alive_counts[inst.node_type] = (
+                                alive_counts.get(inst.node_type, 1) - 1)
         else:
             for inst in self._im.instances(im.IDLE_TRACKED):
                 self._im.transition(inst, im.RUNNING, idle_since=None)
+            # demand cannot un-drain a node (the GCS-side flag is sticky):
+            # holding a DRAINING node would just strand unusable capacity
+            for inst in self._im.instances(im.DRAINING):
+                if now >= inst.drain_deadline:
+                    self._terminate_instance(inst, actions)
 
         actions["launch_failures"] = {
             f.node_type: f.error
@@ -422,6 +450,32 @@ class Autoscaler:
                 self._im.transition(f, im.TERMINATED)
         logger.info("autoscaler: launched %s node %s", nt.name, nid)
         return nid
+
+    def _drain_instance(self, inst: im.Instance, now: float,
+                        actions: dict) -> im.Instance:
+        """Begin drain-then-terminate: DRAINING (with its deadline) is
+        durable BEFORE the node_drain RPC flips GCS state — a crash in
+        between re-enters here with the flag already persisted, and the
+        (idempotent) drain is simply re-issued by the sticky GCS record."""
+        if inst.state == im.DRAINING:
+            return inst
+        inst = self._im.transition(inst, im.DRAINING,
+                                   drain_deadline=now + self.drain_grace_s)
+        try:
+            reply = self._rpc({"type": "node_drain", "node_id": inst.node_id,
+                               "grace_s": self.drain_grace_s,
+                               "reason": "autoscaler scale-down"})
+            if not reply.get("ok"):
+                # provider-known but never joined the GCS: nothing to notify
+                logger.debug("node_drain for %s declined: %s", inst.node_id,
+                             reply.get("error"))
+        except ConnectionClosed:
+            logger.warning("node_drain RPC failed for %s (GCS gone); "
+                           "terminating on schedule anyway", inst.node_id)
+        actions["drained"].append((inst.node_type, inst.node_id))
+        logger.info("autoscaler: draining %s node %s (grace %.0fs)",
+                    inst.node_type, inst.node_id, self.drain_grace_s)
+        return inst
 
     def _terminate_instance(self, inst: im.Instance, actions: dict) -> bool:
         """TERMINATING is durable before the cloud call: a crash in between

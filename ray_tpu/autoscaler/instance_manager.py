@@ -8,17 +8,22 @@ so a restarted reconciler rebuilds from the table instead of from memory).
 
 States:
 
-    REQUESTED ──→ ALLOCATED ──→ RUNNING ──→ IDLE_TRACKED ──→ TERMINATING
-        │             │            ↑ ↓            │               │
-        │             └────────────┼─┴────────────┘               ↓
-        ↓                          │                          TERMINATED
-    ALLOCATION_FAILED ─────────────┴──(cooldown expires)──→  (record gone)
+    REQUESTED ──→ ALLOCATED ──→ RUNNING ──→ IDLE_TRACKED ──→ DRAINING ──→ TERMINATING
+        │             │            ↑ ↓            │               │            │
+        │             └────────────┼─┴────────────┘               │            ↓
+        ↓                          │                              │        TERMINATED
+    ALLOCATION_FAILED ─────────────┴──(cooldown expires)──────────┴─→    (record gone)
 
 - REQUESTED        — persisted BEFORE the provider create call, so a crash
                      mid-launch leaves a record the recovery sweep resolves.
 - ALLOCATED        — the provider returned a node id; persisted with it.
 - RUNNING          — the node registered with the GCS (joined the cluster).
 - IDLE_TRACKED     — no demand; the persisted idle clock is running.
+- DRAINING         — the node_drain RPC was issued (persisted FIRST): the
+                     GCS schedules around the node and resident train
+                     workers grace-checkpoint; termination waits for
+                     drain_deadline. One-way: a drained node never returns
+                     to service.
 - TERMINATING      — persisted BEFORE the provider terminate call; a crash
                      between persist and cloud call re-issues the (idempotent)
                      terminate on restart.
@@ -47,23 +52,28 @@ REQUESTED = "REQUESTED"
 ALLOCATED = "ALLOCATED"
 RUNNING = "RUNNING"
 IDLE_TRACKED = "IDLE_TRACKED"
+DRAINING = "DRAINING"
 TERMINATING = "TERMINATING"
 TERMINATED = "TERMINATED"
 ALLOCATION_FAILED = "ALLOCATION_FAILED"
 
 #: states in which the instance has (or should have) a live provider node
-LIVE_STATES = (ALLOCATED, RUNNING, IDLE_TRACKED)
+LIVE_STATES = (ALLOCATED, RUNNING, IDLE_TRACKED, DRAINING)
 #: states that count toward a node type's min/max capacity. TERMINATING is
 #: included: its provider node is still alive until the terminate succeeds,
 #: so releasing the slot early would let a cloud-API outage (terminate
 #: failing every pass) push provider reality past max_nodes.
-COUNTED_STATES = (REQUESTED, ALLOCATED, RUNNING, IDLE_TRACKED, TERMINATING)
+COUNTED_STATES = (REQUESTED, ALLOCATED, RUNNING, IDLE_TRACKED, DRAINING,
+                  TERMINATING)
 
 _TRANSITIONS: Dict[str, frozenset] = {
     REQUESTED: frozenset({ALLOCATED, ALLOCATION_FAILED, TERMINATED}),
     ALLOCATED: frozenset({RUNNING, IDLE_TRACKED, TERMINATING, TERMINATED}),
-    RUNNING: frozenset({IDLE_TRACKED, TERMINATING, TERMINATED}),
-    IDLE_TRACKED: frozenset({RUNNING, TERMINATING, TERMINATED}),
+    RUNNING: frozenset({IDLE_TRACKED, DRAINING, TERMINATING, TERMINATED}),
+    IDLE_TRACKED: frozenset({RUNNING, DRAINING, TERMINATING, TERMINATED}),
+    # one-way: a draining node only ever terminates (no return to RUNNING —
+    # the GCS-side drain flag is sticky, so the node can't take new work)
+    DRAINING: frozenset({TERMINATING, TERMINATED}),
     TERMINATING: frozenset({TERMINATED}),
     ALLOCATION_FAILED: frozenset({TERMINATED}),
     TERMINATED: frozenset(),
@@ -109,6 +119,7 @@ class Instance:
     node_id: Optional[str] = None       # provider node id, None until ALLOCATED
     launch_time: float = 0.0            # when the provider node was created
     idle_since: Optional[float] = None  # IDLE_TRACKED clock start
+    drain_deadline: float = 0.0         # DRAINING: terminate at/after this
     cooldown_until: float = 0.0         # ALLOCATION_FAILED: suppress until
     error: str = ""                     # ALLOCATION_FAILED: provider error
     provider_data: dict = field(default_factory=dict)  # for adopt_node()

@@ -74,6 +74,36 @@ class DeadlineExceededError(RayTpuError, TimeoutError):
     decode steps — work the client will never see is never started."""
 
 
+class CollectiveError(RayTpuError):
+    """A host-plane collective failed because a peer rank is dead (or the
+    group was already aborted by another rank's detection).
+
+    Raised by util/collective ops well before the op's data timeout: every
+    blocking wait polls peer-actor liveness alongside its data probe, so a
+    SIGKILLed rank surfaces on all survivors within the configured
+    detection interval instead of as an opaque TimeoutError. Carries the
+    group, op seq, and the dead/suspect ranks so the train controller can
+    log the failure precisely before the elastic restart."""
+
+    def __init__(self, msg: str, *, group: str = "", seq: int | None = None,
+                 dead_ranks: tuple = (), kind: str = "peer_death"):
+        self.group = group
+        self.seq = seq
+        self.dead_ranks = tuple(dead_ranks)
+        self.kind = kind
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (_rebuild_collective_error,
+                (self.args[0], self.group, self.seq, self.dead_ranks,
+                 self.kind))
+
+
+def _rebuild_collective_error(msg, group, seq, dead_ranks, kind):
+    return CollectiveError(msg, group=group, seq=seq, dead_ranks=dead_ranks,
+                           kind=kind)
+
+
 class RequestShedError(RayTpuError):
     """Admission control refused the request instead of queueing it.
 

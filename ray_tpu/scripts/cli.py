@@ -269,6 +269,30 @@ def cmd_unquarantine(args):
         c.close()
 
 
+def cmd_drain(args):
+    """Mark a node DRAINING ahead of planned maintenance or a known
+    preemption: the scheduler stops placing work there, resident train
+    workers get the drain notice (grace checkpoint at the next step
+    boundary), and an attached autoscaler terminates the node after the
+    grace window."""
+    from ray_tpu._private.ray_config import RayConfig
+
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        grace = (RayConfig.get("drain_grace_s") if args.grace is None
+                 else float(args.grace))
+        reply = c.rpc({"type": "node_drain", "node_id": args.node_id,
+                       "grace_s": grace, "reason": args.reason})
+        if reply.get("ok"):
+            print(f"node {args.node_id} draining (grace {grace}s)")
+        else:
+            print(f"drain failed: {reply.get('error')}", file=sys.stderr)
+            sys.exit(1)
+    finally:
+        c.close()
+
+
 def cmd_monitor(args):
     from ray_tpu._private import monitor
 
@@ -600,6 +624,16 @@ def main(argv=None):
     sp.add_argument("--node", help="node id (default: the head's local node)")
     sp.add_argument("--chips", help="comma-separated chip ids (default: all)")
     sp.set_defaults(fn=cmd_unquarantine)
+
+    sp = sub.add_parser("drain",
+                        help="drain a node: stop scheduling there, notify "
+                             "resident train workers, then terminate")
+    sp.add_argument("node_id", help="node id (see `list --what nodes`)")
+    sp.add_argument("--grace", type=float, default=None,
+                    help="grace window seconds (default: drain_grace_s)")
+    sp.add_argument("--reason", default="cli",
+                    help="recorded with the drain (default: cli)")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("monitor",
                         help="run the autoscaler monitor process "

@@ -53,6 +53,13 @@ class FailureConfig:
     worker-group errors are retried `max_failures` times, -1 = infinite.)"""
 
     max_failures: int = 0
+    # hang watchdog: kill + elastically restart the attempt when a running
+    # rank makes no step progress (no session.report) for this long while
+    # not cooperatively stopping — a wedged collective or deadlocked input
+    # pipeline otherwise stalls the run forever. None disables. Restarts
+    # triggered by the watchdog DO consume the max_failures budget (a hang
+    # is a failure; a node drain is not).
+    hang_timeout_s: float | None = None
 
 
 @dataclass

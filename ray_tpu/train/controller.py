@@ -38,6 +38,8 @@ class TrainController:
         self.ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
         self.failures = 0
         self.latest_metrics: dict = {}
+        # one entry per attempt: outcome + reason (hang/preemption forensics)
+        self.attempt_log: list[dict] = []
         # sessions reset their cumulative retry counters on restart, so the
         # run total = sum of completed attempts + the live attempt's high-water
         self._retries_prev_attempts = 0
@@ -52,6 +54,9 @@ class TrainController:
 
     def get_state(self) -> str:
         return self.state
+
+    def get_attempt_log(self) -> list[dict]:
+        return list(self.attempt_log)
 
     def run(self) -> dict:
         exp_dir = self._exp_dir
@@ -80,9 +85,21 @@ class TrainController:
                 group.shutdown()
                 self._retries_prev_attempts += self._attempt_retries
                 self._attempt_retries = 0
+            self.attempt_log.append({
+                "attempt": len(self.attempt_log) + 1,
+                "outcome": outcome,
+                "workers": self.current_workers,
+                "error": error,
+            })
             if outcome == "finished":
                 self.state = "FINISHED"
                 break
+            if outcome == "preempted":
+                # a node drain is not a failure: the grace checkpoint is
+                # durable (zero lost steps), so restart on the surviving
+                # nodes without spending the max_failures budget
+                self.state = "RESTARTING"
+                continue
             self.failures += 1
             if max_failures >= 0 and self.failures > max_failures:
                 self.state = "ERRORED"
@@ -98,6 +115,7 @@ class TrainController:
             "error": error if self.state == "ERRORED" else None,
             "path": exp_dir,
             "failures": self.failures,
+            "attempts": list(self.attempt_log),
             "storage_retries": self._retries_prev_attempts + self._attempt_retries,
         }
 
@@ -175,6 +193,8 @@ class TrainController:
 
     def _poll_until_done(self, group: WorkerGroup) -> tuple[str, str | None]:
         n = self.current_workers
+        hang_timeout = getattr(self.run_config.failure_config,
+                               "hang_timeout_s", None)
         while True:
             try:
                 polls = group.poll()
@@ -188,10 +208,47 @@ class TrainController:
             if any(s == "errored" for s in statuses):
                 err = next(p["error"] for p in polls if p["status"] == "errored")
                 return "errored", err
+            if any(s == "preempted" for s in statuses):
+                # at least one rank landed its drain-grace checkpoint and
+                # exited; drain whatever the others reported, then restart
+                info = next((p.get("preempted") for p in polls
+                             if p["status"] == "preempted"), None) or {}
+                return "preempted", (
+                    f"node {info.get('node_id')!r} draining "
+                    f"({info.get('reason')}): grace checkpoint saved at "
+                    f"iter {info.get('iter')}")
             if all(s == "finished" for s in statuses):
                 self._consume_complete_iters(n)
                 return "finished", None
+            if hang_timeout is not None:
+                # a rank that observed request_stop is idle by design; every
+                # other running rank must report() within hang_timeout_s
+                stuck = [
+                    i for i, p in enumerate(polls)
+                    if p["status"] == "running"
+                    and not p.get("stop_observed")
+                    and (p.get("progress_age_s") or 0.0) > hang_timeout]
+                if stuck:
+                    self._record_hang()
+                    return "hung", (
+                        f"hang watchdog: rank(s) {stuck} made no step "
+                        f"progress for > {hang_timeout}s; killing the "
+                        f"attempt and restarting from the latest checkpoint")
             time.sleep(POLL_INTERVAL_S)
+
+    @staticmethod
+    def _record_hang() -> None:
+        from ray_tpu.util import metrics as met
+
+        try:
+            met.get_or_create(
+                met.Counter, "ray_tpu_train_hangs_detected_total",
+                "Training attempts killed by the hang watchdog.").inc()
+        except Exception:  # noqa: BLE001 — metrics must never mask the hang
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "hang counter inc failed", exc_info=True)
 
     def _consume_complete_iters(self, n: int) -> None:
         for idx in sorted(self._iter_buffer):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any
 
 from ray_tpu.train import storage as storage_mod
@@ -83,28 +84,71 @@ class TrainSession:
         self.reports: list[dict] = []   # drained by TrainWorker.poll
         self._lock = threading.Lock()
         self.stop_requested = False
+        # stop_observed tells the controller the train fn actually reached a
+        # step boundary after request_stop — a stopping rank is idle by
+        # design, not hung, so the watchdog must not count it
+        self.stop_observed = False
+        # per-step progress heartbeat: stamped at every report(); the
+        # watchdog clock starts at session init so a rank wedged before its
+        # first step is detected too
+        self.last_progress = time.time()
+        self.preempt_info: dict | None = None  # set once a grace ckpt landed
         self._coll_seq: dict[str, int] = {}  # per-key collective call counter
 
     # ------------------------------------------------------------------ api
 
     def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+        self.last_progress = time.time()
         idx = self.iteration
         persisted = None
+        drain = self._drain_notice()
         if checkpoint is not None:
             persisted = self._persist(checkpoint, idx, metrics)
+        # a drain notice + a checkpoint that actually landed = the
+        # preemption-grace checkpoint: this step is durable, so exiting the
+        # attempt here loses zero steps
+        preempted = drain is not None and persisted is not None
         with self._lock:
             # persist_failed distinguishes "tried and degraded" from
             # "metrics-only report": one failed rank vetoes registration of
             # the whole checkpoint on the controller side
-            self.reports.append({"iter": idx, "rank": self.rank,
-                                 "metrics": dict(metrics),
-                                 "checkpoint_dir": persisted,
-                                 "persist_failed": (checkpoint is not None
-                                                    and persisted is None),
-                                 "storage_retries": self.persist_retries})
+            rep = {"iter": idx, "rank": self.rank,
+                   "metrics": dict(metrics),
+                   "checkpoint_dir": persisted,
+                   "persist_failed": (checkpoint is not None
+                                      and persisted is None),
+                   "storage_retries": self.persist_retries}
+            if preempted:
+                rep["preempt_checkpoint"] = True
+            self.reports.append(rep)
         self.iteration += 1
+        if preempted:
+            self.preempt_info = {"iter": idx, "node_id": drain.get("node_id"),
+                                 "reason": drain.get("reason")}
+            self._count_preempt_checkpoint()
+            raise _Preempted(self.preempt_info)
         if self.stop_requested:
+            self.stop_observed = True
             raise _StopTraining()
+
+    @staticmethod
+    def _drain_notice() -> dict | None:
+        """The node's sticky drain notice (None while not draining); pushed
+        into the worker process by the GCS on node_drain."""
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.drain_info()
+
+    def _count_preempt_checkpoint(self) -> None:
+        from ray_tpu.util import metrics as met
+
+        try:
+            met.get_or_create(
+                met.Counter, "ray_tpu_train_preempt_checkpoints_total",
+                "Preemption-grace checkpoints persisted after a drain notice.",
+                tag_keys=("rank",)).inc(tags={"rank": self.rank})
+        except Exception:  # noqa: BLE001 — metrics must never fail a report
+            logger.debug("preempt-checkpoint counter inc failed", exc_info=True)
 
     def _persist(self, checkpoint: Checkpoint, idx: int,
                  metrics: dict) -> str | None:
@@ -155,6 +199,17 @@ class TrainSession:
 
 class _StopTraining(Exception):
     """Raised inside report() when the controller asked the run to stop."""
+
+
+class _Preempted(Exception):
+    """Raised inside report() once a drain-notice-triggered grace checkpoint
+    has durably landed: this node is going away, so the worker exits the
+    attempt at the step boundary and the controller restarts elsewhere from
+    that checkpoint with zero lost steps."""
+
+    def __init__(self, info: dict | None = None):
+        self.info = dict(info or {})
+        super().__init__("node draining: preemption-grace checkpoint saved")
 
 
 def init_session(**kwargs) -> TrainSession:

@@ -30,6 +30,9 @@ class Result:
     # total checkpoint-upload retries observed (bounded per-op by the
     # storage RetryConfig) — chaos tests assert this stays sane
     storage_retries: int = 0
+    # per-attempt forensics: outcome ("finished"/"errored"/"hung"/
+    # "preempted"), worker count, and the hang/preemption reason
+    attempts: list = field(default_factory=list)
 
 
 class TrainingFailedError(RuntimeError):
@@ -74,6 +77,7 @@ class DataParallelTrainer:
             error=out["error"],
             best_checkpoints=out["best_checkpoints"],
             storage_retries=out.get("storage_retries", 0),
+            attempts=out.get("attempts", []),
         )
         if out["state"] == "ERRORED":
             raise TrainingFailedError(

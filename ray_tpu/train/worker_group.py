@@ -32,6 +32,7 @@ class TrainWorker:
         self._status = "idle"
         self._error: str | None = None
         self._session = None
+        self._preempt_info: dict | None = None
 
     def metadata(self) -> dict:
         import socket
@@ -82,6 +83,11 @@ class TrainWorker:
                 self._status = "finished"
             except session_mod._StopTraining:
                 self._status = "finished"
+            except session_mod._Preempted as e:
+                # the grace checkpoint landed; the controller restarts the
+                # attempt on surviving nodes without spending failure budget
+                self._preempt_info = dict(e.info)
+                self._status = "preempted"
             except BaseException:  # noqa: BLE001 — surfaced via poll()
                 self._error = traceback.format_exc()
                 self._status = "errored"
@@ -90,8 +96,18 @@ class TrainWorker:
         self._thread.start()
 
     def poll(self) -> dict:
-        reports = self._session.drain_reports() if self._session else []
-        return {"status": self._status, "error": self._error, "reports": reports}
+        import time
+
+        s = self._session
+        reports = s.drain_reports() if s else []
+        # progress rides as an age so the controller never compares a worker
+        # wall-clock timestamp against its own clock
+        return {"status": self._status, "error": self._error,
+                "reports": reports,
+                "stop_observed": bool(s is not None and s.stop_observed),
+                "progress_age_s": (time.time() - s.last_progress
+                                   if s is not None else None),
+                "preempted": self._preempt_info}
 
     def request_stop(self) -> None:
         if self._session:

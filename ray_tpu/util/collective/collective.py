@@ -20,13 +20,17 @@ each operation's rendezvous.)
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import NamedTuple
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.exceptions import CollectiveError
 from ray_tpu.util.collective import quantization
+
+logger = logging.getLogger(__name__)
 
 _groups: dict[str, "_GroupHandle"] = {}  # group_name → this process's handle
 
@@ -76,6 +80,17 @@ def _record_collective(op_kind: str, compression: str | None, nbytes: int,
     hist.observe(seconds, tags)
 
 
+def _record_failure(kind: str) -> None:
+    from ray_tpu.util import metrics as met
+
+    met.get_or_create(
+        met.Counter, "ray_tpu_collective_failures_total",
+        "Host-plane collective failures: peer_death (liveness polling "
+        "caught a dead rank mid-wait), aborted (the group was poisoned by "
+        "another rank's detection), timeout (the data wait expired).",
+        tag_keys=("kind",)).inc(tags={"kind": kind})
+
+
 @ray_tpu.remote
 class _Rendezvous:
     """Per-group state: contributions keyed by (seq, rank)."""
@@ -85,6 +100,28 @@ class _Rendezvous:
         self.contribs: dict[int, dict[int, bytes]] = {}    # collectives by seq
         self.consumed: dict[int, set[int]] = {}
         self.mailbox: dict[tuple, bytes] = {}              # p2p: disjoint namespace
+        # rank → actor id registered at join (None for a driver rank):
+        # survivors poll these via actor_info for peer liveness
+        self.members: dict[int, str | None] = {}
+        # group-level poison: first detection wins; every subsequent wait on
+        # the group fails fast instead of re-entering a doomed collective
+        self.abort_info: dict | None = None
+
+    def register(self, rank: int, aid: str | None) -> dict:
+        """Record this rank's actor id; returns the members seen so far."""
+        self.members[rank] = aid
+        return dict(self.members)
+
+    def members_map(self) -> dict:
+        return dict(self.members)
+
+    def abort(self, rank: int, reason: str, dead_ranks: tuple = ()) -> None:
+        if self.abort_info is None:
+            self.abort_info = {"rank": rank, "reason": reason,
+                               "dead_ranks": tuple(dead_ranks)}
+
+    def get_abort(self) -> dict | None:
+        return self.abort_info
 
     def put(self, seq: int, rank: int, blob: bytes) -> None:
         self.contribs.setdefault(seq, {})[rank] = blob
@@ -121,6 +158,12 @@ class _GroupHandle:
         self.rank = rank
         self.actor = actor
         self.seq = 0
+        # rank → actor id (from the rendezvous membership table) for peer
+        # liveness probes; None entries are driver ranks (not probeable)
+        self.peer_aids: dict[int, str | None] = {}
+        # local mirror of the group poison flag: once set, every wait on
+        # this group fails fast with CollectiveError(kind="aborted")
+        self.aborted: str | None = None
 
     def next_seq(self) -> int:
         self.seq += 1
@@ -132,38 +175,75 @@ def _rendezvous_name(group_name: str) -> str:
 
 
 def init_collective_group(world_size: int, rank: int, *, backend: str = "host",
-                          group_name: str = "default") -> None:
+                          group_name: str = "default",
+                          timeout: float | None = None) -> None:
     """Join (rank 0 creates) the named group. Called by each participant.
-    (reference: collective.py:180.)"""
+    (reference: collective.py:180.)
+
+    Blocks until every rank has registered with the rendezvous — the
+    membership (rank → actor id) table is what peer-liveness probes read,
+    so it must be complete before the first op. `timeout` defaults to
+    RayConfig.collective_group_create_timeout_s; on expiry the error names
+    the ranks that never arrived."""
+    from ray_tpu._private.ray_config import RayConfig
+
     if group_name in _groups:
         raise ValueError(f"already in collective group {group_name!r}")
+    if timeout is None:
+        timeout = RayConfig.get("collective_group_create_timeout_s")
     name = _rendezvous_name(group_name)
+    deadline = time.monotonic() + timeout
     if rank == 0:
         actor = _Rendezvous.options(name=name, namespace="_system",
                             num_cpus=0.1).remote(world_size)
         actor.__ray_ready__()
     else:
-        deadline = time.monotonic() + 60.0
         while True:
             try:
                 actor = ray_tpu.get_actor(name, namespace="_system")
                 break
             except ValueError:
                 if time.monotonic() > deadline:
-                    raise TimeoutError(f"group {group_name!r} was never created") from None
+                    raise TimeoutError(
+                        f"collective group {group_name!r} was never created "
+                        f"within {timeout:.0f}s: rank 0 never started the "
+                        "rendezvous") from None
                 time.sleep(0.02)
-    _groups[group_name] = _GroupHandle(group_name, world_size, rank, actor)
+    registered = ray_tpu.get(actor.register.remote(rank, _self_aid()))
+    while len(registered) < world_size:
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(world_size)) - set(registered))
+            raise TimeoutError(
+                f"collective group {group_name!r}: rank(s) {missing} never "
+                f"joined within {timeout:.0f}s "
+                f"({len(registered)}/{world_size} registered)")
+        time.sleep(0.02)
+        registered = ray_tpu.get(actor.members_map.remote())
+    g = _GroupHandle(group_name, world_size, rank, actor)
+    g.peer_aids = dict(registered)
+    _groups[group_name] = g
 
 
 def create_collective_group(actors: list, world_size: int, ranks: list[int], *,
-                            backend: str = "host", group_name: str = "default"):
+                            backend: str = "host", group_name: str = "default",
+                            timeout: float | None = None):
     """Declarative setup from the driver: tells every actor to join.
     The actors must expose the conventional `init_collective_group(world_size,
     rank, backend, group_name)` method (reference: collective.py:217 uses the
-    same information-push pattern)."""
+    same information-push pattern).
+
+    `timeout` (default RayConfig.collective_group_create_timeout_s) bounds
+    the driver-side gather with a small slack so each rank's in-actor
+    deadline — which names the missing ranks — wins the race; set the env
+    override RAY_TPU_COLLECTIVE_GROUP_CREATE_TIMEOUT_S to tighten the
+    in-actor deadline itself (spawn_env forwards it to workers)."""
+    from ray_tpu._private.ray_config import RayConfig
+
+    if timeout is None:
+        timeout = RayConfig.get("collective_group_create_timeout_s")
     refs = [a.init_collective_group.remote(world_size, r, backend, group_name)
             for a, r in zip(actors, ranks)]
-    ray_tpu.get(refs)
+    ray_tpu.get(refs, timeout=timeout + 10.0)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
@@ -192,14 +272,145 @@ def _group(group_name: str) -> _GroupHandle:
     return _groups[group_name]
 
 
+# --------------------------------------------------------- failure detection
+
+def _self_aid() -> str | None:
+    """This process's actor id (None on a driver rank)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod._global_worker
+    return getattr(w, "current_actor_id", None) if w is not None else None
+
+
+def _member_aids(g: _GroupHandle) -> dict:
+    """rank → actor id map from the rendezvous membership table (cached
+    once complete; refreshed while ranks are still joining)."""
+    if len(g.peer_aids) < g.world_size:
+        try:
+            g.peer_aids = ray_tpu.get(g.actor.members_map.remote())
+        except Exception as e:
+            logger.debug("collective members_map fetch failed: %s", e)
+    return g.peer_aids
+
+
+def _probe_dead_ranks(g: _GroupHandle) -> list[int]:
+    """One liveness sweep of all peer ranks via the GCS actor table.
+
+    A rank is dead iff the GCS says its actor is gone or state == "dead";
+    RPC errors are inconclusive (a GCS hiccup must not poison a healthy
+    group), and driver ranks (aid None) are never probed."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod._global_worker
+    if w is None:
+        return []
+    dead: list[int] = []
+    for rank, aid in sorted(_member_aids(g).items()):
+        if rank == g.rank or aid is None:
+            continue
+        try:
+            info = w.rpc({"type": "actor_info", "aid": aid}, timeout=10.0)
+        except Exception as e:
+            logger.debug("liveness probe for rank %s failed: %s", rank, e)
+            continue
+        if not info.get("found") or info.get("state") == "dead":
+            dead.append(rank)
+    return dead
+
+
+def _mark_aborted(g: _GroupHandle, reason: str,
+                  dead_ranks: tuple = ()) -> None:
+    """Poison the group locally and (best-effort) on the rendezvous so
+    every other survivor fails fast instead of re-entering a collective
+    the dead rank can never complete. `dead_ranks` rides the flag so
+    survivors that adopt the abort still name the dead peers."""
+    g.aborted = reason
+    try:
+        g.actor.abort.remote(g.rank, reason, tuple(dead_ranks))
+    except Exception as e:
+        logger.debug("collective abort broadcast failed: %s", e)
+
+
+def _liveness_check(g: _GroupHandle, what: str, seq: int | None) -> None:
+    """One in-wait detection pass: adopt a group abort set by another rank,
+    else probe peer liveness; raises CollectiveError on either."""
+    try:
+        info = ray_tpu.get(g.actor.get_abort.remote())
+    except Exception as e:
+        logger.debug("collective abort-flag check failed: %s", e)
+        info = None
+    if info is not None:
+        g.aborted = info.get("reason") or "aborted"
+        _record_failure("aborted")
+        raise CollectiveError(
+            f"collective group {g.name!r} aborted by rank {info.get('rank')}: "
+            f"{g.aborted}", group=g.name, seq=seq,
+            dead_ranks=tuple(info.get("dead_ranks") or ()), kind="aborted")
+    dead = _probe_dead_ranks(g)
+    if dead:
+        reason = (f"collective group {g.name!r}: rank(s) {dead} died "
+                  f"(detected while waiting: {what})")
+        _mark_aborted(g, reason, tuple(dead))
+        _record_failure("peer_death")
+        raise CollectiveError(reason, group=g.name, seq=seq,
+                              dead_ranks=tuple(dead), kind="peer_death")
+
+
+def _collective_wait(g: _GroupHandle, probe, timeout: float, what: str,
+                     seq: int | None = None):
+    """poll_until with peer-liveness awareness.
+
+    While blocked on collective data, every collective_liveness_interval_s
+    the wait (a) adopts a group-level abort set by another rank and (b)
+    probes peer-actor liveness via the GCS — so a SIGKILLed rank surfaces
+    on every survivor as CollectiveError naming the dead rank within
+    ~the interval, never as an opaque TimeoutError after the full data
+    timeout. On data-timeout expiry one final sweep runs regardless (the
+    fallback when in-wait polling is disabled via interval 0), upgrading
+    the TimeoutError to CollectiveError when it finds suspects."""
+    from ray_tpu._private.poll import _SLEEP_CAP, _SLEEP_INIT
+    from ray_tpu._private.ray_config import RayConfig
+
+    if g.aborted:
+        _record_failure("aborted")
+        raise CollectiveError(
+            f"collective group {g.name!r} is aborted: {g.aborted}",
+            group=g.name, seq=seq, kind="aborted")
+    interval = RayConfig.instance().collective_liveness_interval_s
+    deadline = time.monotonic() + timeout
+    next_check = (time.monotonic() + interval) if interval > 0 else None
+    sleep_s = _SLEEP_INIT
+    while True:
+        out = probe()
+        if out is not None:
+            return out
+        now = time.monotonic()
+        if now > deadline:
+            break
+        if next_check is not None and now >= next_check:
+            _liveness_check(g, what, seq)
+            next_check = time.monotonic() + interval
+        time.sleep(min(sleep_s, max(deadline - now, 0.0)))
+        sleep_s = min(sleep_s * 2, _SLEEP_CAP)
+    dead = _probe_dead_ranks(g)
+    _record_failure("timeout")
+    if dead:
+        reason = (f"collective group {g.name!r}: rank(s) {dead} suspected "
+                  f"dead (liveness sweep at timeout of: {what})")
+        _mark_aborted(g, reason, tuple(dead))
+        raise CollectiveError(reason, group=g.name, seq=seq,
+                              dead_ranks=tuple(dead), kind="timeout")
+    raise TimeoutError(what)
+
+
 def _exchange(g: _GroupHandle, payload, timeout: float) -> dict:
     from ray_tpu._private import serialization as ser
-    from ray_tpu._private.poll import poll_until
 
     seq = g.next_seq()
     g.actor.put.remote(seq, g.rank, ser.dumps(payload))
-    got = poll_until(lambda: ray_tpu.get(g.actor.poll.remote(seq, g.rank)),
-                     timeout, f"collective seq {seq} timed out on rank {g.rank}")
+    got = _collective_wait(
+        g, lambda: ray_tpu.get(g.actor.poll.remote(seq, g.rank)),
+        timeout, f"collective seq {seq} timed out on rank {g.rank}", seq=seq)
     return {r: ser.loads(b) for r, b in got.items()}
 
 
@@ -230,21 +441,23 @@ def _ring_send(g: _GroupHandle, dst: int, tag, ref, timeout: float):
     # ring tags are tuples — a namespace user send()/recv() int tags can't
     # collide with in the shared p2p mailbox
     from ray_tpu._private import serialization as ser
-    from ray_tpu._private.poll import poll_until
 
     blob = ser.dumps(ref)
-    poll_until(
+    _collective_wait(
+        g,
         lambda: ray_tpu.get(g.actor.put_p2p.remote(tag, g.rank, dst, blob)) or None,
-        timeout, f"ring send to rank {dst} (tag {tag}) timed out")
+        timeout, f"ring send to rank {dst} (tag {tag}) timed out",
+        seq=tag[1] if isinstance(tag, tuple) else None)
 
 
 def _ring_recv(g: _GroupHandle, src: int, tag, timeout: float) -> np.ndarray:
     from ray_tpu._private import serialization as ser
-    from ray_tpu._private.poll import poll_until
 
-    blob = poll_until(
+    blob = _collective_wait(
+        g,
         lambda: ray_tpu.get(g.actor.poll_p2p.remote(tag, src, g.rank)),
-        timeout, f"ring recv from rank {src} (tag {tag}) timed out")
+        timeout, f"ring recv from rank {src} (tag {tag}) timed out",
+        seq=tag[1] if isinstance(tag, tuple) else None)
     return ray_tpu.get(ser.loads(blob))
 
 
@@ -565,11 +778,11 @@ def send(tensor: np.ndarray, dst_rank: int, *, group_name: str = "default",
     send to the same peer is unconsumed (mailbox backpressure).
     (reference: :666.)"""
     from ray_tpu._private import serialization as ser
-    from ray_tpu._private.poll import poll_until
 
     g = _group(group_name)
     blob = ser.dumps(np.asarray(tensor))
-    poll_until(
+    _collective_wait(
+        g,
         lambda: ray_tpu.get(g.actor.put_p2p.remote(tag, g.rank, dst_rank, blob)) or None,
         timeout, f"send to rank {dst_rank} (tag {tag}) timed out: receiver never drained")
 
@@ -578,10 +791,10 @@ def recv(src_rank: int, *, group_name: str = "default", tag: int = 0,
          timeout: float = 60.0) -> np.ndarray:
     """(reference: :702.)"""
     from ray_tpu._private import serialization as ser
-    from ray_tpu._private.poll import poll_until
 
     g = _group(group_name)
-    blob = poll_until(
+    blob = _collective_wait(
+        g,
         lambda: ray_tpu.get(g.actor.poll_p2p.remote(tag, src_rank, g.rank)),
         timeout, f"recv from rank {src_rank} timed out")
     return ser.loads(blob)

@@ -48,7 +48,8 @@ def session():
 
 def _mk(provider, types, **kw):
     return Autoscaler(f"unix:{_api._node.socket_path}", provider, types,
-                      idle_timeout_s=kw.pop("idle_timeout_s", 0.2), **kw)
+                      idle_timeout_s=kw.pop("idle_timeout_s", 0.2),
+                      drain_grace_s=kw.pop("drain_grace_s", 0.0), **kw)
 
 
 def test_scale_up_on_pending_demand(session):

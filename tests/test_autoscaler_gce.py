@@ -26,8 +26,11 @@ def session():
 
 
 def _mk(provider, types, **kw):
+    # drain grace 0: these tests assert same-pass scale-down; the
+    # drain-then-terminate window is exercised in test_autoscaler.py
     return Autoscaler(f"unix:{_api._node.socket_path}", provider, types,
-                      idle_timeout_s=kw.pop("idle_timeout_s", 0.2), **kw)
+                      idle_timeout_s=kw.pop("idle_timeout_s", 0.2),
+                      drain_grace_s=kw.pop("drain_grace_s", 0.0), **kw)
 
 
 def test_slice_shapes_and_node_type():

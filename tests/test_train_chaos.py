@@ -1,0 +1,353 @@
+"""Training fault-tolerance chaos: collective peer death, preemption-aware
+node drain (grace checkpoint, zero lost steps), and the hang watchdog.
+
+(reference test strategy: ResourceKillerActor-style chaos from
+_private/test_utils.py; train/v2 controller failure-policy tests. ISSUE 17
+acceptance: survivors see CollectiveError naming the dead rank well inside
+the op timeout; a drained node's attempt resumes from the grace checkpoint
+with zero lost steps; a hung rank is detected and restarted.)
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu._private import api as _api
+from ray_tpu.exceptions import CollectiveError, RayTaskError
+from ray_tpu.train._checkpoint import Checkpoint
+
+pytestmark = pytest.mark.train_chaos
+
+
+# ------------------------------------------------- collective peer death
+
+
+@pytest.fixture
+def liveness_cluster(monkeypatch):
+    # tight liveness polling so peer death surfaces in a couple hundred ms,
+    # not only at the (long) op timeout
+    monkeypatch.setenv("RAY_TPU_COLLECTIVE_LIVENESS_INTERVAL_S", "0.25")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=16)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class ChaosRing:
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        self.rank = rank
+        self.g = group_name
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=group_name)
+        return os.getpid()
+
+    def allreduce(self, n, delay=0.0):
+        if delay:
+            time.sleep(delay)
+        x = np.full((n,), float(self.rank + 1), np.float32)
+        out = self.col.allreduce(x, group_name=self.g, timeout=60.0)
+        return float(out[0])
+
+
+OP_TIMEOUT_S = 60.0
+DETECT_BUDGET_S = 15.0  # < 25% of the op timeout (acceptance criterion)
+
+
+def test_sigkill_mid_allreduce_names_dead_rank(liveness_cluster):
+    """SIGKILL one rank mid-allreduce: survivors get a CollectiveError
+    naming the dead rank well inside the op timeout (never an opaque
+    TimeoutError after the full 60s), and the group stays poisoned for
+    subsequent ops."""
+    world = 3
+    actors = [ChaosRing.remote() for _ in range(world)]
+    pids = ray_tpu.get([
+        a.init_collective_group.remote(world, i, "host", "chaos_g")
+        for i, a in enumerate(actors)])
+    # rank 2 sleeps before contributing, so ranks 0/1 are blocked inside
+    # the collective when it dies
+    refs = [a.allreduce.remote(1 << 18, 30.0 if i == 2 else 0.0)
+            for i, a in enumerate(actors)]
+    time.sleep(0.5)
+    killed_at = time.monotonic()
+    os.kill(pids[2], signal.SIGKILL)
+
+    for ref in refs[:2]:
+        with pytest.raises(RayTaskError) as ei:
+            ray_tpu.get(ref, timeout=DETECT_BUDGET_S + 5.0)
+        assert isinstance(ei.value.cause, CollectiveError), ei.value
+        assert 2 in ei.value.cause.dead_ranks
+        assert "2" in str(ei.value.cause)
+    assert time.monotonic() - killed_at < DETECT_BUDGET_S
+
+    # the abort flag poisons later ops on the group immediately
+    t0 = time.monotonic()
+    with pytest.raises(RayTaskError) as ei:
+        ray_tpu.get(actors[0].allreduce.remote(1 << 18), timeout=10.0)
+    assert isinstance(ei.value.cause, CollectiveError)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_group_create_timeout_names_missing_ranks(liveness_cluster):
+    """A group whose peers never arrive fails at the creation deadline with
+    an error naming the missing ranks (not a bare timeout)."""
+    with pytest.raises(TimeoutError, match=r"rank\(s\) \[1, 2\]"):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(3, 0, group_name="never_formed",
+                                  timeout=1.5)
+
+
+def test_collective_death_elastic_restart_converges(liveness_cluster, tmp_path):
+    """A rank dying mid-run inside a collective surfaces as CollectiveError
+    on the survivor (not a 60s stall), the attempt errors, and the
+    controller's elastic restart resumes from the last complete checkpoint
+    and converges."""
+    marker = str(tmp_path / "killed_once")
+
+    def train_fn(config):
+        import tempfile
+
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        ctx = train.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                with open(os.path.join(d, "rank_0", "iter.txt")) as f:
+                    start = int(f.read()) + 1
+        # per-attempt group: attempt boundaries are collective boundaries
+        group = f"elastic-{start}-{world}"
+        col.init_collective_group(world, rank, group_name=group)
+        for i in range(start, 4):
+            if rank == 1 and i == 2 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard death: survivors are blocked in allreduce
+            x = np.full((1 << 18,), float(rank + 1), np.float32)
+            out = col.allreduce(x, group_name=group, timeout=60.0)
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "iter.txt"), "w") as f:
+                    f.write(str(i))
+                train.report({"iter": i, "allreduced": float(out[0]),
+                              "world": world},
+                             checkpoint=Checkpoint.from_directory(d))
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=train.ScalingConfig(num_workers=2, min_workers=1),
+        run_config=train.RunConfig(
+            name="coll_death", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=2)),
+    )
+    t0 = time.monotonic()
+    result = trainer.fit()
+    assert result.error is None
+    assert os.path.exists(marker)
+    assert result.metrics["iter"] == 3
+    # allreduce of full(rank+1) over the final attempt's world size
+    assert result.metrics["allreduced"] == pytest.approx(
+        sum(r + 1 for r in range(result.metrics["world"])))
+    errored = [a for a in result.attempts if a["outcome"] == "errored"]
+    # detection races: the controller's poll may see the dead actor before
+    # the survivor's in-collective CollectiveError propagates — either way
+    # the attempt dies at the liveness interval, nowhere near the 60s op
+    # timeout, and restarts
+    assert errored, result.attempts
+    assert ("CollectiveError" in errored[0]["error"]
+            or "ActorDiedError" in errored[0]["error"])
+    assert time.monotonic() - t0 < 45.0
+
+
+# --------------------------------------------------- drain / preemption
+
+
+@pytest.fixture
+def drain_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args=dict(
+        num_cpus=16, num_workers=2, max_workers=16))
+    yield cluster
+    ray_tpu.shutdown()
+
+
+def test_drain_grace_checkpoint_zero_lost_steps(drain_cluster, tmp_path):
+    """Drain the node hosting the training worker mid-run: the session
+    lands a grace checkpoint at the next step boundary, the controller
+    restarts on surviving capacity WITHOUT spending the failure budget
+    (max_failures=0), and no step is lost or re-executed."""
+    total = 12
+    step_log = str(tmp_path / "steps.log")
+    # SLOT pins attempt 1's single worker to node-1 (the node we drain);
+    # node-2 joins mid-run as the surviving/replacement capacity
+    node1 = drain_cluster.add_node(num_cpus=4, resources={"SLOT": 1})
+
+    def train_fn(config):
+        import tempfile
+        import time as _t
+
+        rank = train.get_context().get_world_rank()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                shard = sorted(x for x in os.listdir(d)
+                               if x.startswith("rank_"))[0]
+                with open(os.path.join(d, shard, "iter.txt")) as f:
+                    start = int(f.read()) + 1
+        for i in range(start, config["total"]):
+            _t.sleep(0.12)
+            with open(config["log"], "a") as f:
+                f.write(f"{rank}:{i}\n")
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "iter.txt"), "w") as f:
+                    f.write(str(i))
+                train.report({"iter": i, "resumed_from": start},
+                             checkpoint=Checkpoint.from_directory(d))
+
+    import threading
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        train_loop_config={"total": total, "log": step_log},
+        scaling_config=train.ScalingConfig(
+            num_workers=2, min_workers=1,
+            resources_per_worker={"CPU": 1.0, "SLOT": 1.0}),
+        run_config=train.RunConfig(
+            name="drain", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=0)),
+    )
+    result_box = {}
+
+    def run():
+        result_box["result"] = trainer.fit()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # wait until training is demonstrably under way on node-1
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if os.path.exists(step_log) and len(open(step_log).readlines()) >= 3:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("training never started making progress")
+    # replacement capacity joins, then the original node is drained
+    drain_cluster.add_node(num_cpus=4, resources={"SLOT": 2})
+    reply = _api._get_worker().rpc(
+        {"type": "node_drain", "node_id": node1, "grace_s": 30.0,
+         "reason": "test-preemption"})
+    assert reply.get("ok"), reply
+    nodes = {n["node_id"]: n for n in _api._get_worker().list_nodes()}
+    assert nodes[node1]["draining"] is True
+
+    t.join(timeout=90.0)
+    assert not t.is_alive(), "fit() did not complete after the drain"
+    result = result_box["result"]
+    assert result.error is None
+    assert result.metrics["iter"] == total - 1
+    # the run restarted from the grace checkpoint (not from scratch) ...
+    assert result.metrics["resumed_from"] > 0
+    assert any(a["outcome"] == "preempted" for a in result.attempts)
+    # ... and rank 0 executed every step exactly once: nothing lost to the
+    # preemption, nothing re-executed after the grace checkpoint
+    rank0_steps = [int(line.split(":")[1])
+                   for line in open(step_log).read().splitlines()
+                   if line.startswith("0:")]
+    assert sorted(rank0_steps) == list(range(total))
+    assert len(rank0_steps) == len(set(rank0_steps))
+
+
+# --------------------------------------------------------- hang watchdog
+
+
+@pytest.fixture
+def train_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=12)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_hang_watchdog_detects_and_restarts(train_cluster, tmp_path):
+    """A rank that stops calling report() (wedged collective / deadlocked
+    input pipeline) is detected within hang_timeout_s + slack; the attempt
+    is killed, logged as hung, and restarted from the latest checkpoint."""
+    marker = str(tmp_path / "hung_once")
+    hang_timeout = 2.0
+
+    def train_fn(config):
+        import tempfile
+        import time as _t
+
+        rank = train.get_context().get_world_rank()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                with open(os.path.join(d, "rank_0", "iter.txt")) as f:
+                    start = int(f.read()) + 1
+        for i in range(start, 4):
+            if rank == 0 and i == 2 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                _t.sleep(3600)  # wedge: never reaches report()
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "iter.txt"), "w") as f:
+                    f.write(str(i))
+                train.report({"iter": i},
+                             checkpoint=Checkpoint.from_directory(d))
+
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(
+            name="hang", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(
+                max_failures=1, hang_timeout_s=hang_timeout)),
+    )
+    t0 = time.monotonic()
+    result = trainer.fit()
+    elapsed = time.monotonic() - t0
+    assert result.error is None
+    assert result.metrics["iter"] == 3
+    assert os.path.exists(marker)
+    hung = [a for a in result.attempts if a["outcome"] == "hung"]
+    assert hung, result.attempts
+    assert "hang watchdog" in hung[0]["error"]
+    assert "rank" in hung[0]["error"]
+    # detection + restart + the 2 remaining steps must fit well inside
+    # hang_timeout_s + 5s of watchdog slack plus startup overhead
+    assert elapsed < hang_timeout + 30.0
+
+
+def test_stop_observed_flag_set_at_step_boundary(tmp_path):
+    """Cooperative stop: the session marks stop_observed when report()
+    actually sees the flag — the watchdog exempts stopping ranks on this
+    signal, so it must flip before _StopTraining propagates."""
+    from ray_tpu.train import session as session_mod
+
+    s = session_mod.TrainSession(
+        rank=0, world_size=1, local_rank=0, local_world_size=1, node_rank=0,
+        experiment_dir=str(tmp_path), experiment_name="unit")
+    s.report({"iter": 0})
+    assert s.stop_observed is False
+    s.stop_requested = True
+    with pytest.raises(session_mod._StopTraining):
+        s.report({"iter": 1})
+    assert s.stop_observed is True
+    assert s.last_progress <= time.time()

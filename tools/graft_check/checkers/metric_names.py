@@ -90,6 +90,13 @@ EXPECTED_METRICS = (
     # replica queue bound) instead of queued
     "ray_tpu_serve_request_cancellations_total",
     "ray_tpu_serve_requests_shed_total",
+    # training fault tolerance v2: collective-aware failure detection
+    # (util/collective), node drain (gcs), and the train hang watchdog /
+    # preemption-grace checkpoint (train/controller.py + session.py)
+    "ray_tpu_collective_failures_total",
+    "ray_tpu_nodes_draining",
+    "ray_tpu_train_hangs_detected_total",
+    "ray_tpu_train_preempt_checkpoints_total",
 )
 
 
