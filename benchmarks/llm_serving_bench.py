@@ -298,13 +298,14 @@ def main():
          "aggregate_tokens_per_s", "instrumentation_ab"],
         _ROOT)
     path = os.path.join(_ROOT, "LLM_BENCH.json")
-    try:  # the `pd` section belongs to llm_load_bench.py: never clobber it
+    try:  # sections owned by OTHER benches (llm_load_bench's `pd`, future
+        #   additions): keep every prior key this run didn't produce
         with open(path) as f:
-            prev_pd = json.load(f).get("pd")
+            prior = json.load(f)
     except (OSError, ValueError):
-        prev_pd = None
-    if prev_pd is not None:
-        out["pd"] = prev_pd
+        prior = {}
+    for k, v in prior.items():
+        out.setdefault(k, v)
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

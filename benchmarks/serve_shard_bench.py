@@ -1,0 +1,186 @@
+"""Sharded proxy-plane benchmark — `python benchmarks/serve_shard_bench.py`.
+
+Measures the SAME noop HTTP rows as ray_tpu.scripts.serve_bench but through
+the sharded proxy plane (N workers accepting on one SO_REUSEPORT port,
+routing from the controller's shm broadcast), plus a large-payload row that
+exercises the zero-copy body/response path (bodies and byte results above
+`serve_zero_copy_threshold_bytes` ride the arena object plane as refs, not
+pickled payloads). Results land in the ``sharded`` section of
+SERVE_BENCH.json via the section-preserving merge writer, next to (never
+clobbering) serve_bench's single-proxy ``results`` baseline; the per-phase
+proxy histograms (`ray_tpu_serve_proxy_phase_seconds`) are summarized into
+the row so the win/loss is attributable.
+
+Env knobs: RAY_TPU_SHARD_BENCH_PROXIES (default 2),
+RAY_TPU_SHARD_BENCH_N (sequential reqs, default 300).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _phase_summary(snap: dict, name: str) -> dict:
+    """{phase: {count, total_s}} summed across sources for one histogram."""
+    rec = snap.get(name)
+    if not rec:
+        return {}
+    out: dict = {}
+    for series in rec.get("series", {}).values():
+        for tags, st in series:
+            phase = dict(tuple(t) for t in tags).get("phase", "?")
+            agg = out.setdefault(phase, {"count": 0, "total_s": 0.0})
+            agg["count"] += int(st.get("count", 0))
+            agg["total_s"] = round(agg["total_s"] + st.get("sum", 0.0), 4)
+    return out
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu import serve
+
+    num_proxies = int(os.environ.get("RAY_TPU_SHARD_BENCH_PROXIES", "2"))
+    N = int(os.environ.get("RAY_TPU_SHARD_BENCH_N", "300"))
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=32, num_workers=2, max_workers=10)
+    rows = []
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=32)
+    def noop(req):
+        return {"ok": True}
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    def blob(req):
+        # byte result above the zero-copy threshold: rides the object
+        # plane back as a result_ref, served as application/octet-stream
+        n = int((req.get("body") or {}).get("n") or (1 << 20))
+        return b"y" * n
+
+    serve.run(noop.bind(), name="noop", route_prefix="/noop")
+    serve.run(blob.bind(), name="blob", route_prefix="/blob")
+    serve.start(http_port=0, num_proxies=num_proxies)
+    host, port = serve.http_address()
+    st = serve.proxy_status()
+    print(f"proxy plane: {st['num_proxies']} shards on {host}:{port} "
+          f"({st['mode']})")
+
+    def req(conn, path, body):
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+
+    warm = http.client.HTTPConnection(host, port, timeout=30)
+    assert req(warm, "/noop", b"{}")[0] == 200
+    warm.close()
+
+    # sequential noop latency over one keep-alive connection
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        req(conn, "/noop", b"{}")
+    dt = (time.perf_counter() - t0) / N
+    conn.close()
+    rows.append({"name": "http_noop_sequential_sharded",
+                 "ops_per_s": round(1 / dt, 1),
+                 "us_per_op": round(dt * 1e6, 1)})
+    print(f"http_noop_sequential_sharded: {1/dt:,.0f} req/s")
+
+    # concurrent noop throughput (16 client threads, keep-alive each)
+    CT, PER = 16, 60
+    done: list = []
+
+    def worker():
+        c = http.client.HTTPConnection(host, port, timeout=30)
+        n = sum(1 for _ in range(PER) if req(c, "/noop", b"{}")[0] == 200)
+        c.close()
+        done.append(n)
+
+    threads = [threading.Thread(target=worker) for _ in range(CT)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    ok = sum(done)
+    assert ok == CT * PER, f"dropped requests: {ok}/{CT * PER}"
+    rows.append({"name": "http_noop_concurrent16_sharded",
+                 "ops_per_s": round(ok / wall, 1),
+                 "us_per_op": round(wall / max(ok, 1) * 1e6, 1)})
+    print(f"http_noop_concurrent16_sharded: {ok/wall:,.0f} req/s ({ok} ok)")
+
+    # zero-copy payload row: ~1 MiB JSON body up, 1 MiB bytes back — both
+    # legs above the threshold, so neither moves as a pickled RPC payload
+    MB = 1 << 20
+    big_body = json.dumps({"n": MB, "pad": "x" * MB}).encode()
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    BN = 30
+    t0 = time.perf_counter()
+    for _ in range(BN):
+        status, payload = req(conn, "/blob", big_body)
+        assert status == 200 and len(payload) == MB, (status, len(payload))
+    bdt = (time.perf_counter() - t0) / BN
+    conn.close()
+    mb_per_s = (len(big_body) + MB) / MB / bdt
+    rows.append({"name": "http_zero_copy_1mib_roundtrip",
+                 "ops_per_s": round(1 / bdt, 1),
+                 "mb_per_s": round(mb_per_s, 1),
+                 "us_per_op": round(bdt * 1e6, 1)})
+    print(f"http_zero_copy_1mib_roundtrip: {1/bdt:,.1f} req/s "
+          f"({mb_per_s:,.0f} MB/s)")
+
+    # phase attribution + plane gauges from the GCS aggregate (shard phase
+    # observes arrive batched, on the telemetry flush interval)
+    time.sleep(1.5)
+    from ray_tpu._private.api import _get_worker
+
+    snap = _get_worker().rpc({"type": "metrics_snapshot"})["metrics"]
+    phases = _phase_summary(snap, "ray_tpu_serve_proxy_phase_seconds")
+
+    # speedup vs the single-proxy baseline already in the artifact
+    baseline = {}
+    try:
+        with open(os.path.join(_ROOT, "SERVE_BENCH.json")) as f:
+            baseline = {r["name"]: r["ops_per_s"]
+                        for r in json.load(f).get("results", [])}
+    except (OSError, ValueError, KeyError):
+        pass
+    base = baseline.get("http_noop_concurrent16")
+    speedup = (round(rows[1]["ops_per_s"] / base, 2) if base else None)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    from ray_tpu.scripts._artifacts import merge_artifact
+
+    payload = {
+        "num_proxies": num_proxies,
+        "cpus": os.cpu_count(),
+        "rows": rows,
+        "speedup_vs_single_proxy_concurrent16": speedup,
+        "proxy_phase_seconds": phases,
+    }
+    if (os.cpu_count() or 1) <= 2:
+        # shards contend for the same core(s): the row proves the plane
+        # costs ~nothing at parity, NOT the multi-core scale-out it exists
+        # for — rerun on a >=8-core host for the ingress-scaling number
+        payload["note"] = (f"{os.cpu_count()}-core host: shards serialize "
+                           "on the CPU; expect ~linear ingress scaling only "
+                           "with cores to spread across")
+    print("wrote", merge_artifact("SERVE_BENCH.json", "sharded", payload))
+    print(json.dumps(payload, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

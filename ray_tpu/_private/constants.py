@@ -32,6 +32,15 @@ SHM_CHANNEL_PREFIX = "rtpu_chan_"
 #: glob matching every live channel segment (teardown/leak sweeps).
 SHM_CHANNEL_GLOB = SHM_DIR + "/" + SHM_CHANNEL_PREFIX + "*"
 
+#: serve routing-table broadcast segments (single writer = the serve
+#: controller, many readers = the proxy shards): f"{SHM_ROUTING_PREFIX}{nonce}"
+#: under SHM_DIR. The controller creates/unlinks the segment with the proxy
+#: plane's lifecycle; chaos leak checks glob SHM_ROUTING_GLOB.
+SHM_ROUTING_PREFIX = "rtpu_routes_"
+
+#: glob matching every live routing-table segment (teardown/leak sweeps).
+SHM_ROUTING_GLOB = SHM_DIR + "/" + SHM_ROUTING_PREFIX + "*"
+
 # ----------------------------------------------------- cross-process methods
 
 #: actor-task method name the worker routes to the compiled-DAG channel
@@ -58,6 +67,23 @@ SERVE_CONTROLLER_NAME = "SERVE_CONTROLLER"
 #: "_system") — the controller's crash-recovery re-adopts replicas by
 #: exactly this name, so creator and recovery must share the scheme.
 SERVE_REPLICA_NAME_PREFIX = "SERVE_REPLICA:"
+
+#: sharded proxy-plane workers are named
+#: f"{SERVE_PROXY_NAME_PREFIX}{index}:{nonce}:{gen}" (namespace "_system") —
+#: the controller starts, health-checks, replaces, and crash-recovery
+#: re-adopts proxy shards by exactly this name, mirroring the replica scheme
+#: above. `gen` is a plane-wide generation counter persisted BEFORE each
+#: create: a SIGKILLed shard can hold its name past its death, so a
+#: replacement must never reuse it.
+SERVE_PROXY_NAME_PREFIX = "SERVE_PROXY:"
+
+#: request-envelope key carrying a zero-copy body reference: when an HTTP
+#: body exceeds RayConfig.serve_zero_copy_threshold_bytes the proxy `put`s
+#: the raw bytes into the arena object plane and ships the object id hex
+#: under this key instead of pickling the body through fast-RPC; the replica
+#: unwraps it before user code runs. Producer (proxy) and consumer (replica)
+#: live in different processes, so the key is wire protocol.
+SERVE_BODY_REF_KEY = "__rtpu_body_ref__"
 
 # ---------------------------------------------------------------- mesh axes
 

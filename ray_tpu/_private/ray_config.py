@@ -193,6 +193,32 @@ class RayConfig:
     # serving process retains (and ships to the GCS request log) so a slow
     # request can be explained after the fact without sampling luck.
     serve_flight_recorder_size: int = 256
+    # --- serve proxy plane ----------------------------------------------
+    # Number of proxy shard processes serve.start() launches when the
+    # sharded plane is requested without an explicit num_proxies. 0 keeps
+    # the legacy single in-driver ProxyActor (the default: tests and small
+    # deployments need no extra worker processes).
+    serve_num_proxies: int = 0
+    # Ceiling on buffered HTTP request bodies: a Content-Length above this
+    # is refused with 413 before any body bytes are read, and a chunked/
+    # unframed body is cut off at the cap. Headers are bounded separately
+    # (http_server.MAX_HEADER_BYTES).
+    serve_max_http_body_bytes: int = 64 * 1024 * 1024
+    # Zero-copy payload threshold: HTTP bodies / replica results at or
+    # above this many bytes move proxy<->replica through the arena object
+    # plane (envelope carries the object id, never a pickled body through
+    # fast-RPC or the GCS). Must exceed inline_object_limit or the "zero
+    # copy" path would just move the bytes into the GCS table instead.
+    serve_zero_copy_threshold_bytes: int = 256 * 1024
+    # Serve telemetry batching: when > 0, proxy-shard phase observes are
+    # buffered locally and flushed into the real histograms once per this
+    # interval (one lock acquisition per flush instead of per request).
+    # 0 = observe synchronously per request (the legacy single proxy).
+    serve_telemetry_flush_s: float = 0.5
+    # Capacity of the seqlock shm segment the controller publishes the
+    # routing table into. A table that serializes past this falls back to
+    # controller-RPC refresh (proxies log once and keep serving).
+    serve_routing_shm_bytes: int = 1 << 20
     # HTTP proxy per-request budget: ceiling on the blocking handle call
     # behind each non-streaming HTTP request (previously a hardcoded 60 s).
     # A request carrying its own deadline (x-ray-tpu-deadline-s header)

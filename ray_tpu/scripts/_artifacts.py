@@ -19,3 +19,26 @@ def write_artifact(name: str, payload: dict) -> str:
         json.dump({"ts": time.strftime("%Y-%m-%d %H:%M"), **payload}, f,
                   indent=1)
     return path
+
+
+def merge_artifact(name: str, section: str, payload) -> str:
+    """Write ONE top-level section of a shared artifact, preserving every
+    other section (the merge discipline llm_load_bench uses for
+    LLM_BENCH.json's ``pd`` section): SERVE_BENCH.json is shared by
+    serve_bench's baseline ``results`` and serve_shard_bench's ``sharded``
+    section — a rerun of either must not clobber the other."""
+    path = os.path.join(repo_root(), name)
+    prior = {}
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        pass
+    prior.pop("ts", None)
+    prior[section] = payload
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"ts": time.strftime("%Y-%m-%d %H:%M"), **prior}, f,
+                  indent=1)
+    os.replace(tmp, path)
+    return path

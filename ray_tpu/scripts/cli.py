@@ -554,7 +554,14 @@ def cmd_serve(args):
         serve.deploy(args.target)
         print(f"deployed applications from {args.target}")
     elif args.action == "status":
-        print(json.dumps(serve.status(), indent=1, default=str))
+        out = {"applications": serve.status()}
+        try:
+            plane = serve.proxy_status()
+        except Exception:  # noqa: BLE001 — controller without the RPC yet
+            plane = None
+        if plane is not None:
+            out["proxy_plane"] = plane
+        print(json.dumps(out, indent=1, default=str))
 
 
 def cmd_job(args):

@@ -140,9 +140,10 @@ def main():
 
     serve.shutdown()
     ray_tpu.shutdown()
-    from ray_tpu.scripts._artifacts import write_artifact
+    from ray_tpu.scripts._artifacts import merge_artifact
 
-    print("wrote", write_artifact("SERVE_BENCH.json", {"results": results}))
+    # section-preserving write: serve_shard_bench owns the "sharded" section
+    print("wrote", merge_artifact("SERVE_BENCH.json", "results", results))
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     http_address,
+    proxy_status,
     run,
     shutdown,
     start,
@@ -40,6 +41,7 @@ __all__ = [
     "get_multiplexed_model_id",
     "http_address",
     "multiplexed",
+    "proxy_status",
     "run",
     "shutdown",
     "start",

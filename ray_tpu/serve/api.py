@@ -16,6 +16,7 @@ from ray_tpu.serve.deployment import Application
 from ray_tpu.serve.handle import DeploymentHandle
 
 _proxy = None
+_proxy_plane_addr = None  # (host, port) of the sharded ingress, when up
 
 
 def _get_controller(create: bool = False):
@@ -78,11 +79,39 @@ def _resolve_controller(timeout_s: float = 5.0):
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
-          proxy: bool = True):
-    """Ensure controller (and optionally the HTTP proxy) are up."""
-    global _proxy
+          proxy: bool = True, num_proxies: int | None = None):
+    """Ensure controller (and optionally the HTTP ingress) are up.
+
+    ``num_proxies`` selects the ingress topology: 0 (the default, via
+    `RayConfig.serve_num_proxies`) keeps the original single in-driver
+    ProxyActor; >= 1 starts the controller-managed sharded proxy plane —
+    N workers accepting on ONE port (SO_REUSEPORT, or fd-passed acceptor
+    where unavailable), routing from the controller's shm routing-table
+    broadcast."""
+    global _proxy, _proxy_plane_addr
+    from ray_tpu._private.ray_config import RayConfig
+
     controller = _get_controller(create=True)
-    if proxy and _proxy is None:
+    if num_proxies is None:
+        num_proxies = RayConfig.instance().serve_num_proxies
+    if not proxy:
+        return controller
+    if num_proxies and num_proxies > 0:
+        if _proxy_plane_addr is None:
+            st = ray_tpu.get(controller.start_proxy_plane.remote(
+                http_host, http_port, int(num_proxies)), timeout=60.0)
+            _proxy_plane_addr = (st["host"], st["port"])
+            # wait until at least one shard is accepting: callers (and
+            # every existing test idiom) expect start() to return a
+            # connectable ingress
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st = ray_tpu.get(controller.proxy_status.remote())
+                if st and any(s.get("state") == "running"
+                              for s in st["shards"].values()):
+                    break
+                time.sleep(0.05)
+    elif _proxy is None:
         from ray_tpu.serve.proxy import ProxyActor
 
         _proxy = ProxyActor.options(num_cpus=0.5, max_concurrency=32).remote(
@@ -160,13 +189,22 @@ def delete(name: str = "default"):
 
 
 def http_address() -> tuple[str, int] | None:
+    if _proxy_plane_addr is not None:
+        return tuple(_proxy_plane_addr)
     if _proxy is None:
         return None
     return tuple(ray_tpu.get(_proxy.address.remote()))
 
 
+def proxy_status() -> dict | None:
+    """Sharded proxy plane status (shard states/health), or None when the
+    plane isn't running."""
+    return ray_tpu.get(_get_controller().proxy_status.remote())
+
+
 def shutdown():
-    global _proxy
+    global _proxy, _proxy_plane_addr
+    _proxy_plane_addr = None  # plane teardown rides controller.shutdown
     try:
         controller = _get_controller()
     except RuntimeError:

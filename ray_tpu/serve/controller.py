@@ -31,16 +31,21 @@ actor-state="dead" path.
 from __future__ import annotations
 
 import math
+import os
+import tempfile
 import threading
 import time
 import uuid
 
 import ray_tpu
 from ray_tpu._private.constants import (SERVE_CONTROLLER_NAME,
+                                        SERVE_PROXY_NAME_PREFIX,
                                         SERVE_REPLICA_NAME_PREFIX)
+from ray_tpu._private.ray_config import RayConfig
 from ray_tpu.actor import ActorHandle
-from ray_tpu.serve.gcs_state import (META_KEY, blob_key, dep_key,
-                                     gcs_serve_store, rep_key)
+from ray_tpu.serve.gcs_state import (META_KEY, PROXY_PLANE_KEY, blob_key,
+                                     dep_key, gcs_serve_store, proxy_key,
+                                     rep_key)
 from ray_tpu.serve.replica import ReplicaActor
 
 CONTROLLER_NAME = SERVE_CONTROLLER_NAME
@@ -75,6 +80,14 @@ def _probe_failure_counter():
         Counter, "ray_tpu_serve_replica_health_check_failures_total",
         "serve replica health-check probe failures",
         tag_keys=("deployment", "replica"))
+
+
+def _proxy_shards_gauge():
+    from ray_tpu.util.metrics import Gauge, get_or_create
+
+    return get_or_create(
+        Gauge, "ray_tpu_serve_proxy_shards",
+        "serve proxy-plane shard workers currently running")
 
 
 def _count(fn):
@@ -165,6 +178,22 @@ class ServeController:
         self._stop = False
         self._reconcile_dirty = False  # probe path requests one batched bump
         self._store = _store if _store is not None else gcs_serve_store()
+        # sharded proxy plane (started on demand by start_proxy_plane):
+        # shard fleet state mirrors the replica bookkeeping — persisted
+        # rows, probe counters, health strings — plus the plane-scoped
+        # singletons: the shm routing broadcast, the SO_REUSEPORT port
+        # holder, and (fallback mode) the listener-fd donor
+        self._proxy_plane: dict | None = None
+        self._proxies: dict[int, object] = {}      # index → ActorHandle
+        self._proxy_rows: dict[int, dict] = {}
+        self._proxy_addrs: dict[int, tuple] = {}
+        self._proxy_health: dict[int, str] = {}
+        self._proxy_probe_fail: dict[int, int] = {}
+        self._proxy_probe_inflight: dict[int, tuple] = {}
+        self._proxy_probe_last: dict[int, float] = {}
+        self._routes_shm = None
+        self._port_holder = None
+        self._fd_donor = None
         self._recover()
         self._thread = None
         if _start_loop:
@@ -183,9 +212,29 @@ class ServeController:
     def _bump_version(self) -> None:
         """Version bumps are persisted with their routes/apps so a recovered
         controller can never reuse a (version, content) pair a router cached
-        before the crash (recovery restarts from persisted version + 1)."""
+        before the crash (recovery restarts from persisted version + 1).
+        When the proxy plane is up, every bump is also broadcast into the
+        shm routing segment so shards see it without an RPC."""
         self.version += 1
         self._persist_meta()
+        self._publish_routes()
+
+    def _publish_routes(self) -> None:
+        """Publish the full routing table into the plane's shm segment
+        (no-op without a plane). Also called once per reconcile pass with
+        an unchanged version: the fresh publish timestamp is the shards'
+        controller-liveness heartbeat (their routing-table-age gauge)."""
+        try:
+            with self._lock:  # RLock: safe from _bump_version under lock
+                if self._routes_shm is None:
+                    return
+                table = self.get_routing_table(-1)
+                self._routes_shm.publish(table)
+        except Exception as e:  # noqa: BLE001 — shards fall back to RPC
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "routing-table shm publish failed: %r", e)
 
     def _persist_dep(self, st: _DeploymentState) -> None:
         self._store.put(dep_key(st.full_name), st.to_record())
@@ -300,9 +349,59 @@ class ServeController:
         _count(lambda: _recoveries_counter().inc())
         if readopted:
             _count(lambda: _readopted_counter().inc(readopted))
+        self._recover_proxy_plane(rows)
         # force every router to refetch: the rebuilt table content may
-        # differ from anything cached under the persisted version
+        # differ from anything cached under the persisted version (with a
+        # recovered plane, this also re-publishes into the shm segment)
         self._bump_version()
+
+    def _recover_proxy_plane(self, rows: dict) -> None:
+        """Re-adopt a persisted proxy plane: ATTACH the existing shm
+        segment (live shard readers hold mmaps of that inode — an
+        unlink+recreate would silently split the plane into two segments),
+        re-reserve the port, and re-adopt live shards by named-actor
+        lookup exactly like replicas. Dead shards are reaped; the first
+        reconcile replaces them."""
+        plane = rows.get(PROXY_PLANE_KEY)
+        if not plane:
+            return
+        from ray_tpu.serve import proxy_plane as pp
+
+        self._proxy_plane = dict(plane)
+        try:
+            self._routes_shm = pp.create_routing_shm(
+                plane["nonce"],
+                RayConfig.instance().serve_routing_shm_bytes)
+        except OSError:
+            self._routes_shm = None
+        if not plane.get("fd_mode"):
+            try:
+                self._port_holder = pp.reserve_port(plane["host"],
+                                                    plane["port"])
+            except OSError:
+                pass  # another holder (or a shard) keeps the port pinned
+        # fd-passing mode cannot rebuild its donor: the shared acceptor
+        # socket died with the previous incarnation, and the port is held
+        # (without SO_REUSEPORT) by the surviving shards' fds. Existing
+        # shards keep serving; replacements wait for a plane restart.
+        now_mono = time.monotonic()
+        for key, rec in rows.items():
+            if not key.startswith("proxy:"):
+                continue
+            idx = int(rec["index"])
+            aid = self._lookup_named(rec["actor_name"])
+            alive = (aid is not None
+                     and self._actor_state(aid) in ("alive", "pending",
+                                                    "restarting"))
+            if not alive:
+                self._store.delete(key)
+                continue
+            self._proxies[idx] = ActorHandle(aid)
+            self._proxy_rows[idx] = dict(rec)
+            if rec.get("addr"):
+                self._proxy_addrs[idx] = tuple(rec["addr"])
+            self._proxy_health[idx] = "recovering"
+            self._proxy_probe_last[idx] = now_mono
 
     # ------------------------------------------------------------------- api
 
@@ -401,9 +500,247 @@ class ServeController:
                 for full, st in self.deployments.items()
             }
 
+    # ----------------------------------------------------------- proxy plane
+
+    def start_proxy_plane(self, host: str, port: int,
+                          num_proxies: int) -> dict:
+        """Start (idempotently) the sharded proxy plane: pin the ingress
+        port, create the shm routing broadcast, publish the current table,
+        and start N shard workers. Persisted (plane row + per-shard rows)
+        before each side effect, same discipline as replicas."""
+        with self._lock:
+            if self._proxy_plane is not None:
+                return self.proxy_status()
+            from ray_tpu.serve import proxy_plane as pp
+
+            nonce = uuid.uuid4().hex[:8]
+            fd_mode = not pp.REUSEPORT_AVAILABLE
+            uds_path = None
+            if fd_mode:
+                # one shared acceptor, fds donated to every shard. The UDS
+                # lives in tmpdir (NOT /dev/shm — it is not an rtpu shm
+                # segment and must not trip leak sweeps)
+                listen = pp.make_listen_socket(host, port)
+                port = listen.getsockname()[1]
+                uds_path = os.path.join(
+                    tempfile.gettempdir(), f"serve-proxy-fds-{nonce}.sock")
+                self._fd_donor = pp.ListenerFdDonor(listen, uds_path)
+            else:
+                # bound-not-listening holder pins the concrete port for
+                # the fleet without receiving any connections
+                self._port_holder = pp.reserve_port(host, port)
+                port = self._port_holder.getsockname()[1]
+            plane = {"host": host, "port": int(port),
+                     "num_proxies": int(num_proxies), "nonce": nonce,
+                     "fd_mode": fd_mode, "uds_path": uds_path,
+                     "next_gen": 0}
+            self._store.put(PROXY_PLANE_KEY, plane)
+            self._proxy_plane = plane
+            self._routes_shm = pp.create_routing_shm(
+                nonce, RayConfig.instance().serve_routing_shm_bytes)
+            self._publish_routes()
+            for i in range(plane["num_proxies"]):
+                self._start_proxy_locked(i)
+            _count(lambda: _proxy_shards_gauge().set(
+                float(len(self._proxies))))
+            return self.proxy_status()
+
+    def _start_proxy_locked(self, index: int) -> None:
+        plane = self._proxy_plane
+        # the generation is burned (persisted) BEFORE the create: a
+        # SIGKILLed shard may still hold its actor name, so a replacement
+        # must never reuse it — mirrors the replica next_idx discipline
+        gen = plane.get("next_gen", 0)
+        plane["next_gen"] = gen + 1
+        self._store.put(PROXY_PLANE_KEY, plane)
+        actor_name = (f"{SERVE_PROXY_NAME_PREFIX}"
+                      f"{index}:{plane['nonce']}:{gen}")
+        row = {"index": index, "actor_name": actor_name, "actor_id": None,
+               "addr": None, "state": "starting"}
+        self._proxy_rows[index] = row
+        self._store.put(proxy_key(index), row)
+        from ray_tpu.serve.proxy import ProxyActor
+
+        try:
+            handle = ProxyActor.options(
+                name=actor_name, namespace="_system",
+                num_cpus=0.5, max_concurrency=32,
+            ).remote(plane["host"], plane["port"], shard_index=index,
+                     plane_nonce=plane["nonce"],
+                     fd_sock_path=plane.get("uds_path"))
+        except Exception:  # noqa: BLE001 — retry next reconcile tick
+            self._store.delete(proxy_key(index))
+            self._proxy_rows.pop(index, None)
+            return
+        row["actor_id"] = handle.actor_id
+        self._store.put(proxy_key(index), row)
+        self._proxies[index] = handle
+        self._proxy_health[index] = "recovering"  # until ready/first probe
+        self._proxy_probe_last[index] = time.monotonic()
+
+    def note_proxy_ready(self, index: int, addr) -> None:
+        """Shard pushes its bound HTTP (host, port) once its server is up
+        (mirrors note_replica_addr). Marks the row running."""
+        with self._lock:
+            if index not in self._proxies:
+                return  # already replaced: ignore the stale push
+            addr = tuple(addr)
+            self._proxy_addrs[index] = addr
+            row = self._proxy_rows.get(index)
+            if row is not None and (row.get("addr") != list(addr)
+                                    or row.get("state") != "running"):
+                row["addr"] = list(addr)
+                row["state"] = "running"
+                self._store.put(proxy_key(index), row)
+            self._proxy_health[index] = "healthy"
+
+    def proxy_status(self) -> dict | None:
+        """Operator/CLI view of the proxy plane (None when not started)."""
+        with self._lock:
+            plane = self._proxy_plane
+            if plane is None:
+                return None
+            return {
+                "host": plane["host"], "port": plane["port"],
+                "num_proxies": plane["num_proxies"],
+                "mode": "fd_passing" if plane.get("fd_mode") else "reuseport",
+                "shards": {
+                    str(i): {"state": row.get("state"),
+                             "health": self._proxy_health.get(i),
+                             "addr": row.get("addr")}
+                    for i, row in sorted(self._proxy_rows.items())
+                },
+            }
+
+    def _reconcile_proxies_locked(self, lookup: dict, now: float,
+                                  stats_ok: bool) -> None:
+        """Shard fleet reconcile (runs under the lock, once per pass):
+        reap dead shards, probe live ones, start replacements up to the
+        plane's target count."""
+        plane = self._proxy_plane
+        if plane is None:
+            return
+        if stats_ok:
+            dead = [i for i, h in self._proxies.items()
+                    if lookup.get(h.actor_id, {}).get("state") == "dead"]
+            for i in dead:
+                self._proxies.pop(i)
+                self._proxy_addrs.pop(i, None)
+                self._forget_proxy_probe(i)
+                self._store.delete(proxy_key(i))
+                self._proxy_rows.pop(i, None)
+            self._probe_proxy_health(lookup, now)
+        if not plane.get("fd_mode") or self._fd_donor is not None:
+            for i in range(plane["num_proxies"]):
+                if i not in self._proxies:
+                    self._start_proxy_locked(i)
+        _count(lambda: _proxy_shards_gauge().set(float(len(self._proxies))))
+
+    def _forget_proxy_probe(self, index: int) -> None:
+        self._proxy_probe_fail.pop(index, None)
+        self._proxy_probe_inflight.pop(index, None)
+        self._proxy_probe_last.pop(index, None)
+        self._proxy_health.pop(index, None)
+
+    _PROXY_PROBE_PERIOD_S = 2.0
+    _PROXY_PROBE_TIMEOUT_S = 10.0
+
+    def _probe_proxy_health(self, lookup: dict, now: float) -> None:
+        """Same probe machine as replicas: raising probes count toward the
+        failure threshold, a hung probe replaces immediately."""
+        for i, h in list(self._proxies.items()):
+            ref, sent = self._proxy_probe_inflight.get(i, (None, 0.0))
+            if ref is not None:
+                done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                if done:
+                    self._proxy_probe_inflight.pop(i, None)
+                    try:
+                        ray_tpu.get(ref, timeout=5.0)
+                        self._proxy_probe_fail[i] = 0
+                        self._proxy_health[i] = "healthy"
+                    except Exception:  # noqa: BLE001 — failed probe
+                        self._proxy_probe_failed(i)
+                elif now - sent > self._PROXY_PROBE_TIMEOUT_S:
+                    self._proxy_probe_inflight.pop(i, None)
+                    self._proxy_probe_failed(i, hung=True)
+                continue
+            if lookup.get(h.actor_id, {}).get("state") != "alive":
+                continue  # still starting: don't time its init
+            if now - self._proxy_probe_last.get(i, 0.0) \
+                    >= self._PROXY_PROBE_PERIOD_S:
+                self._proxy_probe_last[i] = now
+                try:
+                    self._proxy_probe_inflight[i] = (h.check_health.remote(),
+                                                     now)
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    import logging
+
+                    logging.getLogger(__name__).debug(
+                        "proxy shard %d probe submit failed: %r", i, e)
+
+    def _proxy_probe_failed(self, index: int, hung: bool = False) -> None:
+        self._proxy_probe_fail[index] = \
+            self._proxy_probe_fail.get(index, 0) + 1
+        self._proxy_health[index] = "unhealthy-probing"
+        if hung or (self._proxy_probe_fail[index]
+                    >= HEALTH_PROBE_FAILURE_THRESHOLD):
+            # no graceful drain for an unhealthy ingress: surviving shards
+            # (their own listen sockets / fd copies) keep accepting; kill
+            # and let this same pass start the replacement
+            h = self._proxies.pop(index, None)
+            self._proxy_addrs.pop(index, None)
+            self._forget_proxy_probe(index)
+            self._store.delete(proxy_key(index))
+            self._proxy_rows.pop(index, None)
+            if h is not None:
+                self._kill_replica(h)
+
+    def _teardown_proxy_plane_locked(self) -> None:
+        """Kill every shard and release the plane singletons; the shm
+        routing segment is unlinked here (leak sweeps glob
+        SHM_ROUTING_GLOB)."""
+        if self._proxy_plane is None and not self._proxies:
+            return
+        # persist the teardown intent FIRST: a crash mid-teardown must
+        # recover to "no plane", never re-adopt half-killed shards
+        try:
+            self._store.delete(PROXY_PLANE_KEY)
+            for i in list(self._proxy_rows):
+                self._store.delete(proxy_key(i))
+        except Exception as e:  # noqa: BLE001 — teardown must not raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "proxy plane row cleanup failed (GCS down?): %r", e)
+        for h in self._proxies.values():
+            self._kill_replica(h)
+        self._proxies.clear()
+        self._proxy_addrs.clear()
+        self._proxy_rows.clear()
+        self._proxy_probe_fail.clear()
+        self._proxy_probe_inflight.clear()
+        self._proxy_probe_last.clear()
+        self._proxy_health.clear()
+        if self._fd_donor is not None:
+            self._fd_donor.close()
+            self._fd_donor = None
+        if self._port_holder is not None:
+            try:
+                self._port_holder.close()
+            except OSError:
+                pass
+            self._port_holder = None
+        if self._routes_shm is not None:
+            self._routes_shm.close()
+            self._routes_shm.unlink()
+            self._routes_shm = None
+        self._proxy_plane = None
+        _count(lambda: _proxy_shards_gauge().set(0.0))
+
     def shutdown(self) -> None:
         with self._lock:
             self._stop = True
+            self._teardown_proxy_plane_locked()
             # hard teardown: kill every replica now — the reconcile loop that
             # would finish a graceful drain is about to exit
             for st in self.deployments.values():
@@ -499,9 +836,16 @@ class ServeController:
                     self._store.delete(dep_key(full))
                     self._store.delete(blob_key(full, st.nonce))
                     changed = True
+            self._reconcile_proxies_locked(lookup, now, stats_ok)
             if changed or self._reconcile_dirty:
                 self._reconcile_dirty = False
                 self._bump_version()
+            else:
+                # heartbeat republish (same version, fresh timestamp):
+                # shards' routing-table age gauge measures controller
+                # liveness from this, and a reader that raced a torn
+                # publish converges within one pass
+                self._publish_routes()
 
     # --------------------------------------------------------- health probes
 

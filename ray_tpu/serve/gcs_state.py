@@ -33,6 +33,15 @@ Row key scheme (one flat keyspace, prefix-typed):
                         ∈ {starting, running, unhealthy, draining,
                         stopping}, drain deadline (wall clock — must stay
                         meaningful across processes).
+    proxy_plane       — the sharded proxy plane's config: ingress host,
+                        pinned port, shard count, nonce (names the shm
+                        routing segment and the shard actors), accept
+                        mode (reuseport vs fd-passing), next shard
+                        generation counter (burned before each shard
+                        create, like dep next_idx).
+    proxy:<index>     — one row per proxy shard: actor name (for
+                        named-actor re-adoption), actor id, HTTP addr,
+                        state ∈ {starting, running}.
 
 The invariant consumers rely on (same contract as the autoscaler's
 instance machine): **every mutation is persisted before its side effect
@@ -47,10 +56,15 @@ from __future__ import annotations
 from typing import Callable, Dict
 
 META_KEY = "meta"
+PROXY_PLANE_KEY = "proxy_plane"
 
 
 def dep_key(full_name: str) -> str:
     return f"dep:{full_name}"
+
+
+def proxy_key(index: int) -> str:
+    return f"proxy:{index}"
 
 
 def rep_key(full_name: str, tag: str) -> str:

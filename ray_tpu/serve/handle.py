@@ -27,6 +27,18 @@ logger = logging.getLogger(__name__)
 
 ROUTING_REFRESH_S = 1.0
 
+# process-local routing-table source (proxy shards install one backed by
+# the controller's shm broadcast): fn(known_version) -> full table dict, or
+# None when known_version is already current. When set, in-process routers
+# refresh from it instead of RPCing the controller — the sharded proxy's
+# request path never blocks on a controller round-trip.
+_local_table_source = None
+
+
+def set_local_table_source(fn) -> None:
+    global _local_table_source
+    _local_table_source = fn
+
 
 def _new_cancel_key() -> str:
     """Per-request cancellation address: rides the request to the replica,
@@ -70,6 +82,14 @@ class _Pending:
             raise TimeoutError(f"fast-rpc call timed out after {timeout_s}s")
         if self.reply is None:  # woken by channel death
             raise _channel_dead_error()
+        if "result_ref" in self.reply:
+            # zero-copy result lane: payloads above the threshold ride the
+            # arena object plane — the frame carries only the object-id
+            # hex, the bytes move through shm on this fetch
+            import ray_tpu
+
+            return ray_tpu.get(ray_tpu.ObjectRef(self.reply["result_ref"]),
+                               timeout=30.0)
         if "result_ser" in self.reply or "error_ser" in self.reply:
             # cloudpickle fallback lane (payload the frame codec refused)
             from ray_tpu._private import serialization as ser
@@ -367,6 +387,21 @@ class _Router:
         if not force and now - self._last_refresh < ROUTING_REFRESH_S:
             return
         self._last_refresh = now
+        with self._lock:  # snapshot: version is written under this lock
+            known_version = self.version
+        src = _local_table_source
+        if src is not None:
+            # shm-backed source (proxy shards): version-checked local read,
+            # no controller RPC on the request path. A source failure falls
+            # back to the cached table, same as an RPC outage would.
+            try:
+                table = src(known_version)
+            except Exception as e:  # noqa: BLE001 — keep serving cached
+                logger.debug("local table source failed: %r", e)
+                return
+            if table is not None:
+                self._apply_table(table)
+            return
         try:
             # the table fetch is ASYNC with a short completion wait: during
             # a controller outage (crash-restart queues the call) pick()
@@ -376,7 +411,7 @@ class _Router:
             # refresh tick.
             if self._pending_table is None:
                 self._pending_table = self._controller_handle() \
-                    .get_routing_table.remote(self.version)
+                    .get_routing_table.remote(known_version)
             done, _ = ray_tpu.wait([self._pending_table], num_returns=1,
                                    timeout=1.0 if force else 0.25)
             if not done:
@@ -393,6 +428,9 @@ class _Router:
             return
         if table is None:
             return
+        self._apply_table(table)
+
+    def _apply_table(self, table: dict):
         with self._lock:
             self.version = table["version"]
             dep = table["deployments"].get(self.name)

@@ -30,8 +30,25 @@ logger = logging.getLogger(__name__)
 class _BadRequest(Exception):
     pass
 
+
+class _PayloadTooLarge(Exception):
+    """Declared Content-Length exceeds the configured cap — answered with
+    413 WITHOUT reading the body, so an abusive client can't make the
+    server buffer unbounded bytes per connection."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+
 MAX_HEADER_BYTES = 64 * 1024
-MAX_BODY_BYTES = 512 * 1024 * 1024
+
+
+def _max_body_bytes() -> int:
+    # read through the singleton each request: tests toggle the cap via
+    # env + RayConfig.reset(), and the read is trivial next to a request
+    from ray_tpu._private.ray_config import RayConfig
+
+    return RayConfig.instance().serve_max_http_body_bytes
 
 
 def _observe_accept(seconds: float) -> None:
@@ -55,10 +72,17 @@ class AsyncHTTPServer:
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1",
                  port: int = 0, *, max_connections: int = 1024,
-                 executor_workers: int = 32, drain_grace_s: float = 10.0):
+                 executor_workers: int = 32, drain_grace_s: float = 10.0,
+                 reuse_port: bool = False, sock=None):
         self.handler = handler
         self.host = host
         self.port = port
+        # sharded-ingress plumbing: `reuse_port` lets N sibling servers
+        # bind the same (host, port); `sock` serves from an already-bound
+        # listen socket (the fd-passing fallback hands each shard a dup of
+        # one shared acceptor). Mutually exclusive with each other.
+        self._reuse_port = reuse_port
+        self._sock = sock
         self.drain_grace_s = drain_grace_s
         self._max_connections = max_connections
         self._executor = ThreadPoolExecutor(
@@ -92,8 +116,17 @@ class AsyncHTTPServer:
     async def _serve(self):
         self._conn_sem = asyncio.Semaphore(self._max_connections)
         try:
-            self._server = await asyncio.start_server(
-                self._on_connection, self.host, self.port)
+            if self._sock is not None:
+                self._sock.setblocking(False)
+                self._server = await asyncio.start_server(
+                    self._on_connection, sock=self._sock)
+            elif self._reuse_port:
+                self._server = await asyncio.start_server(
+                    self._on_connection, self.host, self.port,
+                    reuse_port=True)
+            else:
+                self._server = await asyncio.start_server(
+                    self._on_connection, self.host, self.port)
         except OSError as e:  # bind failure surfaces to start() immediately
             self._start_error = e
             self._started.set()
@@ -149,6 +182,21 @@ class AsyncHTTPServer:
                     await writer.drain()
                 except OSError:
                     pass  # client hung up before reading the 400
+            except _PayloadTooLarge as e:
+                # the oversized body was never read, so the connection is
+                # desynchronized — answer and close, never keep-alive
+                try:
+                    body = json.dumps({
+                        "error": "payload too large",
+                        "max_body_bytes": e.limit}).encode()
+                    writer.write(
+                        b"HTTP/1.1 413 X\r\n"
+                        b"Content-Type: application/json\r\n"
+                        + f"Content-Length: {len(body)}\r\n".encode()
+                        + b"Connection: close\r\n\r\n" + body)
+                    await writer.drain()
+                except OSError:
+                    pass  # client hung up before reading the 413
             except (asyncio.IncompleteReadError, ConnectionResetError,
                     asyncio.LimitOverrunError, BrokenPipeError):
                 pass
@@ -180,8 +228,11 @@ class AsyncHTTPServer:
             n = int(headers.get("content-length") or 0)
         except ValueError as e:
             raise _BadRequest from e
-        if n < 0 or n > MAX_BODY_BYTES:
+        if n < 0:
             raise _BadRequest
+        limit = _max_body_bytes()
+        if n > limit:
+            raise _PayloadTooLarge(limit)
         body = await reader.readexactly(n) if n else b""
         return method, path, headers, body
 

@@ -18,6 +18,7 @@ address is known.)
 from __future__ import annotations
 
 import inspect
+import json
 import logging
 import os
 import threading
@@ -25,7 +26,9 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 import ray_tpu
+from ray_tpu._private.constants import SERVE_BODY_REF_KEY
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_tcp
+from ray_tpu._private.ray_config import RayConfig
 from ray_tpu.exceptions import (DeadlineExceededError, RequestCancelledError,
                                 RequestShedError)
 from ray_tpu.serve import request_context as _rc
@@ -139,6 +142,11 @@ class ReplicaActor:
         # cancel frame can overtake a queued data frame)
         self._cancels: dict[str, _CancelHolder] = {}
         self._cancelled_keys: dict[str, float] = {}
+        # zero-copy result lane keepalive: refs for oversized reply
+        # payloads (shipped as object-id hex over fast-RPC) pinned here
+        # until the caller has had its fetch window — dropping the ref at
+        # reply-send would race the consumer's ray_tpu.get
+        self._result_refs: list[tuple[float, object]] = []
         # serve metrics on the cluster metrics plane (reference: serve
         # emits request count/latency per deployment into the metrics
         # agent; the Grafana serve dashboard targets these names)
@@ -293,8 +301,7 @@ class ReplicaActor:
                     msg["method"], args, kwargs, msg.get("model_id"),
                     cancel_key=msg.get("cancel_key"),
                     deadline_ts=msg.get("deadline_ts"))
-            reply = {"rid": rid, "ok": True, "error_text": None,
-                     "result": result}
+            reply = self._build_reply(rid, result)
         except BaseException as e:  # noqa: BLE001 — shipped to the caller
             reply = {"rid": rid, "ok": False, "error": e,
                      "error_text": f"{type(e).__name__}: {e}"}
@@ -338,6 +345,55 @@ class ReplicaActor:
             logger.warning("replica %s rid=%s: could not deliver ANY "
                            "reply (caller will time out): %r",
                            self.replica_tag, rid, e)
+
+    def _build_reply(self, rid, result) -> dict:
+        """Reply envelope for one fast-RPC request. Byte payloads at or
+        above `RayConfig.serve_zero_copy_threshold_bytes` take the
+        zero-copy lane: the bytes go into the arena object plane and the
+        frame carries only the object-id hex — the caller's `_Pending.wait`
+        fetches them through shm. The ref is pinned in `_result_refs` for
+        the caller's fetch window (dropping it at send would race the
+        consumer's get)."""
+        threshold = RayConfig.instance().serve_zero_copy_threshold_bytes
+        if (threshold > 0 and isinstance(result, (bytes, bytearray))
+                and len(result) >= threshold):
+            try:
+                ref = ray_tpu.put(bytes(result))
+                now = time.monotonic()
+                with self._lock:
+                    self._result_refs.append((now, ref))
+                    while self._result_refs and (
+                            now - self._result_refs[0][0] > 30.0
+                            or len(self._result_refs) > 512):
+                        self._result_refs.pop(0)
+                return {"rid": rid, "ok": True, "result_ref": ref.hex()}
+            except Exception as e:  # noqa: BLE001 — fall back to inline
+                logger.debug("replica %s: zero-copy reply put failed, "
+                             "inlining: %r", self.replica_tag, e)
+        return {"rid": rid, "ok": True, "error_text": None, "result": result}
+
+    def _unwrap_body_refs(self, args: tuple) -> tuple:
+        """Zero-copy request lane, consumer side: a request envelope whose
+        body crossed via the arena object plane carries the object-id hex
+        under SERVE_BODY_REF_KEY — fetch the raw bytes (shm-local) and
+        parse them into `body` before user code runs. No-op for inline
+        envelopes, so both planes hand user code the identical request."""
+        if not any(isinstance(a, dict) and SERVE_BODY_REF_KEY in a
+                   for a in args):
+            return args
+        t0 = time.perf_counter()
+        out = []
+        for a in args:
+            if isinstance(a, dict) and SERVE_BODY_REF_KEY in a:
+                a = dict(a)
+                raw = ray_tpu.get(
+                    ray_tpu.ObjectRef(a.pop(SERVE_BODY_REF_KEY)),
+                    timeout=30.0)
+                a["body"] = json.loads(raw) if raw else None
+            out.append(a)
+        _rc.observe_phase(_rc.REPLICA_PHASE, "body_fetch",
+                          time.perf_counter() - t0)
+        return tuple(out)
 
     def _register_cancel(self, cancel_key: str | None) -> _CancelHolder:
         holder = _CancelHolder()
@@ -452,6 +508,7 @@ class ReplicaActor:
                        model_id: str | None = None,
                        cancel_key: str | None = None,
                        deadline_ts: float | None = None):
+        args = self._unwrap_body_refs(args)
         holder, wait_s, w_q = self._enter(cancel_key, deadline_ts)
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()
@@ -506,6 +563,7 @@ class ReplicaActor:
         cancel landing mid-stream interrupts the loop between items and
         closes the user generator (GeneratorExit runs its finally hooks —
         the LLM servers abort their engine request there)."""
+        args = self._unwrap_body_refs(args)
         holder, wait_s, w_q = self._enter(cancel_key, deadline_ts)
         _replica_ctx.model_id = model_id
         t0 = time.perf_counter()

@@ -115,12 +115,89 @@ def observe_phase(metric: str, phase: str, seconds: float,
                   rec: dict | None = None) -> None:
     """Record one phase duration into its pre-bound histogram (no-op when
     serve metrics are off) and, when a flight-recorder entry is being
-    assembled, into its ``phases`` map."""
-    b = phase_observer(metric, phase)
-    if b is not None:
-        b.observe(seconds)
+    assembled, into its ``phases`` map. When a process-wide PhaseBatcher is
+    installed (proxy shards), the observe is buffered and flushed on an
+    interval instead of hitting the bound histogram inline."""
+    batcher = _batcher
+    if batcher is not None:
+        batcher.add(metric, phase, seconds)
+    else:
+        b = phase_observer(metric, phase)
+        if b is not None:
+            b.observe(seconds)
     if rec is not None:
         rec.setdefault("phases", {})[phase] = round(seconds, 6)
+
+
+# --------------------------------------------------------- batched telemetry
+
+_batcher = None  # process-wide PhaseBatcher (proxy shards install one)
+
+
+class PhaseBatcher:
+    """Interval-flushed phase telemetry for the proxy hot path.
+
+    Per-request inline observes cost a registry probe + bound-cache lookup
+    each; a proxy shard doing tens of thousands of requests/s pays that
+    four times per request. The batcher makes the request-path cost one
+    ``list.append`` (atomic under the GIL — no lock on the hot side) and
+    moves the histogram updates to a flush thread that drains the buffer
+    every ``RayConfig.serve_telemetry_flush_s`` seconds, grouping by
+    (metric, phase) so each flush touches each bound histogram once per
+    batch. ``on_flush`` lets the owner piggyback gauge updates (routing
+    table age, shard stats) on the same interval — one timer, one batch.
+    """
+
+    def __init__(self, flush_s: float | None = None, on_flush=None):
+        cfg = RayConfig.instance()
+        self._flush_s = cfg.serve_telemetry_flush_s if flush_s is None \
+            else flush_s
+        self._on_flush = on_flush
+        self._buf: list = []        # (metric, phase, seconds) triples
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-phase-batcher")
+        self._thread.start()
+
+    def add(self, metric: str, phase: str, seconds: float) -> None:
+        self._buf.append((metric, phase, seconds))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._flush_s):
+            self.flush()
+        self.flush()  # final drain so shutdown loses nothing
+
+    def flush(self) -> None:
+        # swap-then-drain: appends racing the swap land in the new list
+        buf, self._buf = self._buf, []
+        if buf and metrics_enabled():
+            grouped: dict = {}
+            for metric, phase, seconds in buf:
+                grouped.setdefault((metric, phase), []).append(seconds)
+            for (metric, phase), vals in grouped.items():
+                b = phase_observer(metric, phase)
+                if b is not None:
+                    for v in vals:
+                        b.observe(v)
+        if self._on_flush is not None:
+            try:
+                self._on_flush()
+            except Exception as e:  # pragma: no cover - gauges best-effort
+                import logging
+
+                logging.getLogger(__name__).debug("on_flush failed: %r", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def set_phase_batcher(batcher: PhaseBatcher | None) -> None:
+    """Install (or clear) the process-wide batcher ``observe_phase`` routes
+    through. Proxy shards install one at startup; everything else keeps
+    the inline path."""
+    global _batcher
+    _batcher = batcher
 
 
 @contextmanager

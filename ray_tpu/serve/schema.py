@@ -27,7 +27,7 @@ _DEPLOYMENT_KEYS = {"name", "num_replicas", "max_ongoing_requests",
                     "graceful_shutdown_timeout_s", "request_router"}
 _APP_KEYS = {"name", "route_prefix", "import_path", "args", "deployments"}
 _ROOT_KEYS = {"applications", "http_options", "proxy_location"}
-_HTTP_KEYS = {"host", "port"}
+_HTTP_KEYS = {"host", "port", "num_proxies"}
 
 
 def _require(cond: bool, where: str, msg: str) -> None:
@@ -208,6 +208,11 @@ class ServeDeploySchema:
         if "port" in http:
             _check_num(http["port"], "config.http_options.port",
                        integer=True, minimum=0)
+        if "num_proxies" in http:
+            # 0 = the legacy single in-driver proxy; >= 1 = the sharded
+            # proxy plane with that many SO_REUSEPORT workers
+            _check_num(http["num_proxies"], "config.http_options.num_proxies",
+                       integer=True, minimum=0)
         return cls(applications=apps, http_options=http)
 
 
@@ -279,7 +284,8 @@ def deploy(config: "ServeDeploySchema | str", *, _blocking: bool = False):
         config = load_config(config)
     http = config.http_options
     api.start(http_host=http.get("host", "127.0.0.1"),
-              http_port=http.get("port", 8000))
+              http_port=http.get("port", 8000),
+              num_proxies=http.get("num_proxies"))
     handles = {}
     for app in config.applications:
         target = _apply_overrides(app.resolve_target(), app.deployments,

@@ -374,3 +374,92 @@ def test_config_only_redeploy_after_recovery(serve_session):
     assert set(ids) <= set(_replica_ids("cr_C")), \
         "config-only redeploy after recovery restarted the adopted replica"
     assert h.remote(5).result(timeout_s=30) == 10
+
+
+def test_proxy_shard_sigkill_under_traffic(serve_session):
+    """Sharded proxy plane chaos: SIGKILL one proxy shard under concurrent
+    HTTP traffic → every COMPLETED request is a 200 (connections cut by the
+    dying shard are retried on a fresh connection, which the kernel's
+    reuseport group steers to a survivor), the controller detects the death
+    and starts a replacement shard under a fresh generation name, and the
+    shm routing segment is unlinked on teardown (no /dev/shm leak)."""
+    import glob
+
+    from ray_tpu._private.constants import SHM_ROUTING_GLOB
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=16)
+    class Echo:
+        def __call__(self, x):
+            return {"ok": True}
+
+    serve.run(Echo.bind(), name="pp", route_prefix="/pp")
+    serve.start(http_port=0, num_proxies=2)
+    host, port = serve.http_address()
+
+    def running_shards():
+        st = serve.proxy_status()
+        return [i for i, s in st["shards"].items()
+                if s["state"] == "running"]
+
+    _wait(lambda: len(running_shards()) == 2, desc="both shards running")
+    assert glob.glob(SHM_ROUTING_GLOB), "routing shm segment missing"
+    row0 = _serve_rows()["proxy:0"]
+    victim_aid = row0["actor_id"]
+
+    errors: list = []
+    counts = {"ok": 0}
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            # a fresh connection per request: retries after the victim's
+            # connections die land on a surviving shard's listen socket
+            for attempt in range(4):
+                try:
+                    status, out = _post(f"http://{host}:{port}/pp", {},
+                                        timeout=30)
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — cut connection
+                    if attempt == 3:
+                        errors.append(("gave up", repr(e)))
+                        return
+                    time.sleep(0.1)
+                    continue
+                if status != 200 or out != {"ok": True}:
+                    errors.append(("bad response", status, out))
+                    return
+                counts["ok"] += 1
+                break
+
+    threads = [threading.Thread(target=loop) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)  # steady state, requests in flight
+    os.kill(_pid_of_actor(victim_aid), signal.SIGKILL)
+
+    # replacement: shard 0's row reappears with a NEW actor and runs
+    def replaced():
+        rows = _serve_rows()
+        row = rows.get("proxy:0")
+        return (row and row.get("actor_id")
+                and row["actor_id"] != victim_aid
+                and row.get("state") == "running")
+
+    _wait(replaced, timeout=60.0, desc="shard 0 replaced")
+    _wait(lambda: len(running_shards()) == 2, timeout=60.0,
+          desc="fleet back to target")
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"dropped requests across shard death: {errors[:3]}"
+    assert counts["ok"] > 10, counts
+
+    # the replacement shard serves too (round-robin over fresh connections)
+    for _ in range(10):
+        status, out = _post(f"http://{host}:{port}/pp", {}, timeout=30)
+        assert status == 200 and out == {"ok": True}
+
+    serve.shutdown()
+    assert glob.glob(SHM_ROUTING_GLOB) == [], \
+        "routing shm segment leaked past serve.shutdown"

@@ -97,6 +97,12 @@ EXPECTED_METRICS = (
     "ray_tpu_nodes_draining",
     "ray_tpu_train_hangs_detected_total",
     "ray_tpu_train_preempt_checkpoints_total",
+    # sharded proxy plane (serve/controller.py + serve/proxy.py): running
+    # shard count from the controller's fleet reconcile, and each shard's
+    # view of how stale the shm-broadcast routing table is (age counts
+    # from the controller's last publish — its liveness heartbeat)
+    "ray_tpu_serve_proxy_shards",
+    "ray_tpu_serve_routing_table_age_seconds",
 )
 
 
