@@ -249,6 +249,9 @@ def timeline(filename: str | None = None) -> list:
     w = _get_worker()
     events = (w.rpc({"type": "task_events"}).get("events", [])
               if hasattr(w, "rpc") else [])  # local mode keeps no store
+    if hasattr(w, "rpc"):
+        # cluster event log rides along as ctrl:<node> rows in the export
+        events = events + w.rpc({"type": "list_events"}).get("events", [])
     if filename:
         # write even when empty: callers open the promised file next.
         # Actor rows labeled class/name, like `ray_tpu timeline`.

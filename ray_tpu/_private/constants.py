@@ -140,6 +140,78 @@ NODE_STATE_DEAD = "DEAD"
 TRAIN_POLL_STOP_OBSERVED = "stop_observed"
 TRAIN_POLL_PROGRESS_AGE = "progress_age_s"
 
+# ------------------------------------------------------------ cluster events
+#
+# The structured cluster event log (_private/events.py + the GCS ring).
+# Event-type and severity strings cross process boundaries twice: once on
+# the `cluster_events_report` flush from controller processes to the GCS,
+# and again on every `list_events` read (CLI, state API, dashboard). A
+# producer spelling "node.leave" and a filter spelling "node.left" would
+# silently match nothing, so the whole vocabulary lives here and the
+# `event-type-literal` graft_check forbids re-spelled literals at
+# emit_event() call sites outside this module.
+
+#: GCS RPC type flushing a batch of locally-buffered cluster events (serve
+#: controller, train controller — anything not co-resident with the GCS).
+#: Documented here as protocol; call sites and the gcs.py dispatch arm
+#: spell the literal so the rpc-pairing checker can pair them lexically.
+CLUSTER_EVENTS_RPC = "cluster_events_report"
+
+#: GCS RPC type reading the event ring with server-side limit/severity/
+#: type/node filtering (same lexical-literal discipline as above).
+LIST_EVENTS_RPC = "list_events"
+
+#: GCS RPC type answering "why is X pending" with the live per-node
+#: rejection table for a pending actor or placement group.
+SCHED_EXPLAIN_RPC = "sched_explain"
+
+#: severity vocabulary, orderable by index in EVENT_SEVERITIES.
+EVENT_SEVERITY_DEBUG = "DEBUG"
+EVENT_SEVERITY_INFO = "INFO"
+EVENT_SEVERITY_WARNING = "WARNING"
+EVENT_SEVERITY_ERROR = "ERROR"
+EVENT_SEVERITIES = (EVENT_SEVERITY_DEBUG, EVENT_SEVERITY_INFO,
+                    EVENT_SEVERITY_WARNING, EVENT_SEVERITY_ERROR)
+
+#: event-type vocabulary: "<entity>.<transition>". Every type a producer
+#: may emit is enumerated here — `ray_tpu events --type` completion, the
+#: README taxonomy table, and the dashboard all key on these strings.
+EVENT_NODE_JOIN = "node.join"
+EVENT_NODE_LEAVE = "node.leave"
+EVENT_NODE_DRAIN = "node.drain"
+EVENT_ACTOR_PENDING = "actor.pending"
+EVENT_ACTOR_ALIVE = "actor.alive"
+EVENT_ACTOR_RESTARTING = "actor.restarting"
+EVENT_ACTOR_DEAD = "actor.dead"
+EVENT_PG_PENDING = "pg.pending"
+EVENT_PG_CREATED = "pg.created"
+EVENT_PG_REMOVED = "pg.removed"
+EVENT_LEASE_GRANT = "lease.grant"
+EVENT_LEASE_RELEASE = "lease.release"
+EVENT_AUTOSCALER_INSTANCE = "autoscaler.instance"
+EVENT_SERVE_RECONCILE = "serve.reconcile"
+EVENT_TRAIN_ATTEMPT = "train.attempt"
+
+EVENT_TYPES = (
+    EVENT_NODE_JOIN, EVENT_NODE_LEAVE, EVENT_NODE_DRAIN,
+    EVENT_ACTOR_PENDING, EVENT_ACTOR_ALIVE, EVENT_ACTOR_RESTARTING,
+    EVENT_ACTOR_DEAD,
+    EVENT_PG_PENDING, EVENT_PG_CREATED, EVENT_PG_REMOVED,
+    EVENT_LEASE_GRANT, EVENT_LEASE_RELEASE,
+    EVENT_AUTOSCALER_INSTANCE, EVENT_SERVE_RECONCILE, EVENT_TRAIN_ATTEMPT,
+)
+
+#: canonical field names on the event record envelope. Producers populate
+#: them positionally through emit_event()'s signature; consumers (CLI
+#: column layout, dashboard JSON, chrome-trace row mapping) index by these.
+EVENT_FIELD_SEQ = "seq"
+EVENT_FIELD_TS = "ts"
+EVENT_FIELD_TYPE = "etype"
+EVENT_FIELD_SEVERITY = "severity"
+EVENT_FIELD_SOURCE = "source"
+EVENT_FIELD_NODE = "node"
+EVENT_FIELD_MESSAGE = "message"
+
 # ---------------------------------------------------------------- deadlines
 
 #: HTTP request header carrying the per-request deadline budget in seconds

@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Callable
 
-from ray_tpu._private import accelerators, fixed_point as fp, pg_policy
+from ray_tpu._private import accelerators, constants as _const, fixed_point as fp, pg_policy
 from ray_tpu._private.protocol import ConnectionClosed, MsgConnection, listen_unix
 from ray_tpu._private.ray_config import RayConfig
 
@@ -152,7 +152,7 @@ class _VNode:
 
     __slots__ = ("node_id", "total", "available", "labels", "alive",
                  "chip_pool", "quarantined_chips", "draining", "drain_reason",
-                 "drain_since")
+                 "drain_since", "drain_grace")
 
     def __init__(self, node_id: str, resources: dict, labels: dict | None = None):
         self.node_id = node_id
@@ -168,6 +168,7 @@ class _VNode:
         self.draining = False
         self.drain_reason = ""
         self.drain_since: float | None = None
+        self.drain_grace: float | None = None
         # unbound TPU chip ids; chips leave the pool when a worker is spawned
         # with them visible and return when that worker dies (reference:
         # TPU_VISIBLE_CHIPS isolation, _private/accelerators/tpu.py:36)
@@ -392,6 +393,23 @@ class GcsServer:
         # worker flushers (request_log_report), read by `ray_tpu trace list`
         # and the dashboard's /api/requests
         self.request_log: collections.deque = collections.deque(maxlen=1024)
+        # structured cluster event log (_private/events.py): node/actor/PG/
+        # lease lifecycle transitions, emitted here at their source and
+        # ingested from controller processes via cluster_events_report.
+        # INFO+ events write through to the sqlite `events` table so the
+        # log survives a GCS restart; the ring answers list_events.
+        self._events_enabled = bool(RayConfig.get("cluster_events"))
+        self._events_ring_size = max(
+            1, int(RayConfig.get("cluster_events_ring_size")))
+        self.cluster_events: collections.deque = collections.deque(
+            maxlen=self._events_ring_size)
+        self._cluster_event_seq = 0
+        self._events_lock = threading.Lock()
+        # scheduler decision traces: actor_id/pg_id → attribution record
+        # (enqueue time, attempts, queue wait, chosen node, lease RTT) kept
+        # while the entity exists so sched_explain can answer "why is X
+        # pending" / "where and how fast did X place"
+        self.sched_traces: dict[str, dict] = {}
         # server-side RPC latency per request type — the measurement floor
         # for control-plane scale work. UNREGISTERED histogram: the GCS
         # often shares a process with the driver, whose flusher would
@@ -420,6 +438,28 @@ class GcsServer:
             "nodes in DRAINING state: no new placements; resident train "
             "workers grace-checkpoint before the node is terminated",
             register=False)
+        # scheduler decision metrics — same unregistered fold-in pattern.
+        # The histogram observes queue-wait at dispatch/placement time and
+        # creation round-trips at completion; the counter is the
+        # decisions/s floor the 1000-node scale harness measures against.
+        from ray_tpu.util.metrics import Counter
+
+        self._sched_hist = Histogram(
+            "ray_tpu_sched_decision_seconds",
+            "scheduler decision latency: queue-wait until dispatch/placement "
+            "(outcome=dispatched/placed) and actor-creation lease RTT "
+            "(outcome=created)",
+            boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                        5.0, 30.0, 120.0],
+            tag_keys=("kind", "outcome"), register=False)
+        self._sched_counter = Counter(
+            "ray_tpu_sched_decisions_total",
+            "terminal scheduler decisions by work kind and outcome",
+            tag_keys=("kind", "outcome"), register=False)
+        self._sched_pending_gauge = Gauge(
+            "ray_tpu_sched_pending",
+            "work items waiting on a placement decision, by kind",
+            tag_keys=("kind",), register=False)
         # retained metric TIME SERIES, head-side (reference: the dashboard's
         # metrics stack — per-node agents scraped into Prometheus,
         # dashboard/modules/metrics/metrics_head.py; here the GCS keeps a
@@ -506,6 +546,7 @@ class GcsServer:
                 self.autoscaler_instances[k] = v
             for k, v in self.storage.items("serve"):
                 self.serve_table[k] = v
+        self._restore_events_from_storage()
         for _, spec in self.storage.items("pgs"):
             self._create_pg(dict(spec), _persist=False)
         for _, spec in self.storage.items("actors"):
@@ -602,6 +643,9 @@ class GcsServer:
 
     def start(self):
         self._restore_from_storage()
+        for node_id in list(self.nodes):
+            self._emit_event(_const.EVENT_NODE_JOIN, node=node_id,
+                             message="head-local virtual node online")
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
@@ -948,6 +992,9 @@ class GcsServer:
                 self.nodes[node_id] = _VNode(
                     node_id, msg["resources"], msg.get("labels"))
                 self._reapply_drain_locked(self.nodes[node_id])
+            self._emit_event(_const.EVENT_NODE_JOIN, node=node_id,
+                             message=f"host {host_id} registered",
+                             host=host_id)
             conn.send({"rid": msg["rid"], "ok": True,
                        "session_id": self.session_id})
             self._schedule()
@@ -1128,9 +1175,19 @@ class GcsServer:
             rec = dict(msg["instance"])
             iid = str(rec["instance_id"])
             with self.lock:
+                prev = self.autoscaler_instances.get(iid)
                 self.autoscaler_instances[iid] = rec
             if self.storage is not None:
                 self.storage.put("instances", iid, rec)
+            old_state = (prev or {}).get("state")
+            new_state = rec.get("state")
+            if new_state != old_state:
+                self._emit_event(
+                    _const.EVENT_AUTOSCALER_INSTANCE,
+                    node=str(rec.get("node_id") or ""),
+                    message=f"instance {iid}: "
+                            f"{old_state or 'NEW'} -> {new_state}",
+                    instance_id=iid, from_state=old_state, to_state=new_state)
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "instance_delete":
             iid = str(msg["instance_id"])
@@ -1372,10 +1429,12 @@ class GcsServer:
                 node_id = msg["node_id"]
                 self.nodes[node_id] = _VNode(node_id, msg["resources"], msg.get("labels"))
                 self._reapply_drain_locked(self.nodes[node_id])
+            self._emit_event(_const.EVENT_NODE_JOIN, node=node_id,
+                             message="virtual node added")
             conn.send({"rid": msg["rid"], "ok": True})
             self._schedule()
         elif t == "remove_node":
-            self._remove_node(msg["node_id"])
+            self._remove_node(msg["node_id"], reason="removed by request")
             conn.send({"rid": msg["rid"], "ok": True})
         elif t == "node_drain":
             node_id = msg["node_id"]
@@ -1401,6 +1460,7 @@ class GcsServer:
                         node.draining = True
                         node.drain_reason = reason
                         node.drain_since = time.time()
+                        node.drain_grace = grace
                     # fan the notice out to every resident worker (and the
                     # node's host agent) so train sessions can land a
                     # preemption-grace checkpoint inside the window
@@ -1411,6 +1471,12 @@ class GcsServer:
                     info = self.hosts.get(host_id) if host_id else None
                     if info is not None and info.get("conn") is not None:
                         notify.append(info["conn"])
+            if ok:
+                self._emit_event(
+                    _const.EVENT_NODE_DRAIN,
+                    severity=_const.EVENT_SEVERITY_WARNING, node=node_id,
+                    message=f"drain requested: {reason or 'no reason given'}",
+                    reason=reason, grace_s=grace)
             push = {"type": "drain_notice", "node_id": node_id,
                     "grace_s": grace, "reason": reason}
             for c in notify:
@@ -1424,6 +1490,11 @@ class GcsServer:
                 nodes = [
                     {"node_id": n.node_id, "alive": n.alive,
                      "draining": n.draining, "labels": dict(n.labels),
+                     "drain_reason": n.drain_reason,
+                     "drain_since": n.drain_since,
+                     "drain_deadline": (n.drain_since + n.drain_grace
+                                        if n.draining and n.drain_since
+                                        and n.drain_grace else None),
                      "total": fp.float_dict(n.total),
                      "available": fp.float_dict(n.available),
                      "quarantined_chips": list(n.quarantined_chips),
@@ -1513,10 +1584,25 @@ class GcsServer:
                     },
                     "nodes": {
                         n.node_id: {"alive": n.alive, "draining": n.draining,
+                                    "drain_reason": n.drain_reason,
+                                    "drain_since": n.drain_since,
+                                    "drain_deadline": (
+                                        n.drain_since + n.drain_grace
+                                        if n.draining and n.drain_since
+                                        and n.drain_grace else None),
                                     "labels": dict(n.labels),
                                     "total": fp.float_dict(n.total),
                                     "available": fp.float_dict(n.available)}
                         for n in self.nodes.values()
+                    },
+                    # what the scheduler is sitting on, by kind — the
+                    # "why is the cluster busy" one-liner for `ray_tpu status`
+                    "pending_demand": {
+                        "tasks": len(self.pending_tasks),
+                        "actor_creations": len(self.pending_actor_creations),
+                        "placement_groups": sum(
+                            1 for pg in self.pgs.values()
+                            if pg.state == "pending"),
                     },
                 }
             conn.send({"rid": msg["rid"], "state": state})
@@ -1755,6 +1841,23 @@ class GcsServer:
                     "description": self._rpc_hist.description,
                     "series": {"gcs": self._rpc_hist._snapshot_series()},
                     "ts": {"gcs": time.time()}}
+                # scheduler decision attribution (unregistered, GCS-local)
+                self._sched_pending_gauge.set(
+                    float(len(self.pending_tasks)), tags={"kind": "task"})
+                self._sched_pending_gauge.set(
+                    float(len(self.pending_actor_creations)),
+                    tags={"kind": "actor"})
+                self._sched_pending_gauge.set(
+                    float(sum(1 for pg in self.pgs.values()
+                              if pg.state == "pending")), tags={"kind": "pg"})
+                for name, obj in (
+                        ("ray_tpu_sched_decision_seconds", self._sched_hist),
+                        ("ray_tpu_sched_decisions_total", self._sched_counter),
+                        ("ray_tpu_sched_pending", self._sched_pending_gauge)):
+                    snap[name] = {
+                        "kind": obj.kind, "description": obj.description,
+                        "series": {"gcs": obj._snapshot_series()},
+                        "ts": {"gcs": time.time()}}
             conn.send({"rid": msg["rid"], "metrics": snap})
         elif t == "events_report":
             with self.lock:
@@ -1793,6 +1896,30 @@ class GcsServer:
             if limit:
                 rows = rows[-limit:]
             conn.send({"rid": msg["rid"], "requests": rows})
+        elif t == "cluster_events_report":
+            # controller processes (serve/train) flushing their local event
+            # rings (no reply — fire-and-forget like request_log_report)
+            if self._events_enabled:
+                src = str(msg.get("source") or wid or "")
+                for ev in msg.get("events", []):
+                    if src and not ev.get("source"):
+                        ev["source"] = src
+                    self._ingest_event(dict(ev))
+        elif t == "list_events":
+            from ray_tpu._private import events as _events
+            with self._events_lock:
+                rows = [dict(r) for r in self.cluster_events]
+            rows = _events.filter_events(
+                rows,
+                min_severity=str(msg.get("severity") or ""),
+                etype=str(msg.get("etype") or ""),
+                node=str(msg.get("node") or ""),
+                after_seq=int(msg.get("after_seq", 0) or 0),
+                limit=int(msg.get("limit", 0) or 0))
+            conn.send({"rid": msg["rid"], "events": rows})
+        elif t == "sched_explain":
+            conn.send({"rid": msg["rid"],
+                       **self._sched_explain(str(msg.get("target") or ""))})
         elif t == "dag_register":
             # compiled-DAG registry (tentpole: observability for the channel
             # execution plane). The registering connection's wid is recorded
@@ -1875,6 +2002,233 @@ class GcsServer:
                 conn.send(msg)
             except (ConnectionClosed, Exception):
                 pass
+
+    # --------------------------------------------------------- cluster events
+
+    def _emit_event(self, etype: str, *, severity: str | None = None,
+                    node: str = "", message: str = "", **fields) -> None:
+        """Record one typed cluster event at its GCS source. The event type
+        must be a constants.py EVENT_* name (event-type-literal check).
+        Callers may hold self.lock: the ring has its own lock and the
+        sqlite write keys on a unique seq, so ordering never inverts."""
+        if not self._events_enabled:
+            return
+        from ray_tpu._private.events import make_event
+
+        rec = make_event(
+            etype, severity=severity or _const.EVENT_SEVERITY_INFO,
+            node=node, message=message, source="gcs", **fields)
+        self._ingest_event(rec)
+
+    def _ingest_event(self, rec: dict) -> None:
+        """Stamp a GCS sequence number onto one event record, ring it, and
+        write INFO+ through to the sqlite `events` table (DEBUG events —
+        lease churn — stay in-memory: they dominate volume and explain
+        nothing after a restart)."""
+        with self._events_lock:
+            self._cluster_event_seq += 1
+            seq = rec[_const.EVENT_FIELD_SEQ] = self._cluster_event_seq
+            self.cluster_events.append(rec)
+        if (self.storage is not None
+                and rec.get(_const.EVENT_FIELD_SEVERITY)
+                != _const.EVENT_SEVERITY_DEBUG):
+            try:
+                self.storage.put("events", f"{seq:012d}", rec)
+                # bound the table to the ring size: the entry this one
+                # rotated out of a full ring also leaves the table
+                if seq > self._events_ring_size:
+                    self.storage.delete(
+                        "events", f"{seq - self._events_ring_size:012d}")
+            except Exception:
+                # persistence is best-effort; the ring stays truthful
+                logger.warning("gcs: event persist failed for seq %d", seq,
+                               exc_info=True)
+
+    def _restore_events_from_storage(self) -> None:
+        """Reload persisted events (oldest first) and resume the sequence
+        counter past them so post-restart events sort after."""
+        rows = sorted(self.storage.items("events"))
+        with self._events_lock:
+            for key, rec in rows[-self._events_ring_size:]:
+                self.cluster_events.append(rec)
+            if rows:
+                self._cluster_event_seq = max(
+                    self._cluster_event_seq, int(rows[-1][0]))
+
+    # ------------------------------------------------- scheduler attribution
+
+    def _observe_sched(self, kind: str, outcome: str,
+                       seconds: float | None, n: int = 1) -> None:
+        """One terminal scheduler decision (n for batched grants):
+        decisions/s counter plus the decision-latency histogram when a
+        wait/RTT is attributable."""
+        tags = {"kind": kind, "outcome": outcome}
+        self._sched_counter.inc(float(n), tags=tags)
+        if seconds is not None and seconds >= 0:
+            self._sched_hist.observe(seconds, tags=tags)
+
+    def _trace_enqueue(self, key: str, kind: str) -> None:
+        """(Re)enter a work item into the pending decision-trace table.
+        Caller holds self.lock."""
+        tr = self.sched_traces.get(key)
+        if tr is None:
+            tr = self.sched_traces[key] = {
+                "kind": kind, "attempts": 0, "history": []}
+        tr["status"] = "pending"
+        tr["attempts"] += 1
+        tr["enqueued_ts"] = time.time()
+        tr["_enq_mono"] = time.monotonic()
+
+    def _trace_decision(self, key: str, status: str, **fields) -> None:
+        """Advance a trace to dispatched/placed/created/failed, recording
+        per-attempt attribution. Caller holds self.lock."""
+        tr = self.sched_traces.get(key)
+        if tr is None:
+            return
+        tr["status"] = status
+        tr.update(fields)
+        if status in ("placed", "created", "failed"):
+            hist = tr.setdefault("history", [])
+            hist.append({k: tr.get(k) for k in
+                         ("attempts", "status", "node", "queue_wait_s",
+                          "lease_rtt_s") if tr.get(k) is not None})
+            del hist[:-8]  # keep the last attempts only
+
+    def _explain_spec_locked(self, spec: dict) -> dict:
+        """Per-node rejection table for one pending spec: mirrors _fits_for
+        but returns WHY each candidate fails instead of the first fit.
+        Computed lazily (only when sched_explain asks) so _schedule never
+        pays for it. Caller holds self.lock."""
+        res = self._spec_fp(spec)
+        strat = spec.get("strategy") or {}
+        reasons: dict[str, str] = {}
+        if strat.get("kind") == "pg":
+            pg = self.pgs.get(strat.get("pg_id"))
+            if pg is None:
+                return {"<pg>": f"no such placement group {strat.get('pg_id')!r}"}
+            if pg.state != "created":
+                return {"<pg>": f"placement group is {pg.state}, not created"}
+            idx = strat.get("bundle", -1)
+            cand = (list(enumerate(pg.bundles)) if idx == -1
+                    else [(idx, pg.bundles[idx])])
+            for i, b in cand:
+                short = next((k for k, v in res.items()
+                              if b.available.get(k, 0) < v), None)
+                if short is None:
+                    reasons[f"bundle[{i}]@{b.node_id}"] = (
+                        "fits; waiting on worker availability")
+                else:
+                    reasons[f"bundle[{i}]@{b.node_id}"] = (
+                        f"insufficient {short}: need "
+                        f"{fp.from_fp(res[short])}, bundle has "
+                        f"{fp.from_fp(b.available.get(short, 0))}")
+            return reasons
+        hard = strat.get("hard", {}) if strat.get("kind") == "node_label" else {}
+        affinity = (strat.get("node_id")
+                    if strat.get("kind") == "node_affinity" else None)
+        soft = bool(strat.get("soft"))
+        for n in self.nodes.values():
+            if not n.alive:
+                reasons[n.node_id] = "node is dead"
+                continue
+            if n.draining:
+                reasons[n.node_id] = (
+                    "node is draining"
+                    + (f" ({n.drain_reason})" if n.drain_reason else ""))
+                continue
+            if affinity is not None and n.node_id != affinity and not soft:
+                reasons[n.node_id] = (
+                    f"not the node_affinity target {affinity!r}")
+                continue
+            miss = next(((k, v) for k, v in hard.items()
+                         if n.labels.get(k) != v), None)
+            if miss is not None:
+                reasons[n.node_id] = (
+                    f"label mismatch: requires {miss[0]}={miss[1]!r}, node "
+                    f"has {n.labels.get(miss[0])!r}")
+                continue
+            short = next((k for k, v in res.items()
+                          if n.available.get(k, 0) < v), None)
+            if short is not None:
+                reasons[n.node_id] = (
+                    f"insufficient {short}: need {fp.from_fp(res[short])}, "
+                    f"node has {fp.from_fp(n.available.get(short, 0))} "
+                    f"available of {fp.from_fp(n.total.get(short, 0))}")
+                continue
+            reasons[n.node_id] = (
+                "fits; waiting on worker availability (spawn in progress "
+                "or max_workers reached)")
+        if not self._deps_ready(spec):
+            reasons["<deps>"] = "task dependencies are not yet available"
+        return reasons
+
+    def _explain_pg_locked(self, pg: "_PG") -> dict:
+        """Per-node rejection view for a pending placement group: what the
+        placement policy could fit on each node in isolation (bundles that
+        fit nowhere, or a strategy that needs a joint assignment no node
+        set satisfies). Caller holds self.lock."""
+        reasons: dict[str, str] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                reasons[n.node_id] = "node is dead"
+                continue
+            if n.draining:
+                reasons[n.node_id] = (
+                    "node is draining"
+                    + (f" ({n.drain_reason})" if n.drain_reason else ""))
+                continue
+            unfit = []
+            for i, b in enumerate(pg.bundles):
+                short = next((k for k, v in b.total.items()
+                              if n.available.get(k, 0) < v), None)
+                if short is not None:
+                    unfit.append(
+                        f"bundle[{i}] short {fp.from_fp(b.total[short] - n.available.get(short, 0))} {short}")
+            if unfit:
+                reasons[n.node_id] = "; ".join(unfit)
+            else:
+                reasons[n.node_id] = (
+                    f"every bundle fits individually; no joint "
+                    f"{pg.strategy} assignment found yet")
+        return reasons
+
+    def _sched_explain(self, target: str) -> dict:
+        """Answer "why is X pending": the live per-node rejection table for
+        a pending actor or placement group, plus the decision trace for
+        anything the scheduler has already placed."""
+        with self.lock:
+            a = self.actors.get(target)
+            if a is not None:
+                out = {"found": True, "kind": "actor", "state": a.state,
+                       "trace": dict(self.sched_traces.get(target) or {})}
+                out["trace"].pop("_enq_mono", None)
+                if a.state in ("pending", "restarting"):
+                    spec = next(
+                        (s for s in self.pending_actor_creations
+                         if s.get("actor_id") == target), None)
+                    if spec is not None:
+                        out["rejections"] = self._explain_spec_locked(spec)
+                        enq = spec.get("_enq_ts")
+                        if enq is not None:
+                            out["queue_wait_s"] = round(
+                                time.monotonic() - enq, 6)
+                    else:
+                        # dispatched: a worker is spawning / creating it
+                        out["rejections"] = {}
+                        out["note"] = ("creation dispatched to worker "
+                                       f"{a.worker!r}; waiting on the "
+                                       "worker to finish __init__")
+                return out
+            pg = self.pgs.get(target)
+            if pg is not None:
+                out = {"found": True, "kind": "pg", "state": pg.state,
+                       "trace": dict(self.sched_traces.get(target) or {})}
+                out["trace"].pop("_enq_mono", None)
+                if pg.state == "pending":
+                    out["rejections"] = self._explain_pg_locked(pg)
+                return out
+        return {"found": False,
+                "error": f"no actor or placement group {target!r}"}
 
     # --------------------------------------------------------------- objects
 
@@ -2468,6 +2822,14 @@ class GcsServer:
         unmet = count - len(grants)
         if unmet > 0:
             self._spawn_for_lease_demand(res, rh, need, unmet)
+        if grants:
+            self._observe_sched("lease", "granted", None, n=len(grants))
+            self._emit_event(
+                _const.EVENT_LEASE_GRANT,
+                severity=_const.EVENT_SEVERITY_DEBUG,
+                message=f"{len(grants)} worker lease(s) to {caller}",
+                caller=caller or "", count=len(grants),
+                nodes=sorted({g["node"] for g in grants}))
         try:
             conn.send({"rid": msg["rid"], "leases": grants})
         except ConnectionClosed:
@@ -2543,6 +2905,10 @@ class GcsServer:
                 self._release_for(spec)
             if not w.dead and make_idle:
                 w.idle = True
+        self._emit_event(_const.EVENT_LEASE_RELEASE,
+                         severity=_const.EVENT_SEVERITY_DEBUG,
+                         message=f"lease on {target} released by {holder}",
+                         worker=target, holder=holder)
         self._schedule()
 
     def _convert_cross_lang_done(self, msg: dict) -> None:
@@ -2724,6 +3090,7 @@ class GcsServer:
                 evicted: list[str] = []
                 if spec["kind"] == "task" and isinstance(spec["num_returns"], int):
                     evicted = self._retain_lineage_locked(spec)
+                spec["_enq_ts"] = time.monotonic()
                 self.pending_tasks.append(spec)
             self.task_counter["submitted"] += 1
         if reason is not None:
@@ -2851,10 +3218,17 @@ class GcsServer:
                 w.idle = False
                 spec["_ts"] = time.monotonic()
                 w.running_tasks[spec["task_id"]] = spec
+                wait = spec["_ts"] - spec.get("_enq_ts", spec["_ts"])
                 if spec["kind"] == "actor_create":
                     w.actor_id = spec["actor_id"]
                     actor = self.actors[spec["actor_id"]]
                     actor.worker = w.wid
+                    self._observe_sched("actor", "dispatched", wait)
+                    self._trace_decision(spec["actor_id"], "dispatched",
+                                         node=node_id, worker=w.wid,
+                                         queue_wait_s=round(wait, 6))
+                else:
+                    self._observe_sched("task", "dispatched", wait)
                 to_send.append((w.conn, {"type": "exec", "spec": spec}))
                 self.pending_tasks.note_consumed(spec["task_id"])
                 dispatched_any = True
@@ -3248,9 +3622,20 @@ class GcsServer:
             error = msg.get("error")
             if kind == "actor_create":
                 actor = self.actors.get(spec["actor_id"])
+                rtt = time.monotonic() - spec.get("_ts", time.monotonic())
                 if error is None:
                     if actor is not None:
                         actor.state = "alive"
+                        self._observe_sched("actor", "created", rtt)
+                        self._trace_decision(actor.aid, "created",
+                                             lease_rtt_s=round(rtt, 6))
+                        self._emit_event(
+                            _const.EVENT_ACTOR_ALIVE,
+                            node=w.node_id if w is not None else "",
+                            message=f"actor {actor.name or actor.aid} alive "
+                                    f"on worker {wid}",
+                            actor_id=actor.aid, name=actor.name, worker=wid,
+                            num_restarts=actor.num_restarts)
                         self.publish("actor_state",
                                      {"actor_id": actor.aid, "state": "alive"})
                         waiters, actor.waiters = actor.waiters, []
@@ -3268,6 +3653,16 @@ class GcsServer:
                     # creation failed → actor dead, release worker
                     if actor is not None:
                         actor.state = "dead"
+                        self._observe_sched("actor", "failed", rtt)
+                        self._trace_decision(actor.aid, "failed", error=error)
+                        self._emit_event(
+                            _const.EVENT_ACTOR_DEAD,
+                            severity=_const.EVENT_SEVERITY_ERROR,
+                            node=w.node_id if w is not None else "",
+                            message=f"actor {actor.name or actor.aid} "
+                                    f"creation failed: {error}",
+                            actor_id=actor.aid, name=actor.name,
+                            death_reason=f"creation failed: {error}")
                         self._unpersist_actor(actor.aid)
                         self.publish("actor_state",
                                      {"actor_id": actor.aid, "state": "dead"})
@@ -3389,10 +3784,18 @@ class GcsServer:
             holds = list(spec.get("deps", ())) + list(spec.get("ref_holds", ()))
             spec["_actor_holds"] = holds
             self._sys_hold_locked(holds, +1)
+            spec["_enq_ts"] = time.monotonic()
             self.pending_actor_creations.append(spec)
+            self._trace_enqueue(aid, "actor")
+        self._emit_event(
+            _const.EVENT_ACTOR_PENDING,
+            message=f"actor {actor.name or aid} "
+                    f"({spec.get('class_name')}) queued for placement",
+            actor_id=aid, name=actor.name, actor_class=spec.get("class_name"))
         if _persist and self.storage is not None:
             clean = {k: v for k, v in spec.items()
-                     if k not in ("_actor_holds", "_paid", "_fp_res")}
+                     if k not in ("_actor_holds", "_paid", "_fp_res",
+                                  "_enq_ts")}
             self.storage.put("actors", aid, clean)
         self._schedule()
         return None
@@ -3482,6 +3885,13 @@ class GcsServer:
                         pass
                 actor.waiters = []
                 free_now = self._actor_dead_cleanup_locked(actor.create_spec)
+                self.sched_traces.pop(aid, None)
+                self._emit_event(
+                    _const.EVENT_ACTOR_DEAD,
+                    message=f"actor {actor.name or aid} killed before "
+                            "creation dispatched",
+                    actor_id=aid, name=actor.name,
+                    death_reason="killed before creation")
         if free_now:
             self._free_objects(free_now)
         for spec in fail:
@@ -3527,6 +3937,13 @@ class GcsServer:
             self.objects.setdefault(pg_ready_oid(pg.pg_id),
                                     {"status": "pending", "where": None, "inline": None, "size": 0})
             self.pending_pgs.append(pg.pg_id)
+            self._trace_enqueue(pg.pg_id, "pg")
+        self._emit_event(
+            _const.EVENT_PG_PENDING,
+            message=f"placement group {pg.name or pg.pg_id} "
+                    f"({pg.strategy}, {len(pg.bundles)} bundles) pending",
+            pg_id=pg.pg_id, name=pg.name, strategy=pg.strategy,
+            n_bundles=len(pg.bundles))
         if _persist and self.storage is not None:
             self.storage.put("pgs", spec["pg_id"], dict(spec))
         self._schedule()
@@ -3556,6 +3973,22 @@ class GcsServer:
             pg.state = "created"
             pg.epoch += 1
             placed.append(pg_id)
+            placement = {str(i): b.node_id
+                         for i, b in enumerate(pg.bundles)}
+            tr = self.sched_traces.get(pg_id)
+            wait = (time.monotonic() - tr["_enq_mono"]
+                    if tr and tr.get("_enq_mono") is not None else None)
+            self._observe_sched("pg", "placed", wait)
+            self._trace_decision(pg_id, "placed", placement=placement,
+                                 epoch=pg.epoch,
+                                 queue_wait_s=(round(wait, 6)
+                                               if wait is not None else None))
+            self._emit_event(
+                _const.EVENT_PG_CREATED,
+                message=f"placement group {pg.name or pg_id} placed "
+                        f"(epoch {pg.epoch})",
+                pg_id=pg_id, name=pg.name, strategy=pg.strategy,
+                placement=placement, epoch=pg.epoch)
             for conn, rid in pg.waiters:
                 try:
                     conn.send({"rid": rid, "ok": True})
@@ -3594,6 +4027,11 @@ class GcsServer:
             if pg.name and self.named_pgs.get(pg.name) == pg_id:
                 del self.named_pgs[pg.name]
             self.pending_pgs = collections.deque(p for p in self.pending_pgs if p != pg_id)
+            self.sched_traces.pop(pg_id, None)
+            self._emit_event(
+                _const.EVENT_PG_REMOVED,
+                message=f"placement group {pg.name or pg_id} removed",
+                pg_id=pg_id, name=pg.name)
         for conn, rid in waiters:
             try:
                 conn.send({"rid": rid, "ok": False, "error": "placement group removed"})
@@ -3645,7 +4083,9 @@ class GcsServer:
                 entry.get("shm_live", set()).discard(host_id)
             self.host_shm_bytes.pop(host_id, None)
         for node_id in doomed_nodes:
-            self._remove_node(node_id)
+            self._remove_node(
+                node_id,
+                reason=f"host {host_id} connection lost / failed health checks")
 
     def _reapply_drain_locked(self, node: "_VNode") -> None:
         """Restore a persisted drain onto a (re)registering node: a drain
@@ -3656,10 +4096,12 @@ class GcsServer:
             node.draining = True
             node.drain_reason = rec.get("reason") or ""
             node.drain_since = rec.get("ts")
+            node.drain_grace = rec.get("grace_s")
 
-    def _remove_node(self, node_id: str):
+    def _remove_node(self, node_id: str, reason: str = ""):
         """Mark a virtual node dead: its workers die, its PG bundles unplace."""
         to_fail: list[dict] = []
+        unplaced_pgs: list[tuple[str, str]] = []
         with self.lock:
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
@@ -3680,8 +4122,23 @@ class GcsServer:
                         b.node_id = None
                     pg.state = "pending"
                     self.pending_pgs.append(pg.pg_id)
+                    self._trace_enqueue(pg.pg_id, "pg")
+                    unplaced_pgs.append((pg.pg_id, pg.name))
                     oid = pg_ready_oid(pg.pg_id)
                     self.objects[oid] = {"status": "pending", "where": None, "inline": None, "size": 0}
+        self._emit_event(
+            _const.EVENT_NODE_LEAVE,
+            severity=_const.EVENT_SEVERITY_WARNING, node=node_id,
+            message=f"node left the cluster: {reason or 'unknown cause'}",
+            reason=reason, n_workers_lost=len(doomed))
+        for pg_id_, pg_name_ in unplaced_pgs:
+            self._emit_event(
+                _const.EVENT_PG_PENDING,
+                severity=_const.EVENT_SEVERITY_WARNING, node=node_id,
+                message=f"placement group {pg_name_ or pg_id_} unplaced: "
+                        f"node {node_id} died; bundles back to pending",
+                pg_id=pg_id_, name=pg_name_,
+                reason=f"node {node_id} died")
         for w in doomed:
             try:
                 w.conn.send({"type": "exit"})
@@ -3903,16 +4360,44 @@ class GcsServer:
                         if actor.method_groups.get(s.get("method") or "")
                         is not None)
                     actor.worker = None
+                    # same freshness window the chip quarantine above uses;
+                    # the module-level death_reason is computed after the
+                    # lock, so derive it locally for the causal event fields
+                    dr = ((w.oom_why if self._oom_fresh(w) else None)
+                          or f"worker {wid} died")
                     if will_restart:
                         if actor.restarts_left > 0:
                             actor.restarts_left -= 1
                         actor.state = "restarting"
                         actor.num_restarts += 1
+                        actor.create_spec["_enq_ts"] = time.monotonic()
+                        self._trace_enqueue(actor.aid, "actor")
+                        self._emit_event(
+                            _const.EVENT_ACTOR_RESTARTING,
+                            severity=_const.EVENT_SEVERITY_WARNING,
+                            node=w.node_id,
+                            message=f"actor {actor.name or actor.aid} "
+                                    f"restarting: {dr}",
+                            actor_id=actor.aid, name=actor.name,
+                            death_reason=dr, worker=wid,
+                            num_restarts=actor.num_restarts,
+                            restarts_left=actor.restarts_left)
                         self.publish("actor_state", {"actor_id": actor.aid,
                                                      "state": "restarting"})
                         self.pending_actor_creations.append(actor.create_spec)
                     else:
                         actor.state = "dead"
+                        self._observe_sched("actor", "died", None)
+                        self.sched_traces.pop(actor.aid, None)
+                        self._emit_event(
+                            _const.EVENT_ACTOR_DEAD,
+                            severity=_const.EVENT_SEVERITY_ERROR,
+                            node=w.node_id,
+                            message=f"actor {actor.name or actor.aid} died: "
+                                    f"{dr}",
+                            actor_id=actor.aid, name=actor.name,
+                            death_reason=dr, worker=wid,
+                            num_restarts=actor.num_restarts)
                         self._unpersist_actor(actor.aid)
                         self.publish("actor_state",
                                      {"actor_id": actor.aid, "state": "dead"})

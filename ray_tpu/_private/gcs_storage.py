@@ -9,7 +9,9 @@ in WAL mode, one table per GCS manager, write-through on every mutation.
 Tables: kv (internal KV incl. jobs), actors (create specs of live actors),
 pgs (placement-group specs), session (session metadata), instances
 (autoscaler instance state machine — see autoscaler/instance_manager.py),
-serve (serve control-plane state — see serve/controller.py recovery).
+serve (serve control-plane state — see serve/controller.py recovery),
+events (INFO+ cluster events — see _private/events.py; keyed by
+zero-padded sequence number so restart recovery replays them in order).
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ from typing import Any, Iterator, Optional
 #: every persisted GCS table. The graft_check rpc-pairing checker verifies
 #: that any table literal the GCS server reads/writes appears here, so a
 #: handler can never target a table this module never created.
-TABLES = ("kv", "actors", "pgs", "session", "instances", "serve")
+TABLES = ("kv", "actors", "pgs", "session", "instances", "serve", "events")
 
 
 class GcsStorage:

@@ -193,6 +193,15 @@ class RayConfig:
     # serving process retains (and ships to the GCS request log) so a slow
     # request can be explained after the fact without sampling luck.
     serve_flight_recorder_size: int = 256
+    # Structured cluster event log (_private/events.py): typed node/actor/
+    # PG/lease lifecycle events recorded at their GCS/controller source and
+    # readable via `ray_tpu events` / state.list_events(). 0/false disables
+    # both emission and the GCS ring (the events bench A/B baseline).
+    cluster_events: bool = True
+    # Capacity of the GCS cluster-event ring (and of each producer-side
+    # buffer); oldest events fall off. Persisted INFO+ events in the sqlite
+    # `events` table are bounded to the same count.
+    cluster_events_ring_size: int = 4096
     # --- serve proxy plane ----------------------------------------------
     # Number of proxy shard processes serve.start() launches when the
     # sharded plane is requested without an explicit num_proxies. 0 keeps

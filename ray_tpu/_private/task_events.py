@@ -141,7 +141,10 @@ def normalize_events(events: list) -> list:
         if "start" not in ev and "ts" in ev:
             ev["start"] = ev["ts"]
             ev["end"] = ev["ts"]
-            ev.setdefault("event", "task:done")
+            # cluster events carry an etype; task completions don't
+            ev.setdefault("event", ev.get("etype") or "task:done")
+            if ev.get("etype"):
+                ev.setdefault("name", ev["etype"])
             ev.setdefault("worker_id", ev.get("worker", ""))
     return events
 
@@ -210,6 +213,12 @@ def to_chrome_trace(events: list, worker_names: dict | None = None) -> str:
         elif ev.get("request_id"):
             row = f"req:{ev['request_id']}"
             tid = ev.get("pid", 0)
+        elif ev.get("etype"):
+            # control-plane cluster events (node/actor/PG lifecycle): one
+            # row per node so a node's control transitions line up next to
+            # the task rows of the workers it hosted
+            row = f"ctrl:{ev.get('node') or 'cluster'}"
+            tid = ev["etype"]
         else:
             row = worker_names.get(wid, wid)
             tid = ev.get("pid", 0)

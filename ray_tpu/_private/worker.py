@@ -423,6 +423,13 @@ class CoreWorker:
                 # in the last-N ring ship)
                 self.send_no_reply({"type": "request_log_report",
                                     "source": self.wid, "entries": reqs})
+            from ray_tpu._private import events as _cev
+            cevs = _cev.drain()
+            if cevs:
+                # controller-side cluster events (serve/train controllers
+                # run as actors in this process) -> the GCS event ring
+                self.send_no_reply({"type": "cluster_events_report",
+                                    "source": self.wid, "events": cevs})
             snap = _met.snapshot()
             if snap:
                 self.send_no_reply({"type": "metrics_report",

@@ -11,6 +11,8 @@ Endpoints:
   GET /api/cluster           — cluster_state JSON
   GET /api/nodes|actors|placement_groups|jobs|tasks
   GET /api/dags              — compiled-DAG registry (state API twin)
+  GET /api/events            — cluster event log (?limit/severity/type/node)
+  GET /api/explain?target=   — scheduler decision attribution for one id
   GET /api/requests          — serve flight-recorder request log
   GET /api/logs              — list log files; /api/logs/<name>?tail=N
   GET /api/timeline          — chrome://tracing JSON of task events
@@ -113,14 +115,34 @@ class _Handler(BaseHTTPRequestHandler):
                     fetch_worker_names, normalize_events, to_chrome_trace)
 
                 evs = gcs.rpc({"type": "task_events"}).get("events", [])
+                # control-plane events ride along as ctrl:<node> rows
+                cevs = gcs.rpc({"type": "list_events"}).get("events", [])
                 # actor-worker rows labeled with class/name, not bare pid
                 self._send(to_chrome_trace(
-                    normalize_events(list(evs)),
+                    normalize_events(list(evs) + list(cevs)),
                     fetch_worker_names(gcs.rpc)).encode())
             elif path == "/api/dags":
                 # compiled-DAG registry (registered at experimental_compile,
                 # dropped at teardown/driver death)
                 self._json(gcs.rpc({"type": "dag_list"}).get("dags", []))
+            elif path == "/api/events":
+                # structured cluster event log with server-side filtering
+                # (limit/severity/type/node/after_seq match the CLI flags)
+                self._json(gcs.rpc({
+                    "type": "list_events",
+                    "limit": int(q.get("limit", [0])[0] or 0),
+                    "severity": q.get("severity", [""])[0] or "",
+                    "etype": q.get("type", [""])[0] or "",
+                    "node": q.get("node", [""])[0] or "",
+                    "after_seq": int(q.get("after_seq", [0])[0] or 0),
+                }).get("events", []))
+            elif path == "/api/explain":
+                target = (q.get("target", [""])[0] or "").strip()
+                if not target:
+                    self._json({"error": "missing ?target="}, 400)
+                    return
+                self._json(gcs.rpc({"type": "sched_explain",
+                                    "target": target}))
             elif path == "/api/requests":
                 # serve flight-recorder log: last-N request summaries with
                 # per-phase seconds (request tracing tentpole) — newest last

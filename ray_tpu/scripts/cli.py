@@ -75,10 +75,24 @@ def cmd_status(args):
     tc = state.get("task_counter", {})
     if tc:
         print("tasks: " + "  ".join(f"{k}={v}" for k, v in sorted(tc.items())))
+    demand = state.get("pending_demand") or {}
+    if any(demand.values()):
+        print("pending demand: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(demand.items()) if v))
+    draining = {nid: i for nid, i in (state.get("nodes") or {}).items()
+                if i.get("draining")}
+    if draining:
+        print("draining nodes:")
+        now = time.time()
+        for nid, info in draining.items():
+            deadline = info.get("drain_deadline")
+            left = (f"  {max(0.0, deadline - now):.0f}s left"
+                    if deadline else "")
+            print(f"  {nid}  reason={info.get('drain_reason') or '?'}{left}")
     pend = {a: i for a, i in state.get("actors", {}).items()
             if i["state"] not in ("alive", "dead")}
     if pend:
-        print("non-running actors:")
+        print("non-running actors (`ray_tpu explain <id>` says why):")
         for aid, info in pend.items():
             print(f"  {aid}  {info['state']}  name={info.get('name')}")
 
@@ -315,12 +329,106 @@ def cmd_timeline(args):
     c = GcsClient(sd)
     try:
         events = c.rpc({"type": "task_events"}).get("events", [])
+        # control-plane event log rides along as one `ctrl:<node>` row per
+        # node, so scheduling churn lines up against the task spans
+        cluster = c.rpc({"type": "list_events"}).get("events", [])
         names = fetch_worker_names(c.rpc)
     finally:
         c.close()
     out = args.output or "timeline.json"
-    export_chrome_trace(events, out, names)
-    print(f"wrote {len(events)} events to {out} (open in chrome://tracing)")
+    export_chrome_trace(events + cluster, out, names)
+    print(f"wrote {len(events)} task + {len(cluster)} cluster events to "
+          f"{out} (open in chrome://tracing)")
+
+
+def _print_event_row(ev: dict) -> None:
+    ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+    extras = " ".join(
+        f"{k}={v}" for k, v in sorted(ev.items())
+        if k not in ("seq", "ts", "etype", "severity", "source", "node",
+                     "message") and v not in (None, "", [], {}))
+    print(f"{ev.get('seq', 0):>6} {ts} {ev.get('severity', ''):<7} "
+          f"{ev.get('etype', ''):<20} {ev.get('node', '') or '-':<12} "
+          f"{ev.get('message', '')}" + (f"  [{extras}]" if extras else ""))
+
+
+def cmd_events(args):
+    """Structured cluster event log (reference capability: `ray list
+    cluster-events` / the dashboard event feed): node joins/leaves/drains,
+    actor lifecycle with death causes, PG placement, autoscaler instance
+    transitions, serve reconciles, train attempts. --follow polls on the
+    server-side seq watermark so only new events ship."""
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+
+    def fetch(after_seq: int = 0, limit: int = 0) -> list:
+        return c.rpc({"type": "list_events",
+                      "severity": args.severity or "",
+                      "etype": args.type or "", "node": args.node or "",
+                      "after_seq": after_seq,
+                      "limit": limit}).get("events", [])
+
+    try:
+        rows = fetch(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=1, default=str))
+            if not args.follow:
+                return
+        else:
+            for ev in rows:
+                _print_event_row(ev)
+        if not args.follow:
+            return
+        last = max((ev.get("seq", 0) for ev in rows), default=0)
+        while True:
+            time.sleep(1.0)
+            fresh = fetch(after_seq=last)
+            for ev in fresh:
+                last = max(last, ev.get("seq", 0))
+                if args.json:
+                    print(json.dumps(ev, default=str))
+                else:
+                    _print_event_row(ev)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        c.close()
+
+
+def cmd_explain(args):
+    """Scheduler decision attribution (\"why is my actor pending\"): the
+    live per-node rejection table for a pending actor/PG, or the recorded
+    decision trace (queue wait, node, lease RTT) once it placed."""
+    sd = _pick_session(args)
+    c = GcsClient(sd)
+    try:
+        reply = c.rpc({"type": "sched_explain", "target": args.target})
+    finally:
+        c.close()
+    if args.json:
+        print(json.dumps(reply, indent=1, default=str))
+        return
+    if not reply.get("found"):
+        print(reply.get("error") or f"no actor or placement group "
+                                    f"{args.target!r}", file=sys.stderr)
+        sys.exit(1)
+    kind, state = reply.get("kind"), reply.get("state")
+    print(f"{kind} {args.target}: {state}")
+    trace = reply.get("trace") or {}
+    if trace:
+        items = "  ".join(f"{k}={v}" for k, v in sorted(trace.items())
+                          if k != "history" and v is not None)
+        print(f"  trace: {items}")
+    if reply.get("queue_wait_s") is not None:
+        print(f"  waiting for {reply['queue_wait_s']:.1f}s")
+    rej = reply.get("rejections")
+    if rej:
+        print("  per-node rejection table:")
+        width = max(len(k) for k in rej)
+        for node_id, why in sorted(rej.items()):
+            print(f"    {node_id:<{width}}  {why}")
+    elif reply.get("note"):
+        print(f"  {reply['note']}")
 
 
 def cmd_dag(args):
@@ -653,6 +761,27 @@ def main(argv=None):
     sp = sub.add_parser("timeline", help="export task timeline (chrome trace)")
     sp.add_argument("-o", "--output", help="output path (default timeline.json)")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("events",
+                        help="structured cluster event log (node/actor/PG "
+                             "lifecycle, drains, autoscaler, serve, train)")
+    sp.add_argument("-f", "--follow", action="store_true",
+                    help="poll for new events (seq watermark)")
+    sp.add_argument("--severity",
+                    help="minimum severity (DEBUG/INFO/WARNING/ERROR)")
+    sp.add_argument("--type", help="exact event type, e.g. node.drain")
+    sp.add_argument("--node", help="only events attributed to this node")
+    sp.add_argument("-n", "--limit", type=int, default=0,
+                    help="newest N matching events (default: all retained)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser("explain",
+                        help="why is this actor/placement-group pending? "
+                             "(per-node rejection table / decision trace)")
+    sp.add_argument("target", help="actor id or placement group id")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_explain)
 
     sp = sub.add_parser("dag", help="compiled-DAG registry: list / show")
     sp.add_argument("action", choices=["list", "show"])

@@ -784,6 +784,7 @@ class ServeController:
         # out 10 replicas) must not serialize 10 round trips while routers'
         # get_routing_table calls wait on the lock
         changed = False
+        dead_dropped = started = scaled_down = deleted_deps = 0
         with self._lock:
             for full, st in list(self.deployments.items()):
                 # replica death detection: drop handles whose actor the GCS
@@ -801,6 +802,7 @@ class ServeController:
                         self._forget_probe(st, tag)
                         self._delete_rep_row(st, tag)
                         changed = True
+                        dead_dropped += 1
                     # active health probing on each deployment's
                     # health_check_period_s — distinct from the
                     # actor-state="dead" path above: these replicas are
@@ -825,10 +827,12 @@ class ServeController:
                     for _ in range(st.target - live):
                         self._start_replica(st)
                     changed = True
+                    started += st.target - live
                 elif live > st.target:
                     drop = list(st.replicas)[: live - st.target]
                     self._drop_replicas(st, drop)
                     changed = True
+                    scaled_down += len(drop)
                 st.status = ("HEALTHY" if len(st.replicas) == st.target
                              else "UPDATING")
                 if st.deleted and not st.replicas and not st.draining:
@@ -836,7 +840,23 @@ class ServeController:
                     self._store.delete(dep_key(full))
                     self._store.delete(blob_key(full, st.nonce))
                     changed = True
+                    deleted_deps += 1
             self._reconcile_proxies_locked(lookup, now, stats_ok)
+            if changed:
+                # controller-side cluster event, shipped to the GCS by the
+                # host worker's telemetry flusher (cluster_events_report)
+                from ray_tpu._private import constants as _const
+                from ray_tpu._private.events import emit_event
+                emit_event(
+                    _const.EVENT_SERVE_RECONCILE,
+                    severity=(_const.EVENT_SEVERITY_WARNING if dead_dropped
+                              else _const.EVENT_SEVERITY_INFO),
+                    message=f"serve reconcile: {dead_dropped} dead replicas "
+                            f"dropped, {started} started, "
+                            f"{scaled_down} scaled down",
+                    source="serve-controller",
+                    dead_replicas=dead_dropped, started=started,
+                    scaled_down=scaled_down, deleted=deleted_deps)
             if changed or self._reconcile_dirty:
                 self._reconcile_dirty = False
                 self._bump_version()

@@ -91,6 +91,20 @@ class TrainController:
                 "workers": self.current_workers,
                 "error": error,
             })
+            # controller-side cluster event, shipped to the GCS by the host
+            # worker's telemetry flusher (cluster_events_report)
+            from ray_tpu._private import constants as _const
+            from ray_tpu._private.events import emit_event
+            emit_event(
+                _const.EVENT_TRAIN_ATTEMPT,
+                severity=(_const.EVENT_SEVERITY_ERROR if outcome == "errored"
+                          else _const.EVENT_SEVERITY_INFO),
+                message=f"train attempt {len(self.attempt_log)} "
+                        f"{outcome} with {self.current_workers} workers"
+                        + (f": {error}" if error else ""),
+                source="train-controller",
+                attempt=len(self.attempt_log), outcome=outcome,
+                workers=self.current_workers, error=error)
             if outcome == "finished":
                 self.state = "FINISHED"
                 break

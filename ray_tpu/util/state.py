@@ -201,6 +201,30 @@ def list_requests(*, filters: Optional[List[Tuple]] = None,
     return _apply(rows, filters, limit)
 
 
+def list_events(*, filters: Optional[List[Tuple]] = None, limit: int = 1000,
+                severity: str = "", etype: str = "", node: str = "",
+                after_seq: int = 0) -> list:
+    """The structured cluster event log (node/actor/PG lifecycle,
+    autoscaler transitions, serve reconciles, train attempts). severity is
+    a MINIMUM bound ("WARNING" → WARNING+ERROR); etype/node are exact
+    matches; after_seq is the follow-mode watermark. All four (plus the
+    limit) are applied SERVER-side against the GCS ring — the reference-
+    style predicate `filters` then refine client-side."""
+    rows = _worker().rpc({
+        "type": "list_events", "limit": limit, "severity": severity,
+        "etype": etype, "node": node, "after_seq": after_seq,
+    }).get("events", [])
+    return _apply(rows, filters, limit)
+
+
+def explain(target: str) -> dict:
+    """Why is this actor/placement-group pending? Returns the scheduler's
+    decision trace (queue wait, attempts, chosen node) and — while the
+    target is pending — the live per-node rejection table naming each
+    node's blocking reason (resources/label/affinity/draining)."""
+    return _worker().rpc({"type": "sched_explain", "target": target})
+
+
 def get_request_trace(request_id: str) -> Optional[dict]:
     """The sampled span tree for one serve request (trace id == request
     id), or None when that request wasn't sampled — fall back to
@@ -223,8 +247,9 @@ def get_node(node_id: str) -> Optional[dict]:
 
 
 __all__ = [
+    "explain",
     "get_actor", "get_node", "get_request_trace", "list_actors",
-    "list_compiled_dags",
+    "list_compiled_dags", "list_events",
     "list_jobs", "list_nodes", "list_objects", "list_placement_groups",
     "list_requests",
     "list_tasks", "list_workers", "summarize_dag", "summarize_dag_metrics",

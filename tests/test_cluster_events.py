@@ -1,0 +1,500 @@
+"""Cluster event log + scheduler decision attribution (ISSUE 19).
+
+(reference capability: the export API / cluster event log plus the state
+API's "why is my actor pending" story — typed node/actor/PG lifecycle
+events readable from the control store, and a live per-node rejection
+table for anything the scheduler can't place.)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+from ray_tpu._private import constants as const
+from ray_tpu._private import events as cev
+from ray_tpu._private.ray_config import RayConfig
+
+
+@pytest.fixture
+def session():
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=4, num_workers=2, max_workers=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _rpc(msg: dict) -> dict:
+    return _api._get_worker().rpc(msg)
+
+
+def _events(**kw) -> list:
+    msg = {"type": "list_events"}
+    msg.update(kw)
+    return _rpc(msg)["events"]
+
+
+def _wait_for_event(predicate, timeout=20.0, **list_kw):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        hits = [e for e in _events(**list_kw) if predicate(e)]
+        if hits:
+            return hits
+        time.sleep(0.2)
+    raise AssertionError(
+        f"no matching event within {timeout}s; have "
+        f"{[(e.get('etype'), e.get('message')) for e in _events()]}")
+
+
+def _run_cli(argv) -> str:
+    from ray_tpu.scripts import cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(argv)
+    return buf.getvalue()
+
+
+# ------------------------------------------------ producer ring (unit)
+
+
+def test_producer_ring_bounds_and_drain_once(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CLUSTER_EVENTS_RING_SIZE", "8")
+    RayConfig.reset()
+    cev.reset()
+    try:
+        for i in range(20):
+            cev.emit_event(const.EVENT_TRAIN_ATTEMPT, message=f"e{i}",
+                           attempt=i)
+        ring = cev.recent()
+        # bounded: only the newest ring-size records survive
+        assert [r["attempt"] for r in ring] == list(range(12, 20))
+        # drain-once: the first drain hands over the surviving suffix...
+        assert [r["attempt"] for r in cev.drain()] == list(range(12, 20))
+        # ...and the second hands over nothing until new events arrive
+        assert cev.drain() == []
+        cev.emit_event(const.EVENT_TRAIN_ATTEMPT, attempt=99)
+        assert [r["attempt"] for r in cev.drain()] == [99]
+        # envelope fields stamped on every record
+        rec = cev.recent()[-1]
+        for f in (const.EVENT_FIELD_SEQ, const.EVENT_FIELD_TS,
+                  const.EVENT_FIELD_TYPE, const.EVENT_FIELD_SEVERITY,
+                  const.EVENT_FIELD_SOURCE):
+            assert f in rec
+    finally:
+        RayConfig.reset()
+        cev.reset()
+
+
+def test_emit_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CLUSTER_EVENTS", "0")
+    RayConfig.reset()
+    cev.reset()
+    try:
+        assert cev.enabled() is False
+        cev.emit_event(const.EVENT_TRAIN_ATTEMPT, attempt=1)
+        assert cev.recent() == []
+        assert cev.drain() == []
+    finally:
+        RayConfig.reset()
+        cev.reset()
+
+
+def test_filter_events_semantics():
+    def row(seq, sev, etype, node):
+        return {const.EVENT_FIELD_SEQ: seq, const.EVENT_FIELD_SEVERITY: sev,
+                const.EVENT_FIELD_TYPE: etype, const.EVENT_FIELD_NODE: node}
+
+    rows = [
+        row(1, const.EVENT_SEVERITY_DEBUG, const.EVENT_LEASE_GRANT, "n0"),
+        row(2, const.EVENT_SEVERITY_INFO, const.EVENT_NODE_JOIN, "n0"),
+        row(3, const.EVENT_SEVERITY_WARNING, const.EVENT_NODE_DRAIN, "n1"),
+        row(4, const.EVENT_SEVERITY_ERROR, const.EVENT_ACTOR_DEAD, "n1"),
+        row(5, "mystery", const.EVENT_NODE_JOIN, "n2"),
+    ]
+    # severity floor drops strictly-lower rows; unknown severities are
+    # never filtered out (they sort above every known level)
+    got = cev.filter_events(rows, min_severity=const.EVENT_SEVERITY_WARNING)
+    assert [r[const.EVENT_FIELD_SEQ] for r in got] == [3, 4, 5]
+    # exact type / node match
+    assert [r[const.EVENT_FIELD_SEQ] for r in cev.filter_events(
+        rows, etype=const.EVENT_NODE_JOIN)] == [2, 5]
+    assert [r[const.EVENT_FIELD_SEQ] for r in cev.filter_events(
+        rows, node="n1")] == [3, 4]
+    # seq watermark (the --follow poll loop)
+    assert [r[const.EVENT_FIELD_SEQ] for r in cev.filter_events(
+        rows, after_seq=3)] == [4, 5]
+    # limit means "the newest N that MATCH" — applied after the filters
+    got = cev.filter_events(rows, min_severity=const.EVENT_SEVERITY_INFO,
+                            limit=2)
+    assert [r[const.EVENT_FIELD_SEQ] for r in got] == [4, 5]
+    # filter output is copies, not aliases into the ring
+    got[0]["mutated"] = True
+    assert "mutated" not in rows[3]
+
+
+def test_severity_rank_ordering():
+    ranks = [cev.severity_rank(s) for s in const.EVENT_SEVERITIES]
+    assert ranks == sorted(ranks)
+    assert cev.severity_rank("nonsense") > cev.severity_rank(
+        const.EVENT_SEVERITY_ERROR)
+
+
+def test_event_literal_checker_flags_respelled_types(tmp_path):
+    """The graft_check invariant: event-type strings at emit sites outside
+    constants.py are findings (both plain literals and f-strings)."""
+    from tools.graft_check.checkers.event_literals import EventLiteralChecker
+    from tools.graft_check.core import ParsedModule
+
+    bad = tmp_path / "producer.py"
+    bad.write_text(
+        "from ray_tpu._private.events import emit_event\n"
+        "def go(kind):\n"
+        "    emit_event('node" + ".join')\n"
+        "    emit_event(f'node" + ".{kind}')\n"
+        "    emit_event(EVENT_NODE_JOIN)\n")
+    mod = ParsedModule(str(tmp_path), str(bad))
+    found = list(EventLiteralChecker().check_module(mod))
+    assert len(found) == 2
+    assert all(f.check_id == "event-type-literal" for f in found)
+    # the constants module itself is exempt
+    exempt = tmp_path / "_private" / "constants.py"
+    exempt.parent.mkdir()
+    exempt.write_text("EVENT_NODE_JOIN = 'node" + ".join'\n"
+                      "def make_event(e):\n    pass\n"
+                      "X = make_event('node" + ".join')\n")
+    assert list(EventLiteralChecker().check_module(
+        ParsedModule(str(tmp_path), str(exempt)))) == []
+
+
+def test_chrome_trace_gets_ctrl_row():
+    from ray_tpu._private.task_events import (normalize_events,
+                                              to_chrome_trace)
+
+    ev = {const.EVENT_FIELD_TYPE: const.EVENT_NODE_JOIN,
+          const.EVENT_FIELD_TS: time.time(),
+          const.EVENT_FIELD_NODE: "node-0",
+          const.EVENT_FIELD_SEVERITY: const.EVENT_SEVERITY_INFO,
+          const.EVENT_FIELD_SEQ: 1,
+          const.EVENT_FIELD_MESSAGE: "joined",
+          const.EVENT_FIELD_SOURCE: "gcs"}
+    trace = to_chrome_trace(normalize_events([dict(ev)]))
+    assert "ctrl:node-0" in trace
+    rows = json.loads(trace)["traceEvents"]
+    assert any(r.get("name") == const.EVENT_NODE_JOIN
+               and r.get("pid") == "ctrl:node-0" for r in rows)
+    # events without a node land on the cluster-wide control row
+    ev2 = dict(ev)
+    ev2[const.EVENT_FIELD_NODE] = ""
+    assert "ctrl:cluster" in to_chrome_trace(normalize_events([ev2]))
+
+
+# ------------------------------------------------ live-session lifecycle
+
+
+def test_actor_lifecycle_and_restart_events(session):
+    """The acceptance chain: a SIGKILLed worker's actor death shows up as
+    actor.restarting with its death cause, then actor.alive with the
+    restart count — all causally linked by actor_id."""
+    # session start already logged node.join for the head node
+    joins = _wait_for_event(lambda e: e["etype"] == const.EVENT_NODE_JOIN)
+    assert any(e.get("node") for e in joins)
+
+    @ray_tpu.remote(max_restarts=-1)
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+    a = Phoenix.options(name="phoenix").remote()
+    aid = a.actor_id
+    victim = ray_tpu.get(a.pid.remote(), timeout=60)
+    _wait_for_event(lambda e: e["etype"] == const.EVENT_ACTOR_ALIVE
+                    and e.get("actor_id") == aid)
+    os.kill(victim, signal.SIGKILL)
+    # the restart announcement carries the cause and the restart budget
+    restarting = _wait_for_event(
+        lambda e: e["etype"] == const.EVENT_ACTOR_RESTARTING
+        and e.get("actor_id") == aid, timeout=60)[0]
+    assert restarting["severity"] == const.EVENT_SEVERITY_WARNING
+    assert restarting.get("death_reason")
+    # ...and the recovery closes the loop with a bumped restart count
+    revived = _wait_for_event(
+        lambda e: e["etype"] == const.EVENT_ACTOR_ALIVE
+        and e.get("actor_id") == aid and e.get("num_restarts", 0) >= 1,
+        timeout=60)[0]
+    assert ray_tpu.get(a.pid.remote(), timeout=60) != victim
+    assert revived["num_restarts"] >= 1
+
+    # kill emits a terminal actor.dead
+    ray_tpu.kill(a)
+    dead = _wait_for_event(lambda e: e["etype"] == const.EVENT_ACTOR_DEAD
+                           and e.get("actor_id") == aid, timeout=60)[0]
+    assert dead["severity"] == const.EVENT_SEVERITY_ERROR
+
+    # server-side filtering: severity floor + type + newest-N limit
+    warn_up = _events(severity=const.EVENT_SEVERITY_WARNING)
+    assert warn_up and all(
+        e["severity"] in (const.EVENT_SEVERITY_WARNING,
+                          const.EVENT_SEVERITY_ERROR) for e in warn_up)
+    only_alive = _events(etype=const.EVENT_ACTOR_ALIVE)
+    assert only_alive and all(
+        e["etype"] == const.EVENT_ACTOR_ALIVE for e in only_alive)
+    assert len(_events(limit=2)) == 2
+    seqs = [e["seq"] for e in _events()]
+    assert seqs == sorted(seqs)
+
+
+def test_node_leave_event_names_lost_capacity(session):
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    nid = cluster.add_node(num_cpus=2.0)
+    _wait_for_event(lambda e: e["etype"] == const.EVENT_NODE_JOIN
+                    and e.get("node") == nid)
+    cluster.remove_node(nid)
+    left = _wait_for_event(lambda e: e["etype"] == const.EVENT_NODE_LEAVE
+                           and e.get("node") == nid)[0]
+    assert left["severity"] == const.EVENT_SEVERITY_WARNING
+    assert left.get("reason")
+
+
+# ------------------------------------------------ scheduler attribution
+
+
+def test_sched_explain_pending_actor_names_every_rejection(session):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(num_cpus=999)
+    class TooBig:
+        pass
+
+    a = TooBig.remote()
+    aid = a.actor_id
+    deadline = time.monotonic() + 20
+    res = {}
+    while time.monotonic() < deadline:
+        res = state.explain(aid)
+        if res.get("found") and res.get("rejections"):
+            break
+        time.sleep(0.2)
+    assert res.get("found"), res
+    assert res["kind"] == "actor" and res["state"] == "pending"
+    # the per-node rejection table names EVERY live node and the blocking
+    # reason on each (the acceptance criterion)
+    alive = [n["node_id"] for n in _api._get_worker().list_nodes()
+             if n["alive"]]
+    rej = res["rejections"]
+    assert set(alive) <= set(rej)
+    assert all("insufficient CPU" in rej[n] for n in alive), rej
+    assert res.get("queue_wait_s", 0) > 0
+    # decision metrics fold into the GCS snapshot
+    snap = _rpc({"type": "metrics_snapshot"})["metrics"]
+    assert "ray_tpu_sched_pending" in snap
+    assert "ray_tpu_sched_decisions_total" in snap
+    assert "ray_tpu_sched_decision_seconds" in snap
+
+    # CLI twin of the same answer
+    sdir = session["session_dir"]
+    out = _run_cli(["--session", sdir, "explain", aid])
+    assert "insufficient CPU" in out and "pending" in out
+    with pytest.raises(SystemExit):
+        _run_cli(["--session", sdir, "explain", "no-such-id"])
+
+    ray_tpu.kill(a)
+    assert not state.explain("no-such-id")["found"]
+
+
+def test_sched_explain_placed_actor_has_trace(session):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Small:
+        def ping(self):
+            return 1
+
+    a = Small.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    res = state.explain(a.actor_id)
+    assert res["found"] and res["state"] == "alive"
+    trace = res["trace"]
+    assert trace.get("status") == "created"
+    assert trace.get("node")
+    assert trace.get("queue_wait_s", -1) >= 0
+    assert trace.get("lease_rtt_s", -1) >= 0
+    ray_tpu.kill(a)
+
+
+# ------------------------------------------------ surfaces
+
+
+def test_status_shows_drain_reason_and_pending_demand(session):
+    # park an unplaceable actor so pending demand is non-zero
+    @ray_tpu.remote(num_cpus=999)
+    class Parked:
+        pass
+
+    a = Parked.remote()
+    nid = _api._get_worker().list_nodes()[0]["node_id"]
+    r = _rpc({"type": "node_drain", "node_id": nid,
+              "reason": "maintenance window", "grace_s": 120.0})
+    assert r["ok"], r
+    # cluster_state carries the drain attribution + demand summary...
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        cs = ray_tpu.cluster_state()
+        if cs["pending_demand"]["actor_creations"] >= 1:
+            break
+        time.sleep(0.2)
+    assert cs["pending_demand"]["actor_creations"] >= 1
+    row = next(n for n in _api._get_worker().list_nodes()
+               if n["node_id"] == nid)
+    assert row["draining"] and row["drain_reason"] == "maintenance window"
+    assert row["drain_deadline"] and row["drain_deadline"] > time.time()
+    # ...and `ray_tpu status` prints both
+    out = _run_cli(["--session", session["session_dir"], "status"])
+    assert "maintenance window" in out
+    assert "pending demand" in out
+    # the drain itself is an event with its reason
+    drained = _wait_for_event(lambda e: e["etype"] == const.EVENT_NODE_DRAIN
+                              and e.get("node") == nid)[0]
+    assert drained.get("reason") == "maintenance window"
+    ray_tpu.kill(a)
+
+
+def test_cli_events_filters_and_json(session):
+    sdir = session["session_dir"]
+    _wait_for_event(lambda e: e["etype"] == const.EVENT_NODE_JOIN)
+    out = _run_cli(["--session", sdir, "events"])
+    assert const.EVENT_NODE_JOIN in out
+    # exact-type filter shows only that type
+    out = _run_cli(["--session", sdir, "events", "--type",
+                    const.EVENT_NODE_JOIN])
+    assert const.EVENT_NODE_JOIN in out
+    assert const.EVENT_LEASE_GRANT not in out
+    # a severity floor above everything emitted so far prints no rows
+    rows = json.loads(_run_cli(["--session", sdir, "events", "--json"]))
+    assert rows and all("etype" in r and "seq" in r for r in rows)
+    if all(r["severity"] != const.EVENT_SEVERITY_ERROR for r in rows):
+        out = _run_cli(["--session", sdir, "events", "--severity",
+                        const.EVENT_SEVERITY_ERROR])
+        assert const.EVENT_NODE_JOIN not in out
+    # -n limits to the newest N
+    assert len(json.loads(_run_cli(
+        ["--session", sdir, "events", "--json", "-n", "1"]))) == 1
+
+
+def test_dashboard_events_and_explain_endpoints(session):
+    from ray_tpu.dashboard.head import DashboardHead
+
+    head = DashboardHead(session["session_dir"]).start()
+    try:
+        base = f"http://127.0.0.1:{head.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 15
+        rows = []
+        while time.monotonic() < deadline and not rows:
+            rows = get("/api/events")
+            time.sleep(0.2)
+        assert rows and all("etype" in r for r in rows)
+        only = get(f"/api/events?type={const.EVENT_NODE_JOIN}&limit=3")
+        assert 0 < len(only) <= 3
+        assert all(r["etype"] == const.EVENT_NODE_JOIN for r in only)
+        # explain requires a target
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/api/explain")
+        assert ei.value.code == 400
+        assert get("/api/explain?target=nope")["found"] is False
+        # the timeline export carries the control-plane rows
+        with urllib.request.urlopen(base + "/api/timeline",
+                                    timeout=10) as r:
+            assert b"ctrl:" in r.read()
+    finally:
+        head.stop()
+
+
+def test_state_list_events_severity_and_limit(session):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Noise:
+        def ping(self):
+            return 1
+
+    a = Noise.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    ray_tpu.kill(a)
+    _wait_for_event(lambda e: e["etype"] == const.EVENT_ACTOR_DEAD)
+    rows = state.list_events()
+    assert len(rows) >= 2 and all("etype" in r for r in rows)
+    two = state.list_events(limit=2)
+    assert len(two) == 2
+    assert [r["seq"] for r in two] == [r["seq"] for r in rows[-2:]]
+    warn = state.list_events(severity=const.EVENT_SEVERITY_WARNING)
+    assert all(r["severity"] != const.EVENT_SEVERITY_INFO for r in warn)
+
+
+# ------------------------------------------------ persistence
+
+
+def test_events_survive_gcs_restart(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_GCS_STORAGE_PATH", str(tmp_path / "gcs.db"))
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_workers=1, max_workers=4)
+    try:
+        @ray_tpu.remote
+        class Witness:
+            def ping(self):
+                return 1
+
+        a = Witness.remote()
+        ray_tpu.get(a.ping.remote(), timeout=60)
+        aid = a.actor_id
+        pre = _wait_for_event(lambda e: e["etype"] == const.EVENT_ACTOR_ALIVE
+                              and e.get("actor_id") == aid)[0]
+        pre_rows = _events()
+        pre_max_seq = max(e["seq"] for e in pre_rows)
+        had_debug = any(e["severity"] == const.EVENT_SEVERITY_DEBUG
+                        for e in pre_rows)
+
+        node = _api._node
+        node.gcs.crash_for_testing()
+        time.sleep(0.3)
+        node.restart_gcs()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                if ray_tpu.cluster_resources():
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+
+        # the pre-crash history is still there, same seq, same cause fields
+        rows = _events()
+        match = [e for e in rows
+                 if e["etype"] == const.EVENT_ACTOR_ALIVE
+                 and e.get("actor_id") == aid]
+        assert match and match[0]["seq"] == pre["seq"]
+        # post-restart events sequence AFTER the restored history
+        restarted_seqs = [e["seq"] for e in rows]
+        assert restarted_seqs == sorted(restarted_seqs)
+        # DEBUG rows (lease churn) are ring-only: any that existed before
+        # the crash did NOT come back from sqlite
+        if had_debug:
+            assert all(e["severity"] != const.EVENT_SEVERITY_DEBUG
+                       for e in rows if e["seq"] <= pre_max_seq)
+    finally:
+        ray_tpu.shutdown()

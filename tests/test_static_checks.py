@@ -36,6 +36,7 @@ if REPO not in sys.path:
 from tools.graft_check import (load_baseline, run_checks,  # noqa: E402
                                run_default)
 from tools.graft_check.checkers import (AsyncBlockingChecker,  # noqa: E402
+                                        EventLiteralChecker,
                                         LockDisciplineChecker,
                                         LockOrderChecker,
                                         MetricNamesChecker,
@@ -1183,6 +1184,9 @@ FIRING_FIXTURES = {
     "metric-expected": (
         {"m.py": "x = 1\n"},
         lambda: [MetricNamesChecker(expected=("ray_tpu_gone_total",))]),
+    "event-type-literal": (
+        {"m.py": "def f(gcs):\n    gcs.emit_event('node.bogus', {})\n"},
+        lambda: [EventLiteralChecker()]),
 }
 
 #: ids that fire through dedicated machinery, with their own tests above.

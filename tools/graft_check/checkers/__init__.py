@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from tools.graft_check.checkers.async_blocking import AsyncBlockingChecker
+from tools.graft_check.checkers.event_literals import EventLiteralChecker
 from tools.graft_check.checkers.lock_discipline import LockDisciplineChecker
 from tools.graft_check.checkers.lock_order import LockOrderChecker
 from tools.graft_check.checkers.metric_names import (EXPECTED_METRICS,
@@ -33,6 +34,7 @@ ALL_CHECKERS = (
     RpcPairingChecker,
     RpcFieldSchemaChecker,
     MetricNamesChecker,
+    EventLiteralChecker,
 )
 
 
@@ -51,7 +53,8 @@ def all_check_ids():
 
 
 __all__ = ["ALL_CHECKERS", "make_suite", "all_check_ids", "EXPECTED_METRICS",
-           "AsyncBlockingChecker", "LockDisciplineChecker",
+           "AsyncBlockingChecker", "EventLiteralChecker",
+           "LockDisciplineChecker",
            "LockOrderChecker", "MetricNamesChecker", "PersistOrderChecker",
            "ResourceLeakChecker", "RpcFieldSchemaChecker",
            "RpcPairingChecker", "ShmLifecycleChecker",

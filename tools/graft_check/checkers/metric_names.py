@@ -103,6 +103,13 @@ EXPECTED_METRICS = (
     # from the controller's last publish — its liveness heartbeat)
     "ray_tpu_serve_proxy_shards",
     "ray_tpu_serve_routing_table_age_seconds",
+    # scheduler decision attribution (gcs.py, unregistered — folded into
+    # metrics_snapshot under the "gcs" source): decision latency by
+    # kind/outcome, decisions/s counters (the scale harness's scheduler
+    # throughput probe), and the pending-work gauge per kind
+    "ray_tpu_sched_decision_seconds",
+    "ray_tpu_sched_decisions_total",
+    "ray_tpu_sched_pending",
 )
 
 
