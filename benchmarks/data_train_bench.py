@@ -184,8 +184,12 @@ def main():
     out = _capture.orchestrate(
         os.path.abspath(__file__), "RAY_TPU_DATA_BENCH_CHILD", _BUDGET_S,
         _LKG_PATH, ["images_per_sec", "device_wait_frac"], _ROOT)
-    with open(os.path.join(_ROOT, "DATA_BENCH.json"), "w") as f:
-        json.dump(out, f, indent=1)
+    # merge discipline: DATA_BENCH.json is shared with data_bench.py's
+    # `fault_tolerance` A/B section — a rerun here must not clobber it
+    sys.path.insert(0, _ROOT)
+    from ray_tpu.scripts._artifacts import merge_artifact
+
+    merge_artifact("DATA_BENCH.json", "results", out)
     print(json.dumps(out))
     return 0
 

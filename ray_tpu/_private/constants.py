@@ -191,6 +191,14 @@ EVENT_LEASE_RELEASE = "lease.release"
 EVENT_AUTOSCALER_INSTANCE = "autoscaler.instance"
 EVENT_SERVE_RECONCILE = "serve.reconcile"
 EVENT_TRAIN_ATTEMPT = "train.attempt"
+#: data-plane fault tolerance: a block's task was resubmitted after a
+#: SYSTEM error (actor death / worker crash / lost object), a dead
+#: `_MapPoolActor` was replaced by pool supervision, or a block was
+#: permanently errored (UDF raise under the skip policy, or a retry
+#: budget exhausted).
+EVENT_DATA_BLOCK_RETRY = "data.block_retry"
+EVENT_DATA_ACTOR_REPLACED = "data.actor_replaced"
+EVENT_DATA_BLOCK_ERRORED = "data.block_errored"
 
 EVENT_TYPES = (
     EVENT_NODE_JOIN, EVENT_NODE_LEAVE, EVENT_NODE_DRAIN,
@@ -199,6 +207,8 @@ EVENT_TYPES = (
     EVENT_PG_PENDING, EVENT_PG_CREATED, EVENT_PG_REMOVED,
     EVENT_LEASE_GRANT, EVENT_LEASE_RELEASE,
     EVENT_AUTOSCALER_INSTANCE, EVENT_SERVE_RECONCILE, EVENT_TRAIN_ATTEMPT,
+    EVENT_DATA_BLOCK_RETRY, EVENT_DATA_ACTOR_REPLACED,
+    EVENT_DATA_BLOCK_ERRORED,
 )
 
 #: canonical field names on the event record envelope. Producers populate
@@ -211,6 +221,11 @@ EVENT_FIELD_SEVERITY = "severity"
 EVENT_FIELD_SOURCE = "source"
 EVENT_FIELD_NODE = "node"
 EVENT_FIELD_MESSAGE = "message"
+
+#: pytest marker gating the data-plane chaos suite (SIGKILL of pool
+#: actors / forced block loss mid-pipeline). Registered in pytest.ini and
+#: spelled by tests/test_data_chaos.py's module pytestmark.
+DATA_CHAOS_MARKER = "data_chaos"
 
 # ---------------------------------------------------------------- deadlines
 

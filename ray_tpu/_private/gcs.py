@@ -2572,13 +2572,19 @@ class GcsServer:
         plan: list[dict] = []
         with self.lock:
             e = self.objects.get(oid)
-            if e is None:
-                return "gone"
-            if e["status"] == "pending":
-                return "pending"  # reconstruction already in flight
-            if e.get("where") == "inline":
-                return "ready"
             tid = oid[:-5] if len(oid) > 5 else ""
+            if e is None:
+                # no entry yet the owner asserts loss: an UNPUBLISHED
+                # direct-task result (owned bookkeeping never reached the
+                # GCS). The retained lineage spec can still replay it —
+                # _collect_recon_locked creates the pending entries the
+                # consumer's follow-up wait_object parks on.
+                if tid not in self.lineage:
+                    return "gone"
+            elif e["status"] == "pending":
+                return "pending"  # reconstruction already in flight
+            elif e.get("where") == "inline":
+                return "ready"
             if not self._collect_recon_locked(tid, plan, set(), 0):
                 return "lost"
         # resubmit upstream-first: _deps_ready gates execution order anyway

@@ -239,6 +239,34 @@ class RayConfig:
     # submit-path fallback.
     dag_recovery_timeout_s: float = 60.0
 
+    # --- data plane fault tolerance -------------------------------------
+    # Master switch for Data-plane fault handling (per-block retry, pool
+    # actor replacement, lineage-backed barrier recovery). Off = legacy
+    # fail-fast behavior (the DATA_BENCH A/B baseline).
+    data_fault_tolerance: bool = True
+    # Max resubmissions per block after a SYSTEM error (actor death /
+    # worker crash / lost object). Exhausting the budget raises
+    # DataBlockError(kind="system") naming the block.
+    data_max_block_retries: int = 3
+    # Base for the full-jitter retry backoff: sleep ~uniform(0,
+    # base * 2**attempt), capped at 8x base (PR 2 idiom, injectable rng).
+    data_retry_backoff_s: float = 0.25
+    # How many dead `_MapPoolActor`s a pool may replace over its lifetime
+    # (-1 = unlimited). Exhausting it with zero survivors fails the
+    # pipeline rather than hanging it.
+    data_actor_restart_budget: int = 4
+    # Transient-IO retries per file inside datasource read tasks (OSError
+    # except FileNotFoundError), and their backoff base. Failures carry
+    # per-file attribution.
+    data_read_retries: int = 2
+    data_read_retry_backoff_s: float = 0.2
+    # APPLICATION-error (UDF raise) policy: "raise" surfaces the first
+    # errored block; "skip" drops it (counted + logged with block id)
+    # until max_errored_blocks is exceeded (-1 = unlimited skips).
+    # Retried SYSTEM errors never consume this budget.
+    data_on_block_error: str = "raise"
+    data_max_errored_blocks: int = -1
+
     _singleton = None
     _lock = threading.Lock()
 

@@ -111,7 +111,32 @@ def dumps_into(obj: Any) -> tuple[list[bytes | memoryview], int]:
     return parts, total
 
 
-def loads(data: bytes | memoryview) -> Any:
+def _tethered(view: memoryview, owner: Any):
+    """Wrap a zero-copy buffer slice so its consumers keep `owner` alive.
+
+    Out-of-band buffers become the base of the numpy arrays pickle
+    reconstructs; a plain memoryview keeps only the mmap alive, NOT the
+    store pin wrapper — so freeing the ref would release the pin and let
+    the arena recycle the slot under live views (plasma semantics: a Get
+    buffer pins the entry, and deleting a pinned entry defers space reuse
+    until the final release — cpp/shm_store.cc kDeleting). A ctypes array
+    is the one pure-Python buffer exporter that reports ITSELF as the
+    owner of derived memoryviews (numpy's export redirects to the root
+    base, so an ndarray-subclass tether gets collapsed away)."""
+    import ctypes
+
+    try:
+        t = (ctypes.c_char * view.nbytes).from_buffer(view)
+    except (TypeError, ValueError):
+        # read-only buffer: the file-backend PROT_READ mmap. Unlinked-file
+        # pages persist while mapped, so there is no reuse hazard to pin
+        # against — the plain view is safe there.
+        return view
+    t._tether_owner = owner
+    return t
+
+
+def loads(data: bytes | memoryview, owner: Any = None) -> Any:
     view = memoryview(data)
     (pick_len,) = _U64.unpack_from(view, 0)
     pick = view[8 : 8 + pick_len]
@@ -122,6 +147,7 @@ def loads(data: bytes | memoryview) -> Any:
     for _ in range(n_buf):
         (blen,) = _U64.unpack_from(view, off)
         off += 8
-        buffers.append(view[off : off + blen])
+        b = view[off : off + blen]
+        buffers.append(_tethered(b, owner) if owner is not None else b)
         off += blen
     return pickle.loads(pick, buffers=buffers)

@@ -275,6 +275,7 @@ class CoreWorker:
         self._owned: dict[str, dict] = {}
         self._owned_lock = threading.RLock()
         self._loc_cache: dict[str, tuple] = {}  # oid → (host, size) once ready
+        self._status_cache: dict[str, str] = {}  # oid → "ready"|"error"
         self._flight_holds: dict[str, list[str]] = {}  # direct tid → held oids
         self._direct = None  # DirectDispatcher, created lazily on first use
         # deserialized task functions keyed by content sha (or raw blob for
@@ -1322,20 +1323,23 @@ class CoreWorker:
         else:
             plasma = self.store.get(oid)
             self._plasma_refs[oid] = plasma
-            value = self._loads_restoring(plasma.buf)
+            value = self._loads_restoring(plasma.buf, owner=plasma)
         if reply["status"] == "error":
             raise value
         self._memory[oid] = value
         return value
 
-    def _loads_restoring(self, buf):
+    def _loads_restoring(self, buf, owner=None):
         """Deserialize, resolving RDT markers when (and only when) the
         payload constructed one during unpickling — exact detection at any
-        nesting depth (reference: RDT materialization on get)."""
+        nesting depth (reference: RDT materialization on get). `owner` is
+        the store pin wrapper backing `buf`: zero-copy arrays tether it so
+        the arena slot cannot be recycled while they are alive, even after
+        the ref itself is freed."""
         from ray_tpu.experimental.device_objects import marker_capture, restore
 
         with marker_capture() as saw:
-            value = ser.loads(buf)
+            value = ser.loads(buf, owner=owner)
         if saw():
             value = restore(value, self)
         return value
@@ -1383,12 +1387,23 @@ class CoreWorker:
                     raise value
                 self._memory[oid] = value
                 return value
-            if st == "ready" and where == "shm" and self.store.contains(oid):
-                plasma = self.store.get(oid)
-                self._plasma_refs[oid] = plasma
-                value = self._loads_restoring(plasma.buf)
-                self._memory[oid] = value
-                return value
+            if st == "ready" and where == "shm":
+                if self.store.contains(oid):
+                    plasma = self.store.get(oid)
+                    self._plasma_refs[oid] = plasma
+                    value = self._loads_restoring(plasma.buf, owner=plasma)
+                    self._memory[oid] = value
+                    return value
+                if (ent.get("host") or self.host_id) == self.host_id:
+                    # the owned local copy vanished (deleted, or evicted
+                    # without a spill). wait_object would park forever: an
+                    # unpublished direct result has no GCS entry to wait on.
+                    # Drive the pull/reconstruct loop instead — object_lost
+                    # replays the retained lineage spec.
+                    reply = {"ready": True, "status": st, "where": where,
+                             "inline": None, "size": ent.get("size", 0),
+                             "locations": []}
+                    return self._materialize(oid, reply)
             # redirected to the GCS (retry) or a remote shm copy: fall through
         reply = self.rpc({"type": "wait_object", "oid": oid},
                          timeout=timeout if timeout is not None else 86400.0)
@@ -1405,9 +1420,57 @@ class CoreWorker:
         if locs:
             host = locs[0][0]
         self._loc_cache[oid] = (host, reply.get("size", 0))
+        if reply.get("status") in ("ready", "error"):
+            self._status_cache[oid] = reply["status"]
+            if len(self._status_cache) > 4096:
+                for k in list(self._status_cache)[:1024]:
+                    self._status_cache.pop(k, None)
         if len(self._loc_cache) > 4096:
             for k in list(self._loc_cache)[:1024]:
                 self._loc_cache.pop(k, None)
+
+    def error_of(self, oid: str):
+        """The exception a ready-but-errored object carries, or None.
+
+        `wait()` reports errored objects as ready, so a completion poll
+        that forwards "ready" refs downstream would forward poison; this
+        probe answers error-ness WITHOUT fetching successful payloads
+        (error blobs are always inline, and `_note_locations` caches the
+        status of every ref wait() resolved, so the healthy path is
+        RPC-free). Never raises — an unreachable GCS is inconclusive and
+        returns None, leaving the error to surface at the eventual
+        `get()`. Call only on refs `wait()` already reported ready: the
+        fallback RPC blocks until the object resolves."""
+        if oid in self._memory:
+            return None  # only successful gets land in _memory
+        ent = self._owned.get(oid)
+        if ent is not None and ent.get("status") != "redirect":
+            st = ent.get("status")
+            if st == "ready":
+                return None
+            if st == "error":
+                try:
+                    return self._loads_restoring(ent.get("inline"))
+                except Exception as exc:
+                    return exc
+        if self._status_cache.get(oid) == "ready":
+            return None
+        try:
+            reply = self.rpc({"type": "wait_object", "oid": oid},
+                             timeout=30.0)
+        except Exception:
+            return None
+        self._note_locations(oid, reply)
+        if reply.get("status") != "error":
+            return None
+        try:
+            if reply.get("inline") is not None:
+                return self._loads_restoring(reply["inline"])
+            self._materialize(oid, reply)  # errored objects raise here
+        except Exception as exc:
+            return exc
+        return WorkerCrashedError(
+            f"object {oid[:12]}… errored but its payload is unavailable")
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -1498,6 +1561,7 @@ class CoreWorker:
             self._memory.pop(oid, None)
             self._plasma_refs.pop(oid, None)
             self._obj_waits.pop(oid, None)
+            self._status_cache.pop(oid, None)
             with self._owned_lock:
                 self._owned.pop(oid, None)
             self.store.delete(oid)

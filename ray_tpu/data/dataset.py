@@ -39,6 +39,7 @@ from ray_tpu.data.datasource import (
 from ray_tpu.data.execution import (
     StreamingExecutor,
     _rebatch,
+    _robust_get,
     build_stages,
     iter_result_blocks,
 )
@@ -47,14 +48,32 @@ DEFAULT_PARALLELISM = 8
 
 
 class Dataset:
-    def __init__(self, last_op: L.LogicalOp):
+    def __init__(self, last_op: L.LogicalOp, exec_opts: dict | None = None):
         self._op = last_op
+        # execution policy (on_block_error / max_errored_blocks), threaded
+        # through every derived Dataset so execute_options() set early in
+        # a chain governs the eventual consumption
+        self._exec_opts: dict = dict(exec_opts or {})
 
     # ------------------------------------------------------------ transforms
 
     def _append(self, op: L.LogicalOp) -> "Dataset":
         op.input = self._op
-        return Dataset(op)
+        return Dataset(op, self._exec_opts)
+
+    def execute_options(self, *, on_block_error: str | None = None,
+                        max_errored_blocks: int | None = None) -> "Dataset":
+        """Dataset with updated fault-handling policy for UDF errors:
+        `on_block_error` "raise" (default) surfaces the first errored
+        block, "skip" drops-and-counts up to `max_errored_blocks`
+        (-1 = unlimited). System faults (dead actors, lost blocks) are
+        always retried and never consult these knobs."""
+        opts = dict(self._exec_opts)
+        if on_block_error is not None:
+            opts["on_block_error"] = on_block_error
+        if max_errored_blocks is not None:
+            opts["max_errored_blocks"] = max_errored_blocks
+        return Dataset(self._op, opts)
 
     def map_batches(self, fn: Callable, *, batch_size: int | None = None,
                     batch_format: str = "numpy", fn_kwargs: dict | None = None,
@@ -250,7 +269,7 @@ class Dataset:
         return build_stages(ops, DEFAULT_PARALLELISM)
 
     def iter_blocks(self) -> Iterator[Block]:
-        yield from iter_result_blocks(self._stages())
+        yield from iter_result_blocks(self._stages(), **self._exec_opts)
 
     def _materialize_blocks(self) -> list[Block]:
         return list(self.iter_blocks())
@@ -380,7 +399,8 @@ class Dataset:
     def streaming_split(self, n: int, *, equal: bool = True) -> list["DataIterator"]:
         """N coordinated iterators backed by one shared executor actor.
         (reference: dataset.py streaming_split:1854 + output_splitter.py)"""
-        coordinator = _SplitCoordinator.options(name=None).remote(self._op, n)
+        coordinator = _SplitCoordinator.options(name=None).remote(
+            self._op, n, self._exec_opts)
         return [DataIterator(coordinator, i) for i in builtins.range(n)]
 
     # ---------------------------------------------------------------- writes
@@ -556,11 +576,11 @@ class _SplitCoordinator:
     OutputSplitter, execution/operators/output_splitter.py — blocks are
     routed round-robin to N registered consumers with per-split queues.)"""
 
-    def __init__(self, last_op, n: int):
+    def __init__(self, last_op, n: int, exec_opts: dict | None = None):
         self.n = n
         stages = build_stages(L.optimize(last_op.chain()), DEFAULT_PARALLELISM)
         self._queues: list[collections.deque] = [collections.deque() for _ in builtins.range(n)]
-        self._ex = StreamingExecutor(stages)
+        self._ex = StreamingExecutor(stages, **(exec_opts or {}))
         self._gen = self._ex.execute()
         self._rr = 0
         self._done = False
@@ -572,7 +592,7 @@ class _SplitCoordinator:
             except StopIteration:
                 self._done = True
                 return
-            got = ray_tpu.get(item) if hasattr(item, "hex") else item
+            got = _robust_get(item) if hasattr(item, "hex") else item
             self._ex._free_if_owned(item)
             blocks = got if isinstance(got, list) else [got]
             for b in blocks:

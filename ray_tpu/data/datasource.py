@@ -108,6 +108,39 @@ def _expand_paths(paths, suffixes: tuple[str, ...]) -> list[str]:
     return out
 
 
+def _read_with_retries(reader: Callable, path: str) -> list:
+    """One file read with bounded transient-IO retries (jittered backoff).
+
+    Runs INSIDE the read task, so a flaky filesystem degrades to latency
+    instead of failing the block; the executor's per-block retry above it
+    only sees errors that survived this budget. A persistent failure
+    carries per-file attribution (the path and attempt count), and
+    `FileNotFoundError` is never retried — a missing file will not
+    reappear."""
+    import random as _random
+    import time as _time
+
+    from ray_tpu._private.ray_config import RayConfig
+
+    cfg = RayConfig.instance()
+    retries = cfg.data_read_retries if cfg.data_fault_tolerance else 0
+    base = cfg.data_read_retry_backoff_s
+    attempt = 0
+    while True:
+        try:
+            return reader(path)
+        except FileNotFoundError:
+            raise
+        except OSError as exc:
+            if attempt >= retries:
+                raise OSError(
+                    f"read of {path!r} failed after {attempt + 1} "
+                    f"attempt(s): {exc}") from exc
+            _time.sleep(_random.uniform(
+                0.0, min(base * (2 ** attempt), base * 8.0)))
+            attempt += 1
+
+
 class FileDatasource(Datasource):
     suffixes: tuple[str, ...] = ()
 
@@ -124,7 +157,7 @@ class FileDatasource(Datasource):
             def fn(grp=grp, reader=self.read_file):
                 blocks = []
                 for path in grp:
-                    blocks.extend(reader(path))
+                    blocks.extend(_read_with_retries(reader, path))
                 return blocks
 
             tasks.append(ReadTask(fn, input_files=grp))

@@ -15,7 +15,9 @@ in-flight caps + output-queue caps give the same streaming property.)
 from __future__ import annotations
 
 import collections
+import heapq
 import itertools
+import logging
 import random
 import time
 from dataclasses import dataclass, field
@@ -24,8 +26,98 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import constants as const
+from ray_tpu._private.ray_config import RayConfig
 from ray_tpu.data import logical as L
 from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_block
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    DataBlockError,
+    ObjectLostError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+# Retry taxonomy: SYSTEM errors are the runtime's fault — the task never
+# (fully) ran because its actor/worker died or an input copy vanished —
+# and resubmission from the retained input is safe and invisible.
+# Everything else reached the UDF and is an APPLICATION error, governed by
+# the on_block_error policy (reference: Ray Data's task retry vs
+# max_errored_blocks split).
+_SYSTEM_ERRORS = (ActorDiedError, WorkerCrashedError, ObjectLostError)
+
+
+def _is_system_error(exc) -> bool:
+    if isinstance(exc, _SYSTEM_ERRORS):
+        return True
+    return isinstance(getattr(exc, "cause", None), _SYSTEM_ERRORS)
+
+
+def _backoff_delay(attempt: int, base: float, rng) -> float:
+    """Full-jitter exponential backoff, capped at 8x base (PR 2 idiom —
+    rng is injectable so tests pin the schedule)."""
+    return rng.uniform(0.0, min(base * (2 ** attempt), base * 8.0))
+
+
+def _ref_error(ref):
+    """The exception a wait()-ready ref carries, or None. `wait` reports
+    errored objects as ready, so completion polls must probe before
+    forwarding a ref downstream — via the owner's status cache, never by
+    fetching successful payloads."""
+    if not hasattr(ref, "hex"):
+        return None
+    try:
+        from ray_tpu._private.api import _get_worker
+
+        return _get_worker().error_of(ref.hex())
+    except Exception:
+        return None
+
+
+def _actor_dead(actor) -> bool:
+    """GCS `actor_info` liveness probe (the same poll PR 17's collectives
+    use): dead only on a positive answer — an RPC failure is inconclusive
+    and must never condemn a healthy actor."""
+    try:
+        from ray_tpu._private.api import _get_worker
+
+        info = _get_worker().rpc(
+            {"type": "actor_info", "aid": actor._actor_id}, timeout=10.0)
+    except Exception:
+        return False
+    return (not info.get("found")) or info.get("state") == "dead"
+
+
+def _emit_data_event(etype: str, message: str, **fields) -> None:
+    try:
+        from ray_tpu._private.events import emit_event
+
+        emit_event(etype, severity=const.EVENT_SEVERITY_WARNING,
+                   message=message, **fields)
+    except Exception:  # noqa: BLE001 — telemetry must not kill the pipeline
+        pass
+
+
+def _robust_get(refs, *, rng=None):
+    """Driver-side barrier `get` riding lineage recovery: a lost copy is
+    reconstructed inside the worker's `_ensure_local` loop, and the rare
+    `ObjectLostError` that still escapes (reconstruction racing eviction)
+    gets a bounded, jittered re-get before surfacing."""
+    cfg = RayConfig.instance()
+    if not cfg.data_fault_tolerance:
+        return ray_tpu.get(refs)
+    rng = rng if rng is not None else random.Random()
+    attempt = 0
+    while True:
+        try:
+            return ray_tpu.get(refs)
+        except ObjectLostError:
+            if attempt >= cfg.data_max_block_retries:
+                raise
+            time.sleep(_backoff_delay(attempt, cfg.data_retry_backoff_s,
+                                      rng))
+            attempt += 1
 
 
 # Transform fns operate on list[Block] → list[Block]; a stage fuses several.
@@ -615,12 +707,15 @@ def _dist_join_refs(op):
         right_stages = build_stages(L.optimize(op.right_last.chain()), 8)
         ex = StreamingExecutor(right_stages)
         right_refs = []
-        for item in ex.execute():
-            if not hasattr(item, "hex"):
-                item = ray_tpu.put(item if isinstance(item, list) else [item])
-            else:
-                ex.owned.discard(item.hex())  # ownership moves to this stage
-            right_refs.append(item)
+        try:
+            for item in ex.execute():
+                if not hasattr(item, "hex"):
+                    item = ray_tpu.put(item if isinstance(item, list) else [item])
+                else:
+                    ex.owned.discard(item.hex())  # ownership moves to this stage
+                right_refs.append(item)
+        finally:
+            ex.release_owned()
         w = op.num_partitions or max(len(inputs), len(right_refs), 1)
         lparts = [_normalize_parts(
             _split_hash.options(num_returns=w).remote(it, w, op.on), w)
@@ -655,8 +750,9 @@ def _dist_sort_refs(key: str, descending: bool):
         if not inputs:
             return []
         w = len(inputs)
-        # sample pass → range boundaries (small arrays; fine on the driver)
-        samples = ray_tpu.get(
+        # sample pass → range boundaries (small arrays; fine on the
+        # driver); the get rides lineage recovery like every barrier get
+        samples = _robust_get(
             [_sample_keys.remote(it, key, 64) for it in inputs])
         allk = np.sort(np.concatenate([np.asarray(s) for s in samples])
                        if samples else np.asarray([]))
@@ -679,7 +775,7 @@ def _dist_repartition_refs(k: int):
     def run(inputs: list) -> list:
         if not inputs:
             return []
-        counts = ray_tpu.get([_rows_of.remote(it) for it in inputs])
+        counts = _robust_get([_rows_of.remote(it) for it in inputs])
         total = sum(counts)
         bounds = [round(total * (j + 1) / k) for j in range(k - 1)]
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).tolist()
@@ -729,10 +825,17 @@ class _ActorPool:
             num_cpus=res.get("CPU", 1.0),
             num_tpus=res.get("TPU", 0.0) or None)
         self._blob = blob
+        self._stage_name = stage.name
         self.actors = [self._cls.remote(blob) for _ in range(self.min_size)]
         self._outstanding: dict[str, int] = {}  # ref hex → actor index
         self._load = [0] * len(self.actors)
         self._idle_since = [time.monotonic()] * len(self.actors)
+        cfg = RayConfig.instance()
+        # lifetime dead-actor replacement budget (-1 = unlimited); FT off
+        # pins it to 0 so a dead actor is dropped, never respawned
+        self._restart_budget = (cfg.data_actor_restart_budget
+                                if cfg.data_fault_tolerance else 0)
+        self.replacements = 0
 
     def remote(self, payload):
         # grow whenever every live actor is already busy — the executor
@@ -775,6 +878,52 @@ class _ActorPool:
                         pass
                     break
 
+    def note_failed(self, ref_hex: str) -> tuple[list[str], int]:
+        """A task this pool dispatched came back errored: release its
+        slot, probe the actor that ran it, and if dead, replace it within
+        the restart budget. Returns (orphaned ref hexes — the dead actor's
+        OTHER in-flight tasks, for the executor to re-dispatch from its
+        retained payloads — and how many actors were replaced)."""
+        idx = self._outstanding.pop(ref_hex, None)
+        if idx is None or idx >= len(self.actors):
+            return [], 0
+        self._load[idx] -= 1
+        if self._load[idx] == 0:
+            self._idle_since[idx] = time.monotonic()
+        if not _actor_dead(self.actors[idx]):
+            return [], 0  # plain task failure on a live actor
+        return self._replace(idx)
+
+    def _replace(self, idx: int) -> tuple[list[str], int]:
+        orphans = [k for k, v in self._outstanding.items() if v == idx]
+        for k in orphans:
+            del self._outstanding[k]
+        dead = self.actors.pop(idx)
+        self._load.pop(idx)
+        self._idle_since.pop(idx)
+        for k, v in list(self._outstanding.items()):
+            if v > idx:
+                self._outstanding[k] = v - 1
+        try:
+            ray_tpu.kill(dead)  # reap the corpse's GCS record
+        except Exception:
+            pass
+        replaced = 0
+        if self._restart_budget != 0:
+            if self._restart_budget > 0:
+                self._restart_budget -= 1
+            self.actors.append(self._cls.remote(self._blob))
+            self._load.append(0)
+            self._idle_since.append(time.monotonic())
+            self.replacements += 1
+            replaced = 1
+        if not self.actors:
+            raise DataBlockError(
+                f"map-actor pool for stage {self._stage_name!r} has no "
+                f"survivors and its restart budget is exhausted",
+                stage=self._stage_name, kind="system")
+        return orphans, replaced
+
     def shutdown(self):
         for a in self.actors:
             try:
@@ -804,6 +953,17 @@ def _pipeline_metrics() -> tuple:
             _met.Counter("ray_tpu_data_backpressure_waits",
                          "dispatches deferred by queue/byte backpressure",
                          tag_keys=("pipeline",)),
+            _met.Counter("ray_tpu_data_block_retries_total",
+                         "block tasks resubmitted after SYSTEM errors "
+                         "(actor death / worker crash / lost object)",
+                         tag_keys=("pipeline",)),
+            _met.Counter("ray_tpu_data_actor_replacements_total",
+                         "dead map-pool actors replaced by supervision",
+                         tag_keys=("pipeline",)),
+            _met.Counter("ray_tpu_data_blocks_errored_total",
+                         "blocks permanently errored by UDF raises "
+                         "(skipped or surfaced per on_block_error)",
+                         tag_keys=("pipeline",)),
         )
     return _pipeline_metric_cache
 
@@ -817,9 +977,29 @@ class StreamingExecutor:
     """
 
     def __init__(self, stages: list[Stage], *, max_queued: int = 16,
-                 max_queued_bytes: int | None = None):
+                 max_queued_bytes: int | None = None,
+                 on_block_error: str | None = None,
+                 max_errored_blocks: int | None = None, rng=None):
         self.stages = stages
         self.max_queued = max_queued
+        cfg = RayConfig.instance()
+        # APPLICATION-error policy (UDF raises): "raise" surfaces the
+        # first errored block; "skip" drops-and-counts until
+        # max_errored_blocks is exceeded (-1 = unlimited). SYSTEM errors
+        # never consult either — they are retried, and only a retry
+        # budget exhaustion raises.
+        self.on_block_error = (on_block_error if on_block_error is not None
+                               else cfg.data_on_block_error)
+        if self.on_block_error not in ("raise", "skip"):
+            raise ValueError(
+                f"on_block_error must be 'raise' or 'skip', "
+                f"got {self.on_block_error!r}")
+        self.max_errored_blocks = (
+            max_errored_blocks if max_errored_blocks is not None
+            else cfg.data_max_errored_blocks)
+        self._rng = rng if rng is not None else random.Random()
+        self.errored_blocks = 0
+        self.errored_block_ids: list = []
         # reservation-style memory backpressure (reference:
         # data/_internal/execution/resource_manager.py — operator output
         # budgets in BYTES, not just counts): dispatch into a queue stalls
@@ -847,10 +1027,30 @@ class StreamingExecutor:
             except Exception:  # noqa: BLE001 — cleanup must not kill the stream
                 pass
 
+    def release_owned(self) -> None:
+        """Free every ref this execution still owns (idempotent).
+
+        The teardown half of the owned-ref ledger: `execute()` calls it
+        from its `finally` so an error or abandoned iteration never
+        strands store segments, and consumers that construct an executor
+        must call it on every path — graft_check's resource-leak pair
+        (`StreamingExecutor` / `release_owned`) holds them to it."""
+        if not self.owned:
+            return
+        from ray_tpu._private.worker import ObjectRef
+
+        refs = [ObjectRef(h) for h in self.owned]
+        self.owned.clear()
+        try:
+            ray_tpu.free(refs)
+        except Exception:  # noqa: BLE001 — cleanup must not kill teardown
+            pass
+
     def execute(self) -> Iterator[list]:
         """Yield ObjectRefs of list[Block] results of the final stage."""
         remote_cache: dict[int, Any] = {}
         actor_pools: list = []
+        self._actor_pools = actor_pools  # introspection (chaos tests)
 
         def stage_remote(i: int, stage: Stage):
             if i not in remote_cache:
@@ -937,9 +1137,142 @@ class StreamingExecutor:
         # Data's dashboard metrics tab — operator bytes/queue gauges);
         # process-wide gauges tagged per pipeline, updated at the same
         # sites that maintain the byte accounting
-        m_bytes, m_blocks, m_bp = _pipeline_metrics()
+        (m_bytes, m_blocks, m_bp, m_retries, m_replacements,
+         m_errored) = _pipeline_metrics()
         pipeline_tag = {"pipeline": f"exec-{next(_pipeline_seq)}"}
         bp_blocked = [False] * (len(rest) + 1)  # per-queue deferral state
+        # per-pipeline counter tallies, folded into the stable
+        # {"pipeline": "_retired"} aggregate at teardown: cumulative
+        # *_total counters must outlive the pipeline that earned them,
+        # while the per-pipeline series still retires (bounded cardinality)
+        tally = {"bp": 0.0, "retries": 0.0, "repl": 0.0, "errored": 0.0}
+
+        # ---- fault handling state (tentpole, ISSUE 20) ----
+        cfg = RayConfig.instance()
+        ft_on = cfg.data_fault_tolerance
+        max_retries = cfg.data_max_block_retries
+        backoff_s = cfg.data_retry_backoff_s
+        rng = self._rng
+        # block id = the block's submission-order sequence tag, which
+        # `_inherit` threads through every map stage — so the attempt
+        # count follows the BLOCK, not any one task ref, and a poison
+        # payload bouncing between replacement actors stays bounded
+        attempts: dict[int, int] = {}
+        retry_heap: list = []  # (due, tiebreak, stage idx | -1=source, item)
+        retry_tick = _it.count()
+
+        def _probe_ready(ready):
+            """Split wait()-ready refs into (ok, [(ref, exc)])."""
+            if not ft_on:
+                return ready, []
+            ok, bad = [], []
+            for r in ready:
+                exc = _ref_error(r)
+                (ok.append(r) if exc is None else bad.append((r, exc)))
+            return ok, bad
+
+        def _drop_item(item) -> None:
+            # forget a permanently-dead block's input: its tag must leave
+            # seq_of or the ordered-emission min-live gate stalls forever
+            seq_of.pop(_skey(item), None)
+            size_of.pop(_skey(item), None)
+            self._free_if_owned(item)
+
+        def _handle_failure(stage_idx: int, stage_name: str, ref, item,
+                            exc) -> None:
+            """One dispatched block task came back errored: classify, then
+            resubmit the retained input (SYSTEM, within budget), skip the
+            block (APPLICATION under the skip policy), or raise."""
+            _inherit(item, ref)  # the block id follows the input back
+            bid = seq_of.get(_skey(item), -1)
+            self.owned.discard(ref.hex())
+            try:
+                ray_tpu.free([ref])
+            except Exception:
+                pass
+            if _is_system_error(exc):
+                done = attempts.get(bid, 0)
+                if done < max_retries:
+                    attempts[bid] = done + 1
+                    tally["retries"] += 1
+                    try:
+                        m_retries.inc(tags=pipeline_tag)
+                    except Exception:
+                        pass
+                    _emit_data_event(
+                        const.EVENT_DATA_BLOCK_RETRY,
+                        f"block {bid} stage {stage_name!r}: retry "
+                        f"{done + 1}/{max_retries} after {type(exc).__name__}",
+                        block_id=bid, stage=stage_name)
+                    logger.warning(
+                        "data: retrying block %s in stage %r "
+                        "(attempt %d/%d) after %r",
+                        bid, stage_name, done + 1, max_retries, exc)
+                    heapq.heappush(
+                        retry_heap,
+                        (time.monotonic()
+                         + _backoff_delay(done, backoff_s, rng),
+                         next(retry_tick), stage_idx, item))
+                    return
+                _drop_item(item)
+                raise DataBlockError(
+                    f"block {bid} failed in stage {stage_name!r} after "
+                    f"{done} retries: {exc!r}", block_id=bid,
+                    stage=stage_name, kind="system") from exc
+            # APPLICATION error (the UDF itself raised)
+            if self.on_block_error == "skip":
+                self.errored_blocks += 1
+                self.errored_block_ids.append(bid)
+                tally["errored"] += 1
+                try:
+                    m_errored.inc(tags=pipeline_tag)
+                except Exception:
+                    pass
+                _emit_data_event(
+                    const.EVENT_DATA_BLOCK_ERRORED,
+                    f"block {bid} stage {stage_name!r} skipped: "
+                    f"{type(exc).__name__}",
+                    block_id=bid, stage=stage_name)
+                logger.warning(
+                    "data: skipping errored block %s in stage %r "
+                    "(%d skipped so far): %r",
+                    bid, stage_name, self.errored_blocks, exc)
+                _drop_item(item)
+                if 0 <= self.max_errored_blocks < self.errored_blocks:
+                    raise DataBlockError(
+                        f"{self.errored_blocks} errored blocks exceed "
+                        f"max_errored_blocks={self.max_errored_blocks} "
+                        f"(last: block {bid} in stage {stage_name!r}: "
+                        f"{exc!r})", block_id=bid, stage=stage_name,
+                        kind="application") from exc
+                return
+            _drop_item(item)
+            raise DataBlockError(
+                f"block {bid} failed in stage {stage_name!r}: UDF raised "
+                f"{exc!r}", block_id=bid, stage=stage_name,
+                kind="application") from exc
+
+        def _note_replacements(pool, stage_name: str, n: int) -> None:
+            if not n:
+                return
+            tally["repl"] += float(n)
+            try:
+                m_replacements.inc(float(n), tags=pipeline_tag)
+            except Exception:
+                pass
+            _emit_data_event(
+                const.EVENT_DATA_ACTOR_REPLACED,
+                f"stage {stage_name!r}: replaced {n} dead map-pool "
+                f"actor(s) ({pool.replacements} lifetime)",
+                stage=stage_name)
+            logger.warning(
+                "data: replaced %d dead map-pool actor(s) in stage %r",
+                n, stage_name)
+
+        def _pending_retries_before(i: int) -> bool:
+            # a pending retry for the source or any stage < i means the
+            # barrier at i has NOT seen all of its input yet
+            return any(entry[2] < i for entry in retry_heap)
 
         def _note_queues() -> None:
             try:
@@ -1002,6 +1335,7 @@ class StreamingExecutor:
             # otherwise inflate the counter at spin rate
             if not room and not bp_blocked[j]:
                 bp_blocked[j] = True
+                tally["bp"] += 1
                 try:
                     m_bp.inc(tags=pipeline_tag)
                 except Exception:
@@ -1016,6 +1350,19 @@ class StreamingExecutor:
         a2a_done = [False] * len(rest)
 
         def pump() -> None:
+            # due retries re-enter the normal dispatch queues first: a
+            # source payload returns to the head of the backlog, a map
+            # input back to its stage queue (min-tag-first dispatch then
+            # favors it — the retried block is the oldest pending work)
+            if retry_heap:
+                now = time.monotonic()
+                while retry_heap and retry_heap[0][0] <= now:
+                    _, _, j, item = heapq.heappop(retry_heap)
+                    if j < 0:
+                        source_payloads.appendleft(item)
+                    else:
+                        _q_add(j, item)
+
             # source dispatch
             while (source_payloads and len(src_in_flight) < first.max_in_flight
                    and _q_room(0)):
@@ -1026,25 +1373,39 @@ class StreamingExecutor:
                     continue
                 fn = stage_remote(-1, first)
                 ref = fn.remote(payload)
-                _tag(ref)
+                # a retried payload already carries its block tag; fresh
+                # payloads are tagged here, at first dispatch
+                if _skey(payload) in seq_of:
+                    _inherit(ref, payload)
+                else:
+                    _tag(ref)
                 self.owned.add(ref.hex())
-                src_in_flight[ref.hex()] = ref
+                # the payload is RETAINED while in flight: resubmission
+                # after a SYSTEM failure needs it
+                src_in_flight[ref.hex()] = (ref, payload)
 
             # poll source completions
             if src_in_flight:
-                refs = list(src_in_flight.values())
+                refs = [r for r, _ in src_in_flight.values()]
                 ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
-                for r in ready:
+                ok, bad = _probe_ready(ready)
+                for r in ok:
                     src_in_flight.pop(r.hex(), None)
                     _q_add(0, r)
+                for r, exc in bad:
+                    _, payload = src_in_flight.pop(r.hex())
+                    _handle_failure(-1, first.name, r, payload, exc)
 
             # downstream stages
             for i, stage in enumerate(rest):
                 if is_barrier(stage):
-                    # barrier: wait until everything upstream drained
+                    # barrier: wait until everything upstream drained —
+                    # including blocks parked on the retry heap, which
+                    # will re-enter an upstream queue when due
                     upstream_done = (not source_payloads and not src_in_flight
                                      and all(not f for f in in_flight[:i])
-                                     and all(not queues[j] or j == i for j in range(i + 1)))
+                                     and all(not queues[j] or j == i for j in range(i + 1))
+                                     and not _pending_retries_before(i))
                     if a2a_done[i] or not upstream_done or not _upstream_a2a_done(i):
                         continue
                     inputs = _ordered(queues[i])
@@ -1072,7 +1433,10 @@ class StreamingExecutor:
                     else:
                         blocks: list[Block] = []
                         for item in inputs:
-                            got = ray_tpu.get(item) if hasattr(item, "hex") else item
+                            # lineage-backed: a block whose only copy was
+                            # lost is reconstructed inside the get
+                            got = (_robust_get(item, rng=rng)
+                                   if hasattr(item, "hex") else item)
                             blocks.extend(got if isinstance(got, list) else [got])
                             self._free_if_owned(item)
                         for out_blocks in stage.all_to_all(blocks):
@@ -1093,18 +1457,38 @@ class StreamingExecutor:
                     refs = [r for r, _ in in_flight[i].values()]
                     ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
                     pool = remote_cache.get(i)
-                    for r in ready:
+                    ok, bad = _probe_ready(ready)
+                    for r in ok:
                         _, consumed = in_flight[i].pop(r.hex())
                         self._free_if_owned(consumed)
                         if hasattr(pool, "note_done"):
                             pool.note_done(r.hex())
                         _q_add(i + 1, r)
+                    for r, exc in bad:
+                        _, item = in_flight[i].pop(r.hex())
+                        if hasattr(pool, "note_failed"):
+                            # pool supervision: probe + replace the dead
+                            # actor, then re-dispatch every OTHER payload
+                            # it held from our retained inputs (each one
+                            # consumes a retry attempt, so a poison
+                            # payload cannot ping-pong forever)
+                            orphans, replaced = pool.note_failed(r.hex())
+                            _note_replacements(pool, stage.name, replaced)
+                            for oh in orphans:
+                                oe = in_flight[i].pop(oh, None)
+                                if oe is not None:
+                                    _handle_failure(
+                                        i, stage.name, oe[0], oe[1],
+                                        ActorDiedError(
+                                            "map-pool actor died with "
+                                            "this block in flight"))
+                        _handle_failure(i, stage.name, r, item, exc)
 
         def _upstream_a2a_done(i):
             return all(a2a_done[j] for j, s in enumerate(rest[:i]) if is_barrier(s))
 
         def all_done() -> bool:
-            return (not source_payloads and not src_in_flight
+            return (not source_payloads and not src_in_flight and not retry_heap
                     and all(not f for f in in_flight)
                     and all(not q for q in queues[:-1])
                     and all(a2a_done[i] for i, s in enumerate(rest) if is_barrier(s)))
@@ -1161,24 +1545,43 @@ class StreamingExecutor:
             # retire this pipeline's labelsets once it stops (normal end,
             # consumer abandonment, or error) — stale series would both
             # mislead /metrics and accumulate one labelset per lifetime
-            # pipeline in a long-lived driver
+            # pipeline in a long-lived driver. Counters first fold into a
+            # stable {"pipeline": "_retired"} aggregate: a *_total counter
+            # that vanished with its pipeline could never be scraped
+            # reliably, while gauges are point-in-time and just retire.
             try:
+                for met, key in ((m_bp, "bp"), (m_retries, "retries"),
+                                 (m_replacements, "repl"),
+                                 (m_errored, "errored")):
+                    if tally[key]:
+                        met.inc(tally[key], tags={"pipeline": "_retired"})
                 m_bytes.remove(pipeline_tag)
                 m_blocks.remove(pipeline_tag)
                 m_bp.remove(pipeline_tag)
+                m_retries.remove(pipeline_tag)
+                m_replacements.remove(pipeline_tag)
+                m_errored.remove(pipeline_tag)
             except Exception:
                 pass
             for pool in actor_pools:
                 pool.shutdown()
+            # every exception/abandonment path releases the owned-ref
+            # ledger — yielded-but-unconsumed and in-flight outputs never
+            # strand store segments (ISSUE 20 satellite)
+            self.release_owned()
 
 
-def iter_result_blocks(stages: list[Stage]) -> Iterator[Block]:
+def iter_result_blocks(stages: list[Stage], **exec_opts) -> Iterator[Block]:
     """Execute and yield individual blocks (driver-side materialized)."""
-    ex = StreamingExecutor(stages)
-    for item in ex.execute():
-        got = ray_tpu.get(item) if hasattr(item, "hex") else item
-        ex._free_if_owned(item)
-        if isinstance(got, list):
-            yield from got
-        else:
-            yield got
+    ex = StreamingExecutor(stages, **exec_opts)
+    try:
+        for item in ex.execute():
+            got = (_robust_get(item, rng=ex._rng)
+                   if hasattr(item, "hex") else item)
+            ex._free_if_owned(item)
+            if isinstance(got, list):
+                yield from got
+            else:
+                yield got
+    finally:
+        ex.release_owned()

@@ -104,6 +104,32 @@ def _rebuild_collective_error(msg, group, seq, dead_ranks, kind):
                            kind=kind)
 
 
+class DataBlockError(RayTpuError):
+    """A Data-plane block permanently failed after fault handling ran out.
+
+    Raised by the streaming executor with the block id and stage name
+    attached: either a SYSTEM failure (actor death / worker crash / lost
+    object) exhausted its resubmission budget (``kind="system"``), or a
+    UDF raised and the ``on_block_error`` policy surfaced it — directly
+    under ``"raise"``, or once skipped blocks exceeded
+    ``max_errored_blocks`` under ``"skip"`` (``kind="application"``)."""
+
+    def __init__(self, msg: str, *, block_id=None, stage: str = "",
+                 kind: str = "application"):
+        self.block_id = block_id
+        self.stage = stage
+        self.kind = kind
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (_rebuild_data_block_error,
+                (self.args[0], self.block_id, self.stage, self.kind))
+
+
+def _rebuild_data_block_error(msg, block_id, stage, kind):
+    return DataBlockError(msg, block_id=block_id, stage=stage, kind=kind)
+
+
 class RequestShedError(RayTpuError):
     """Admission control refused the request instead of queueing it.
 

@@ -1,0 +1,223 @@
+"""Data-plane fault-injection chaos: SIGKILL map-pool actors mid-stream
+and delete shm block copies mid-pipeline; the executor must recover.
+
+(reference capability: lineage-backed recovery as a dataplane property —
+Ray paper arXiv:1712.05889 §4; Ray Data's per-block retry + actor-pool
+supervision, python/ray/data/_internal/execution/.)
+
+The headline test SIGKILLs a map-pool actor's worker process while an
+`iter_batches` consumer is mid-stream: the supervised `_ActorPool` must
+detect the death (task failure + `actor_info` liveness probe), replace
+the actor within the restart budget, re-dispatch the dead actor's
+in-flight payloads from the executor's retained inputs, and finish the
+run BIT-EXACT versus an unkilled run — same rows, same order — with the
+pool back at its target size, the replacement/retry counters advanced,
+and zero leaked `/dev/shm/rtpu_*` segments after shutdown. A second test
+deletes a result block's only shm copy mid-pipeline and asserts the
+consume path refills it through lineage reconstruction. Stays behind
+`-m slow` so tier-1 stays fast (style: test_dag_chaos.py).
+"""
+
+import glob
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu._private import api as _api
+from ray_tpu._private.constants import SHM_DIR, SHM_SESSION_PREFIX
+from ray_tpu.data.execution import StreamingExecutor, _robust_get
+
+pytestmark = [pytest.mark.data_chaos, pytest.mark.slow]
+
+
+def _shm_files():
+    return set(glob.glob(SHM_DIR + "/" + SHM_SESSION_PREFIX + "*"))
+
+
+@pytest.fixture
+def chaos_cluster():
+    ray_tpu.shutdown()
+    before = _shm_files()
+    ray_tpu.init(num_cpus=16, num_workers=2, max_workers=10)
+    yield before
+    ray_tpu.shutdown()
+    leaked = _shm_files() - before
+    assert not leaked, f"/dev/shm segment leak: {leaked}"
+
+
+def _actor_rows():
+    rows = _api._get_worker().rpc({"type": "list_workers"}).get(
+        "workers", [])
+    return {r["actor_id"]: r for r in rows
+            if r.get("actor_id") and not r.get("dead")}
+
+
+def _sigkill_actor(actor) -> int:
+    rows = _api._get_worker().rpc({"type": "list_workers"}).get(
+        "workers", [])
+    pid = next(r["pid"] for r in rows
+               if r.get("actor_id") == actor._actor_id and not r.get("dead"))
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def _metric_total(name: str) -> float:
+    from ray_tpu.util import metrics
+
+    return sum(value
+               for m in metrics.snapshot() if m["name"] == name
+               for _tags, value in m["series"])
+
+
+def _slow_triple():
+    # closure (not a module-level def): the worker can't import this
+    # test module, so the UDF must pickle by value with no globals
+    def fn(batch):
+        import time as _t
+
+        _t.sleep(0.15)  # keep work in flight when the SIGKILL lands
+        return {"id": batch["id"], "v": batch["id"] * 3.0}
+
+    return fn
+
+
+def _pool_pipeline():
+    return rd.range(1000, parallelism=8).map_batches(
+        _slow_triple(), compute="actors", concurrency=2)
+
+
+def _drain_batches(ds, batch_size=100, kill_after=None):
+    """Concatenate every iter_batches row; optionally SIGKILL one NEW
+    pool actor right after the first batch arrives."""
+    pre = set(_actor_rows()) if kill_after is not None else set()
+    ids, vals, killed = [], [], False
+    for batch in ds.iter_batches(batch_size=batch_size):
+        ids.append(np.asarray(batch["id"]))
+        vals.append(np.asarray(batch["v"]))
+        if kill_after is not None and not killed:
+            fresh = {aid: r for aid, r in _actor_rows().items()
+                     if aid not in pre}
+            assert fresh, "no live map-pool actor found to kill"
+            os.kill(next(iter(fresh.values()))["pid"], signal.SIGKILL)
+            killed = True
+    assert kill_after is None or killed
+    return np.concatenate(ids), np.concatenate(vals)
+
+
+def test_sigkill_pool_actor_mid_iter_batches_bit_exact(chaos_cluster):
+    """Headline: SIGKILL a map-pool actor mid-`iter_batches` → the run
+    finishes bit-exact vs an unkilled run, with supervision visible in
+    the replacement/retry counters and the data.* event log."""
+    from ray_tpu._private import events as _events
+
+    want_ids, want_vals = _drain_batches(_pool_pipeline())
+
+    _events.reset()
+    retries0 = _metric_total("ray_tpu_data_block_retries_total")
+    repl0 = _metric_total("ray_tpu_data_actor_replacements_total")
+
+    got_ids, got_vals = _drain_batches(_pool_pipeline(), kill_after=1)
+
+    assert np.array_equal(got_ids, want_ids)
+    assert np.array_equal(got_vals, want_vals)
+    assert _metric_total("ray_tpu_data_actor_replacements_total") > repl0
+    assert _metric_total("ray_tpu_data_block_retries_total") > retries0
+    etypes = {e["etype"] for e in _events.recent()}
+    assert "data.actor_replaced" in etypes
+    assert "data.block_retry" in etypes
+
+
+def test_pool_restored_to_target_size_after_kill(chaos_cluster):
+    """Direct-executor drive: kill a pool actor by handle, finish the
+    run, and inspect the pool — back at target size, one replacement
+    consumed, zero errored blocks (system retries are not errors)."""
+    ex = StreamingExecutor(_pool_pipeline()._stages())
+    gen = ex.execute()
+    blocks = []
+
+    def _take(item):
+        got = _robust_get(item, rng=ex._rng) if hasattr(item, "hex") else item
+        ex._free_if_owned(item)
+        blocks.extend(got if isinstance(got, list) else [got])
+
+    try:
+        _take(next(gen))
+        pool = next(iter(ex._actor_pools))
+        _sigkill_actor(pool.actors[0])
+        for item in gen:
+            _take(item)
+    finally:
+        ex.release_owned()
+
+    ids = np.concatenate([np.asarray(b["id"]) for b in blocks])
+    assert np.array_equal(ids, np.arange(1000))
+    assert len(pool.actors) == 2, "pool not restored to target size"
+    assert pool.replacements >= 1
+    assert ex.errored_blocks == 0  # system failures never consume budget
+    assert not ex.owned, "executor leaked owned refs"
+
+
+def _widen():
+    def fn(batch):
+        import numpy as _np
+
+        n = len(batch["id"])
+        # 64 float64 columns per row pushes every block well past the
+        # inline-object limit, so results live as shm segments with lineage
+        return {"id": batch["id"],
+                "pad": _np.ones((n, 64), dtype=_np.float64)}
+
+    return fn
+
+
+def test_lost_block_copies_refilled_by_lineage(chaos_cluster):
+    """Destroy a finished result block's ONLY copy mid-stream — delete it
+    from the host arena and purge every driver-side cache — before the
+    consumer reads it: the consume path must replay the retained lineage
+    spec (the fused read+map task) and refill the block bit-exact.
+
+    The consume loop mirrors iter_result_blocks: each item materializes
+    while the generator is LIVE. Exhausting the generator first would
+    free the yielded refs (release_owned) and turn this into
+    use-after-free, not loss-injection."""
+    ex = StreamingExecutor(
+        rd.range(1000, parallelism=4).map_batches(_widen())._stages())
+    w = _api._worker
+    blocks = []
+
+    def _take(item):
+        got = (_robust_get(item, rng=ex._rng)
+               if hasattr(item, "hex") else item)
+        ex._free_if_owned(item)
+        blocks.extend(got if isinstance(got, list) else [got])
+
+    gen = ex.execute()
+    deleted = None
+    try:
+        _take(next(gen))
+        for item in gen:
+            if (deleted is None and hasattr(item, "hex")
+                    and w.store.contains(item.hex())):
+                oid = item.hex()
+                # the arena holds the only copy; the driver-side caches
+                # (value cache, pinned view, status) must go too or the
+                # get would never notice the loss
+                w.store.delete(oid)
+                w._memory.pop(oid, None)
+                w._plasma_refs.pop(oid, None)
+                w._status_cache.pop(oid, None)
+                deleted = oid
+            _take(item)
+    finally:
+        ex.release_owned()
+
+    assert deleted, "no shm-resident result block was available to delete"
+    ids = np.concatenate([np.asarray(b["id"]) for b in blocks])
+    assert np.array_equal(np.sort(ids), np.arange(1000))
+    assert all(b["pad"].shape[1] == 64 and float(b["pad"].sum())
+               == b["pad"].size for b in blocks)
+    assert ex.errored_blocks == 0  # reconstruction is not an app error

@@ -36,6 +36,7 @@ if REPO not in sys.path:
 from tools.graft_check import (load_baseline, run_checks,  # noqa: E402
                                run_default)
 from tools.graft_check.checkers import (AsyncBlockingChecker,  # noqa: E402
+                                        BoundedRetryChecker,
                                         EventLiteralChecker,
                                         LockDisciplineChecker,
                                         LockOrderChecker,
@@ -1177,6 +1178,14 @@ FIRING_FIXTURES = {
                   "    except Exception:\n"
                   "        pass\n")},
         lambda: [SilentSwallowChecker()]),
+    "bounded-retry": (
+        {"m.py": ("def f(w):\n"
+                  "    while True:\n"
+                  "        try:\n"
+                  "            return w.rpc({'type': 'ping'})\n"
+                  "        except Exception:\n"
+                  "            continue\n")},
+        lambda: [BoundedRetryChecker()]),
     "metric-name": (
         {"m.py": ("from ray_tpu.util.metrics import Counter\n"
                   "c = Counter('bad_name')\n")},

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from tools.graft_check.checkers.async_blocking import AsyncBlockingChecker
+from tools.graft_check.checkers.bounded_retry import BoundedRetryChecker
 from tools.graft_check.checkers.event_literals import EventLiteralChecker
 from tools.graft_check.checkers.lock_discipline import LockDisciplineChecker
 from tools.graft_check.checkers.lock_order import LockOrderChecker
@@ -33,6 +34,7 @@ ALL_CHECKERS = (
     SilentSwallowChecker,
     RpcPairingChecker,
     RpcFieldSchemaChecker,
+    BoundedRetryChecker,
     MetricNamesChecker,
     EventLiteralChecker,
 )
@@ -53,7 +55,8 @@ def all_check_ids():
 
 
 __all__ = ["ALL_CHECKERS", "make_suite", "all_check_ids", "EXPECTED_METRICS",
-           "AsyncBlockingChecker", "EventLiteralChecker",
+           "AsyncBlockingChecker", "BoundedRetryChecker",
+           "EventLiteralChecker",
            "LockDisciplineChecker",
            "LockOrderChecker", "MetricNamesChecker", "PersistOrderChecker",
            "ResourceLeakChecker", "RpcFieldSchemaChecker",

@@ -1,0 +1,184 @@
+"""bounded-retry: every retry loop has a bound and a backoff.
+
+A retry loop — a `while` (or `for ... in range(...)` attempt budget)
+that wraps an RPC / IO / remote call in a `try` which EXITS the loop on
+success (`return`/`break` in the try body) and whose handler lets the
+loop run again — must (a) be bounded: a finite attempt budget, a
+`while` with a real condition, or a conditional `raise`/`return`/
+`break` escape inside the loop, and (b) back off between attempts: a
+`sleep`/backoff call in the loop body. Fan-out loops (`for w in
+workers: try: w.rpc(...)`) and daemon/serve loops (`while running:
+try: handle()`) re-loop over NEW work, not the same attempt — they are
+deliberately out of scope.
+An unbounded retry turns a dead peer into a silent hang, and a
+tight-spin retry turns a brownout into a DDoS of the very service that
+is struggling (the Data plane's `_read_with_retries` / `_robust_get`
+are the canonical shape). Deliberate forever-retry loops (connection
+keepalive, reconnect-until-shutdown) are baselined with `=N` pins.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from tools.graft_check.core import (Checker, Finding, ParsedModule,
+                                    blocking_call_desc, call_target)
+
+CHECK_ID = "bounded-retry"
+
+#: attribute calls that count as retryable work even when core's
+#: blocking-primitive table does not know them (submissions and socket /
+#: HTTP verbs; `.remote()` is the task/actor submission everywhere).
+RETRY_ATTRS = {"remote", "connect", "urlopen", "recv", "send", "sendall",
+               "accept", "request", "fetch", "read_file"}
+#: bare-name calls that count as retryable work (builtin/open-coded IO
+#: and injected reader callables, e.g. `reader(path)` in a datasource).
+RETRY_NAME_RE = re.compile(
+    r"^(open|urlopen|connect|reader|read_[a-z0-9_]+|fetch[a-z0-9_]*)$")
+#: calls that count as backoff (a plain `.wait(t)` does not — it waits
+#: for an event, not between attempts).
+BACKOFF_RE = re.compile(r"sleep|backoff", re.IGNORECASE)
+
+
+def _is_retryable_call(node: ast.Call) -> bool:
+    base, attr = call_target(node)
+    if not attr:
+        return False
+    if base == "" and RETRY_NAME_RE.match(attr):
+        return True
+    if attr in RETRY_ATTRS:
+        return True
+    desc = blocking_call_desc(node)
+    # blocking primitives are the RPC/IO nucleus; sleeping is pacing,
+    # not work
+    return desc is not None and attr != "sleep"
+
+
+def _iter_nodes_shallow(stmts, *, skip_loops: bool = False):
+    """Walk statements WITHOUT descending into nested function/class
+    definitions (their loops are judged in their own right), optionally
+    stopping at nested loops (an inner loop's try/except belongs to the
+    inner loop's verdict)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if skip_loops and isinstance(n, (ast.While, ast.For,
+                                         ast.AsyncFor)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _handler_reenters(handler: ast.ExceptHandler) -> bool:
+    """Can this handler fall through to (or `continue` into) another
+    iteration? An unconditional top-level raise/return/break says no."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Raise, ast.Return, ast.Break)):
+            return False
+    return True
+
+
+def _has_conditional_escape(loop) -> bool:
+    """A raise/return/break somewhere under an `if` inside the loop —
+    the `if attempt >= retries: raise` bound idiom."""
+    for n in _iter_nodes_shallow(loop.body, skip_loops=True):
+        if isinstance(n, ast.If):
+            for sub in ast.walk(n):
+                if isinstance(sub, (ast.Raise, ast.Return, ast.Break)):
+                    return True
+    return False
+
+
+def _has_backoff(loop) -> bool:
+    for n in _iter_nodes_shallow(loop.body, skip_loops=True):
+        if isinstance(n, ast.Call):
+            base, attr = call_target(n)
+            if attr and BACKOFF_RE.search(attr):
+                return True
+    return False
+
+
+def _while_true(loop) -> bool:
+    if not isinstance(loop, ast.While):
+        return False
+    t = loop.test
+    return isinstance(t, ast.Constant) and bool(t.value)
+
+
+def _attempt_budget_for(loop) -> bool:
+    """`for ... in range(...)` — the attempt-budget spelling of a retry
+    loop. Any other `for` iterates over WORK ITEMS, not attempts."""
+    if not isinstance(loop, ast.For):
+        return False
+    it = loop.iter
+    if not isinstance(it, ast.Call):
+        return False
+    _base, attr = call_target(it)
+    return attr == "range"
+
+
+def _exits_on_success(try_node: ast.Try) -> bool:
+    """A retry loop stops re-attempting once the call succeeds — a
+    `return`/`break` in the try body (or its else). Daemon loops keep
+    looping after success and are not retries."""
+    for n in _iter_nodes_shallow(list(try_node.body) + list(try_node.orelse),
+                                 skip_loops=True):
+        if isinstance(n, (ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _retry_try(loop) -> Optional[ast.Try]:
+    """The loop's top-level-ish Try that wraps retryable work, exits the
+    loop when that work succeeds, and whose handlers re-enter the loop —
+    or None (not a retry loop)."""
+    if isinstance(loop, ast.For) and not _attempt_budget_for(loop):
+        return None
+    for n in _iter_nodes_shallow(loop.body, skip_loops=True):
+        if not isinstance(n, ast.Try):
+            continue
+        work = any(isinstance(c, ast.Call) and _is_retryable_call(c)
+                   for stmt in n.body
+                   for c in ast.walk(stmt))
+        if not work:
+            continue
+        if not _exits_on_success(n):
+            continue
+        if any(_handler_reenters(h) for h in n.handlers):
+            return n
+    return None
+
+
+class BoundedRetryChecker(Checker):
+    ids = (
+        (CHECK_ID,
+         "retry loops around RPC/IO/remote calls have a bound and a "
+         "backoff call"),
+    )
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            if _retry_try(node) is None:
+                continue
+            missing = []
+            # a `for` iterates a finite budget; a real `while` condition
+            # is its own bound; `while True` needs a conditional escape
+            if _while_true(node) and not _has_conditional_escape(node):
+                missing.append("a bound (finite attempts or a "
+                               "conditional raise/break)")
+            if not _has_backoff(node):
+                missing.append("a backoff call between attempts")
+            if missing:
+                out.append(mod.finding(
+                    CHECK_ID, node,
+                    "retry loop around an RPC/IO/remote call lacks "
+                    + " and ".join(missing)))
+        return out

@@ -110,6 +110,13 @@ EXPECTED_METRICS = (
     "ray_tpu_sched_decision_seconds",
     "ray_tpu_sched_decisions_total",
     "ray_tpu_sched_pending",
+    # data-plane fault tolerance (data/execution.py): per-pipeline block
+    # resubmissions after SYSTEM failures, map-pool actors replaced by
+    # supervision, and APPLICATION-errored blocks skipped under the
+    # `on_block_error="skip"` policy (never silently dropped)
+    "ray_tpu_data_block_retries_total",
+    "ray_tpu_data_actor_replacements_total",
+    "ray_tpu_data_blocks_errored_total",
 )
 
 

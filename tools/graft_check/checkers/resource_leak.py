@@ -131,6 +131,12 @@ PAIRS: Tuple[ResourcePair, ...] = (
         release_attrs=("release",),
         what="semaphore/occupancy slot"),
     ResourcePair(
+        "executor-owned-refs",
+        acquire_calls=("StreamingExecutor",),
+        release_attrs=("release_owned", "shutdown"),
+        what="streaming-executor owned-ref ledger (intermediate blocks "
+             "stay pinned in the object store until released)"),
+    ResourcePair(
         "bound-series",
         acquire_attrs=("bind",), recv_re=r"hist|metr|_m_|_h_",
         release_arg_attrs=("remove", "retire"),
