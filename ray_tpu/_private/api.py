@@ -138,6 +138,12 @@ def shutdown():
         if _namespace_env_set:
             os.environ.pop("RAY_TPU_NAMESPACE", None)
             _namespace_env_set = False
+        # same isolation story for the node-drain notice: it names a node
+        # of the session that just ended, and would read as a phantom
+        # preemption to the next init()'s train sessions
+        from ray_tpu._private.worker import _reset_drain
+
+        _reset_drain()
         try:
             atexit.unregister(shutdown)
         except Exception:

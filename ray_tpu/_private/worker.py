@@ -1456,8 +1456,12 @@ class CoreWorker:
         if self._status_cache.get(oid) == "ready":
             return None
         try:
+            # short timeout: callers hold the contract that the ref is
+            # already wait()-ready, so the GCS answers immediately — and
+            # this runs inside the executor's pump loop, where a long
+            # block per cache-missed ref would stall driver-side dispatch
             reply = self.rpc({"type": "wait_object", "oid": oid},
-                             timeout=30.0)
+                             timeout=2.0)
         except Exception:
             return None
         self._note_locations(oid, reply)
@@ -2066,9 +2070,20 @@ def _set_drain(msg: dict) -> None:
     _drain_event.set()
 
 
+def _reset_drain() -> None:
+    """Forget the previous session's drain notice (called from
+    shutdown()): the notice names a node of a cluster that no longer
+    exists, and a fresh init() in the same process would otherwise see a
+    phantom preemption on its first train step."""
+    global _drain_info
+    _drain_info = None
+    _drain_event.clear()
+
+
 def drain_info() -> dict | None:
     """The drain notice this process received, or None. Sticky for the
-    process lifetime: a draining node never un-drains."""
+    session lifetime: a draining node never un-drains while its cluster
+    is alive."""
     return _drain_info
 
 

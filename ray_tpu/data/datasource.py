@@ -133,9 +133,16 @@ def _read_with_retries(reader: Callable, path: str) -> list:
             raise
         except OSError as exc:
             if attempt >= retries:
-                raise OSError(
-                    f"read of {path!r} failed after {attempt + 1} "
-                    f"attempt(s): {exc}") from exc
+                # re-raise as the SAME subclass: upstream handlers dispatch
+                # on the OSError subtype (PermissionError vs ConnectionError
+                # vs ...), which a bare OSError wrapper would collapse
+                msg = (f"read of {path!r} failed after {attempt + 1} "
+                       f"attempt(s): {exc}")
+                try:
+                    wrapped = type(exc)(msg)
+                except Exception:
+                    wrapped = OSError(msg)  # exotic constructor signature
+                raise wrapped from exc
             _time.sleep(_random.uniform(
                 0.0, min(base * (2 ** attempt), base * 8.0)))
             attempt += 1

@@ -1356,12 +1356,22 @@ class StreamingExecutor:
             # favors it — the retried block is the oldest pending work)
             if retry_heap:
                 now = time.monotonic()
+                deferred = []
                 while retry_heap and retry_heap[0][0] <= now:
-                    _, _, j, item = heapq.heappop(retry_heap)
+                    entry = heapq.heappop(retry_heap)
+                    _, _, j, item = entry
                     if j < 0:
                         source_payloads.appendleft(item)
-                    else:
+                    elif _q_room(j):
                         _q_add(j, item)
+                    else:
+                        # queue full: the retry stays parked on the heap
+                        # (already due, so the next pump re-probes) rather
+                        # than overshooting the max_queued/byte budgets —
+                        # barrier gating and all_done() still see it pending
+                        deferred.append(entry)
+                for entry in deferred:
+                    heapq.heappush(retry_heap, entry)
 
             # source dispatch
             while (source_payloads and len(src_in_flight) < first.max_in_flight
@@ -1465,7 +1475,13 @@ class StreamingExecutor:
                             pool.note_done(r.hex())
                         _q_add(i + 1, r)
                     for r, exc in bad:
-                        _, item = in_flight[i].pop(r.hex())
+                        # default pop: a second failed task of the same dead
+                        # actor may already have been handled as an orphan of
+                        # the first — each failure is classified exactly once
+                        entry = in_flight[i].pop(r.hex(), None)
+                        if entry is None:
+                            continue
+                        _, item = entry
                         if hasattr(pool, "note_failed"):
                             # pool supervision: probe + replace the dead
                             # actor, then re-dispatch every OTHER payload

@@ -161,6 +161,47 @@ def test_pool_restored_to_target_size_after_kill(chaos_cluster):
     assert not ex.owned, "executor leaked owned refs"
 
 
+def test_dead_actor_multi_task_batch_failure(chaos_cluster):
+    """Regression: SIGKILL an actor holding SEVERAL in-flight payloads
+    (single-actor pool with max_in_flight raised) — they all come back
+    errored in the same wait batch. The first failure's orphan handling
+    re-dispatches the siblings from retained inputs; the loop must then
+    skip the siblings' own entries in the failed batch (each failure
+    classified exactly once), not KeyError the pump loop."""
+    stages = rd.range(1000, parallelism=8).map_batches(
+        _slow_triple(), compute="actors", concurrency=1)._stages()
+    stage = next(s for s in stages if s.compute == "actors")
+    stage.max_in_flight = 4  # 4 payloads in flight on the ONE pool actor
+    ex = StreamingExecutor(stages)
+    gen = ex.execute()
+    blocks = []
+
+    def _take(item):
+        got = _robust_get(item, rng=ex._rng) if hasattr(item, "hex") else item
+        ex._free_if_owned(item)
+        blocks.extend(got if isinstance(got, list) else [got])
+
+    try:
+        _take(next(gen))
+        pool = next(iter(ex._actor_pools))
+        assert len(pool.actors) == 1
+        while len(pool._outstanding) < 2:
+            _take(next(gen))  # pump until >= 2 payloads share the actor
+        _sigkill_actor(pool.actors[0])
+        for item in gen:
+            _take(item)
+    finally:
+        ex.release_owned()
+
+    ids = np.concatenate([np.asarray(b["id"]) for b in blocks])
+    vals = np.concatenate([np.asarray(b["v"]) for b in blocks])
+    assert np.array_equal(ids, np.arange(1000))
+    assert np.array_equal(vals, np.arange(1000) * 3.0)
+    assert pool.replacements >= 1
+    assert ex.errored_blocks == 0  # system failures never consume budget
+    assert not ex.owned, "executor leaked owned refs"
+
+
 def _widen():
     def fn(batch):
         import numpy as _np
